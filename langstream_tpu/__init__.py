@@ -1,0 +1,34 @@
+"""langstream-tpu: a TPU-native event-driven streaming platform for LLM applications.
+
+Capability parity target: LangStream (reference), an event-driven streaming
+platform where applications are declared as YAML (pipelines of agents wired by
+topics, plus gateways, resources, secrets and assets), planned into an
+execution graph, and executed by replicated agent runtimes that consume and
+produce records on topics, with a WebSocket/HTTP gateway for chat clients.
+
+The key divergence from the reference: model inference is **in-tree and
+TPU-resident**. The AI agents (``ai-chat-completions``, ``ai-text-completions``,
+``compute-ai-embeddings``) feed micro-batched records into a JAX/XLA serving
+engine (continuous batching, ``NamedSharding``-sharded parameters over ICI
+meshes, Pallas kernels on the hot ops) instead of calling external SaaS APIs.
+
+Package map (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``api``      — L1 kernel SPIs: records, agent contracts, topic contracts,
+                 the application model, execution plans, registries.
+- ``core``     — L2: YAML parser, placeholder resolution, planner + agent
+                 fusion optimiser, deployer facade, expression language.
+- ``runtime``  — L3a/L4: streaming runtimes (in-memory broker; gated Kafka)
+                 and the agent-runner hot loop with at-least-once commits.
+- ``agents``   — L7: the agent library (AI, text, flow-control, http,
+                 vector stores, sources, custom-python).
+- ``models``   — JAX model zoo: MiniLM-class encoders, Llama-family decoders.
+- ``ops``      — Pallas/TPU kernels and XLA-friendly primitive ops.
+- ``serving``  — the continuous-batching TPU serving engine.
+- ``parallel`` — meshes, sharding rules, ring attention, collectives.
+- ``gateway``  — WebSocket/HTTP gateway (produce/consume/chat/service).
+- ``controlplane`` — REST control plane + stores.
+- ``cli``      — command line interface.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""L7: the built-in agent library.
+
+Importing this package registers every built-in agent type with
+:class:`~langstream_tpu.api.registry.AgentCodeRegistry` and its planner
+metadata with :func:`~langstream_tpu.core.planner.register_agent_type`
+(parity: the reference's NAR-packaged ``AgentCodeProvider``s plus the
+per-agent planner providers in ``langstream-k8s-runtime``).
+"""
+
+from langstream_tpu.agents import builtin  # noqa: F401  (registers everything)

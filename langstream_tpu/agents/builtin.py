@@ -1,0 +1,94 @@
+"""Registration of every built-in agent type.
+
+This is the single registration point (parity: per-family
+``AgentCodeProvider``s discovered from NARs, e.g. ``GenAIAgentCodeProvider``,
+plus the planner metadata providers under ``langstream-k8s-runtime``).
+"""
+
+from __future__ import annotations
+
+from langstream_tpu.api.agent import ComponentType
+from langstream_tpu.api.registry import AgentCodeProvider, AgentCodeRegistry
+from langstream_tpu.core.planner import register_agent_type
+
+from langstream_tpu.agents import transform, text, flow, ai, vector, http, storage
+from langstream_tpu.agents import python_custom, webcrawler
+
+SOURCE = ComponentType.SOURCE
+PROCESSOR = ComponentType.PROCESSOR
+SINK = ComponentType.SINK
+SERVICE = ComponentType.SERVICE
+
+_FACTORIES = {
+    # GenAI transform steps
+    "cast": transform.CastStep,
+    "compute": transform.ComputeStep,
+    "drop": transform.DropStep,
+    "drop-fields": transform.DropFieldsStep,
+    "flatten": transform.FlattenStep,
+    "merge-key-value": transform.MergeKeyValueStep,
+    "unwrap-key-value": transform.UnwrapKeyValueStep,
+    # AI
+    "ai-chat-completions": ai.ChatCompletionsAgent,
+    "ai-text-completions": ai.TextCompletionsAgent,
+    "compute-ai-embeddings": ai.ComputeAIEmbeddingsAgent,
+    "query": ai.QueryAgent,
+    "re-rank": ai.ReRankAgent,
+    "flare-controller": ai.FlareControllerAgent,
+    # text processing
+    "text-extractor": text.TextExtractorAgent,
+    "text-splitter": text.TextSplitterAgent,
+    "text-normaliser": text.TextNormaliserAgent,
+    "language-detector": text.LanguageDetectorAgent,
+    "document-to-json": text.DocumentToJsonAgent,
+    # flow control
+    "dispatch": flow.DispatchAgent,
+    "timer-source": flow.TimerSource,
+    "trigger-event": flow.TriggerEventProcessor,
+    "log-event": flow.LogEventProcessor,
+    # vector stores
+    "vector-db-sink": vector.VectorDBSinkAgent,
+    "query-vector-db": vector.QueryVectorDBAgent,
+    # http
+    "http-request": http.HttpRequestAgent,
+    "langserve-invoke": http.LangServeInvokeAgent,
+    # sources
+    "webcrawler": webcrawler.WebCrawlerSource,
+    "local-storage-source": storage.LocalStorageSource,
+    "s3-source": storage.make_s3_source,
+    "azure-blob-storage-source": storage.make_azure_source,
+    # custom python (in-process; no gRPC hop needed — see python_custom.py)
+    "python-processor": python_custom.PythonProcessorAgent,
+    "python-function": python_custom.PythonProcessorAgent,
+    "experimental-python-processor": python_custom.PythonProcessorAgent,
+    "python-source": python_custom.PythonSourceAgent,
+    "experimental-python-source": python_custom.PythonSourceAgent,
+    "python-sink": python_custom.PythonSinkAgent,
+    "experimental-python-sink": python_custom.PythonSinkAgent,
+    "python-service": python_custom.PythonServiceAgent,
+    "experimental-python-service": python_custom.PythonServiceAgent,
+}
+
+_METADATA = {
+    # component type, composable
+    "timer-source": (SOURCE, True),
+    "webcrawler": (SOURCE, True),
+    "local-storage-source": (SOURCE, True),
+    "s3-source": (SOURCE, True),
+    "azure-blob-storage-source": (SOURCE, True),
+    "python-source": (SOURCE, True),
+    "experimental-python-source": (SOURCE, True),
+    "vector-db-sink": (SINK, True),
+    "python-sink": (SINK, True),
+    "experimental-python-sink": (SINK, True),
+    "python-service": (SERVICE, False),
+    "experimental-python-service": (SERVICE, False),
+}
+
+AgentCodeRegistry.register_provider(
+    AgentCodeProvider({name: factory for name, factory in _FACTORIES.items()})
+)
+
+for name in _FACTORIES:
+    component_type, composable = _METADATA.get(name, (PROCESSOR, True))
+    register_agent_type(name, component_type, composable)

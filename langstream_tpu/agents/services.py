@@ -1,0 +1,235 @@
+"""Model-service SPI: completions + embeddings behind one interface.
+
+Parity: the reference's ``ServiceProvider`` SPI
+(``langstream-ai-agents/.../services/ServiceProvider.java:24`` →
+``CompletionsService.java:22`` with ``StreamingChunksConsumer`` and
+``embeddings/EmbeddingsService.java:25``), where implementations are HTTP
+clients for OpenAI/VertexAI/Bedrock/HuggingFace/Ollama.
+
+The TPU-native divergence: the first-party provider is **in-tree** — the
+``tpu-serving-configuration`` resource spins up (or attaches to) a local JAX
+serving engine (``langstream_tpu.serving``) so completions/embeddings run on
+the chips in this pod, not behind SaaS HTTP. External OpenAI-compatible HTTP
+providers remain available (gated on network) for parity.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable
+
+
+@dataclass
+class Chunk:
+    """One streamed completion fragment."""
+
+    text: str
+    index: int
+    last: bool = False
+
+
+@dataclass
+class CompletionResult:
+    text: str
+    num_prompt_tokens: int = 0
+    num_completion_tokens: int = 0
+    finish_reason: str = "stop"
+
+
+StreamingChunksConsumer = Callable[[Chunk], Any]
+
+
+class CompletionsService(abc.ABC):
+    @abc.abstractmethod
+    async def chat_completions(
+        self,
+        messages: list[dict[str, str]],
+        options: dict[str, Any],
+        consumer: StreamingChunksConsumer | None = None,
+    ) -> CompletionResult: ...
+
+    @abc.abstractmethod
+    async def text_completions(
+        self,
+        prompt: str,
+        options: dict[str, Any],
+        consumer: StreamingChunksConsumer | None = None,
+    ) -> CompletionResult: ...
+
+
+class EmbeddingsService(abc.ABC):
+    @abc.abstractmethod
+    async def compute_embeddings(self, texts: list[str]) -> list[list[float]]: ...
+
+
+class ServiceProvider(abc.ABC):
+    @abc.abstractmethod
+    def get_completions_service(self, config: dict[str, Any]) -> CompletionsService: ...
+
+    @abc.abstractmethod
+    def get_embeddings_service(self, config: dict[str, Any]) -> EmbeddingsService: ...
+
+    async def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# provider resolution from application resources
+# ---------------------------------------------------------------------------
+
+# resource ``type:`` → provider factory name. Mirrors the reference's
+# resource types so existing configuration.yaml files keep working.
+_PROVIDER_RESOURCE_TYPES = [
+    "tpu-serving-configuration",
+    "mock-serving-configuration",
+    "open-ai-configuration",
+    "hugging-face-configuration",
+    "ollama-configuration",
+    "vertex-configuration",
+    "bedrock-configuration",
+]
+
+_provider_factories: dict[str, Callable[[dict[str, Any]], ServiceProvider]] = {}
+
+
+def register_provider(
+    resource_type: str, factory: Callable[[dict[str, Any]], ServiceProvider]
+) -> None:
+    _provider_factories[resource_type] = factory
+
+
+def resolve_service_provider(resources: dict[str, dict[str, Any]]) -> ServiceProvider:
+    """Pick the provider from the application's shared resources (parity:
+    the GenAI toolkit scans configured resources for a supported type)."""
+    for rtype in _PROVIDER_RESOURCE_TYPES:
+        for resource in resources.values():
+            if resource.get("type") == rtype and rtype in _provider_factories:
+                return _provider_factories[rtype](resource)
+    # No explicit provider: default to the in-tree TPU engine when
+    # configured globally, else the deterministic mock (tests, dry runs).
+    if "tpu-serving-configuration" in _provider_factories:
+        for resource in resources.values():
+            if resource.get("type") == "tpu-serving-configuration":
+                return _provider_factories["tpu-serving-configuration"](resource)
+    return MockServiceProvider({})
+
+
+# ---------------------------------------------------------------------------
+# mock provider (deterministic; the WireMock analogue for our tests)
+# ---------------------------------------------------------------------------
+
+
+class MockCompletionsService(CompletionsService):
+    def __init__(self, config: dict[str, Any]):
+        self.config = config
+        self.reply = config.get("reply")
+        self.chunk_delay = float(config.get("chunk-delay", 0))
+
+    def _answer(self, prompt: str) -> str:
+        if self.reply is not None:
+            return str(self.reply)
+        return f"mock-answer:{prompt[-40:]}"
+
+    async def _stream(
+        self, text: str, consumer: StreamingChunksConsumer | None
+    ) -> None:
+        if consumer is None:
+            return
+        words = text.split(" ")
+        for i, w in enumerate(words):
+            chunk = Chunk(
+                text=w if i == 0 else " " + w, index=i, last=i == len(words) - 1
+            )
+            result = consumer(chunk)
+            if asyncio.iscoroutine(result):
+                await result
+            if self.chunk_delay:
+                await asyncio.sleep(self.chunk_delay)
+
+    async def chat_completions(
+        self,
+        messages: list[dict[str, str]],
+        options: dict[str, Any],
+        consumer: StreamingChunksConsumer | None = None,
+    ) -> CompletionResult:
+        prompt = " ".join(m.get("content", "") for m in messages)
+        text = self._answer(prompt)
+        await self._stream(text, consumer)
+        return CompletionResult(
+            text=text,
+            num_prompt_tokens=len(prompt.split()),
+            num_completion_tokens=len(text.split()),
+        )
+
+    async def text_completions(
+        self,
+        prompt: str,
+        options: dict[str, Any],
+        consumer: StreamingChunksConsumer | None = None,
+    ) -> CompletionResult:
+        text = self._answer(prompt)
+        await self._stream(text, consumer)
+        return CompletionResult(
+            text=text,
+            num_prompt_tokens=len(prompt.split()),
+            num_completion_tokens=len(text.split()),
+        )
+
+
+class MockEmbeddingsService(EmbeddingsService):
+    """Deterministic hash-bucket embeddings: equal texts → equal vectors."""
+
+    def __init__(self, config: dict[str, Any]):
+        self.dimensions = int(config.get("dimensions", 8))
+
+    async def compute_embeddings(self, texts: list[str]) -> list[list[float]]:
+        out = []
+        for text in texts:
+            vec = [0.0] * self.dimensions
+            for tok in text.lower().split():
+                vec[hash(tok) % self.dimensions] += 1.0
+            norm = sum(v * v for v in vec) ** 0.5 or 1.0
+            out.append([v / norm for v in vec])
+        return out
+
+
+@dataclass
+class MockServiceProvider(ServiceProvider):
+    config: dict[str, Any] = field(default_factory=dict)
+
+    def get_completions_service(self, config: dict[str, Any]) -> CompletionsService:
+        return MockCompletionsService({**self.config, **config})
+
+    def get_embeddings_service(self, config: dict[str, Any]) -> EmbeddingsService:
+        return MockEmbeddingsService({**self.config, **config})
+
+
+register_provider("mock-serving-configuration", lambda cfg: MockServiceProvider(cfg))
+
+
+def _tpu_provider(cfg: dict[str, Any]) -> ServiceProvider:
+    # lazy import: keeps JAX out of control-plane processes
+    try:
+        from langstream_tpu.agents.tpu_provider import TpuServiceProvider
+    except ImportError as e:  # pragma: no cover - serving ships in-tree
+        raise RuntimeError(
+            "tpu-serving-configuration requires the langstream_tpu.serving "
+            f"engine, which failed to import: {e}"
+        ) from e
+
+    return TpuServiceProvider(cfg)
+
+
+register_provider("tpu-serving-configuration", _tpu_provider)
+
+
+def _openai_provider(cfg: dict[str, Any]) -> ServiceProvider:
+    from langstream_tpu.agents.http_providers import OpenAICompatProvider
+
+    return OpenAICompatProvider(cfg)
+
+
+register_provider("open-ai-configuration", _openai_provider)
+register_provider("ollama-configuration", _openai_provider)

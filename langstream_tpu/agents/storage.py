@@ -1,0 +1,110 @@
+"""Object-storage sources + the local-directory source.
+
+Parity: ``langstream-agent-s3`` (``agents/s3/S3Source.java`` — list/read,
+delete-on-commit, idle polling) and
+``langstream-agent-azure-blob-storage-source``. Neither MinIO nor Azure SDKs
+are baked into this image, so those gate on their client libraries; the
+first-party equivalent is ``local-storage-source`` (same list/read/
+delete-on-commit contract against a directory), which the tests and dev mode
+use the way the reference's tests use MinIO testcontainers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from pathlib import Path
+from typing import Any
+
+from langstream_tpu.api.agent import AgentSource
+from langstream_tpu.api.record import Record, make_record
+
+
+class LocalStorageSource(AgentSource):
+    """``local-storage-source``: emits one record per file in a directory.
+
+    Config: ``path``, ``extensions`` (filter), ``delete-on-commit`` (default
+    true), ``idle-time`` (seconds between polls).
+    """
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        await super().init(configuration)
+        self.path = Path(configuration["path"])
+        self.extensions = set(configuration.get("extensions", []))
+        self.delete_on_commit = bool(configuration.get("delete-on-commit", True))
+        self.idle_time = float(configuration.get("idle-time", 1.0))
+        self._emitted: set[str] = set()
+
+    async def read(self) -> list[Record]:
+        if not self.path.is_dir():
+            await asyncio.sleep(self.idle_time)
+            return []
+        out: list[Record] = []
+        for file in sorted(self.path.iterdir()):
+            if not file.is_file():
+                continue
+            if self.extensions and file.suffix.lstrip(".") not in self.extensions:
+                continue
+            if str(file) in self._emitted:
+                continue
+            data = file.read_bytes()
+            try:
+                value: Any = data.decode("utf-8")
+            except UnicodeDecodeError:
+                value = data
+            out.append(
+                make_record(
+                    value=value,
+                    key=file.name,
+                    headers={"name": file.name, "path": str(file)},
+                )
+            )
+            self._emitted.add(str(file))
+        if not out:
+            await asyncio.sleep(self.idle_time)
+        return out
+
+    async def commit(self, records: list[Record]) -> None:
+        if not self.delete_on_commit:
+            return
+        for record in records:
+            path = record.header("path")
+            if path:
+                Path(path).unlink(missing_ok=True)
+                self._emitted.discard(path)
+
+
+def _gated_source(name: str, lib: str):
+    class _Gated(AgentSource):
+        async def init(self, configuration: dict[str, Any]) -> None:
+            raise RuntimeError(
+                f"agent {name!r} requires the {lib!r} client library, which is "
+                f"not available in this environment"
+            )
+
+        async def read(self) -> list[Record]:
+            return []
+
+    _Gated.__name__ = f"Gated{name.title().replace('-', '')}"
+    return _Gated
+
+
+def make_s3_source() -> AgentSource:
+    try:
+        import minio  # noqa: F401
+
+        from langstream_tpu.agents.s3_impl import S3Source  # pragma: no cover
+
+        return S3Source()
+    except ImportError:
+        return _gated_source("s3-source", "minio")()
+
+
+def make_azure_source() -> AgentSource:
+    try:
+        import azure.storage.blob  # noqa: F401
+
+        from langstream_tpu.agents.azure_impl import AzureBlobSource  # pragma: no cover
+
+        return AzureBlobSource()
+    except ImportError:
+        return _gated_source("azure-blob-storage-source", "azure-storage-blob")()

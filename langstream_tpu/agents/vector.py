@@ -1,0 +1,330 @@
+"""Vector-database agents + datasource SPI.
+
+Parity: ``langstream-vector-agents`` — ``vector-db-sink``
+(``agents/vector/VectorDBSinkAgent.java`` + per-store writers),
+``query-vector-db`` (+ per-store ``DataSource`` impls), and the asset
+managers that provision tables/collections.
+
+First-party store: an **in-process vector store** (NumPy brute-force cosine
+/ dot-product search, optional JSONL persistence under the agent's state
+dir) — the role HerdDB-with-vectors plays in the reference's dev mode.
+External stores (JDBC/PGVector, Cassandra/Astra, Pinecone, Milvus,
+OpenSearch, Solr) register behind the same SPI when their client libraries
+are importable; none are baked into this image, so they gate cleanly.
+
+Query format for the in-memory store: a JSON object (the reference sends
+store-native queries through the same string field, e.g. SQL for JDBC):
+
+    {"collection": "docs", "vector": ?, "top-k": 5, "filter": {"k": "v"}}
+
+``?`` placeholders bind positionally from the agent's ``fields`` config.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from langstream_tpu.agents.assets import AssetManager, AssetManagerRegistry
+from langstream_tpu.api.agent import AgentSink, SingleRecordProcessor
+from langstream_tpu.api.application import AssetDefinition
+from langstream_tpu.api.record import MutableRecord, Record
+from langstream_tpu.core.expressions import evaluate, evaluate_accessor
+
+
+class DataSource:
+    """Query SPI (parity: ``ai/agents/datasource/DataSourceProvider``)."""
+
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        raise NotImplementedError
+
+    async def execute_write(self, query: str, params: list[Any]) -> None:
+        raise NotImplementedError
+
+
+class _Collection:
+    """Rows are kept strictly aligned: ``ids[i]`` ↔ ``vectors[i]`` ↔
+    ``payloads[i]`` — rows without a vector store ``None`` so mixed
+    vectored/vectorless upserts can't misattribute search results."""
+
+    def __init__(self) -> None:
+        self.ids: list[Any] = []
+        self.vectors: list[np.ndarray | None] = []  # each (d,) float32, unit norm
+        self.payloads: list[dict[str, Any]] = []
+        self.lock = threading.Lock()
+
+    def upsert(self, item_id: Any, vector: list[float] | None, payload: dict[str, Any]) -> None:
+        with self.lock:
+            vec = None
+            if vector is not None:
+                vec = np.asarray(vector, dtype=np.float32)
+                norm = float(np.linalg.norm(vec)) or 1.0
+                vec = vec / norm
+            if item_id in self.ids:
+                idx = self.ids.index(item_id)
+                self.payloads[idx] = payload
+                self.vectors[idx] = vec
+                return
+            self.ids.append(item_id)
+            self.payloads.append(payload)
+            self.vectors.append(vec)
+
+    def delete(self, item_id: Any) -> None:
+        with self.lock:
+            if item_id in self.ids:
+                idx = self.ids.index(item_id)
+                self.ids.pop(idx)
+                self.payloads.pop(idx)
+                self.vectors.pop(idx)
+
+    def search(
+        self,
+        vector: list[float] | None,
+        top_k: int,
+        flt: dict[str, Any] | None,
+    ) -> list[dict[str, Any]]:
+        with self.lock:
+            candidates = list(range(len(self.ids)))
+            if flt:
+                candidates = [
+                    i
+                    for i in candidates
+                    if all(self.payloads[i].get(k) == v for k, v in flt.items())
+                ]
+            if vector is not None and candidates:
+                scored = [i for i in candidates if self.vectors[i] is not None]
+                if scored:
+                    q = np.asarray(vector, dtype=np.float32)
+                    q = q / (float(np.linalg.norm(q)) or 1.0)
+                    matrix = np.stack([self.vectors[i] for i in scored])
+                    sims = matrix @ q
+                    order = np.argsort(-sims)[:top_k]
+                    return [
+                        {
+                            **self.payloads[scored[i]],
+                            "id": self.ids[scored[i]],
+                            "similarity": float(sims[i]),
+                        }
+                        for i in order
+                    ]
+            return [
+                {**self.payloads[i], "id": self.ids[i]} for i in candidates[:top_k]
+            ]
+
+
+class InMemoryVectorStore(DataSource):
+    """Named, process-shared store instances."""
+
+    _stores: dict[str, "InMemoryVectorStore"] = {}
+    _stores_lock = threading.Lock()
+
+    def __init__(self, persist_dir: Path | None = None):
+        self.collections: dict[str, _Collection] = {}
+        self.persist_dir = persist_dir
+        if persist_dir is not None:
+            self._load()
+
+    @classmethod
+    def get(cls, name: str, persist_dir: Path | None = None) -> "InMemoryVectorStore":
+        with cls._stores_lock:
+            if name not in cls._stores:
+                cls._stores[name] = cls(persist_dir)
+            return cls._stores[name]
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._stores_lock:
+            cls._stores.clear()
+
+    def collection(self, name: str) -> _Collection:
+        if name not in self.collections:
+            self.collections[name] = _Collection()
+        return self.collections[name]
+
+    # -- DataSource ------------------------------------------------------
+
+    @staticmethod
+    def _bind(query: str, params: list[Any]) -> dict[str, Any]:
+        # JSON query with positional `?` placeholders (values, incl. arrays)
+        parts = query.split("?")
+        if len(parts) - 1 != len(params) and len(parts) > 1:
+            raise ValueError(
+                f"query has {len(parts) - 1} placeholders, {len(params)} params given"
+            )
+        out = parts[0]
+        for part, param in zip(parts[1:], params):
+            out += json.dumps(param) + part
+        return json.loads(out)
+
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        q = self._bind(query, params)
+        coll = self.collection(q.get("collection", "default"))
+        return coll.search(
+            q.get("vector"), int(q.get("top-k", q.get("topK", 10))), q.get("filter")
+        )
+
+    async def execute_write(self, query: str, params: list[Any]) -> None:
+        q = self._bind(query, params)
+        coll = self.collection(q.get("collection", "default"))
+        if q.get("delete"):
+            coll.delete(q.get("id"))
+            return
+        coll.upsert(q.get("id"), q.get("vector"), q.get("payload", {}))
+        self._persist()
+
+    # -- persistence -----------------------------------------------------
+
+    def _persist(self) -> None:
+        if self.persist_dir is None:
+            return
+        self.persist_dir.mkdir(parents=True, exist_ok=True)
+        for name, coll in self.collections.items():
+            with (self.persist_dir / f"{name}.jsonl").open("w") as f:
+                with coll.lock:
+                    for i, item_id in enumerate(coll.ids):
+                        vec = (
+                            coll.vectors[i].tolist()
+                            if coll.vectors[i] is not None
+                            else None
+                        )
+                        f.write(
+                            json.dumps(
+                                {"id": item_id, "vector": vec, "payload": coll.payloads[i]}
+                            )
+                            + "\n"
+                        )
+
+    def _load(self) -> None:
+        if self.persist_dir is None or not self.persist_dir.exists():
+            return
+        for path in self.persist_dir.glob("*.jsonl"):
+            coll = self.collection(path.stem)
+            for line in path.read_text().splitlines():
+                item = json.loads(line)
+                coll.upsert(item["id"], item.get("vector"), item.get("payload", {}))
+
+
+def resolve_datasource(
+    name: str | None, resources: dict[str, dict[str, Any]]
+) -> DataSource:
+    """Find the named datasource resource and build its DataSource.
+
+    Resource shape (parity: ``configuration.yaml`` datasource resources):
+    ``{type: "datasource"|"vector-database", name, configuration: {service: ...}}``.
+    """
+    resource = None
+    for rid, r in resources.items():
+        if r.get("type") in ("datasource", "vector-database") and (
+            name is None or r.get("name") == name or rid == name
+        ):
+            resource = r
+            break
+    if resource is None:
+        # default: an anonymous in-memory store
+        return InMemoryVectorStore.get(name or "default")
+    service = resource.get("service", "in-memory")
+    if service in ("in-memory", "memory", "herddb"):
+        return InMemoryVectorStore.get(resource.get("name") or name or "default")
+    if service in ("jdbc", "postgres", "pgvector"):
+        try:
+            from langstream_tpu.agents.jdbc import JdbcDataSource  # gated
+
+            return JdbcDataSource(resource)
+        except ImportError as e:
+            raise RuntimeError(
+                f"datasource service {service!r} requires a DB client library: {e}"
+            )
+    raise RuntimeError(f"unsupported datasource service {service!r}")
+
+
+class VectorDBSinkAgent(AgentSink):
+    """``vector-db-sink``: upsert records into the configured store.
+
+    Field mapping via expressions (parity: per-store writer configs):
+    ``datasource``, ``collection-name``, ``fields: [{name, expression}]``
+    with conventional names ``id``, ``vector``/``embeddings``, others →
+    payload.
+    """
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        await super().init(configuration)
+        self.datasource = resolve_datasource(
+            configuration.get("datasource"),
+            configuration.get("__resources__", {}),
+        )
+        self.collection = configuration.get(
+            "collection-name", configuration.get("table-name", "default")
+        )
+
+    async def write(self, record: Record) -> None:
+        mutable = MutableRecord.from_record(record)
+        item_id: Any = None
+        vector: list[float] | None = None
+        payload: dict[str, Any] = {}
+        for f in self.configuration.get("fields", []):
+            fname = f["name"]
+            value = evaluate(str(f["expression"]), mutable)
+            if fname == "id":
+                item_id = value
+            elif fname in ("vector", "embeddings"):
+                vector = list(map(float, value)) if value is not None else None
+            else:
+                payload[fname] = value
+        if item_id is None:
+            item_id = f"{record.origin}-{record.timestamp}-{hash(str(record.value)) & 0xFFFFFFFF}"
+        if isinstance(self.datasource, InMemoryVectorStore):
+            self.datasource.collection(self.collection).upsert(item_id, vector, payload)
+            self.datasource._persist()
+        else:
+            await self.datasource.execute_write(
+                json.dumps(
+                    {
+                        "collection": self.collection,
+                        "id": item_id,
+                        "vector": vector,
+                        "payload": payload,
+                    }
+                ),
+                [],
+            )
+
+
+class QueryVectorDBAgent(SingleRecordProcessor):
+    """``query-vector-db``: similarity query → ``output-field``."""
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        await super().init(configuration)
+        self.datasource = resolve_datasource(
+            configuration.get("datasource"),
+            configuration.get("__resources__", {}),
+        )
+
+    async def process_record(self, record: Record) -> list[Record]:
+        cfg = self.configuration
+        mutable = MutableRecord.from_record(record)
+        params = [evaluate_accessor(f, mutable) for f in cfg.get("fields", [])]
+        results = await self.datasource.fetch_data(cfg.get("query", "{}"), params)
+        mutable.set_field(cfg.get("output-field", "value.query_results"), results)
+        return [mutable.to_record()]
+
+
+class _InMemoryCollectionAssetManager(AssetManager):
+    """Asset type ``in-memory-collection`` — and the fallback target for
+    table-like assets when their real store isn't configured locally."""
+
+    async def asset_exists(self, asset: AssetDefinition) -> bool:
+        cfg = asset.config
+        store = InMemoryVectorStore.get(cfg.get("datasource", "default"))
+        return cfg.get("collection-name", asset.name) in store.collections
+
+    async def deploy_asset(self, asset: AssetDefinition) -> None:
+        cfg = asset.config
+        store = InMemoryVectorStore.get(cfg.get("datasource", "default"))
+        store.collection(cfg.get("collection-name", asset.name))
+
+
+AssetManagerRegistry.register("in-memory-collection", _InMemoryCollectionAssetManager())

@@ -1,0 +1,63 @@
+"""Prometheus metrics reporter.
+
+Parity: ``MetricsReporter`` SPI + ``PrometheusMetricsReporter``
+(``langstream-runtime-impl/.../agent/metrics/PrometheusMetricsReporter.java:23``)
+— counters/gauges labeled by agent, exposed over the runtime's HTTP
+``/metrics`` endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from langstream_tpu.api.agent import MetricsReporter
+
+try:
+    from prometheus_client import Counter, Gauge, REGISTRY, generate_latest
+
+    _HAVE_PROM = True
+except ImportError:  # pragma: no cover - prometheus_client is in the image
+    _HAVE_PROM = False
+
+_metric_lock = threading.Lock()
+_counters: dict[str, "Counter"] = {}
+_gauges: dict[str, "Gauge"] = {}
+
+
+class PrometheusMetricsReporter(MetricsReporter):
+    def __init__(self, prefix: str = "langstream", agent_id: str = ""):
+        self.prefix = prefix
+        self.agent_id = agent_id
+
+    def with_prefix(self, prefix: str) -> "PrometheusMetricsReporter":
+        return PrometheusMetricsReporter(f"{self.prefix}_{prefix}", self.agent_id)
+
+    def _full(self, name: str) -> str:
+        return f"{self.prefix}_{name}".replace("-", "_").replace(".", "_")
+
+    def counter(self, name: str, help: str = "") -> Callable[[int], None]:
+        if not _HAVE_PROM:
+            return super().counter(name, help)
+        full = self._full(name)
+        with _metric_lock:
+            if full not in _counters:
+                _counters[full] = Counter(full, help or full, ["agent_id"])
+            c = _counters[full].labels(agent_id=self.agent_id)
+        return lambda n=1: c.inc(n)
+
+    def gauge(self, name: str, help: str = "") -> Callable[[float], None]:
+        if not _HAVE_PROM:
+            return super().gauge(name, help)
+        full = self._full(name)
+        with _metric_lock:
+            if full not in _gauges:
+                _gauges[full] = Gauge(full, help or full, ["agent_id"])
+            g = _gauges[full].labels(agent_id=self.agent_id)
+        return lambda v: g.set(v)
+
+
+def render_metrics() -> bytes:
+    if not _HAVE_PROM:
+        return b""
+    return generate_latest(REGISTRY)

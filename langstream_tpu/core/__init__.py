@@ -1,0 +1,20 @@
+"""L2 core: parser, placeholder resolution, planner, deployer, expressions.
+
+Mirrors the reference's ``langstream-core`` (SURVEY.md §2.1): YAML →
+:class:`~langstream_tpu.api.application.Application` →
+:class:`~langstream_tpu.api.execution_plan.ExecutionPlan`.
+"""
+
+from langstream_tpu.core.parser import ModelBuilder, build_application_from_directory
+from langstream_tpu.core.planner import Planner, build_execution_plan
+from langstream_tpu.core.placeholders import resolve_placeholders
+from langstream_tpu.core.deployer import ApplicationDeployer
+
+__all__ = [
+    "ModelBuilder",
+    "build_application_from_directory",
+    "Planner",
+    "build_execution_plan",
+    "resolve_placeholders",
+    "ApplicationDeployer",
+]

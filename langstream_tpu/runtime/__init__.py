@@ -1,0 +1,33 @@
+"""L3a/L4: streaming runtimes + the agent runner.
+
+Importing this package registers the built-in streaming runtimes with
+:class:`~langstream_tpu.api.topics.TopicConnectionsRuntimeRegistry`:
+
+- ``memory`` — the first-party in-process partitioned broker (the role the
+  embedded Kafka plays in the reference's ``langstream docker run`` tester).
+- ``kafka`` — only when a Kafka client library is importable (none is baked
+  into this image; the implementation is gated, not stubbed).
+"""
+
+from langstream_tpu.api.topics import TopicConnectionsRuntimeRegistry
+from langstream_tpu.runtime.memory_broker import MemoryTopicConnectionsRuntime
+
+TopicConnectionsRuntimeRegistry.register("memory", MemoryTopicConnectionsRuntime)
+
+try:  # pragma: no cover - kafka client not in the image
+    import confluent_kafka  # noqa: F401
+
+    from langstream_tpu.runtime.kafka_broker import KafkaTopicConnectionsRuntime
+
+    TopicConnectionsRuntimeRegistry.register("kafka", KafkaTopicConnectionsRuntime)
+except ImportError:
+    pass
+
+from langstream_tpu.runtime.runner import AgentRunner  # noqa: E402
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner  # noqa: E402
+
+__all__ = [
+    "AgentRunner",
+    "LocalApplicationRunner",
+    "MemoryTopicConnectionsRuntime",
+]

@@ -1,0 +1,118 @@
+"""Composite (fused) agent execution.
+
+Parity: ``CompositeAgentProcessor``
+(``langstream-runtime-impl/.../agent/CompositeAgentProcessor.java:36,150``):
+the planner fuses consecutive composable stages into one node; at runtime the
+stages chain in-memory — each source record flows through every stage, fan-out
+included, with per-source-record error attribution preserved.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from langstream_tpu.api.agent import (
+    AgentContext,
+    AgentProcessor,
+    ComponentType,
+    RecordSink,
+    SourceRecordAndResult,
+)
+from langstream_tpu.api.record import Record
+
+
+class _CollectorSink:
+    """RecordSink that resolves a future once all expected source records
+    have reported a result."""
+
+    def __init__(self, expected: int):
+        self.expected = expected
+        self.results: list[SourceRecordAndResult] = []
+        self.future: asyncio.Future[list[SourceRecordAndResult]] = (
+            asyncio.get_running_loop().create_future()
+        )
+
+    def emit(self, result: SourceRecordAndResult) -> None:
+        self.results.append(result)
+        if len(self.results) >= self.expected and not self.future.done():
+            self.future.set_result(self.results)
+
+    def emit_error(self, source_record: Record, error: Exception) -> None:
+        self.emit(SourceRecordAndResult(source_record, [], error))
+
+
+async def process_await(
+    processor: AgentProcessor, records: list[Record]
+) -> list[SourceRecordAndResult]:
+    """Drive one processor call to completion and gather its emissions."""
+    if not records:
+        return []
+    collector = _CollectorSink(len(records))
+    processor.process(records, collector)
+    return await collector.future
+
+
+class CompositeAgentProcessor(AgentProcessor):
+    """Chains N processors; emits final results attributed to the original
+    source record. Any stage error fails the source record as a whole."""
+
+    def __init__(self, processors: list[AgentProcessor]):
+        self.processors = processors
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.configuration = configuration
+
+    async def setup(self, context: AgentContext) -> None:
+        self.context = context
+        for p in self.processors:
+            await p.setup(context)
+
+    async def start(self) -> None:
+        for p in self.processors:
+            await p.start()
+
+    async def close(self) -> None:
+        for p in self.processors:
+            await p.close()
+
+    def component_type(self) -> ComponentType:
+        return ComponentType.PROCESSOR
+
+    def agent_info(self) -> dict[str, Any]:
+        return {
+            "composite": [
+                {"type": p.agent_type, "info": p.agent_info()} for p in self.processors
+            ]
+        }
+
+    def process(self, records: list[Record], sink: RecordSink) -> None:
+        for record in records:
+            task = asyncio.ensure_future(self._chain_one(record))
+
+            def _done(t: "asyncio.Task", r: Record = record) -> None:
+                err = t.exception()
+                if err is not None:
+                    sink.emit(
+                        SourceRecordAndResult(
+                            r, [], err if isinstance(err, Exception) else Exception(str(err))
+                        )
+                    )
+                else:
+                    sink.emit(SourceRecordAndResult(r, t.result(), None))
+
+            task.add_done_callback(_done)
+
+    async def _chain_one(self, record: Record) -> list[Record]:
+        current: list[Record] = [record]
+        for stage in self.processors:
+            if not current:
+                return []
+            next_records: list[Record] = []
+            results = await process_await(stage, current)
+            for res in results:
+                if res.error is not None:
+                    raise res.error
+                next_records.extend(res.results)
+            current = next_records
+        return current

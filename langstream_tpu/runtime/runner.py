@@ -1,0 +1,451 @@
+"""The agent runner: one replica's hot loop.
+
+Parity: ``AgentRunner`` (``langstream-runtime-impl/.../agent/AgentRunner.java``)
+— wiring (``:138``): resolve the streaming runtime, build
+consumer/producer/dead-letter, wrap defaults ``TopicConsumerSource`` /
+``TopicProducerSink`` (``:338,354``); hot loop (``runMainLoop``, ``:651-730``):
+``source.read() → processor.process(records, sink) → write results``, with the
+:class:`~langstream_tpu.runtime.tracker.SourceRecordTracker` committing source
+offsets only after all derived writes land, retry/skip/dead-letter per
+``ErrorsSpec``, and graceful drain on shutdown (``:562``).
+
+The loop is a single asyncio task; processors may resolve results out of
+order (the GPU/TPU-serving agents do), commit contiguity is preserved by the
+consumer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from pathlib import Path
+from typing import Any
+
+from langstream_tpu.api.agent import (
+    AgentCode,
+    AgentContext,
+    AgentProcessor,
+    AgentService,
+    AgentSink,
+    AgentSource,
+    ComponentType,
+    RecordSink,
+    SourceRecordAndResult,
+)
+from langstream_tpu.api.application import ErrorsSpec
+from langstream_tpu.api.execution_plan import AgentNode, ExecutionPlan
+from langstream_tpu.api.metrics import PrometheusMetricsReporter
+from langstream_tpu.api.record import Record, SimpleRecord
+from langstream_tpu.api.registry import AgentCodeRegistry
+from langstream_tpu.api.topics import (
+    TopicConnectionsRuntime,
+    TopicConsumer,
+    TopicProducer,
+)
+from langstream_tpu.runtime.composite import CompositeAgentProcessor
+from langstream_tpu.runtime.errors_handler import (
+    FailureAction,
+    StandardErrorsHandler,
+    deadletter_record,
+)
+from langstream_tpu.runtime.tracker import SourceRecordTracker
+
+log = logging.getLogger(__name__)
+
+DESTINATION_TOPIC_HEADER = "langstream-destination-topic"
+
+
+class TopicConsumerSource(AgentSource):
+    """Default source: reads the node's input topic
+    (parity: ``AgentRunner.java:338``)."""
+
+    def __init__(self, consumer: TopicConsumer):
+        self.consumer = consumer
+
+    async def start(self) -> None:
+        await self.consumer.start()
+
+    async def close(self) -> None:
+        await self.consumer.close()
+
+    async def read(self) -> list[Record]:
+        return await self.consumer.read()
+
+    async def commit(self, records: list[Record]) -> None:
+        await self.consumer.commit(records)
+
+
+class TopicProducerSink(AgentSink):
+    """Default sink: writes to the node's output topic, honoring per-record
+    destination-topic routing (used by the ``dispatch`` agent)."""
+
+    def __init__(
+        self,
+        producer: TopicProducer | None,
+        runtime: TopicConnectionsRuntime,
+        agent_id: str,
+    ):
+        self.producer = producer
+        self.runtime = runtime
+        self.agent_id = agent_id
+        self._extra_producers: dict[str, TopicProducer] = {}
+
+    async def start(self) -> None:
+        if self.producer:
+            await self.producer.start()
+
+    async def close(self) -> None:
+        if self.producer:
+            await self.producer.close()
+        for p in self._extra_producers.values():
+            await p.close()
+
+    async def write(self, record: Record) -> None:
+        destination = record.header(DESTINATION_TOPIC_HEADER)
+        if destination:
+            # strip the routing header so downstream nodes fall back to their
+            # own configured outputs instead of re-routing forever
+            routed = SimpleRecord(
+                value=record.value,
+                key=record.key,
+                headers=tuple(
+                    (k, v)
+                    for k, v in record.headers
+                    if k != DESTINATION_TOPIC_HEADER
+                ),
+                origin=record.origin,
+                timestamp=record.timestamp,
+            )
+            producer = await self._producer_for(destination)
+            await producer.write(routed)
+            return
+        if self.producer is None:
+            # terminal agent without output: drop (the reference logs these)
+            return
+        await self.producer.write(record)
+
+    async def _producer_for(self, topic: str) -> TopicProducer:
+        if topic not in self._extra_producers:
+            producer = self.runtime.create_producer(self.agent_id, {"topic": topic})
+            await producer.start()
+            self._extra_producers[topic] = producer
+        return self._extra_producers[topic]
+
+
+class _PassthroughProcessor(AgentProcessor):
+    def process(self, records: list[Record], sink: RecordSink) -> None:
+        for r in records:
+            sink.emit(SourceRecordAndResult(r, [r], None))
+
+
+class _RunnerRecordSink:
+    """The RecordSink handed to the processor: applies the error policy and
+    drives the write side + tracker."""
+
+    def __init__(self, runner: "AgentRunner"):
+        self.runner = runner
+
+    def emit(self, result: SourceRecordAndResult) -> None:
+        asyncio.ensure_future(self.runner._handle_result(result))
+
+    def emit_error(self, source_record: Record, error: Exception) -> None:
+        self.emit(SourceRecordAndResult(source_record, [], error))
+
+
+class AgentRunner:
+    """Runs one replica of one (possibly composite) agent node."""
+
+    def __init__(
+        self,
+        plan: ExecutionPlan,
+        node: AgentNode,
+        replica: int = 0,
+        state_dir: Path | None = None,
+    ):
+        self.plan = plan
+        self.node = node
+        self.replica = replica
+        self.state_dir = state_dir
+        self.agent_id = f"{plan.application_id}-{node.id}"
+        self._running = False
+        self._stop_requested = asyncio.Event()
+        self._fatal: Exception | None = None
+        self.records_in = 0
+        self.records_out = 0
+        self.errors_total = 0
+        # backpressure: max records read-but-not-terminal before the loop
+        # stops polling (parity: the reference loop awaits processing; we
+        # allow a bounded pipeline depth instead so TPU batches can fill)
+        self.max_pending = int(
+            (node.configuration or {}).get("max-pending-records", 512)
+        )
+        self._inflight = 0
+        self._loop_task: asyncio.Task | None = None
+        self._service_task: asyncio.Task | None = None
+
+    # ---- wiring ----------------------------------------------------------
+
+    async def start(self) -> None:
+        streaming = self.plan.application.instance.streaming_cluster
+        from langstream_tpu.api.topics import TopicConnectionsRuntimeRegistry
+
+        self.topics_runtime = TopicConnectionsRuntimeRegistry.get_runtime(
+            {"type": streaming.type, "configuration": streaming.configuration}
+        )
+
+        node = self.node
+        consumer: TopicConsumer | None = None
+        producer: TopicProducer | None = None
+        self.deadletter_producer: TopicProducer | None = None
+
+        if node.input is not None:
+            consumer = self.topics_runtime.create_consumer(
+                self.agent_id, {"topic": node.input.topic, "group": self.agent_id}
+            )
+            if node.input.deadletter_enabled:
+                self.deadletter_producer = (
+                    self.topics_runtime.create_deadletter_producer(
+                        self.agent_id, {"topic": node.input.topic}
+                    )
+                )
+        if node.output is not None:
+            producer = self.topics_runtime.create_producer(
+                self.agent_id, {"topic": node.output.topic}
+            )
+
+        # agent instantiation (composite → chain of processors)
+        agents = [
+            await self._instantiate(cfg.type, cfg.configuration, cfg.id)
+            for cfg in node.agents
+        ]
+
+        self.source: AgentSource
+        self.sink: AgentSink
+        self.service: AgentService | None = None
+        processors: list[AgentProcessor] = []
+
+        first, last = agents[0], agents[-1]
+        if isinstance(first, AgentService):
+            self.service = first
+            self.source = _NullSource()
+            self.sink = TopicProducerSink(None, self.topics_runtime, self.agent_id)
+            self.processor = _PassthroughProcessor()
+        else:
+            if isinstance(first, AgentSource):
+                self.source = first
+                middles = agents[1:]
+            else:
+                if consumer is None:
+                    raise RuntimeError(
+                        f"agent {node.id} is not a source and has no input topic"
+                    )
+                self.source = TopicConsumerSource(consumer)
+                middles = agents
+            if middles and isinstance(middles[-1], AgentSink):
+                self.sink = middles[-1]
+                middles = middles[:-1]
+            else:
+                self.sink = TopicProducerSink(
+                    producer, self.topics_runtime, self.agent_id
+                )
+            for a in middles:
+                if not isinstance(a, AgentProcessor):
+                    raise RuntimeError(
+                        f"agent {a.agent_type!r} cannot sit mid-pipeline "
+                        f"(component type {a.component_type().value})"
+                    )
+                processors.append(a)
+            self.processor = (
+                processors[0]
+                if len(processors) == 1
+                else CompositeAgentProcessor(processors)
+                if processors
+                else _PassthroughProcessor()
+            )
+
+        # context + lifecycle
+        metrics = PrometheusMetricsReporter(agent_id=self.agent_id)
+        context = AgentContext(
+            agent_id=self.node.id,
+            global_agent_id=self.agent_id,
+            persistent_state_dir=(
+                self.state_dir / f"{self.node.id}-{self.replica}"
+                if self.state_dir
+                else None
+            ),
+            metrics=metrics,
+            topic_producer_factory=self._make_producer,
+            critical_failure_handler=self._on_critical_failure,
+        )
+        self.context = context
+        self.tracker = SourceRecordTracker(self.source.commit)
+        self.errors_handler = StandardErrorsHandler(self.node.errors or ErrorsSpec())
+        self.record_sink = _RunnerRecordSink(self)
+
+        # note: a CompositeAgentProcessor propagates setup/start/close to its
+        # children, so only the top-level trio is driven here.
+        for a in dict.fromkeys(
+            [self.source, self.processor, self.sink]
+            + ([self.service] if self.service else [])
+        ):
+            await a.setup(context)
+        await self.source.start()
+        await self.sink.start()
+        await self.processor.start()
+        if self.deadletter_producer:
+            await self.deadletter_producer.start()
+        if self.service:
+            await self.service.start()
+            self._service_task = asyncio.ensure_future(self.service.run())
+
+        self._running = True
+        self._loop_task = asyncio.ensure_future(self._main_loop())
+
+    async def _instantiate(self, agent_type: str, configuration: dict[str, Any], agent_id: str) -> AgentCode:
+        agent = AgentCodeRegistry.get_agent_code(agent_type)
+        agent.agent_id = agent_id
+        cfg = dict(configuration)
+        # ambient application context for agents that reference shared
+        # resources (model providers, datasources) or globals
+        cfg["__resources__"] = {
+            rid: {"type": r.type, "name": r.name, **r.configuration}
+            for rid, r in self.plan.application.resources.items()
+        }
+        cfg["__globals__"] = self.plan.application.instance.globals_
+        cfg["__application_id__"] = self.plan.application_id
+        await agent.init(cfg)
+        return agent
+
+    def _make_producer(self, topic: str):
+        producer = self.topics_runtime.create_producer(self.agent_id, {"topic": topic})
+
+        class _Handle:
+            def __init__(self, producer: TopicProducer):
+                self._producer = producer
+                self._started = False
+
+            async def write(self, record: Record) -> None:
+                if not self._started:
+                    await self._producer.start()
+                    self._started = True
+                await self._producer.write(record)
+
+        return _Handle(producer)
+
+    def _on_critical_failure(self, error: Exception) -> None:
+        log.error("agent %s critical failure: %s", self.agent_id, error)
+        self._fatal = error
+        self._stop_requested.set()
+
+    # ---- hot loop --------------------------------------------------------
+
+    async def _main_loop(self) -> None:
+        try:
+            while not self._stop_requested.is_set():
+                while (
+                    self._inflight >= self.max_pending
+                    and not self._stop_requested.is_set()
+                ):
+                    await asyncio.sleep(0.002)
+                records = await self.source.read()
+                if self._stop_requested.is_set():
+                    break
+                if not records:
+                    await asyncio.sleep(0)
+                    continue
+                self.records_in += len(records)
+                self._inflight += len(records)
+                self.processor.process(records, self.record_sink)
+                await asyncio.sleep(0)
+        except Exception as e:  # loop-level failure is fatal for the replica
+            self._fatal = e
+            log.exception("agent %s main loop failed", self.agent_id)
+
+    async def _handle_result(self, result: SourceRecordAndResult) -> None:
+        if result.error is not None:
+            await self._handle_error(result.source_record, result.error)
+            return
+        self.errors_handler.clear(result.source_record)
+        self._inflight = max(0, self._inflight - 1)
+        self.tracker.track(result.source_record, len(result.results))
+        if not result.results:
+            await self.tracker.commit_if_tracked_empty(result.source_record)
+            return
+        for record in result.results:
+            try:
+                await self.sink.write(record)
+                self.records_out += 1
+                await self.tracker.record_written(result.source_record)
+            except Exception as e:
+                await self.tracker.record_failed(result.source_record)
+                self._inflight += 1  # re-enters error handling below
+                await self._handle_error(result.source_record, e)
+                return
+
+    async def _handle_error(self, source_record: Record, error: Exception) -> None:
+        self.errors_total += 1
+        action = self.errors_handler.handle(source_record, error)
+        if action == FailureAction.RETRY:
+            # single-record retry, documented out-of-order; stays in flight
+            self.processor.process([source_record], self.record_sink)
+            return
+        self._inflight = max(0, self._inflight - 1)
+        if action == FailureAction.SKIP:
+            await self.tracker.commit_now(source_record)
+        elif action == FailureAction.DEAD_LETTER:
+            if self.deadletter_producer is not None:
+                await self.deadletter_producer.write(
+                    deadletter_record(source_record, error)
+                )
+            await self.tracker.commit_now(source_record)
+        else:  # FAIL
+            if isinstance(self.source, AgentSource):
+                try:
+                    await self.source.permanent_failure(source_record, error)
+                except Exception as e:
+                    self._fatal = e
+            self._stop_requested.set()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    async def stop(self, drain_timeout: float = 10.0) -> None:
+        self._stop_requested.set()
+        if self._loop_task is not None:
+            await self._loop_task
+        await self.tracker.wait_for_no_pending(drain_timeout)
+        if self._service_task is not None:
+            self._service_task.cancel()
+            try:
+                await self._service_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for closer in (self.processor, self.sink, self.source):
+            try:
+                await closer.close()
+            except Exception:
+                log.exception("error closing %s", closer)
+        if self.deadletter_producer:
+            await self.deadletter_producer.close()
+        await self.topics_runtime.close()
+        self._running = False
+        if self._fatal is not None:
+            raise self._fatal
+
+    def info(self) -> dict[str, Any]:
+        return {
+            "agent-id": self.agent_id,
+            "type": self.node.agent_type,
+            "component-type": self.node.component_type,
+            "replica": self.replica,
+            "records-in": self.records_in,
+            "records-out": self.records_out,
+            "errors": self.errors_total,
+            "pending": self.tracker.pending_count() if hasattr(self, "tracker") else 0,
+            "agent-info": self.processor.agent_info() if hasattr(self, "processor") else {},
+        }
+
+
+class _NullSource(AgentSource):
+    async def read(self) -> list[Record]:
+        await asyncio.sleep(0.2)
+        return []

@@ -1,0 +1,42 @@
+"""Test fixtures.
+
+Multi-chip-without-TPUs strategy (SURVEY.md §4 implication): tests run JAX on
+CPU with 8 virtual devices (`--xla_force_host_platform_device_count=8`), the
+role KubeTestServer + testcontainers play in the reference — sharding and
+collectives are exercised for real, just on host devices.
+"""
+
+import os
+
+# Must be set before jax is imported by any test module.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+from langstream_tpu.runtime.memory_broker import MemoryBroker  # noqa: E402
+from langstream_tpu.agents.vector import InMemoryVectorStore  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    """Isolate broker + vector-store state between tests."""
+    MemoryBroker.reset()
+    InMemoryVectorStore.reset()
+    yield
+    MemoryBroker.reset()
+    InMemoryVectorStore.reset()
+
+
+@pytest.fixture
+def run_async():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run

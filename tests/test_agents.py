@@ -1,0 +1,533 @@
+"""Agent-level tests: transform steps, text processing, flow control,
+AI agents against the mock provider, vector store round trip."""
+
+import asyncio
+import json
+
+import pytest
+
+from langstream_tpu.api.agent import AgentContext
+from langstream_tpu.api.record import make_record
+from langstream_tpu.runtime.composite import process_await
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: "memory"
+"""
+
+MOCK_CONFIG = """
+configuration:
+  resources:
+    - type: "mock-serving-configuration"
+      name: "mock"
+      configuration:
+        reply: "the answer is 42"
+"""
+
+
+async def run_single(agent_factory, configuration, record):
+    agent = agent_factory()
+    await agent.init({**configuration, "__resources__": {}, "__globals__": {}})
+    await agent.setup(AgentContext())
+    await agent.start()
+    results = await process_await(agent, [record])
+    await agent.close()
+    assert len(results) == 1
+    if results[0].error:
+        raise results[0].error
+    return results[0].results
+
+
+# ---------------------------------------------------------------------------
+# transform steps
+# ---------------------------------------------------------------------------
+
+
+def test_compute_and_drop_fields(run_async):
+    from langstream_tpu.agents.transform import ComputeStep, DropFieldsStep
+
+    async def main():
+        record = make_record(value={"a": 2, "secret": "x"})
+        out = await run_single(
+            ComputeStep,
+            {"fields": [{"name": "value.b", "expression": "value.a * 3"}]},
+            record,
+        )
+        assert out[0].value == {"a": 2, "secret": "x", "b": 6}
+        out2 = await run_single(DropFieldsStep, {"fields": ["secret"]}, out[0])
+        assert out2[0].value == {"a": 2, "b": 6}
+
+    run_async(main())
+
+
+def test_when_guard_skips_step(run_async):
+    from langstream_tpu.agents.transform import ComputeStep
+
+    async def main():
+        record = make_record(value={"a": 1})
+        out = await run_single(
+            ComputeStep,
+            {
+                "when": "value.a > 10",
+                "fields": [{"name": "value.b", "expression": "99"}],
+            },
+            record,
+        )
+        assert out[0].value == {"a": 1}
+
+    run_async(main())
+
+
+def test_drop_flatten_merge_unwrap(run_async):
+    from langstream_tpu.agents.transform import (
+        DropStep,
+        FlattenStep,
+        MergeKeyValueStep,
+        UnwrapKeyValueStep,
+    )
+
+    async def main():
+        dropped = await run_single(
+            DropStep, {"when": "value.x == 1"}, make_record(value={"x": 1})
+        )
+        assert dropped == []
+        kept = await run_single(
+            DropStep, {"when": "value.x == 1"}, make_record(value={"x": 2})
+        )
+        assert len(kept) == 1
+
+        flat = await run_single(
+            FlattenStep, {}, make_record(value={"a": {"b": {"c": 1}}})
+        )
+        assert flat[0].value == {"a_b_c": 1}
+
+        merged = await run_single(
+            MergeKeyValueStep, {}, make_record(value={"v": 1}, key={"k": 2})
+        )
+        assert merged[0].value == {"k": 2, "v": 1}
+
+        unwrapped = await run_single(
+            UnwrapKeyValueStep, {}, make_record(value={"v": 1}, key={"k": 2})
+        )
+        assert unwrapped[0].value == {"v": 1} and unwrapped[0].key is None
+
+    run_async(main())
+
+
+def test_cast(run_async):
+    from langstream_tpu.agents.transform import CastStep
+
+    async def main():
+        out = await run_single(
+            CastStep, {"schema-type": "string"}, make_record(value={"a": 1})
+        )
+        assert out[0].value == '{"a": 1}'
+        out2 = await run_single(
+            CastStep, {"schema-type": "int32"}, make_record(value="42")
+        )
+        assert out2[0].value == 42
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# text processing
+# ---------------------------------------------------------------------------
+
+
+def test_text_splitter_chunks(run_async):
+    from langstream_tpu.agents.text import TextSplitterAgent
+
+    async def main():
+        text = "\n\n".join(f"paragraph {i} " + "word " * 30 for i in range(5))
+        out = await run_single(
+            TextSplitterAgent,
+            {"chunk-size": 100, "chunk-overlap": 10},
+            make_record(value=text),
+        )
+        assert len(out) > 1
+        assert all(len(r.value) <= 120 for r in out)
+        assert out[0].header("chunk_id") == "0"
+        # every chunk advertises the total
+        assert {r.header("text_num_chunks") for r in out} == {str(len(out))}
+
+    run_async(main())
+
+
+def test_splitter_reassembly_covers_text(run_async):
+    from langstream_tpu.agents.text import RecursiveCharacterTextSplitter
+
+    async def main():
+        text = "the quick brown fox. " * 50
+        splitter = RecursiveCharacterTextSplitter(chunk_size=80, chunk_overlap=0)
+        chunks = splitter.split_text(text)
+        assert all(len(c) <= 80 for c in chunks)
+        assert "".join(c.replace(" ", "") for c in chunks).startswith(
+            "thequickbrownfox"
+        )
+
+    run_async(main())
+
+
+def test_html_extraction_and_language(run_async):
+    from langstream_tpu.agents.text import LanguageDetectorAgent, TextExtractorAgent
+
+    async def main():
+        html = "<html><head><script>bad()</script></head><body><p>The cat is on the mat and it is happy</p></body></html>"
+        out = await run_single(TextExtractorAgent, {}, make_record(value=html))
+        assert "cat is on the mat" in out[0].value
+        assert "bad()" not in out[0].value
+        lang = await run_single(LanguageDetectorAgent, {}, out[0])
+        assert lang[0].header("language") == "en"
+
+    run_async(main())
+
+
+def test_document_to_json(run_async):
+    from langstream_tpu.agents.text import DocumentToJsonAgent
+
+    async def main():
+        out = await run_single(
+            DocumentToJsonAgent, {"text-field": "question"}, make_record(value="hi")
+        )
+        assert out[0].value == {"question": "hi"}
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# AI agents with the mock provider (WireMock analogue)
+# ---------------------------------------------------------------------------
+
+CHAT_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+  - name: "stream-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "convert"
+    type: "document-to-json"
+    input: "input-topic"
+    configuration:
+      text-field: "question"
+  - name: "chat"
+    type: "ai-chat-completions"
+    output: "output-topic"
+    configuration:
+      model: "mock-model"
+      completion-field: "value.answer"
+      log-field: "value.prompt"
+      stream-to-topic: "stream-topic"
+      stream-response-completion-field: "value"
+      min-chunks-per-message: 2
+      messages:
+        - role: user
+          content: "Q: {{ value.question }}"
+"""
+
+
+def test_chat_completions_with_streaming(tmp_path, run_async):
+    async def main():
+        (tmp_path / "pipeline.yaml").write_text(CHAT_PIPELINE)
+        (tmp_path / "configuration.yaml").write_text(MOCK_CONFIG)
+        runner = LocalApplicationRunner.from_directory(tmp_path, instance=INSTANCE)
+        async with runner:
+            await runner.produce(
+                "input-topic", "what is it?", headers={"session": "s1"}
+            )
+            final = await runner.wait_for_messages("output-topic", 1)
+            assert final[0].value["answer"] == "the answer is 42"
+            assert "Q: what is it?" in final[0].value["prompt"]
+            # streamed chunks reassemble to the full answer, preserve headers
+            await asyncio.sleep(0.1)
+            chunks = await runner.wait_for_messages("stream-topic", 1)
+            text = "".join(c.value for c in chunks)
+            # eventually all chunks arrive
+            for _ in range(50):
+                if text == "the answer is 42":
+                    break
+                await asyncio.sleep(0.05)
+                chunks = await runner.wait_for_messages("stream-topic", len(chunks))
+                text = "".join(c.value for c in chunks)
+            assert text == "the answer is 42"
+            assert chunks[0].header("session") == "s1"
+            assert chunks[-1].header("stream-last-message") == "true"
+            indexes = [int(c.header("stream-index")) for c in chunks]
+            assert indexes == sorted(indexes)
+
+    run_async(main())
+
+
+EMBED_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "embed"
+    type: "compute-ai-embeddings"
+    input: "input-topic"
+    output: "output-topic"
+    configuration:
+      model: "mock-embed"
+      embeddings-field: "value.embeddings"
+      text: "{{ value.text }}"
+      batch-size: 4
+      flush-interval: 50
+"""
+
+
+def test_embeddings_batched(tmp_path, run_async):
+    async def main():
+        (tmp_path / "pipeline.yaml").write_text(EMBED_PIPELINE)
+        (tmp_path / "configuration.yaml").write_text(MOCK_CONFIG)
+        runner = LocalApplicationRunner.from_directory(tmp_path, instance=INSTANCE)
+        async with runner:
+            for i in range(6):
+                await runner.produce("input-topic", {"text": f"doc {i}"})
+            msgs = await runner.wait_for_messages("output-topic", 6)
+            for m in msgs:
+                assert len(m.value["embeddings"]) == 8
+                assert abs(sum(x * x for x in m.value["embeddings"]) - 1.0) < 1e-5
+
+    run_async(main())
+
+
+RAG_PIPELINE = """
+topics:
+  - name: "docs-topic"
+    creation-mode: create-if-not-exists
+  - name: "questions-topic"
+    creation-mode: create-if-not-exists
+  - name: "answers-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "embed-docs"
+    id: "ingest"
+    type: "compute-ai-embeddings"
+    input: "docs-topic"
+    configuration:
+      embeddings-field: "value.embeddings"
+      text: "{{ value.text }}"
+      flush-interval: 0
+  - name: "write-docs"
+    type: "vector-db-sink"
+    configuration:
+      datasource: "vdb"
+      collection-name: "docs"
+      fields:
+        - name: "id"
+          expression: "value.doc_id"
+        - name: "vector"
+          expression: "value.embeddings"
+        - name: "text"
+          expression: "value.text"
+"""
+
+QUERY_PIPELINE = """
+topics:
+  - name: "questions-topic"
+    creation-mode: create-if-not-exists
+  - name: "answers-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "embed-q"
+    id: "query"
+    type: "compute-ai-embeddings"
+    input: "questions-topic"
+    configuration:
+      embeddings-field: "value.q_embeddings"
+      text: "{{ value.q }}"
+      flush-interval: 0
+  - name: "lookup"
+    type: "query-vector-db"
+    output: "answers-topic"
+    configuration:
+      datasource: "vdb"
+      query: '{"collection": "docs", "vector": ?, "top-k": 2}'
+      fields:
+        - "value.q_embeddings"
+      output-field: "value.related"
+"""
+
+VDB_CONFIG = """
+configuration:
+  resources:
+    - type: "mock-serving-configuration"
+      name: "mock"
+      configuration: {}
+    - type: "datasource"
+      name: "vdb"
+      configuration:
+        service: "in-memory"
+"""
+
+
+def test_rag_vector_roundtrip(tmp_path, run_async):
+    async def main():
+        ingest = tmp_path / "ingest"
+        ingest.mkdir()
+        (ingest / "pipeline.yaml").write_text(RAG_PIPELINE)
+        (ingest / "configuration.yaml").write_text(VDB_CONFIG)
+        query = tmp_path / "query"
+        query.mkdir()
+        (query / "pipeline.yaml").write_text(QUERY_PIPELINE)
+        (query / "configuration.yaml").write_text(VDB_CONFIG)
+
+        ingest_runner = LocalApplicationRunner.from_directory(
+            ingest, instance=INSTANCE, application_id="ingest"
+        )
+        async with ingest_runner:
+            for i, text in enumerate(
+                ["cats purr softly", "dogs bark loudly", "fish swim in water"]
+            ):
+                await ingest_runner.produce(
+                    "docs-topic", {"doc_id": f"d{i}", "text": text}
+                )
+            # wait for the sink to land all three
+            from langstream_tpu.agents.vector import InMemoryVectorStore
+
+            for _ in range(100):
+                store = InMemoryVectorStore.get("vdb")
+                if len(store.collection("docs").ids) == 3:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(store.collection("docs").ids) == 3
+
+        query_runner = LocalApplicationRunner.from_directory(
+            query, instance=INSTANCE, application_id="query"
+        )
+        async with query_runner:
+            await query_runner.produce("questions-topic", {"q": "cats purr"})
+            msgs = await query_runner.wait_for_messages("answers-topic", 1)
+            related = msgs[0].value["related"]
+            assert len(related) == 2
+            assert related[0]["text"] == "cats purr softly"
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# re-rank
+# ---------------------------------------------------------------------------
+
+
+def test_rerank_mmr(run_async):
+    from langstream_tpu.agents.ai import ReRankAgent
+
+    async def main():
+        docs = [
+            {"text": "cats purr", "emb": [1.0, 0.0]},
+            {"text": "cats purr again", "emb": [0.99, 0.1]},
+            {"text": "dogs bark", "emb": [0.0, 1.0]},
+        ]
+        record = make_record(
+            value={"docs": docs, "q": "cats", "q_emb": [1.0, 0.0]}
+        )
+        out = await run_single(
+            ReRankAgent,
+            {
+                "field": "value.docs",
+                "query-text": "value.q",
+                "query-embeddings": "value.q_emb",
+                "text-field": "record.text",
+                "embeddings-field": "record.emb",
+                "output-field": "value.docs",
+                "max": 2,
+                "lambda": 0.3,  # diversity-heavy: penalise the near-duplicate
+            },
+            record,
+        )
+        reranked = out[0].value["docs"]
+        assert len(reranked) == 2
+        assert reranked[0]["text"] == "cats purr"
+        # MMR should diversify: second pick is the dog doc, not the near-dup
+        assert reranked[1]["text"] == "dogs bark"
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# custom python agents
+# ---------------------------------------------------------------------------
+
+PY_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "custom"
+    type: "python-processor"
+    input: "input-topic"
+    output: "output-topic"
+    configuration:
+      className: "my_agent.Exclaimer"
+"""
+
+PY_AGENT = """
+class Exclaimer:
+    def init(self, config):
+        self.mark = config.get("mark", "!")
+
+    def process(self, record):
+        return [(str(record.value) + self.mark, record.key, {})]
+"""
+
+
+def test_custom_python_processor(tmp_path, run_async):
+    async def main():
+        (tmp_path / "pipeline.yaml").write_text(PY_PIPELINE)
+        pydir = tmp_path / "python"
+        pydir.mkdir()
+        (pydir / "my_agent.py").write_text(PY_AGENT)
+        import sys
+
+        sys.path.insert(0, str(pydir))
+        try:
+            runner = LocalApplicationRunner.from_directory(tmp_path, instance=INSTANCE)
+            async with runner:
+                await runner.produce("input-topic", "hello")
+                msgs = await runner.wait_for_messages("output-topic", 1)
+                assert msgs[0].value == "hello!"
+        finally:
+            sys.path.remove(str(pydir))
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# batching executor
+# ---------------------------------------------------------------------------
+
+
+def test_ordered_batch_executor(run_async):
+    from langstream_tpu.api.batching import OrderedAsyncBatchExecutor
+
+    async def main():
+        batches = []
+
+        async def proc(batch):
+            batches.append(list(batch))
+            await asyncio.sleep(0.01)
+
+        ex = OrderedAsyncBatchExecutor(
+            batch_size=3, processor=proc, flush_interval=10.0, num_buckets=2,
+            key_fn=lambda item: item[0],
+        )
+        for i in range(6):
+            await ex.add(("k1", i))
+        await ex.close()
+        # same key → same bucket → order preserved across batches
+        flat = [item for b in batches for item in b]
+        assert [x[1] for x in flat] == list(range(6))
+        assert all(len(b) <= 3 for b in batches)
+
+    run_async(main())

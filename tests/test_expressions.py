@@ -1,0 +1,90 @@
+from langstream_tpu.api.record import MutableRecord
+from langstream_tpu.core.expressions import (
+    ExpressionError,
+    evaluate,
+    evaluate_accessor,
+    render_template,
+)
+
+import pytest
+
+
+def rec(value=None, key=None, props=None):
+    return MutableRecord(value=value, key=key, properties=props or {})
+
+
+def test_dotted_access():
+    r = rec(value={"question": "hi", "nested": {"x": 3}})
+    assert evaluate("value.question", r) == "hi"
+    assert evaluate("value.nested.x", r) == 3
+    assert evaluate("value.missing", r) is None
+
+
+def test_operators_and_el_normalisation():
+    r = rec(value={"a": 2, "b": "yes"})
+    assert evaluate("value.a == 2 && value.b == 'yes'", r) is True
+    assert evaluate("value.a > 5 || value.b == 'yes'", r) is True
+    assert evaluate("!(value.a == 2)", r) is False
+    assert evaluate("value.a + 3", r) == 5
+
+
+def test_fn_helpers():
+    r = rec(value={"s": "  Hello  "})
+    assert evaluate("fn:trim(value.s)", r) == "Hello"
+    assert evaluate("fn:lowercase(value.s)", r) == "  hello  "
+    assert evaluate("fn:concat('a', 'b', 1)", r) == "ab1"
+    assert evaluate("fn:coalesce(value.missing, 'x')", r) == "x"
+    assert evaluate("fn:len(value.s)", r) == 9
+
+
+def test_properties_access():
+    r = rec(value="v", props={"lang": "en"})
+    assert evaluate("properties.lang == 'en'", r) is True
+
+
+def test_safety():
+    r = rec(value={})
+    with pytest.raises(ExpressionError):
+        evaluate("__import__('os')", r)
+    with pytest.raises(ExpressionError):
+        evaluate("[x for x in value]", r)
+    with pytest.raises(ExpressionError):
+        evaluate("value.__class__", r)
+
+
+def test_string_literals_survive_normalisation():
+    # regression: EL keyword rewriting must not touch string literals
+    r = rec(value={"flag": "true", "op": "eq", "brace": "}"})
+    assert evaluate("value.flag == 'true'", r) is True
+    assert evaluate("value.op == 'eq'", r) is True
+    assert evaluate("value.brace == '}'", r) is True
+    assert evaluate("fn:contains('not a keyword', 'a')", r) is True
+
+
+def test_dict_literals_parse():
+    r = rec(value={})
+    assert evaluate("{'a': 1}", r) == {"a": 1}
+
+
+def test_accessor_fast_path():
+    r = rec(value={"a": {"b": 1}})
+    assert evaluate_accessor("value.a.b", r) == 1
+    assert evaluate_accessor("value.a.b + 1", r) == 2
+
+
+def test_template_basic():
+    r = rec(value={"question": "what?"})
+    assert render_template("Q: {{ value.question }}", r) == "Q: what?"
+    assert render_template("{{ value.missing }}", r) == ""
+
+
+def test_template_sections():
+    r = rec(value={"docs": [{"text": "a"}, {"text": "b"}], "none": []})
+    out = render_template("{{# value.docs}}[{{ text}}]{{/ value.docs}}", r)
+    assert out == "[a][b]"
+    assert render_template("{{^ value.none}}empty{{/ value.none}}", r) == "empty"
+
+
+def test_template_scalar_list():
+    r = rec(value={"items": ["x", "y"]})
+    assert render_template("{{# value.items}}{{.}},{{/ value.items}}", r) == "x,y,"

@@ -1,0 +1,224 @@
+import textwrap
+
+import pytest
+
+from langstream_tpu.api.application import ErrorsSpec
+from langstream_tpu.core.deployer import ApplicationDeployer
+from langstream_tpu.core.parser import ModelBuilder, build_application_from_directory
+from langstream_tpu.core.placeholders import PlaceholderError, resolve_placeholders
+from langstream_tpu.core.planner import build_execution_plan
+
+PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+errors:
+  on-failure: "skip"
+pipeline:
+  - name: "convert"
+    type: "document-to-json"
+    input: "input-topic"
+    configuration:
+      text-field: "question"
+  - name: "chat"
+    type: "ai-chat-completions"
+    output: "output-topic"
+    configuration:
+      model: "${secrets.llm.model}"
+      completion-field: "value.answer"
+"""
+
+GATEWAYS = """
+gateways:
+  - id: produce-input
+    type: produce
+    topic: input-topic
+    parameters: [sessionId]
+    produce-options:
+      headers:
+        - key: langstream-client-session-id
+          value-from-parameters: sessionId
+  - id: consume-output
+    type: consume
+    topic: output-topic
+    parameters: [sessionId]
+    consume-options:
+      filters:
+        headers:
+          - key: langstream-client-session-id
+            value-from-parameters: sessionId
+"""
+
+CONFIGURATION = """
+configuration:
+  resources:
+    - type: "mock-serving-configuration"
+      name: "mock"
+      configuration:
+        reply: "hello"
+"""
+
+SECRETS = """
+secrets:
+  - id: llm
+    name: llm
+    data:
+      model: "llama-3-8b"
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: "memory"
+  globals:
+    table: "docs"
+"""
+
+
+def build_app(tmp_path, pipeline=PIPELINE):
+    (tmp_path / "pipeline.yaml").write_text(pipeline)
+    (tmp_path / "gateways.yaml").write_text(GATEWAYS)
+    (tmp_path / "configuration.yaml").write_text(CONFIGURATION)
+    return build_application_from_directory(
+        tmp_path, instance=INSTANCE, secrets=SECRETS
+    )
+
+
+def test_parse_full_application(tmp_path):
+    app = build_app(tmp_path)
+    module = app.get_module()
+    assert set(module.topics) == {"input-topic", "output-topic"}
+    pipeline = module.pipelines["pipeline"]
+    assert [a.type for a in pipeline.agents] == [
+        "document-to-json",
+        "ai-chat-completions",
+    ]
+    assert pipeline.errors.on_failure == "skip"
+    assert len(app.gateways) == 2
+    assert app.gateways[0].produce_headers[0].value_from_parameters == "sessionId"
+    assert app.resources and app.instance.globals_["table"] == "docs"
+
+
+def test_placeholder_resolution(tmp_path):
+    app = build_app(tmp_path)
+    resolve_placeholders(app)
+    chat = [a for a in app.all_agents() if a.type == "ai-chat-completions"][0]
+    assert chat.configuration["model"] == "llama-3-8b"
+
+
+def test_placeholder_unresolved_raises(tmp_path):
+    app = build_app(
+        tmp_path,
+        pipeline=PIPELINE.replace("${secrets.llm.model}", "${secrets.nope.x}"),
+    )
+    with pytest.raises(PlaceholderError):
+        resolve_placeholders(app)
+
+
+def test_globals_placeholder(tmp_path):
+    app = build_app(
+        tmp_path, pipeline=PIPELINE.replace("${secrets.llm.model}", "${globals.table}")
+    )
+    resolve_placeholders(app)
+    chat = [a for a in app.all_agents() if a.type == "ai-chat-completions"][0]
+    assert chat.configuration["model"] == "docs"
+
+
+def test_plan_fuses_composable_stages(tmp_path):
+    app = build_app(tmp_path)
+    plan = ApplicationDeployer().create_implementation("app", app)
+    # document-to-json + ai-chat-completions are both composable processors
+    # with equal resources and no explicit topic between → ONE composite node
+    assert len(plan.agents) == 1
+    node = next(iter(plan.agents.values()))
+    assert node.is_composite
+    assert node.input.topic == "input-topic"
+    assert node.output.topic == "output-topic"
+    # skip policy inherited from the pipeline level
+    assert node.errors.on_failure == ErrorsSpec.SKIP
+
+
+def test_plan_no_fusion_on_explicit_topic(tmp_path):
+    pipeline = textwrap.dedent(
+        """
+        topics:
+          - name: "input-topic"
+            creation-mode: create-if-not-exists
+          - name: "mid-topic"
+            creation-mode: create-if-not-exists
+          - name: "output-topic"
+            creation-mode: create-if-not-exists
+        pipeline:
+          - name: "a"
+            type: "document-to-json"
+            input: "input-topic"
+            output: "mid-topic"
+          - name: "b"
+            type: "compute"
+            input: "mid-topic"
+            output: "output-topic"
+            configuration:
+              fields: []
+        """
+    )
+    (tmp_path / "p.yaml").write_text(pipeline)
+    app = build_application_from_directory(tmp_path, instance=INSTANCE)
+    plan = build_execution_plan("app", app)
+    assert len(plan.agents) == 2
+
+
+def test_plan_no_fusion_on_different_parallelism(tmp_path):
+    pipeline = textwrap.dedent(
+        """
+        topics:
+          - name: "input-topic"
+            creation-mode: create-if-not-exists
+        pipeline:
+          - name: "a"
+            type: "document-to-json"
+            input: "input-topic"
+          - name: "b"
+            type: "compute"
+            resources:
+              parallelism: 2
+            configuration:
+              fields: []
+        """
+    )
+    (tmp_path / "p.yaml").write_text(pipeline)
+    app = build_application_from_directory(tmp_path, instance=INSTANCE)
+    plan = build_execution_plan("app", app)
+    assert len(plan.agents) == 2
+    # implicit topic inserted between the two nodes
+    implicit = [t for t in plan.topics.values() if t.implicit]
+    assert len(implicit) == 1
+
+
+def test_plan_undeclared_topic_fails(tmp_path):
+    pipeline = textwrap.dedent(
+        """
+        pipeline:
+          - name: "a"
+            type: "document-to-json"
+            input: "nope-topic"
+        """
+    )
+    (tmp_path / "p.yaml").write_text(pipeline)
+    app = build_application_from_directory(tmp_path, instance=INSTANCE)
+    from langstream_tpu.core.planner import PlanningError
+
+    with pytest.raises(PlanningError):
+        build_execution_plan("app", app)
+
+
+def test_multi_pipeline_files(tmp_path):
+    (tmp_path / "a.yaml").write_text(PIPELINE)
+    (tmp_path / "b.yaml").write_text(
+        PIPELINE.replace("input-topic", "in2").replace("output-topic", "out2")
+    )
+    builder = ModelBuilder()
+    builder.add_application_directory(tmp_path)
+    app = builder.build()
+    assert set(app.get_module().pipelines) == {"a", "b"}

@@ -1,0 +1,376 @@
+"""Runtime integration tests: the role AbstractApplicationRunner plays in the
+reference test suite (in-process app, real broker semantics)."""
+
+import asyncio
+import json
+
+import pytest
+
+from langstream_tpu.api.record import make_record
+from langstream_tpu.core.parser import build_application_from_directory
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+from langstream_tpu.runtime.memory_broker import (
+    MemoryBroker,
+    MemoryTopicConnectionsRuntime,
+)
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: "memory"
+"""
+
+
+def write_app(tmp_path, pipeline, configuration=None):
+    (tmp_path / "pipeline.yaml").write_text(pipeline)
+    if configuration:
+        (tmp_path / "configuration.yaml").write_text(configuration)
+    return tmp_path
+
+
+# ---------------------------------------------------------------------------
+# broker semantics
+# ---------------------------------------------------------------------------
+
+
+def make_runtime():
+    rt = MemoryTopicConnectionsRuntime()
+    rt.init({"cluster": "test"})
+    return rt
+
+
+def test_contiguous_offset_commit(run_async):
+    async def main():
+        rt = make_runtime()
+        admin = rt.create_topic_admin()
+        await admin.create_topic("t", partitions=1)
+        producer = rt.create_producer("p", {"topic": "t"})
+        for i in range(5):
+            await producer.write(make_record(value=i))
+        consumer = rt.create_consumer("g", {"topic": "t", "group": "g"})
+        await consumer.start()
+        records = []
+        while len(records) < 5:
+            records.extend(await consumer.read())
+        # ack out of order: 1,2 but not 0 → committed stays 0
+        await consumer.commit([records[1], records[2]])
+        broker = MemoryBroker.get("test")
+        state = broker.topic("t").group_state("g", 0)
+        assert state.committed == 0
+        # ack 0 → contiguous prefix 0..2 commits
+        await consumer.commit([records[0]])
+        assert state.committed == 3
+        await consumer.close()
+
+    run_async(main())
+
+
+def test_redelivery_after_restart(run_async):
+    async def main():
+        rt = make_runtime()
+        producer = rt.create_producer("p", {"topic": "t"})
+        for i in range(3):
+            await producer.write(make_record(value=i))
+        consumer = rt.create_consumer("g", {"topic": "t", "group": "g"})
+        await consumer.start()
+        records = []
+        while len(records) < 3:
+            records.extend(await consumer.read())
+        await consumer.commit([records[0]])
+        await consumer.close()
+        # new consumer in the same group: uncommitted records redelivered
+        consumer2 = rt.create_consumer("g", {"topic": "t", "group": "g"})
+        await consumer2.start()
+        redelivered = []
+        while len(redelivered) < 2:
+            redelivered.extend(await consumer2.read())
+        assert [r.value for r in redelivered] == [1, 2]
+        await consumer2.close()
+
+    run_async(main())
+
+
+def test_partition_rebalance(run_async):
+    async def main():
+        rt = make_runtime()
+        admin = rt.create_topic_admin()
+        await admin.create_topic("t", partitions=4)
+        c1 = rt.create_consumer("g", {"topic": "t", "group": "g"})
+        c2 = rt.create_consumer("g", {"topic": "t", "group": "g"})
+        await c1.start()
+        assert len(c1.assigned) == 4
+        await c2.start()
+        assert len(c1.assigned) == 2 and len(c2.assigned) == 2
+        await c2.close()
+        assert len(c1.assigned) == 4
+        await c1.close()
+
+    run_async(main())
+
+
+def test_keyed_records_same_partition(run_async):
+    async def main():
+        rt = make_runtime()
+        admin = rt.create_topic_admin()
+        await admin.create_topic("t", partitions=4)
+        producer = rt.create_producer("p", {"topic": "t"})
+        for i in range(10):
+            await producer.write(make_record(value=i, key="same"))
+        broker = MemoryBroker.get("test")
+        partitions_used = [
+            p.index for p in broker.topic("t").partitions if p.records
+        ]
+        assert len(partitions_used) == 1
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end pipelines
+# ---------------------------------------------------------------------------
+
+SIMPLE_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "convert"
+    type: "document-to-json"
+    input: "input-topic"
+    configuration:
+      text-field: "question"
+  - name: "annotate"
+    type: "compute"
+    output: "output-topic"
+    configuration:
+      fields:
+        - name: "value.upper"
+          expression: "fn:uppercase(value.question)"
+"""
+
+
+def test_end_to_end_pipeline(tmp_path, run_async):
+    async def main():
+        app_dir = write_app(tmp_path, SIMPLE_PIPELINE)
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        async with runner:
+            await runner.produce("input-topic", "hello world")
+            msgs = await runner.wait_for_messages("output-topic", 1)
+            assert msgs[0].value == {"question": "hello world", "upper": "HELLO WORLD"}
+
+    run_async(main())
+
+
+ERROR_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+errors:
+  on-failure: "{policy}"
+  retries: {retries}
+pipeline:
+  - name: "boom"
+    type: "compute"
+    input: "input-topic"
+    output: "output-topic"
+    configuration:
+      fields:
+        - name: "value.x"
+          expression: "value.a / value.b"
+"""
+
+
+def test_error_skip_policy(tmp_path, run_async):
+    async def main():
+        app_dir = write_app(
+            tmp_path, ERROR_PIPELINE.format(policy="skip", retries=0)
+        )
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        async with runner:
+            await runner.produce("input-topic", {"a": 1, "b": 0})  # div by zero
+            await runner.produce("input-topic", {"a": 4, "b": 2})
+            msgs = await runner.wait_for_messages("output-topic", 1)
+            assert msgs[0].value["x"] == 2.0
+            info = runner.agent_info()
+            assert info[0]["errors"] >= 1
+
+    run_async(main())
+
+
+def test_error_deadletter_policy(tmp_path, run_async):
+    async def main():
+        app_dir = write_app(
+            tmp_path, ERROR_PIPELINE.format(policy="dead-letter", retries=0)
+        )
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        async with runner:
+            await runner.produce("input-topic", {"a": 1, "b": 0})
+            dead = await runner.wait_for_messages("input-topic-deadletter", 1)
+            assert dead[0].value == {"a": 1, "b": 0}
+            assert dead[0].header("langstream-error-class") == "ZeroDivisionError"
+
+    run_async(main())
+
+
+PARALLEL_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+    partitions: 4
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "annotate"
+    type: "compute"
+    input: "input-topic"
+    output: "output-topic"
+    resources:
+      parallelism: 2
+    configuration:
+      fields:
+        - name: "value.seen"
+          expression: "true"
+"""
+
+
+def test_parallel_replicas_split_partitions(tmp_path, run_async):
+    async def main():
+        app_dir = write_app(tmp_path, PARALLEL_PIPELINE)
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        async with runner:
+            assert len(runner.runners) == 2
+            for i in range(8):
+                await runner.produce("input-topic", {"n": i}, key=f"k{i}")
+            msgs = await runner.wait_for_messages("output-topic", 8)
+            assert len(msgs) == 8
+            # both replicas processed something (4 partitions, 2 consumers)
+            ins = [r.records_in for r in runner.runners]
+            assert all(n > 0 for n in ins)
+
+    run_async(main())
+
+
+DISPATCH_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "english-topic"
+    creation-mode: create-if-not-exists
+  - name: "other-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "route"
+    type: "dispatch"
+    input: "input-topic"
+    output: "other-topic"
+    configuration:
+      routes:
+        - when: "properties.language == 'en'"
+          destination: "english-topic"
+        - when: "properties.language == 'xx'"
+          action: "drop"
+"""
+
+
+def test_dispatch_routing(tmp_path, run_async):
+    async def main():
+        app_dir = write_app(tmp_path, DISPATCH_PIPELINE)
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        async with runner:
+            await runner.produce("input-topic", "hi", headers={"language": "en"})
+            await runner.produce("input-topic", "drop me", headers={"language": "xx"})
+            await runner.produce("input-topic", "autre", headers={"language": "fr"})
+            en = await runner.wait_for_messages("english-topic", 1)
+            other = await runner.wait_for_messages("other-topic", 1)
+            assert en[0].value == "hi"
+            assert other[0].value == "autre"
+
+    run_async(main())
+
+
+def test_dispatch_header_stripped_from_routed_record(tmp_path, run_async):
+    # regression: the destination-topic routing header must not survive onto
+    # the routed record (it would hijack every downstream node's output)
+    async def main():
+        app_dir = write_app(tmp_path, DISPATCH_PIPELINE)
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        async with runner:
+            await runner.produce("input-topic", "hi", headers={"language": "en"})
+            en = await runner.wait_for_messages("english-topic", 1)
+            assert en[0].header("langstream-destination-topic") is None
+
+    run_async(main())
+
+
+def test_mixed_vector_upserts_stay_aligned(run_async):
+    # regression: vectorless + vectored upserts must not misalign rows
+    async def main():
+        from langstream_tpu.agents.vector import InMemoryVectorStore
+
+        store = InMemoryVectorStore.get("align-test")
+        coll = store.collection("c")
+        coll.upsert("no-vec", None, {"text": "plain"})
+        coll.upsert("vec-1", [1.0, 0.0], {"text": "one"})
+        coll.upsert("vec-2", [0.0, 1.0], {"text": "two"})
+        hits = coll.search([1.0, 0.0], top_k=2, flt=None)
+        assert hits[0]["id"] == "vec-1" and hits[0]["text"] == "one"
+        coll.upsert("vec-1", [0.0, 1.0], {"text": "one-moved"})
+        hits = coll.search([0.0, 1.0], top_k=1, flt=None)
+        assert hits[0]["text"] in ("two", "one-moved")
+        coll.delete("no-vec")
+        assert coll.ids == ["vec-1", "vec-2"]
+
+    run_async(main())
+
+
+def test_backpressure_bounds_inflight(tmp_path, run_async):
+    async def main():
+        slow_pipeline = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "annotate"
+    type: "compute"
+    input: "input-topic"
+    output: "output-topic"
+    configuration:
+      max-pending-records: 4
+      fields:
+        - name: "value.seen"
+          expression: "true"
+"""
+        app_dir = write_app(tmp_path, slow_pipeline)
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        async with runner:
+            assert runner.runners[0].max_pending == 4
+            for i in range(40):
+                await runner.produce("input-topic", {"n": i})
+            msgs = await runner.wait_for_messages("output-topic", 40)
+            assert len(msgs) == 40
+
+    run_async(main())
+
+
+def test_graceful_drain_commits_before_stop(tmp_path, run_async):
+    async def main():
+        app_dir = write_app(tmp_path, SIMPLE_PIPELINE)
+        runner = LocalApplicationRunner.from_directory(app_dir, instance=INSTANCE)
+        await runner.start()
+        for i in range(20):
+            await runner.produce("input-topic", f"m{i}")
+        msgs = await runner.wait_for_messages("output-topic", 20)
+        await runner.stop()
+        # all offsets committed: a fresh group member sees nothing pending
+        broker = MemoryBroker.get("default")
+        group = f"app-{next(iter(runner.plan.agents))}"
+        state = broker.topic("input-topic").group_state(group, 0)
+        assert state.committed == 20
+
+    run_async(main())
