@@ -1,0 +1,159 @@
+"""The in-tree TPU ServiceProvider: the point of the whole framework.
+
+Where the reference's providers are HTTP clients
+(``OpenAIServiceProvider.java:26``, ``VertexAIProvider.java:58``, …), this
+provider hands the AI agents a local :class:`TpuServingEngine` /
+:class:`EmbeddingEngine` — completions and embeddings run on this pod's
+chips, streaming tokens straight into the agent's chunk writer.
+
+Resource shape (``configuration.yaml``):
+
+    resources:
+      - type: "tpu-serving-configuration"
+        name: "tpu"
+        configuration:
+          model: "llama-1b"            # tiny | llama-1b | llama3-8b | llama3-70b
+          slots: 8
+          max-seq-len: 2048
+          tokenizer: null              # byte-level fallback; or local HF dir
+          checkpoint: null             # local weights dir; random init otherwise
+          mesh: {dp: 1, tp: 8}         # omit for single device
+          embeddings-model: "minilm-l6"
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from langstream_tpu.agents.services import (
+    Chunk,
+    CompletionResult,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+from langstream_tpu.serving.engine import (
+    EmbeddingEngine,
+    ServingConfig,
+    TpuServingEngine,
+)
+
+
+def _render_chat_prompt(messages: list[dict[str, str]]) -> str:
+    """Default chat template (checkpoint-specific templates come from the
+    tokenizer when a real HF tokenizer dir is configured)."""
+    parts = []
+    for m in messages:
+        parts.append(f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}")
+    parts.append("<|assistant|>\n")
+    return "\n".join(parts)
+
+
+class _StreamAdapter:
+    """Bridges engine on_token callbacks to the agents' chunk consumers,
+    detokenising incrementally (only complete UTF-8 prefixes are emitted)."""
+
+    def __init__(self, tokenizer, consumer: StreamingChunksConsumer):
+        self.tokenizer = tokenizer
+        self.consumer = consumer
+        self.ids: list[int] = []
+        self.emitted = ""
+        self.index = 0
+
+    async def on_token(self, token: int, logprob: float, last: bool) -> None:
+        self.ids.append(token)
+        text = self.tokenizer.decode(self.ids)
+        # hold back a trailing replacement char (partial multi-byte sequence)
+        safe = text[:-1] if text.endswith("�") and not last else text
+        delta = safe[len(self.emitted):]
+        if delta or last:
+            self.emitted = safe
+            result = self.consumer(Chunk(delta, self.index, last=last))
+            if hasattr(result, "__await__"):
+                await result
+            self.index += 1
+
+
+class TpuCompletionsService(CompletionsService):
+    def __init__(self, engine: TpuServingEngine):
+        self.engine = engine
+
+    async def _generate(
+        self,
+        prompt: str,
+        options: dict[str, Any],
+        consumer: StreamingChunksConsumer | None,
+    ) -> CompletionResult:
+        adapter = (
+            _StreamAdapter(self.engine.tokenizer, consumer)
+            if consumer is not None
+            else None
+        )
+        result = await self.engine.generate(
+            prompt,
+            options,
+            on_token=adapter.on_token if adapter else None,
+        )
+        return CompletionResult(
+            text=result["text"],
+            num_prompt_tokens=result["num_prompt_tokens"],
+            num_completion_tokens=result["num_completion_tokens"],
+            finish_reason=result["finish_reason"],
+        )
+
+    async def chat_completions(
+        self,
+        messages: list[dict[str, str]],
+        options: dict[str, Any],
+        consumer: StreamingChunksConsumer | None = None,
+    ) -> CompletionResult:
+        return await self._generate(_render_chat_prompt(messages), options, consumer)
+
+    async def text_completions(
+        self,
+        prompt: str,
+        options: dict[str, Any],
+        consumer: StreamingChunksConsumer | None = None,
+    ) -> CompletionResult:
+        return await self._generate(prompt, options, consumer)
+
+
+class TpuEmbeddingsService(EmbeddingsService):
+    def __init__(self, engine: EmbeddingEngine):
+        self.engine = engine
+
+    async def compute_embeddings(self, texts: list[str]) -> list[list[float]]:
+        return await self.engine.embed(texts)
+
+
+class TpuServiceProvider(ServiceProvider):
+    def __init__(self, resource_config: dict[str, Any]):
+        self.resource_config = resource_config
+
+    def _engine_config(self) -> dict[str, Any]:
+        """Engine topology comes from the *resource* (model, slots, mesh,
+        checkpoint); per-request options (max-tokens, temperature, …) come
+        from the agent at call time — so every agent in the app shares one
+        engine per resource."""
+        return {
+            k: v
+            for k, v in self.resource_config.items()
+            if k not in ("type", "name")
+        }
+
+    def get_completions_service(self, config: dict[str, Any]) -> CompletionsService:
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig.from_dict(self._engine_config())
+        )
+        return TpuCompletionsService(engine)
+
+    def get_embeddings_service(self, config: dict[str, Any]) -> EmbeddingsService:
+        cfg = self._engine_config()
+        engine = EmbeddingEngine.get_or_create(
+            model=cfg.get("embeddings-model", "minilm-l6"),
+            tokenizer=cfg.get("tokenizer"),
+            checkpoint=cfg.get("embeddings-checkpoint"),
+            mesh=cfg.get("mesh"),
+        )
+        return TpuEmbeddingsService(engine)

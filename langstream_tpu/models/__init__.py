@@ -1,0 +1,21 @@
+"""JAX model zoo: the in-tree replacements for the reference's SaaS models.
+
+The reference delegates completions/embeddings to external HTTP APIs
+(``langstream-ai-agents/.../services/impl/*``); here the models live in-tree
+as pure-JAX functional implementations designed for the MXU: stacked-layer
+parameters scanned with ``lax.scan`` (one compiled layer body), bfloat16
+weights, static shapes, and ``NamedSharding`` rules for tensor parallelism.
+"""
+
+from langstream_tpu.models.llama import LlamaConfig, init_llama_params, llama_prefill, llama_decode_step
+from langstream_tpu.models.encoder import EncoderConfig, init_encoder_params, encode
+
+__all__ = [
+    "LlamaConfig",
+    "init_llama_params",
+    "llama_prefill",
+    "llama_decode_step",
+    "EncoderConfig",
+    "init_encoder_params",
+    "encode",
+]

@@ -1,0 +1,80 @@
+"""Checkpoint loading for Llama-family weights (local files only).
+
+Supports HF-format directories (``*.safetensors`` or ``pytorch_model*.bin``)
+with standard Llama tensor names, converted into our stacked-layer layout.
+No network egress exists in this environment, so loading is gated on the
+files being present; the serving engine falls back to random init otherwise.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.llama import LlamaConfig
+
+
+def _load_state_dict(path: Path) -> dict:
+    safetensors = sorted(path.glob("*.safetensors"))
+    if safetensors:
+        try:
+            from safetensors.numpy import load_file
+        except ImportError as e:
+            raise RuntimeError(
+                "checkpoint is in safetensors format but the safetensors "
+                f"library is unavailable: {e}"
+            )
+        state: dict = {}
+        for f in safetensors:
+            state.update(load_file(str(f)))
+        return state
+    bins = sorted(path.glob("pytorch_model*.bin"))
+    if bins:
+        import torch
+
+        state = {}
+        for f in bins:
+            part = torch.load(str(f), map_location="cpu")
+            state.update({k: v.numpy() for k, v in part.items()})
+        return state
+    raise FileNotFoundError(f"no weight files under {path}")
+
+
+def load_llama_checkpoint(checkpoint_dir: str, config: LlamaConfig) -> dict:
+    path = Path(checkpoint_dir)
+    state = _load_state_dict(path)
+    c = config
+    dt = c.dtype
+
+    def g(name: str) -> np.ndarray:
+        key = name if name in state else f"model.{name}"
+        return np.asarray(state[key])
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        mats = []
+        for i in range(c.layers):
+            m = g(fmt.format(i=i))
+            mats.append(m.T if transpose else m)
+        return jnp.asarray(np.stack(mats), dtype=dt)
+
+    return {
+        "embed": jnp.asarray(g("embed_tokens.weight"), dtype=dt),
+        "layers": {
+            "attn_norm": stack("layers.{i}.input_layernorm.weight", transpose=False),
+            "wq": stack("layers.{i}.self_attn.q_proj.weight"),
+            "wk": stack("layers.{i}.self_attn.k_proj.weight"),
+            "wv": stack("layers.{i}.self_attn.v_proj.weight"),
+            "wo": stack("layers.{i}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stack("layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stack("layers.{i}.mlp.up_proj.weight"),
+            "w_down": stack("layers.{i}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(g("norm.weight"), dtype=dt),
+        "lm_head": jnp.asarray(
+            np.asarray(state.get("lm_head.weight", g("embed_tokens.weight"))).T,
+            dtype=dt,
+        ),
+    }

@@ -1,0 +1,205 @@
+"""BERT/MiniLM-class text encoder for embeddings, pure JAX.
+
+This is the in-tree engine behind ``compute-ai-embeddings`` (the reference
+calls OpenAI/HF embedding APIs; ``ComputeAIEmbeddingsStep.java:46``).
+Architecture matches sentence-transformers all-MiniLM-L6-v2 (6 layers, 384
+hidden, 12 heads, GELU, post-LN) with mean pooling + L2 normalisation, so
+real checkpoints can be loaded when weight files are present
+(:func:`load_from_sentence_transformers`); random init otherwise (tests,
+offline dev).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    hidden: int = 384
+    layers: int = 6
+    heads: int = 12
+    intermediate: int = 1536
+    max_position: int = 512
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def minilm_l6(cls) -> "EncoderConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "EncoderConfig":
+        # vocab covers the byte tokenizer (256 bytes + specials)
+        return cls(vocab_size=384, hidden=32, layers=2, heads=4,
+                   intermediate=64, max_position=64)
+
+
+def init_encoder_params(config: EncoderConfig, key: jax.Array | None = None) -> dict:
+    key = key if key is not None else jax.random.PRNGKey(0)
+    c = config
+    ks = jax.random.split(key, 12)
+
+    def w(k, *shape, fan_in):
+        return (
+            jax.random.normal(k, shape, dtype=jnp.float32) / math.sqrt(fan_in)
+        ).astype(c.dtype)
+
+    L = c.layers
+    return {
+        "tok_embed": w(ks[0], c.vocab_size, c.hidden, fan_in=c.hidden),
+        "pos_embed": w(ks[1], c.max_position, c.hidden, fan_in=c.hidden),
+        "embed_norm_w": jnp.ones((c.hidden,), c.dtype),
+        "embed_norm_b": jnp.zeros((c.hidden,), c.dtype),
+        "layers": {
+            "wq": w(ks[2], L, c.hidden, c.hidden, fan_in=c.hidden),
+            "bq": jnp.zeros((L, c.hidden), c.dtype),
+            "wk": w(ks[3], L, c.hidden, c.hidden, fan_in=c.hidden),
+            "bk": jnp.zeros((L, c.hidden), c.dtype),
+            "wv": w(ks[4], L, c.hidden, c.hidden, fan_in=c.hidden),
+            "bv": jnp.zeros((L, c.hidden), c.dtype),
+            "wo": w(ks[5], L, c.hidden, c.hidden, fan_in=c.hidden),
+            "bo": jnp.zeros((L, c.hidden), c.dtype),
+            "attn_norm_w": jnp.ones((L, c.hidden), c.dtype),
+            "attn_norm_b": jnp.zeros((L, c.hidden), c.dtype),
+            "w1": w(ks[6], L, c.hidden, c.intermediate, fan_in=c.hidden),
+            "b1": jnp.zeros((L, c.intermediate), c.dtype),
+            "w2": w(ks[7], L, c.intermediate, c.hidden, fan_in=c.intermediate),
+            "b2": jnp.zeros((L, c.hidden), c.dtype),
+            "mlp_norm_w": jnp.ones((L, c.hidden), c.dtype),
+            "mlp_norm_b": jnp.zeros((L, c.hidden), c.dtype),
+        },
+    }
+
+
+def encoder_param_specs(config: EncoderConfig) -> dict:
+    """TP specs (column/row split per layer); dp shards the batch."""
+    return {
+        "tok_embed": P(None, None),
+        "pos_embed": P(None, None),
+        "embed_norm_w": P(None),
+        "embed_norm_b": P(None),
+        "layers": {
+            "wq": P(None, None, "tp"), "bq": P(None, "tp"),
+            "wk": P(None, None, "tp"), "bk": P(None, "tp"),
+            "wv": P(None, None, "tp"), "bv": P(None, "tp"),
+            "wo": P(None, "tp", None), "bo": P(None, None),
+            "attn_norm_w": P(None, None), "attn_norm_b": P(None, None),
+            "w1": P(None, None, "tp"), "b1": P(None, "tp"),
+            "w2": P(None, "tp", None), "b2": P(None, None),
+            "mlp_norm_w": P(None, None), "mlp_norm_b": P(None, None),
+        },
+    }
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    return (((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)) * w + b
+
+
+def encode(
+    config: EncoderConfig,
+    params: dict,
+    tokens: jax.Array,   # (B, S) int32, right-padded
+    mask: jax.Array,     # (B, S) 1 for real tokens
+) -> jax.Array:
+    """→ (B, hidden) L2-normalised sentence embeddings (mean pooling)."""
+    c = config
+    B, S = tokens.shape
+    x = jnp.take(params["tok_embed"], tokens, axis=0)
+    x = x + params["pos_embed"][None, :S]
+    x = _layer_norm(x, params["embed_norm_w"], params["embed_norm_b"], c.norm_eps)
+    attn_mask = (mask[:, None, None, :] == 1)
+    neg = jnp.finfo(jnp.float32).min
+    head_dim = c.hidden // c.heads
+
+    def layer(x, lp):
+        q = (jnp.einsum("bsh,hd->bsd", x, lp["wq"]) + lp["bq"]).reshape(
+            B, S, c.heads, head_dim
+        )
+        k = (jnp.einsum("bsh,hd->bsd", x, lp["wk"]) + lp["bk"]).reshape(
+            B, S, c.heads, head_dim
+        )
+        v = (jnp.einsum("bsh,hd->bsd", x, lp["wv"]) + lp["bv"]).reshape(
+            B, S, c.heads, head_dim
+        )
+        scores = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
+        scores = scores / math.sqrt(head_dim)
+        scores = jnp.where(attn_mask, scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(B, S, c.hidden)
+        out = jnp.einsum("bsd,dh->bsh", out, lp["wo"]) + lp["bo"]
+        x = _layer_norm(x + out, lp["attn_norm_w"], lp["attn_norm_b"], c.norm_eps)
+        h = jax.nn.gelu(jnp.einsum("bsh,hi->bsi", x, lp["w1"]) + lp["b1"])
+        h = jnp.einsum("bsi,ih->bsh", h, lp["w2"]) + lp["b2"]
+        x = _layer_norm(x + h, lp["mlp_norm_w"], lp["mlp_norm_b"], c.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    # mean pooling over real tokens, then L2 normalise
+    m = mask[..., None].astype(x.dtype)
+    pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    pooled = pooled.astype(jnp.float32)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
+def load_from_sentence_transformers(model_name_or_path: str) -> tuple[EncoderConfig, dict]:
+    """Load real MiniLM weights when available locally (gated on weight
+    files being present; no network in this environment)."""
+    import numpy as np
+    from pathlib import Path
+
+    path = Path(model_name_or_path)
+    if not path.exists():
+        raise FileNotFoundError(
+            f"no local checkpoint at {model_name_or_path}; download is not "
+            f"possible offline"
+        )
+    import torch  # cpu-only torch is in the image
+
+    state = torch.load(path / "pytorch_model.bin", map_location="cpu")
+    c = EncoderConfig.minilm_l6()
+
+    def get(name):
+        return jnp.asarray(np.asarray(state[name]))
+
+    layers: dict[str, list] = {k: [] for k in (
+        "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+        "attn_norm_w", "attn_norm_b", "w1", "b1", "w2", "b2",
+        "mlp_norm_w", "mlp_norm_b",
+    )}
+    for i in range(c.layers):
+        p = f"encoder.layer.{i}."
+        layers["wq"].append(get(p + "attention.self.query.weight").T)
+        layers["bq"].append(get(p + "attention.self.query.bias"))
+        layers["wk"].append(get(p + "attention.self.key.weight").T)
+        layers["bk"].append(get(p + "attention.self.key.bias"))
+        layers["wv"].append(get(p + "attention.self.value.weight").T)
+        layers["bv"].append(get(p + "attention.self.value.bias"))
+        layers["wo"].append(get(p + "attention.output.dense.weight").T)
+        layers["bo"].append(get(p + "attention.output.dense.bias"))
+        layers["attn_norm_w"].append(get(p + "attention.output.LayerNorm.weight"))
+        layers["attn_norm_b"].append(get(p + "attention.output.LayerNorm.bias"))
+        layers["w1"].append(get(p + "intermediate.dense.weight").T)
+        layers["b1"].append(get(p + "intermediate.dense.bias"))
+        layers["w2"].append(get(p + "output.dense.weight").T)
+        layers["b2"].append(get(p + "output.dense.bias"))
+        layers["mlp_norm_w"].append(get(p + "output.LayerNorm.weight"))
+        layers["mlp_norm_b"].append(get(p + "output.LayerNorm.bias"))
+    params = {
+        "tok_embed": get("embeddings.word_embeddings.weight"),
+        "pos_embed": get("embeddings.position_embeddings.weight"),
+        "embed_norm_w": get("embeddings.LayerNorm.weight"),
+        "embed_norm_b": get("embeddings.LayerNorm.bias"),
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+    }
+    return c, params

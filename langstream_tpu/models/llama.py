@@ -1,0 +1,481 @@
+"""Llama-family decoder, pure JAX, TPU-first.
+
+Design choices (vs. a torch port):
+- **Stacked layer params + ``lax.scan``**: one compiled layer body instead of
+  N inlined layers — faster compiles, identical runtime (XLA unrolls DMA
+  pipelining itself).
+- **bfloat16 weights/activations, float32 softmax+norms**: MXU-native.
+- **GQA attention via grouped einsum** — no KV head replication, so the KV
+  cache stays small and HBM-bandwidth-friendly.
+- **Static shapes everywhere**: prefill pads to length buckets; decode is a
+  fixed (slots,) batch. No data-dependent control flow inside jit.
+- **TP sharding rules** (Megatron-style, over the ``tp`` mesh axis):
+  attention QKV and MLP up/gate are column-sharded, attention out and MLP
+  down row-sharded; XLA inserts the psums on ICI. KV cache shards on the KV
+  head axis; batch (slots) shards on ``dp``.
+
+Capability parity: this is the engine behind ``ai-chat-completions`` /
+``ai-text-completions`` (reference: ``ChatCompletionsStep.java`` calling
+OpenAI etc. — here the model is local).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden: int = 2048
+    layers: int = 16
+    heads: int = 16
+    kv_heads: int = 8
+    head_dim: int = 128
+    intermediate: int = 5632
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def llama3_8b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, hidden=4096, layers=32, heads=32, kv_heads=8,
+            head_dim=128, intermediate=14336, rope_theta=500000.0,
+            max_seq_len=max_seq_len,
+        )
+
+    @classmethod
+    def llama3_70b(cls, max_seq_len: int = 8192) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, hidden=8192, layers=80, heads=64, kv_heads=8,
+            head_dim=128, intermediate=28672, rope_theta=500000.0,
+            max_seq_len=max_seq_len,
+        )
+
+    @classmethod
+    def llama_1b(cls, max_seq_len: int = 2048) -> "LlamaConfig":
+        """~1.2B params — the per-chip share of Llama-3-8B under TP8, used as
+        the single-chip benchmark proxy (BASELINE.md config #2/#5)."""
+        return cls(
+            vocab_size=32000, hidden=2048, layers=16, heads=16, kv_heads=8,
+            head_dim=128, intermediate=5632, max_seq_len=max_seq_len,
+        )
+
+    @classmethod
+    def tiny(cls, max_seq_len: int = 128) -> "LlamaConfig":
+        """Test-size config (CPU-mesh tests, dry runs). Vocab covers the
+        byte-level tokenizer (256 bytes + specials)."""
+        return cls(
+            vocab_size=384, hidden=64, layers=2, heads=4, kv_heads=2,
+            head_dim=16, intermediate=128, max_seq_len=max_seq_len,
+        )
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def init_llama_params(config: LlamaConfig, key: jax.Array | None = None) -> dict:
+    """Random-init params (stacked per-layer leading dim L)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    c = config
+    keys = jax.random.split(key, 10)
+    qkv_dim = c.heads * c.head_dim
+    kv_dim = c.kv_heads * c.head_dim
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=c.dtype)
+
+    def w_init(k, *shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(c.dtype)
+
+    L = c.layers
+    return {
+        "embed": w_init(keys[0], c.vocab_size, c.hidden, fan_in=c.hidden),
+        "layers": {
+            "attn_norm": norm_init(L, c.hidden),
+            "wq": w_init(keys[1], L, c.hidden, qkv_dim, fan_in=c.hidden),
+            "wk": w_init(keys[2], L, c.hidden, kv_dim, fan_in=c.hidden),
+            "wv": w_init(keys[3], L, c.hidden, kv_dim, fan_in=c.hidden),
+            "wo": w_init(keys[4], L, qkv_dim, c.hidden, fan_in=qkv_dim),
+            "mlp_norm": norm_init(L, c.hidden),
+            "w_gate": w_init(keys[5], L, c.hidden, c.intermediate, fan_in=c.hidden),
+            "w_up": w_init(keys[6], L, c.hidden, c.intermediate, fan_in=c.hidden),
+            "w_down": w_init(keys[7], L, c.intermediate, c.hidden, fan_in=c.intermediate),
+        },
+        "final_norm": norm_init(c.hidden),
+        "lm_head": w_init(keys[8], c.hidden, c.vocab_size, fan_in=c.hidden),
+    }
+
+
+def llama_param_specs(config: LlamaConfig) -> dict:
+    """PartitionSpecs per param (Megatron TP over axis ``tp``)."""
+    return {
+        "embed": P("tp", None),          # vocab-sharded
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),   # column (heads)
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),   # row
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),        # vocab-sharded logits
+    }
+
+
+def shard_llama_params(params: dict, config: LlamaConfig, mesh: Mesh) -> dict:
+    specs = llama_param_specs(config)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def kv_cache_spec(mesh_axes: tuple[str, ...]) -> P:
+    """Cache (L, slots, S, kv_heads, head_dim): slots on dp, kv heads on tp."""
+    dp = "dp" if "dp" in mesh_axes else None
+    tp = "tp" if "tp" in mesh_axes else None
+    return P(None, dp, None, tp, None)
+
+
+def init_kv_cache(
+    config: LlamaConfig, slots: int, max_seq_len: int | None = None
+) -> tuple[jax.Array, jax.Array]:
+    c = config
+    seq = max_seq_len or c.max_seq_len
+    shape = (c.layers, slots, seq, c.kv_heads, c.head_dim)
+    return jnp.zeros(shape, dtype=c.dtype), jnp.zeros(shape, dtype=c.dtype)
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def _rope(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions: (..., head_dim//2)."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (..., heads, head_dim); cos/sin broadcast over the heads axis."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(
+        x.dtype
+    )
+
+
+def _swiglu(x, w_gate, w_up, w_down):
+    gate = jax.nn.silu(jnp.einsum("...h,hi->...i", x, w_gate))
+    up = jnp.einsum("...h,hi->...i", x, w_up)
+    return jnp.einsum("...i,ih->...h", gate * up, w_down)
+
+
+# ---------------------------------------------------------------------------
+# prefill
+# ---------------------------------------------------------------------------
+
+
+def llama_prefill(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,       # (B, P) int32, right-padded
+    lengths: jax.Array,      # (B,) true lengths
+    cache_k: jax.Array,      # (L, slots, S, K, D)
+    cache_v: jax.Array,
+    slot_ids: jax.Array,     # (B,) which cache slots to fill
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Process prompts, fill the KV cache, return last-token logits (B, V)."""
+    c = config
+    B, Pn = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)  # (B, P, H)
+    positions = jnp.arange(Pn)[None, :].repeat(B, axis=0)
+    cos, sin = _rope(positions, c.head_dim, c.rope_theta)
+    # causal + padding mask: (B, 1, P, P)
+    q_idx = jnp.arange(Pn)[:, None]
+    k_idx = jnp.arange(Pn)[None, :]
+    causal = q_idx >= k_idx
+    valid = k_idx < lengths[:, None, None]  # (B, 1, P) keys within length
+    mask = causal[None, :, :] & valid
+    neg = jnp.finfo(jnp.float32).min
+
+    def layer(carry, layer_in):
+        x = carry
+        lp, ck_l, cv_l = layer_in
+        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, Pn, c.heads, c.head_dim)
+        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, Pn, c.kv_heads, c.head_dim)
+        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, Pn, c.kv_heads, c.head_dim)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        # grouped-query attention: heads = kv_heads * group
+        G = c.heads // c.kv_heads
+        qg = q.reshape(B, Pn, c.kv_heads, G, c.head_dim)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
+        scores = scores / math.sqrt(c.head_dim)
+        scores = jnp.where(mask[:, None, None, :, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+        out = out.reshape(B, Pn, c.heads * c.head_dim)
+        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+        h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+        x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        # write this layer's K/V into the cache at the given slots
+        pad = ck_l.shape[1] - Pn
+        k_padded = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v_padded = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        ck_l = ck_l.at[slot_ids].set(k_padded)
+        cv_l = cv_l.at[slot_ids].set(v_padded)
+        return x, (ck_l, cv_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache_k, cache_v)
+    )
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    # logits for the last real token of each prompt
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].clip(0), axis=1
+    ).squeeze(1)
+    logits = jnp.einsum("bh,hv->bv", last, params["lm_head"]).astype(jnp.float32)
+    return logits, new_k, new_v
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+
+def llama_decode_step(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,     # (B,) current token per slot
+    lengths: jax.Array,    # (B,) tokens already in cache per slot
+    cache_k: jax.Array,    # (L, B, S, K, D)
+    cache_v: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for every slot; returns logits (B, V) + new caches.
+
+    The new K/V is written at position ``lengths`` per slot; attention spans
+    positions 0..lengths inclusive. Inactive slots simply produce garbage
+    logits the engine ignores (no dynamic shapes).
+    """
+    c = config
+    B = tokens.shape[0]
+    S = cache_k.shape[2]
+    x = jnp.take(params["embed"], tokens, axis=0)  # (B, H)
+    cos, sin = _rope(lengths, c.head_dim, c.rope_theta)  # (B, half)
+    k_idx = jnp.arange(S)[None, :]
+    key_mask = k_idx <= lengths[:, None]  # (B, S)
+    neg = jnp.finfo(jnp.float32).min
+    G = c.heads // c.kv_heads
+    batch_idx = jnp.arange(B)
+
+    def layer(carry, layer_in):
+        x = carry
+        lp, ck_l, cv_l = layer_in
+        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, c.heads, c.head_dim)
+        k = (h @ lp["wk"]).reshape(B, c.kv_heads, c.head_dim)
+        v = (h @ lp["wv"]).reshape(B, c.kv_heads, c.head_dim)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        ck_l = ck_l.at[batch_idx, lengths].set(k)
+        cv_l = cv_l.at[batch_idx, lengths].set(v)
+        qg = q.reshape(B, c.kv_heads, G, c.head_dim)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qg, ck_l).astype(jnp.float32)
+        scores = scores / math.sqrt(c.head_dim)
+        scores = jnp.where(key_mask[:, None, None, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bkgs,bskd->bkgd", probs, cv_l)
+        out = out.reshape(B, c.heads * c.head_dim)
+        x = x + out @ lp["wo"]
+        h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+        x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (ck_l, cv_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache_k, cache_v)
+    )
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_k, new_v
+
+
+def llama_decode_chunk(
+    config: LlamaConfig,
+    params: dict,
+    tokens0: jax.Array,       # (B,) current token per slot
+    base_lengths: jax.Array,  # (B,) tokens in cache at chunk start
+    active: jax.Array,        # (B,) bool
+    cache_k: jax.Array,       # (L, B, S, K, D) — READ-ONLY during the chunk
+    cache_v: jax.Array,
+    sample_fn,                # (logits, key) -> (tokens, logprobs)
+    key: jax.Array,
+    num_steps: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """K fused decode steps with a two-segment KV layout.
+
+    HBM discipline: the big cache is consumed read-only (no per-step
+    rematerialisation); each step's new K/V lands in a small chunk buffer
+    ``(L, B, num_steps, Kh, D)`` carried through the step scan; a single
+    commit writes the buffer back into the cache at the end. Attention spans
+    [cache rows < base_len] ∪ [buffer rows ≤ step]. Per-step HBM traffic is
+    params + cache *read* only — the difference between ~1k and ~10k tok/s.
+
+    Returns (chunk_tokens (K,B), chunk_logprobs (K,B), final_tokens,
+    final_lengths, cache_k, cache_v) with the buffer committed.
+    """
+    c = config
+    B = tokens0.shape[0]
+    S = cache_k.shape[2]
+    G = c.heads // c.kv_heads
+    adv = active.astype(jnp.int32)
+    neg = jnp.finfo(jnp.float32).min
+    cache_mask = (jnp.arange(S)[None, :] < base_lengths[:, None])  # (B, S) static per chunk
+    kbuf0 = jnp.zeros((c.layers, B, num_steps, c.kv_heads, c.head_dim), c.dtype)
+    vbuf0 = jnp.zeros_like(kbuf0)
+
+    def step(carry, step_idx):
+        tokens, kbuf, vbuf, key = carry
+        key, sub = jax.random.split(key)
+        x = jnp.take(params["embed"], tokens, axis=0)  # (B, H)
+        positions = base_lengths + step_idx * adv
+        cos, sin = _rope(positions, c.head_dim, c.rope_theta)
+        buf_mask = (jnp.arange(num_steps)[None, :] <= step_idx)  # (1, K)
+
+        def layer(x, layer_in):
+            lp, ck_l, cv_l, kbuf_l, vbuf_l = layer_in
+            h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+            q = (h @ lp["wq"]).reshape(B, c.heads, c.head_dim)
+            k = (h @ lp["wk"]).reshape(B, c.kv_heads, c.head_dim)
+            v = (h @ lp["wv"]).reshape(B, c.kv_heads, c.head_dim)
+            q = _apply_rope(q, cos, sin)
+            k = _apply_rope(k, cos, sin)
+            kbuf_l = jax.lax.dynamic_update_slice_in_dim(
+                kbuf_l, k[:, None], step_idx, axis=1
+            )
+            vbuf_l = jax.lax.dynamic_update_slice_in_dim(
+                vbuf_l, v[:, None], step_idx, axis=1
+            )
+            qg = q.reshape(B, c.kv_heads, G, c.head_dim)
+            s_cache = jnp.einsum("bkgd,bskd->bkgs", qg, ck_l).astype(jnp.float32)
+            s_buf = jnp.einsum("bkgd,btkd->bkgt", qg, kbuf_l).astype(jnp.float32)
+            scale = 1.0 / math.sqrt(c.head_dim)
+            s_cache = jnp.where(
+                cache_mask[:, None, None, :], s_cache * scale, neg
+            )
+            s_buf = jnp.where(buf_mask[:, None, None, :], s_buf * scale, neg)
+            s_all = jnp.concatenate([s_cache, s_buf], axis=-1)
+            probs = jax.nn.softmax(s_all, axis=-1).astype(x.dtype)
+            p_cache, p_buf = probs[..., :S], probs[..., S:]
+            out = jnp.einsum("bkgs,bskd->bkgd", p_cache, cv_l) + jnp.einsum(
+                "bkgt,btkd->bkgd", p_buf, vbuf_l
+            )
+            out = out.reshape(B, c.heads * c.head_dim)
+            x = x + out @ lp["wo"]
+            h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+            x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+            return x, (kbuf_l, vbuf_l)
+
+        x, (kbuf, vbuf) = jax.lax.scan(
+            layer, x, (params["layers"], cache_k, cache_v, kbuf, vbuf)
+        )
+        x = _rms_norm(x, params["final_norm"], c.norm_eps)
+        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        nxt, lp = sample_fn(logits, sub)
+        nxt = jnp.where(active, nxt, tokens)
+        return (nxt, kbuf, vbuf, key), (nxt, lp)
+
+    (final_tokens, kbuf, vbuf, _), (chunk_tokens, chunk_lps) = jax.lax.scan(
+        step, (tokens0, kbuf0, vbuf0, key), jnp.arange(num_steps)
+    )
+
+    # commit: one write of the chunk buffer into the cache per slot
+    def commit_lb(c_lb, buf_lb, start):  # (S,K,D), (num_steps,K,D)
+        return jax.lax.dynamic_update_slice(c_lb, buf_lb, (start, 0, 0))
+
+    commit = jax.vmap(  # over layers
+        jax.vmap(commit_lb, in_axes=(0, 0, 0)), in_axes=(0, 0, None)
+    )
+    cache_k = commit(cache_k, kbuf, base_lengths)
+    cache_v = commit(cache_v, vbuf, base_lengths)
+    final_lengths = base_lengths + num_steps * adv
+    return chunk_tokens, chunk_lps, final_tokens, final_lengths, cache_k, cache_v
+
+
+def llama_forward(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,  # (B, S) int32
+) -> jax.Array:
+    """All-position logits (B, S, V), no KV cache — the training-side
+    forward (next-token loss) and the long-context prefill building block."""
+    c = config
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.arange(S)[None, :].repeat(B, axis=0)
+    cos, sin = _rope(positions, c.head_dim, c.rope_theta)
+    causal = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+    neg = jnp.finfo(jnp.float32).min
+    G = c.heads // c.kv_heads
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
+        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
+        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        qg = q.reshape(B, S, c.kv_heads, G, c.head_dim)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
+        scores = scores / math.sqrt(c.head_dim)
+        scores = jnp.where(causal[None, None, None, :, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v).reshape(B, S, c.heads * c.head_dim)
+        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+        h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+        x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    return jnp.einsum("bsh,hv->bsv", x, params["lm_head"]).astype(jnp.float32)
+
+
+def param_count(config: LlamaConfig) -> int:
+    c = config
+    per_layer = (
+        c.hidden * c.heads * c.head_dim
+        + 2 * c.hidden * c.kv_heads * c.head_dim
+        + c.heads * c.head_dim * c.hidden
+        + 3 * c.hidden * c.intermediate
+        + 2 * c.hidden
+    )
+    return c.layers * per_layer + 2 * c.vocab_size * c.hidden + c.hidden

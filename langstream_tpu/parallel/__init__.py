@@ -1,0 +1,19 @@
+"""Parallelism: meshes, sharding rules, sequence parallelism.
+
+The reference's only parallelism is pod replication + broker partitions
+(SURVEY.md §2.2); device-level parallelism is the capability gap this package
+fills. Axes:
+
+- ``dp`` — data parallel: request/batch fan-out (the device-level analogue of
+  the reference's partition fan-out).
+- ``tp`` — tensor parallel: Megatron-style sharded matmuls over ICI.
+- ``sp`` — sequence parallel: ring attention for long contexts.
+
+Everything is expressed as ``jax.sharding.NamedSharding`` over a ``Mesh`` —
+XLA inserts the collectives (psum/all-gather/reduce-scatter) and schedules
+them on ICI.
+"""
+
+from langstream_tpu.parallel.mesh import make_mesh, mesh_axes, local_mesh
+
+__all__ = ["make_mesh", "mesh_axes", "local_mesh"]

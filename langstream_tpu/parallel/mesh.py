@@ -1,0 +1,50 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_axes(mesh: Mesh | None) -> tuple[str, ...]:
+    return tuple(mesh.axis_names) if mesh is not None else ()
+
+
+def make_mesh(
+    axis_sizes: dict[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh from axis sizes, e.g. ``{"dp": 2, "tp": 4}``.
+
+    A size of ``-1`` on exactly one axis means "all remaining devices".
+    Axis order follows dict order; put the fastest-communicating axis last
+    (``tp`` innermost) so tensor-parallel collectives ride neighbouring ICI
+    links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axis_sizes = dict(axis_sizes or {"tp": len(devices)})
+    wildcard = [k for k, v in axis_sizes.items() if v == -1]
+    known = math.prod(v for v in axis_sizes.values() if v != -1)
+    if wildcard:
+        if len(wildcard) > 1:
+            raise ValueError("only one axis may be -1")
+        axis_sizes[wildcard[0]] = len(devices) // known
+    total = math.prod(axis_sizes.values())
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {axis_sizes} needs {total} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:total]).reshape(tuple(axis_sizes.values()))
+    return Mesh(grid, tuple(axis_sizes))
+
+
+def local_mesh(tp: int | None = None, dp: int = 1, sp: int = 1) -> Mesh:
+    """Convenience mesh over the local devices: ``(dp, sp, tp)``."""
+    n = len(jax.devices())
+    if tp is None:
+        tp = n // (dp * sp)
+    return make_mesh({"dp": dp, "sp": sp, "tp": tp})

@@ -1,0 +1,19 @@
+"""The TPU serving engine: continuous batching over a slot-based KV cache.
+
+This is the genuinely new core relative to the reference (SURVEY.md §7 stage
+6): where the reference's ``ai-*`` agents call SaaS HTTP APIs, this engine
+serves Llama-family decoders and MiniLM-class encoders **in-process on the
+pod's chips**: prefill/decode split, slot-based continuous batching (a
+request joins the running decode batch as soon as a slot frees), in-jit
+sampling (only the sampled token ids cross the host boundary), streaming
+detokenisation, and ``NamedSharding`` tensor/data parallelism over ICI
+meshes.
+"""
+
+from langstream_tpu.serving.engine import (
+    ServingConfig,
+    TpuServingEngine,
+    EmbeddingEngine,
+)
+
+__all__ = ["ServingConfig", "TpuServingEngine", "EmbeddingEngine"]

@@ -1,0 +1,670 @@
+"""Continuous-batching serving engine.
+
+Execution model:
+
+- A fixed pool of ``slots`` (the decode batch dimension). Each active slot
+  owns a row of the KV cache ``(L, slots, S, K, D)``.
+- **Admission**: a queued request prefilles into a free slot (prompt padded
+  to a power-of-two bucket → few compiled shapes) and immediately joins the
+  decode batch. No stop-the-world: decode keeps a fixed batch shape, so a
+  new arrival never recompiles anything.
+- **Decode**: one jitted step advances *all* active slots one token;
+  sampling happens in-jit (see sampler.py), only (B,) token ids come back.
+- **At-least-once friendly**: generation is driven by the agent layer's
+  record loop; the engine itself is agnostic to commits.
+- **Sharding**: with a mesh, params are TP-sharded (Megatron), cache shards
+  KV heads on ``tp`` and slots on ``dp``; XLA places the collectives on ICI.
+
+JAX calls are dispatched through a single-thread executor so the asyncio
+event loop (broker I/O, gateways) never blocks on device execution —
+compute/IO overlap comes free.
+
+Parity anchor: replaces the external-HTTP ``CompletionsService`` /
+``EmbeddingsService`` providers (``OpenAIServiceProvider.java:26`` etc.) with
+an in-tree engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from functools import partial
+from typing import Any, Awaitable, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.llama import (
+    LlamaConfig,
+    init_kv_cache,
+    init_llama_params,
+    llama_decode_step,
+    llama_param_specs,
+    llama_prefill,
+    kv_cache_spec,
+)
+from langstream_tpu.models.encoder import (
+    EncoderConfig,
+    encode,
+    encoder_param_specs,
+    init_encoder_params,
+)
+from langstream_tpu.models.tokenizer import Tokenizer, load_tokenizer
+from langstream_tpu.serving.sampler import sample_tokens
+
+log = logging.getLogger(__name__)
+
+_MODEL_CONFIGS = {
+    "tiny": LlamaConfig.tiny,
+    "llama-1b": LlamaConfig.llama_1b,
+    "llama3-8b": LlamaConfig.llama3_8b,
+    "llama-3-8b": LlamaConfig.llama3_8b,
+    "llama3-70b": LlamaConfig.llama3_70b,
+    "llama-3-70b": LlamaConfig.llama3_70b,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    model: str = "tiny"
+    slots: int = 8
+    max_seq_len: int = 512
+    tokenizer: str | None = None       # None/"byte" or local HF path
+    checkpoint: str | None = None      # local weights dir (gated; random init otherwise)
+    mesh: tuple[tuple[str, int], ...] = ()  # e.g. (("dp",1),("tp",8)); () = single device
+    default_max_tokens: int = 128
+    seed: int = 0
+    # decode steps fused into one jitted lax.scan per host round-trip —
+    # the host sync (not device compute) dominates per-step cost, so K
+    # steps per sync multiplies throughput by ~K at a K-token batching
+    # cost in streaming latency
+    decode_chunk: int = 16
+    # max requests prefilled in one batched call
+    prefill_batch: int = 8
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ServingConfig":
+        mesh = tuple((k, int(v)) for k, v in (d.get("mesh") or {}).items())
+        return cls(
+            model=d.get("model", "tiny"),
+            slots=int(d.get("slots", 8)),
+            max_seq_len=int(d.get("max-seq-len", d.get("max_seq_len", 512))),
+            tokenizer=d.get("tokenizer"),
+            checkpoint=d.get("checkpoint"),
+            mesh=mesh,
+            default_max_tokens=int(d.get("max-tokens", 128)),
+            seed=int(d.get("seed", 0)),
+            decode_chunk=int(d.get("decode-chunk", 16)),
+            prefill_batch=int(d.get("prefill-batch", 8)),
+        )
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: "_Request | None" = None
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt_tokens: list[int]
+    max_tokens: int
+    temperature: float
+    top_k: int
+    top_p: float
+    on_token: Callable[[int, float, bool], Awaitable[None] | None] | None
+    future: asyncio.Future
+    generated: list[int] = dataclasses.field(default_factory=list)
+    logprobs: list[float] = dataclasses.field(default_factory=list)
+    loop: asyncio.AbstractEventLoop | None = None
+    enqueue_time: float = 0.0
+    first_token_time: float | None = None
+
+
+def _bucket(n: int, lo: int = 32, hi: int = 32768) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return min(b, hi)  # hi may not be a power of two (user max_seq_len)
+
+
+class TpuServingEngine:
+    """One engine per (model, mesh) — shared across agents in the process.
+
+    Public API:
+      await engine.generate(prompt, options, on_token=...) -> GenerationResult
+    """
+
+    _instances: dict[Any, "TpuServingEngine"] = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def get_or_create(cls, config: ServingConfig) -> "TpuServingEngine":
+        with cls._instances_lock:
+            if config not in cls._instances:
+                cls._instances[config] = cls(config)
+            return cls._instances[config]
+
+    @classmethod
+    def reset_instances(cls) -> None:
+        with cls._instances_lock:
+            cls._instances.clear()
+
+    def __init__(self, config: ServingConfig):
+        self.config = config
+        if config.model not in _MODEL_CONFIGS:
+            raise ValueError(
+                f"unknown model {config.model!r}; known: {sorted(_MODEL_CONFIGS)}"
+            )
+        self.model_config: LlamaConfig = _MODEL_CONFIGS[config.model](
+            max_seq_len=config.max_seq_len
+        )
+        self.tokenizer: Tokenizer = load_tokenizer(config.tokenizer)
+        if self.tokenizer.vocab_size > self.model_config.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {self.tokenizer.vocab_size} exceeds model "
+                f"vocab {self.model_config.vocab_size}"
+            )
+
+        self.mesh = None
+        if config.mesh:
+            from langstream_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(dict(config.mesh))
+
+        self._init_model()
+
+        self.slots = [_Slot() for _ in range(config.slots)]
+        self._queue: asyncio.Queue[_Request] = asyncio.Queue()
+        self._wake = asyncio.Event()
+        self._stop = False
+        self._loop_task: asyncio.Task | None = None
+        # one dedicated thread: JAX dispatch is serialised, asyncio stays live
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-engine")
+        self._key = jax.random.PRNGKey(config.seed)
+        # decode-side state mirrors (host copies, device arrays built per step)
+        self._lengths = np.zeros(config.slots, dtype=np.int32)
+        self._current = np.zeros(config.slots, dtype=np.int32)
+        self._temps = np.zeros(config.slots, dtype=np.float32)
+        self._topks = np.zeros(config.slots, dtype=np.int32)
+        self._topps = np.ones(config.slots, dtype=np.float32)
+        self._pending_emits: list = []
+        self._finished_requests: list = []
+        self.total_generated = 0
+
+    # ------------------------------------------------------------------
+    # model + jit setup
+    # ------------------------------------------------------------------
+
+    def _init_model(self) -> None:
+        mc = self.model_config
+        if self.config.checkpoint:
+            from langstream_tpu.models.checkpoints import load_llama_checkpoint
+
+            self.params = load_llama_checkpoint(self.config.checkpoint, mc)
+        else:
+            log.warning(
+                "no checkpoint configured for model %r: using random-init "
+                "weights (offline/dev mode)", self.config.model,
+            )
+            self.params = init_llama_params(mc)
+        cache_k, cache_v = init_kv_cache(mc, self.config.slots)
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            specs = llama_param_specs(mc)
+            self.params = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+                self.params,
+                specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            cspec = NamedSharding(self.mesh, kv_cache_spec(self.mesh.axis_names))
+            cache_k = jax.device_put(cache_k, cspec)
+            cache_v = jax.device_put(cache_v, cspec)
+        self.cache_k, self.cache_v = cache_k, cache_v
+
+        mc_static = mc
+        K = self.config.decode_chunk
+
+        def _make_decode(use_top_p: bool):
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def _decode_chunk(params, cache_k, cache_v, tokens, lengths, active,
+                              key, temps, topks, topps):
+                """K fused decode steps; one host round-trip per chunk. The
+                big cache is read-only inside the chunk (llama_decode_chunk)
+                — per-step HBM traffic is params+cache *read* only."""
+                from langstream_tpu.models.llama import llama_decode_chunk
+
+                def sample_fn(logits, sub):
+                    return sample_tokens(
+                        logits, sub, temps, topks,
+                        use_top_p=use_top_p, top_ps=topps,
+                    )
+
+                return llama_decode_chunk(
+                    mc_static, params, tokens, lengths, active,
+                    cache_k, cache_v, sample_fn, key, K,
+                )
+
+            return _decode_chunk
+
+        def _make_prefill(use_top_p: bool):
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def _prefill(params, cache_k, cache_v, tokens, lengths, slot_ids,
+                         key, temps, topks, topps):
+                logits, ck, cv = llama_prefill(
+                    mc_static, params, tokens, lengths, cache_k, cache_v, slot_ids
+                )
+                next_tokens, logprobs = sample_tokens(
+                    logits, key, temps, topks, use_top_p=use_top_p, top_ps=topps
+                )
+                return next_tokens, logprobs, ck, cv
+
+            return _prefill
+
+        # top-p costs a vocab sort per step, so it's a separate compiled
+        # variant selected only when an active request asks for it
+        self._decode_chunk_fns = {p: _make_decode(p) for p in (False, True)}
+        self._prefill_fns = {p: _make_prefill(p) for p in (False, True)}
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    async def generate(
+        self,
+        prompt: str | list[int],
+        options: dict[str, Any] | None = None,
+        on_token: Callable[[int, float, bool], Any] | None = None,
+    ) -> dict[str, Any]:
+        """Generate a completion. ``on_token(token_id, logprob, last)`` fires
+        per token (sync or async). Returns
+        ``{"tokens", "text", "logprobs", "num_prompt_tokens", "ttft"}``."""
+        options = options or {}
+        tokens = (
+            self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        )
+        max_prompt = self.model_config.max_seq_len - 2
+        if len(tokens) > max_prompt:
+            tokens = tokens[-max_prompt:]
+        top_k = int(options.get("top-k", 0))
+        if top_k > 64:
+            log.warning("top-k %d exceeds the compiled window of 64; clamping", top_k)
+            top_k = 64
+        request = _Request(
+            prompt_tokens=tokens,
+            max_tokens=min(
+                int(options.get("max-tokens", self.config.default_max_tokens)),
+                self.model_config.max_seq_len - len(tokens) - 1,
+            ),
+            temperature=float(options.get("temperature", 0.0)),
+            top_k=top_k,
+            top_p=float(options.get("top-p", 1.0)),
+            on_token=on_token,
+            future=asyncio.get_running_loop().create_future(),
+            loop=asyncio.get_running_loop(),
+            enqueue_time=time.monotonic(),
+        )
+        await self._queue.put(request)
+        self._ensure_loop()
+        self._wake.set()
+        return await request.future
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "model": self.config.model,
+            "slots": self.config.slots,
+            "active": sum(1 for s in self.slots if not s.free),
+            "queued": self._queue.qsize(),
+            "total-generated": self.total_generated,
+        }
+
+    async def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._loop_task is not None:
+            await self._loop_task
+        self._executor.shutdown(wait=False)
+        # evict from the singleton cache: a closed engine must not be handed
+        # out again (its loop would exit immediately, stranding requests)
+        with self._instances_lock:
+            for key, inst in list(self._instances.items()):
+                if inst is self:
+                    del self._instances[key]
+
+    # ------------------------------------------------------------------
+    # engine loop
+    # ------------------------------------------------------------------
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._run_loop())
+
+    def _split_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    async def _run_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stop:
+            try:
+                if not self._queue.empty():
+                    await self._admit(loop)
+                active = [i for i, s in enumerate(self.slots) if not s.free]
+                if not active:
+                    if self._queue.empty():
+                        self._wake.clear()
+                        try:
+                            await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                        except asyncio.TimeoutError:
+                            pass
+                    continue
+                await self._decode_burst(loop, active)
+            except Exception as e:  # device/runtime error: fail in-flight work,
+                # free the slots, keep serving (callers see the exception)
+                log.exception("serving engine step failed")
+                self._fail_inflight(e)
+
+    def _fail_inflight(self, error: Exception) -> None:
+        for slot in self.slots:
+            request = slot.request
+            if request is not None and not request.future.done():
+                request.future.set_exception(error)
+            slot.request = None
+        self._lengths[:] = 0
+        while not self._queue.empty():
+            request = self._queue.get_nowait()
+            if not request.future.done():
+                request.future.set_exception(error)
+        self._pending_emits.clear()
+        self._finished_requests.clear()
+
+    async def _decode_burst(self, loop, active: list[int]) -> None:
+        """Pipelined chunk decoding: chunk k+1 is dispatched from chunk k's
+        *device-resident* outputs before k's tokens reach the host, so the
+        host round-trip (the dominant per-chunk cost on tunneled chips, and
+        a real cost on local ones) overlaps device compute. Slots that
+        finish inside a speculative chunk burn a few wasted steps; the host
+        discards their tail. The burst ends when admission work appears."""
+        key1 = self._split_key()
+        active_mask = np.zeros(self.config.slots, dtype=bool)
+        active_mask[active] = True
+        amask = jnp.asarray(active_mask)
+        temps = jnp.asarray(self._temps)
+        topks = jnp.asarray(self._topks)
+        topps = jnp.asarray(self._topps)
+        decode_fn = self._decode_chunk_fns[
+            bool((self._topps[active_mask] < 1.0).any())
+        ]
+
+        def _dispatch(tokens, lengths, key):
+            # async JAX dispatch: returns device arrays without blocking
+            chunk_t, chunk_lp, t, l, ck, cv = decode_fn(
+                self.params, self.cache_k, self.cache_v,
+                tokens, lengths, amask, key, temps, topks, topps,
+            )
+            self.cache_k, self.cache_v = ck, cv
+            return chunk_t, chunk_lp, t, l
+
+        out = await loop.run_in_executor(
+            self._executor,
+            partial(
+                _dispatch, jnp.asarray(self._current), jnp.asarray(self._lengths), key1
+            ),
+        )
+        while True:
+            # speculate the next chunk from device state
+            key_next = self._split_key()
+            next_out_task = loop.run_in_executor(
+                self._executor, partial(_dispatch, out[2], out[3], key_next)
+            )
+            chunk_t, chunk_lp = await loop.run_in_executor(
+                self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))
+            )
+            finished = self._process_chunk(chunk_t, chunk_lp, active)
+            await self._flush_emits(active)
+            out = await next_out_task
+            if finished or not self._queue.empty() or self._stop:
+                # drain the speculative chunk, then hand back to the loop
+                chunk_t, chunk_lp = await loop.run_in_executor(
+                    self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))
+                )
+                self._process_chunk(chunk_t, chunk_lp, active)
+                await self._flush_emits(active)
+                return
+
+    async def _admit(self, loop) -> None:
+        """Admit queued requests in batched prefill calls (grouped by
+        prompt-length bucket, count padded to a power of two by repeating
+        the last row — a duplicate write of identical K/V is a no-op)."""
+        while not self._queue.empty():
+            free = [i for i, s in enumerate(self.slots) if s.free]
+            if not free:
+                return
+            batch: list[tuple[int, _Request]] = []
+            bucket = None
+            while (
+                not self._queue.empty()
+                and len(batch) < min(len(free), self.config.prefill_batch)
+            ):
+                request = self._queue._queue[0]  # peek
+                b = _bucket(len(request.prompt_tokens), hi=self.model_config.max_seq_len)
+                if bucket is None:
+                    bucket = b
+                elif b != bucket:
+                    break
+                self._queue.get_nowait()
+                batch.append((free[len(batch)], request))
+            if not batch:
+                return
+            for slot_id, request in batch:
+                self.slots[slot_id].request = request
+            Bp = 1
+            while Bp < len(batch):
+                Bp *= 2
+            padded = np.zeros((Bp, bucket), dtype=np.int32)
+            lengths = np.zeros(Bp, dtype=np.int32)
+            slot_ids = np.zeros(Bp, dtype=np.int32)
+            temps = np.zeros(Bp, dtype=np.float32)
+            topks = np.zeros(Bp, dtype=np.int32)
+            topps = np.ones(Bp, dtype=np.float32)
+            for i in range(Bp):
+                slot_id, request = batch[min(i, len(batch) - 1)]
+                padded[i, : len(request.prompt_tokens)] = request.prompt_tokens
+                lengths[i] = len(request.prompt_tokens)
+                slot_ids[i] = slot_id
+                temps[i] = request.temperature
+                topks[i] = request.top_k
+                topps[i] = request.top_p
+            key = self._split_key()
+            prefill_fn = self._prefill_fns[bool((topps < 1.0).any())]
+
+            def _run():
+                return prefill_fn(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(padded), jnp.asarray(lengths),
+                    jnp.asarray(slot_ids), key,
+                    jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                )
+
+            next_tokens, logprobs, self.cache_k, self.cache_v = (
+                await loop.run_in_executor(self._executor, _run)
+            )
+            next_np = np.asarray(next_tokens)
+            logprob_np = np.asarray(logprobs)
+            now = time.monotonic()
+            admitted_slots = []
+            for i, (slot_id, request) in enumerate(batch):
+                self._lengths[slot_id] = len(request.prompt_tokens)
+                self._current[slot_id] = int(next_np[i])
+                self._temps[slot_id] = request.temperature
+                self._topks[slot_id] = request.top_k
+                self._topps[slot_id] = request.top_p
+                request.first_token_time = now
+                self._emit_token(slot_id, int(next_np[i]), float(logprob_np[i]))
+                admitted_slots.append(slot_id)
+            await self._flush_emits(admitted_slots)
+
+    def _process_chunk(
+        self, chunk_tokens: np.ndarray, chunk_lps: np.ndarray, active: list[int]
+    ) -> bool:
+        """Apply a chunk's tokens to host state; queue emissions. Returns
+        True if any slot finished (→ admission opportunity)."""
+        K = chunk_tokens.shape[0]
+        finished_any = False
+        for slot_id in active:
+            for k in range(K):
+                slot = self.slots[slot_id]
+                if slot.request is None:
+                    break  # finished mid-chunk; discard the tail
+                self._lengths[slot_id] += 1
+                token = int(chunk_tokens[k, slot_id])
+                self._current[slot_id] = token
+                if self._emit_token(slot_id, token, float(chunk_lps[k, slot_id])):
+                    finished_any = True
+        return finished_any
+
+    def _emit_token(self, slot_id: int, token: int, logprob: float) -> bool:
+        """Synchronous part of emission; async callbacks are deferred to
+        :meth:`_flush_emits`. Returns True when the slot finished."""
+        slot = self.slots[slot_id]
+        request = slot.request
+        if request is None:
+            return False
+        is_eos = token == self.tokenizer.eos_id
+        if not is_eos:
+            request.generated.append(token)
+            request.logprobs.append(logprob)
+        self.total_generated += 1
+        done = bool(
+            is_eos
+            or len(request.generated) >= request.max_tokens
+            or self._lengths[slot_id] + 1 >= self.model_config.max_seq_len
+        )
+        # streaming consumers always get a final last=True emission (the
+        # tokenizer hides the EOS id itself), so chunk streams terminate
+        if request.on_token is not None:
+            self._pending_emits.append((request, token, logprob, done))
+        if done:
+            slot.request = None
+            self._lengths[slot_id] = 0
+            self._finished_requests.append((request, is_eos))
+        return done
+
+    async def _flush_emits(self, active: list[int]) -> None:
+        emits, self._pending_emits = self._pending_emits, []
+        for request, token, logprob, done in emits:
+            result = request.on_token(token, logprob, done)
+            if asyncio.iscoroutine(result):
+                await result
+        finished, self._finished_requests = self._finished_requests, []
+        for request, is_eos in finished:
+            text = self.tokenizer.decode(request.generated)
+            if not request.future.done():
+                request.future.set_result(
+                    {
+                        "tokens": request.generated,
+                        "text": text,
+                        "logprobs": request.logprobs,
+                        "num_prompt_tokens": len(request.prompt_tokens),
+                        "num_completion_tokens": len(request.generated),
+                        "ttft": (request.first_token_time or time.monotonic())
+                        - request.enqueue_time,
+                        "finish_reason": "stop" if is_eos else "length",
+                    }
+                )
+
+
+# ---------------------------------------------------------------------------
+# embeddings
+# ---------------------------------------------------------------------------
+
+
+class EmbeddingEngine:
+    """Batched encoder serving (drives ``compute-ai-embeddings``)."""
+
+    _instances: dict[Any, "EmbeddingEngine"] = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def get_or_create(cls, model: str = "minilm-l6", tokenizer: str | None = None,
+                      checkpoint: str | None = None, mesh: dict | None = None) -> "EmbeddingEngine":
+        key = (model, tokenizer, checkpoint, tuple((mesh or {}).items()))
+        with cls._instances_lock:
+            if key not in cls._instances:
+                cls._instances[key] = cls(model, tokenizer, checkpoint, mesh)
+            return cls._instances[key]
+
+    @classmethod
+    def reset_instances(cls) -> None:
+        with cls._instances_lock:
+            cls._instances.clear()
+
+    def __init__(self, model: str, tokenizer: str | None, checkpoint: str | None,
+                 mesh: dict | None):
+        if model in ("tiny", "tiny-encoder"):
+            self.config = EncoderConfig.tiny()
+        else:
+            self.config = EncoderConfig.minilm_l6()
+        self.tokenizer = load_tokenizer(tokenizer)
+        if checkpoint:
+            from langstream_tpu.models.encoder import load_from_sentence_transformers
+
+            self.config, self.params = load_from_sentence_transformers(checkpoint)
+        else:
+            self.params = init_encoder_params(self.config)
+        self.mesh = None
+        if mesh:
+            from langstream_tpu.parallel.mesh import make_mesh
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.mesh = make_mesh(dict(mesh))
+            specs = encoder_param_specs(self.config)
+            self.params = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+                self.params,
+                specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-embed")
+        cfg = self.config
+
+        @jax.jit
+        def _encode(params, tokens, mask):
+            return encode(cfg, params, tokens, mask)
+
+        self._encode_fn = _encode
+
+    async def embed(self, texts: list[str]) -> list[list[float]]:
+        if not texts:
+            return []
+        max_pos = self.config.max_position
+        ids = [self.tokenizer.encode(t)[: max_pos] for t in texts]
+        # clip ids into the encoder vocab (byte fallback on a tiny vocab)
+        V = self.config.vocab_size
+        ids = [[t % V for t in row] for row in ids]
+        bucket = _bucket(max(len(r) for r in ids), lo=16, hi=max_pos)
+        B = len(ids)
+        tokens = np.zeros((B, bucket), dtype=np.int32)
+        mask = np.zeros((B, bucket), dtype=np.int32)
+        for i, row in enumerate(ids):
+            tokens[i, : len(row)] = row
+            mask[i, : len(row)] = 1
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            self._executor,
+            lambda: np.asarray(
+                self._encode_fn(self.params, jnp.asarray(tokens), jnp.asarray(mask))
+            ),
+        )
+        return out.tolist()

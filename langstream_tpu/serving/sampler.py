@@ -1,0 +1,62 @@
+"""In-jit token sampling.
+
+Sampling runs inside the same jit as the decode step so only the sampled
+token ids (B int32) and their logprobs cross the host boundary per step —
+never the (B, vocab) logits (HBM→host bandwidth is the TTFT killer at high
+slot counts).
+
+Supports greedy (temperature 0), temperature, and top-k. Top-p is
+implemented via sorted cumulative mass; it costs a vocab sort per step, so
+it's compiled in only when a request asks for it (static flag).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jax.Array,        # (B, V) float32
+    key: jax.Array,
+    temperatures: jax.Array,  # (B,) 0 = greedy
+    top_ks: jax.Array,        # (B,) 0 = off
+    use_top_p: bool = False,
+    top_ps: jax.Array | None = None,  # (B,) 1.0 = off
+) -> tuple[jax.Array, jax.Array]:
+    """→ (tokens (B,) int32, logprobs (B,) float32 of the sampled token)."""
+    B, V = logits.shape
+    greedy_tokens = jnp.argmax(logits, axis=-1)
+
+    temps = jnp.maximum(temperatures, 1e-6)[:, None]
+    scaled = logits / temps
+
+    # top-k: mask everything below the k-th largest (k dynamic per row via
+    # a fixed K_MAX window — vocab-sized sort avoided)
+    K_MAX = 64
+    top_vals, _ = jax.lax.top_k(scaled, K_MAX)  # (B, K_MAX) descending
+    k_idx = jnp.clip(top_ks - 1, 0, K_MAX - 1)
+    kth_val = jnp.take_along_axis(top_vals, k_idx[:, None], axis=1)
+    apply_topk = (top_ks > 0)[:, None]
+    neg = jnp.finfo(scaled.dtype).min
+    scaled = jnp.where(apply_topk & (scaled < kth_val), neg, scaled)
+
+    if use_top_p:
+        assert top_ps is not None
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_ps[:, None]  # always keep the top one
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(B)[:, None], sort_idx
+        ].set(keep_sorted)
+        scaled = jnp.where(keep, scaled, neg)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    tokens = jnp.where(temperatures <= 0, greedy_tokens, sampled)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    token_logprobs = jnp.take_along_axis(
+        logprobs, tokens[:, None], axis=1
+    ).squeeze(1)
+    return tokens.astype(jnp.int32), token_logprobs

@@ -8,13 +8,19 @@ collectives are exercised for real, just on host devices.
 
 import os
 
-# Must be set before jax is imported by any test module.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initialises a backend. The environment's TPU plugin
+# prepends its own platform to JAX_PLATFORMS at interpreter start, so the
+# config override below (not just the env var) is what actually forces CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

@@ -1,0 +1,423 @@
+"""Serving engine + model tests on the 8-virtual-device CPU mesh."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engines():
+    from langstream_tpu.serving.engine import EmbeddingEngine, TpuServingEngine
+
+    TpuServingEngine.reset_instances()
+    EmbeddingEngine.reset_instances()
+    yield
+    TpuServingEngine.reset_instances()
+    EmbeddingEngine.reset_instances()
+
+
+# ---------------------------------------------------------------------------
+# model-level invariants
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_decode_equivalence():
+    """Decoding token-by-token must match a fresh prefill over the same
+    prefix (KV cache correctness)."""
+    from langstream_tpu.models.llama import (
+        LlamaConfig,
+        init_kv_cache,
+        init_llama_params,
+        llama_decode_step,
+        llama_prefill,
+    )
+
+    c = LlamaConfig.tiny(max_seq_len=32)
+    params = init_llama_params(c, jax.random.PRNGKey(1))
+    tokens = jnp.array([[5, 9, 17, 3, 11, 2, 7, 1]], dtype=jnp.int32)
+    n = tokens.shape[1]
+
+    # full prefill over n tokens
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=32)
+    logits_full, _, _ = llama_prefill(
+        c, params, tokens, jnp.array([n]), ck, cv, jnp.array([0])
+    )
+
+    # prefill over n-1 then decode the last token
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=32)
+    _, ck, cv = llama_prefill(
+        c, params, tokens[:, : n - 1], jnp.array([n - 1]), ck, cv, jnp.array([0])
+    )
+    logits_step, _, _ = llama_decode_step(
+        c, params, tokens[:, n - 1], jnp.array([n - 1]), ck, cv
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_full), np.asarray(logits_step), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_prefill_padding_invariance():
+    """Padding a prompt to a larger bucket must not change its logits."""
+    from langstream_tpu.models.llama import (
+        LlamaConfig,
+        init_kv_cache,
+        init_llama_params,
+        llama_prefill,
+    )
+
+    c = LlamaConfig.tiny(max_seq_len=64)
+    params = init_llama_params(c, jax.random.PRNGKey(2))
+    prompt = [5, 9, 17, 3]
+
+    def run(pad_to):
+        t = np.zeros((1, pad_to), dtype=np.int32)
+        t[0, : len(prompt)] = prompt
+        ck, cv = init_kv_cache(c, slots=1, max_seq_len=64)
+        logits, _, _ = llama_prefill(
+            c, params, jnp.asarray(t), jnp.array([len(prompt)]), ck, cv, jnp.array([0])
+        )
+        return np.asarray(logits)
+
+    np.testing.assert_allclose(run(8), run(32), rtol=2e-2, atol=2e-2)
+
+
+def test_tp_sharded_decode_matches_single_device():
+    """The TP-sharded model must produce the same logits as unsharded."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from langstream_tpu.models.llama import (
+        LlamaConfig,
+        init_kv_cache,
+        init_llama_params,
+        llama_decode_step,
+        llama_param_specs,
+        kv_cache_spec,
+        llama_prefill,
+    )
+    from langstream_tpu.parallel.mesh import make_mesh
+
+    c = LlamaConfig.tiny(max_seq_len=32)
+    params = init_llama_params(c, jax.random.PRNGKey(3))
+    tokens = jnp.array([[5, 9, 17, 3]], dtype=jnp.int32)
+
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=32)
+    ref_logits, ck1, cv1 = llama_prefill(
+        c, params, tokens, jnp.array([4]), ck, cv, jnp.array([0])
+    )
+
+    mesh = make_mesh({"dp": 1, "tp": 2})
+    specs = llama_param_specs(c)
+    sharded = jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P),
+    )
+    cspec = NamedSharding(mesh, kv_cache_spec(mesh.axis_names))
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=32)
+    ck, cv = jax.device_put(ck, cspec), jax.device_put(cv, cspec)
+    tp_logits, ck2, cv2 = llama_prefill(
+        c, sharded, tokens, jnp.array([4]), ck, cv, jnp.array([0])
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(tp_logits), rtol=2e-2, atol=2e-2
+    )
+
+    # one decode step too
+    ref_d, _, _ = llama_decode_step(
+        c, params, jnp.array([7]), jnp.array([4]), ck1, cv1
+    )
+    tp_d, _, _ = llama_decode_step(
+        c, sharded, jnp.array([7]), jnp.array([4]), ck2, cv2
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref_d), np.asarray(tp_d), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_chunked_decode_matches_stepwise():
+    """The fused K-step chunk (two-segment KV) must reproduce greedy
+    step-by-step decoding exactly."""
+    import jax
+
+    from langstream_tpu.models.llama import (
+        LlamaConfig,
+        init_kv_cache,
+        init_llama_params,
+        llama_decode_chunk,
+        llama_decode_step,
+        llama_prefill,
+    )
+
+    c = LlamaConfig.tiny(max_seq_len=64)
+    params = init_llama_params(c, jax.random.PRNGKey(7))
+    prompt = jnp.array([[5, 9, 17, 3]], dtype=jnp.int32)
+
+    def greedy_sample(logits, key):
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return t, jnp.zeros_like(t, dtype=jnp.float32)
+
+    # stepwise reference
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=64)
+    logits, ck, cv = llama_prefill(
+        c, params, prompt, jnp.array([4]), ck, cv, jnp.array([0])
+    )
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    ref = [int(tok[0])]
+    lengths = jnp.array([4])
+    for _ in range(6):
+        logits, ck, cv = llama_decode_step(c, params, tok, lengths, ck, cv)
+        lengths = lengths + 1
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ref.append(int(tok[0]))
+
+    # chunked
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=64)
+    logits, ck, cv = llama_prefill(
+        c, params, prompt, jnp.array([4]), ck, cv, jnp.array([0])
+    )
+    tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    chunk_t, _, ftok, flen, ck, cv = llama_decode_chunk(
+        c, params, tok0, jnp.array([4]), jnp.array([True]),
+        ck, cv, greedy_sample, jax.random.PRNGKey(0), 3,
+    )
+    got = [int(tok0[0])] + [int(x) for x in np.asarray(chunk_t)[:, 0]]
+    # continue with a second chunk from committed state
+    chunk_t2, _, _, _, ck, cv = llama_decode_chunk(
+        c, params, ftok, flen, jnp.array([True]),
+        ck, cv, greedy_sample, jax.random.PRNGKey(0), 3,
+    )
+    got += [int(x) for x in np.asarray(chunk_t2)[:, 0]]
+    assert got == ref
+
+
+def test_encoder_embeddings_normalised_and_padding_invariant():
+    from langstream_tpu.models.encoder import (
+        EncoderConfig,
+        encode,
+        init_encoder_params,
+    )
+
+    c = EncoderConfig.tiny()
+    params = init_encoder_params(c, jax.random.PRNGKey(4))
+
+    def run(pad_to):
+        tokens = np.zeros((1, pad_to), dtype=np.int32)
+        tokens[0, :3] = [5, 9, 17]
+        mask = np.zeros((1, pad_to), dtype=np.int32)
+        mask[0, :3] = 1
+        return np.asarray(encode(c, params, jnp.asarray(tokens), jnp.asarray(mask)))
+
+    e8, e16 = run(8), run(16)
+    np.testing.assert_allclose(e8, e16, rtol=1e-4, atol=1e-5)
+    assert abs(float(np.linalg.norm(e8[0])) - 1.0) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# engine-level behavior
+# ---------------------------------------------------------------------------
+
+
+def _engine(slots=4, max_seq_len=64):
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    return TpuServingEngine.get_or_create(
+        ServingConfig(model="tiny", slots=slots, max_seq_len=max_seq_len)
+    )
+
+
+def test_engine_generates_and_streams(run_async):
+    async def main():
+        engine = _engine()
+        seen: list[int] = []
+
+        def on_token(token, logprob, last):
+            seen.append(token)
+
+        result = await engine.generate(
+            "hello", {"max-tokens": 8}, on_token=on_token
+        )
+        assert len(result["tokens"]) <= 8
+        assert result["tokens"] == seen[: len(result["tokens"])]
+        assert result["num_prompt_tokens"] == len("hello") + 1  # BOS
+        assert isinstance(result["text"], str)
+        assert result["ttft"] >= 0
+        await engine.close()
+
+    run_async(main())
+
+
+def test_engine_greedy_deterministic(run_async):
+    async def main():
+        engine = _engine()
+        r1 = await engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+        r2 = await engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+        assert r1["tokens"] == r2["tokens"]
+        await engine.close()
+
+    run_async(main())
+
+
+def test_engine_continuous_batching_concurrent(run_async):
+    """More requests than slots: all complete; greedy results match the
+    single-request baseline (slot interference would corrupt logits)."""
+
+    async def main():
+        engine = _engine(slots=2)
+        baseline = await engine.generate("abc", {"max-tokens": 5, "temperature": 0})
+        results = await asyncio.gather(
+            *(engine.generate("abc", {"max-tokens": 5, "temperature": 0})
+              for _ in range(5))
+        )
+        for r in results:
+            assert r["tokens"] == baseline["tokens"]
+        assert engine.stats()["active"] == 0
+        await engine.close()
+
+    run_async(main())
+
+
+def test_engine_respects_max_tokens_and_seq_len(run_async):
+    async def main():
+        engine = _engine(slots=2, max_seq_len=32)
+        r = await engine.generate("x" * 20, {"max-tokens": 100})
+        # prompt ~21 tokens, seq cap 32 → at most ~11 generated
+        assert len(r["tokens"]) <= 11
+        await engine.close()
+
+    run_async(main())
+
+
+def test_engine_top_p_and_stream_termination(run_async):
+    async def main():
+        engine = _engine()
+        events: list[tuple[int, bool]] = []
+
+        def on_token(token, logprob, last):
+            events.append((token, last))
+
+        r = await engine.generate(
+            "xyz", {"max-tokens": 5, "temperature": 0.9, "top-p": 0.8},
+            on_token=on_token,
+        )
+        assert len(r["tokens"]) <= 5
+        # the stream always terminates with a last=True emission
+        assert events[-1][1] is True
+        assert all(last is False for _, last in events[:-1])
+        await engine.close()
+
+    run_async(main())
+
+
+def test_closed_engine_not_reused(run_async):
+    async def main():
+        from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+        cfg = ServingConfig(model="tiny", slots=2, max_seq_len=64)
+        e1 = TpuServingEngine.get_or_create(cfg)
+        await e1.generate("a", {"max-tokens": 2})
+        await e1.close()
+        e2 = TpuServingEngine.get_or_create(cfg)
+        assert e2 is not e1
+        r = await e2.generate("a", {"max-tokens": 2})
+        assert len(r["tokens"]) <= 2
+        await e2.close()
+
+    run_async(main())
+
+
+def test_non_power_of_two_max_seq(run_async):
+    async def main():
+        from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(model="tiny", slots=2, max_seq_len=48)
+        )
+        r = await engine.generate("y" * 40, {"max-tokens": 4})
+        assert len(r["tokens"]) <= 7
+        await engine.close()
+
+    run_async(main())
+
+
+def test_embedding_engine(run_async):
+    async def main():
+        from langstream_tpu.serving.engine import EmbeddingEngine
+
+        engine = EmbeddingEngine.get_or_create(model="tiny")
+        vecs = await engine.embed(["hello world", "hello world", "different"])
+        assert len(vecs) == 3
+        assert vecs[0] == vecs[1]
+        assert vecs[0] != vecs[2]
+        norm = sum(v * v for v in vecs[0]) ** 0.5
+        assert abs(norm - 1.0) < 1e-3
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# tpu provider end-to-end through an application
+# ---------------------------------------------------------------------------
+
+TPU_APP = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+  - name: "stream-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "convert"
+    type: "document-to-json"
+    input: "input-topic"
+    configuration:
+      text-field: "question"
+  - name: "chat"
+    type: "ai-chat-completions"
+    output: "output-topic"
+    configuration:
+      completion-field: "value.answer"
+      stream-to-topic: "stream-topic"
+      stream-response-completion-field: "value"
+      min-chunks-per-message: 4
+      max-tokens: 6
+      messages:
+        - role: user
+          content: "{{ value.question }}"
+"""
+
+TPU_CONFIG = """
+configuration:
+  resources:
+    - type: "tpu-serving-configuration"
+      name: "tpu"
+      configuration:
+        model: "tiny"
+        slots: 2
+        max-seq-len: 64
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: "memory"
+"""
+
+
+def test_chat_agent_on_tpu_engine(tmp_path, run_async):
+    async def main():
+        from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+        (tmp_path / "pipeline.yaml").write_text(TPU_APP)
+        (tmp_path / "configuration.yaml").write_text(TPU_CONFIG)
+        runner = LocalApplicationRunner.from_directory(tmp_path, instance=INSTANCE)
+        async with runner:
+            await runner.produce("input-topic", "hi there")
+            msgs = await runner.wait_for_messages("output-topic", 1, timeout=30)
+            assert "answer" in msgs[0].value
+            assert isinstance(msgs[0].value["answer"], str)
+
+    run_async(main())
