@@ -1,0 +1,4 @@
+from langstream_tpu.cli.main import cli
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,449 @@
+"""The CLI.
+
+Parity: the reference's picocli CLI (``langstream-cli``): profiles,
+``tenants``, ``apps deploy/update/get/delete/list/logs``, ``gateway
+produce/consume/chat`` (WebSocket clients), and the single-process dev mode
+(``langstream docker run`` → here ``run``, no container needed — the broker,
+control plane, gateway, and TPU engine are all in-tree).
+
+Usage: ``python -m langstream_tpu.cli <command>``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+import click
+
+DEFAULT_API = "http://127.0.0.1:8090"
+DEFAULT_GATEWAY = "http://127.0.0.1:8091"
+PROFILE_PATH = Path.home() / ".langstream-tpu" / "config.json"
+
+
+def _profile() -> dict:
+    if PROFILE_PATH.exists():
+        return json.loads(PROFILE_PATH.read_text())
+    return {}
+
+
+def _api_url(ctx_value: str | None) -> str:
+    return ctx_value or _profile().get("api-url", DEFAULT_API)
+
+
+def _gateway_url(ctx_value: str | None) -> str:
+    return ctx_value or _profile().get("gateway-url", DEFAULT_GATEWAY)
+
+
+def _ws_connect(session, url: str):
+    """ws_connect wrapper that turns handshake failures into CLI errors."""
+    import aiohttp
+
+    class _Ctx:
+        def __init__(self):
+            self._inner = session.ws_connect(url)
+
+        async def __aenter__(self):
+            try:
+                return await self._inner.__aenter__()
+            except aiohttp.WSServerHandshakeError as e:
+                raise click.ClickException(
+                    f"gateway refused connection ({e.status}): {e.message} [{url}]"
+                )
+
+        async def __aexit__(self, *exc):
+            return await self._inner.__aexit__(*exc)
+
+    return _Ctx()
+
+
+async def _request(method: str, url: str, **kwargs):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.request(method, url, **kwargs) as resp:
+            text = await resp.text()
+            if resp.status >= 300:
+                raise click.ClickException(f"{resp.status}: {text}")
+            try:
+                return json.loads(text)
+            except json.JSONDecodeError:
+                return text
+
+
+@click.group()
+def cli() -> None:
+    """langstream-tpu: TPU-native event-driven LLM application platform."""
+
+
+@cli.command()
+@click.option("--api-url", default=None)
+@click.option("--gateway-url", default=None)
+@click.option("--tenant", default=None)
+def configure(api_url: str | None, gateway_url: str | None, tenant: str | None) -> None:
+    """Save connection profile to ~/.langstream-tpu/config.json."""
+    profile = _profile()
+    if api_url:
+        profile["api-url"] = api_url
+    if gateway_url:
+        profile["gateway-url"] = gateway_url
+    if tenant:
+        profile["tenant"] = tenant
+    PROFILE_PATH.parent.mkdir(parents=True, exist_ok=True)
+    PROFILE_PATH.write_text(json.dumps(profile, indent=2))
+    click.echo(f"profile saved: {PROFILE_PATH}")
+
+
+# ---------------------------------------------------------------------------
+# tenants
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def tenants() -> None:
+    """Manage tenants."""
+
+
+@tenants.command("put")
+@click.argument("name")
+@click.option("--api-url", default=None)
+def tenants_put(name: str, api_url: str | None) -> None:
+    out = asyncio.run(_request("PUT", f"{_api_url(api_url)}/api/tenants/{name}"))
+    click.echo(json.dumps(out))
+
+
+@tenants.command("list")
+@click.option("--api-url", default=None)
+def tenants_list(api_url: str | None) -> None:
+    out = asyncio.run(_request("GET", f"{_api_url(api_url)}/api/tenants"))
+    click.echo(json.dumps(out, indent=2))
+
+
+@tenants.command("delete")
+@click.argument("name")
+@click.option("--api-url", default=None)
+def tenants_delete(name: str, api_url: str | None) -> None:
+    out = asyncio.run(_request("DELETE", f"{_api_url(api_url)}/api/tenants/{name}"))
+    click.echo(json.dumps(out))
+
+
+# ---------------------------------------------------------------------------
+# apps
+# ---------------------------------------------------------------------------
+
+
+def _collect_files(app_dir: Path) -> dict[str, str]:
+    files = {}
+    for path in sorted(app_dir.glob("*.yaml")) + sorted(app_dir.glob("*.yml")):
+        files[path.name] = path.read_text()
+    if not files:
+        raise click.ClickException(f"no YAML files in {app_dir}")
+    return files
+
+
+@cli.group()
+def apps() -> None:
+    """Manage applications."""
+
+
+def _app_payload(app: str, instance: str | None, secrets: str | None) -> dict:
+    payload: dict = {"files": _collect_files(Path(app))}
+    if instance:
+        payload["instance"] = Path(instance).read_text()
+    if secrets:
+        payload["secrets"] = Path(secrets).read_text()
+    return payload
+
+
+@apps.command("deploy")
+@click.argument("name")
+@click.option("-app", "--application", "app", required=True, type=click.Path(exists=True))
+@click.option("-i", "--instance", default=None, type=click.Path(exists=True))
+@click.option("-s", "--secrets", default=None, type=click.Path(exists=True))
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def apps_deploy(name, app, instance, secrets, tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request(
+            "POST",
+            f"{_api_url(api_url)}/api/applications/{tenant}/{name}",
+            json=_app_payload(app, instance, secrets),
+        )
+    )
+    click.echo(json.dumps(out, indent=2))
+
+
+@apps.command("update")
+@click.argument("name")
+@click.option("-app", "--application", "app", required=True, type=click.Path(exists=True))
+@click.option("-i", "--instance", default=None, type=click.Path(exists=True))
+@click.option("-s", "--secrets", default=None, type=click.Path(exists=True))
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def apps_update(name, app, instance, secrets, tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request(
+            "PATCH",
+            f"{_api_url(api_url)}/api/applications/{tenant}/{name}",
+            json=_app_payload(app, instance, secrets),
+        )
+    )
+    click.echo(json.dumps(out, indent=2))
+
+
+@apps.command("get")
+@click.argument("name")
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def apps_get(name, tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request("GET", f"{_api_url(api_url)}/api/applications/{tenant}/{name}")
+    )
+    click.echo(json.dumps(out, indent=2))
+
+
+@apps.command("list")
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def apps_list(tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request("GET", f"{_api_url(api_url)}/api/applications/{tenant}")
+    )
+    click.echo(json.dumps(out, indent=2))
+
+
+@apps.command("delete")
+@click.argument("name")
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def apps_delete(name, tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request("DELETE", f"{_api_url(api_url)}/api/applications/{tenant}/{name}")
+    )
+    click.echo(json.dumps(out))
+
+
+@apps.command("logs")
+@click.argument("name")
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def apps_logs(name, tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request("GET", f"{_api_url(api_url)}/api/applications/{tenant}/{name}/logs")
+    )
+    click.echo(out)
+
+
+# ---------------------------------------------------------------------------
+# gateway clients
+# ---------------------------------------------------------------------------
+
+
+def _gw_ws_url(base: str, kind: str, tenant: str, app: str, gateway: str,
+               params: tuple[str, ...], credentials: str | None,
+               options: dict | None = None) -> str:
+    from urllib.parse import quote
+
+    url = base.replace("http://", "ws://").replace("https://", "wss://")
+    qs = []
+    for p in params:
+        k, _, v = p.partition("=")
+        qs.append(f"param:{quote(k, safe='')}={quote(v, safe='')}")
+    if credentials:
+        qs.append(f"credentials={quote(credentials, safe='')}")
+    for k, v in (options or {}).items():
+        qs.append(f"option:{quote(str(k), safe='')}={quote(str(v), safe='')}")
+    query = ("?" + "&".join(qs)) if qs else ""
+    return f"{url}/v1/{kind}/{tenant}/{app}/{gateway}{query}"
+
+
+@cli.group()
+def gateway() -> None:
+    """Interact with application gateways."""
+
+
+@gateway.command("produce")
+@click.argument("application")
+@click.argument("gateway_id")
+@click.option("-v", "--value", required=True)
+@click.option("-k", "--key", default=None)
+@click.option("-p", "--param", multiple=True, help="name=value")
+@click.option("--credentials", default=None)
+@click.option("--tenant", default=None)
+@click.option("--gateway-url", default=None)
+def gateway_produce(application, gateway_id, value, key, param, credentials,
+                    tenant, gateway_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+
+    async def run():
+        import aiohttp
+
+        url = _gw_ws_url(
+            _gateway_url(gateway_url), "produce", tenant, application, gateway_id,
+            param, credentials,
+        )
+        async with aiohttp.ClientSession() as session:
+            async with _ws_connect(session, url) as ws:
+                await ws.send_json({"value": value, "key": key})
+                reply = await ws.receive_json()
+                click.echo(json.dumps(reply))
+
+    asyncio.run(run())
+
+
+@gateway.command("consume")
+@click.argument("application")
+@click.argument("gateway_id")
+@click.option("-p", "--param", multiple=True)
+@click.option("--position", default="latest")
+@click.option("-n", "--num-messages", default=0, help="0 = forever")
+@click.option("--credentials", default=None)
+@click.option("--tenant", default=None)
+@click.option("--gateway-url", default=None)
+def gateway_consume(application, gateway_id, param, position, num_messages,
+                    credentials, tenant, gateway_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+
+    async def run():
+        import aiohttp
+
+        url = _gw_ws_url(
+            _gateway_url(gateway_url), "consume", tenant, application, gateway_id,
+            param, credentials, {"position": position},
+        )
+        count = 0
+        async with aiohttp.ClientSession() as session:
+            async with _ws_connect(session, url) as ws:
+                async for msg in ws:
+                    if msg.type == aiohttp.WSMsgType.TEXT:
+                        click.echo(msg.data)
+                        count += 1
+                        if num_messages and count >= num_messages:
+                            return
+
+    asyncio.run(run())
+
+
+@gateway.command("chat")
+@click.argument("application")
+@click.argument("gateway_id")
+@click.option("-p", "--param", multiple=True)
+@click.option("--credentials", default=None)
+@click.option("--tenant", default=None)
+@click.option("--gateway-url", default=None)
+def gateway_chat(application, gateway_id, param, credentials, tenant,
+                 gateway_url) -> None:
+    """Interactive chat: reads prompts from stdin, prints streamed answers."""
+    tenant = tenant or _profile().get("tenant", "default")
+
+    async def run():
+        import aiohttp
+
+        url = _gw_ws_url(
+            _gateway_url(gateway_url), "chat", tenant, application, gateway_id,
+            param, credentials,
+        )
+        async with aiohttp.ClientSession() as session:
+            async with _ws_connect(session, url) as ws:
+                loop = asyncio.get_event_loop()
+
+                async def pump_stdin():
+                    while True:
+                        line = await loop.run_in_executor(None, sys.stdin.readline)
+                        if not line:
+                            await ws.close()
+                            return
+                        await ws.send_json({"value": line.strip()})
+
+                stdin_task = asyncio.ensure_future(pump_stdin())
+                try:
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.TEXT:
+                            data = json.loads(msg.data)
+                            if "record" in data:
+                                value = data["record"].get("value")
+                                if isinstance(value, str):
+                                    click.echo(value, nl=False)
+                                    headers = data["record"].get("headers", {})
+                                    if headers.get("stream-last-message") == "true":
+                                        click.echo("")
+                                else:
+                                    click.echo(json.dumps(value))
+                finally:
+                    stdin_task.cancel()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# dev mode: everything in one process
+# ---------------------------------------------------------------------------
+
+
+@cli.command("run")
+@click.argument("name")
+@click.option("-app", "--application", "app", required=True, type=click.Path(exists=True))
+@click.option("-i", "--instance", default=None, type=click.Path(exists=True))
+@click.option("-s", "--secrets", default=None, type=click.Path(exists=True))
+@click.option("--api-port", default=8090)
+@click.option("--gateway-port", default=8091)
+def run_local(name, app, instance, secrets, api_port, gateway_port) -> None:
+    """Single-process dev mode (parity: ``langstream docker run``): boots the
+    control plane + gateway in-process, deploys the app, serves until ^C."""
+    from langstream_tpu.controlplane.server import (
+        ControlPlaneServer,
+        LocalComputeRuntime,
+    )
+    from langstream_tpu.controlplane.stores import (
+        InMemoryApplicationStore,
+        StoredApplication,
+    )
+    from langstream_tpu.gateway.server import GatewayRegistry, GatewayServer
+
+    async def run():
+        registry = GatewayRegistry()
+        compute = LocalComputeRuntime(gateway_registry=registry)
+        store = InMemoryApplicationStore()
+        store.put_tenant("default")
+        control = ControlPlaneServer(store=store, compute=compute, port=api_port)
+        gw = GatewayServer(registry=registry, port=gateway_port)
+        await control.start()
+        await gw.start()
+        stored = StoredApplication(
+            tenant="default",
+            name=name,
+            files=_collect_files(Path(app)),
+            instance=Path(instance).read_text() if instance else None,
+            secrets=Path(secrets).read_text() if secrets else None,
+        )
+        store.put_application(stored)
+        await compute.deploy(stored)
+        stored.status = "DEPLOYED"
+        click.echo(f"application {name!r} deployed")
+        click.echo(f"control plane: http://127.0.0.1:{api_port}")
+        click.echo(f"gateway:       ws://127.0.0.1:{gateway_port}")
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            pass
+        finally:
+            await gw.stop()
+            await control.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        click.echo("\nstopped")
+
+
+if __name__ == "__main__":
+    cli()

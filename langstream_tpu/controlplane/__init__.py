@@ -1,0 +1,23 @@
+"""L6 control plane: multi-tenant REST API over application stores.
+
+Parity: ``langstream-webservice`` (Spring REST control plane —
+``ApplicationResource.java:79``: deploy/update/delete/get/logs;
+``TenantResource.java:45``) with the k8s stores
+(``KubernetesApplicationStore``) replaced by pluggable in-memory /
+filesystem stores, and the deployer Jobs replaced by an in-process compute
+runtime in dev mode (the k8s compute runtime plugs in the same way).
+"""
+
+from langstream_tpu.controlplane.server import ControlPlaneServer
+from langstream_tpu.controlplane.stores import (
+    ApplicationStore,
+    FileSystemApplicationStore,
+    InMemoryApplicationStore,
+)
+
+__all__ = [
+    "ControlPlaneServer",
+    "ApplicationStore",
+    "InMemoryApplicationStore",
+    "FileSystemApplicationStore",
+]

@@ -1,0 +1,335 @@
+"""REST control plane + in-process compute runtime.
+
+Endpoints (parity: ``ApplicationResource.java:79``, ``TenantResource.java:45``):
+
+- PUT/GET/DELETE ``/api/tenants/{tenant}``; GET ``/api/tenants``
+- POST   ``/api/applications/{tenant}/{name}`` — deploy (JSON body:
+  ``{"files": {"pipeline.yaml": "...", ...}, "instance": "...",
+  "secrets": "..."}``; multipart zip also accepted)
+- PATCH  — update (revalidated against the running plan)
+- GET    — describe (status); DELETE — undeploy
+- GET    ``/api/applications/{tenant}`` — list
+- GET    ``/api/applications/{tenant}/{name}/logs`` — recent log lines
+
+Deploy path mirrors the reference: parse → ``createImplementation`` (plan,
+validation; ``ApplicationService.java:71-98``) → store → hand to the
+compute runtime. In dev/single-node mode the compute runtime is in-process
+(agents run as asyncio tasks, the role of the reference's tester); under
+the k8s layer the same store contents drive the operator.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import zipfile
+from collections import deque
+from typing import Any
+
+from aiohttp import web
+
+from langstream_tpu.api.application import Application
+from langstream_tpu.controlplane.stores import (
+    ApplicationStore,
+    InMemoryApplicationStore,
+    StoredApplication,
+)
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.gateway.server import GatewayRegistry
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+log = logging.getLogger(__name__)
+
+
+def parse_stored(stored: StoredApplication) -> Application:
+    builder = ModelBuilder()
+    for fname, content in sorted(stored.files.items()):
+        if fname == "configuration.yaml":
+            builder.add_configuration_file(content)
+        elif fname == "gateways.yaml":
+            builder.add_gateways_file(content)
+        elif fname == "secrets.yaml":
+            builder.add_secrets(content)
+        elif fname == "instance.yaml":
+            builder.add_instance(content)
+        else:
+            builder.add_pipeline_file(fname, content)
+    if stored.instance:
+        builder.add_instance(stored.instance)
+    if stored.secrets:
+        builder.add_secrets(stored.secrets)
+    return builder.build()
+
+
+class LocalComputeRuntime:
+    """Runs deployed applications in-process (dev/single-node mode)."""
+
+    def __init__(self, gateway_registry: GatewayRegistry | None = None):
+        self.runners: dict[tuple[str, str], LocalApplicationRunner] = {}
+        self.gateway_registry = gateway_registry
+        self.logs: dict[tuple[str, str], deque[str]] = {}
+        self._log_handlers: dict[tuple[str, str], logging.Handler] = {}
+
+    async def deploy(self, stored: StoredApplication) -> None:
+        application = parse_stored(stored)
+        key = (stored.tenant, stored.name)
+        runner = LocalApplicationRunner(
+            application, application_id=f"{stored.tenant}-{stored.name}"
+        )
+        self._attach_log_capture(key)
+        await runner.start()
+        self.runners[key] = runner
+        self.append_log(*key, f"application {stored.name} deployed")
+        if self.gateway_registry is not None:
+            # gateways resolve against the *resolved* application
+            self.gateway_registry.register(stored.tenant, stored.name, application)
+
+    async def undeploy(self, tenant: str, name: str) -> None:
+        key = (tenant, name)
+        runner = self.runners.pop(key, None)
+        if runner is not None:
+            try:
+                await runner.stop()
+            except Exception:
+                log.exception("error stopping %s/%s", tenant, name)
+        handler = self._log_handlers.pop(key, None)
+        if handler is not None:
+            logging.getLogger("langstream_tpu").removeHandler(handler)
+        if self.gateway_registry is not None:
+            self.gateway_registry.unregister(tenant, name)
+
+    def _attach_log_capture(self, key: tuple[str, str]) -> None:
+        """Capture framework log lines for the /logs endpoint (the role pod
+        log streaming plays in the reference, ``ApplicationResource.java:318``).
+        Dev-mode caveat: all in-process apps share the logger namespace, so
+        each app's buffer sees the whole process's framework logs."""
+        buffer = self.logs.setdefault(key, deque(maxlen=1000))
+
+        class _Capture(logging.Handler):
+            def emit(self, record: logging.LogRecord) -> None:
+                try:
+                    buffer.append(self.format(record))
+                except Exception:
+                    pass
+
+        handler = _Capture(level=logging.INFO)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+        logging.getLogger("langstream_tpu").addHandler(handler)
+        self._log_handlers[key] = handler
+
+    def append_log(self, tenant: str, name: str, line: str) -> None:
+        self.logs.setdefault((tenant, name), deque(maxlen=1000)).append(line)
+
+    def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
+        runner = self.runners.get((tenant, name))
+        return runner.agent_info() if runner else []
+
+    async def close(self) -> None:
+        for tenant, name in list(self.runners):
+            await self.undeploy(tenant, name)
+
+
+class ControlPlaneServer:
+    def __init__(
+        self,
+        store: ApplicationStore | None = None,
+        compute: LocalComputeRuntime | None = None,
+        port: int = 8090,
+    ):
+        self.store = store or InMemoryApplicationStore()
+        self.compute = compute or LocalComputeRuntime()
+        self.port = port
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.add_routes(
+            [
+                web.get("/api/tenants", self._list_tenants),
+                web.put("/api/tenants/{tenant}", self._put_tenant),
+                web.get("/api/tenants/{tenant}", self._get_tenant),
+                web.delete("/api/tenants/{tenant}", self._delete_tenant),
+                web.get("/api/applications/{tenant}", self._list_apps),
+                web.post("/api/applications/{tenant}/{name}", self._deploy),
+                web.patch("/api/applications/{tenant}/{name}", self._update),
+                web.get("/api/applications/{tenant}/{name}", self._get_app),
+                web.delete("/api/applications/{tenant}/{name}", self._delete_app),
+                web.get("/api/applications/{tenant}/{name}/logs", self._logs),
+                web.get("/api/applications/{tenant}/{name}/agents", self._agents),
+            ]
+        )
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        await site.start()
+        log.info("control plane listening on :%d", self.port)
+
+    async def stop(self) -> None:
+        await self.compute.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ---- tenants ---------------------------------------------------------
+
+    async def _list_tenants(self, request: web.Request) -> web.Response:
+        return web.json_response(self.store.list_tenants())
+
+    async def _put_tenant(self, request: web.Request) -> web.Response:
+        config = {}
+        if request.can_read_body:
+            try:
+                config = await request.json()
+            except Exception:
+                config = {}
+        self.store.put_tenant(request.match_info["tenant"], config)
+        return web.json_response({"status": "OK"})
+
+    async def _get_tenant(self, request: web.Request) -> web.Response:
+        tenants = self.store.list_tenants()
+        tenant = request.match_info["tenant"]
+        if tenant not in tenants:
+            raise web.HTTPNotFound()
+        return web.json_response({"name": tenant, **tenants[tenant]})
+
+    async def _delete_tenant(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        for name in self.store.list_applications(tenant):
+            await self.compute.undeploy(tenant, name)
+        self.store.delete_tenant(tenant)
+        return web.json_response({"status": "OK"})
+
+    # ---- applications ----------------------------------------------------
+
+    def _require_tenant(self, tenant: str) -> None:
+        if not self.store.tenant_exists(tenant):
+            raise web.HTTPNotFound(reason=f"unknown tenant {tenant!r}")
+
+    async def _read_app_payload(self, request: web.Request) -> StoredApplication:
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        content_type = request.content_type or ""
+        files: dict[str, str] = {}
+        instance = secrets = None
+        if "multipart" in content_type:
+            reader = await request.multipart()
+            async for part in reader:
+                data = await part.read(decode=True)
+                if part.name == "app":
+                    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                        for entry in zf.namelist():
+                            if entry.endswith((".yaml", ".yml")) and "/" not in entry.strip("/"):
+                                files[entry] = zf.read(entry).decode()
+                elif part.name == "instance":
+                    instance = data.decode()
+                elif part.name == "secrets":
+                    secrets = data.decode()
+        else:
+            payload = await request.json()
+            files = payload.get("files", {})
+            instance = payload.get("instance")
+            secrets = payload.get("secrets")
+        if not files:
+            raise web.HTTPBadRequest(reason="no application files provided")
+        from langstream_tpu.controlplane.stores import validate_filenames
+
+        try:
+            validate_filenames(files)
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        return StoredApplication(
+            tenant=tenant, name=name, files=files, instance=instance, secrets=secrets
+        )
+
+    async def _deploy(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        self._require_tenant(tenant)
+        if self.store.get_application(tenant, name) is not None:
+            raise web.HTTPConflict(reason=f"application {name!r} already exists")
+        stored = await self._read_app_payload(request)
+        return await self._do_deploy(stored)
+
+    async def _update(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        self._require_tenant(tenant)
+        existing = self.store.get_application(tenant, name)
+        if existing is None:
+            raise web.HTTPNotFound()
+        stored = await self._read_app_payload(request)
+        # merge: unchanged files/instance/secrets carry over
+        merged_files = {**existing.files, **stored.files}
+        stored.files = merged_files
+        stored.instance = stored.instance or existing.instance
+        stored.secrets = stored.secrets or existing.secrets
+        # validate BEFORE undeploying the running app — a bad update must
+        # leave the old deployment untouched (parity: update validation in
+        # ApplicationService.validateAgentsUpdate)
+        from langstream_tpu.core.deployer import ApplicationDeployer
+
+        try:
+            application = parse_stored(stored)
+            ApplicationDeployer().create_implementation(
+                f"{stored.tenant}-{stored.name}", application
+            )
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=f"invalid application: {e}")
+        await self.compute.undeploy(tenant, name)
+        return await self._do_deploy(stored)
+
+    async def _do_deploy(self, stored: StoredApplication) -> web.Response:
+        # validation = full plan (parity: createImplementation before store)
+        from langstream_tpu.core.deployer import ApplicationDeployer
+
+        try:
+            application = parse_stored(stored)
+            ApplicationDeployer().create_implementation(
+                f"{stored.tenant}-{stored.name}", application
+            )
+        except Exception as e:
+            raise web.HTTPBadRequest(reason=f"invalid application: {e}")
+        stored.status = "DEPLOYING"
+        self.store.put_application(stored)
+        try:
+            await self.compute.deploy(stored)
+            stored.status = "DEPLOYED"
+        except Exception as e:
+            stored.status = "ERROR"
+            stored.error = str(e)
+            log.exception("deploy failed")
+        self.store.put_application(stored)
+        return web.json_response(stored.public_view())
+
+    async def _get_app(self, request: web.Request) -> web.Response:
+        stored = self.store.get_application(
+            request.match_info["tenant"], request.match_info["name"]
+        )
+        if stored is None:
+            raise web.HTTPNotFound()
+        return web.json_response(stored.public_view())
+
+    async def _list_apps(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        self._require_tenant(tenant)
+        return web.json_response(self.store.list_applications(tenant))
+
+    async def _delete_app(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        await self.compute.undeploy(tenant, name)
+        self.store.delete_application(tenant, name)
+        return web.json_response({"status": "OK"})
+
+    async def _logs(self, request: web.Request) -> web.Response:
+        key = (request.match_info["tenant"], request.match_info["name"])
+        lines = list(self.compute.logs.get(key, []))
+        return web.Response(text="\n".join(lines))
+
+    async def _agents(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            self.compute.agent_info(
+                request.match_info["tenant"], request.match_info["name"]
+            )
+        )

@@ -1,0 +1,15 @@
+"""L6 gateway: the client-facing WebSocket/HTTP front door.
+
+Parity: ``langstream-api-gateway`` — WS endpoints
+``/v1/{consume,produce,chat}/{tenant}/{application}/{gateway}``
+(``websocket/WebSocketConfig.java:47-49``), HTTP produce + service endpoints
+(``http/GatewayResource.java:72-95``), gateway-level authentication
+providers, header injection from client parameters
+(``value-from-parameters``) and from the authenticated principal
+(``value-from-authentication``), server-side consume filters, and client
+lifecycle events to an events topic (``EventRecord.java:29-44``).
+"""
+
+from langstream_tpu.gateway.server import GatewayServer
+
+__all__ = ["GatewayServer"]

@@ -1,0 +1,78 @@
+"""Gateway authentication providers.
+
+Parity: ``langstream-api-gateway-auth`` (google/github/jwt/http providers).
+First-party: ``http`` (POST credentials to a verification endpoint) and
+``test`` (accept-all, principal echoes the credentials — the fixture role
+the reference's tests play). ``google``/``github``/``jwt`` gate on network
+or optional libraries.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+
+class AuthenticationException(Exception):
+    pass
+
+
+class GatewayAuthenticationProvider(abc.ABC):
+    """authenticate(credentials) → principal claims dict (raises on deny)."""
+
+    def __init__(self, configuration: dict[str, Any]):
+        self.configuration = configuration
+
+    @abc.abstractmethod
+    async def authenticate(self, credentials: str | None) -> dict[str, Any]: ...
+
+
+class TestAuthenticationProvider(GatewayAuthenticationProvider):
+    """Accept-all provider for tests/dev: principal.subject = credentials."""
+
+    async def authenticate(self, credentials: str | None) -> dict[str, Any]:
+        if self.configuration.get("require-credentials") and not credentials:
+            raise AuthenticationException("credentials required")
+        return {"subject": credentials or "anonymous"}
+
+
+class HttpAuthenticationProvider(GatewayAuthenticationProvider):
+    """POSTs the credentials to an external endpoint; 2xx → principal from
+    the JSON response (parity: the reference's http auth provider)."""
+
+    async def authenticate(self, credentials: str | None) -> dict[str, Any]:
+        import aiohttp
+
+        url = self.configuration.get("base-url", "") + self.configuration.get(
+            "path-template", "/check"
+        )
+        async with aiohttp.ClientSession() as session:
+            async with session.post(url, json={"token": credentials}) as resp:
+                if resp.status >= 300:
+                    raise AuthenticationException(f"auth endpoint: {resp.status}")
+                try:
+                    data = await resp.json()
+                except Exception:
+                    data = {}
+        return data if isinstance(data, dict) else {"subject": str(data)}
+
+
+_PROVIDERS: dict[str, type[GatewayAuthenticationProvider]] = {
+    "test": TestAuthenticationProvider,
+    "http": HttpAuthenticationProvider,
+}
+
+
+def register_auth_provider(name: str, cls: type[GatewayAuthenticationProvider]) -> None:
+    _PROVIDERS[name] = cls
+
+
+def get_auth_provider(
+    name: str, configuration: dict[str, Any]
+) -> GatewayAuthenticationProvider:
+    if name not in _PROVIDERS:
+        raise AuthenticationException(
+            f"unknown auth provider {name!r}; available: {sorted(_PROVIDERS)} "
+            f"(google/github/jwt gate on network access)"
+        )
+    return _PROVIDERS[name](configuration)

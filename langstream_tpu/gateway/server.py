@@ -1,0 +1,468 @@
+"""The gateway server (aiohttp: HTTP + WebSocket in one listener).
+
+Endpoints (parity: ``WebSocketConfig.java:47-49``, ``GatewayResource.java``):
+
+- WS  ``/v1/produce/{tenant}/{application}/{gateway}``
+- WS  ``/v1/consume/{tenant}/{application}/{gateway}``
+- WS  ``/v1/chat/{tenant}/{application}/{gateway}``
+- POST ``/api/gateways/produce/{tenant}/{application}/{gateway}``
+- GET  ``/api/gateways/service/{tenant}/{application}/{gateway}`` (+ POST)
+
+Client protocol (reference-compatible shapes):
+- query params: ``param:<name>=value`` for declared gateway parameters,
+  ``credentials=`` for auth, ``option:position=earliest|latest`` for
+  consume starting position.
+- produce message: ``{"key":..., "value":..., "headers": {...}}``
+- consume push:   ``{"record": {...}, "offset": "..."}``
+- chat: client sends produce messages, receives consume pushes on one
+  socket, correlated by the gateway's header mappings.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+from aiohttp import WSMsgType, web
+
+from langstream_tpu.api.application import Application, Gateway
+from langstream_tpu.api.record import Record, make_record
+from langstream_tpu.api.topics import TopicConnectionsRuntimeRegistry
+from langstream_tpu.gateway.auth import (
+    AuthenticationException,
+    get_auth_provider,
+)
+
+log = logging.getLogger(__name__)
+
+
+class GatewayRegistry:
+    """Resolves (tenant, application, gateway-id) → (Gateway, streaming
+    cluster config). Backed by the application store in the control plane,
+    or by directly-registered local apps in dev mode."""
+
+    def __init__(self) -> None:
+        self._apps: dict[tuple[str, str], Application] = {}
+
+    def register(self, tenant: str, app_id: str, application: Application) -> None:
+        self._apps[(tenant, app_id)] = application
+
+    def unregister(self, tenant: str, app_id: str) -> None:
+        self._apps.pop((tenant, app_id), None)
+
+    def resolve(
+        self, tenant: str, app_id: str, gateway_id: str
+    ) -> tuple[Gateway, dict[str, Any]]:
+        app = self._apps.get((tenant, app_id))
+        if app is None:
+            raise web.HTTPNotFound(reason=f"unknown application {tenant}/{app_id}")
+        for gw in app.gateways:
+            if gw.id == gateway_id:
+                streaming = app.instance.streaming_cluster
+                return gw, {
+                    "type": streaming.type,
+                    "configuration": streaming.configuration,
+                }
+        raise web.HTTPNotFound(reason=f"unknown gateway {gateway_id!r}")
+
+
+class GatewayServer:
+    def __init__(self, registry: GatewayRegistry | None = None, port: int = 8091):
+        self.registry = registry or GatewayRegistry()
+        self.port = port
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/v1/produce/{tenant}/{application}/{gateway}", self._ws_produce),
+                web.get("/v1/consume/{tenant}/{application}/{gateway}", self._ws_consume),
+                web.get("/v1/chat/{tenant}/{application}/{gateway}", self._ws_chat),
+                web.post(
+                    "/api/gateways/produce/{tenant}/{application}/{gateway}",
+                    self._http_produce,
+                ),
+                web.route(
+                    "*",
+                    "/api/gateways/service/{tenant}/{application}/{gateway}",
+                    self._http_service,
+                ),
+                web.route(
+                    "*",
+                    "/api/gateways/service/{tenant}/{application}/{gateway}/{tail:.*}",
+                    self._http_service,
+                ),
+            ]
+        )
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        await site.start()
+        log.info("gateway listening on :%d", self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------
+    # shared plumbing
+    # ------------------------------------------------------------------
+
+    def _context(self, request: web.Request):
+        tenant = request.match_info["tenant"]
+        app_id = request.match_info["application"]
+        gateway_id = request.match_info["gateway"]
+        gateway, streaming = self.registry.resolve(tenant, app_id, gateway_id)
+        params: dict[str, str] = {}
+        options: dict[str, str] = {}
+        for k, v in request.query.items():
+            if k.startswith("param:"):
+                params[k[6:]] = v
+            elif k.startswith("option:"):
+                options[k[7:]] = v
+        missing = [p for p in gateway.parameters if p not in params]
+        if missing:
+            raise web.HTTPBadRequest(reason=f"missing parameters: {missing}")
+        credentials = request.query.get("credentials")
+        return tenant, app_id, gateway, streaming, params, options, credentials
+
+    async def _authenticate(
+        self, gateway: Gateway, credentials: str | None
+    ) -> dict[str, Any]:
+        if not gateway.authentication:
+            return {}
+        provider = get_auth_provider(
+            gateway.authentication.get("provider", "test"),
+            gateway.authentication.get("configuration", {}),
+        )
+        try:
+            return await provider.authenticate(credentials)
+        except AuthenticationException:
+            raise
+        except Exception as e:
+            # provider infrastructure failure (endpoint down, bad config):
+            # an auth failure to the client, not a 500 with a traceback
+            log.warning("auth provider failure: %s", e)
+            raise AuthenticationException(f"authentication unavailable: {e}")
+
+    @staticmethod
+    def _mapped_headers(
+        mappings, params: dict[str, str], principal: dict[str, Any]
+    ) -> dict[str, Any]:
+        headers: dict[str, Any] = {}
+        for m in mappings:
+            if m.value_from_parameters:
+                value = params.get(m.value_from_parameters)
+            elif m.value_from_authentication:
+                value = principal.get(m.value_from_authentication)
+            else:
+                value = m.literal_value
+            key = m.key or (
+                f"langstream-client-{m.value_from_parameters or m.value_from_authentication}"
+            )
+            if value is not None:
+                headers[key] = value
+        return headers
+
+    @staticmethod
+    def _record_json(record: Record) -> dict[str, Any]:
+        offset = None
+        headers = {}
+        for k, v in record.headers:
+            if k == "__offset":
+                offset = f"{v.topic}:{v.partition}:{v.offset}"
+            else:
+                headers[k] = v
+        return {
+            "record": {"key": record.key, "value": record.value, "headers": headers},
+            "offset": offset,
+        }
+
+    def _filters_match(
+        self, gateway: Gateway, params, principal, record: Record
+    ) -> bool:
+        expected = self._mapped_headers(gateway.consume_filters, params, principal)
+        record_headers = record.header_map()
+        return all(record_headers.get(k) == v for k, v in expected.items())
+
+    async def _emit_event(self, gateway: Gateway, streaming, event_type: str,
+                          tenant: str, app_id: str) -> None:
+        """Client lifecycle events (parity: ``EventRecord.java:29-44``)."""
+        if not gateway.events_topic:
+            return
+        try:
+            runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
+            producer = runtime.create_producer("gateway-events", {"topic": gateway.events_topic})
+            await producer.start()
+            await producer.write(
+                make_record(
+                    value={
+                        "type": event_type,
+                        "tenant": tenant,
+                        "application": app_id,
+                        "gateway": gateway.id,
+                    }
+                )
+            )
+            await producer.close()
+            await runtime.close()
+        except Exception:
+            log.exception("failed to emit gateway event")
+
+    # ------------------------------------------------------------------
+    # produce
+    # ------------------------------------------------------------------
+
+    async def _ws_produce(self, request: web.Request) -> web.WebSocketResponse:
+        tenant, app_id, gateway, streaming, params, options, credentials = (
+            self._context(request)
+        )
+        if gateway.type != Gateway.PRODUCE:
+            raise web.HTTPBadRequest(reason="not a produce gateway")
+        try:
+            principal = await self._authenticate(gateway, credentials)
+        except AuthenticationException as e:
+            raise web.HTTPUnauthorized(reason=str(e))
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        await self._emit_event(gateway, streaming, "ClientConnected", tenant, app_id)
+        runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
+        producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
+        await producer.start()
+        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    payload = json.loads(msg.data)
+                    record = make_record(
+                        value=payload.get("value"),
+                        key=payload.get("key"),
+                        headers={**(payload.get("headers") or {}), **inject},
+                    )
+                    await producer.write(record)
+                    await ws.send_json({"status": "OK"})
+                except Exception as e:
+                    await ws.send_json({"status": "BAD_REQUEST", "reason": str(e)})
+        finally:
+            await producer.close()
+            await runtime.close()
+            await self._emit_event(
+                gateway, streaming, "ClientDisconnected", tenant, app_id
+            )
+        return ws
+
+    async def _http_produce(self, request: web.Request) -> web.Response:
+        tenant, app_id, gateway, streaming, params, options, credentials = (
+            self._context(request)
+        )
+        if gateway.type != Gateway.PRODUCE:
+            raise web.HTTPBadRequest(reason="not a produce gateway")
+        try:
+            principal = await self._authenticate(gateway, credentials)
+        except AuthenticationException as e:
+            raise web.HTTPUnauthorized(reason=str(e))
+        payload = await request.json()
+        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
+        producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
+        await producer.start()
+        try:
+            await producer.write(
+                make_record(
+                    value=payload.get("value"),
+                    key=payload.get("key"),
+                    headers={**(payload.get("headers") or {}), **inject},
+                )
+            )
+        finally:
+            await producer.close()
+            await runtime.close()
+        return web.json_response({"status": "OK"})
+
+    # ------------------------------------------------------------------
+    # consume
+    # ------------------------------------------------------------------
+
+    async def _ws_consume(self, request: web.Request) -> web.WebSocketResponse:
+        tenant, app_id, gateway, streaming, params, options, credentials = (
+            self._context(request)
+        )
+        if gateway.type != Gateway.CONSUME:
+            raise web.HTTPBadRequest(reason="not a consume gateway")
+        try:
+            principal = await self._authenticate(gateway, credentials)
+        except AuthenticationException as e:
+            raise web.HTTPUnauthorized(reason=str(e))
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        await self._emit_event(gateway, streaming, "ClientConnected", tenant, app_id)
+        runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
+        reader = runtime.create_reader(
+            {"topic": gateway.topic},
+            initial_position=options.get("position", "latest"),
+        )
+        await reader.start()
+        pusher = asyncio.ensure_future(
+            self._push_loop(ws, reader, gateway, params, principal)
+        )
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    pass  # client acks are accepted and ignored (at-most-once push)
+        finally:
+            pusher.cancel()
+            await reader.close()
+            await runtime.close()
+            await self._emit_event(
+                gateway, streaming, "ClientDisconnected", tenant, app_id
+            )
+        return ws
+
+    async def _push_loop(self, ws, reader, gateway, params, principal) -> None:
+        try:
+            while not ws.closed:
+                records = await reader.read(timeout=0.5)
+                for record in records:
+                    if self._filters_match(gateway, params, principal, record):
+                        await ws.send_json(self._record_json(record))
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("consume push loop failed")
+
+    # ------------------------------------------------------------------
+    # chat: produce + consume on one socket
+    # ------------------------------------------------------------------
+
+    async def _ws_chat(self, request: web.Request) -> web.WebSocketResponse:
+        tenant, app_id, gateway, streaming, params, options, credentials = (
+            self._context(request)
+        )
+        if gateway.type != Gateway.CHAT:
+            raise web.HTTPBadRequest(reason="not a chat gateway")
+        try:
+            principal = await self._authenticate(gateway, credentials)
+        except AuthenticationException as e:
+            raise web.HTTPUnauthorized(reason=str(e))
+        chat = gateway.chat_options
+        questions_topic = chat.get("questions-topic")
+        answers_topic = chat.get("answers-topic")
+        if not questions_topic or not answers_topic:
+            raise web.HTTPBadRequest(reason="chat gateway needs questions/answers topics")
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        await self._emit_event(gateway, streaming, "ClientConnected", tenant, app_id)
+        runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
+        producer = runtime.create_producer("gateway-chat", {"topic": questions_topic})
+        await producer.start()
+        reader = runtime.create_reader(
+            {"topic": answers_topic}, initial_position="latest"
+        )
+        await reader.start()
+        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        # the same headers injected on produce are the consume-side filters
+        # (that's how chat correlates answers to this session)
+        pusher = asyncio.ensure_future(
+            self._chat_push_loop(ws, reader, inject)
+        )
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    payload = json.loads(msg.data)
+                    await producer.write(
+                        make_record(
+                            value=payload.get("value"),
+                            key=payload.get("key"),
+                            headers={**(payload.get("headers") or {}), **inject},
+                        )
+                    )
+                    await ws.send_json({"status": "OK"})
+                except Exception as e:
+                    await ws.send_json({"status": "BAD_REQUEST", "reason": str(e)})
+        finally:
+            pusher.cancel()
+            await producer.close()
+            await reader.close()
+            await runtime.close()
+            await self._emit_event(
+                gateway, streaming, "ClientDisconnected", tenant, app_id
+            )
+        return ws
+
+    async def _chat_push_loop(self, ws, reader, inject: dict[str, Any]) -> None:
+        try:
+            while not ws.closed:
+                records = await reader.read(timeout=0.5)
+                for record in records:
+                    headers = record.header_map()
+                    if all(headers.get(k) == v for k, v in inject.items()):
+                        await ws.send_json(self._record_json(record))
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("chat push loop failed")
+
+    # ------------------------------------------------------------------
+    # service gateway: request/response over topics
+    # ------------------------------------------------------------------
+
+    async def _http_service(self, request: web.Request) -> web.Response:
+        tenant, app_id, gateway, streaming, params, options, credentials = (
+            self._context(request)
+        )
+        if gateway.type != Gateway.SERVICE:
+            raise web.HTTPBadRequest(reason="not a service gateway")
+        try:
+            principal = await self._authenticate(gateway, credentials)
+        except AuthenticationException as e:
+            raise web.HTTPUnauthorized(reason=str(e))
+        service = gateway.service_options
+        input_topic = service.get("input-topic")
+        output_topic = service.get("output-topic")
+        if not input_topic or not output_topic:
+            raise web.HTTPBadRequest(
+                reason="service gateway needs input-topic/output-topic"
+            )
+        import uuid
+
+        correlation = str(uuid.uuid4())
+        payload = await request.json() if request.can_read_body else {}
+        runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
+        reader = runtime.create_reader(
+            {"topic": output_topic}, initial_position="latest"
+        )
+        await reader.start()
+        producer = runtime.create_producer("gateway-service", {"topic": input_topic})
+        await producer.start()
+        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        try:
+            await producer.write(
+                make_record(
+                    value=payload.get("value", payload),
+                    key=payload.get("key"),
+                    headers={
+                        **(payload.get("headers") or {}),
+                        **inject,
+                        "langstream-service-request-id": correlation,
+                    },
+                )
+            )
+            deadline = asyncio.get_event_loop().time() + float(
+                service.get("timeout-seconds", 30)
+            )
+            while asyncio.get_event_loop().time() < deadline:
+                for record in await reader.read(timeout=0.5):
+                    if record.header("langstream-service-request-id") == correlation:
+                        return web.json_response(self._record_json(record))
+            raise web.HTTPGatewayTimeout(reason="no response on output topic")
+        finally:
+            await producer.close()
+            await reader.close()
+            await runtime.close()

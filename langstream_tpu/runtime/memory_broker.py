@@ -305,7 +305,11 @@ class MemoryTopicReader(TopicReader):
         for p in topic.partitions:
             pos = self.positions.setdefault(p.index, len(p.records))
             while pos < len(p.records):
-                batch.append(p.records[pos])
+                batch.append(
+                    p.records[pos].with_headers(
+                        {OFFSET_HEADER: TopicOffset(self.topic_name, p.index, pos)}
+                    )
+                )
                 pos += 1
             self.positions[p.index] = pos
         return batch

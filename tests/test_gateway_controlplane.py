@@ -1,0 +1,439 @@
+"""Gateway + control plane integration tests (in-process servers on
+ephemeral ports, real HTTP/WS clients — the role the reference's
+webservice/api-gateway Spring tests play)."""
+
+import asyncio
+import json
+import socket
+
+import aiohttp
+import pytest
+
+from langstream_tpu.controlplane.server import ControlPlaneServer, LocalComputeRuntime
+from langstream_tpu.controlplane.stores import (
+    FileSystemApplicationStore,
+    InMemoryApplicationStore,
+    StoredApplication,
+)
+from langstream_tpu.gateway.server import GatewayRegistry, GatewayServer
+
+PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "annotate"
+    type: "compute"
+    input: "input-topic"
+    output: "output-topic"
+    configuration:
+      fields:
+        - name: "value.echo"
+          expression: "fn:uppercase(value.q)"
+"""
+
+GATEWAYS = """
+gateways:
+  - id: "produce-input"
+    type: produce
+    topic: "input-topic"
+    parameters: [sessionId]
+    produce-options:
+      headers:
+        - key: "langstream-client-session-id"
+          value-from-parameters: sessionId
+  - id: "consume-output"
+    type: consume
+    topic: "output-topic"
+    parameters: [sessionId]
+    consume-options:
+      filters:
+        headers:
+          - key: "langstream-client-session-id"
+            value-from-parameters: sessionId
+  - id: "chat"
+    type: chat
+    chat-options:
+      questions-topic: "input-topic"
+      answers-topic: "output-topic"
+      headers:
+        - key: "langstream-client-session-id"
+          value-from-parameters: sessionId
+  - id: "auth-produce"
+    type: produce
+    topic: "input-topic"
+    authentication:
+      provider: test
+      configuration:
+        require-credentials: true
+    produce-options:
+      headers:
+        - key: "user"
+          value-from-authentication: subject
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Servers:
+    def __init__(self):
+        self.api_port = free_port()
+        self.gw_port = free_port()
+
+    async def __aenter__(self):
+        self.registry = GatewayRegistry()
+        self.compute = LocalComputeRuntime(gateway_registry=self.registry)
+        self.store = InMemoryApplicationStore()
+        self.control = ControlPlaneServer(
+            store=self.store, compute=self.compute, port=self.api_port
+        )
+        self.gateway = GatewayServer(registry=self.registry, port=self.gw_port)
+        await self.control.start()
+        await self.gateway.start()
+        self.session = aiohttp.ClientSession()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.session.close()
+        await self.gateway.stop()
+        await self.control.stop()
+
+    def api(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.api_port}{path}"
+
+    def ws(self, path: str) -> str:
+        return f"ws://127.0.0.1:{self.gw_port}{path}"
+
+
+APP_PAYLOAD = {
+    "files": {"pipeline.yaml": PIPELINE, "gateways.yaml": GATEWAYS},
+    "instance": INSTANCE,
+}
+
+
+def test_tenant_and_app_lifecycle(run_async):
+    async def main():
+        async with Servers() as s:
+            # tenant CRUD
+            async with s.session.put(s.api("/api/tenants/t1")) as r:
+                assert r.status == 200
+            async with s.session.get(s.api("/api/tenants")) as r:
+                assert "t1" in await r.json()
+            # deploying to an unknown tenant fails
+            async with s.session.post(
+                s.api("/api/applications/nope/app1"), json=APP_PAYLOAD
+            ) as r:
+                assert r.status == 404
+            # deploy
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=APP_PAYLOAD
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["status"]["status"] == "DEPLOYED"
+            # duplicate deploy conflicts
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=APP_PAYLOAD
+            ) as r:
+                assert r.status == 409
+            # invalid app rejected at validation
+            bad = {"files": {"p.yaml": "pipeline:\n  - name: x\n    type: compute\n    input: missing\n"}}
+            async with s.session.post(
+                s.api("/api/applications/t1/bad"), json=bad
+            ) as r:
+                assert r.status == 400
+            # list / get / agents
+            async with s.session.get(s.api("/api/applications/t1")) as r:
+                assert await r.json() == ["app1"]
+            async with s.session.get(s.api("/api/applications/t1/app1/agents")) as r:
+                agents = await r.json()
+                assert len(agents) == 1 and agents[0]["type"] == "compute"
+            # delete
+            async with s.session.delete(s.api("/api/applications/t1/app1")) as r:
+                assert r.status == 200
+            async with s.session.get(s.api("/api/applications/t1/app1")) as r:
+                assert r.status == 404
+
+    run_async(main())
+
+
+def test_gateway_produce_consume_roundtrip(run_async):
+    async def main():
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=APP_PAYLOAD
+            ) as r:
+                assert r.status == 200
+
+            consume_url = s.ws(
+                "/v1/consume/t1/app1/consume-output?param:sessionId=s1&option:position=earliest"
+            )
+            produce_url = s.ws("/v1/produce/t1/app1/produce-input?param:sessionId=s1")
+            async with s.session.ws_connect(consume_url) as consumer:
+                async with s.session.ws_connect(produce_url) as producer:
+                    await producer.send_json({"value": {"q": "hello"}})
+                    reply = await producer.receive_json()
+                    assert reply["status"] == "OK"
+                push = await asyncio.wait_for(consumer.receive_json(), timeout=10)
+                assert push["record"]["value"]["echo"] == "HELLO"
+                assert (
+                    push["record"]["headers"]["langstream-client-session-id"] == "s1"
+                )
+
+            # session isolation: another session sees nothing
+            other_url = s.ws(
+                "/v1/consume/t1/app1/consume-output?param:sessionId=OTHER&option:position=earliest"
+            )
+            async with s.session.ws_connect(other_url) as other:
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(other.receive_json(), timeout=1.0)
+
+    run_async(main())
+
+
+def test_gateway_chat(run_async):
+    async def main():
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=APP_PAYLOAD
+            ):
+                pass
+            chat_url = s.ws("/v1/chat/t1/app1/chat?param:sessionId=c1")
+            async with s.session.ws_connect(chat_url) as chat:
+                await chat.send_json({"value": {"q": "ping"}})
+                ack = await chat.receive_json()
+                assert ack["status"] == "OK"
+                push = await asyncio.wait_for(chat.receive_json(), timeout=10)
+                assert push["record"]["value"]["echo"] == "PING"
+
+    run_async(main())
+
+
+def test_gateway_missing_parameter_and_auth(run_async):
+    async def main():
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=APP_PAYLOAD
+            ):
+                pass
+            # missing declared parameter → 400
+            async with s.session.get(
+                s.ws("/v1/produce/t1/app1/produce-input")
+            ) as resp:
+                assert resp.status == 400
+            # auth-required gateway without credentials → 401
+            async with s.session.get(s.ws("/v1/produce/t1/app1/auth-produce")) as resp:
+                assert resp.status == 401
+            # with credentials: header injected from principal
+            url = s.ws("/v1/produce/t1/app1/auth-produce?credentials=alice")
+            async with s.session.ws_connect(url) as producer:
+                await producer.send_json({"value": {"q": "x"}})
+                assert (await producer.receive_json())["status"] == "OK"
+
+    run_async(main())
+
+
+def test_http_produce_and_service_gateway(run_async):
+    async def main():
+        gateways = GATEWAYS + """
+  - id: "svc"
+    type: service
+    service-options:
+      input-topic: "input-topic"
+      output-topic: "output-topic"
+      timeout-seconds: 10
+"""
+        payload = {
+            "files": {"pipeline.yaml": PIPELINE, "gateways.yaml": gateways},
+            "instance": INSTANCE,
+        }
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=payload
+            ) as r:
+                assert r.status == 200
+            # HTTP produce
+            async with s.session.post(
+                f"http://127.0.0.1:{s.gw_port}/api/gateways/produce/t1/app1/produce-input?param:sessionId=h1",
+                json={"value": {"q": "via-http"}},
+            ) as r:
+                assert r.status == 200
+            # service gateway: full request/response over the pipeline
+            async with s.session.post(
+                f"http://127.0.0.1:{s.gw_port}/api/gateways/service/t1/app1/svc/",
+                json={"value": {"q": "svc"}},
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["record"]["value"]["echo"] == "SVC"
+
+    run_async(main())
+
+
+def test_deploy_rejects_path_traversal_filenames(run_async):
+    async def main():
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            evil = {"files": {"../../evil.yaml": PIPELINE}}
+            async with s.session.post(
+                s.api("/api/applications/t1/evil"), json=evil
+            ) as r:
+                assert r.status == 400
+            evil2 = {"files": {"sub/dir.yaml": PIPELINE}}
+            async with s.session.post(
+                s.api("/api/applications/t1/evil2"), json=evil2
+            ) as r:
+                assert r.status == 400
+
+    run_async(main())
+
+
+def test_failed_update_leaves_app_running(run_async):
+    async def main():
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=APP_PAYLOAD
+            ) as r:
+                assert r.status == 200
+            # update with a broken pipeline: rejected, old app still live
+            bad = {"files": {"pipeline.yaml": "pipeline:\n  - name: x\n    type: compute\n    input: missing\n"}}
+            async with s.session.patch(
+                s.api("/api/applications/t1/app1"), json=bad
+            ) as r:
+                assert r.status == 400
+            # the original pipeline still serves traffic
+            url = s.ws("/v1/chat/t1/app1/chat?param:sessionId=u1")
+            async with s.session.ws_connect(url) as chat:
+                await chat.send_json({"value": {"q": "alive"}})
+                await chat.receive_json()  # ack
+                push = await asyncio.wait_for(chat.receive_json(), timeout=10)
+                assert push["record"]["value"]["echo"] == "ALIVE"
+
+    run_async(main())
+
+
+def test_consume_push_carries_offset(run_async):
+    async def main():
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=APP_PAYLOAD
+            ):
+                pass
+            consume_url = s.ws(
+                "/v1/consume/t1/app1/consume-output?param:sessionId=s1&option:position=earliest"
+            )
+            produce_url = s.ws("/v1/produce/t1/app1/produce-input?param:sessionId=s1")
+            async with s.session.ws_connect(consume_url) as consumer:
+                async with s.session.ws_connect(produce_url) as producer:
+                    await producer.send_json({"value": {"q": "o"}})
+                    await producer.receive_json()
+                push = await asyncio.wait_for(consumer.receive_json(), timeout=10)
+                assert push["offset"] is not None
+                assert push["offset"].startswith("output-topic:")
+
+    run_async(main())
+
+
+def test_service_gateway_without_trailing_slash(run_async):
+    async def main():
+        gateways = GATEWAYS + """
+  - id: "svc"
+    type: service
+    service-options:
+      input-topic: "input-topic"
+      output-topic: "output-topic"
+"""
+        payload = {
+            "files": {"pipeline.yaml": PIPELINE, "gateways.yaml": gateways},
+            "instance": INSTANCE,
+        }
+        async with Servers() as s:
+            async with s.session.put(s.api("/api/tenants/t1")):
+                pass
+            async with s.session.post(
+                s.api("/api/applications/t1/app1"), json=payload
+            ):
+                pass
+            async with s.session.post(
+                f"http://127.0.0.1:{s.gw_port}/api/gateways/service/t1/app1/svc",
+                json={"value": {"q": "noslash"}},
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["record"]["value"]["echo"] == "NOSLASH"
+
+    run_async(main())
+
+
+def test_ws_url_encoding():
+    from langstream_tpu.cli.main import _gw_ws_url
+
+    url = _gw_ws_url(
+        "http://h:1", "produce", "t", "a", "g", ("sessionId=a&b=c",), "tok=en%"
+    )
+    assert "param:sessionId=a%26b%3Dc" in url
+    assert "credentials=tok%3Den%25" in url
+
+
+def test_filesystem_store_roundtrip(tmp_path, run_async):
+    async def main():
+        store = FileSystemApplicationStore(tmp_path)
+        store.put_tenant("t1", {"plan": "dev"})
+        stored = StoredApplication(
+            tenant="t1",
+            name="a1",
+            files={"pipeline.yaml": PIPELINE},
+            instance=INSTANCE,
+            status="DEPLOYED",
+        )
+        store.put_application(stored)
+        # fresh store instance reads back from disk
+        store2 = FileSystemApplicationStore(tmp_path)
+        assert store2.list_tenants() == {"t1": {"plan": "dev"}}
+        loaded = store2.get_application("t1", "a1")
+        assert loaded.status == "DEPLOYED"
+        assert loaded.files["pipeline.yaml"] == PIPELINE
+        assert store2.list_applications("t1") == ["a1"]
+        store2.delete_application("t1", "a1")
+        assert store2.list_applications("t1") == []
+
+    run_async(main())
+
+
+def test_cli_dev_mode_smoke(tmp_path, run_async):
+    """Drive the CLI's in-process building blocks (the `run` command's guts)."""
+
+    async def main():
+        from langstream_tpu.cli.main import _collect_files
+
+        (tmp_path / "pipeline.yaml").write_text(PIPELINE)
+        (tmp_path / "gateways.yaml").write_text(GATEWAYS)
+        files = _collect_files(tmp_path)
+        assert set(files) == {"pipeline.yaml", "gateways.yaml"}
+
+    run_async(main())
