@@ -31,6 +31,12 @@ class TopicOffset:
     offset: int
 
 
+#: Header under which brokers attach each record's :class:`TopicOffset`
+#: (the cross-module wire constant used by brokers, the runtime tracker and
+#: the gateway's consume push messages).
+OFFSET_HEADER = "__offset"
+
+
 class TopicConsumer(abc.ABC):
     """Group-managed consumer with contiguous-prefix commit."""
 

@@ -354,11 +354,27 @@ def gateway_chat(application, gateway_id, param, credentials, tenant,
         async with aiohttp.ClientSession() as session:
             async with _ws_connect(session, url) as ws:
                 loop = asyncio.get_event_loop()
+                # stdin is read on a dedicated daemon thread (NOT the default
+                # executor): when the server closes the socket mid-readline,
+                # asyncio.run's shutdown would otherwise join the blocked
+                # executor thread and hang the CLI until the next keypress
+                lines: asyncio.Queue[str | None] = asyncio.Queue()
+
+                def _read_stdin():
+                    while True:
+                        line = sys.stdin.readline()
+                        loop.call_soon_threadsafe(lines.put_nowait, line or None)
+                        if not line:
+                            return
+
+                import threading
+
+                threading.Thread(target=_read_stdin, daemon=True).start()
 
                 async def pump_stdin():
                     while True:
-                        line = await loop.run_in_executor(None, sys.stdin.readline)
-                        if not line:
+                        line = await lines.get()
+                        if line is None:
                             await ws.close()
                             return
                         await ws.send_json({"value": line.strip()})

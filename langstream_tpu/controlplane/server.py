@@ -70,14 +70,22 @@ class LocalComputeRuntime:
         self.logs: dict[tuple[str, str], deque[str]] = {}
         self._log_handlers: dict[tuple[str, str], logging.Handler] = {}
 
-    async def deploy(self, stored: StoredApplication) -> None:
-        application = parse_stored(stored)
+    async def deploy(
+        self, stored: StoredApplication, application: Application | None = None
+    ) -> None:
+        if application is None:
+            application = parse_stored(stored)
         key = (stored.tenant, stored.name)
         runner = LocalApplicationRunner(
             application, application_id=f"{stored.tenant}-{stored.name}"
         )
         self._attach_log_capture(key)
-        await runner.start()
+        try:
+            await runner.start()
+        except Exception:
+            # failed deploys must not leave the capture handler attached
+            self._detach_log_capture(key)
+            raise
         self.runners[key] = runner
         self.append_log(*key, f"application {stored.name} deployed")
         if self.gateway_registry is not None:
@@ -92,11 +100,15 @@ class LocalComputeRuntime:
                 await runner.stop()
             except Exception:
                 log.exception("error stopping %s/%s", tenant, name)
+        self._detach_log_capture(key)
+        self.logs.pop(key, None)  # buffers die with the app (no slow leak)
+        if self.gateway_registry is not None:
+            self.gateway_registry.unregister(tenant, name)
+
+    def _detach_log_capture(self, key: tuple[str, str]) -> None:
         handler = self._log_handlers.pop(key, None)
         if handler is not None:
             logging.getLogger("langstream_tpu").removeHandler(handler)
-        if self.gateway_registry is not None:
-            self.gateway_registry.unregister(tenant, name)
 
     def _attach_log_capture(self, key: tuple[str, str]) -> None:
         """Capture framework log lines for the /logs endpoint (the role pod
@@ -277,23 +289,27 @@ class ControlPlaneServer:
         except Exception as e:
             raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         await self.compute.undeploy(tenant, name)
-        return await self._do_deploy(stored)
+        return await self._do_deploy(stored, application)
 
-    async def _do_deploy(self, stored: StoredApplication) -> web.Response:
-        # validation = full plan (parity: createImplementation before store)
+    async def _do_deploy(
+        self, stored: StoredApplication, application: Application | None = None
+    ) -> web.Response:
+        # validation = full plan (parity: createImplementation before store);
+        # callers that already validated pass the parsed application through
         from langstream_tpu.core.deployer import ApplicationDeployer
 
-        try:
-            application = parse_stored(stored)
-            ApplicationDeployer().create_implementation(
-                f"{stored.tenant}-{stored.name}", application
-            )
-        except Exception as e:
-            raise web.HTTPBadRequest(reason=f"invalid application: {e}")
+        if application is None:
+            try:
+                application = parse_stored(stored)
+                ApplicationDeployer().create_implementation(
+                    f"{stored.tenant}-{stored.name}", application
+                )
+            except Exception as e:
+                raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         stored.status = "DEPLOYING"
         self.store.put_application(stored)
         try:
-            await self.compute.deploy(stored)
+            await self.compute.deploy(stored, application)
             stored.status = "DEPLOYED"
         except Exception as e:
             stored.status = "ERROR"

@@ -29,7 +29,10 @@ from aiohttp import WSMsgType, web
 
 from langstream_tpu.api.application import Application, Gateway
 from langstream_tpu.api.record import Record, make_record
-from langstream_tpu.api.topics import TopicConnectionsRuntimeRegistry
+from langstream_tpu.api.topics import (
+    OFFSET_HEADER,
+    TopicConnectionsRuntimeRegistry,
+)
 from langstream_tpu.gateway.auth import (
     AuthenticationException,
     get_auth_provider,
@@ -168,11 +171,23 @@ class GatewayServer:
         return headers
 
     @staticmethod
+    async def _json_body(request: web.Request) -> dict[str, Any]:
+        """Parse a JSON object body; malformed input is a client error (400),
+        not a front-door 500."""
+        try:
+            payload = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise web.HTTPBadRequest(reason="body is not valid JSON")
+        if not isinstance(payload, dict):
+            raise web.HTTPBadRequest(reason="body must be a JSON object")
+        return payload
+
+    @staticmethod
     def _record_json(record: Record) -> dict[str, Any]:
         offset = None
         headers = {}
         for k, v in record.headers:
-            if k == "__offset":
+            if k == OFFSET_HEADER:
                 offset = f"{v.topic}:{v.partition}:{v.offset}"
             else:
                 headers[k] = v
@@ -266,7 +281,7 @@ class GatewayServer:
             principal = await self._authenticate(gateway, credentials)
         except AuthenticationException as e:
             raise web.HTTPUnauthorized(reason=str(e))
-        payload = await request.json()
+        payload = await self._json_body(request)
         inject = self._mapped_headers(gateway.produce_headers, params, principal)
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
         producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
@@ -433,7 +448,7 @@ class GatewayServer:
         import uuid
 
         correlation = str(uuid.uuid4())
-        payload = await request.json() if request.can_read_body else {}
+        payload = await self._json_body(request) if request.can_read_body else {}
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
         reader = runtime.create_reader(
             {"topic": output_topic}, initial_position="latest"

@@ -469,6 +469,55 @@ def llama_forward(
     return jnp.einsum("bsh,hv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
 
+def llama_forward_sp(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,  # (B, S) int32, S divisible by the sp axis size
+    mesh: Mesh,
+    attn: str = "ring",
+) -> jax.Array:
+    """Sequence-parallel long-context forward: activations sharded on the
+    ``sp`` mesh axis end to end; attention runs as a collective over ICI —
+    ring attention (``ppermute`` K/V rotation + online softmax) or Ulysses
+    (all-to-all head re-sharding). See :mod:`langstream_tpu.parallel.ring`.
+
+    This is the context-parallel path for sequences that exceed one chip's
+    HBM: per-device activation memory is ``S/sp``, and the full ``S×S``
+    score matrix never materialises.
+    """
+    from langstream_tpu.parallel.ring import ring_attention, ulysses_attention
+
+    c = config
+    B, S = tokens.shape
+    attn_fn = {"ring": ring_attention, "ulysses": ulysses_attention}[attn]
+    kwargs = {} if attn == "ulysses" else {"head_axis": "tp"}
+    x_spec = NamedSharding(mesh, P("dp" if "dp" in mesh.axis_names else None,
+                                   "sp", None))
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = jax.lax.with_sharding_constraint(x, x_spec)
+    positions = jnp.arange(S)[None, :].repeat(B, axis=0)
+    cos, sin = _rope(positions, c.head_dim, c.rope_theta)
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
+        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
+        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        out = attn_fn(q, k, v, mesh, causal=True, **kwargs)
+        out = out.reshape(B, S, c.heads * c.head_dim)
+        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+        h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+        x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = jax.lax.with_sharding_constraint(x, x_spec)
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    return jnp.einsum("bsh,hv->bsv", x, params["lm_head"]).astype(jnp.float32)
+
+
 def param_count(config: LlamaConfig) -> int:
     c = config
     per_layer = (

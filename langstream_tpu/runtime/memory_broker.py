@@ -26,6 +26,7 @@ from typing import Any
 
 from langstream_tpu.api.record import Record, SimpleRecord
 from langstream_tpu.api.topics import (
+    OFFSET_HEADER,
     TopicAdmin,
     TopicConsumer,
     TopicConnectionsRuntime,
@@ -33,8 +34,6 @@ from langstream_tpu.api.topics import (
     TopicProducer,
     TopicReader,
 )
-
-OFFSET_HEADER = "__offset"
 
 
 class _Partition:
