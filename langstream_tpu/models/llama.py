@@ -23,12 +23,34 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from functools import partial
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _flash_mode(seq_len: int) -> str | None:
+    """Whether prefill attention should use the Pallas flash kernel.
+
+    ``LS_TPU_FLASH``: ``auto`` (default — compiled kernel on TPU for
+    long-enough sequences), ``1``/``0`` force on/off, ``interpret`` runs the
+    kernel in interpreter mode (CPU tests).
+    """
+    env = os.environ.get("LS_TPU_FLASH", "auto").lower()
+    if env == "interpret":
+        return "interpret"
+    if env in ("1", "true", "on"):
+        return "compiled"
+    if env in ("0", "false", "off"):
+        return None
+    return (
+        "compiled"
+        if jax.default_backend() == "tpu" and seq_len >= 512
+        else None
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -211,6 +233,10 @@ def llama_prefill(
     cache_k: jax.Array,      # (L, slots, S, K, D)
     cache_v: jax.Array,
     slot_ids: jax.Array,     # (B,) which cache slots to fill
+    use_flash: bool | None = None,  # None = auto (LS_TPU_FLASH); False when
+                                    # params are mesh-sharded: pallas_call has
+                                    # no SPMD partitioning rule, so under
+                                    # pjit-TP it would replicate, not shard
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process prompts, fill the KV cache, return last-token logits (B, V)."""
     c = config
@@ -226,6 +252,8 @@ def llama_prefill(
     mask = causal[None, :, :] & valid
     neg = jnp.finfo(jnp.float32).min
 
+    flash = _flash_mode(Pn) if use_flash is None else ("compiled" if use_flash else None)
+
     def layer(carry, layer_in):
         x = carry
         lp, ck_l, cv_l = layer_in
@@ -235,15 +263,26 @@ def llama_prefill(
         v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, Pn, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        # grouped-query attention: heads = kv_heads * group
-        G = c.heads // c.kv_heads
-        qg = q.reshape(B, Pn, c.kv_heads, G, c.head_dim)
-        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
-        scores = scores / math.sqrt(c.head_dim)
-        scores = jnp.where(mask[:, None, None, :, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
-        out = out.reshape(B, Pn, c.heads * c.head_dim)
+        if flash is not None:
+            # Pallas blocked attention: no (B,H,P,P) score matrix in HBM.
+            # Causality alone hides right-padded keys from every real query
+            # row; padded rows' outputs are garbage the caller discards.
+            from langstream_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, causal=True, interpret=(flash == "interpret")
+            )
+            out = out.reshape(B, Pn, c.heads * c.head_dim)
+        else:
+            # grouped-query attention: heads = kv_heads * group
+            G = c.heads // c.kv_heads
+            qg = q.reshape(B, Pn, c.kv_heads, G, c.head_dim)
+            scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
+            scores = scores / math.sqrt(c.head_dim)
+            scores = jnp.where(mask[:, None, None, :, :], scores, neg)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+            out = out.reshape(B, Pn, c.heads * c.head_dim)
         x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
@@ -434,17 +473,31 @@ def llama_forward(
     config: LlamaConfig,
     params: dict,
     tokens: jax.Array,  # (B, S) int32
+    *,
+    attention=None,   # (q (B,S,H,D), k, v (B,S,Kh,D)) -> (B,S,H,D); default
+                      # dense causal GQA — callers swap in ring/Ulysses
+    constrain=None,   # applied to activations after embed and each layer
 ) -> jax.Array:
     """All-position logits (B, S, V), no KV cache — the training-side
-    forward (next-token loss) and the long-context prefill building block."""
+    forward (next-token loss) and the long-context prefill building block.
+
+    One transformer body serves the dense and the sequence-parallel paths:
+    they differ only in the ``attention`` callback and the activation
+    ``constrain`` hook (see :func:`llama_forward_sp`).
+    """
     c = config
     B, S = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
+    if attention is None:
+        from langstream_tpu.parallel.ring import _dense_attention
+
+        attention = partial(
+            _dense_attention, causal=True, scale=1.0 / math.sqrt(c.head_dim)
+        )
+    if constrain is None:
+        constrain = lambda x: x  # noqa: E731
+    x = constrain(jnp.take(params["embed"], tokens, axis=0))
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     cos, sin = _rope(positions, c.head_dim, c.rope_theta)
-    causal = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
-    neg = jnp.finfo(jnp.float32).min
-    G = c.heads // c.kv_heads
 
     def layer(x, lp):
         h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
@@ -453,16 +506,11 @@ def llama_forward(
         v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        qg = q.reshape(B, S, c.kv_heads, G, c.head_dim)
-        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
-        scores = scores / math.sqrt(c.head_dim)
-        scores = jnp.where(causal[None, None, None, :, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v).reshape(B, S, c.heads * c.head_dim)
+        out = attention(q, k, v).reshape(B, S, c.heads * c.head_dim)
         x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
-        return x, None
+        return constrain(x), None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
@@ -487,35 +535,16 @@ def llama_forward_sp(
     """
     from langstream_tpu.parallel.ring import ring_attention, ulysses_attention
 
-    c = config
-    B, S = tokens.shape
     attn_fn = {"ring": ring_attention, "ulysses": ulysses_attention}[attn]
     kwargs = {} if attn == "ulysses" else {"head_axis": "tp"}
-    x_spec = NamedSharding(mesh, P("dp" if "dp" in mesh.axis_names else None,
-                                   "sp", None))
-    x = jnp.take(params["embed"], tokens, axis=0)
-    x = jax.lax.with_sharding_constraint(x, x_spec)
-    positions = jnp.arange(S)[None, :].repeat(B, axis=0)
-    cos, sin = _rope(positions, c.head_dim, c.rope_theta)
-
-    def layer(x, lp):
-        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
-        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
-        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
-        out = attn_fn(q, k, v, mesh, causal=True, **kwargs)
-        out = out.reshape(B, S, c.heads * c.head_dim)
-        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
-        h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
-        x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
-        x = jax.lax.with_sharding_constraint(x, x_spec)
-        return x, None
-
-    x, _ = jax.lax.scan(layer, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return jnp.einsum("bsh,hv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    x_spec = NamedSharding(
+        mesh, P("dp" if "dp" in mesh.axis_names else None, "sp", None)
+    )
+    return llama_forward(
+        config, params, tokens,
+        attention=lambda q, k, v: attn_fn(q, k, v, mesh, causal=True, **kwargs),
+        constrain=lambda x: jax.lax.with_sharding_constraint(x, x_spec),
+    )
 
 
 def param_count(config: LlamaConfig) -> int:

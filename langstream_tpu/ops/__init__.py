@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the serving hot ops.
+
+- :mod:`langstream_tpu.ops.flash_attention` — blocked causal GQA attention
+  (prefill/forward): O(S) memory instead of the O(S²) score matrix.
+
+Kernels run compiled on TPU and in interpret mode on CPU (tests).
+"""
+
+from langstream_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,221 @@
+"""Blocked (flash) causal GQA attention as a Pallas TPU kernel.
+
+Why a kernel: the einsum attention path materialises the full
+``(B, heads, S, S)`` float32 score matrix in HBM — at S=4k, B=8, 32 heads
+that is >16 GB of traffic per layer. This kernel streams K/V blocks through
+VMEM with an online-softmax accumulator, so HBM traffic is O(S·D) and the
+MXU sees back-to-back 128×128 tiles.
+
+Scope: inference prefill / forward (no custom VJP — the training paths keep
+the differentiable einsum attention). Causal masking only: for right-padded
+self-attention batches, causality alone already hides the padded keys from
+every real query row, so no per-row length input is needed (the engine
+discards logits of padded rows).
+
+Grid: ``(B, heads, num_q_blocks, num_k_blocks)`` with the K dimension
+innermost; the running max / sum / accumulator live in VMEM scratch across
+the K sweep and the output block is written on the last K step. Fully-masked
+K blocks (``k_start > q_end``) are skipped via ``pl.when``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _flash_kernel(
+    q_ref,      # (1, 1, block_q, D)
+    k_ref,      # (1, 1, block_k, D)
+    v_ref,      # (1, 1, block_k, D)
+    o_ref,      # (1, 1, block_q, D)
+    m_ref,      # VMEM (block_q, 128) f32 — running max (broadcast cols)
+    l_ref,      # VMEM (block_q, 128) f32 — running sum
+    acc_ref,    # VMEM (block_q, D) f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    kv_len: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1  # block not fully in the future
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0, 0]  # (block_q, D)
+        k = k_ref[0, 0]  # (block_k, D)
+        v = v_ref[0, 0]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k)
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        # kv_len bound hides right-padding from non-causal queries; the
+        # causal mask subsumes it for self-attention but is cheap to keep
+        mask = cols < kv_len
+        if causal:
+            mask = mask & (rows >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, 0]                       # (block_q,)
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(m_new <= NEG_INF, 0.0, m_new)  # NaN guard
+        p = jnp.exp(s - shift[:, None])
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - shift))
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        inv = jnp.where(l > 0.0, 1.0 / jnp.maximum(l, 1e-30), 0.0)
+        o_ref[0, 0] = (acc_ref[:] * inv[:, None]).astype(o_ref.dtype)
+
+
+def _flash_bhsd(
+    q: jax.Array,  # (B, H, Sq, D)
+    k: jax.Array,  # (B, Kh, Sk, D)
+    v: jax.Array,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    kv_len: int,
+    interpret: bool,
+) -> jax.Array:
+    B, H, Sq, D = q.shape
+    Kh, Sk = k.shape[1], k.shape[2]
+    group = H // Kh
+    grid = (B, H, pl.cdiv(Sq, block_q), pl.cdiv(Sk, block_k))
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        kv_len=kv_len,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, D),
+                lambda b, h, qi, ki: (b, h, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D),
+                lambda b, h, qi, ki, g=group: (b, h // g, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D),
+                lambda b, h, qi, ki, g=group: (b, h // g, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D),
+            lambda b, h, qi, ki: (b, h, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(
+    q: jax.Array,  # (B, Sq, H, D)
+    k: jax.Array,  # (B, Sk, Kh, D)
+    v: jax.Array,  # (B, Sk, Kh, D)
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    # 512-blocks measured ~2.2x faster than XLA dense attention at S=8k on
+    # v5e (and never slower down to S=1k); both clamp to the sequence length
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over ``(batch, seq, heads, head_dim)`` tensors.
+
+    GQA: ``H`` may be a multiple of ``Kh``. Sequences are padded up to the
+    block size internally (causal masking keeps padded keys invisible to
+    real queries in the self-attention case ``Sq == Sk``).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    if causal and Sq != Sk:
+        raise ValueError(
+            f"causal flash attention expects self-attention (Sq == Sk), got "
+            f"{Sq} vs {Sk}"
+        )
+    block_q = min(block_q, max(16, Sq))
+    block_k = min(block_k, max(16, Sk))
+    pad_q = (-Sq) % block_q
+    pad_k = (-Sk) % block_k
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # (B, H, Sq, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    out = _flash_bhsd(
+        qt, kt, vt,
+        scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=Sk, interpret=interpret,
+    )
+    if pad_q:
+        out = out[:, :, :Sq]
+    return jnp.transpose(out, (0, 2, 1, 3))

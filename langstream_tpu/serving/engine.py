@@ -263,7 +263,11 @@ class TpuServingEngine:
             def _prefill(params, cache_k, cache_v, tokens, lengths, slot_ids,
                          key, temps, topks, topps):
                 logits, ck, cv = llama_prefill(
-                    mc_static, params, tokens, lengths, cache_k, cache_v, slot_ids
+                    mc_static, params, tokens, lengths, cache_k, cache_v, slot_ids,
+                    # flash kernel only on the unsharded path: pallas_call has
+                    # no SPMD partition rule, so under a mesh XLA would
+                    # replicate it per chip instead of sharding heads
+                    use_flash=False if self.mesh is not None else None,
                 )
                 next_tokens, logprobs = sample_tokens(
                     logits, key, temps, topks, use_top_p=use_top_p, top_ps=topps
