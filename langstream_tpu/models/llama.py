@@ -488,10 +488,10 @@ def llama_forward(
     c = config
     B, S = tokens.shape
     if attention is None:
-        from langstream_tpu.parallel.ring import _dense_attention
+        from langstream_tpu.parallel.ring import dense_attention
 
         attention = partial(
-            _dense_attention, causal=True, scale=1.0 / math.sqrt(c.head_dim)
+            dense_attention, causal=True, scale=1.0 / math.sqrt(c.head_dim)
         )
     if constrain is None:
         constrain = lambda x: x  # noqa: E731

@@ -1,0 +1,112 @@
+"""Native components: build + process management for the tpustream broker.
+
+The C++ broker (``tsbroker.cc``) is compiled on demand with the system
+toolchain and cached next to the source; a content hash keyed cache makes
+rebuilds automatic when the source changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+BROKER_SOURCE = _HERE / "tsbroker.cc"
+_BIN_DIR = _HERE / "bin"
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def toolchain_available() -> bool:
+    return shutil.which("g++") is not None
+
+
+def ensure_broker_binary() -> Path:
+    """Compile (or reuse a cached) tsbroker binary; returns its path."""
+    source = BROKER_SOURCE.read_bytes()
+    digest = hashlib.sha256(source).hexdigest()[:16]
+    binary = _BIN_DIR / f"tsbroker-{digest}"
+    if binary.exists():
+        return binary
+    if not toolchain_available():
+        raise NativeBuildError("g++ not found; cannot build tsbroker")
+    _BIN_DIR.mkdir(parents=True, exist_ok=True)
+    # Build to a temp name then rename: concurrent builders race benignly.
+    fd, tmp = tempfile.mkstemp(prefix="tsbroker-", dir=_BIN_DIR)
+    os.close(fd)
+    try:
+        proc = subprocess.run(
+            ["g++", "-std=c++17", "-O2", "-o", tmp, str(BROKER_SOURCE)],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise NativeBuildError(f"tsbroker build failed:\n{proc.stderr}")
+        os.chmod(tmp, 0o755)
+        os.replace(tmp, binary)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    # Prune stale cached builds.
+    for old in _BIN_DIR.glob("tsbroker-*"):
+        if old != binary:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    return binary
+
+
+class BrokerProcess:
+    """Launches a tsbroker subprocess and reports its port.
+
+    Used by the dev-mode runner (the reference's embedded Kafka/Kraft in the
+    runtime-tester image, ``langstream-runtime-tester/src/main/docker/
+    Dockerfile:23-40``) and by tests.
+    """
+
+    def __init__(self, port: int = 0, data_dir: str | None = None,
+                 host: str = "127.0.0.1"):
+        self.host = host
+        self._requested_port = port
+        self.data_dir = data_dir
+        self.port: int | None = None
+        self.proc: subprocess.Popen | None = None
+
+    def start(self) -> "BrokerProcess":
+        binary = ensure_broker_binary()
+        cmd = [str(binary), "--host", self.host, "--port",
+               str(self._requested_port)]
+        if self.data_dir:
+            cmd += ["--data-dir", self.data_dir]
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+        )
+        line = self.proc.stdout.readline().strip()
+        if not line.startswith("LISTENING "):
+            self.stop()
+            raise NativeBuildError(f"tsbroker failed to start: {line!r}")
+        self.port = int(line.split()[1])
+        return self
+
+    def stop(self) -> None:
+        if self.proc is not None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+            self.proc = None
+
+    def __enter__(self) -> "BrokerProcess":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -166,21 +166,40 @@ def ring_attention(
 # ---------------------------------------------------------------------------
 
 
-def _dense_attention(q, k, v, *, causal: bool, scale: float, q_offset=0):
-    """Reference dense GQA attention. q: (B, Sq, H, D); k/v: (B, Sk, Kh, D)."""
+def dense_attention(q, k, v, *, causal: bool, scale: float, q_offset=0):
+    """Dense GQA attention. q: (B, Sq, H, D); k/v: (B, Sk, Kh, D).
+
+    Matmuls run in the input dtype (bf16 on the model path — full MXU rate)
+    with f32 accumulation via ``preferred_element_type``; only the softmax
+    itself is f32.
+    """
     B, Sq, H, D = q.shape
     Sk, Kh = k.shape[1], k.shape[2]
     G = H // Kh
-    qg = (q.astype(jnp.float32) * scale).reshape(B, Sq, Kh, G, D)
-    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k.astype(jnp.float32))
+    qg = q.reshape(B, Sq, Kh, G, D)
+    scores = (
+        jnp.einsum(
+            "bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     if causal:
         mask = (q_offset + jnp.arange(Sq))[:, None] >= jnp.arange(Sk)[None, :]
         scores = jnp.where(
             mask[None, None, None], scores, jnp.finfo(jnp.float32).min
         )
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bkgqd", probs, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bkgqs,bskd->bkgqd",
+        probs.astype(q.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D).astype(q.dtype)
+
+
+# Backwards-compatible private alias (pre-public-API name).
+_dense_attention = dense_attention
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
@@ -198,7 +217,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = _dense_attention(q, k, v, causal=causal, scale=scale)
+    out = dense_attention(q, k, v, causal=causal, scale=scale)
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 

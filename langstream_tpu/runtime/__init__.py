@@ -14,6 +14,11 @@ from langstream_tpu.runtime.memory_broker import MemoryTopicConnectionsRuntime
 
 TopicConnectionsRuntimeRegistry.register("memory", MemoryTopicConnectionsRuntime)
 
+# ``tpustream`` — the in-tree native C++ broker (langstream_tpu/native/
+# tsbroker.cc) speaking its own wire protocol; the framework's first-party
+# answer to the reference's external Kafka cluster.
+from langstream_tpu.runtime.tsb import TsbTopicConnectionsRuntime  # noqa: E402,F401
+
 try:  # pragma: no cover - kafka client not in the image
     import confluent_kafka  # noqa: F401
 

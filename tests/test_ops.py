@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from langstream_tpu.ops.flash_attention import flash_attention
-from langstream_tpu.parallel.ring import _dense_attention
+from langstream_tpu.parallel.ring import dense_attention
 
 
 def _qkv(B=2, S=64, H=8, Kh=4, D=32, seed=0, dtype=jnp.float32):
@@ -21,7 +21,7 @@ def _qkv(B=2, S=64, H=8, Kh=4, D=32, seed=0, dtype=jnp.float32):
 def test_flash_matches_dense(causal):
     q, k, v = _qkv()
     scale = 1.0 / np.sqrt(q.shape[-1])
-    want = _dense_attention(q, k, v, causal=causal, scale=scale)
+    want = dense_attention(q, k, v, causal=causal, scale=scale)
     got = flash_attention(
         q, k, v, causal=causal, block_q=32, block_k=32, interpret=True
     )
@@ -32,7 +32,7 @@ def test_flash_unaligned_seq_padding():
     # S not a multiple of the block: wrapper pads, causal hides the padding
     q, k, v = _qkv(S=48)
     scale = 1.0 / np.sqrt(q.shape[-1])
-    want = _dense_attention(q, k, v, causal=True, scale=scale)
+    want = dense_attention(q, k, v, causal=True, scale=scale)
     got = flash_attention(
         q, k, v, causal=True, block_q=32, block_k=32, interpret=True
     )
@@ -43,7 +43,7 @@ def test_flash_noncausal_padded_keys_masked():
     # non-causal + padding exercises the kv_len bound
     q, k, v = _qkv(S=40, H=4, Kh=4)
     scale = 1.0 / np.sqrt(q.shape[-1])
-    want = _dense_attention(q, k, v, causal=False, scale=scale)
+    want = dense_attention(q, k, v, causal=False, scale=scale)
     got = flash_attention(
         q, k, v, causal=False, block_q=32, block_k=32, interpret=True
     )
@@ -54,7 +54,7 @@ def test_flash_mqa_group_mapping():
     # 8 query heads on 2 KV heads: block index_map must hit the right group
     q, k, v = _qkv(H=8, Kh=2)
     scale = 1.0 / np.sqrt(q.shape[-1])
-    want = _dense_attention(q, k, v, causal=True, scale=scale)
+    want = dense_attention(q, k, v, causal=True, scale=scale)
     got = flash_attention(
         q, k, v, causal=True, block_q=32, block_k=32, interpret=True
     )
@@ -94,4 +94,7 @@ def test_llama_prefill_flash_matches_einsum(monkeypatch):
     for slot, n in enumerate(np.asarray(lengths)):
         np.testing.assert_allclose(
             np.asarray(gk)[:, slot, :n], np.asarray(wk)[:, slot, :n], atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(gv)[:, slot, :n], np.asarray(wv)[:, slot, :n], atol=1e-5
         )

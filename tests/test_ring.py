@@ -18,7 +18,7 @@ from langstream_tpu.models.llama import (
 )
 from langstream_tpu.parallel.mesh import make_mesh
 from langstream_tpu.parallel.ring import (
-    _dense_attention,
+    dense_attention,
     ring_attention,
     ulysses_attention,
 )
@@ -37,7 +37,7 @@ def test_ring_attention_matches_dense(causal):
     q, k, v = _qkv()
     mesh = make_mesh({"dp": 2, "sp": 4})
     scale = 1.0 / np.sqrt(q.shape[-1])
-    want = _dense_attention(q, k, v, causal=causal, scale=scale)
+    want = dense_attention(q, k, v, causal=causal, scale=scale)
     got = ring_attention(q, k, v, mesh, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
@@ -46,7 +46,7 @@ def test_ring_attention_with_tensor_parallel_heads():
     q, k, v = _qkv(H=8, Kh=2)
     mesh = make_mesh({"sp": 4, "tp": 2})
     scale = 1.0 / np.sqrt(q.shape[-1])
-    want = _dense_attention(q, k, v, causal=True, scale=scale)
+    want = dense_attention(q, k, v, causal=True, scale=scale)
     got = ring_attention(q, k, v, mesh, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
@@ -56,7 +56,7 @@ def test_ulysses_matches_dense(Kh):
     q, k, v = _qkv(H=8, Kh=Kh)
     mesh = make_mesh({"dp": 2, "sp": 4})
     scale = 1.0 / np.sqrt(q.shape[-1])
-    want = _dense_attention(q, k, v, causal=True, scale=scale)
+    want = dense_attention(q, k, v, causal=True, scale=scale)
     got = ulysses_attention(q, k, v, mesh, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
