@@ -1,0 +1,428 @@
+"""Kafka runtime semantics against a fake client.
+
+The image has no Kafka client library; these tests inject a fake
+``confluent_kafka`` into ``sys.modules`` and verify the adapter's *semantics*
+— the part the reference unit-tests in ``KafkaConsumerTest.java``:
+out-of-order acknowledgement with contiguous-prefix commits, serializer
+inference, rebalance redelivery accounting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import types
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# Fake confluent_kafka
+# ---------------------------------------------------------------------------
+
+
+class FakeTopicPartition:
+    def __init__(self, topic, partition, offset=-1001):
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+
+    def __repr__(self):
+        return f"TP({self.topic}[{self.partition}]@{self.offset})"
+
+
+class FakeMessage:
+    def __init__(self, topic, partition, offset, value=None, key=None, headers=None):
+        self._topic, self._partition, self._offset = topic, partition, offset
+        self._value, self._key, self._headers = value, key, headers or []
+
+    def topic(self):
+        return self._topic
+
+    def partition(self):
+        return self._partition
+
+    def offset(self):
+        return self._offset
+
+    def value(self):
+        return self._value
+
+    def key(self):
+        return self._key
+
+    def headers(self):
+        return self._headers
+
+    def timestamp(self):
+        return (1, 1700000000000)
+
+    def error(self):
+        return None
+
+
+class FakeConsumer:
+    def __init__(self, conf):
+        self.conf = conf
+        self.queue: list[FakeMessage] = []
+        self.commits: list[list[FakeTopicPartition]] = []
+        self.on_assign = None
+        self.on_revoke = None
+        self.assigned = []
+        self.closed = False
+
+    def subscribe(self, topics, on_assign=None, on_revoke=None):
+        self.on_assign = on_assign
+        self.on_revoke = on_revoke
+        tps = [FakeTopicPartition(t, 0, -1001) for t in topics]
+        self.assigned = tps
+        if on_assign:
+            on_assign(self, tps)
+
+    def consume(self, num, timeout):
+        batch, self.queue = self.queue[:num], self.queue[num:]
+        return batch
+
+    def commit(self, offsets=None, asynchronous=True):
+        self.commits.append(offsets)
+
+    def close(self):
+        self.closed = True
+
+    # reader API
+    def list_topics(self, topic, timeout=None):
+        md = types.SimpleNamespace(
+            topics={topic: types.SimpleNamespace(partitions={0: None, 1: None})}
+        )
+        return md
+
+    def get_watermark_offsets(self, tp, timeout=None):
+        return (2, 7)
+
+    def assign(self, tps):
+        self.assigned = tps
+
+
+class FakeProducer:
+    def __init__(self, conf):
+        self.conf = conf
+        self.sent = []
+        self._pending = []
+
+    def produce(self, topic, value=None, key=None, headers=None, on_delivery=None):
+        self.sent.append((topic, value, key, headers))
+        if on_delivery:
+            self._pending.append(on_delivery)
+
+    def poll(self, timeout):
+        pending, self._pending = self._pending, []
+        for cb in pending:
+            cb(None, None)
+        return len(pending)
+
+    def flush(self):
+        self.poll(0)
+
+
+class FakeKafkaError(Exception):
+    _PARTITION_EOF = -191
+
+
+@pytest.fixture()
+def fake_kafka(monkeypatch):
+    mod = types.ModuleType("confluent_kafka")
+    mod.Consumer = FakeConsumer
+    mod.Producer = FakeProducer
+    mod.TopicPartition = FakeTopicPartition
+    mod.KafkaError = FakeKafkaError
+    admin = types.ModuleType("confluent_kafka.admin")
+
+    class FakeAdminClient:
+        created, deleted = [], []
+
+        def __init__(self, conf):
+            pass
+
+        def create_topics(self, topics):
+            FakeAdminClient.created.extend(topics)
+            fut = types.SimpleNamespace(result=lambda: None)
+            return {t.topic: fut for t in topics}
+
+        def delete_topics(self, names):
+            FakeAdminClient.deleted.extend(names)
+            fut = types.SimpleNamespace(result=lambda: None)
+            return {n: fut for n in names}
+
+    class FakeNewTopic:
+        def __init__(self, topic, num_partitions=1, replication_factor=1):
+            self.topic = topic
+            self.num_partitions = num_partitions
+            self.replication_factor = replication_factor
+
+    admin.AdminClient = FakeAdminClient
+    admin.NewTopic = FakeNewTopic
+    mod.admin = admin
+    monkeypatch.setitem(sys.modules, "confluent_kafka", mod)
+    monkeypatch.setitem(sys.modules, "confluent_kafka.admin", admin)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Pure tracker semantics
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_contiguous_prefix_only():
+    from langstream_tpu.runtime.kafka_broker import ContiguousOffsetTracker
+
+    t = ContiguousOffsetTracker()
+    t.start_partition("in", 0, 0)
+    for off in range(5):
+        t.delivered("in", 0, off)
+    # acks arrive out of order: 2, 1 → no commit yet (0 still pending)
+    assert t.acknowledge("in", 0, 2) is None
+    assert t.acknowledge("in", 0, 1) is None
+    assert t.pending("in", 0) == 3
+    # ack 0 → prefix [0,1,2] done → position 3
+    assert t.acknowledge("in", 0, 0) == 3
+    # ack 4 → gap at 3 → no advance
+    assert t.acknowledge("in", 0, 4) is None
+    assert t.acknowledge("in", 0, 3) == 5
+    assert t.pending("in", 0) == 0
+
+
+def test_tracker_duplicate_and_stale_acks():
+    from langstream_tpu.runtime.kafka_broker import ContiguousOffsetTracker
+
+    t = ContiguousOffsetTracker()
+    t.start_partition("in", 0, 10)
+    t.delivered("in", 0, 10)
+    assert t.acknowledge("in", 0, 9) is None  # below committed position
+    assert t.acknowledge("in", 0, 10) == 11
+    assert t.acknowledge("in", 0, 10) is None  # duplicate ack is a no-op
+
+
+# ---------------------------------------------------------------------------
+# Consumer wrapper
+# ---------------------------------------------------------------------------
+
+
+def _consumer(fake_kafka, **kw):
+    from langstream_tpu.runtime.kafka_broker import KafkaTopicConsumer
+
+    return KafkaTopicConsumer(
+        {"bootstrap.servers": "fake:9092"}, topic="in", group="app-agent", **kw
+    )
+
+
+def test_consumer_out_of_order_commit(fake_kafka):
+    async def run():
+        c = _consumer(fake_kafka)
+        await c.start()
+        fake = c._consumer
+        fake.queue = [
+            FakeMessage("in", 0, i, value=f"v{i}".encode()) for i in range(4)
+        ]
+        records = await c.read()
+        assert [r.value for r in records] == ["v0", "v1", "v2", "v3"]
+
+        # commit 2 and 3 first: no broker commit (0,1 outstanding)
+        await c.commit([records[2], records[3]])
+        assert fake.commits == []
+        # commit 0: prefix [0] → broker commit at position 1
+        await c.commit([records[0]])
+        assert len(fake.commits) == 1
+        (tp,) = fake.commits[0]
+        assert (tp.topic, tp.partition, tp.offset) == ("in", 0, 1)
+        # commit 1: closes the gap → position 4
+        await c.commit([records[1]])
+        (tp,) = fake.commits[1]
+        assert tp.offset == 4
+        await c.close()
+        assert fake.closed
+
+    asyncio.run(run())
+
+
+def test_consumer_resume_past_offset_zero(fake_kafka):
+    """On a normal rebalance tp.offset is OFFSET_INVALID (-1001); the tracker
+    must adopt the first delivered offset (the group's committed position),
+    not 0 — otherwise commits wedge forever after a restart."""
+
+    async def run():
+        c = _consumer(fake_kafka)
+        await c.start()
+        fake = c._consumer
+        # group resumes at committed offset 100
+        fake.queue = [FakeMessage("in", 0, off) for off in (100, 101)]
+        records = await c.read()
+        await c.commit([records[1]])  # out of order: no commit yet
+        assert fake.commits == []
+        await c.commit([records[0]])
+        (tp,) = fake.commits[0]
+        assert tp.offset == 102
+
+    asyncio.run(run())
+
+
+def test_consumer_rebalance_redelivery_accounting(fake_kafka):
+    async def run():
+        c = _consumer(fake_kafka)
+        await c.start()
+        fake = c._consumer
+        fake.queue = [FakeMessage("in", 0, i) for i in range(3)]
+        records = await c.read()
+        await c.commit([records[0]])
+        assert c.tracker.pending("in", 0) == 2
+        # revoke: in-flight records are dropped from tracking (they will be
+        # redelivered from the committed position to the next assignee)
+        fake.on_revoke(fake, [FakeTopicPartition("in", 0)])
+        assert c.tracker.pending("in", 0) == 0
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Producer serde inference
+# ---------------------------------------------------------------------------
+
+
+def test_producer_serializer_inference(fake_kafka):
+    from langstream_tpu.api.record import make_record
+    from langstream_tpu.api.topics import OFFSET_HEADER, TopicOffset
+    from langstream_tpu.runtime.kafka_broker import KafkaTopicProducer
+
+    async def run():
+        p = KafkaTopicProducer({"bootstrap.servers": "fake:9092"}, topic="out")
+        await p.start()
+        rec = make_record(
+            value={"answer": 42},
+            key="k1",
+            headers={
+                "session": "s-1",
+                OFFSET_HEADER: TopicOffset("in", 0, 7),
+            },
+        )
+        await p.write(rec)
+        topic, value, key, headers = p._producer.sent[0]
+        assert topic == "out"
+        assert json.loads(value) == {"answer": 42}
+        assert key == b"k1"
+        hdr_names = [h[0] for h in headers]
+        assert "session" in hdr_names and OFFSET_HEADER not in hdr_names
+        assert p.total_in() == 1
+        await p.close()
+
+    asyncio.run(run())
+
+
+def test_structured_values_and_headers_roundtrip(fake_kafka):
+    """dict values, typed headers and None headers survive the byte wire."""
+    from langstream_tpu.api.record import make_record
+    from langstream_tpu.runtime.kafka_broker import (
+        kafka_message_to_record,
+        record_headers_to_kafka,
+        serialize_datum_kind,
+        HEADER_KINDS_HEADER,
+        KEY_KIND_HEADER,
+        VALUE_KIND_HEADER,
+    )
+
+    rec = make_record(
+        value={"q": "hi"},
+        key=7,
+        headers={"retries": 3, "meta": {"a": 1}, "empty": None, "s": "x"},
+    )
+    value, vkind = serialize_datum_kind(rec.value)
+    key, kkind = serialize_datum_kind(rec.key)
+    headers = record_headers_to_kafka(rec)
+    headers.append((VALUE_KIND_HEADER, vkind.encode()))
+    headers.append((KEY_KIND_HEADER, kkind.encode()))
+    msg = FakeMessage("t", 0, 5, value=value, key=key, headers=headers)
+    out = kafka_message_to_record(msg)
+    assert out.value == {"q": "hi"}
+    assert out.key == 7
+    hdrs = out.header_map()
+    assert hdrs["retries"] == 3
+    assert hdrs["meta"] == {"a": 1}
+    assert hdrs["empty"] is None
+    assert hdrs["s"] == "x"
+    assert HEADER_KINDS_HEADER not in hdrs
+
+
+def test_serde_roundtrip_types():
+    from langstream_tpu.runtime.kafka_broker import (
+        deserialize_datum,
+        serialize_datum,
+    )
+
+    assert serialize_datum(None) is None
+    assert serialize_datum(b"\x00\x01") == b"\x00\x01"
+    assert serialize_datum("hi") == b"hi"
+    assert json.loads(serialize_datum([1, 2])) == [1, 2]
+    assert deserialize_datum(b"text") == "text"
+    assert deserialize_datum(b"\xff\xfe") == b"\xff\xfe"
+
+
+# ---------------------------------------------------------------------------
+# Reader + admin + registry
+# ---------------------------------------------------------------------------
+
+
+def test_reader_assigns_at_watermarks(fake_kafka):
+    from langstream_tpu.runtime.kafka_broker import KafkaTopicReader
+
+    async def run():
+        r = KafkaTopicReader(
+            {"bootstrap.servers": "fake:9092"}, "out", initial_position="latest"
+        )
+        await r.start()
+        offsets = {(tp.partition): tp.offset for tp in r._consumer.assigned}
+        assert offsets == {0: 7, 1: 7}  # high watermark
+        await r.close()
+
+        r2 = KafkaTopicReader(
+            {"bootstrap.servers": "fake:9092"}, "out", initial_position="earliest"
+        )
+        await r2.start()
+        offsets = {(tp.partition): tp.offset for tp in r2._consumer.assigned}
+        assert offsets == {0: 2, 1: 2}  # low watermark
+        await r2.close()
+
+    asyncio.run(run())
+
+
+def test_admin_create_delete(fake_kafka):
+    from langstream_tpu.runtime.kafka_broker import KafkaTopicAdmin
+
+    async def run():
+        admin = KafkaTopicAdmin({"bootstrap.servers": "fake:9092"})
+        await admin.create_topic("t1", partitions=4)
+        created = fake_kafka.admin.AdminClient.created
+        assert created[-1].topic == "t1" and created[-1].num_partitions == 4
+        await admin.delete_topic("t1")
+        assert fake_kafka.admin.AdminClient.deleted[-1] == "t1"
+
+    asyncio.run(run())
+
+
+def test_runtime_wires_configuration(fake_kafka):
+    from langstream_tpu.runtime.kafka_broker import KafkaTopicConnectionsRuntime
+
+    rt = KafkaTopicConnectionsRuntime()
+    rt.init(
+        {
+            "admin": {"bootstrap.servers": "broker:9092"},
+            "consumer": {"max.poll.records": 10},
+        }
+    )
+    c = rt.create_consumer("app-agent1", {"topic": "in"})
+    assert c._conf["bootstrap.servers"] == "broker:9092"
+    assert c._conf["max.poll.records"] == 10
+    assert c._conf["group.id"] == "app-agent1"
+    p = rt.create_producer("app-agent1", {"topic": "out"})
+    assert p._conf["bootstrap.servers"] == "broker:9092"
+    # dead-letter producer targets <topic>-deadletter
+    dl = rt.create_deadletter_producer("app-agent1", {"topic": "in"})
+    assert dl.topic == "in-deadletter"
