@@ -180,27 +180,48 @@ class ModelBuilder:
             secrets[secret.id] = secret
         self.application.secrets = Secrets(secrets=secrets)
 
-    # ---- directory entry point ------------------------------------------
+    # ---- named-file dispatch --------------------------------------------
+
+    def add_named_file(self, name: str, content: str) -> None:
+        """Route one application file by its reserved name (the single
+        dispatch point shared by the directory and in-memory entry points)."""
+        if name == "configuration.yaml":
+            self.add_configuration_file(content)
+        elif name == "gateways.yaml":
+            self.add_gateways_file(content)
+        elif name == "secrets.yaml":
+            self.add_secrets(content)
+        elif name == "instance.yaml":
+            self.add_instance(content)
+        elif name.endswith((".yaml", ".yml")):
+            self.add_pipeline_file(name, content)
 
     def add_application_directory(self, directory: Path | str) -> None:
         directory = Path(directory)
         if not directory.is_dir():
             raise ApplicationParseError(f"not a directory: {directory}")
         for path in sorted(directory.glob("*.yaml")) + sorted(directory.glob("*.yml")):
-            content = path.read_text()
-            if path.name == "configuration.yaml":
-                self.add_configuration_file(content)
-            elif path.name == "gateways.yaml":
-                self.add_gateways_file(content)
-            elif path.name == "secrets.yaml":
-                self.add_secrets(content)
-            elif path.name == "instance.yaml":
-                self.add_instance(content)
-            else:
-                self.add_pipeline_file(path.name, content)
+            self.add_named_file(path.name, path.read_text())
 
     def build(self) -> Application:
         return self.application
+
+
+def build_application_from_files(
+    files: dict[str, str],
+    instance: str | None = None,
+    secrets: str | None = None,
+) -> Application:
+    """Parse from an in-memory filename→content map (the shape stored by the
+    control plane and shipped to in-cluster setup/deployer Jobs)."""
+    builder = ModelBuilder()
+    for name in sorted(files):
+        builder.add_named_file(name, files[name])
+    if instance is not None:
+        builder.add_instance(instance)
+    if secrets is not None:
+        builder.add_secrets(secrets)
+    return builder.build()
 
 
 def build_application_from_directory(

@@ -1,0 +1,33 @@
+"""L3b/L5: the Kubernetes control & data plane.
+
+Parity map (reference → here):
+
+- CRD POJOs (``langstream-k8s-deployer-api/.../crds/*``)        → :mod:`crds`
+- ``AgentResourcesFactory`` / ``AppResourcesFactory``
+  (``langstream-k8s-deployer-core``)                             → :mod:`resources`
+- ``KubernetesClusterRuntime`` (``langstream-k8s-runtime``)      → :mod:`cluster_runtime`
+- operator reconcilers (``langstream-k8s-deployer-operator``)    → :mod:`operator`
+- app/metadata stores (``langstream-k8s-storage``)               → :mod:`stores`
+- ``SpecDiffer`` / limits checker                                → :mod:`diff`, :mod:`limits`
+- fabric8 client + ``KubeTestServer`` (``langstream-k8s-common``)→ :mod:`client`
+
+TPU-first departures: agent pods schedule onto GKE TPU node pools
+(``google.com/tpu`` resources, accelerator/topology node selectors derived
+from the agent's ``device-mesh``), and a multi-host ICI slice is one
+*logical* replica — the factory emits one StatefulSet per logical replica
+whose pods form the JAX distributed process group (coordinator = ordinal 0
+via the headless service), instead of the reference's replicas=parallelism
+single-host mapping.
+"""
+
+from langstream_tpu.k8s.client import InMemoryKubeApi, KubeApi
+from langstream_tpu.k8s.cluster_runtime import KubernetesClusterRuntime
+from langstream_tpu.k8s.crds import AgentCustomResource, ApplicationCustomResource
+
+__all__ = [
+    "AgentCustomResource",
+    "ApplicationCustomResource",
+    "InMemoryKubeApi",
+    "KubeApi",
+    "KubernetesClusterRuntime",
+]

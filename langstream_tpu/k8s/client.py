@@ -1,0 +1,264 @@
+"""Kubernetes API access: a thin typed-by-kind client facade.
+
+Parity: ``langstream-k8s-common`` (shared fabric8 client factory +
+``KubeTestServer`` mock). Everything above (deployer, operator, stores) codes
+against :class:`KubeApi`; tests and the dev-mode runner use
+:class:`InMemoryKubeApi` (the ``KubeTestServer`` role), real clusters use
+:class:`HttpKubeApi` — stdlib-only (urllib + in-cluster service-account
+auth), since no kubernetes client library is baked into the image.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.request
+from pathlib import Path
+from typing import Any, Callable
+
+# kind → (api prefix, plural, namespaced)
+KIND_ROUTES: dict[str, tuple[str, str, bool]] = {
+    "Application": ("/apis/langstream.tpu/v1alpha1", "applications", True),
+    "Agent": ("/apis/langstream.tpu/v1alpha1", "agents", True),
+    "Secret": ("/api/v1", "secrets", True),
+    "ConfigMap": ("/api/v1", "configmaps", True),
+    "Service": ("/api/v1", "services", True),
+    "Pod": ("/api/v1", "pods", True),
+    "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", True),
+    "Namespace": ("/api/v1", "namespaces", False),
+    "StatefulSet": ("/apis/apps/v1", "statefulsets", True),
+    "Job": ("/apis/batch/v1", "jobs", True),
+    "CustomResourceDefinition": (
+        "/apis/apiextensions.k8s.io/v1",
+        "customresourcedefinitions",
+        False,
+    ),
+}
+
+
+class KubeApi:
+    """Minimal CRUD surface the control/data-plane layers need."""
+
+    def get(self, kind: str, namespace: str | None, name: str) -> dict | None:
+        raise NotImplementedError
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict]:
+        raise NotImplementedError
+
+    def apply(self, obj: dict) -> dict:
+        """Create-or-replace by (kind, namespace, name)."""
+        raise NotImplementedError
+
+    def delete(self, kind: str, namespace: str | None, name: str) -> bool:
+        raise NotImplementedError
+
+    def update_status(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    # convenience
+    def exists(self, kind: str, namespace: str | None, name: str) -> bool:
+        return self.get(kind, namespace, name) is not None
+
+
+def _match_labels(obj: dict, selector: dict[str, str] | None) -> bool:
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class InMemoryKubeApi(KubeApi):
+    """The fake API server used by tests and `docker run` dev mode.
+
+    Keeps every applied object; records mutations in ``events`` so tests can
+    assert on CR writes the way the reference's ``KubeTestServer`` spies do.
+    Optional ``on_apply`` hooks let tests simulate controller behavior
+    (e.g. marking StatefulSets ready).
+    """
+
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str | None, str], dict] = {}
+        self.events: list[tuple[str, str, str | None, str]] = []  # op, kind, ns, name
+        self.on_apply: list[Callable[[dict], None]] = []
+
+    def _key(self, kind: str, namespace: str | None, name: str):
+        if kind not in KIND_ROUTES:
+            raise ValueError(f"unknown kind {kind!r}")
+        namespaced = KIND_ROUTES[kind][2]
+        return (kind, namespace if namespaced else None, name)
+
+    def get(self, kind: str, namespace: str | None, name: str) -> dict | None:
+        obj = self.objects.get(self._key(kind, namespace, name))
+        return json.loads(json.dumps(obj)) if obj is not None else None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict]:
+        out = []
+        for (k, ns, _), obj in self.objects.items():
+            if k != kind:
+                continue
+            if namespace is not None and ns != namespace:
+                continue
+            if _match_labels(obj, label_selector):
+                out.append(json.loads(json.dumps(obj)))
+        return out
+
+    def apply(self, obj: dict) -> dict:
+        kind = obj["kind"]
+        meta = obj.get("metadata") or {}
+        key = self._key(kind, meta.get("namespace"), meta["name"])
+        existing = self.objects.get(key)
+        if existing is not None and "status" not in obj and "status" in existing:
+            obj = {**obj, "status": existing["status"]}
+        self.objects[key] = json.loads(json.dumps(obj))
+        self.events.append(
+            ("apply", kind, meta.get("namespace"), meta["name"])
+        )
+        for hook in self.on_apply:
+            hook(self.objects[key])
+        return self.get(kind, meta.get("namespace"), meta["name"])
+
+    def delete(self, kind: str, namespace: str | None, name: str) -> bool:
+        key = self._key(kind, namespace, name)
+        existed = self.objects.pop(key, None) is not None
+        if existed:
+            self.events.append(("delete", kind, namespace, name))
+        return existed
+
+    def update_status(self, obj: dict) -> dict:
+        kind = obj["kind"]
+        meta = obj.get("metadata") or {}
+        key = self._key(kind, meta.get("namespace"), meta["name"])
+        if key not in self.objects:
+            raise KeyError(f"{kind}/{meta['name']} not found")
+        self.objects[key]["status"] = json.loads(json.dumps(obj.get("status") or {}))
+        self.events.append(("status", kind, meta.get("namespace"), meta["name"]))
+        return self.get(kind, meta.get("namespace"), meta["name"])
+
+    # test helpers (KubeTestServer.spyAgentCustomResources role)
+    def applied(self, kind: str) -> list[str]:
+        return [n for op, k, _, n in self.events if op == "apply" and k == kind]
+
+
+class HttpKubeApi(KubeApi):
+    """Real API server over stdlib HTTP.
+
+    In-cluster: reads the service-account token + CA from the standard
+    mount; out-of-cluster: pass ``base_url``/``token``/``ca_file`` directly.
+    """
+
+    SA_DIR = Path("/var/run/secrets/kubernetes.io/serviceaccount")
+
+    def __init__(
+        self,
+        base_url: str,
+        token: str | None = None,
+        ca_file: str | None = None,
+        insecure: bool = False,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        if insecure:
+            self.ssl_context = ssl._create_unverified_context()
+        elif ca_file:
+            self.ssl_context = ssl.create_default_context(cafile=ca_file)
+        else:
+            self.ssl_context = ssl.create_default_context()
+
+    @classmethod
+    def in_cluster(cls) -> "HttpKubeApi":
+        import os
+
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token = (cls.SA_DIR / "token").read_text().strip()
+        return cls(
+            f"https://{host}:{port}",
+            token=token,
+            ca_file=str(cls.SA_DIR / "ca.crt"),
+        )
+
+    def _url(self, kind: str, namespace: str | None, name: str | None = None) -> str:
+        prefix, plural, namespaced = KIND_ROUTES[kind]
+        parts = [self.base_url, prefix.lstrip("/")]
+        if namespaced and namespace:
+            parts += ["namespaces", namespace]
+        parts.append(plural)
+        if name:
+            parts.append(name)
+        return "/".join(parts)
+
+    def _request(
+        self, method: str, url: str, body: dict | None = None
+    ) -> dict | None:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self.ssl_context) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else None
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise RuntimeError(
+                f"kube api {method} {url} failed: {e.code} {e.read()[:500]!r}"
+            ) from e
+
+    def get(self, kind: str, namespace: str | None, name: str) -> dict | None:
+        return self._request("GET", self._url(kind, namespace, name))
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict]:
+        url = self._url(kind, namespace)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            url += f"?labelSelector={urllib.request.quote(sel)}"
+        result = self._request("GET", url) or {}
+        return result.get("items", [])
+
+    def apply(self, obj: dict) -> dict:
+        kind = obj["kind"]
+        meta = obj["metadata"]
+        namespace, name = meta.get("namespace"), meta["name"]
+        existing = self.get(kind, namespace, name)
+        if existing is None:
+            return self._request("POST", self._url(kind, namespace), obj)
+        # deep-copy before injecting resourceVersion: the caller's manifest
+        # must stay reusable (a stale resourceVersion poisons later applies)
+        obj = json.loads(json.dumps(obj))
+        obj.setdefault("metadata", {})["resourceVersion"] = existing["metadata"][
+            "resourceVersion"
+        ]
+        return self._request("PUT", self._url(kind, namespace, name), obj)
+
+    def delete(self, kind: str, namespace: str | None, name: str) -> bool:
+        return (
+            self._request("DELETE", self._url(kind, namespace, name)) is not None
+        )
+
+    def update_status(self, obj: dict) -> dict:
+        kind = obj["kind"]
+        meta = obj["metadata"]
+        url = self._url(kind, meta.get("namespace"), meta["name"]) + "/status"
+        current = self.get(kind, meta.get("namespace"), meta["name"]) or {}
+        merged = {**current, "status": obj.get("status") or {}}
+        return self._request("PUT", url, merged)

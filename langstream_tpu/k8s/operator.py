@@ -1,0 +1,252 @@
+"""The operator: reconcilers for Application and Agent CRs.
+
+Parity: ``langstream-k8s-deployer-operator`` —
+``AppController.reconcile`` (Application CR → setup Job, then deployer Job;
+``controllers/apps/AppController.java:54,314``) and
+``AgentController.reconcile`` (Agent CR → StatefulSet(s) + headless Service,
+status DEPLOYING/DEPLOYED from STS readiness;
+``controllers/agents/AgentController.java:49-92``), with infinite retry
+(``InfiniteRetry.java``) expressed as a poll loop that never gives up on a
+failing resource.
+
+The reconcilers are pure functions of (CR, cluster state) → (writes, status),
+so they run identically against :class:`InMemoryKubeApi` in tests and
+:class:`HttpKubeApi` in a cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Any
+
+from langstream_tpu.k8s.client import KubeApi
+from langstream_tpu.k8s.crds import AgentCustomResource, ApplicationCustomResource
+from langstream_tpu.k8s.resources import AgentResourcesFactory, AppResourcesFactory
+
+log = logging.getLogger(__name__)
+
+# Application/Agent lifecycle statuses (parity: ApplicationLifecycleStatus)
+DEPLOYING = "DEPLOYING"
+DEPLOYED = "DEPLOYED"
+ERROR_DEPLOYING = "ERROR_DEPLOYING"
+DELETING = "DELETING"
+
+
+class AgentController:
+    """Agent CR → StatefulSet(s) + headless Service; status from readiness."""
+
+    def __init__(self, api: KubeApi, accelerator: str = "v5e"):
+        self.api = api
+        self.accelerator = accelerator
+
+    def reconcile(self, cr_dict: dict[str, Any]) -> str:
+        cr = AgentCustomResource.from_dict(cr_dict)
+        service = AgentResourcesFactory.generate_headless_service(cr)
+        self.api.apply(service)
+        statefulsets = AgentResourcesFactory.generate_statefulsets(
+            cr, accelerator=self.accelerator
+        )
+        # prune StatefulSets from a previous shape (e.g. parallelism shrank
+        # or the agent moved between single- and multi-host)
+        wanted = {sts["metadata"]["name"] for sts in statefulsets}
+        existing = self.api.list(
+            "StatefulSet",
+            cr.namespace,
+            label_selector={
+                "langstream-application": cr.spec.application_id,
+                "langstream-agent": cr.spec.agent_id,
+            },
+        )
+        for sts in existing:
+            if sts["metadata"]["name"] not in wanted:
+                self.api.delete("StatefulSet", cr.namespace, sts["metadata"]["name"])
+        ready = True
+        for sts in statefulsets:
+            applied = self.api.apply(sts)
+            status = (applied or {}).get("status") or {}
+            if status.get("readyReplicas", 0) < sts["spec"]["replicas"]:
+                ready = False
+        phase = DEPLOYED if ready else DEPLOYING
+        cr_dict = {**cr_dict, "status": {**cr.status, "status": phase}}
+        self.api.update_status(cr_dict)
+        return phase
+
+    def cleanup(self, cr_dict: dict[str, Any]) -> None:
+        cr = AgentCustomResource.from_dict(cr_dict)
+        for sts in self.api.list(
+            "StatefulSet",
+            cr.namespace,
+            label_selector={
+                "langstream-application": cr.spec.application_id,
+                "langstream-agent": cr.spec.agent_id,
+            },
+        ):
+            self.api.delete("StatefulSet", cr.namespace, sts["metadata"]["name"])
+        name = AgentResourcesFactory.agent_resource_name(
+            cr.spec.application_id, cr.spec.agent_id
+        )
+        self.api.delete("Service", cr.namespace, name)
+
+
+class AppController:
+    """Application CR → setup Job → deployer Job (two-phase deploy)."""
+
+    def __init__(self, api: KubeApi):
+        self.api = api
+
+    def _ensure_app_config_secret(self, cr: ApplicationCustomResource) -> str:
+        """Materialize the config document the setup/deployer Jobs mount:
+        the parsed files + instance from the Application CR, the secrets
+        YAML from the companion ``<app>-secrets`` Secret, and code-storage
+        coordinates (what :func:`runtime.pod.run_setup`/``run_deployer``
+        read)."""
+        name = f"{cr.name}-app-config"
+        payload = json.loads(cr.spec.application or "{}")
+        secrets_yaml = None
+        secrets_obj = self.api.get("Secret", cr.namespace, f"{cr.name}-secrets")
+        if secrets_obj is not None:
+            raw = (secrets_obj.get("data") or {}).get("secrets", "")
+            secrets_yaml = base64.b64decode(raw).decode() if raw else None
+        config = {
+            "applicationId": cr.name,
+            "tenant": cr.spec.tenant,
+            "image": cr.spec.image,
+            "files": payload.get("files") or {},
+            "instance": payload.get("instance"),
+            "secrets": secrets_yaml,
+            "codeArchiveId": cr.spec.code_archive_id,
+            "codeStorage": (cr.spec.options or {}).get("codeStorage") or {},
+        }
+        self.api.apply(
+            {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {
+                    "name": name,
+                    "namespace": cr.namespace,
+                    "labels": {"langstream-application": cr.name},
+                },
+                "data": {
+                    "config": base64.b64encode(
+                        json.dumps(config).encode()
+                    ).decode()
+                },
+            }
+        )
+        return name
+
+    def reconcile(self, cr_dict: dict[str, Any]) -> str:
+        cr = ApplicationCustomResource.from_dict(cr_dict)
+        image = cr.spec.image
+        config_secret = self._ensure_app_config_secret(cr)
+        setup_job = AppResourcesFactory.generate_setup_job(
+            cr.spec.tenant, cr.name, cr.namespace, image, config_secret
+        )
+        existing_setup = self.api.get(
+            "Job", cr.namespace, setup_job["metadata"]["name"]
+        )
+        if existing_setup is None:
+            self.api.apply(setup_job)
+            return self._set_status(cr_dict, DEPLOYING, "setup job created")
+        if not _job_succeeded(existing_setup):
+            return self._set_status(cr_dict, DEPLOYING, "waiting for setup job")
+
+        deployer_job = AppResourcesFactory.generate_deployer_job(
+            cr.spec.tenant, cr.name, cr.namespace, image, config_secret
+        )
+        existing_deployer = self.api.get(
+            "Job", cr.namespace, deployer_job["metadata"]["name"]
+        )
+        if existing_deployer is None:
+            self.api.apply(deployer_job)
+            return self._set_status(cr_dict, DEPLOYING, "deployer job created")
+        if not _job_succeeded(existing_deployer):
+            return self._set_status(cr_dict, DEPLOYING, "waiting for deployer job")
+        return self._set_status(cr_dict, DEPLOYED, "deployed")
+
+    def cleanup(self, cr_dict: dict[str, Any]) -> str:
+        """Delete path: run the deployer job with ``delete`` to tear down
+        Agent CRs, then remove the jobs."""
+        cr = ApplicationCustomResource.from_dict(cr_dict)
+        config_secret = f"{cr.name}-app-config"
+        delete_job = AppResourcesFactory.generate_deployer_job(
+            cr.spec.tenant, cr.name, cr.namespace, cr.spec.image,
+            config_secret, delete=True,
+        )
+        existing = self.api.get("Job", cr.namespace, delete_job["metadata"]["name"])
+        if existing is None:
+            self.api.apply(delete_job)
+            return DELETING
+        if not _job_succeeded(existing):
+            return DELETING
+        for job in (
+            f"langstream-runtime-setup-{cr.name}",
+            f"langstream-runtime-deployer-deploy-{cr.name}",
+            delete_job["metadata"]["name"],
+        ):
+            self.api.delete("Job", cr.namespace, job)
+        return "DELETED"
+
+    def _set_status(self, cr_dict: dict[str, Any], phase: str, reason: str) -> str:
+        self.api.update_status(
+            {**cr_dict, "status": {"status": phase, "reason": reason}}
+        )
+        return phase
+
+
+def _job_succeeded(job: dict[str, Any]) -> bool:
+    return ((job.get("status") or {}).get("succeeded") or 0) >= 1
+
+
+class Operator:
+    """Poll-based reconcile loop over all namespaces.
+
+    The reference uses informer-driven reconciliation with leader election;
+    here a single loop lists CRs on an interval — the reconcilers themselves
+    are level-triggered and idempotent, so missed events only cost latency.
+    Infinite retry: reconcile failures are logged and retried next tick.
+    """
+
+    def __init__(
+        self,
+        api: KubeApi,
+        interval: float = 2.0,
+        accelerator: str = "v5e",
+    ):
+        self.api = api
+        self.interval = interval
+        self.apps = AppController(api)
+        self.agents = AgentController(api, accelerator=accelerator)
+        self._stop = asyncio.Event()
+
+    def reconcile_once(self) -> dict[str, str]:
+        statuses: dict[str, str] = {}
+        for cr in self.api.list("Application"):
+            name = cr["metadata"]["name"]
+            try:
+                statuses[f"app/{name}"] = self.apps.reconcile(cr)
+            except Exception as e:  # infinite retry: next tick
+                log.exception("app reconcile failed for %s", name)
+                statuses[f"app/{name}"] = f"RETRY: {e}"
+        for cr in self.api.list("Agent"):
+            name = cr["metadata"]["name"]
+            try:
+                statuses[f"agent/{name}"] = self.agents.reconcile(cr)
+            except Exception as e:
+                log.exception("agent reconcile failed for %s", name)
+                statuses[f"agent/{name}"] = f"RETRY: {e}"
+        return statuses
+
+    async def run(self) -> None:
+        while not self._stop.is_set():
+            self.reconcile_once()
+            try:
+                await asyncio.wait_for(self._stop.wait(), timeout=self.interval)
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,396 @@
+"""Manifest factories: CR → StatefulSet/Service/Job dicts.
+
+Parity: ``AgentResourcesFactory.generateStatefulSet``
+(``langstream-k8s-deployer-core/.../agents/AgentResourcesFactory.java:138``)
+— init container ``agent-code-download`` (``:201``), main container
+``agent-runtime`` (``:277``), PVC templates for agent disks, headless Service
+per agent (``:98``) — and ``AppResourcesFactory.generateSetupJob`` /
+``generateDeployerJob`` (``.../apps/AppResourcesFactory.java:231,76``).
+
+TPU-first scheduling (the departure from the reference):
+
+- an agent whose ``resources.device-mesh`` is set gets GKE TPU node-pool
+  placement: ``google.com/tpu`` chip requests plus
+  ``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology`` selectors
+  derived from the mesh's chip count;
+- a mesh larger than one host's chips makes the logical replica a
+  *multi-host slice*: the factory emits one StatefulSet per logical replica
+  whose ``hosts`` pods form a JAX distributed process group — ordinal 0 is
+  the coordinator, discovered through the headless service; the pod
+  entrypoint turns ordinals into ``jax.distributed.initialize`` arguments.
+  Data-parallel fan-out (``parallelism``) stays partition-based, exactly like
+  the reference's replicas.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from langstream_tpu.k8s.crds import AgentCustomResource
+
+AGENT_PORT = 8080  # /metrics + /info (parity: AgentRunner.java:96-110)
+COORDINATOR_PORT = 8476  # jax.distributed coordinator
+
+
+# accelerator → (GKE accelerator label, chips per host, topology by chips)
+TPU_TOPOLOGIES: dict[str, tuple[str, int, dict[int, str]]] = {
+    "v5e": (
+        "tpu-v5-lite-podslice",
+        4,
+        {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8", 64: "8x8",
+         128: "8x16", 256: "16x16"},
+    ),
+    "v5p": (
+        "tpu-v5p-slice",
+        4,
+        {4: "2x2x1", 8: "2x2x2", 16: "2x2x4", 32: "2x4x4", 64: "4x4x4",
+         128: "4x4x8", 256: "4x8x8"},
+    ),
+    "v4": (
+        "tpu-v4-podslice",
+        4,
+        {4: "2x2x1", 8: "2x2x2", 16: "2x2x4", 32: "2x4x4", 64: "4x4x4"},
+    ),
+}
+
+
+def mesh_chips(device_mesh: dict[str, int] | None) -> int:
+    chips = 1
+    for axis_size in (device_mesh or {}).values():
+        chips *= int(axis_size)
+    return chips if device_mesh else 0
+
+
+def tpu_placement(accelerator: str, chips: int) -> dict[str, Any]:
+    """Node selectors + per-pod chip request for one slice of ``chips``."""
+    if accelerator not in TPU_TOPOLOGIES:
+        raise ValueError(
+            f"unknown TPU accelerator {accelerator!r}; known: "
+            f"{sorted(TPU_TOPOLOGIES)}"
+        )
+    label, chips_per_host, topologies = TPU_TOPOLOGIES[accelerator]
+    if chips not in topologies:
+        raise ValueError(
+            f"no {accelerator} topology for {chips} chips; available: "
+            f"{sorted(topologies)}"
+        )
+    hosts = max(1, chips // chips_per_host)
+    return {
+        "hosts": hosts,
+        "chips_per_pod": min(chips, chips_per_host),
+        "node_selector": {
+            "cloud.google.com/gke-tpu-accelerator": label,
+            "cloud.google.com/gke-tpu-topology": topologies[chips],
+        },
+    }
+
+
+class AgentResourcesFactory:
+    """Turns one Agent CR into StatefulSet(s) + headless Service manifests."""
+
+    @staticmethod
+    def agent_resource_name(application_id: str, agent_id: str) -> str:
+        return f"{application_id}-{agent_id}".lower().replace("_", "-")
+
+    @classmethod
+    def generate_headless_service(cls, cr: AgentCustomResource) -> dict[str, Any]:
+        name = cls.agent_resource_name(cr.spec.application_id, cr.spec.agent_id)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "namespace": cr.namespace,
+                "labels": _agent_labels(cr),
+            },
+            "spec": {
+                "clusterIP": "None",
+                "selector": _agent_labels(cr),
+                "ports": [
+                    {"name": "http", "port": AGENT_PORT},
+                    {"name": "coordinator", "port": COORDINATOR_PORT},
+                ],
+            },
+        }
+
+    @classmethod
+    def generate_statefulsets(
+        cls,
+        cr: AgentCustomResource,
+        accelerator: str = "v5e",
+        image_pull_policy: str = "IfNotPresent",
+    ) -> list[dict[str, Any]]:
+        """One STS for single-host agents (replicas = parallelism); one STS
+        *per logical replica* for multi-host slices (replicas = hosts)."""
+        chips = mesh_chips(cr.spec.resources.device_mesh)
+        parallelism = max(1, cr.spec.resources.parallelism)
+        base = cls.agent_resource_name(cr.spec.application_id, cr.spec.agent_id)
+        service = base
+
+        if chips == 0:
+            return [
+                cls._statefulset(
+                    cr, name=base, service=service, replicas=parallelism,
+                    placement=None, image_pull_policy=image_pull_policy,
+                    logical_replica=None,
+                )
+            ]
+
+        placement = tpu_placement(accelerator, chips)
+        if placement["hosts"] == 1:
+            return [
+                cls._statefulset(
+                    cr, name=base, service=service, replicas=parallelism,
+                    placement=placement, image_pull_policy=image_pull_policy,
+                    logical_replica=None,
+                )
+            ]
+        # multi-host: parallelism logical replicas × hosts pods each
+        return [
+            cls._statefulset(
+                cr, name=f"{base}-r{i}", service=service,
+                replicas=placement["hosts"], placement=placement,
+                image_pull_policy=image_pull_policy, logical_replica=i,
+            )
+            for i in range(parallelism)
+        ]
+
+    @classmethod
+    def _statefulset(
+        cls,
+        cr: AgentCustomResource,
+        name: str,
+        service: str,
+        replicas: int,
+        placement: dict[str, Any] | None,
+        image_pull_policy: str,
+        logical_replica: int | None,
+    ) -> dict[str, Any]:
+        spec = cr.spec
+        env = [
+            {"name": "LS_APPLICATION_ID", "value": spec.application_id},
+            {"name": "LS_AGENT_ID", "value": spec.agent_id},
+            {"name": "LS_TENANT", "value": spec.tenant},
+            {
+                "name": "LS_POD_NAME",
+                "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+            },
+        ]
+        resources: dict[str, Any] = {
+            "requests": {
+                "cpu": f"{spec.resources.size * 0.5}",
+                "memory": f"{spec.resources.size * 512}M",
+            }
+        }
+        if placement:
+            chips = placement["chips_per_pod"]
+            resources.setdefault("limits", {})["google.com/tpu"] = str(chips)
+            resources["requests"]["google.com/tpu"] = str(chips)
+            env += [
+                {"name": "LS_SLICE_HOSTS", "value": str(placement["hosts"])},
+                {
+                    "name": "LS_COORDINATOR_ADDRESS",
+                    "value": f"{name}-0.{service}:{COORDINATOR_PORT}",
+                },
+            ]
+        if logical_replica is not None:
+            env.append(
+                {"name": "LS_LOGICAL_REPLICA", "value": str(logical_replica)}
+            )
+
+        volume_mounts = [
+            {"name": "app-config", "mountPath": "/app-config"},
+            {"name": "app-code-download", "mountPath": "/app-code-download"},
+        ]
+        volumes: list[dict[str, Any]] = [
+            {
+                "name": "app-config",
+                "secret": {"secretName": spec.agent_config_secret_ref},
+            },
+            {"name": "app-code-download", "emptyDir": {}},
+        ]
+        volume_claim_templates: list[dict[str, Any]] = []
+        if spec.disk is not None and spec.disk.enabled:
+            volume_mounts.append(
+                {"name": "agent-state", "mountPath": "/agent-state"}
+            )
+            claim: dict[str, Any] = {
+                "metadata": {"name": "agent-state"},
+                "spec": {
+                    "accessModes": ["ReadWriteOnce"],
+                    "resources": {"requests": {"storage": spec.disk.size}},
+                },
+            }
+            if spec.disk.type != "default":
+                claim["spec"]["storageClassName"] = spec.disk.type
+            volume_claim_templates.append(claim)
+
+        entrypoint = ["python", "-m", "langstream_tpu.runtime.pod"]
+        pod_spec: dict[str, Any] = {
+            "terminationGracePeriodSeconds": 60,
+            "initContainers": [
+                {
+                    "name": "code-download",
+                    "image": spec.image,
+                    "imagePullPolicy": image_pull_policy,
+                    "command": entrypoint
+                    + ["agent-code-download", "/app-config/config",
+                       "/app-code-download"],
+                    "volumeMounts": volume_mounts,
+                }
+            ],
+            "containers": [
+                {
+                    "name": "runtime",
+                    "image": spec.image,
+                    "imagePullPolicy": image_pull_policy,
+                    "command": entrypoint
+                    + ["agent-runtime", "/app-config/config",
+                       "/app-code-download"],
+                    "env": env,
+                    "ports": [
+                        {"name": "http", "containerPort": AGENT_PORT},
+                        {"name": "coordinator", "containerPort": COORDINATOR_PORT},
+                    ],
+                    "resources": resources,
+                    "volumeMounts": volume_mounts,
+                    "readinessProbe": {
+                        "httpGet": {"path": "/info", "port": AGENT_PORT},
+                        "initialDelaySeconds": 5,
+                        "periodSeconds": 10,
+                    },
+                }
+            ],
+            "volumes": volumes,
+        }
+        if placement:
+            pod_spec["nodeSelector"] = placement["node_selector"]
+
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": name,
+                "namespace": cr.namespace,
+                "labels": _agent_labels(cr),
+            },
+            "spec": {
+                "serviceName": service,
+                "replicas": replicas,
+                "podManagementPolicy": "Parallel",
+                "selector": {"matchLabels": {**_agent_labels(cr), "sts": name}},
+                "template": {
+                    "metadata": {
+                        "labels": {**_agent_labels(cr), "sts": name},
+                        "annotations": {
+                            # config rollout trigger (parity: checksum on the
+                            # agent-config Secret ref)
+                            "langstream.tpu/config-checksum": (
+                                spec.agent_config_secret_ref_checksum
+                            ),
+                            "prometheus.io/scrape": "true",
+                            "prometheus.io/port": str(AGENT_PORT),
+                            "prometheus.io/path": "/metrics",
+                        },
+                    },
+                    "spec": pod_spec,
+                },
+                "volumeClaimTemplates": volume_claim_templates,
+            },
+        }
+
+
+def _agent_labels(cr: AgentCustomResource) -> dict[str, str]:
+    return {
+        "app": "langstream-tpu-runtime",
+        "langstream-application": cr.spec.application_id,
+        "langstream-agent": cr.spec.agent_id,
+    }
+
+
+class AppResourcesFactory:
+    """Setup/deployer Job manifests (the in-cluster halves of deploy)."""
+
+    @staticmethod
+    def _job(
+        name: str,
+        namespace: str,
+        image: str,
+        args: list[str],
+        config_secret: str,
+        labels: dict[str, str],
+    ) -> dict[str, Any]:
+        return {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": name, "namespace": namespace, "labels": labels},
+            "spec": {
+                "backoffLimit": 6,
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {
+                        "restartPolicy": "OnFailure",
+                        "containers": [
+                            {
+                                "name": "main",
+                                "image": image,
+                                "command": [
+                                    "python", "-m", "langstream_tpu.runtime.pod",
+                                ] + args,
+                                "volumeMounts": [
+                                    {
+                                        "name": "app-config",
+                                        "mountPath": "/app-config",
+                                    }
+                                ],
+                            }
+                        ],
+                        "volumes": [
+                            {
+                                "name": "app-config",
+                                "secret": {"secretName": config_secret},
+                            }
+                        ],
+                    },
+                },
+            },
+        }
+
+    @classmethod
+    def generate_setup_job(
+        cls, tenant: str, application_id: str, namespace: str, image: str,
+        config_secret: str,
+    ) -> dict[str, Any]:
+        """Creates topics + provisions assets (pod command
+        ``application-setup``; parity ``AppResourcesFactory.java:231``)."""
+        return cls._job(
+            name=f"langstream-runtime-setup-{application_id}",
+            namespace=namespace,
+            image=image,
+            args=["application-setup", "setup", "/app-config/config"],
+            config_secret=config_secret,
+            labels={
+                "app": "langstream-tpu-setup",
+                "langstream-application": application_id,
+            },
+        )
+
+    @classmethod
+    def generate_deployer_job(
+        cls, tenant: str, application_id: str, namespace: str, image: str,
+        config_secret: str, delete: bool = False,
+    ) -> dict[str, Any]:
+        """Plans the app in-cluster and writes/deletes Agent CRs (pod command
+        ``deployer-runtime``; parity ``AppResourcesFactory.java:76``)."""
+        action = "delete" if delete else "deploy"
+        return cls._job(
+            name=f"langstream-runtime-deployer-{action}-{application_id}",
+            namespace=namespace,
+            image=image,
+            args=["deployer-runtime", action, "/app-config/config"],
+            config_secret=config_secret,
+            labels={
+                "app": "langstream-tpu-deployer",
+                "langstream-application": application_id,
+            },
+        )
