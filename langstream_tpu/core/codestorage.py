@@ -70,13 +70,12 @@ class S3CodeStorage(CodeStorage):
 
     def __init__(self, configuration: dict[str, Any]):
         try:
-            import boto3  # noqa: F401
+            import boto3
         except ImportError as e:
             raise RuntimeError(
                 "S3 code storage requires the boto3 client library, which is "
                 "not available in this environment"
             ) from e
-        import boto3
 
         self.bucket = configuration.get("bucket-name", "langstream-code-storage")
         self.client = boto3.client(

@@ -112,6 +112,14 @@ class KubernetesClusterRuntime:
             )
             self.api.apply(cr.to_dict())
             crs.append(cr)
+        # prune agents dropped from the plan (a redeploy that removes a
+        # pipeline step must tear its pods down, not leak them)
+        wanted = {cr.name for cr in crs}
+        for existing in self.current_agents(tenant, plan.application_id):
+            name = existing["metadata"]["name"]
+            if name not in wanted:
+                self.api.delete("Agent", namespace, name)
+                self.api.delete("Secret", namespace, f"{name}-config")
         return crs
 
     def delete(self, tenant: str, plan: ExecutionPlan) -> None:

@@ -23,7 +23,12 @@ import logging
 from typing import Any
 
 from langstream_tpu.k8s.client import KubeApi
-from langstream_tpu.k8s.crds import AgentCustomResource, ApplicationCustomResource
+from langstream_tpu.k8s.crds import (
+    AgentCustomResource,
+    ApplicationCustomResource,
+    config_checksum,
+)
+from langstream_tpu.k8s.diff import specs_equal
 from langstream_tpu.k8s.resources import AgentResourcesFactory, AppResourcesFactory
 
 log = logging.getLogger(__name__)
@@ -33,6 +38,21 @@ DEPLOYING = "DEPLOYING"
 DEPLOYED = "DEPLOYED"
 ERROR_DEPLOYING = "ERROR_DEPLOYING"
 DELETING = "DELETING"
+
+
+def apply_if_changed(api: KubeApi, obj: dict[str, Any]) -> dict[str, Any]:
+    """Level-triggered writes without churn: skip the PUT when the desired
+    spec/data/labels already match (every tick would otherwise rewrite every
+    object, hammering the API server and bumping resourceVersions)."""
+    meta = obj.get("metadata") or {}
+    existing = api.get(obj["kind"], meta.get("namespace"), meta["name"])
+    if existing is not None and all(
+        specs_equal(obj.get(k), existing.get(k)) for k in ("spec", "data")
+    ) and specs_equal(
+        (meta.get("labels")), ((existing.get("metadata") or {}).get("labels"))
+    ):
+        return existing
+    return api.apply(obj)
 
 
 class AgentController:
@@ -45,7 +65,7 @@ class AgentController:
     def reconcile(self, cr_dict: dict[str, Any]) -> str:
         cr = AgentCustomResource.from_dict(cr_dict)
         service = AgentResourcesFactory.generate_headless_service(cr)
-        self.api.apply(service)
+        apply_if_changed(self.api, service)
         statefulsets = AgentResourcesFactory.generate_statefulsets(
             cr, accelerator=self.accelerator
         )
@@ -65,13 +85,15 @@ class AgentController:
                 self.api.delete("StatefulSet", cr.namespace, sts["metadata"]["name"])
         ready = True
         for sts in statefulsets:
-            applied = self.api.apply(sts)
+            applied = apply_if_changed(self.api, sts)
             status = (applied or {}).get("status") or {}
             if status.get("readyReplicas", 0) < sts["spec"]["replicas"]:
                 ready = False
         phase = DEPLOYED if ready else DEPLOYING
-        cr_dict = {**cr_dict, "status": {**cr.status, "status": phase}}
-        self.api.update_status(cr_dict)
+        if (cr.status or {}).get("status") != phase:
+            self.api.update_status(
+                {**cr_dict, "status": {**cr.status, "status": phase}}
+            )
         return phase
 
     def cleanup(self, cr_dict: dict[str, Any]) -> None:
@@ -97,12 +119,15 @@ class AppController:
     def __init__(self, api: KubeApi):
         self.api = api
 
-    def _ensure_app_config_secret(self, cr: ApplicationCustomResource) -> str:
+    def _ensure_app_config_secret(
+        self, cr: ApplicationCustomResource
+    ) -> tuple[str, str]:
         """Materialize the config document the setup/deployer Jobs mount:
         the parsed files + instance from the Application CR, the secrets
         YAML from the companion ``<app>-secrets`` Secret, and code-storage
         coordinates (what :func:`runtime.pod.run_setup`/``run_deployer``
-        read)."""
+        read). Returns (secret name, config checksum) — the checksum keys
+        the Jobs' identity so an updated app re-runs them."""
         name = f"{cr.name}-app-config"
         payload = json.loads(cr.spec.application or "{}")
         secrets_yaml = None
@@ -120,7 +145,8 @@ class AppController:
             "codeArchiveId": cr.spec.code_archive_id,
             "codeStorage": (cr.spec.options or {}).get("codeStorage") or {},
         }
-        self.api.apply(
+        apply_if_changed(
+            self.api,
             {
                 "apiVersion": "v1",
                 "kind": "Secret",
@@ -134,16 +160,40 @@ class AppController:
                         json.dumps(config).encode()
                     ).decode()
                 },
-            }
+            },
         )
-        return name
+        return name, config_checksum(config)
+
+    def _prune_stale_jobs(
+        self, cr: ApplicationCustomResource, keep: set[str]
+    ) -> None:
+        for job in self.api.list(
+            "Job", cr.namespace, label_selector={"langstream-application": cr.name}
+        ):
+            if job["metadata"]["name"] not in keep:
+                self.api.delete("Job", cr.namespace, job["metadata"]["name"])
 
     def reconcile(self, cr_dict: dict[str, Any]) -> str:
         cr = ApplicationCustomResource.from_dict(cr_dict)
         image = cr.spec.image
-        config_secret = self._ensure_app_config_secret(cr)
+        config_secret, checksum = self._ensure_app_config_secret(cr)
+        suffix = f"-{checksum[:8]}"
         setup_job = AppResourcesFactory.generate_setup_job(
-            cr.spec.tenant, cr.name, cr.namespace, image, config_secret
+            cr.spec.tenant, cr.name, cr.namespace, image, config_secret,
+            name_suffix=suffix,
+        )
+        deployer_job = AppResourcesFactory.generate_deployer_job(
+            cr.spec.tenant, cr.name, cr.namespace, image, config_secret,
+            name_suffix=suffix,
+        )
+        # an updated app produces a new checksum → fresh jobs; older
+        # generations' jobs are pruned
+        self._prune_stale_jobs(
+            cr,
+            keep={
+                setup_job["metadata"]["name"],
+                deployer_job["metadata"]["name"],
+            },
         )
         existing_setup = self.api.get(
             "Job", cr.namespace, setup_job["metadata"]["name"]
@@ -154,9 +204,6 @@ class AppController:
         if not _job_succeeded(existing_setup):
             return self._set_status(cr_dict, DEPLOYING, "waiting for setup job")
 
-        deployer_job = AppResourcesFactory.generate_deployer_job(
-            cr.spec.tenant, cr.name, cr.namespace, image, config_secret
-        )
         existing_deployer = self.api.get(
             "Job", cr.namespace, deployer_job["metadata"]["name"]
         )
@@ -169,7 +216,7 @@ class AppController:
 
     def cleanup(self, cr_dict: dict[str, Any]) -> str:
         """Delete path: run the deployer job with ``delete`` to tear down
-        Agent CRs, then remove the jobs."""
+        Agent CRs, then remove every job and the config Secret."""
         cr = ApplicationCustomResource.from_dict(cr_dict)
         config_secret = f"{cr.name}-app-config"
         delete_job = AppResourcesFactory.generate_deployer_job(
@@ -182,18 +229,18 @@ class AppController:
             return DELETING
         if not _job_succeeded(existing):
             return DELETING
-        for job in (
-            f"langstream-runtime-setup-{cr.name}",
-            f"langstream-runtime-deployer-deploy-{cr.name}",
-            delete_job["metadata"]["name"],
-        ):
-            self.api.delete("Job", cr.namespace, job)
+        self._prune_stale_jobs(cr, keep=set())
+        # the config Secret carries the full app (incl. secrets YAML) —
+        # never leave it behind
+        self.api.delete("Secret", cr.namespace, config_secret)
         return "DELETED"
 
     def _set_status(self, cr_dict: dict[str, Any], phase: str, reason: str) -> str:
-        self.api.update_status(
-            {**cr_dict, "status": {"status": phase, "reason": reason}}
-        )
+        current = (cr_dict.get("status") or {})
+        if current.get("status") != phase or current.get("reason") != reason:
+            self.api.update_status(
+                {**cr_dict, "status": {"status": phase, "reason": reason}}
+            )
         return phase
 
 

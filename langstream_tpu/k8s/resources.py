@@ -105,6 +105,11 @@ class AgentResourcesFactory:
             },
             "spec": {
                 "clusterIP": "None",
+                # slice bootstrap: followers must resolve the coordinator
+                # pod's DNS *before* it is Ready (jax.distributed.initialize
+                # blocks until every host joins, which is itself gated on
+                # this DNS) — without this flag multi-host startup deadlocks
+                "publishNotReadyAddresses": True,
                 "selector": _agent_labels(cr),
                 "ports": [
                     {"name": "http", "port": AGENT_PORT},
@@ -359,12 +364,14 @@ class AppResourcesFactory:
     @classmethod
     def generate_setup_job(
         cls, tenant: str, application_id: str, namespace: str, image: str,
-        config_secret: str,
+        config_secret: str, name_suffix: str = "",
     ) -> dict[str, Any]:
         """Creates topics + provisions assets (pod command
-        ``application-setup``; parity ``AppResourcesFactory.java:231``)."""
+        ``application-setup``; parity ``AppResourcesFactory.java:231``).
+        ``name_suffix`` ties the Job's identity to the app-config checksum so
+        an updated application re-runs setup (Jobs are immutable-ish)."""
         return cls._job(
-            name=f"langstream-runtime-setup-{application_id}",
+            name=f"langstream-runtime-setup-{application_id}{name_suffix}",
             namespace=namespace,
             image=image,
             args=["application-setup", "setup", "/app-config/config"],
@@ -378,13 +385,14 @@ class AppResourcesFactory:
     @classmethod
     def generate_deployer_job(
         cls, tenant: str, application_id: str, namespace: str, image: str,
-        config_secret: str, delete: bool = False,
+        config_secret: str, delete: bool = False, name_suffix: str = "",
     ) -> dict[str, Any]:
         """Plans the app in-cluster and writes/deletes Agent CRs (pod command
         ``deployer-runtime``; parity ``AppResourcesFactory.java:76``)."""
         action = "delete" if delete else "deploy"
         return cls._job(
-            name=f"langstream-runtime-deployer-{action}-{application_id}",
+            name=f"langstream-runtime-deployer-{action}-{application_id}"
+            f"{name_suffix}",
             namespace=namespace,
             image=image,
             args=["deployer-runtime", action, "/app-config/config"],

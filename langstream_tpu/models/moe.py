@@ -1,0 +1,324 @@
+"""Mixture-of-Experts decoder (Mixtral-family), pure JAX, TPU-first.
+
+Design (vs. a torch port of Mixtral):
+
+- **Capacity-based top-2 dispatch as one-hot matmuls** (GShard style): the
+  dispatch/combine tensors are einsummed on the MXU — no scatter/gather, no
+  dynamic shapes, so XLA tiles everything. Tokens overflowing an expert's
+  capacity fall through the residual (standard GShard semantics).
+- **Expert parallelism over the ``ep`` mesh axis**: expert weights are
+  sharded ``P("ep", ...)``; the dispatch einsum contracts a ``dp``-sharded
+  token axis against an ``ep``-sharded expert axis, so XLA inserts the
+  all-to-all over ICI — no hand-written collectives.
+- **TP composes inside each expert**: expert up/gate column-sharded on
+  ``tp``, down row-sharded, same Megatron rule as the dense model.
+- Attention blocks are exactly the Llama ones (imported), so every
+  parallelism mode of the dense path (ring/Ulysses sp, flash prefill)
+  composes with MoE FFNs.
+
+Capability parity: the reference serves MoE SaaS models (e.g. Mixtral via
+Ollama/HF providers, ``HuggingFaceProvider.java:47``); here the MoE family
+is in-tree and TPU-resident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from langstream_tpu.models.llama import (
+    _apply_rope,
+    _rms_norm,
+    _rope,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 8
+    head_dim: int = 128
+    moe_intermediate: int = 14336
+    experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    rope_theta: float = 1000000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def mixtral_8x7b(cls, max_seq_len: int = 4096) -> "MoEConfig":
+        return cls(max_seq_len=max_seq_len)
+
+    @classmethod
+    def tiny(cls, max_seq_len: int = 128) -> "MoEConfig":
+        return cls(
+            vocab_size=384, hidden=64, layers=2, heads=4, kv_heads=2,
+            head_dim=16, moe_intermediate=128, experts=4,
+            experts_per_token=2, max_seq_len=max_seq_len,
+        )
+
+    def capacity(self, tokens: int) -> int:
+        """Static per-expert capacity for a batch of ``tokens``."""
+        return max(
+            1,
+            int(
+                math.ceil(
+                    self.experts_per_token * tokens * self.capacity_factor
+                    / self.experts
+                )
+            ),
+        )
+
+
+def init_moe_params(config: MoEConfig, key: jax.Array | None = None) -> dict:
+    key = key if key is not None else jax.random.PRNGKey(0)
+    c = config
+    keys = jax.random.split(key, 12)
+    qkv_dim = c.heads * c.head_dim
+    kv_dim = c.kv_heads * c.head_dim
+    L, E, I = c.layers, c.experts, c.moe_intermediate
+
+    def w_init(k, *shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(
+            c.dtype
+        )
+
+    return {
+        "embed": w_init(keys[0], c.vocab_size, c.hidden, fan_in=c.hidden),
+        "layers": {
+            "attn_norm": jnp.ones((L, c.hidden), dtype=c.dtype),
+            "wq": w_init(keys[1], L, c.hidden, qkv_dim, fan_in=c.hidden),
+            "wk": w_init(keys[2], L, c.hidden, kv_dim, fan_in=c.hidden),
+            "wv": w_init(keys[3], L, c.hidden, kv_dim, fan_in=c.hidden),
+            "wo": w_init(keys[4], L, qkv_dim, c.hidden, fan_in=qkv_dim),
+            "mlp_norm": jnp.ones((L, c.hidden), dtype=c.dtype),
+            # router stays float32: tiny, and routing decisions are
+            # numerically delicate
+            "router": jax.random.normal(
+                keys[5], (L, c.hidden, E), dtype=jnp.float32
+            ) * (1.0 / math.sqrt(c.hidden)),
+            "w_gate": w_init(keys[6], L, E, c.hidden, I, fan_in=c.hidden),
+            "w_up": w_init(keys[7], L, E, c.hidden, I, fan_in=c.hidden),
+            "w_down": w_init(keys[8], L, E, I, c.hidden, fan_in=I),
+        },
+        "final_norm": jnp.ones((c.hidden,), dtype=c.dtype),
+        "lm_head": w_init(keys[9], c.hidden, c.vocab_size, fan_in=c.hidden),
+    }
+
+
+def moe_param_specs(config: MoEConfig) -> dict:
+    """Expert axis on ``ep``, Megatron TP inside each expert."""
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "router": P(None, None, None),
+            "w_gate": P(None, "ep", None, "tp"),
+            "w_up": P(None, "ep", None, "tp"),
+            "w_down": P(None, "ep", "tp", None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def shard_moe_params(params: dict, config: MoEConfig, mesh: Mesh) -> dict:
+    specs = moe_param_specs(config)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# top-2 gating + dispatch
+# ---------------------------------------------------------------------------
+
+
+def top2_gating(
+    router_logits: jax.Array,  # (B, S, E) float32
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """GShard top-2 gating with static capacity.
+
+    Returns (dispatch (B,S,E,C) bool, combine (B,S,E,C) float32,
+    aux_loss scalar — the load-balancing loss from the GShard/Switch papers).
+    """
+    B, S, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (B, S, E)
+
+    idx1 = jnp.argmax(probs, axis=-1)                       # (B, S)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)      # (B, S, E)
+    p1 = jnp.sum(probs * mask1, axis=-1)                    # (B, S)
+
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
+    p2 = jnp.sum(probs * mask2, axis=-1)
+
+    # renormalise the two winners (Mixtral semantics)
+    denom = p1 + p2 + 1e-9
+    w1, w2 = p1 / denom, p2 / denom
+
+    # position of each token within its expert's queue, flattened over (B,S)
+    flat1 = mask1.reshape(B * S, E)
+    flat2 = mask2.reshape(B * S, E)
+    pos1 = jnp.cumsum(flat1, axis=0) * flat1 - flat1        # 0-based
+    pos2 = (jnp.cumsum(flat2, axis=0) + flat1.sum(0, keepdims=True)) * flat2 - flat2
+    keep1 = (pos1 < capacity) & (flat1 > 0)
+    keep2 = (pos2 < capacity) & (flat2 > 0)
+
+    oh1 = jax.nn.one_hot(pos1.astype(jnp.int32), capacity, dtype=probs.dtype)
+    oh2 = jax.nn.one_hot(pos2.astype(jnp.int32), capacity, dtype=probs.dtype)
+    combine_flat = (
+        w1.reshape(-1, 1, 1) * keep1[..., None] * oh1
+        + w2.reshape(-1, 1, 1) * keep2[..., None] * oh2
+    )  # (B*S, E, C)
+    combine = combine_flat.reshape(B, S, E, capacity)
+    dispatch = combine > 0.0
+
+    # load-balancing auxiliary loss: E * Σ_e fraction_tokens_e · mean_prob_e
+    density = mask1.reshape(B * S, E).mean(axis=0)
+    density_proxy = probs.reshape(B * S, E).mean(axis=0)
+    aux_loss = jnp.sum(density * density_proxy) * (E * E) / 2.0
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(
+    x: jax.Array,            # (B, S, H)
+    router_w: jax.Array,     # (H, E) float32
+    w_gate: jax.Array,       # (E, H, I)
+    w_up: jax.Array,         # (E, H, I)
+    w_down: jax.Array,       # (E, I, H)
+    capacity: int,
+    ep_constrain=None,       # applied to (E, C', H) expert-major tensors
+) -> tuple[jax.Array, jax.Array]:
+    """Top-2 MoE feed-forward; returns (output (B,S,H), aux_loss).
+
+    The two einsums flanking the expert computation are the all-to-alls:
+    tokens (sharded ``dp``/``sp``) → expert-major (sharded ``ep``) and back.
+    """
+    B, S, H = x.shape
+    router_logits = jnp.einsum(
+        "bsh,he->bse", x.astype(jnp.float32), router_w
+    )
+    dispatch, combine, aux = top2_gating(router_logits, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    if ep_constrain is None:
+        ep_constrain = lambda t: t  # noqa: E731
+    # dispatch all-to-all: tokens → (E, C, H) expert-major
+    xe = ep_constrain(jnp.einsum("bsec,bsh->ech", dispatch, x))
+    gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", xe, w_gate))
+    up = jnp.einsum("ech,ehi->eci", xe, w_up)
+    ye = ep_constrain(jnp.einsum("eci,eih->ech", gate * up, w_down))
+    # combine all-to-all: expert-major → tokens
+    out = jnp.einsum("bsec,ech->bsh", combine.astype(x.dtype), ye)
+    return out, aux
+
+
+# ---------------------------------------------------------------------------
+# forward (training / prefill building block)
+# ---------------------------------------------------------------------------
+
+
+def moe_forward(
+    config: MoEConfig,
+    params: dict,
+    tokens: jax.Array,  # (B, S)
+    *,
+    attention=None,
+    constrain=None,     # activations (B,S,H)
+    ep_constrain=None,  # expert-major intermediates (E,C,H)
+) -> tuple[jax.Array, jax.Array]:
+    """All-position logits (B, S, V) + summed aux loss. Same shape contract
+    as :func:`llama_forward`, plus the MoE auxiliary load-balancing loss the
+    training step adds to the CE loss."""
+    c = config
+    B, S = tokens.shape
+    if attention is None:
+        from langstream_tpu.parallel.ring import dense_attention
+        from functools import partial
+
+        attention = partial(
+            dense_attention, causal=True, scale=1.0 / math.sqrt(c.head_dim)
+        )
+    if constrain is None:
+        constrain = lambda x: x  # noqa: E731
+    capacity = c.capacity(B * S)
+
+    x = constrain(jnp.take(params["embed"], tokens, axis=0))
+    positions = jnp.arange(S)[None, :].repeat(B, axis=0)
+    cos, sin = _rope(positions, c.head_dim, c.rope_theta)
+
+    def layer(carry, lp):
+        x, aux_total = carry
+        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
+        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
+        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        out = attention(q, k, v).reshape(B, S, c.heads * c.head_dim)
+        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+        h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+        ffn, aux = moe_ffn(
+            h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            capacity, ep_constrain=ep_constrain,
+        )
+        x = x + ffn
+        return (constrain(x), aux_total + aux), None
+
+    (x, aux_total), _ = jax.lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    return logits, aux_total
+
+
+def moe_forward_sharded(
+    config: MoEConfig,
+    params: dict,
+    tokens: jax.Array,
+    mesh: Mesh,
+) -> tuple[jax.Array, jax.Array]:
+    """Mesh-annotated MoE forward: activations on dp/sp, expert-major
+    intermediates on ep (XLA materialises the dispatch/combine all-to-alls
+    over ICI at those constraints)."""
+    axes = mesh.axis_names
+    dp = "dp" if "dp" in axes else None
+    sp = "sp" if "sp" in axes else None
+    ep = "ep" if "ep" in axes else None
+    x_spec = NamedSharding(mesh, P(dp, sp, None))
+    e_spec = NamedSharding(mesh, P(ep, None, None))
+    return moe_forward(
+        config, params, tokens,
+        constrain=lambda x: jax.lax.with_sharding_constraint(x, x_spec),
+        ep_constrain=lambda t: jax.lax.with_sharding_constraint(t, e_spec),
+    )
+
+
+def moe_param_count(config: MoEConfig) -> int:
+    c = config
+    attn = (
+        c.hidden * c.heads * c.head_dim
+        + 2 * c.hidden * c.kv_heads * c.head_dim
+        + c.heads * c.head_dim * c.hidden
+    )
+    experts = c.experts * 3 * c.hidden * c.moe_intermediate
+    per_layer = attn + experts + c.hidden * c.experts + 2 * c.hidden
+    return c.layers * per_layer + 2 * c.vocab_size * c.hidden + c.hidden

@@ -311,6 +311,12 @@ def test_agent_controller_prunes_old_shape():
     assert names == {"myapp-step1-r0"}
 
 
+def _jobs(api, ns, kind):
+    return [
+        j for j in api.list("Job", ns, label_selector={"app": kind})
+    ]
+
+
 def test_app_controller_two_phase_deploy():
     api = InMemoryKubeApi()
     cr = ApplicationCustomResource(
@@ -323,8 +329,8 @@ def test_app_controller_two_phase_deploy():
     ns = "langstream-t1"
 
     assert controller.reconcile(api.get("Application", ns, "myapp")) == DEPLOYING
-    setup = api.get("Job", ns, "langstream-runtime-setup-myapp")
-    assert setup is not None
+    (setup,) = _jobs(api, ns, "langstream-tpu-setup")
+    assert setup["metadata"]["name"].startswith("langstream-runtime-setup-myapp-")
     # the config Secret the jobs mount is materialized by the controller
     app_config = api.get("Secret", ns, "myapp-app-config")
     assert app_config is not None
@@ -336,16 +342,106 @@ def test_app_controller_two_phase_deploy():
     assert mounted == "myapp-app-config"
     # setup still running → still DEPLOYING, no deployer job yet
     assert controller.reconcile(api.get("Application", ns, "myapp")) == DEPLOYING
-    assert api.get("Job", ns, "langstream-runtime-deployer-deploy-myapp") is None
+    assert _jobs(api, ns, "langstream-tpu-deployer") == []
     # setup succeeds → deployer job created
     setup["status"] = {"succeeded": 1}
     api.update_status(setup)
     assert controller.reconcile(api.get("Application", ns, "myapp")) == DEPLOYING
-    deployer = api.get("Job", ns, "langstream-runtime-deployer-deploy-myapp")
-    assert deployer is not None
+    (deployer,) = _jobs(api, ns, "langstream-tpu-deployer")
     deployer["status"] = {"succeeded": 1}
     api.update_status(deployer)
     assert controller.reconcile(api.get("Application", ns, "myapp")) == DEPLOYED
+
+
+def test_app_controller_update_reruns_jobs_and_cleanup_removes_secret():
+    api = InMemoryKubeApi()
+    ns = "langstream-t1"
+    cr = ApplicationCustomResource(
+        name="myapp", namespace=ns,
+        spec=ApplicationSpec(tenant="t1", image="img", application='{"files": {"a.yaml": "x"}}'),
+    )
+    api.apply(cr.to_dict())
+    controller = AppController(api)
+    controller.reconcile(api.get("Application", ns, "myapp"))
+    (setup_v1,) = _jobs(api, ns, "langstream-tpu-setup")
+    setup_v1["status"] = {"succeeded": 1}
+    api.update_status(setup_v1)
+    controller.reconcile(api.get("Application", ns, "myapp"))
+    (deployer_v1,) = _jobs(api, ns, "langstream-tpu-deployer")
+    deployer_v1["status"] = {"succeeded": 1}
+    api.update_status(deployer_v1)
+    assert controller.reconcile(api.get("Application", ns, "myapp")) == DEPLOYED
+
+    # update the application → new checksum → fresh jobs, old ones pruned
+    cr2 = ApplicationCustomResource(
+        name="myapp", namespace=ns,
+        spec=ApplicationSpec(tenant="t1", image="img", application='{"files": {"a.yaml": "CHANGED"}}'),
+    )
+    api.apply(cr2.to_dict())
+    assert controller.reconcile(api.get("Application", ns, "myapp")) == DEPLOYING
+    (setup_v2,) = _jobs(api, ns, "langstream-tpu-setup")
+    assert setup_v2["metadata"]["name"] != setup_v1["metadata"]["name"]
+    assert _jobs(api, ns, "langstream-tpu-deployer") == []  # old deployer pruned
+
+    # cleanup: delete job runs, then everything incl. the config Secret goes
+    assert controller.cleanup(api.get("Application", ns, "myapp")) == "DELETING"
+    delete_jobs = [
+        j for j in _jobs(api, ns, "langstream-tpu-deployer")
+        if "delete" in j["metadata"]["name"]
+    ]
+    delete_jobs[0]["status"] = {"succeeded": 1}
+    api.update_status(delete_jobs[0])
+    assert controller.cleanup(api.get("Application", ns, "myapp")) == "DELETED"
+    assert api.list("Job", ns) == []
+    assert api.get("Secret", ns, "myapp-app-config") is None
+
+
+TWO_NODE_PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "convert"
+    type: "document-to-json"
+    input: "input-topic"
+    configuration:
+      text-field: "question"
+  - name: "annotate"
+    type: "compute"
+    output: "output-topic"
+    resources:
+      parallelism: 2
+    configuration:
+      fields:
+        - name: "value.upper"
+          expression: "fn:uppercase(value.question)"
+"""
+
+
+def test_cluster_runtime_prunes_removed_agents():
+    api = InMemoryKubeApi()
+    runtime = KubernetesClusterRuntime(api)
+    # distinct parallelism defeats fusion → two separate agent nodes
+    plan = make_plan(TWO_NODE_PIPELINE)
+    assert len(plan.agents) == 2
+    runtime.deploy("t1", plan)
+    ns = tenant_namespace("t1")
+    before = {cr["metadata"]["name"] for cr in api.list("Agent", ns)}
+    assert len(before) == 2
+    # redeploy with the second agent dropped
+    smaller = make_plan(
+        TWO_NODE_PIPELINE.split('  - name: "annotate"')[0]
+    )
+    assert len(smaller.agents) == 1
+    runtime.deploy("t1", smaller)
+    after = {cr["metadata"]["name"] for cr in api.list("Agent", ns)}
+    assert after == {f"myapp-{node_id}" for node_id in smaller.agents}
+    assert len(after) == 1
+    # secrets for pruned agents are gone too
+    for name in before - after:
+        assert api.get("Secret", ns, f"{name}-config") is None
 
 
 def test_operator_loop_reconciles_all():
