@@ -69,6 +69,38 @@ _FACTORIES = {
     "experimental-python-service": python_custom.PythonServiceAgent,
 }
 
+
+# out-of-process python/any-language agents over the sidecar gRPC protocol
+# (parity: the reference's default python-* execution; here opt-in, since
+# in-process is the zero-overhead default). Lazy imports: grpc machinery
+# loads only when an application actually uses these types.
+def _grpc_processor():
+    from langstream_tpu.grpc.client import GrpcAgentProcessor
+
+    return GrpcAgentProcessor()
+
+
+def _grpc_source():
+    from langstream_tpu.grpc.client import GrpcAgentSource
+
+    return GrpcAgentSource()
+
+
+def _grpc_sink():
+    from langstream_tpu.grpc.client import GrpcAgentSink
+
+    return GrpcAgentSink()
+
+
+_FACTORIES.update(
+    {
+        "grpc-python-processor": _grpc_processor,
+        "grpc-agent": _grpc_processor,  # external endpoint, any language
+        "grpc-python-source": _grpc_source,
+        "grpc-python-sink": _grpc_sink,
+    }
+)
+
 _METADATA = {
     # component type, composable
     "timer-source": (SOURCE, True),
@@ -83,6 +115,8 @@ _METADATA = {
     "experimental-python-sink": (SINK, True),
     "python-service": (SERVICE, False),
     "experimental-python-service": (SERVICE, False),
+    "grpc-python-source": (SOURCE, True),
+    "grpc-python-sink": (SINK, True),
 }
 
 AgentCodeRegistry.register_provider(

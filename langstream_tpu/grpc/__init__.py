@@ -1,0 +1,20 @@
+"""External-agent gRPC protocol (the sidecar lane).
+
+Parity: ``langstream-agent-grpc`` — the reference runs user Python code in a
+sidecar interpreter behind a localhost gRPC bidi-stream protocol
+(``agent.proto``, ``PythonGrpcServer.java:31``, ``grpc_service.py``). In
+this framework Python user code loads in-process by default
+(:mod:`langstream_tpu.agents.python_custom`); this package provides the
+*out-of-process* lane for code that needs interpreter isolation (conflicting
+deps, crash containment) or another language entirely.
+
+Toolchain note: the image ships ``protoc`` and the protobuf runtime but not
+``grpcio-tools``, so message classes are generated from ``agent.proto`` by
+invoking ``protoc`` on demand (content-hash cached, same pattern as the
+native broker build) and the service stubs are hand-written against
+``grpc.aio``'s generic handler API in :mod:`proto`.
+"""
+
+from langstream_tpu.grpc.proto import load_messages
+
+__all__ = ["load_messages"]

@@ -1,0 +1,89 @@
+"""On-demand protobuf codegen + hand-written gRPC method table.
+
+``protoc --python_out`` runs once per proto-file content hash (no
+``grpcio-tools`` in the image, so the service layer is defined here as a
+method table both the aio server and the client build from).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+_HERE = Path(__file__).parent
+PROTO_FILE = _HERE / "agent.proto"
+_GEN_DIR = _HERE / "_gen"
+
+SERVICE_NAME = "langstream_tpu.ExternalAgent"
+
+
+class ProtoBuildError(RuntimeError):
+    pass
+
+
+def load_messages():
+    """Generate (if needed) and import the ``agent_pb2`` message module."""
+    digest = hashlib.sha256(PROTO_FILE.read_bytes()).hexdigest()[:16]
+    gen_dir = _GEN_DIR / digest
+    target = gen_dir / "agent_pb2.py"
+    if not target.exists():
+        gen_dir.mkdir(parents=True, exist_ok=True)
+        with tempfile.TemporaryDirectory() as tmp:
+            proc = subprocess.run(
+                [
+                    "protoc",
+                    f"--proto_path={PROTO_FILE.parent}",
+                    f"--python_out={tmp}",
+                    PROTO_FILE.name,
+                ],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                raise ProtoBuildError(f"protoc failed:\n{proc.stderr}")
+            generated = Path(tmp) / "agent_pb2.py"
+            target.write_bytes(generated.read_bytes())
+    spec = importlib.util.spec_from_file_location(
+        f"langstream_tpu_agent_pb2_{digest}", target
+    )
+    module = importlib.util.module_from_spec(spec)
+    # protobuf-generated modules self-register by module name
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def method_table(pb2) -> dict[str, dict]:
+    """Every RPC of the ExternalAgent service: name → kind + message types.
+    The single source both sides build handlers/stubs from."""
+    return {
+        "agent_info": {
+            "kind": "unary_unary",
+            "request": pb2.InfoRequest,
+            "response": pb2.InfoResponse,
+        },
+        "read": {
+            "kind": "stream_stream",
+            "request": pb2.SourceRequest,
+            "response": pb2.SourceResponse,
+        },
+        "process": {
+            "kind": "stream_stream",
+            "request": pb2.ProcessRequest,
+            "response": pb2.ProcessResponse,
+        },
+        "write": {
+            "kind": "stream_stream",
+            "request": pb2.SinkRequest,
+            "response": pb2.SinkResponse,
+        },
+        "topic_producer_records": {
+            "kind": "stream_stream",
+            "request": pb2.TopicProducerAck,
+            "response": pb2.TopicProducerRecord,
+        },
+    }

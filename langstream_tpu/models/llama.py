@@ -220,6 +220,23 @@ def _swiglu(x, w_gate, w_up, w_down):
     return jnp.einsum("...i,ih->...h", gate * up, w_down)
 
 
+def attention_block(config, x, lp, cos, sin, attention):
+    """Pre-norm attention sub-block + residual: the piece shared verbatim by
+    the dense, MoE, and pipeline-stage forwards (they differ only in FFN and
+    sharding hooks). ``config`` needs heads/kv_heads/head_dim/norm_eps — both
+    LlamaConfig and MoEConfig qualify."""
+    c = config
+    B, S = x.shape[0], x.shape[1]
+    h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+    q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
+    k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
+    v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+    out = attention(q, k, v).reshape(B, S, c.heads * c.head_dim)
+    return x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+
+
 # ---------------------------------------------------------------------------
 # prefill
 # ---------------------------------------------------------------------------
@@ -500,14 +517,7 @@ def llama_forward(
     cos, sin = _rope(positions, c.head_dim, c.rope_theta)
 
     def layer(x, lp):
-        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
-        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
-        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
-        out = attention(q, k, v).reshape(B, S, c.heads * c.head_dim)
-        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+        x = attention_block(c, x, lp, cos, sin, attention)
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
         return constrain(x), None

@@ -32,9 +32,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from langstream_tpu.models.llama import (
-    _apply_rope,
     _rms_norm,
     _rope,
+    attention_block,
 )
 
 
@@ -268,14 +268,7 @@ def moe_forward(
 
     def layer(carry, lp):
         x, aux_total = carry
-        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
-        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
-        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
-        out = attention(q, k, v).reshape(B, S, c.heads * c.head_dim)
-        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+        x = attention_block(c, x, lp, cos, sin, attention)
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         ffn, aux = moe_ffn(
             h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],

@@ -28,9 +28,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from langstream_tpu.models.llama import (
     LlamaConfig,
-    _apply_rope,
     _rms_norm,
     _swiglu,
+    attention_block,
 )
 from langstream_tpu.models.llama import _rope as rope_tables
 
@@ -103,22 +103,17 @@ def pp_layer_specs(layer_specs: dict) -> dict:
     )
 
 
-def _llama_layer(config: LlamaConfig, x: jax.Array, lp: dict, cos, sin):
-    c = config
-    B, S = x.shape[0], x.shape[1]
+def _causal_attention(config):
     from langstream_tpu.parallel.ring import dense_attention
 
-    h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-    q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
-    k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
-    v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
-    q = _apply_rope(q, cos, sin)
-    k = _apply_rope(k, cos, sin)
-    out = dense_attention(
-        q, k, v, causal=True, scale=1.0 / math.sqrt(c.head_dim)
-    ).reshape(B, S, c.heads * c.head_dim)
-    x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
-    h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+    return partial(
+        dense_attention, causal=True, scale=1.0 / math.sqrt(config.head_dim)
+    )
+
+
+def _llama_layer(config: LlamaConfig, x: jax.Array, lp: dict, cos, sin):
+    x = attention_block(config, x, lp, cos, sin, _causal_attention(config))
+    h2 = _rms_norm(x, lp["mlp_norm"], config.norm_eps)
     return x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
@@ -183,7 +178,6 @@ def moe_forward_pp(
     parallelism (ep) + TP automatic *inside* each stage. Returns (logits,
     aux load-balancing loss)."""
     from langstream_tpu.models.moe import moe_ffn
-    from langstream_tpu.parallel.ring import dense_attention
     from jax.sharding import NamedSharding
 
     c = config
@@ -209,22 +203,7 @@ def moe_forward_pp(
 
         def body(carry, lp):
             x, aux_acc = carry
-            h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-            q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(
-                b, S, c.heads, c.head_dim
-            )
-            k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(
-                b, S, c.kv_heads, c.head_dim
-            )
-            v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(
-                b, S, c.kv_heads, c.head_dim
-            )
-            q = _apply_rope(q, cos, sin)
-            k = _apply_rope(k, cos, sin)
-            out = dense_attention(
-                q, k, v, causal=True, scale=1.0 / math.sqrt(c.head_dim)
-            ).reshape(b, S, c.heads * c.head_dim)
-            x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+            x = attention_block(c, x, lp, cos, sin, _causal_attention(c))
             h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
             ffn, aux = moe_ffn(
                 h2, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
