@@ -1,0 +1,8 @@
+class Exclamation:
+    """In-process custom agent: same SDK contract as the sidecar lane."""
+
+    def init(self, config):
+        self.suffix = config.get("suffix", "!")
+
+    def process(self, record):
+        return [(str(record.value) + self.suffix, record.key, None)]

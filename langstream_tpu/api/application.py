@@ -285,6 +285,9 @@ class Application:
     dependencies: list[dict[str, Any]] = field(default_factory=list)
     instance: Instance = field(default_factory=Instance)
     secrets: Secrets = field(default_factory=Secrets)
+    # where the application package lives on disk (its python/ dir feeds
+    # custom agents); None when parsed from an in-memory files map
+    directory: str | None = None
 
     def get_module(self, module_id: str = DEFAULT_MODULE) -> Module:
         if module_id not in self.modules:

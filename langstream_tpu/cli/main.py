@@ -140,6 +140,10 @@ def _collect_files(app_dir: Path) -> dict[str, str]:
         files[path.name] = path.read_text()
     if not files:
         raise click.ClickException(f"no YAML files in {app_dir}")
+    # custom agent code ships with the app (python/ + python/lib/)
+    for pattern in ("python/*.py", "python/lib/*.py"):
+        for path in sorted(app_dir.glob(pattern)):
+            files[path.relative_to(app_dir).as_posix()] = path.read_text()
     return files
 
 
@@ -240,6 +244,110 @@ def apps_logs(name, tenant, api_url) -> None:
         _request("GET", f"{_api_url(api_url)}/api/applications/{tenant}/{name}/logs")
     )
     click.echo(out)
+
+
+@apps.command("diagram")
+@click.option("-app", "--application", "app", required=True, type=click.Path(exists=True))
+@click.option("-i", "--instance", default=None, type=click.Path(exists=True))
+@click.option("-s", "--secrets", default=None, type=click.Path(exists=True))
+def apps_diagram(app, instance, secrets) -> None:
+    """Render the planned pipeline as a Mermaid flowchart (parity:
+    MermaidAppDiagramGenerator)."""
+    from langstream_tpu.core.deployer import ApplicationDeployer
+    from langstream_tpu.core.diagram import mermaid_diagram
+    from langstream_tpu.core.parser import build_application_from_directory
+
+    application = build_application_from_directory(app, instance, secrets)
+    plan = ApplicationDeployer().create_implementation("app", application)
+    click.echo(mermaid_diagram(plan))
+
+
+# ---------------------------------------------------------------------------
+# archetypes + docs
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def archetypes() -> None:
+    """Parameterized application templates."""
+
+
+@archetypes.command("list")
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def archetypes_list(tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request("GET", f"{_api_url(api_url)}/api/archetypes/{tenant}")
+    )
+    click.echo(json.dumps(out, indent=2))
+
+
+@archetypes.command("get")
+@click.argument("archetype_id")
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def archetypes_get(archetype_id, tenant, api_url) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    out = asyncio.run(
+        _request(
+            "GET", f"{_api_url(api_url)}/api/archetypes/{tenant}/{archetype_id}"
+        )
+    )
+    click.echo(json.dumps(out, indent=2))
+
+
+@archetypes.command("deploy")
+@click.argument("archetype_id")
+@click.argument("name")
+@click.option("-p", "--parameter", "parameters", multiple=True,
+              help="name=value (repeatable)")
+@click.option("-i", "--instance", default=None, type=click.Path(exists=True))
+@click.option("-s", "--secrets", default=None, type=click.Path(exists=True))
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def archetypes_deploy(
+    archetype_id, name, parameters, instance, secrets, tenant, api_url
+) -> None:
+    tenant = tenant or _profile().get("tenant", "default")
+    payload: dict = {
+        "parameters": dict(p.split("=", 1) for p in parameters),
+    }
+    if instance:
+        payload["instance"] = Path(instance).read_text()
+    if secrets:
+        payload["secrets"] = Path(secrets).read_text()
+    out = asyncio.run(
+        _request(
+            "POST",
+            f"{_api_url(api_url)}/api/archetypes/{tenant}/{archetype_id}"
+            f"/applications/{name}",
+            json=payload,
+        )
+    )
+    click.echo(json.dumps(out, indent=2))
+
+
+@cli.group()
+def docs() -> None:
+    """Generated documentation."""
+
+
+@docs.command("agents")
+@click.option("--format", "fmt", type=click.Choice(["markdown", "json"]),
+              default="markdown")
+@click.option("-o", "--output", default=None, type=click.Path())
+def docs_agents(fmt, output) -> None:
+    """Agent-type reference generated from the registry (parity:
+    DocumentationGenerator)."""
+    from langstream_tpu.core.docsgen import render_json, render_markdown
+
+    text = render_markdown() if fmt == "markdown" else render_json()
+    if output:
+        Path(output).write_text(text)
+        click.echo(f"wrote {output}")
+    else:
+        click.echo(text)
 
 
 # ---------------------------------------------------------------------------
@@ -411,7 +519,10 @@ def gateway_chat(application, gateway_id, param, credentials, tenant,
 @click.option("-s", "--secrets", default=None, type=click.Path(exists=True))
 @click.option("--api-port", default=8090)
 @click.option("--gateway-port", default=8091)
-def run_local(name, app, instance, secrets, api_port, gateway_port) -> None:
+@click.option("--archetypes", "archetypes_path", default=None,
+              type=click.Path(exists=True), help="archetype templates root")
+def run_local(name, app, instance, secrets, api_port, gateway_port,
+              archetypes_path) -> None:
     """Single-process dev mode (parity: ``langstream docker run``): boots the
     control plane + gateway in-process, deploys the app, serves until ^C."""
     from langstream_tpu.controlplane.server import (
@@ -429,7 +540,10 @@ def run_local(name, app, instance, secrets, api_port, gateway_port) -> None:
         compute = LocalComputeRuntime(gateway_registry=registry)
         store = InMemoryApplicationStore()
         store.put_tenant("default")
-        control = ControlPlaneServer(store=store, compute=compute, port=api_port)
+        control = ControlPlaneServer(
+            store=store, compute=compute, port=api_port,
+            archetypes_path=archetypes_path,
+        )
         gw = GatewayServer(registry=registry, port=gateway_port)
         await control.start()
         await gw.start()

@@ -44,16 +44,7 @@ log = logging.getLogger(__name__)
 def parse_stored(stored: StoredApplication) -> Application:
     builder = ModelBuilder()
     for fname, content in sorted(stored.files.items()):
-        if fname == "configuration.yaml":
-            builder.add_configuration_file(content)
-        elif fname == "gateways.yaml":
-            builder.add_gateways_file(content)
-        elif fname == "secrets.yaml":
-            builder.add_secrets(content)
-        elif fname == "instance.yaml":
-            builder.add_instance(content)
-        else:
-            builder.add_pipeline_file(fname, content)
+        builder.add_named_file(fname, content)
     if stored.instance:
         builder.add_instance(stored.instance)
     if stored.secrets:
@@ -69,6 +60,41 @@ class LocalComputeRuntime:
         self.gateway_registry = gateway_registry
         self.logs: dict[tuple[str, str], deque[str]] = {}
         self._log_handlers: dict[tuple[str, str], logging.Handler] = {}
+        self._code_dirs: dict[tuple[str, str], str] = {}
+
+    def _materialize_code(
+        self,
+        key: tuple[str, str],
+        stored: StoredApplication,
+        application: Application,
+    ) -> None:
+        """Write the app's shipped ``python/`` files to a temp package dir so
+        custom agents can import them (the dev-mode stand-in for the code
+        archive an agent pod's init container downloads)."""
+        if application.directory:
+            return  # parsed straight from a real directory
+        python_files = {
+            name: content
+            for name, content in stored.files.items()
+            if name.startswith("python/")
+        }
+        if not python_files:
+            return
+        import shutil
+        import tempfile
+
+        old = self._code_dirs.pop(key, None)
+        if old:
+            shutil.rmtree(old, ignore_errors=True)
+        code_dir = tempfile.mkdtemp(prefix=f"ls-app-{stored.name}-")
+        from pathlib import Path as _Path
+
+        for name, content in python_files.items():
+            target = _Path(code_dir) / name
+            target.parent.mkdir(parents=True, exist_ok=True)
+            target.write_text(content)
+        self._code_dirs[key] = code_dir
+        application.directory = code_dir
 
     async def deploy(
         self, stored: StoredApplication, application: Application | None = None
@@ -76,6 +102,7 @@ class LocalComputeRuntime:
         if application is None:
             application = parse_stored(stored)
         key = (stored.tenant, stored.name)
+        self._materialize_code(key, stored, application)
         runner = LocalApplicationRunner(
             application, application_id=f"{stored.tenant}-{stored.name}"
         )
@@ -102,6 +129,11 @@ class LocalComputeRuntime:
                 log.exception("error stopping %s/%s", tenant, name)
         self._detach_log_capture(key)
         self.logs.pop(key, None)  # buffers die with the app (no slow leak)
+        code_dir = self._code_dirs.pop(key, None)
+        if code_dir:
+            import shutil
+
+            shutil.rmtree(code_dir, ignore_errors=True)
         if self.gateway_registry is not None:
             self.gateway_registry.unregister(tenant, name)
 
@@ -149,10 +181,12 @@ class ControlPlaneServer:
         store: ApplicationStore | None = None,
         compute: LocalComputeRuntime | None = None,
         port: int = 8090,
+        archetypes_path: str | None = None,
     ):
         self.store = store or InMemoryApplicationStore()
         self.compute = compute or LocalComputeRuntime()
         self.port = port
+        self.archetypes_path = archetypes_path
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes(
             [
@@ -167,6 +201,15 @@ class ControlPlaneServer:
                 web.delete("/api/applications/{tenant}/{name}", self._delete_app),
                 web.get("/api/applications/{tenant}/{name}/logs", self._logs),
                 web.get("/api/applications/{tenant}/{name}/agents", self._agents),
+                # archetypes (parity: ArchetypeResource)
+                web.get("/api/archetypes/{tenant}", self._list_archetypes),
+                web.get("/api/archetypes/{tenant}/{id}", self._get_archetype),
+                web.post(
+                    "/api/archetypes/{tenant}/{id}/applications/{name}",
+                    self._deploy_from_archetype,
+                ),
+                # agent-type documentation (parity: DocumentationGenerator)
+                web.get("/api/docs/agents", self._agent_docs),
             ]
         )
         self._runner: web.AppRunner | None = None
@@ -231,7 +274,13 @@ class ControlPlaneServer:
                 if part.name == "app":
                     with zipfile.ZipFile(io.BytesIO(data)) as zf:
                         for entry in zf.namelist():
-                            if entry.endswith((".yaml", ".yml")) and "/" not in entry.strip("/"):
+                            top_level_yaml = entry.endswith(
+                                (".yaml", ".yml")
+                            ) and "/" not in entry.strip("/")
+                            app_code = entry.startswith("python/") and (
+                                entry.endswith(".py")
+                            )
+                            if top_level_yaml or app_code:
                                 files[entry] = zf.read(entry).decode()
                 elif part.name == "instance":
                     instance = data.decode()
@@ -349,3 +398,66 @@ class ControlPlaneServer:
                 request.match_info["tenant"], request.match_info["name"]
             )
         )
+
+    # ---- archetypes ------------------------------------------------------
+
+    def _archetypes(self):
+        from langstream_tpu.core.archetypes import list_archetypes
+
+        if not self.archetypes_path:
+            return []
+        return list_archetypes(self.archetypes_path)
+
+    async def _list_archetypes(self, request: web.Request) -> web.Response:
+        self._require_tenant(request.match_info["tenant"])
+        return web.json_response(
+            [{"id": a.id, "title": a.title} for a in self._archetypes()]
+        )
+
+    async def _get_archetype(self, request: web.Request) -> web.Response:
+        self._require_tenant(request.match_info["tenant"])
+        wanted = request.match_info["id"]
+        for archetype in self._archetypes():
+            if archetype.id == wanted:
+                return web.json_response(archetype.public_view())
+        raise web.HTTPNotFound(reason=f"unknown archetype {wanted!r}")
+
+    async def _deploy_from_archetype(self, request: web.Request) -> web.Response:
+        from langstream_tpu.core.archetypes import ArchetypeError, instantiate
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        self._require_tenant(tenant)
+        if self.store.get_application(tenant, name) is not None:
+            raise web.HTTPConflict(reason=f"application {name!r} already exists")
+        wanted = request.match_info["id"]
+        archetype = next(
+            (a for a in self._archetypes() if a.id == wanted), None
+        )
+        if archetype is None:
+            raise web.HTTPNotFound(reason=f"unknown archetype {wanted!r}")
+        payload = await request.json() if request.can_read_body else {}
+        try:
+            files = instantiate(archetype, payload.get("parameters") or {})
+        except ArchetypeError as e:
+            raise web.HTTPBadRequest(reason=str(e))
+        # archetype-rendered apps obey the same filename rules as uploads
+        from langstream_tpu.controlplane.stores import validate_filenames
+
+        try:
+            validate_filenames(files)
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=f"archetype renders {e}")
+        stored = StoredApplication(
+            tenant=tenant,
+            name=name,
+            files=files,
+            instance=payload.get("instance"),
+            secrets=payload.get("secrets"),
+        )
+        return await self._do_deploy(stored)
+
+    async def _agent_docs(self, request: web.Request) -> web.Response:
+        from langstream_tpu.core.docsgen import agent_docs
+
+        return web.json_response(agent_docs())

@@ -23,12 +23,16 @@ from pathlib import Path
 from typing import Any
 
 _SAFE_FILENAME = re.compile(r"^[A-Za-z0-9._-]+\.(yaml|yml)$")
+# application python code ships alongside the YAML (the reference ships the
+# whole app dir as a code archive): python/x.py and python/lib/x.py
+_SAFE_PYTHON = re.compile(r"^python/(lib/)?[A-Za-z0-9._-]+\.py$")
 
 
 def validate_filenames(files: dict[str, str]) -> None:
-    """Reject path-traversal / non-YAML names before anything touches disk."""
+    """Reject path-traversal / unexpected names before anything touches disk."""
     for fname in files:
-        if not _SAFE_FILENAME.match(fname) or ".." in fname:
+        ok = _SAFE_FILENAME.match(fname) or _SAFE_PYTHON.match(fname)
+        if not ok or ".." in fname:
             raise ValueError(f"illegal application file name {fname!r}")
 
 

@@ -200,6 +200,7 @@ class ModelBuilder:
         directory = Path(directory)
         if not directory.is_dir():
             raise ApplicationParseError(f"not a directory: {directory}")
+        self.application.directory = str(directory)
         for path in sorted(directory.glob("*.yaml")) + sorted(directory.glob("*.yml")):
             self.add_named_file(path.name, path.read_text())
 

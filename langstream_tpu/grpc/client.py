@@ -44,6 +44,10 @@ log = logging.getLogger("langstream_tpu.grpc.client")
 class SidecarProcess:
     """Spawns and supervises the external agent interpreter."""
 
+    #: max seconds for the child to report its port (covers interpreter boot
+    #: + user-code imports); a wedged boot must fail, not hang the deploy
+    START_TIMEOUT = 60.0
+
     def __init__(self, config: dict[str, Any]):
         self.config = config
         self.process: subprocess.Popen | None = None
@@ -74,14 +78,28 @@ class SidecarProcess:
             env=env,
             text=True,
         )
+        # watchdog: kill the child if it never reports its port, so the
+        # blocking readline below is guaranteed to return
+        import threading
+
+        booted = threading.Event()
+
+        def watchdog() -> None:
+            if not booted.wait(self.START_TIMEOUT) and self.process.poll() is None:
+                log.error("sidecar boot timed out; killing it")
+                self.process.kill()
+
+        threading.Thread(target=watchdog, daemon=True).start()
         for line in self.process.stdout:  # type: ignore[union-attr]
             if line.startswith("PORT="):
+                booted.set()
                 self.port = int(line.strip().split("=", 1)[1])
                 self._start_stdout_drain()
                 return self.port
+        booted.set()
         raise RuntimeError(
-            "sidecar process exited before reporting its port "
-            f"(rc={self.process.poll()})"
+            "sidecar process exited (or timed out) before reporting its "
+            f"port (rc={self.process.poll()})"
         )
 
     def _start_stdout_drain(self) -> None:
@@ -259,6 +277,9 @@ class GrpcAgentProcessor(_GrpcAgentBase, AgentProcessor):
         self._inflight: dict[int, tuple[Record, RecordSink]] = {}
         self._call = None
         self._reader: asyncio.Task | None = None
+        # strong refs: the loop only weak-refs tasks, and a GC'd _send task
+        # would strand its records in _inflight forever
+        self._send_tasks: set[asyncio.Task] = set()
 
     async def start(self) -> None:
         await super().start()
@@ -305,7 +326,9 @@ class GrpcAgentProcessor(_GrpcAgentBase, AgentProcessor):
                 self._escalate(RuntimeError(f"sidecar process lost: {e}"))
 
     def process(self, records: list[Record], sink: RecordSink) -> None:
-        asyncio.ensure_future(self._send(records, sink))
+        task = asyncio.ensure_future(self._send(records, sink))
+        self._send_tasks.add(task)
+        task.add_done_callback(self._send_tasks.discard)
 
     async def _send(self, records: list[Record], sink: RecordSink) -> None:
         try:

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from langstream_tpu.models.quant import as_weight as _w, embedding_take
+
 
 def _flash_mode(seq_len: int) -> str | None:
     """Whether prefill attention should use the Pallas flash kernel.
@@ -215,9 +217,9 @@ def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _swiglu(x, w_gate, w_up, w_down):
-    gate = jax.nn.silu(jnp.einsum("...h,hi->...i", x, w_gate))
-    up = jnp.einsum("...h,hi->...i", x, w_up)
-    return jnp.einsum("...i,ih->...h", gate * up, w_down)
+    gate = jax.nn.silu(jnp.einsum("...h,hi->...i", x, _w(w_gate)))
+    up = jnp.einsum("...h,hi->...i", x, _w(w_up))
+    return jnp.einsum("...i,ih->...h", gate * up, _w(w_down))
 
 
 def attention_block(config, x, lp, cos, sin, attention):
@@ -228,13 +230,13 @@ def attention_block(config, x, lp, cos, sin, attention):
     c = config
     B, S = x.shape[0], x.shape[1]
     h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-    q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, S, c.heads, c.head_dim)
-    k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, S, c.kv_heads, c.head_dim)
-    v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, S, c.kv_heads, c.head_dim)
+    q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"])).reshape(B, S, c.heads, c.head_dim)
+    k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"])).reshape(B, S, c.kv_heads, c.head_dim)
+    v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"])).reshape(B, S, c.kv_heads, c.head_dim)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
     out = attention(q, k, v).reshape(B, S, c.heads * c.head_dim)
-    return x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+    return x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
 
 
 # ---------------------------------------------------------------------------
@@ -258,7 +260,7 @@ def llama_prefill(
     """Process prompts, fill the KV cache, return last-token logits (B, V)."""
     c = config
     B, Pn = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)  # (B, P, H)
+    x = embedding_take(params["embed"], tokens)  # (B, P, H)
     positions = jnp.arange(Pn)[None, :].repeat(B, axis=0)
     cos, sin = _rope(positions, c.head_dim, c.rope_theta)
     # causal + padding mask: (B, 1, P, P)
@@ -275,9 +277,9 @@ def llama_prefill(
         x = carry
         lp, ck_l, cv_l = layer_in
         h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = jnp.einsum("bph,hd->bpd", h, lp["wq"]).reshape(B, Pn, c.heads, c.head_dim)
-        k = jnp.einsum("bph,hd->bpd", h, lp["wk"]).reshape(B, Pn, c.kv_heads, c.head_dim)
-        v = jnp.einsum("bph,hd->bpd", h, lp["wv"]).reshape(B, Pn, c.kv_heads, c.head_dim)
+        q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"])).reshape(B, Pn, c.heads, c.head_dim)
+        k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"])).reshape(B, Pn, c.kv_heads, c.head_dim)
+        v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"])).reshape(B, Pn, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         if flash is not None:
@@ -300,7 +302,7 @@ def llama_prefill(
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
             out = out.reshape(B, Pn, c.heads * c.head_dim)
-        x = x + jnp.einsum("bpd,dh->bph", out, lp["wo"])
+        x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
         # write this layer's K/V into the cache at the given slots
@@ -319,7 +321,7 @@ def llama_prefill(
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].clip(0), axis=1
     ).squeeze(1)
-    logits = jnp.einsum("bh,hv->bv", last, params["lm_head"]).astype(jnp.float32)
+    logits = jnp.einsum("bh,hv->bv", last, _w(params["lm_head"])).astype(jnp.float32)
     return logits, new_k, new_v
 
 
@@ -345,7 +347,7 @@ def llama_decode_step(
     c = config
     B = tokens.shape[0]
     S = cache_k.shape[2]
-    x = jnp.take(params["embed"], tokens, axis=0)  # (B, H)
+    x = embedding_take(params["embed"], tokens)  # (B, H)
     cos, sin = _rope(lengths, c.head_dim, c.rope_theta)  # (B, half)
     k_idx = jnp.arange(S)[None, :]
     key_mask = k_idx <= lengths[:, None]  # (B, S)
@@ -357,9 +359,9 @@ def llama_decode_step(
         x = carry
         lp, ck_l, cv_l = layer_in
         h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = (h @ lp["wq"]).reshape(B, c.heads, c.head_dim)
-        k = (h @ lp["wk"]).reshape(B, c.kv_heads, c.head_dim)
-        v = (h @ lp["wv"]).reshape(B, c.kv_heads, c.head_dim)
+        q = (h @ _w(lp["wq"])).reshape(B, c.heads, c.head_dim)
+        k = (h @ _w(lp["wk"])).reshape(B, c.kv_heads, c.head_dim)
+        v = (h @ _w(lp["wv"])).reshape(B, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         ck_l = ck_l.at[batch_idx, lengths].set(k)
@@ -371,7 +373,7 @@ def llama_decode_step(
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         out = jnp.einsum("bkgs,bskd->bkgd", probs, cv_l)
         out = out.reshape(B, c.heads * c.head_dim)
-        x = x + out @ lp["wo"]
+        x = x + out @ _w(lp["wo"])
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
         return x, (ck_l, cv_l)
@@ -380,7 +382,7 @@ def llama_decode_step(
         layer, x, (params["layers"], cache_k, cache_v)
     )
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ _w(params["lm_head"])).astype(jnp.float32)
     return logits, new_k, new_v
 
 
@@ -395,6 +397,11 @@ def llama_decode_chunk(
     sample_fn,                # (logits, key) -> (tokens, logprobs)
     key: jax.Array,
     num_steps: int,
+    window: int | None = None,  # static attention window: read only cache
+                                # rows [0, window) — the host picks the
+                                # smallest bucket covering max(base_lengths),
+                                # so short sequences don't pay full-S HBM
+                                # traffic (decode is cache-read bound)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps with a two-segment KV layout.
 
@@ -410,6 +417,12 @@ def llama_decode_chunk(
     """
     c = config
     B = tokens0.shape[0]
+    full_k, full_v = cache_k, cache_v
+    if window is not None and window < cache_k.shape[2]:
+        # static slice: XLA reads only these rows; the commit below still
+        # targets the full cache (valid because base_lengths < window)
+        cache_k = jax.lax.slice_in_dim(cache_k, 0, window, axis=2)
+        cache_v = jax.lax.slice_in_dim(cache_v, 0, window, axis=2)
     S = cache_k.shape[2]
     G = c.heads // c.kv_heads
     adv = active.astype(jnp.int32)
@@ -421,7 +434,7 @@ def llama_decode_chunk(
     def step(carry, step_idx):
         tokens, kbuf, vbuf, key = carry
         key, sub = jax.random.split(key)
-        x = jnp.take(params["embed"], tokens, axis=0)  # (B, H)
+        x = embedding_take(params["embed"], tokens)  # (B, H)
         positions = base_lengths + step_idx * adv
         cos, sin = _rope(positions, c.head_dim, c.rope_theta)
         buf_mask = (jnp.arange(num_steps)[None, :] <= step_idx)  # (1, K)
@@ -429,9 +442,9 @@ def llama_decode_chunk(
         def layer(x, layer_in):
             lp, ck_l, cv_l, kbuf_l, vbuf_l = layer_in
             h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-            q = (h @ lp["wq"]).reshape(B, c.heads, c.head_dim)
-            k = (h @ lp["wk"]).reshape(B, c.kv_heads, c.head_dim)
-            v = (h @ lp["wv"]).reshape(B, c.kv_heads, c.head_dim)
+            q = (h @ _w(lp["wq"])).reshape(B, c.heads, c.head_dim)
+            k = (h @ _w(lp["wk"])).reshape(B, c.kv_heads, c.head_dim)
+            v = (h @ _w(lp["wv"])).reshape(B, c.kv_heads, c.head_dim)
             q = _apply_rope(q, cos, sin)
             k = _apply_rope(k, cos, sin)
             kbuf_l = jax.lax.dynamic_update_slice_in_dim(
@@ -455,7 +468,7 @@ def llama_decode_chunk(
                 "bkgt,btkd->bkgd", p_buf, vbuf_l
             )
             out = out.reshape(B, c.heads * c.head_dim)
-            x = x + out @ lp["wo"]
+            x = x + out @ _w(lp["wo"])
             h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
             x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
             return x, (kbuf_l, vbuf_l)
@@ -464,7 +477,7 @@ def llama_decode_chunk(
             layer, x, (params["layers"], cache_k, cache_v, kbuf, vbuf)
         )
         x = _rms_norm(x, params["final_norm"], c.norm_eps)
-        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        logits = (x @ _w(params["lm_head"])).astype(jnp.float32)
         nxt, lp = sample_fn(logits, sub)
         nxt = jnp.where(active, nxt, tokens)
         return (nxt, kbuf, vbuf, key), (nxt, lp)
@@ -480,10 +493,10 @@ def llama_decode_chunk(
     commit = jax.vmap(  # over layers
         jax.vmap(commit_lb, in_axes=(0, 0, 0)), in_axes=(0, 0, None)
     )
-    cache_k = commit(cache_k, kbuf, base_lengths)
-    cache_v = commit(cache_v, vbuf, base_lengths)
+    out_k = commit(full_k, kbuf, base_lengths)
+    out_v = commit(full_v, vbuf, base_lengths)
     final_lengths = base_lengths + num_steps * adv
-    return chunk_tokens, chunk_lps, final_tokens, final_lengths, cache_k, cache_v
+    return chunk_tokens, chunk_lps, final_tokens, final_lengths, out_k, out_v
 
 
 def llama_forward(
@@ -512,7 +525,7 @@ def llama_forward(
         )
     if constrain is None:
         constrain = lambda x: x  # noqa: E731
-    x = constrain(jnp.take(params["embed"], tokens, axis=0))
+    x = constrain(embedding_take(params["embed"], tokens))
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     cos, sin = _rope(positions, c.head_dim, c.rope_theta)
 
@@ -524,7 +537,7 @@ def llama_forward(
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return jnp.einsum("bsh,hv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    return jnp.einsum("bsh,hv->bsv", x, _w(params["lm_head"])).astype(jnp.float32)
 
 
 def llama_forward_sp(

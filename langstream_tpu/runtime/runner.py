@@ -265,6 +265,16 @@ class AgentRunner:
 
         # context + lifecycle
         metrics = PrometheusMetricsReporter(agent_id=self.agent_id)
+        # runtime counters on /metrics (parity: the reference's per-agent
+        # Prometheus counters; scraped by deploy/metrics/prometheus.yml)
+        self._m_records_in = metrics.counter(
+            "records_in", "records read from the source"
+        )
+        self._m_records_out = metrics.counter(
+            "records_out", "records written to the sink"
+        )
+        self._m_errors = metrics.counter("record_errors", "record failures")
+        self._m_pending = metrics.gauge("records_pending", "in-flight records")
         context = AgentContext(
             agent_id=self.node.id,
             global_agent_id=self.agent_id,
@@ -313,6 +323,12 @@ class AgentRunner:
         }
         cfg["__globals__"] = self.plan.application.instance.globals_
         cfg["__application_id__"] = self.plan.application_id
+        if self.plan.application.directory:
+            # custom python/sidecar agents resolve their code relative to
+            # the application package (its python/ dir)
+            cfg.setdefault(
+                "__application_directory__", self.plan.application.directory
+            )
         await agent.init(cfg)
         return agent
 
@@ -354,7 +370,9 @@ class AgentRunner:
                     await asyncio.sleep(0)
                     continue
                 self.records_in += len(records)
+                self._m_records_in(len(records))
                 self._inflight += len(records)
+                self._m_pending(self._inflight)
                 self.processor.process(records, self.record_sink)
                 await asyncio.sleep(0)
         except Exception as e:  # loop-level failure is fatal for the replica
@@ -367,6 +385,7 @@ class AgentRunner:
             return
         self.errors_handler.clear(result.source_record)
         self._inflight = max(0, self._inflight - 1)
+        self._m_pending(self._inflight)
         self.tracker.track(result.source_record, len(result.results))
         if not result.results:
             await self.tracker.commit_if_tracked_empty(result.source_record)
@@ -375,6 +394,7 @@ class AgentRunner:
             try:
                 await self.sink.write(record)
                 self.records_out += 1
+                self._m_records_out(1)
                 await self.tracker.record_written(result.source_record)
             except Exception as e:
                 await self.tracker.record_failed(result.source_record)
@@ -384,12 +404,14 @@ class AgentRunner:
 
     async def _handle_error(self, source_record: Record, error: Exception) -> None:
         self.errors_total += 1
+        self._m_errors(1)
         action = self.errors_handler.handle(source_record, error)
         if action == FailureAction.RETRY:
             # single-record retry, documented out-of-order; stays in flight
             self.processor.process([source_record], self.record_sink)
             return
         self._inflight = max(0, self._inflight - 1)
+        self._m_pending(self._inflight)
         if action == FailureAction.SKIP:
             await self.tracker.commit_now(source_record)
         elif action == FailureAction.DEAD_LETTER:

@@ -86,11 +86,14 @@ class ServingConfig:
     decode_chunk: int = 16
     # max requests prefilled in one batched call
     prefill_batch: int = 8
+    # weight-only quantization: None (bf16) or "int8" (single-chip only)
+    quantize: str | None = None
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ServingConfig":
         mesh = tuple((k, int(v)) for k, v in (d.get("mesh") or {}).items())
         return cls(
+            quantize=d.get("quantize"),
             model=d.get("model", "tiny"),
             slots=int(d.get("slots", 8)),
             max_seq_len=int(d.get("max-seq-len", d.get("max_seq_len", 512))),
@@ -216,6 +219,17 @@ class TpuServingEngine:
                 "weights (offline/dev mode)", self.config.model,
             )
             self.params = init_llama_params(mc)
+        if self.config.quantize == "int8":
+            if self.mesh is not None:
+                raise ValueError(
+                    "quantize=int8 is single-chip only (QTensor sharding "
+                    "specs not implemented); drop the mesh or the quantize"
+                )
+            from langstream_tpu.models.quant import quantize_llama_params
+
+            self.params = quantize_llama_params(self.params)
+        elif self.config.quantize not in (None, "none"):
+            raise ValueError(f"unknown quantize mode {self.config.quantize!r}")
         cache_k, cache_v = init_kv_cache(mc, self.config.slots)
 
         if self.mesh is not None:
@@ -236,13 +250,15 @@ class TpuServingEngine:
         mc_static = mc
         K = self.config.decode_chunk
 
-        def _make_decode(use_top_p: bool):
+        def _make_decode(use_top_p: bool, window: int | None):
             @partial(jax.jit, donate_argnums=(1, 2))
             def _decode_chunk(params, cache_k, cache_v, tokens, lengths, active,
                               key, temps, topks, topps):
                 """K fused decode steps; one host round-trip per chunk. The
                 big cache is read-only inside the chunk (llama_decode_chunk)
-                — per-step HBM traffic is params+cache *read* only."""
+                — per-step HBM traffic is params+cache *read* only, and the
+                static ``window`` caps the cache read to the smallest bucket
+                covering the longest active sequence."""
                 from langstream_tpu.models.llama import llama_decode_chunk
 
                 def sample_fn(logits, sub):
@@ -253,10 +269,12 @@ class TpuServingEngine:
 
                 return llama_decode_chunk(
                     mc_static, params, tokens, lengths, active,
-                    cache_k, cache_v, sample_fn, key, K,
+                    cache_k, cache_v, sample_fn, key, K, window=window,
                 )
 
             return _decode_chunk
+
+        self._make_decode = _make_decode
 
         def _make_prefill(use_top_p: bool):
             @partial(jax.jit, donate_argnums=(1, 2))
@@ -277,9 +295,26 @@ class TpuServingEngine:
             return _prefill
 
         # top-p costs a vocab sort per step, so it's a separate compiled
-        # variant selected only when an active request asks for it
-        self._decode_chunk_fns = {p: _make_decode(p) for p in (False, True)}
+        # variant selected only when an active request asks for it; decode
+        # additionally specialises per attention window bucket (compiled
+        # lazily on first use of each bucket)
+        self._decode_chunk_fns: dict[tuple[bool, int | None], Any] = {}
         self._prefill_fns = {p: _make_prefill(p) for p in (False, True)}
+
+    def _decode_fn(self, use_top_p: bool, window: int | None):
+        key = (use_top_p, window)
+        if key not in self._decode_chunk_fns:
+            self._decode_chunk_fns[key] = self._make_decode(use_top_p, window)
+        return self._decode_chunk_fns[key]
+
+    def _window_for(self, max_len: int) -> int | None:
+        """Smallest power-of-two cache window covering ``max_len`` rows (the
+        chunk's new tokens live in the chunk buffer, not the window)."""
+        S = self.model_config.max_seq_len
+        w = 128
+        while w < max_len:
+            w *= 2
+        return None if w >= S else w
 
     # ------------------------------------------------------------------
     # public API
@@ -407,12 +442,15 @@ class TpuServingEngine:
         temps = jnp.asarray(self._temps)
         topks = jnp.asarray(self._topks)
         topps = jnp.asarray(self._topps)
-        decode_fn = self._decode_chunk_fns[
-            bool((self._topps[active_mask] < 1.0).any())
-        ]
+        use_top_p = bool((self._topps[active_mask] < 1.0).any())
+        K = self.config.decode_chunk
+        # host-tracked longest active sequence: each dispatched chunk grows
+        # it by K; the attention window bucket follows
+        base_max = int(self._lengths[active].max())
 
-        def _dispatch(tokens, lengths, key):
+        def _dispatch(tokens, lengths, key, window):
             # async JAX dispatch: returns device arrays without blocking
+            decode_fn = self._decode_fn(use_top_p, window)
             chunk_t, chunk_lp, t, l, ck, cv = decode_fn(
                 self.params, self.cache_k, self.cache_v,
                 tokens, lengths, amask, key, temps, topks, topps,
@@ -423,14 +461,18 @@ class TpuServingEngine:
         out = await loop.run_in_executor(
             self._executor,
             partial(
-                _dispatch, jnp.asarray(self._current), jnp.asarray(self._lengths), key1
+                _dispatch, jnp.asarray(self._current), jnp.asarray(self._lengths),
+                key1, self._window_for(base_max),
             ),
         )
         while True:
             # speculate the next chunk from device state
+            base_max += K
             key_next = self._split_key()
             next_out_task = loop.run_in_executor(
-                self._executor, partial(_dispatch, out[2], out[3], key_next)
+                self._executor,
+                partial(_dispatch, out[2], out[3], key_next,
+                        self._window_for(base_max)),
             )
             chunk_t, chunk_lp = await loop.run_in_executor(
                 self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))

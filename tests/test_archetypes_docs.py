@@ -1,0 +1,177 @@
+"""Archetypes, diagram, docs generation + the examples tree itself.
+
+Every example application must parse and plan (the role the reference's 36
+sample apps play as living documentation — here they are also golden tests).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from langstream_tpu.core.archetypes import (
+    ArchetypeError,
+    instantiate,
+    list_archetypes,
+    load_archetype,
+)
+from langstream_tpu.core.deployer import ApplicationDeployer
+from langstream_tpu.core.diagram import mermaid_diagram
+from langstream_tpu.core.docsgen import agent_docs, render_json, render_markdown
+from langstream_tpu.core.parser import (
+    build_application_from_directory,
+    build_application_from_files,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+EXAMPLES = REPO / "examples"
+
+
+# ---------------------------------------------------------------------------
+# examples are golden tests
+# ---------------------------------------------------------------------------
+
+EXAMPLE_APPS = sorted(
+    p for p in (EXAMPLES / "applications").iterdir() if p.is_dir()
+)
+
+
+@pytest.mark.parametrize("app_dir", EXAMPLE_APPS, ids=lambda p: p.name)
+def test_example_application_plans(app_dir):
+    app = build_application_from_directory(
+        app_dir,
+        instance=EXAMPLES / "instances" / "memory.yaml",
+        secrets=EXAMPLES / "secrets" / "secrets.yaml",
+    )
+    plan = ApplicationDeployer().create_implementation("example", app)
+    assert plan.agents, f"{app_dir.name}: no agents planned"
+    # every agent type is known to the registry
+    from langstream_tpu.api.registry import AgentCodeRegistry
+
+    known = AgentCodeRegistry.known_types()
+    for node in plan.agents.values():
+        for agent in node.agents:
+            assert agent.type in known, f"unknown agent type {agent.type!r}"
+
+
+@pytest.mark.parametrize(
+    "instance_file",
+    sorted((EXAMPLES / "instances").glob("*.yaml")),
+    ids=lambda p: p.name,
+)
+def test_example_instances_parse(instance_file):
+    app = build_application_from_files(
+        {"pipeline.yaml": "topics:\n  - name: t\n"},
+        instance=instance_file.read_text(),
+    )
+    assert app.instance.streaming_cluster.type
+
+
+# ---------------------------------------------------------------------------
+# archetypes
+# ---------------------------------------------------------------------------
+
+
+def test_archetype_load_and_instantiate():
+    archetypes = list_archetypes(EXAMPLES / "archetypes")
+    assert [a.id for a in archetypes] == ["chatbot"]
+    chatbot = load_archetype(EXAMPLES / "archetypes" / "chatbot")
+    assert chatbot.parameters[0].name == "model"
+
+    files = instantiate(chatbot, {"model": "tiny", "slots": 4})
+    assert 'model: "tiny"' in files["pipeline.yaml"]
+    assert "slots: 4" in files["configuration.yaml"]
+    # defaults apply
+    assert "helpful assistant" in files["pipeline.yaml"]
+    # the rendered app actually plans
+    app = build_application_from_files(
+        files, instance="instance:\n  streamingCluster:\n    type: memory\n"
+    )
+    plan = ApplicationDeployer().create_implementation("chatbot", app)
+    assert plan.agents
+
+
+def test_archetype_parameter_validation():
+    chatbot = load_archetype(EXAMPLES / "archetypes" / "chatbot")
+    with pytest.raises(ArchetypeError, match="missing required"):
+        instantiate(chatbot, {})
+    with pytest.raises(ArchetypeError, match="unknown parameters"):
+        instantiate(chatbot, {"model": "tiny", "nope": 1})
+
+
+def test_archetype_endpoints(run_async):
+    import aiohttp
+
+    from langstream_tpu.controlplane.server import ControlPlaneServer
+
+    async def main():
+        server = ControlPlaneServer(
+            port=18990, archetypes_path=str(EXAMPLES / "archetypes")
+        )
+        server.store.put_tenant("default")
+        await server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    "http://127.0.0.1:18990/api/archetypes/default"
+                ) as r:
+                    assert (await r.json()) == [
+                        {"id": "chatbot", "title": "TPU chatbot"}
+                    ]
+                async with session.get(
+                    "http://127.0.0.1:18990/api/archetypes/default/chatbot"
+                ) as r:
+                    detail = await r.json()
+                    assert detail["parameters"][0]["name"] == "model"
+                async with session.post(
+                    "http://127.0.0.1:18990/api/archetypes/default/chatbot"
+                    "/applications/mybot",
+                    json={
+                        "parameters": {"model": "tiny", "slots": 2},
+                        "instance": (
+                            "instance:\n  streamingCluster:\n    type: memory\n"
+                        ),
+                    },
+                ) as r:
+                    body = await r.json()
+                    assert r.status == 200, body
+                    assert body["status"]["status"] == "DEPLOYED"
+                async with session.get(
+                    "http://127.0.0.1:18990/api/docs/agents"
+                ) as r:
+                    docs = await r.json()
+                    assert "ai-chat-completions" in docs
+        finally:
+            await server.stop()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# diagram + docs
+# ---------------------------------------------------------------------------
+
+
+def test_mermaid_diagram():
+    app = build_application_from_directory(
+        EXAMPLES / "applications" / "chat-completions",
+        instance=EXAMPLES / "instances" / "memory.yaml",
+    )
+    plan = ApplicationDeployer().create_implementation("app", app)
+    diagram = mermaid_diagram(plan)
+    assert diagram.startswith("flowchart LR")
+    assert 'T_questions_topic[("questions-topic")]' in diagram
+    assert "gateway: user-input (produce)" in diagram
+    assert "-->" in diagram
+
+
+def test_docs_generation():
+    docs = agent_docs()
+    assert docs["ai-chat-completions"]["component-type"] == "processor"
+    assert "model" in docs["ai-chat-completions"]["configuration"]
+    assert docs["webcrawler"]["component-type"] == "source"
+    md = render_markdown()
+    assert "## `compute-ai-embeddings`" in md
+    assert "| `batch-size` |" in md
+    assert render_json().startswith("{")
