@@ -1,0 +1,15 @@
+"""Authentication: JWT validation + gateway user-auth providers.
+
+Parity: ``langstream-auth-jwt`` (token validation incl. JWKS fetch,
+``AuthenticationProviderToken.java`` / ``JwksUriSigningKeyResolver.java``)
+and ``langstream-api-gateway-auth`` (google/github/jwt/http providers).
+"""
+
+from langstream_tpu.auth.jwt import (
+    JwtError,
+    JwtValidator,
+    decode_unverified,
+    encode_hs256,
+)
+
+__all__ = ["JwtError", "JwtValidator", "decode_unverified", "encode_hs256"]

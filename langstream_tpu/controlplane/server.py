@@ -182,12 +182,35 @@ class ControlPlaneServer:
         compute: LocalComputeRuntime | None = None,
         port: int = 8090,
         archetypes_path: str | None = None,
+        admin_auth: dict[str, Any] | None = None,
     ):
         self.store = store or InMemoryApplicationStore()
         self.compute = compute or LocalComputeRuntime()
         self.port = port
         self.archetypes_path = archetypes_path
-        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        middlewares = []
+        if admin_auth:
+            # admin JWT on every /api route (parity: TokenAuthFilter)
+            from langstream_tpu.auth.jwt import JwtError, JwtValidator
+
+            validator = JwtValidator.from_config(admin_auth)
+
+            @web.middleware
+            async def auth_middleware(request, handler):
+                auth_header = request.headers.get("Authorization", "")
+                token = auth_header.removeprefix("Bearer ").strip()
+                if not token:
+                    raise web.HTTPUnauthorized(reason="missing bearer token")
+                try:
+                    request["principal"] = validator.validate(token)
+                except JwtError as e:
+                    raise web.HTTPUnauthorized(reason=str(e))
+                return await handler(request)
+
+            middlewares.append(auth_middleware)
+        self.app = web.Application(
+            client_max_size=64 * 1024 * 1024, middlewares=middlewares
+        )
         self.app.add_routes(
             [
                 web.get("/api/tenants", self._list_tenants),
@@ -350,11 +373,38 @@ class ControlPlaneServer:
         if application is None:
             try:
                 application = parse_stored(stored)
-                ApplicationDeployer().create_implementation(
+                plan = ApplicationDeployer().create_implementation(
                     f"{stored.tenant}-{stored.name}", application
                 )
             except Exception as e:
                 raise web.HTTPBadRequest(reason=f"invalid application: {e}")
+        else:
+            plan = ApplicationDeployer().create_implementation(
+                f"{stored.tenant}-{stored.name}", application
+            )
+        # per-tenant unit quota (parity: ApplicationService.java:98-121):
+        # a unit = parallelism × size; the app's own previous usage releases
+        stored.units = sum(
+            max(1, node.resources.parallelism) * max(1, node.resources.size)
+            for node in plan.agents.values()
+        )
+        max_units = (self.store.list_tenants().get(stored.tenant) or {}).get(
+            "max-units"
+        )
+        if max_units is not None:
+            used = sum(
+                (self.store.get_application(stored.tenant, other) or
+                 StoredApplication(stored.tenant, other, {})).units
+                for other in self.store.list_applications(stored.tenant)
+                if other != stored.name
+            )
+            if used + stored.units > int(max_units):
+                raise web.HTTPConflict(
+                    reason=(
+                        f"tenant quota exceeded: {used} units in use, "
+                        f"{stored.units} requested, limit {max_units}"
+                    )
+                )
         stored.status = "DEPLOYING"
         self.store.put_application(stored)
         try:

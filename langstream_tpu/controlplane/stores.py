@@ -46,6 +46,10 @@ class StoredApplication:
     status: str = "CREATED"                # CREATED | DEPLOYING | DEPLOYED | ERROR | DELETING
     error: str | None = None
     created_at: float = field(default_factory=time.time)
+    # resource units (Σ parallelism × size over agents) — computed at deploy,
+    # consumed by the tenant quota check (parity: per-tenant unit quotas,
+    # ApplicationService.java:98-121)
+    units: int = 0
 
     def public_view(self) -> dict[str, Any]:
         return {
@@ -53,6 +57,7 @@ class StoredApplication:
             "tenant": self.tenant,
             "status": {"status": self.status, "error": self.error},
             "created-at": self.created_at,
+            "units": self.units,
             "files": sorted(self.files),
         }
 
@@ -148,11 +153,14 @@ class FileSystemApplicationStore(ApplicationStore):
         files_dir = d / "files"
         files_dir.mkdir(parents=True, exist_ok=True)
         for fname, content in app.files.items():
-            (files_dir / fname).write_text(content)
+            target = files_dir / fname
+            target.parent.mkdir(parents=True, exist_ok=True)  # python/ code
+            target.write_text(content)
         meta = {
             "status": app.status,
             "error": app.error,
             "created_at": app.created_at,
+            "units": app.units,
         }
         (d / "meta.json").write_text(json.dumps(meta))
         if app.instance is not None:
@@ -166,8 +174,8 @@ class FileSystemApplicationStore(ApplicationStore):
             return None
         meta = json.loads((d / "meta.json").read_text())
         files = {
-            f.name: f.read_text()
-            for pattern in ("*.yaml", "*.yml")
+            f.relative_to(d / "files").as_posix(): f.read_text()
+            for pattern in ("*.yaml", "*.yml", "python/*.py", "python/lib/*.py")
             for f in (d / "files").glob(pattern)
         }
         instance = (
@@ -185,6 +193,7 @@ class FileSystemApplicationStore(ApplicationStore):
             status=meta.get("status", "CREATED"),
             error=meta.get("error"),
             created_at=meta.get("created_at", 0),
+            units=int(meta.get("units", 0)),
         )
 
     def delete_application(self, tenant: str, name: str) -> None:

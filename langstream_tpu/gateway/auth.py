@@ -67,12 +67,26 @@ def register_auth_provider(name: str, cls: type[GatewayAuthenticationProvider]) 
     _PROVIDERS[name] = cls
 
 
+def _ensure_providers() -> None:
+    if "jwt" not in _PROVIDERS:
+        from langstream_tpu.auth.providers import (
+            GithubAuthenticationProvider,
+            GoogleAuthenticationProvider,
+            JwtAuthenticationProvider,
+        )
+
+        _PROVIDERS["jwt"] = JwtAuthenticationProvider
+        _PROVIDERS["google"] = GoogleAuthenticationProvider
+        _PROVIDERS["github"] = GithubAuthenticationProvider
+
+
 def get_auth_provider(
     name: str, configuration: dict[str, Any]
 ) -> GatewayAuthenticationProvider:
+    _ensure_providers()
     if name not in _PROVIDERS:
         raise AuthenticationException(
             f"unknown auth provider {name!r}; available: {sorted(_PROVIDERS)} "
-            f"(google/github/jwt gate on network access)"
+            f"(google/github need outbound network)"
         )
     return _PROVIDERS[name](configuration)

@@ -90,6 +90,7 @@ class KubernetesApplicationStore(ApplicationStore):
                 "files": app.files,
                 "instance": app.instance,
                 "created_at": app.created_at,
+                "units": app.units,
             }
         )
         cr = ApplicationCustomResource(
@@ -144,6 +145,7 @@ class KubernetesApplicationStore(ApplicationStore):
             status=(cr.status or {}).get("status", "CREATED"),
             error=(cr.status or {}).get("error"),
             created_at=payload.get("created_at", 0),
+            units=int(payload.get("units", 0)),
         )
 
     def delete_application(self, tenant: str, name: str) -> None:

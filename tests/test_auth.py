@@ -1,0 +1,241 @@
+"""JWT validation, gateway auth providers, admin token filter, quotas."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from langstream_tpu.auth.jwt import (
+    JwtError,
+    JwtValidator,
+    decode_unverified,
+    encode_hs256,
+)
+
+
+# ---------------------------------------------------------------------------
+# HS256
+# ---------------------------------------------------------------------------
+
+
+def test_hs256_roundtrip_and_claims():
+    token = encode_hs256({"sub": "alice", "role": "admin"}, "s3cret")
+    header, claims = decode_unverified(token)
+    assert header["alg"] == "HS256" and claims["sub"] == "alice"
+    out = JwtValidator(secret="s3cret").validate(token)
+    assert out["sub"] == "alice" and out["role"] == "admin"
+
+
+def test_hs256_rejects_bad_signature_and_expiry():
+    v = JwtValidator(secret="right")
+    with pytest.raises(JwtError, match="signature"):
+        v.validate(encode_hs256({"sub": "x"}, "wrong"))
+    with pytest.raises(JwtError, match="expired"):
+        v.validate(encode_hs256({"exp": time.time() - 3600}, "right"))
+    with pytest.raises(JwtError, match="not yet valid"):
+        v.validate(encode_hs256({"nbf": time.time() + 3600}, "right"))
+
+
+def test_audience_and_issuer_checks():
+    v = JwtValidator(secret="s", audience="my-api", issuer="me")
+    good = encode_hs256({"aud": ["other", "my-api"], "iss": "me"}, "s")
+    assert v.validate(good)["iss"] == "me"
+    with pytest.raises(JwtError, match="audience"):
+        v.validate(encode_hs256({"aud": "other", "iss": "me"}, "s"))
+    with pytest.raises(JwtError, match="issuer"):
+        v.validate(encode_hs256({"aud": "my-api", "iss": "them"}, "s"))
+
+
+# ---------------------------------------------------------------------------
+# RS256 (local keypair via cryptography)
+# ---------------------------------------------------------------------------
+
+
+def _rs256_token_and_jwk(claims: dict) -> tuple[str, dict]:
+    import base64
+    import json
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    numbers = key.public_key().public_numbers()
+
+    def b64url(data: bytes) -> str:
+        return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+    def int_b64(i: int) -> str:
+        length = (i.bit_length() + 7) // 8
+        return b64url(i.to_bytes(length, "big"))
+
+    header = b64url(json.dumps({"alg": "RS256", "kid": "k1"}).encode())
+    payload = b64url(json.dumps(claims).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+    token = f"{header}.{payload}.{b64url(sig)}"
+    jwk = {"kty": "RSA", "kid": "k1", "n": int_b64(numbers.n), "e": int_b64(numbers.e)}
+    return token, jwk
+
+
+def test_rs256_with_public_jwk():
+    token, jwk = _rs256_token_and_jwk({"sub": "svc"})
+    assert JwtValidator(public_jwk=jwk).validate(token)["sub"] == "svc"
+    # tampered payload fails
+    head, payload, sig = token.split(".")
+    bad = f"{head}.{payload[:-2]}AA.{sig}"
+    with pytest.raises(JwtError):
+        JwtValidator(public_jwk=jwk).validate(bad)
+
+
+def test_jwks_host_allowlist():
+    from langstream_tpu.auth.jwt import JwksCache
+
+    cache = JwksCache(allowed_hosts=["trusted.example.com"])
+    with pytest.raises(JwtError, match="allowlist"):
+        cache.get("https://evil.example.com/jwks.json")
+
+
+# ---------------------------------------------------------------------------
+# gateway providers
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_jwt_provider(run_async):
+    from langstream_tpu.gateway.auth import (
+        AuthenticationException,
+        get_auth_provider,
+    )
+
+    async def main():
+        provider = get_auth_provider("jwt", {"secret": "gw-secret"})
+        claims = await provider.authenticate(
+            encode_hs256({"sub": "user-1"}, "gw-secret")
+        )
+        assert claims["subject"] == "user-1"
+        with pytest.raises(AuthenticationException):
+            await provider.authenticate("not-a-token")
+        with pytest.raises(AuthenticationException):
+            await provider.authenticate(None)
+
+    run_async(main())
+
+
+def test_google_github_gate_cleanly(run_async):
+    """Offline: the providers must raise AuthenticationException, not hang
+    or crash with an unrelated error."""
+    from langstream_tpu.gateway.auth import (
+        AuthenticationException,
+        get_auth_provider,
+    )
+
+    async def main():
+        google = get_auth_provider("google", {"clientId": "cid"})
+        with pytest.raises(AuthenticationException):
+            await google.authenticate("fake-id-token")
+        github = get_auth_provider("github", {})
+        with pytest.raises(AuthenticationException):
+            await github.authenticate("gho_fake")
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# control plane: admin filter + quotas
+# ---------------------------------------------------------------------------
+
+PIPELINE = """
+topics:
+  - name: "in-t"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "noop"
+    type: "compute"
+    input: "in-t"
+    resources:
+      parallelism: {par}
+    configuration:
+      fields: []
+"""
+
+INSTANCE = "instance:\n  streamingCluster:\n    type: memory\n"
+
+
+def test_admin_token_filter(run_async):
+    import aiohttp
+
+    from langstream_tpu.controlplane.server import ControlPlaneServer
+
+    async def main():
+        server = ControlPlaneServer(
+            port=18991, admin_auth={"secret": "admin-secret"}
+        )
+        await server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    "http://127.0.0.1:18991/api/tenants"
+                ) as r:
+                    assert r.status == 401
+                token = encode_hs256({"sub": "admin"}, "admin-secret")
+                async with session.get(
+                    "http://127.0.0.1:18991/api/tenants",
+                    headers={"Authorization": f"Bearer {token}"},
+                ) as r:
+                    assert r.status == 200
+                bad = encode_hs256({"sub": "admin"}, "other")
+                async with session.get(
+                    "http://127.0.0.1:18991/api/tenants",
+                    headers={"Authorization": f"Bearer {bad}"},
+                ) as r:
+                    assert r.status == 401
+        finally:
+            await server.stop()
+
+    run_async(main())
+
+
+def test_tenant_unit_quota(run_async):
+    import aiohttp
+
+    from langstream_tpu.controlplane.server import ControlPlaneServer
+
+    async def main():
+        server = ControlPlaneServer(port=18992)
+        server.store.put_tenant("q", {"max-units": 3})
+        await server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    "http://127.0.0.1:18992/api/applications/q/app1",
+                    json={
+                        "files": {"pipeline.yaml": PIPELINE.format(par=2)},
+                        "instance": INSTANCE,
+                    },
+                ) as r:
+                    body = await r.json()
+                    assert r.status == 200, body
+                    assert body["units"] == 2
+                # 2 units used; another 2 exceeds the 3-unit quota
+                async with session.post(
+                    "http://127.0.0.1:18992/api/applications/q/app2",
+                    json={
+                        "files": {"pipeline.yaml": PIPELINE.format(par=2)},
+                        "instance": INSTANCE,
+                    },
+                ) as r:
+                    assert r.status == 409
+                    assert "quota" in (await r.text())
+                # 1 unit fits
+                async with session.post(
+                    "http://127.0.0.1:18992/api/applications/q/app3",
+                    json={
+                        "files": {"pipeline.yaml": PIPELINE.format(par=1)},
+                        "instance": INSTANCE,
+                    },
+                ) as r:
+                    assert r.status == 200
+        finally:
+            await server.stop()
+
+    run_async(main())
