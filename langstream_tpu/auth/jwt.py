@@ -14,6 +14,7 @@ import base64
 import hashlib
 import hmac
 import json
+import math
 import time
 import urllib.request
 from typing import Any
@@ -183,9 +184,20 @@ class JwtValidator:
         if not isinstance(claims, dict):
             raise JwtError("claims payload is not an object")
         now = time.time()
-        if "exp" in claims and now > float(claims["exp"]) + self.leeway:
+        try:
+            exp = float(claims["exp"]) if "exp" in claims else None
+            nbf = float(claims["nbf"]) if "nbf" in claims else None
+        except (TypeError, ValueError) as e:
+            raise JwtError(f"non-numeric exp/nbf claim: {e}") from e
+        # float() also accepts "NaN"/"Infinity", which would make every
+        # time comparison below vacuously pass (never expires)
+        if (exp is not None and not math.isfinite(exp)) or (
+            nbf is not None and not math.isfinite(nbf)
+        ):
+            raise JwtError("non-finite exp/nbf claim")
+        if exp is not None and now > exp + self.leeway:
             raise JwtError("token expired")
-        if "nbf" in claims and now < float(claims["nbf"]) - self.leeway:
+        if nbf is not None and now < nbf - self.leeway:
             raise JwtError("token not yet valid")
         if self.audience is not None:
             aud = claims.get("aud")

@@ -53,6 +53,13 @@ class GoogleAuthenticationProvider(GatewayAuthenticationProvider):
     def __init__(self, configuration: dict[str, Any]):
         super().__init__(configuration)
         self.client_id = configuration.get("clientId")
+        if not self.client_id:
+            # without an audience check any valid Google ID token (minted
+            # for any OAuth client) would authenticate — refuse to
+            # construct (this fails deploy-time gateway validation)
+            raise AuthenticationException(
+                "google auth provider requires 'clientId' (token audience)"
+            )
         # one validator per provider: JwksCache amortizes the JWKS fetch
         # across requests (per-call construction would re-fetch every login)
         self.validator = JwtValidator(

@@ -35,6 +35,7 @@ from langstream_tpu.controlplane.stores import (
     StoredApplication,
 )
 from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.gateway.auth import validate_gateway_authentication
 from langstream_tpu.gateway.server import GatewayRegistry
 from langstream_tpu.runtime.local_runner import LocalApplicationRunner
 
@@ -358,6 +359,9 @@ class ControlPlaneServer:
             ApplicationDeployer().create_implementation(
                 f"{stored.tenant}-{stored.name}", application
             )
+            validate_gateway_authentication(application.gateways)
+        except web.HTTPException:
+            raise
         except Exception as e:
             raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         await self.compute.undeploy(tenant, name)
@@ -376,6 +380,7 @@ class ControlPlaneServer:
                 plan = ApplicationDeployer().create_implementation(
                     f"{stored.tenant}-{stored.name}", application
                 )
+                validate_gateway_authentication(application.gateways)
             except Exception as e:
                 raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         else:

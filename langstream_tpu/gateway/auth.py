@@ -80,13 +80,44 @@ def _ensure_providers() -> None:
         _PROVIDERS["github"] = GithubAuthenticationProvider
 
 
+# Constructed providers are memoized by (name, config): gateways resolve
+# their provider on every request, and per-request construction would both
+# rebuild validator state (defeating e.g. the google JWKS cache) and defer
+# construction-time config validation to the first login.
+_INSTANCES: dict[tuple[str, str], GatewayAuthenticationProvider] = {}
+
+
 def get_auth_provider(
     name: str, configuration: dict[str, Any]
 ) -> GatewayAuthenticationProvider:
+    import json
+
     _ensure_providers()
     if name not in _PROVIDERS:
         raise AuthenticationException(
             f"unknown auth provider {name!r}; available: {sorted(_PROVIDERS)} "
             f"(google/github need outbound network)"
         )
-    return _PROVIDERS[name](configuration)
+    key = (name, json.dumps(configuration, sort_keys=True, default=str))
+    provider = _INSTANCES.get(key)
+    if provider is None:
+        provider = _INSTANCES[key] = _PROVIDERS[name](configuration)
+    return provider
+
+
+def validate_gateway_authentication(gateways) -> None:
+    """Construct every gateway's auth provider once at deploy/update
+    validation time so misconfiguration (e.g. google without clientId)
+    fails the deploy instead of surfacing as per-login 401s."""
+    for gw in gateways or []:
+        auth = getattr(gw, "authentication", None)
+        if not auth:
+            continue
+        name = auth.get("provider", "test")
+        try:
+            get_auth_provider(name, auth.get("configuration", {}))
+        except AuthenticationException as e:
+            gw_id = getattr(gw, "id", None) or "?"
+            raise ValueError(
+                f"gateway {gw_id!r}: invalid authentication ({name}): {e}"
+            ) from e

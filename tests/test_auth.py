@@ -239,3 +239,74 @@ def test_tenant_unit_quota(run_async):
             await server.stop()
 
     run_async(main())
+
+
+def test_google_provider_requires_client_id(run_async):
+    """A missing clientId would silently disable the audience check and
+    accept any OAuth client's tokens — must refuse to construct instead."""
+    from langstream_tpu.gateway.auth import (
+        AuthenticationException,
+        get_auth_provider,
+    )
+
+    async def main():
+        with pytest.raises(AuthenticationException, match="clientId"):
+            get_auth_provider("google", {})
+
+    run_async(main())
+
+
+def test_non_numeric_exp_nbf_raise_jwt_error():
+    """Garbage exp/nbf in a validly signed token must map to JwtError (→401),
+    not leak TypeError/ValueError (→500)."""
+    v = JwtValidator(secret="s")
+    with pytest.raises(JwtError, match="exp/nbf"):
+        v.validate(encode_hs256({"exp": "soon"}, "s"))
+    with pytest.raises(JwtError, match="exp/nbf"):
+        v.validate(encode_hs256({"nbf": None}, "s"))
+    # float() accepts "NaN"/"Infinity" — those would never expire
+    with pytest.raises(JwtError, match="non-finite"):
+        v.validate(encode_hs256({"exp": "NaN"}, "s"))
+    with pytest.raises(JwtError, match="non-finite"):
+        v.validate(encode_hs256({"exp": "Infinity"}, "s"))
+
+
+def test_gateway_auth_validated_at_deploy_time():
+    """A google gateway without clientId must fail deploy validation, not
+    surface as per-login 401s."""
+    from langstream_tpu.api.application import Gateway
+    from langstream_tpu.gateway.auth import validate_gateway_authentication
+
+    bad = Gateway.from_dict(
+        {
+            "id": "chat",
+            "type": "chat",
+            "chat-options": {"questions-topic": "q", "answers-topic": "a"},
+            "authentication": {"provider": "google", "configuration": {}},
+        }
+    )
+    with pytest.raises(ValueError, match="clientId"):
+        validate_gateway_authentication([bad])
+    good = Gateway.from_dict(
+        {
+            "id": "chat",
+            "type": "chat",
+            "chat-options": {"questions-topic": "q", "answers-topic": "a"},
+            "authentication": {
+                "provider": "google",
+                "configuration": {"clientId": "cid"},
+            },
+        }
+    )
+    validate_gateway_authentication([good])
+
+
+def test_auth_provider_instances_memoized():
+    """Per-request provider construction would rebuild validator caches on
+    every login; same (name, config) must return the same instance."""
+    from langstream_tpu.gateway.auth import get_auth_provider
+
+    a = get_auth_provider("jwt", {"secret": "memo"})
+    b = get_auth_provider("jwt", {"secret": "memo"})
+    c = get_auth_provider("jwt", {"secret": "other"})
+    assert a is b and a is not c

@@ -624,3 +624,88 @@ def test_unzip_rejects_sibling_prefix_escape(tmp_path):
     with pytest.raises(ValueError, match="illegal archive member"):
         unzip_to(buf.getvalue(), dest)
     assert not (tmp_path / "app2").exists()
+
+
+def test_run_agent_wires_app_directory_for_sidecar(tmp_path, run_async):
+    """k8s lane: the downloaded code archive must become the application
+    directory so grpc-python-* sidecar agents can import the app's python/
+    code (the sidecar builds its PYTHONPATH from it, grpc/client.py)."""
+    import textwrap
+
+    from langstream_tpu.api.record import make_record
+    from langstream_tpu.api.topics import TopicConnectionsRuntimeRegistry
+    from langstream_tpu.runtime.memory_broker import MemoryBroker
+    from langstream_tpu.runtime.pod import build_agent_runner
+
+    code_dir = tmp_path / "code-download"
+    pkg = code_dir / "app" / "python"
+    pkg.mkdir(parents=True)
+    (pkg / "podside.py").write_text(
+        textwrap.dedent(
+            """
+            class Upper:
+                def init(self, config):
+                    pass
+
+                def process(self, record):
+                    return [(record.value.upper(), record.key, None)]
+            """
+        )
+    )
+
+    config = {
+        "applicationId": "podapp",
+        "tenant": "t1",
+        "agent": {
+            "id": "step1",
+            "type": "grpc-python-processor",
+            "componentType": "PROCESSOR",
+            "configuration": {"className": "podside.Upper"},
+            "agents": [
+                {
+                    "id": "step1",
+                    "type": "grpc-python-processor",
+                    "configuration": {"className": "podside.Upper"},
+                }
+            ],
+        },
+        "input": {"topic": "pod-in"},
+        "output": {"topic": "pod-out"},
+        "streamingCluster": {
+            "type": "memory",
+            "configuration": {"cluster": "podlane"},
+        },
+    }
+
+    import sys
+
+    saved_path = list(sys.path)
+    try:
+        runner = build_agent_runner(config, str(code_dir))
+        assert runner.plan.application.directory == str(code_dir / "app")
+
+        async def main():
+            MemoryBroker.reset()
+            await runner.start()
+            rt = TopicConnectionsRuntimeRegistry.get_runtime(
+                {"type": "memory", "configuration": {"cluster": "podlane"}}
+            )
+            producer = rt.create_producer("test", {"topic": "pod-in"})
+            await producer.start()
+            await producer.write(make_record(value="downloaded code"))
+            reader = rt.create_reader({"topic": "pod-out"}, "earliest")
+            await reader.start()
+            got = []
+            for _ in range(200):
+                got.extend(await reader.read(timeout=0.1))
+                if got:
+                    break
+            await runner.stop()
+            assert got and got[0].value == "DOWNLOADED CODE"
+
+        run_async(main())
+    finally:
+        # build_agent_runner mutates process-global import state; undo it so
+        # later tests don't see tmp_path on sys.path or a cached module
+        sys.path[:] = saved_path
+        sys.modules.pop("podside", None)
