@@ -70,6 +70,27 @@ async def run_bench() -> dict:
     tok_s = total_tokens / elapsed
     await engine.close()
     wdtype = "int8-weights" if QUANTIZE == "int8" else "bf16"
+
+    # roofline: decode streams weights + the KV window every step; report
+    # achieved HBM utilization against that floor (profiling.py model)
+    from langstream_tpu.serving.profiling import decode_step_bytes
+
+    prompt_tokens = results[0]["num_prompt_tokens"]
+    mean_len = prompt_tokens + MAX_TOKENS / 2
+    # the engine's own bucketing (None = full cache) keeps bench and engine
+    # in lockstep on what a "window" means
+    window = engine._window_for(int(mean_len)) or MAX_SEQ
+    roof = decode_step_bytes(
+        engine.model_config, slots=SLOTS, window=window, quantize=QUANTIZE
+    )
+    achieved_step_ms = SLOTS / tok_s * 1e3  # all slots advance one token/step
+    roofline = {
+        "hbm_gbps_assumed": roof.hbm_gbps,
+        "bytes_per_step": roof.total_bytes_per_step,
+        "min_step_ms": round(roof.min_step_ms(), 3),
+        "achieved_step_ms": round(achieved_step_ms, 3),
+        "hbm_utilization": round(roof.utilization(achieved_step_ms), 3),
+    }
     return {
         "metric": f"tok/s/chip llama-1b {wdtype} decode (per-chip shard "
         "proxy of Llama-3-8B TP8, v5e)",
@@ -84,6 +105,7 @@ async def run_bench() -> dict:
             "total_tokens": total_tokens,
             "elapsed_s": round(elapsed, 2),
             "p50_ttft_s": round(p50_ttft, 3),
+            "roofline": roofline,
         },
     }
 

@@ -10,6 +10,7 @@ or optional libraries.
 from __future__ import annotations
 
 import abc
+from collections import OrderedDict
 from typing import Any
 
 
@@ -83,8 +84,12 @@ def _ensure_providers() -> None:
 # Constructed providers are memoized by (name, config): gateways resolve
 # their provider on every request, and per-request construction would both
 # rebuild validator state (defeating e.g. the google JWKS cache) and defer
-# construction-time config validation to the first login.
-_INSTANCES: dict[tuple[str, str], GatewayAuthenticationProvider] = {}
+# construction-time config validation to the first login. LRU-bounded so
+# rotated secrets/configs don't pin provider objects for process lifetime.
+_INSTANCES: OrderedDict[tuple[str, str], GatewayAuthenticationProvider] = (
+    OrderedDict()
+)
+_INSTANCES_MAX = 64
 
 
 def get_auth_provider(
@@ -102,6 +107,9 @@ def get_auth_provider(
     provider = _INSTANCES.get(key)
     if provider is None:
         provider = _INSTANCES[key] = _PROVIDERS[name](configuration)
+    _INSTANCES.move_to_end(key)
+    while len(_INSTANCES) > _INSTANCES_MAX:
+        _INSTANCES.popitem(last=False)
     return provider
 
 

@@ -6,8 +6,8 @@ per step. The dequant (``convert int8→bf16`` + one broadcast multiply) sits
 directly on the matmul operand so XLA fuses it into the dot's operand load —
 no materialized bf16 copy of the weights.
 
-Scope: serving inference only (single-chip path; the sharded path keeps bf16
-until a QTensor-aware spec mapping lands). Quality: per-channel symmetric
+Scope: serving inference only, single-chip or TP-sharded (scales shard with
+their weights via :func:`quantize_specs`). Quality: per-channel symmetric
 int8 on weights only (activations stay bf16) — the standard recipe that is
 lossless in practice for decoder LMs of this size.
 """
@@ -73,6 +73,34 @@ def quantize_tensor(w: jax.Array, axis: int) -> QTensor:
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return QTensor(q=q, s=scale, dtype=w.dtype)
+
+
+def quantize_specs(specs: Any, params: Any) -> Any:
+    """Lift a PartitionSpec tree over a (partially) quantized param tree.
+
+    Each QTensor leaf's spec ``P`` becomes ``QTensor(q=P, s=P')`` where
+    ``P'`` drops the mesh axis on dimensions the scale reduces to size 1
+    (a size-1 dimension cannot shard over a >1 mesh axis; the scale is
+    simply replicated along the contraction axis, which is exactly the
+    axis TP row-sharding splits). Column-sharded weights keep the axis:
+    their scales are per-output-channel and shard with the outputs.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def lift(p, w):
+        if not isinstance(w, QTensor):
+            return p
+        ndim = w.q.ndim
+        entries = list(p) + [None] * (ndim - len(list(p)))
+        s_entries = [
+            None if w.s.shape[i] == 1 else entries[i] for i in range(ndim)
+        ]
+        return QTensor(q=p, s=P(*s_entries), dtype=w.dtype)
+
+    return jax.tree.map(
+        lift, specs, params,
+        is_leaf=lambda x: isinstance(x, (P, QTensor)),
+    )
 
 
 def quantize_llama_params(params: dict) -> dict:

@@ -55,6 +55,7 @@ from langstream_tpu.models.encoder import (
     init_encoder_params,
 )
 from langstream_tpu.models.tokenizer import Tokenizer, load_tokenizer
+from langstream_tpu.serving.profiling import ProfilerHooks
 from langstream_tpu.serving.sampler import sample_tokens
 
 log = logging.getLogger(__name__)
@@ -86,7 +87,8 @@ class ServingConfig:
     decode_chunk: int = 16
     # max requests prefilled in one batched call
     prefill_batch: int = 8
-    # weight-only quantization: None (bf16) or "int8" (single-chip only)
+    # weight-only quantization: None (bf16) or "int8" (scales TP-shard
+    # with their weights, so the mesh posture keeps the int8 default)
     quantize: str | None = None
 
     @classmethod
@@ -202,6 +204,8 @@ class TpuServingEngine:
         self._pending_emits: list = []
         self._finished_requests: list = []
         self.total_generated = 0
+        # jax.profiler trace + HLO dump hooks (env-gated, off by default)
+        self.profiler = ProfilerHooks()
 
     # ------------------------------------------------------------------
     # model + jit setup
@@ -220,11 +224,6 @@ class TpuServingEngine:
             )
             self.params = init_llama_params(mc)
         if self.config.quantize == "int8":
-            if self.mesh is not None:
-                raise ValueError(
-                    "quantize=int8 is single-chip only (QTensor sharding "
-                    "specs not implemented); drop the mesh or the quantize"
-                )
             from langstream_tpu.models.quant import quantize_llama_params
 
             self.params = quantize_llama_params(self.params)
@@ -235,7 +234,9 @@ class TpuServingEngine:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            specs = llama_param_specs(mc)
+            from langstream_tpu.models.quant import quantize_specs
+
+            specs = quantize_specs(llama_param_specs(mc), self.params)
             self.params = jax.tree.map(
                 lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
                 self.params,
@@ -451,6 +452,12 @@ class TpuServingEngine:
         def _dispatch(tokens, lengths, key, window):
             # async JAX dispatch: returns device arrays without blocking
             decode_fn = self._decode_fn(use_top_p, window)
+            self.profiler.on_decode_chunk()
+            self.profiler.dump_hlo(
+                f"decode_chunk_w{window}_topp{int(use_top_p)}", decode_fn,
+                self.params, self.cache_k, self.cache_v,
+                tokens, lengths, amask, key, temps, topks, topps,
+            )
             chunk_t, chunk_lp, t, l, ck, cv = decode_fn(
                 self.params, self.cache_k, self.cache_v,
                 tokens, lengths, amask, key, temps, topks, topps,
@@ -536,12 +543,14 @@ class TpuServingEngine:
             prefill_fn = self._prefill_fns[bool((topps < 1.0).any())]
 
             def _run():
-                return prefill_fn(
+                args = (
                     self.params, self.cache_k, self.cache_v,
                     jnp.asarray(padded), jnp.asarray(lengths),
                     jnp.asarray(slot_ids), key,
                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
                 )
+                self.profiler.dump_hlo(f"prefill_p{bucket}_b{Bp}", prefill_fn, *args)
+                return prefill_fn(*args)
 
             next_tokens, logprobs, self.cache_k, self.cache_v = (
                 await loop.run_in_executor(self._executor, _run)
@@ -629,6 +638,22 @@ class TpuServingEngine:
                         "finish_reason": "stop" if is_eos else "length",
                     }
                 )
+
+
+def profile_engines(action: str, trace_dir: str | None = None) -> dict[str, bool]:
+    """Start/stop jax.profiler capture on every live engine (the pod's
+    ``/profile/{start,stop}`` debug endpoint drives this)."""
+    if action not in ("start", "stop"):
+        raise ValueError(f"unknown profile action {action!r} (start|stop)")
+    with TpuServingEngine._instances_lock:
+        engines = list(TpuServingEngine._instances.values())
+    results: dict[str, bool] = {}
+    for engine in engines:
+        if action == "start":
+            results[engine.config.model] = engine.profiler.start_trace(trace_dir)
+        else:
+            results[engine.config.model] = engine.profiler.stop_trace()
+    return results
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,186 @@
+"""Profiling and tracing hooks for the serving engine.
+
+The TPU-native analogue of the reference's per-agent observability servlet
+(``AgentInfoServlet.java`` / ``AgentRunner.java:604-624``): instead of JVM
+stats, we capture device truth — ``jax.profiler`` traces (op-level timeline
+viewable in TensorBoard/Perfetto) and the compiled HLO of the hot programs.
+
+Activation (all off by default, zero overhead when unset):
+
+- ``LS_TPU_PROFILE_DIR=/path``: the engine captures a trace of the first
+  ``LS_TPU_PROFILE_CHUNKS`` (default 4) decode chunks after startup into
+  ``/path``. Inspect with TensorBoard's profile plugin or Perfetto.
+- ``LS_TPU_HLO_DUMP_DIR=/path``: each jitted serving program (prefill
+  buckets, decode chunk variants) writes its optimized HLO text next to its
+  first execution — the ground truth for "what did XLA fuse".
+- Engine methods :meth:`ProfilerHooks.start_trace` / ``stop_trace`` expose
+  the same capture programmatically (the pod's ``/profile`` debug endpoint
+  drives these).
+
+Also here: the decode roofline model. Decode is HBM-bandwidth bound: each
+step must stream every live weight byte plus the attention-window slice of
+the KV cache. ``decode_step_bytes`` computes that floor so benches can
+report achieved-vs-roofline utilization instead of a bare tok/s.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+
+class ProfilerHooks:
+    """Owns trace capture state for one engine instance."""
+
+    def __init__(self) -> None:
+        self.profile_dir = os.environ.get("LS_TPU_PROFILE_DIR")
+        self.auto_chunks = int(os.environ.get("LS_TPU_PROFILE_CHUNKS", "4"))
+        self.hlo_dir = os.environ.get("LS_TPU_HLO_DUMP_DIR")
+        self._tracing = False
+        self._auto_remaining = self.auto_chunks if self.profile_dir else 0
+        self._dumped: set[str] = set()
+
+    # -- trace capture --------------------------------------------------
+
+    def start_trace(self, trace_dir: str | None = None) -> bool:
+        """Begin a jax.profiler capture (idempotent). Returns True if a
+        capture started. The profiler is process-global while hooks are
+        per-engine, so a capture already running elsewhere (another engine)
+        is tolerated, never raised into the serving path."""
+        if self._tracing:
+            return False
+        target = trace_dir or self.profile_dir
+        if not target:
+            return False
+        import jax
+
+        try:
+            os.makedirs(target, exist_ok=True)
+            jax.profiler.start_trace(target)
+        except Exception as e:  # profiling must never break serving
+            log.warning("profiler trace start failed (already active?): %s", e)
+            self._auto_remaining = 0
+            return False
+        self._tracing = True
+        log.info("jax profiler trace started -> %s", target)
+        return True
+
+    def stop_trace(self) -> bool:
+        if not self._tracing:
+            return False
+        import jax
+
+        self._tracing = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("profiler trace stop failed: %s", e)
+            return False
+        log.info("jax profiler trace stopped")
+        return True
+
+    def on_decode_chunk(self) -> None:
+        """Called once per dispatched decode chunk: drives the env-var
+        auto-capture of the first N chunks."""
+        if self._auto_remaining <= 0:
+            return
+        if not self._tracing and not self.start_trace():
+            return  # start failed/disabled; _auto_remaining already zeroed
+        self._auto_remaining -= 1
+        if self._auto_remaining == 0:
+            self.stop_trace()
+
+    # -- HLO dumps ------------------------------------------------------
+
+    def dump_hlo(self, name: str, jitted: Any, *args: Any, **kwargs: Any) -> str | None:
+        """Write ``jitted``'s HLO for the given example args to
+        ``<hlo_dir>/<name>.hlo.txt`` (once per name).
+
+        Default dump is the (cheap) pre-optimization lowering — AOT
+        ``compile()`` results don't populate the jit dispatch cache, so
+        compiling here would double every program's warm-up. Set
+        ``LS_TPU_HLO_OPTIMIZED=1`` to pay one extra compile per program and
+        dump the post-fusion optimized HLO instead."""
+        if not self.hlo_dir or name in self._dumped:
+            return None
+        self._dumped.add(name)
+        try:
+            lowered = jitted.lower(*args, **kwargs)
+            if os.environ.get("LS_TPU_HLO_OPTIMIZED") == "1":
+                text = lowered.compile().as_text()
+            else:
+                text = lowered.as_text()
+        except Exception as e:  # profiling must never break serving
+            log.warning("HLO dump %s failed: %s", name, e)
+            return None
+        os.makedirs(self.hlo_dir, exist_ok=True)
+        path = os.path.join(self.hlo_dir, f"{name}.hlo.txt")
+        with open(path, "w") as f:
+            f.write(text)
+        log.info("HLO dump: %s", path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# roofline model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeRoofline:
+    weight_bytes: int          # streamed once per step (all slots share it)
+    cache_bytes_per_step: int  # KV window read across all slots
+    total_bytes_per_step: int
+    hbm_gbps: float            # assumed device bandwidth
+
+    def min_step_ms(self) -> float:
+        return self.total_bytes_per_step / (self.hbm_gbps * 1e9) * 1e3
+
+    def utilization(self, achieved_step_ms: float) -> float:
+        return self.min_step_ms() / max(achieved_step_ms, 1e-9)
+
+
+# published HBM bandwidth by TPU generation (GB/s); used for reporting only
+_HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0}
+
+
+def detect_hbm_gbps(default: float = 819.0) -> float:
+    gen = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    for key, bw in _HBM_GBPS.items():
+        if gen.startswith(key):
+            return bw
+    return default
+
+
+def decode_step_bytes(
+    model_config: Any,
+    slots: int,
+    window: int,
+    quantize: str | None = None,
+    kv_dtype_bytes: int = 2,
+) -> DecodeRoofline:
+    """Bytes that MUST cross HBM for one decode step of ``slots`` slots with
+    an attention window of ``window`` cache rows per slot.
+
+    Weight traffic: every parameter once (int8 → 1 byte + per-channel f32
+    scales, negligible). Cache traffic: K and V windows for every slot and
+    layer. Activations are negligible at decode batch sizes.
+    """
+    c = model_config
+    from langstream_tpu.models.llama import param_count
+
+    n_params = param_count(c)
+    wbytes = n_params * (1 if quantize == "int8" else 2)
+    cache = (
+        c.layers * slots * window * c.kv_heads * c.head_dim * kv_dtype_bytes * 2
+    )
+    return DecodeRoofline(
+        weight_bytes=wbytes,
+        cache_bytes_per_step=cache,
+        total_bytes_per_step=wbytes + cache,
+        hbm_gbps=detect_hbm_gbps(),
+    )

@@ -290,15 +290,82 @@ def test_quantized_logits_close_to_float():
     assert corr > 0.999
 
 
-def test_int8_rejects_mesh():
-    import pytest
+def test_int8_tp_sharded_matches_single_device():
+    """int8 weights under a TP mesh: scales shard with their weights
+    (quantize_specs) and the sharded logits match the unsharded quantized
+    ones — the serving-default posture in the north-star TP8 config."""
+    import dataclasses
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from langstream_tpu.models.llama import (
+        LlamaConfig,
+        init_kv_cache,
+        init_llama_params,
+        llama_decode_step,
+        llama_param_specs,
+        llama_prefill,
+        kv_cache_spec,
+    )
+    from langstream_tpu.models.quant import quantize_llama_params, quantize_specs
+    from langstream_tpu.parallel.mesh import make_mesh
+
+    c = dataclasses.replace(LlamaConfig.tiny(max_seq_len=32), dtype=jnp.float32)
+    qparams = quantize_llama_params(init_llama_params(c, jax.random.PRNGKey(7)))
+    tokens = jnp.array([[5, 9, 17, 3]], dtype=jnp.int32)
+    lens = jnp.array([4])
+    sid = jnp.array([0])
+
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=32)
+    ref_logits, rk, rv = llama_prefill(
+        c, qparams, tokens, lens, ck, cv, sid, use_flash=False
+    )
+
+    mesh = make_mesh({"dp": 1, "tp": 2})
+    specs = quantize_specs(llama_param_specs(c), qparams)
+    sharded = jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        qparams, specs, is_leaf=lambda x: isinstance(x, P),
+    )
+    cspec = NamedSharding(mesh, kv_cache_spec(mesh.axis_names))
+    ck, cv = init_kv_cache(c, slots=1, max_seq_len=32)
+    ck, cv = jax.device_put(ck, cspec), jax.device_put(cv, cspec)
+    tp_logits, sk, sv = llama_prefill(
+        c, sharded, tokens, lens, ck, cv, sid, use_flash=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(tp_logits), rtol=2e-2, atol=2e-2
+    )
+
+    # one decode step too
+    d_ref, _, _ = llama_decode_step(
+        c, qparams, jnp.array([11]), lens, rk, rv
+    )
+    d_tp, _, _ = llama_decode_step(
+        c, sharded, jnp.array([11]), lens, sk, sv
+    )
+    np.testing.assert_allclose(
+        np.asarray(d_ref), np.asarray(d_tp), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_int8_engine_runs_under_mesh(run_async):
+    """The engine's serving-default int8 posture must construct and serve
+    under a dp×tp mesh."""
     from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
 
-    with pytest.raises(ValueError, match="single-chip"):
-        TpuServingEngine(
-            ServingConfig(model="tiny", quantize="int8", mesh=(("tp", 2),))
+    async def main():
+        config = ServingConfig(
+            model="tiny", slots=2, max_seq_len=64, decode_chunk=2,
+            default_max_tokens=4, quantize="int8",
+            mesh=(("dp", 1), ("tp", 2)),
         )
+        engine = TpuServingEngine.get_or_create(config)
+        r = await engine.generate("mesh int8", {"max-tokens": 4})
+        await engine.close()
+        assert 0 < len(r["tokens"]) <= 4
+
+    run_async(main())
 
 
 def test_encoder_embeddings_normalised_and_padding_invariant():
@@ -529,3 +596,55 @@ def test_chat_agent_on_tpu_engine(tmp_path, run_async):
             assert isinstance(msgs[0].value["answer"], str)
 
     run_async(main())
+
+
+def test_profiler_hooks_trace_and_hlo_dump(tmp_path, run_async, monkeypatch):
+    """Env-gated profiling: a trace of the first N decode chunks lands in
+    LS_TPU_PROFILE_DIR; each compiled serving program dumps its HLO text
+    into LS_TPU_HLO_DUMP_DIR (SURVEY §5.1's TPU-native observability)."""
+    import os
+
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    trace_dir = tmp_path / "trace"
+    hlo_dir = tmp_path / "hlo"
+    monkeypatch.setenv("LS_TPU_PROFILE_DIR", str(trace_dir))
+    monkeypatch.setenv("LS_TPU_PROFILE_CHUNKS", "2")
+    monkeypatch.setenv("LS_TPU_HLO_DUMP_DIR", str(hlo_dir))
+
+    async def main():
+        config = ServingConfig(
+            model="tiny", slots=2, max_seq_len=64, decode_chunk=2,
+            default_max_tokens=6,
+        )
+        engine = TpuServingEngine.get_or_create(config)
+        await engine.generate("profile me", {"max-tokens": 6})
+        engine.profiler.stop_trace()  # in case fewer than N chunks ran
+        await engine.close()
+
+    run_async(main())
+    # jax.profiler writes a plugins/profile/<ts>/ tree with .xplane.pb files
+    traces = [p for p in trace_dir.rglob("*") if p.is_file()]
+    assert traces, "no profiler trace files captured"
+    hlos = list(hlo_dir.glob("*.hlo.txt"))
+    assert any("prefill" in p.name for p in hlos), hlos
+    assert any("decode_chunk" in p.name for p in hlos), hlos
+    assert all(p.stat().st_size > 1000 for p in hlos)
+
+
+def test_decode_roofline_model():
+    from langstream_tpu.models.llama import LlamaConfig
+    from langstream_tpu.serving.profiling import decode_step_bytes
+
+    c = LlamaConfig.llama_1b()
+    r8 = decode_step_bytes(c, slots=64, window=256, quantize="int8")
+    rb = decode_step_bytes(c, slots=64, window=256, quantize=None)
+    # int8 halves weight traffic, cache unchanged
+    assert rb.weight_bytes == 2 * r8.weight_bytes
+    assert rb.cache_bytes_per_step == r8.cache_bytes_per_step
+    # ~0.9B params -> ~0.9GB int8
+    assert 0.8e9 < r8.weight_bytes < 1.1e9
+    # cache window: L16 * 64 slots * 256 rows * 8 kvh * 128 d * 2B * 2(K,V)
+    assert r8.cache_bytes_per_step == 16 * 64 * 256 * 8 * 128 * 2 * 2
+    assert r8.min_step_ms() > 0
+    assert 0 < r8.utilization(achieved_step_ms=10 * r8.min_step_ms()) <= 0.11
