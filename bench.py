@@ -33,6 +33,10 @@ BASELINE_TOK_S = 2000.0
 # ~8% faster than bf16 here); BENCH_QUANTIZE=none reverts to bf16
 _quant_env = os.environ.get("BENCH_QUANTIZE", "int8").strip().lower()
 QUANTIZE = None if _quant_env in ("", "none", "bf16") else _quant_env
+# BENCH_KV=paged runs the block-pool cache (Pallas paged-attention read on
+# TPU) — same slot count at half the cache HBM; BENCH_SLOTS can then be
+# raised beyond what the dense layout fits
+KV_LAYOUT = os.environ.get("BENCH_KV", "dense").strip().lower()
 
 
 async def run_bench() -> dict:
@@ -46,6 +50,7 @@ async def run_bench() -> dict:
             default_max_tokens=MAX_TOKENS,
             decode_chunk=DECODE_CHUNK,
             quantize=QUANTIZE,
+            kv_layout=KV_LAYOUT,
         )
     )
 

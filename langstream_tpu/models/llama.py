@@ -244,20 +244,17 @@ def attention_block(config, x, lp, cos, sin, attention):
 # ---------------------------------------------------------------------------
 
 
-def llama_prefill(
+def prefill_forward(
     config: LlamaConfig,
     params: dict,
     tokens: jax.Array,       # (B, P) int32, right-padded
     lengths: jax.Array,      # (B,) true lengths
-    cache_k: jax.Array,      # (L, slots, S, K, D)
-    cache_v: jax.Array,
-    slot_ids: jax.Array,     # (B,) which cache slots to fill
-    use_flash: bool | None = None,  # None = auto (LS_TPU_FLASH); False when
-                                    # params are mesh-sharded: pallas_call has
-                                    # no SPMD partitioning rule, so under
-                                    # pjit-TP it would replicate, not shard
+    use_flash: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Process prompts, fill the KV cache, return last-token logits (B, V)."""
+    """Shared prompt forward (the single source of the prefill layer math):
+    returns (last-token logits (B,V), ks, vs) where ks/vs are the roped
+    per-layer K/V ``(L, B, P, Kh, D)`` for the caller's cache layout —
+    dense (:func:`llama_prefill`) or paged (``llama_prefill_paged``)."""
     c = config
     B, Pn = tokens.shape
     x = embedding_take(params["embed"], tokens)  # (B, P, H)
@@ -273,9 +270,8 @@ def llama_prefill(
 
     flash = _flash_mode(Pn) if use_flash is None else ("compiled" if use_flash else None)
 
-    def layer(carry, layer_in):
+    def layer(carry, lp):
         x = carry
-        lp, ck_l, cv_l = layer_in
         h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
         q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"])).reshape(B, Pn, c.heads, c.head_dim)
         k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"])).reshape(B, Pn, c.kv_heads, c.head_dim)
@@ -305,23 +301,41 @@ def llama_prefill(
         x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
-        # write this layer's K/V into the cache at the given slots
-        pad = ck_l.shape[1] - Pn
-        k_padded = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        v_padded = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        ck_l = ck_l.at[slot_ids].set(k_padded)
-        cv_l = cv_l.at[slot_ids].set(v_padded)
-        return x, (ck_l, cv_l)
+        return x, (k, v)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache_k, cache_v)
-    )
+    x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     # logits for the last real token of each prompt
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].clip(0), axis=1
     ).squeeze(1)
     logits = jnp.einsum("bh,hv->bv", last, _w(params["lm_head"])).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def llama_prefill(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,       # (B, P) int32, right-padded
+    lengths: jax.Array,      # (B,) true lengths
+    cache_k: jax.Array,      # (L, slots, S, K, D)
+    cache_v: jax.Array,
+    slot_ids: jax.Array,     # (B,) which cache slots to fill
+    use_flash: bool | None = None,  # None = auto (LS_TPU_FLASH); False when
+                                    # params are mesh-sharded: pallas_call has
+                                    # no SPMD partitioning rule, so under
+                                    # pjit-TP it would replicate, not shard
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Process prompts, fill the KV cache, return last-token logits (B, V).
+
+    Only the first P rows of each slot are written; stale rows beyond are
+    harmless — every decode read is masked to positions < length, and each
+    new row is written before it is ever attended to.
+    """
+    Pn = tokens.shape[1]
+    logits, ks, vs = prefill_forward(config, params, tokens, lengths, use_flash)
+    new_k = cache_k.at[:, slot_ids, :Pn].set(ks)
+    new_v = cache_v.at[:, slot_ids, :Pn].set(vs)
     return logits, new_k, new_v
 
 

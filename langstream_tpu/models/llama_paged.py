@@ -1,0 +1,218 @@
+"""Llama prefill/decode over the paged KV pool.
+
+Same math as the dense paths in :mod:`langstream_tpu.models.llama`; only the
+cache geometry changes: K/V rows live in pool blocks mapped by per-slot
+block tables (:mod:`langstream_tpu.models.paged`). Decode attention runs in
+two segments — the paged pool (Pallas kernel or XLA gather reference) and
+the in-chunk KV buffer — merged with the associative online-softmax combine
+(:func:`merge_partial_attention`).
+
+Parity: the dense/paged pair mirrors the reference's single code path the
+way vLLM relates to naive HF decoding — the capability (continuous batching
+at fixed HBM) is SURVEY §7 build-order item 6.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from langstream_tpu.models.llama import (
+    LlamaConfig,
+    _apply_rope,
+    _rms_norm,
+    _rope,
+    _swiglu,
+)
+from langstream_tpu.models.paged import gather_kv, write_rows
+from langstream_tpu.models.quant import as_weight as _w, embedding_take
+from langstream_tpu.ops.paged_attention import (
+    NEG_INF,
+    merge_partial_attention,
+    paged_attention_partial,
+)
+
+
+def llama_prefill_paged(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,        # (B, P) int32, right-padded
+    lengths: jax.Array,       # (B,) true lengths
+    pool_k: jax.Array,        # (L, nb, bs, Kh*D)
+    pool_v: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32 — rows for THIS batch
+    use_flash: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Prompt forward + paged cache fill: the shared
+    :func:`~langstream_tpu.models.llama.prefill_forward` layer math with the
+    K/V landing in pool blocks — one scatter commit per K and V."""
+    from langstream_tpu.models.llama import prefill_forward
+
+    c = config
+    B, Pn = tokens.shape
+    logits, ks, vs = prefill_forward(c, params, tokens, lengths, use_flash)
+    KhD = c.kv_heads * c.head_dim
+    L = ks.shape[0]
+    valid = (jnp.arange(Pn)[None, :] < lengths[:, None])
+    starts = jnp.zeros((B,), dtype=jnp.int32)
+    pool_k = write_rows(pool_k, ks.reshape(L, B, Pn, KhD), block_tables, starts, valid)
+    pool_v = write_rows(pool_v, vs.reshape(L, B, Pn, KhD), block_tables, starts, valid)
+    return logits, pool_k, pool_v
+
+
+def _cache_partial_xla(
+    c: LlamaConfig,
+    q: jax.Array,             # (B, H, D)
+    ck_l: jax.Array,          # (nb, bs, KhD)
+    cv_l: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks)
+    lengths: jax.Array,       # (B,)
+    num_read_blocks: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Reference paged read: gather the window densely, compute partial
+    softmax stats. Works on every backend and under pjit meshes (gathers
+    shard like any XLA op); pays one densified copy."""
+    B, H, D = q.shape
+    bs = ck_l.shape[1]
+    W = num_read_blocks * bs
+    kw = gather_kv(ck_l[None], block_tables, num_read_blocks)[0]  # (B, W, KhD)
+    vw = gather_kv(cv_l[None], block_tables, num_read_blocks)[0]
+    kw = kw.reshape(B, W, c.kv_heads, c.head_dim)
+    vw = vw.reshape(B, W, c.kv_heads, c.head_dim)
+    G = c.heads // c.kv_heads
+    qg = q.reshape(B, c.kv_heads, G, c.head_dim)
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, kw).astype(jnp.float32)
+    s = s / math.sqrt(c.head_dim)
+    mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # (B, Kh, G)
+    shift = jnp.where(m <= NEG_INF, 0.0, m)
+    p = jnp.exp(s - shift[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgs,bskd->bkgd", p.astype(vw.dtype), vw).astype(jnp.float32)
+    return (
+        acc.reshape(B, H, D),
+        m.reshape(B, H),
+        l.reshape(B, H),
+    )
+
+
+def llama_decode_chunk_paged(
+    config: LlamaConfig,
+    params: dict,
+    tokens0: jax.Array,       # (B,)
+    base_lengths: jax.Array,  # (B,)
+    active: jax.Array,        # (B,) bool
+    pool_k: jax.Array,        # (L, nb, bs, KhD) — read-only during the chunk
+    pool_v: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks)
+    sample_fn: Callable,
+    key: jax.Array,
+    num_steps: int,
+    num_read_blocks: int,     # static block-sweep bucket (covers max length)
+    kernel: str = "xla",      # "xla" | "pallas" | "pallas-interpret"
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """K fused decode steps against the paged pool; same two-segment
+    discipline as the dense ``llama_decode_chunk`` (pool read-only, new K/V
+    in a chunk buffer, one scatter commit at the end)."""
+    c = config
+    B = tokens0.shape[0]
+    KhD = c.kv_heads * c.head_dim
+    adv = active.astype(jnp.int32)
+    kbuf0 = jnp.zeros((c.layers, B, num_steps, c.kv_heads, c.head_dim), c.dtype)
+    vbuf0 = jnp.zeros_like(kbuf0)
+
+    def cache_partial(q, ck_l, cv_l):
+        if kernel == "xla":
+            return _cache_partial_xla(
+                c, q, ck_l, cv_l, block_tables, base_lengths, num_read_blocks
+            )
+        return paged_attention_partial(
+            q, ck_l, cv_l, block_tables, base_lengths,
+            num_read_blocks=num_read_blocks,
+            kv_heads=c.kv_heads, head_dim=c.head_dim,
+            scale=1.0 / math.sqrt(c.head_dim),
+            interpret=(kernel == "pallas-interpret"),
+        )
+
+    def step(carry, step_idx):
+        tokens, kbuf, vbuf, key = carry
+        key, sub = jax.random.split(key)
+        x = embedding_take(params["embed"], tokens)
+        positions = base_lengths + step_idx * adv
+        cos, sin = _rope(positions, c.head_dim, c.rope_theta)
+        buf_mask = jnp.arange(num_steps)[None, :] <= step_idx  # (1, K)
+        G = c.heads // c.kv_heads
+
+        def layer(x, layer_in):
+            lp, ck_l, cv_l, kbuf_l, vbuf_l = layer_in
+            h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+            q = (h @ _w(lp["wq"])).reshape(B, c.heads, c.head_dim)
+            k = (h @ _w(lp["wk"])).reshape(B, c.kv_heads, c.head_dim)
+            v = (h @ _w(lp["wv"])).reshape(B, c.kv_heads, c.head_dim)
+            q = _apply_rope(q, cos, sin)
+            k = _apply_rope(k, cos, sin)
+            kbuf_l = jax.lax.dynamic_update_slice_in_dim(
+                kbuf_l, k[:, None], step_idx, axis=1
+            )
+            vbuf_l = jax.lax.dynamic_update_slice_in_dim(
+                vbuf_l, v[:, None], step_idx, axis=1
+            )
+            # segment 1: paged pool (partial stats)
+            acc_c, m_c, l_c = cache_partial(q, ck_l, cv_l)
+            # segment 2: in-chunk buffer (partial stats, tiny)
+            qg = q.reshape(B, c.kv_heads, G, c.head_dim)
+            s_buf = jnp.einsum("bkgd,btkd->bkgt", qg, kbuf_l).astype(jnp.float32)
+            s_buf = s_buf / math.sqrt(c.head_dim)
+            s_buf = jnp.where(buf_mask[:, None, None, :], s_buf, NEG_INF)
+            m_b = jnp.max(s_buf, axis=-1)
+            shift = jnp.where(m_b <= NEG_INF, 0.0, m_b)
+            p_b = jnp.exp(s_buf - shift[..., None])
+            p_b = jnp.where(buf_mask[:, None, None, :], p_b, 0.0)
+            l_b = jnp.sum(p_b, axis=-1)
+            acc_b = jnp.einsum(
+                "bkgt,btkd->bkgd", p_b.astype(vbuf_l.dtype), vbuf_l
+            ).astype(jnp.float32)
+            out = merge_partial_attention([
+                (acc_c, m_c, l_c),
+                (
+                    acc_b.reshape(B, c.heads, c.head_dim),
+                    m_b.reshape(B, c.heads),
+                    l_b.reshape(B, c.heads),
+                ),
+            ]).astype(x.dtype)
+            out = out.reshape(B, c.heads * c.head_dim)
+            x = x + out @ _w(lp["wo"])
+            h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+            x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+            return x, (kbuf_l, vbuf_l)
+
+        x, (kbuf, vbuf) = jax.lax.scan(
+            layer, x, (params["layers"], pool_k, pool_v, kbuf, vbuf)
+        )
+        x = _rms_norm(x, params["final_norm"], c.norm_eps)
+        logits = (x @ _w(params["lm_head"])).astype(jnp.float32)
+        nxt, lp_ = sample_fn(logits, sub)
+        nxt = jnp.where(active, nxt, tokens)
+        return (nxt, kbuf, vbuf, key), (nxt, lp_)
+
+    (final_tokens, kbuf, vbuf, _), (chunk_tokens, chunk_lps) = jax.lax.scan(
+        step, (tokens0, kbuf0, vbuf0, key), jnp.arange(num_steps)
+    )
+
+    L = c.layers
+    valid = jnp.broadcast_to(active[:, None], (B, num_steps))
+    pool_k = write_rows(
+        pool_k, kbuf.reshape(L, B, num_steps, KhD), block_tables,
+        base_lengths, valid,
+    )
+    pool_v = write_rows(
+        pool_v, vbuf.reshape(L, B, num_steps, KhD), block_tables,
+        base_lengths, valid,
+    )
+    final_lengths = base_lengths + num_steps * adv
+    return chunk_tokens, chunk_lps, final_tokens, final_lengths, pool_k, pool_v

@@ -1,0 +1,236 @@
+"""Paged KV cache: block-table layout + pure read/write/attention helpers.
+
+Why paged: the dense cache ``(L, slots, S, Kh, D)`` reserves
+``slots × max_seq_len`` rows of HBM up front, so slot count is capped by the
+*worst-case* sequence length even when every live request is short. Paging
+(vLLM-style) slices the cache into fixed ``block_size``-row blocks shared
+from one pool; a slot holds ``ceil(len/bs)`` blocks, mapped by a small
+host-managed block table. Capacity then scales with *actual* tokens
+resident, not slots × S (reference parity: SURVEY §7 build-order item 6).
+
+TPU-first layout: the pool is ``(L, num_blocks, block_size, Kh*D)`` — the
+trailing two dims ``(block_size, Kh*D)`` are clean (8,128)-multiples, so
+both XLA scatters/gathers and the Pallas kernel DMA whole tiles. All
+functions here are jit-pure; the host side (free lists, reservations) lives
+in :class:`BlockManager`.
+
+Read paths:
+- :func:`gather_kv` — XLA reference: gathers a slot's blocks into a dense
+  window. Correct everywhere (CPU tests, sharded meshes); costs an extra
+  HBM round-trip for the gathered copy.
+- :mod:`langstream_tpu.ops.paged_attention` — Pallas kernel that walks the
+  block table directly via scalar prefetch; no gathered copy. Single-chip
+  TPU fast path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedLayout:
+    """Static geometry of the paged pool."""
+
+    block_size: int
+    num_blocks: int
+    max_blocks_per_slot: int
+
+    @classmethod
+    def for_model(
+        cls,
+        max_seq_len: int,
+        slots: int,
+        block_size: int = 64,
+        hbm_fraction_of_dense: float = 0.5,
+        num_blocks: int | None = None,
+    ) -> "PagedLayout":
+        """Size the pool to ``hbm_fraction_of_dense`` of what the dense
+        cache would reserve (the whole point: same slot count, less HBM —
+        or more slots at the same HBM)."""
+        max_blocks_per_slot = -(-max_seq_len // block_size)
+        if num_blocks is None:
+            dense_rows = slots * max_seq_len
+            num_blocks = max(
+                slots + 1, int(dense_rows * hbm_fraction_of_dense) // block_size
+            )
+        return cls(
+            block_size=block_size,
+            num_blocks=num_blocks,
+            max_blocks_per_slot=max_blocks_per_slot,
+        )
+
+
+def init_paged_kv_cache(
+    config, layout: PagedLayout
+) -> tuple[jax.Array, jax.Array]:
+    """Pool arrays ``(L, num_blocks, block_size, Kh*D)`` for K and V."""
+    c = config
+    shape = (
+        c.layers,
+        layout.num_blocks,
+        layout.block_size,
+        c.kv_heads * c.head_dim,
+    )
+    return jnp.zeros(shape, dtype=c.dtype), jnp.zeros(shape, dtype=c.dtype)
+
+
+def paged_cache_spec(mesh_axes: tuple[str, ...]):
+    """Pool (L, nb, bs, Kh*D): the trailing fused head axis shards on tp.
+    Blocks are NOT sharded on dp (any slot may use any block), so paged
+    serving shards the model, not the pool rows."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = "tp" if "tp" in mesh_axes else None
+    return P(None, None, None, tp)
+
+
+# ---------------------------------------------------------------------------
+# jit-pure read/write
+# ---------------------------------------------------------------------------
+
+
+def write_rows(
+    cache: jax.Array,       # (L, nb, bs, KhD)
+    rows: jax.Array,        # (L, B, T, KhD) — new K or V rows per slot
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    starts: jax.Array,      # (B,) first sequence position of rows[;, b]
+    valid: jax.Array,       # (B, T) bool — rows beyond a slot's true count
+) -> jax.Array:
+    """Scatter ``rows`` into the pool at each slot's block-mapped positions.
+
+    Invalid rows are redirected to a scratch row (block 0 never backs live
+    data; see BlockManager) so the scatter stays shape-static.
+    """
+    L, nb, bs, KhD = cache.shape
+    B, T = rows.shape[1], rows.shape[2]
+    pos = starts[:, None] + jnp.arange(T)[None, :]          # (B, T)
+    # clamp: invalid rows may compute positions past the table; they're
+    # redirected to scratch below, the clamp just keeps indexing in-bounds
+    block_idx = jnp.clip(pos // bs, 0, block_tables.shape[1] - 1)
+    offset = pos % bs
+    blocks = jnp.take_along_axis(block_tables, block_idx, axis=1)  # (B, T)
+    flat = blocks * bs + offset                              # row in (nb*bs)
+    # invalid rows land in block 0 (reserved scratch, never allocated), so
+    # the scatter stays shape-static and garbage never touches live data
+    flat = jnp.where(valid, flat, 0).reshape(-1)             # (B*T,)
+    flat_rows = rows.reshape(L, B * T, KhD)
+    flat_cache = cache.reshape(L, nb * bs, KhD)
+    updated = flat_cache.at[:, flat].set(flat_rows)
+    return updated.reshape(L, nb, bs, KhD)
+
+
+def gather_kv(
+    cache: jax.Array,         # (L, nb, bs, KhD)
+    block_tables: jax.Array,  # (B, max_blocks)
+    num_read_blocks: int,     # static: table columns to read (window bucket)
+) -> jax.Array:
+    """XLA reference read: densify the first ``num_read_blocks`` blocks of
+    every slot → ``(L, B, num_read_blocks*bs, KhD)``."""
+    L, nb, bs, KhD = cache.shape
+    tables = block_tables[:, :num_read_blocks]               # (B, nrb)
+    gathered = jnp.take(cache, tables, axis=1)               # (L, B, nrb, bs, KhD)
+    B = tables.shape[0]
+    return gathered.reshape(L, B, num_read_blocks * bs, KhD)
+
+
+# ---------------------------------------------------------------------------
+# host-side block management
+# ---------------------------------------------------------------------------
+
+
+class BlockManager:
+    """Free-list + worst-case reservation accounting (no preemption needed:
+    admission only passes when the request's worst case fits, while physical
+    blocks are handed out lazily as generation grows).
+
+    Block 0 is reserved as the scatter scratch target for masked writes and
+    is never allocated.
+    """
+
+    def __init__(self, layout: PagedLayout, slots: int):
+        self.layout = layout
+        self._free = list(range(layout.num_blocks - 1, 0, -1))  # block 0 reserved
+        self._reserved = 0
+        self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
+        self._slot_reservation = [0] * slots
+        self.tables = np.zeros(
+            (slots, layout.max_blocks_per_slot), dtype=np.int32
+        )
+
+    # -- admission -----------------------------------------------------
+
+    def blocks_needed(self, total_tokens: int) -> int:
+        return -(-total_tokens // self.layout.block_size)
+
+    def fits_ever(self, total_tokens: int) -> bool:
+        """Whether a request of this worst-case size could EVER be admitted
+        (even into an empty pool) — callers must reject oversized requests
+        up front or they would queue forever."""
+        return self.blocks_needed(total_tokens) <= min(
+            self.layout.num_blocks - 1, self.layout.max_blocks_per_slot
+        )
+
+    def can_admit(self, total_tokens: int) -> bool:
+        need = self.blocks_needed(total_tokens)
+        usable = self.layout.num_blocks - 1  # block 0 is scratch
+        return (
+            self._reserved + need <= usable
+            and need <= self.layout.max_blocks_per_slot
+        )
+
+    def admit(self, slot: int, total_tokens: int) -> None:
+        need = self.blocks_needed(total_tokens)
+        if not self.can_admit(total_tokens):
+            raise RuntimeError("paged KV pool exhausted (admission bug)")
+        self._slot_reservation[slot] = need
+        self._reserved += need
+
+    # -- growth --------------------------------------------------------
+
+    def ensure_capacity(self, slot: int, tokens: int) -> bool:
+        """Allocate physical blocks so ``tokens`` positions fit. Returns
+        True if the table changed.
+
+        Growth is capped at the slot's admission reservation: speculative
+        decode chunks may request coverage past the request's true maximum,
+        and capping keeps the reservation invariant (those excess writes are
+        redirected to the scratch block by the unallocated table columns).
+        """
+        need = self.blocks_needed(tokens)
+        if self._slot_reservation[slot]:
+            need = min(need, self._slot_reservation[slot])
+        changed = False
+        while len(self._slot_blocks[slot]) < need:
+            if not self._free:
+                raise RuntimeError(
+                    "paged KV pool exhausted despite reservation accounting"
+                )
+            b = self._free.pop()
+            idx = len(self._slot_blocks[slot])
+            self._slot_blocks[slot].append(b)
+            self.tables[slot, idx] = b
+            changed = True
+        return changed
+
+    def release(self, slot: int) -> None:
+        blocks = self._slot_blocks[slot]
+        self._free.extend(reversed(blocks))
+        self._reserved -= self._slot_reservation[slot]
+        self._slot_reservation[slot] = 0
+        self._slot_blocks[slot] = []
+        self.tables[slot, :] = 0
+
+    # -- stats ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "num_blocks": self.layout.num_blocks,
+            "free_blocks": len(self._free),
+            "reserved_blocks": self._reserved,
+            "live_blocks": sum(len(b) for b in self._slot_blocks),
+        }

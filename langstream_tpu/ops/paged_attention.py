@@ -1,0 +1,204 @@
+"""Paged-attention decode kernel (Pallas TPU).
+
+One decode step reads each slot's KV *blocks* straight out of the shared
+pool — the block table rides in as a scalar-prefetch argument, so each grid
+step's ``index_map`` picks the right pool block to DMA into VMEM. No
+densified gather copy (the XLA reference path :func:`gather_kv` pays one),
+no ``slots × max_seq`` layout anywhere.
+
+Online softmax over the block sweep, same discipline as
+``flash_attention.py``. The kernel returns *partial* results
+``(acc, m, l)`` — unnormalised accumulator, running max, running sum-exp —
+because decode attends over two segments: the paged cache (here) and the
+in-chunk KV buffer (tiny, handled in XLA). The caller merges the two with
+the standard online-softmax combine (``merge_partial_attention``).
+
+Shapes (one layer; the layer loop lives in the model's ``lax.scan``):
+  q             (B, H, D)
+  k_pool/v_pool (nb, bs, Kh*D)
+  block_tables  (B, max_blocks) int32   [scalar prefetch]
+  lengths       (B,) int32              [scalar prefetch]
+  → acc (B, H, D) f32, m (B, H, 128) f32, l (B, H, 128) f32
+    (m/l broadcast along a 128-lane axis: TPU-friendly layout)
+
+Grid ``(B, num_read_blocks)``, block sweep innermost; fully-masked blocks
+(``start >= length``) are skipped with ``pl.when`` — their DMA still
+happens (block 0, the scratch block), which is the price of a static grid.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _paged_kernel(
+    tables_ref,   # SMEM (B, max_blocks) int32
+    lengths_ref,  # SMEM (B,) int32
+    q_ref,        # (1, H, D)
+    k_ref,        # (1, bs, KhD)
+    v_ref,        # (1, bs, KhD)
+    acc_out,      # (1, H, D) f32
+    m_out,        # (1, H, 128) f32
+    l_out,        # (1, H, 128) f32
+    m_ref,        # VMEM (H, 128) f32
+    l_ref,        # VMEM (H, 128) f32
+    acc_ref,      # VMEM (H, D) f32
+    *,
+    scale: float,
+    block_size: int,
+    kv_heads: int,
+    head_dim: int,
+):
+    b = pl.program_id(0)
+    ji = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    length = lengths_ref[b]
+    start = ji * block_size
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(start < length)
+    def _accumulate():
+        H, D = acc_ref.shape
+        G = H // kv_heads
+        q = q_ref[0]                                   # (H, D)
+        k = k_ref[0].reshape(block_size, kv_heads, head_dim)
+        v = v_ref[0].reshape(block_size, kv_heads, head_dim)
+        # scores per kv-head group: q rows [kh*G:(kh+1)*G] attend k[:, kh]
+        qg = q.reshape(kv_heads, G, D)
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                      # (Kh, G, bs)
+        s = s.reshape(H, block_size)
+        cols = start + jax.lax.broadcasted_iota(
+            jnp.int32, (H, block_size), 1
+        )
+        mask = cols < length
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, 0]                           # (H,)
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - shift[:, None])
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - shift))
+        l_ref[:] = jnp.broadcast_to(
+            (l_prev * alpha + jnp.sum(p, axis=1))[:, None], l_ref.shape
+        )
+        pg = p.reshape(kv_heads, G, block_size)
+        pv = jax.lax.dot_general(
+            pg.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )                                              # (Kh, G, D)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv.reshape(H, D)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(ji == num_j - 1)
+    def _finalize():
+        acc_out[0] = acc_ref[:]
+        m_out[0] = m_ref[:]
+        l_out[0] = l_ref[:]
+
+
+def paged_attention_partial(
+    q: jax.Array,             # (B, H, D)
+    k_pool: jax.Array,        # (nb, bs, Kh*D)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    lengths: jax.Array,       # (B,) int32 — cache rows to attend per slot
+    *,
+    num_read_blocks: int,     # static table columns to sweep (window bucket)
+    kv_heads: int,
+    head_dim: int,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Partial (unnormalised) paged attention over the cache segment.
+
+    Returns ``(acc (B,H,D) f32, m (B,H) f32, l (B,H) f32)`` for the caller
+    to merge with other segments via :func:`merge_partial_attention`.
+    """
+    B, H, D = q.shape
+    nb, bs, KhD = k_pool.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    kernel = functools.partial(
+        _paged_kernel,
+        scale=scale,
+        block_size=bs,
+        kv_heads=kv_heads,
+        head_dim=head_dim,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, num_read_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, H, D), lambda b, j, tables, lengths: (b, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, bs, KhD),
+                lambda b, j, tables, lengths: (tables[b, j], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, KhD),
+                lambda b, j, tables, lengths: (tables[b, j], 0, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, D), lambda b, j, tables, lengths: (b, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda b, j, tables, lengths: (b, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda b, j, tables, lengths: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_tables, lengths, q, k_pool, v_pool)
+    return acc, m[:, :, 0], l[:, :, 0]
+
+
+def merge_partial_attention(
+    parts: list[tuple[jax.Array, jax.Array, jax.Array]],
+) -> jax.Array:
+    """Combine per-segment ``(acc, m, l)`` partials into normalised attention
+    output: the associative online-softmax merge."""
+    acc, m, l = parts[0]
+    for acc2, m2, l2 in parts[1:]:
+        m_new = jnp.maximum(m, m2)
+        shift = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        a1 = jnp.exp(jnp.where(m <= NEG_INF, NEG_INF, m - shift))
+        a2 = jnp.exp(jnp.where(m2 <= NEG_INF, NEG_INF, m2 - shift))
+        acc = acc * a1[..., None] + acc2 * a2[..., None]
+        l = l * a1 + l2 * a2
+        m = m_new
+    inv = jnp.where(l > 0.0, 1.0 / jnp.maximum(l, 1e-30), 0.0)
+    return acc * inv[..., None]

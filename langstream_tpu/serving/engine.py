@@ -90,6 +90,16 @@ class ServingConfig:
     # weight-only quantization: None (bf16) or "int8" (scales TP-shard
     # with their weights, so the mesh posture keeps the int8 default)
     quantize: str | None = None
+    # KV cache layout: "dense" reserves slots × max_seq_len rows up front;
+    # "paged" shares a block pool sized kv_pool_fraction of that, with
+    # worst-case admission reservations (models/paged.py)
+    kv_layout: str = "dense"
+    kv_block_size: int = 64
+    kv_pool_fraction: float = 0.5
+    kv_pool_blocks: int | None = None  # explicit pool size override
+    # paged read path: "auto" (Pallas kernel on single-chip TPU, XLA gather
+    # elsewhere), or force "xla" | "pallas" | "pallas-interpret"
+    paged_kernel: str = "auto"
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ServingConfig":
@@ -106,6 +116,17 @@ class ServingConfig:
             seed=int(d.get("seed", 0)),
             decode_chunk=int(d.get("decode-chunk", 16)),
             prefill_batch=int(d.get("prefill-batch", 8)),
+            kv_layout=d.get("kv-layout", d.get("kv_layout", "dense")),
+            kv_block_size=int(d.get("kv-block-size", d.get("kv_block_size", 64))),
+            kv_pool_fraction=float(
+                d.get("kv-pool-fraction", d.get("kv_pool_fraction", 0.5))
+            ),
+            kv_pool_blocks=(
+                int(d.get("kv-pool-blocks") or d.get("kv_pool_blocks"))
+                if (d.get("kv-pool-blocks") or d.get("kv_pool_blocks"))
+                else None
+            ),
+            paged_kernel=d.get("paged-kernel", d.get("paged_kernel", "auto")),
         )
 
 
@@ -229,7 +250,38 @@ class TpuServingEngine:
             self.params = quantize_llama_params(self.params)
         elif self.config.quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {self.config.quantize!r}")
-        cache_k, cache_v = init_kv_cache(mc, self.config.slots)
+
+        self.block_mgr = None
+        if self.config.kv_layout == "paged":
+            from langstream_tpu.models.paged import (
+                BlockManager,
+                PagedLayout,
+                init_paged_kv_cache,
+            )
+
+            self.paged_layout = PagedLayout.for_model(
+                mc.max_seq_len,
+                self.config.slots,
+                block_size=self.config.kv_block_size,
+                hbm_fraction_of_dense=self.config.kv_pool_fraction,
+                num_blocks=self.config.kv_pool_blocks,
+            )
+            self.block_mgr = BlockManager(self.paged_layout, self.config.slots)
+            cache_k, cache_v = init_paged_kv_cache(mc, self.paged_layout)
+            kernel = self.config.paged_kernel
+            if kernel == "auto":
+                # pallas_call has no SPMD partition rule → XLA gather path
+                # under a mesh; the kernel is the single-chip TPU fast path
+                kernel = (
+                    "pallas"
+                    if self.mesh is None and jax.default_backend() == "tpu"
+                    else "xla"
+                )
+            self.paged_read_kernel = kernel
+        elif self.config.kv_layout != "dense":
+            raise ValueError(f"unknown kv_layout {self.config.kv_layout!r}")
+        else:
+            cache_k, cache_v = init_kv_cache(mc, self.config.slots)
 
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -243,7 +295,16 @@ class TpuServingEngine:
                 specs,
                 is_leaf=lambda x: isinstance(x, P),
             )
-            cspec = NamedSharding(self.mesh, kv_cache_spec(self.mesh.axis_names))
+            if self.block_mgr is not None:
+                from langstream_tpu.models.paged import paged_cache_spec
+
+                cspec = NamedSharding(
+                    self.mesh, paged_cache_spec(self.mesh.axis_names)
+                )
+            else:
+                cspec = NamedSharding(
+                    self.mesh, kv_cache_spec(self.mesh.axis_names)
+                )
             cache_k = jax.device_put(cache_k, cspec)
             cache_v = jax.device_put(cache_v, cspec)
         self.cache_k, self.cache_v = cache_k, cache_v
@@ -251,7 +312,38 @@ class TpuServingEngine:
         mc_static = mc
         K = self.config.decode_chunk
 
+        paged = self.block_mgr is not None
+        # flash kernel only on the unsharded path: pallas_call has no SPMD
+        # partition rule, so under a mesh XLA would replicate it per chip
+        # instead of sharding heads
+        prefill_flash = False if self.mesh is not None else None
+
         def _make_decode(use_top_p: bool, window: int | None):
+            """``window``: dense → cache-row bucket (None = full cache);
+            paged → number of block-table columns to sweep."""
+            if paged:
+                @partial(jax.jit, donate_argnums=(1, 2))
+                def _decode_chunk(params, cache_k, cache_v, tokens, lengths,
+                                  active, tables, key, temps, topks, topps):
+                    from langstream_tpu.models.llama_paged import (
+                        llama_decode_chunk_paged,
+                    )
+
+                    def sample_fn(logits, sub):
+                        return sample_tokens(
+                            logits, sub, temps, topks,
+                            use_top_p=use_top_p, top_ps=topps,
+                        )
+
+                    return llama_decode_chunk_paged(
+                        mc_static, params, tokens, lengths, active,
+                        cache_k, cache_v, tables, sample_fn, key, K,
+                        num_read_blocks=window,
+                        kernel=self.paged_read_kernel,
+                    )
+
+                return _decode_chunk
+
             @partial(jax.jit, donate_argnums=(1, 2))
             def _decode_chunk(params, cache_k, cache_v, tokens, lengths, active,
                               key, temps, topks, topps):
@@ -278,15 +370,32 @@ class TpuServingEngine:
         self._make_decode = _make_decode
 
         def _make_prefill(use_top_p: bool):
+            if paged:
+                @partial(jax.jit, donate_argnums=(1, 2))
+                def _prefill(params, cache_k, cache_v, tokens, lengths, tables,
+                             key, temps, topks, topps):
+                    from langstream_tpu.models.llama_paged import (
+                        llama_prefill_paged,
+                    )
+
+                    logits, ck, cv = llama_prefill_paged(
+                        mc_static, params, tokens, lengths, cache_k, cache_v,
+                        tables, use_flash=prefill_flash,
+                    )
+                    next_tokens, logprobs = sample_tokens(
+                        logits, key, temps, topks,
+                        use_top_p=use_top_p, top_ps=topps,
+                    )
+                    return next_tokens, logprobs, ck, cv
+
+                return _prefill
+
             @partial(jax.jit, donate_argnums=(1, 2))
             def _prefill(params, cache_k, cache_v, tokens, lengths, slot_ids,
                          key, temps, topks, topps):
                 logits, ck, cv = llama_prefill(
                     mc_static, params, tokens, lengths, cache_k, cache_v, slot_ids,
-                    # flash kernel only on the unsharded path: pallas_call has
-                    # no SPMD partition rule, so under a mesh XLA would
-                    # replicate it per chip instead of sharding heads
-                    use_flash=False if self.mesh is not None else None,
+                    use_flash=prefill_flash,
                 )
                 next_tokens, logprobs = sample_tokens(
                     logits, key, temps, topks, use_top_p=use_top_p, top_ps=topps
@@ -317,6 +426,13 @@ class TpuServingEngine:
             w *= 2
         return None if w >= S else w
 
+    def _read_blocks_for(self, max_len: int) -> int:
+        """Paged analogue of :meth:`_window_for`: block-table columns to
+        sweep, bucketed so few decode variants compile."""
+        bs = self.paged_layout.block_size
+        window = self._window_for(max_len) or self.model_config.max_seq_len
+        return max(1, min(-(-window // bs), self.paged_layout.max_blocks_per_slot))
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -341,12 +457,23 @@ class TpuServingEngine:
         if top_k > 64:
             log.warning("top-k %d exceeds the compiled window of 64; clamping", top_k)
             top_k = 64
+        max_tokens = min(
+            int(options.get("max-tokens", self.config.default_max_tokens)),
+            self.model_config.max_seq_len - len(tokens) - 1,
+        )
+        if self.block_mgr is not None and not self.block_mgr.fits_ever(
+            len(tokens) + max_tokens + 1
+        ):
+            raise ValueError(
+                f"request needs {len(tokens) + max_tokens + 1} tokens of KV, "
+                f"more than the paged pool can ever hold "
+                f"({self.block_mgr.stats()['num_blocks']} blocks of "
+                f"{self.paged_layout.block_size}); lower max-tokens or grow "
+                f"kv-pool-blocks/kv-pool-fraction"
+            )
         request = _Request(
             prompt_tokens=tokens,
-            max_tokens=min(
-                int(options.get("max-tokens", self.config.default_max_tokens)),
-                self.model_config.max_seq_len - len(tokens) - 1,
-            ),
+            max_tokens=max_tokens,
             temperature=float(options.get("temperature", 0.0)),
             top_k=top_k,
             top_p=float(options.get("top-p", 1.0)),
@@ -361,13 +488,16 @@ class TpuServingEngine:
         return await request.future
 
     def stats(self) -> dict[str, Any]:
-        return {
+        out = {
             "model": self.config.model,
             "slots": self.config.slots,
             "active": sum(1 for s in self.slots if not s.free),
             "queued": self._queue.qsize(),
             "total-generated": self.total_generated,
         }
+        if self.block_mgr is not None:
+            out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
+        return out
 
     async def close(self) -> None:
         self._stop = True
@@ -416,11 +546,13 @@ class TpuServingEngine:
                 self._fail_inflight(e)
 
     def _fail_inflight(self, error: Exception) -> None:
-        for slot in self.slots:
+        for slot_id, slot in enumerate(self.slots):
             request = slot.request
             if request is not None and not request.future.done():
                 request.future.set_exception(error)
             slot.request = None
+            if self.block_mgr is not None:
+                self.block_mgr.release(slot_id)
         self._lengths[:] = 0
         while not self._queue.empty():
             request = self._queue.get_nowait()
@@ -448,38 +580,61 @@ class TpuServingEngine:
         # host-tracked longest active sequence: each dispatched chunk grows
         # it by K; the attention window bucket follows
         base_max = int(self._lengths[active].max())
+        paged = self.block_mgr is not None
 
-        def _dispatch(tokens, lengths, key, window):
+        def _grow_blocks(chunk_index: int) -> jax.Array | None:
+            """Paged: allocate blocks covering every active slot through the
+            (chunk_index+1)-th speculative chunk; return the block tables."""
+            if not paged:
+                return None
+            S = self.model_config.max_seq_len
+            for slot_id in active:
+                if self.slots[slot_id].request is not None:
+                    need = min(int(self._lengths[slot_id]) + (chunk_index + 1) * K, S)
+                    self.block_mgr.ensure_capacity(slot_id, need)
+            return jnp.asarray(self.block_mgr.tables)
+
+        def _dispatch(tokens, lengths, key, window, tables):
             # async JAX dispatch: returns device arrays without blocking
             decode_fn = self._decode_fn(use_top_p, window)
             self.profiler.on_decode_chunk()
+            args = (
+                (self.params, self.cache_k, self.cache_v,
+                 tokens, lengths, amask, tables, key, temps, topks, topps)
+                if paged
+                else (self.params, self.cache_k, self.cache_v,
+                      tokens, lengths, amask, key, temps, topks, topps)
+            )
             self.profiler.dump_hlo(
-                f"decode_chunk_w{window}_topp{int(use_top_p)}", decode_fn,
-                self.params, self.cache_k, self.cache_v,
-                tokens, lengths, amask, key, temps, topks, topps,
+                f"decode_chunk_w{window}_topp{int(use_top_p)}", decode_fn, *args
             )
-            chunk_t, chunk_lp, t, l, ck, cv = decode_fn(
-                self.params, self.cache_k, self.cache_v,
-                tokens, lengths, amask, key, temps, topks, topps,
-            )
+            chunk_t, chunk_lp, t, l, ck, cv = decode_fn(*args)
             self.cache_k, self.cache_v = ck, cv
             return chunk_t, chunk_lp, t, l
+
+        def _bucket_for(max_len: int):
+            return (
+                self._read_blocks_for(max_len) if paged
+                else self._window_for(max_len)
+            )
 
         out = await loop.run_in_executor(
             self._executor,
             partial(
                 _dispatch, jnp.asarray(self._current), jnp.asarray(self._lengths),
-                key1, self._window_for(base_max),
+                key1, _bucket_for(base_max), _grow_blocks(0),
             ),
         )
+        chunk_index = 0
         while True:
             # speculate the next chunk from device state
             base_max += K
+            chunk_index += 1
             key_next = self._split_key()
             next_out_task = loop.run_in_executor(
                 self._executor,
                 partial(_dispatch, out[2], out[3], key_next,
-                        self._window_for(base_max)),
+                        _bucket_for(base_max), _grow_blocks(chunk_index)),
             )
             chunk_t, chunk_lp = await loop.run_in_executor(
                 self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))
@@ -511,17 +666,36 @@ class TpuServingEngine:
                 and len(batch) < min(len(free), self.config.prefill_batch)
             ):
                 request = self._queue._queue[0]  # peek
+                if self.block_mgr is not None and not self.block_mgr.can_admit(
+                    len(request.prompt_tokens) + request.max_tokens + 1
+                ):
+                    # paged backpressure: the worst case doesn't fit the
+                    # pool right now; finished slots will free reservations.
+                    # (Requests that could NEVER fit are rejected up front in
+                    # generate(), so this always unblocks eventually.)
+                    break
                 b = _bucket(len(request.prompt_tokens), hi=self.model_config.max_seq_len)
                 if bucket is None:
                     bucket = b
                 elif b != bucket:
                     break
+                slot_id = free[len(batch)]
                 self._queue.get_nowait()
-                batch.append((free[len(batch)], request))
+                if self.block_mgr is not None:
+                    # reserve at pop time so the NEXT peek's can_admit sees
+                    # this batch member's reservation
+                    self.block_mgr.admit(
+                        slot_id, len(request.prompt_tokens) + request.max_tokens + 1
+                    )
+                batch.append((slot_id, request))
             if not batch:
                 return
             for slot_id, request in batch:
                 self.slots[slot_id].request = request
+                if self.block_mgr is not None:
+                    self.block_mgr.ensure_capacity(
+                        slot_id, len(request.prompt_tokens)
+                    )
             Bp = 1
             while Bp < len(batch):
                 Bp *= 2
@@ -542,11 +716,18 @@ class TpuServingEngine:
             key = self._split_key()
             prefill_fn = self._prefill_fns[bool((topps < 1.0).any())]
 
+            if self.block_mgr is not None:
+                # per-batch-row block tables (duplicate padded rows write
+                # identical values to identical blocks — harmless)
+                sel = jnp.asarray(self.block_mgr.tables[slot_ids])
+            else:
+                sel = jnp.asarray(slot_ids)
+
             def _run():
                 args = (
                     self.params, self.cache_k, self.cache_v,
                     jnp.asarray(padded), jnp.asarray(lengths),
-                    jnp.asarray(slot_ids), key,
+                    sel, key,
                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
                 )
                 self.profiler.dump_hlo(f"prefill_p{bucket}_b{Bp}", prefill_fn, *args)
@@ -613,6 +794,11 @@ class TpuServingEngine:
         if done:
             slot.request = None
             self._lengths[slot_id] = 0
+            if self.block_mgr is not None:
+                # safe while a speculative chunk is in flight: it writes via
+                # the tables captured at its dispatch, and those writes land
+                # before any re-allocation's prefill (single executor thread)
+                self.block_mgr.release(slot_id)
             self._finished_requests.append((request, is_eos))
         return done
 
