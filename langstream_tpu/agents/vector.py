@@ -37,13 +37,34 @@ from langstream_tpu.core.expressions import evaluate, evaluate_accessor
 
 
 class DataSource:
-    """Query SPI (parity: ``ai/agents/datasource/DataSourceProvider``)."""
+    """Query SPI (parity: ``ai/agents/datasource/DataSourceProvider``).
+
+    ``fetch_data``/``execute_write`` carry store-native query strings with
+    positional ``?`` binding (SQL for JDBC, JSON DSL for the in-memory and
+    OpenSearch stores). ``upsert``/``delete_item`` are the structured lane
+    the ``vector-db-sink`` agent drives, so each store maps the common
+    (collection, id, vector, payload) shape to its own write."""
 
     async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
         raise NotImplementedError
 
     async def execute_write(self, query: str, params: list[Any]) -> None:
         raise NotImplementedError
+
+    async def upsert(
+        self,
+        collection: str,
+        item_id: Any,
+        vector: list[float] | None,
+        payload: dict[str, Any],
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_item(self, collection: str, item_id: Any) -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
 
 
 class _Collection:
@@ -176,6 +197,14 @@ class InMemoryVectorStore(DataSource):
         coll.upsert(q.get("id"), q.get("vector"), q.get("payload", {}))
         self._persist()
 
+    async def upsert(self, collection, item_id, vector, payload) -> None:
+        self.collection(collection).upsert(item_id, vector, payload)
+        self._persist()
+
+    async def delete_item(self, collection, item_id) -> None:
+        self.collection(collection).delete(item_id)
+        self._persist()
+
     # -- persistence -----------------------------------------------------
 
     def _persist(self) -> None:
@@ -226,18 +255,25 @@ def resolve_datasource(
     if resource is None:
         # default: an anonymous in-memory store
         return InMemoryVectorStore.get(name or "default")
-    service = resource.get("service", "in-memory")
+    cfg = resource.get("configuration", resource)
+    service = cfg.get("service", resource.get("service", "in-memory"))
     if service in ("in-memory", "memory", "herddb"):
+        # herddb is the reference's embedded dev-mode store; the in-memory
+        # store plays that role here (auto-creating collections)
         return InMemoryVectorStore.get(resource.get("name") or name or "default")
-    if service in ("jdbc", "postgres", "pgvector"):
+    if service in ("jdbc", "sqlite", "postgres", "pgvector"):
         try:
-            from langstream_tpu.agents.jdbc import JdbcDataSource  # gated
+            from langstream_tpu.agents.jdbc import JdbcDataSource
 
-            return JdbcDataSource(resource)
-        except ImportError as e:
+            return JdbcDataSource.get(resource)
+        except ImportError as e:  # postgres driver without psycopg
             raise RuntimeError(
-                f"datasource service {service!r} requires a DB client library: {e}"
+                f"datasource service {service!r}: {e}"
             )
+    if service in ("opensearch", "elasticsearch"):
+        from langstream_tpu.agents.opensearch import OpenSearchDataSource
+
+        return OpenSearchDataSource(resource)
     raise RuntimeError(f"unsupported datasource service {service!r}")
 
 
@@ -276,21 +312,7 @@ class VectorDBSinkAgent(AgentSink):
                 payload[fname] = value
         if item_id is None:
             item_id = f"{record.origin}-{record.timestamp}-{hash(str(record.value)) & 0xFFFFFFFF}"
-        if isinstance(self.datasource, InMemoryVectorStore):
-            self.datasource.collection(self.collection).upsert(item_id, vector, payload)
-            self.datasource._persist()
-        else:
-            await self.datasource.execute_write(
-                json.dumps(
-                    {
-                        "collection": self.collection,
-                        "id": item_id,
-                        "vector": vector,
-                        "payload": payload,
-                    }
-                ),
-                [],
-            )
+        await self.datasource.upsert(self.collection, item_id, vector, payload)
 
 
 class QueryVectorDBAgent(SingleRecordProcessor):
