@@ -2,11 +2,12 @@
 
 Parity: ``langstream-agent-s3`` (``agents/s3/S3Source.java`` — list/read,
 delete-on-commit, idle polling) and
-``langstream-agent-azure-blob-storage-source``. Neither MinIO nor Azure SDKs
-are baked into this image, so those gate on their client libraries; the
-first-party equivalent is ``local-storage-source`` (same list/read/
-delete-on-commit contract against a directory), which the tests and dev mode
-use the way the reference's tests use MinIO testcontainers.
+``langstream-agent-azure-blob-storage-source``. Both are first-party here:
+:mod:`langstream_tpu.agents.s3_impl` speaks SigV4-signed S3 REST and
+:mod:`langstream_tpu.agents.azure_impl` speaks SharedKey/SAS Blob REST, so
+neither needs an SDK. ``local-storage-source`` (same list/read/
+delete-on-commit contract against a directory) remains the dev-mode
+equivalent, used the way the reference's tests use MinIO testcontainers.
 """
 
 from __future__ import annotations
@@ -73,38 +74,13 @@ class LocalStorageSource(AgentSource):
                 self._emitted.discard(path)
 
 
-def _gated_source(name: str, lib: str):
-    class _Gated(AgentSource):
-        async def init(self, configuration: dict[str, Any]) -> None:
-            raise RuntimeError(
-                f"agent {name!r} requires the {lib!r} client library, which is "
-                f"not available in this environment"
-            )
-
-        async def read(self) -> list[Record]:
-            return []
-
-    _Gated.__name__ = f"Gated{name.title().replace('-', '')}"
-    return _Gated
-
-
 def make_s3_source() -> AgentSource:
-    try:
-        import minio  # noqa: F401
+    from langstream_tpu.agents.s3_impl import S3Source
 
-        from langstream_tpu.agents.s3_impl import S3Source  # pragma: no cover
-
-        return S3Source()
-    except ImportError:
-        return _gated_source("s3-source", "minio")()
+    return S3Source()
 
 
 def make_azure_source() -> AgentSource:
-    try:
-        import azure.storage.blob  # noqa: F401
+    from langstream_tpu.agents.azure_impl import AzureBlobSource
 
-        from langstream_tpu.agents.azure_impl import AzureBlobSource  # pragma: no cover
-
-        return AzureBlobSource()
-    except ImportError:
-        return _gated_source("azure-blob-storage-source", "azure-storage-blob")()
+    return AzureBlobSource()

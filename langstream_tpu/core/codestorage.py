@@ -6,9 +6,10 @@ provider module (``langstream-codestorage-providers``: S3 via MinIO client,
 Azure blobs). The control plane uploads the zipped app directory on deploy;
 agent pods' init container downloads it before the runtime starts.
 
-In this build the first-party store is the local filesystem (shared volume /
-PV in-cluster); S3/Azure register only when their client libraries are
-importable (none are baked into the image — gated, not stubbed).
+First-party stores: the local filesystem (shared volume / PV in-cluster),
+S3-compatible object storage (SigV4 REST via
+:class:`langstream_tpu.agents.s3_impl.SyncS3Client` — no SDK needed), and
+Azure Blob (SharedKey REST via :mod:`langstream_tpu.agents.azure_impl`).
 """
 
 from __future__ import annotations
@@ -63,51 +64,100 @@ class LocalDiskCodeStorage(CodeStorage):
 
 
 class S3CodeStorage(CodeStorage):
-    """S3/MinIO-backed archives (parity: ``S3CodeStorage.java:51,84``).
+    """S3/MinIO-backed archives (parity: ``S3CodeStorage.java:51,84``) over
+    the in-tree SigV4 REST client — works against AWS S3 and MinIO alike.
 
-    Gated: requires ``boto3``, which is not baked into this image.
+    No network I/O at construction: read-only consumers (the code-download
+    init container) may hold credentials that can't HEAD/create the bucket;
+    the bucket is ensured lazily on the first ``store``.
     """
 
     def __init__(self, configuration: dict[str, Any]):
-        try:
-            import boto3
-        except ImportError as e:
-            raise RuntimeError(
-                "S3 code storage requires the boto3 client library, which is "
-                "not available in this environment"
-            ) from e
+        from langstream_tpu.agents.s3_impl import SyncS3Client
 
         self.bucket = configuration.get("bucket-name", "langstream-code-storage")
-        self.client = boto3.client(
-            "s3",
-            endpoint_url=configuration.get("endpoint"),
-            aws_access_key_id=configuration.get("access-key"),
-            aws_secret_access_key=configuration.get("secret-key"),
+        self.client = SyncS3Client(
+            endpoint=configuration.get("endpoint", "http://localhost:9000"),
+            access_key=configuration.get("access-key", ""),
+            secret_key=configuration.get("secret-key", ""),
+            region=configuration.get("region", "") or "us-east-1",
         )
+        self._bucket_ready = False
 
     def _key(self, tenant: str, code_archive_id: str) -> str:
         return f"{tenant}/{code_archive_id}.zip"
 
     def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        if not self._bucket_ready:
+            if not self.client.bucket_exists(self.bucket):
+                self.client.create_bucket(self.bucket)
+            self._bucket_ready = True
         digest = hashlib.sha256(archive).hexdigest()[:24]
         code_archive_id = f"{application_id}-{digest}"
         self.client.put_object(
-            Bucket=self.bucket,
-            Key=self._key(tenant, code_archive_id),
-            Body=archive,
+            self.bucket, self._key(tenant, code_archive_id), archive
         )
         return code_archive_id
 
     def download(self, tenant: str, code_archive_id: str) -> bytes:
-        obj = self.client.get_object(
-            Bucket=self.bucket, Key=self._key(tenant, code_archive_id)
+        return self.client.get_object(
+            self.bucket, self._key(tenant, code_archive_id)
         )
-        return obj["Body"].read()
 
     def delete(self, tenant: str, code_archive_id: str) -> None:
         self.client.delete_object(
-            Bucket=self.bucket, Key=self._key(tenant, code_archive_id)
+            self.bucket, self._key(tenant, code_archive_id)
         )
+
+
+class AzureBlobCodeStorage(CodeStorage):
+    """Azure-Blob-backed archives (parity:
+    ``AzureBlobCodeStorage.java`` in ``langstream-codestorage-providers``)
+    over the in-tree SharedKey REST client. Same lazy-container policy as
+    :class:`S3CodeStorage`."""
+
+    def __init__(self, configuration: dict[str, Any]):
+        from langstream_tpu.agents.azure_impl import (
+            SyncAzureBlobClient,
+            parse_connection_string,
+        )
+
+        endpoint = configuration.get("endpoint")
+        if not endpoint:
+            raise ValueError("azure code storage requires 'endpoint'")
+        container = configuration.get("container", "langstream-code-storage")
+        conn = configuration.get("storage-account-connection-string")
+        account = configuration.get("storage-account-name")
+        key = configuration.get("storage-account-key")
+        if conn and not (account and key):
+            parts = parse_connection_string(str(conn))
+            account = parts.get("AccountName")
+            key = parts.get("AccountKey")
+        self.client = SyncAzureBlobClient(
+            endpoint, container,
+            account=account, account_key=key,
+            sas_token=configuration.get("sas-token"),
+        )
+        self._container_ready = False
+
+    def _name(self, tenant: str, code_archive_id: str) -> str:
+        return f"{tenant}/{code_archive_id}.zip"
+
+    def store(self, tenant: str, application_id: str, archive: bytes) -> str:
+        if not self._container_ready:
+            if not self.client.container_exists():
+                self.client.create_container()
+            self._container_ready = True
+        digest = hashlib.sha256(archive).hexdigest()[:24]
+        code_archive_id = f"{application_id}-{digest}"
+        self.client.put_blob(self._name(tenant, code_archive_id), archive)
+        return code_archive_id
+
+    def download(self, tenant: str, code_archive_id: str) -> bytes:
+        return self.client.get_blob(self._name(tenant, code_archive_id))
+
+    def delete(self, tenant: str, code_archive_id: str) -> None:
+        self.client.delete_blob(self._name(tenant, code_archive_id))
 
 
 def make_code_storage(configuration: dict[str, Any] | None) -> CodeStorage:
@@ -120,6 +170,10 @@ def make_code_storage(configuration: dict[str, Any] | None) -> CodeStorage:
         )
     if storage_type == "s3":
         return S3CodeStorage(configuration.get("configuration", configuration))
+    if storage_type in ("azure", "azure-blob-storage"):
+        return AzureBlobCodeStorage(
+            configuration.get("configuration", configuration)
+        )
     raise ValueError(f"unknown code storage type {storage_type!r}")
 
 

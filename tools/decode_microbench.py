@@ -1,0 +1,113 @@
+"""Device-only attribution of the decode-chunk roofline gap.
+
+Times ``llama_decode_chunk`` variants on the real chip with the engine's
+bench shape (llama-1b, B=64 slots, window 512, K=96) and ablations that
+isolate each suspect:
+
+- int8 vs bf16 weights        → is the dequant fusing, or inflating traffic?
+- window sweep (128..1024)    → slope = effective cache read bandwidth;
+                                intercept = weights + fixed overhead
+- batch sweep (8..64)         → cache traffic scales with B, weights don't
+- greedy-only sampler         → top-k lax.top_k cost
+- K sweep (8..96)             → per-chunk fixed cost vs per-step cost
+
+Usage: python tools/decode_microbench.py [--iters 5]
+Prints one JSON line per variant: {"name", "step_ms", "chunk_ms"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.llama import (
+    LlamaConfig,
+    init_kv_cache,
+    init_llama_params,
+    llama_decode_chunk,
+)
+from langstream_tpu.models.quant import quantize_llama_params
+from langstream_tpu.serving.sampler import sample_tokens
+
+
+def build(mc, B, K, window, quantize, sampler):
+    params = init_llama_params(mc)
+    if quantize:
+        params = quantize_llama_params(params)
+    cache_k, cache_v = init_kv_cache(mc, B)
+
+    if sampler == "full":
+        def sample_fn(logits, sub):
+            return sample_tokens(
+                logits, sub,
+                jnp.full((B,), 0.7, jnp.float32),
+                jnp.full((B,), 40, jnp.int32),
+            )
+    else:
+        def sample_fn(logits, sub):
+            t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), t[:, None], axis=1
+            ).squeeze(1)
+            return t, lp
+
+    @jax.jit
+    def run(params, ck, cv, tokens, lengths, active, key):
+        return llama_decode_chunk(
+            mc, params, tokens, lengths, active, ck, cv,
+            sample_fn, key, K, window=window,
+        )
+
+    tokens = jnp.zeros((B,), jnp.int32)
+    lengths = jnp.full((B,), 64, jnp.int32)
+    active = jnp.ones((B,), bool)
+    key = jax.random.PRNGKey(0)
+    return run, params, cache_k, cache_v, tokens, lengths, active, key
+
+
+def measure(name, mc, B, K, window, quantize, sampler, iters):
+    run, params, ck, cv, tokens, lengths, active, key = build(
+        mc, B, K, window, quantize, sampler
+    )
+    out = run(params, ck, cv, tokens, lengths, active, key)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(params, ck, cv, tokens, lengths, active, key)
+    jax.block_until_ready(out)
+    chunk_ms = (time.perf_counter() - t0) / iters * 1e3
+    print(json.dumps({
+        "name": name, "B": B, "K": K, "window": window,
+        "quant": quantize, "sampler": sampler,
+        "chunk_ms": round(chunk_ms, 2),
+        "step_ms": round(chunk_ms / K, 3),
+    }), flush=True)
+    del run, params, ck, cv, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+    mc = LlamaConfig.llama_1b(max_seq_len=1024)
+
+    # bench shape baseline
+    measure("baseline-int8", mc, 64, 96, 512, "int8", "full", args.iters)
+    measure("bf16", mc, 64, 96, 512, None, "full", args.iters)
+    measure("greedy-sampler", mc, 64, 96, 512, "int8", "greedy", args.iters)
+    for w in (128, 256, 1024):
+        measure(f"window-{w}", mc, 64, 96, w, "int8", "full", args.iters)
+    for b in (8, 16, 32):
+        measure(f"batch-{b}", mc, b, 96, 512, "int8", "full", args.iters)
+    for k in (8, 32):
+        measure(f"ksteps-{k}", mc, 64, k, 512, "int8", "full", args.iters)
+
+
+if __name__ == "__main__":
+    main()
