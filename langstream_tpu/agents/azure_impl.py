@@ -31,6 +31,15 @@ log = logging.getLogger(__name__)
 API_VERSION = "2021-08-06"
 
 
+class AzureRequestError(RuntimeError):
+    """Non-OK Blob-service response; carries the HTTP status so callers can
+    treat 404s (blob raced away between list and get) as skippable."""
+
+    def __init__(self, message: str, status: int):
+        super().__init__(message)
+        self.status = status
+
+
 def parse_connection_string(conn: str) -> dict[str, str]:
     out: dict[str, str] = {}
     for part in conn.split(";"):
@@ -150,15 +159,14 @@ class AsyncAzureBlobClient:
         qs = [q for q in (query, self.sas) if q]
         return f"{self.endpoint}{path}" + ("?" + "&".join(qs) if qs else "")
 
-    async def _request(
-        self, method: str, path: str, query: str = "", *, payload: bytes = b"",
-        ok: tuple[int, ...] = (200, 201, 202),
-    ) -> tuple[int, bytes]:
-        url = self._url(path, query)
-        # the Content-Type that goes on the wire must be the one that gets
-        # signed: aiohttp adds 'application/octet-stream' on its own to any
-        # PUT/POST (even body-less ones), which would break the SharedKey
-        # signature — so set it explicitly and sign exactly that
+    def _headers(self, method: str, url: str, payload: bytes) -> dict[str, str]:
+        """Auth + content headers for one request — the single place the
+        signing decisions live (the sync client reuses it verbatim).
+
+        The Content-Type that goes on the wire must be the one that gets
+        signed: aiohttp adds 'application/octet-stream' on its own to any
+        PUT/POST (even body-less ones), which would break the SharedKey
+        signature — so it is set explicitly and signed exactly as sent."""
         content_type = (
             "application/octet-stream"
             if payload or method in ("PUT", "POST")
@@ -175,14 +183,23 @@ class AsyncAzureBlobClient:
                 headers["x-ms-blob-type"] = "BlockBlob"
         if content_type:
             headers["Content-Type"] = content_type
+        return headers
+
+    async def _request(
+        self, method: str, path: str, query: str = "", *, payload: bytes = b"",
+        ok: tuple[int, ...] = (200, 201, 202),
+    ) -> tuple[int, bytes]:
+        url = self._url(path, query)
+        headers = self._headers(method, url, payload)
         session = await self._client()
         async with session.request(
             method, url, data=payload or None, headers=headers
         ) as resp:
             body = await resp.read()
             if resp.status not in ok:
-                raise RuntimeError(
-                    f"azure-blob {method} {path}: {resp.status} {body[:300]!r}"
+                raise AzureRequestError(
+                    f"azure-blob {method} {path}: {resp.status} {body[:300]!r}",
+                    resp.status,
                 )
             return resp.status, body
 
@@ -255,22 +272,7 @@ class SyncAzureBlobClient:
 
         impl = self._impl
         url = impl._url(path, query)
-        content_type = (
-            "application/octet-stream"
-            if payload or method in ("PUT", "POST")
-            else ""
-        )
-        if impl.account_key:
-            headers = shared_key_headers(
-                method, url, account=impl.account, key_b64=impl.account_key,
-                payload=payload, content_type=content_type,
-            )
-        else:
-            headers = {"x-ms-version": API_VERSION}
-            if payload:
-                headers["x-ms-blob-type"] = "BlockBlob"
-        if content_type:
-            headers["Content-Type"] = content_type
+        headers = impl._headers(method, url, payload)
         req = urllib.request.Request(
             url, data=payload or None, headers=headers, method=method
         )
@@ -280,8 +282,8 @@ class SyncAzureBlobClient:
         except urllib.error.HTTPError as e:
             status, body = e.code, e.read()
         if status not in ok:
-            raise RuntimeError(
-                f"azure-blob {method} {path}: {status} {body[:300]!r}"
+            raise AzureRequestError(
+                f"azure-blob {method} {path}: {status} {body[:300]!r}", status
             )
         return status, body
 
@@ -380,7 +382,13 @@ class AzureBlobSource(AgentSource):
             name = self._listing.pop(0)
             if name in self._pending:
                 continue
-            data = await self.client.get_blob(name)
+            try:
+                data = await self.client.get_blob(name)
+            except AzureRequestError as e:
+                if e.status == 404:
+                    log.info("blob %s vanished before read; skipping", name)
+                    continue
+                raise
             self._pending.add(name)
             return [
                 make_record(

@@ -13,6 +13,7 @@ from langstream_tpu.core.planner import register_agent_type
 
 from langstream_tpu.agents import transform, text, flow, ai, vector, http, storage
 from langstream_tpu.agents import jdbc, opensearch  # noqa: F401  (asset managers)
+from langstream_tpu.agents import astra, milvus, solr  # noqa: F401  (asset managers)
 from langstream_tpu.agents import python_custom, webcrawler
 
 SOURCE = ComponentType.SOURCE

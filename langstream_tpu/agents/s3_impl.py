@@ -31,6 +31,15 @@ log = logging.getLogger(__name__)
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
 
+class S3RequestError(RuntimeError):
+    """Non-OK S3 response; carries the HTTP status so callers can treat
+    404s (object raced away between list and get) as skippable."""
+
+    def __init__(self, message: str, status: int):
+        super().__init__(message)
+        self.status = status
+
+
 def _uri_encode(value: str, *, encode_slash: bool = True) -> str:
     safe = "-._~" + ("" if encode_slash else "/")
     return urllib.parse.quote(value, safe=safe)
@@ -157,8 +166,9 @@ class AsyncS3Client:
         ) as resp:
             body = await resp.read()
             if resp.status not in ok:
-                raise RuntimeError(
-                    f"s3 {method} {path}: {resp.status} {body[:300]!r}"
+                raise S3RequestError(
+                    f"s3 {method} {path}: {resp.status} {body[:300]!r}",
+                    resp.status,
                 )
             return resp.status, body
 
@@ -227,7 +237,9 @@ class SyncS3Client:
         except urllib.error.HTTPError as e:
             status, body = e.code, e.read()
         if status not in ok:
-            raise RuntimeError(f"s3 {method} {path}: {status} {body[:300]!r}")
+            raise S3RequestError(
+                f"s3 {method} {path}: {status} {body[:300]!r}", status
+            )
         return status, body
 
     def bucket_exists(self, bucket: str) -> bool:
@@ -307,7 +319,15 @@ class S3Source(AgentSource):
             key = self._listing.pop(0)
             if key in self._pending:
                 continue
-            data = await self.client.get_object(self.bucket, key)
+            try:
+                data = await self.client.get_object(self.bucket, key)
+            except S3RequestError as e:
+                if e.status == 404:
+                    # deleted between list and get (another replica committed
+                    # it, or an external actor) — stale listing entry, skip
+                    log.info("object %s vanished before read; skipping", key)
+                    continue
+                raise
             self._pending.add(key)
             return [
                 make_record(

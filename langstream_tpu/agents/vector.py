@@ -8,9 +8,10 @@ managers that provision tables/collections.
 First-party store: an **in-process vector store** (NumPy brute-force cosine
 / dot-product search, optional JSONL persistence under the agent's state
 dir) — the role HerdDB-with-vectors plays in the reference's dev mode.
-External stores (JDBC/PGVector, Cassandra/Astra, Pinecone, Milvus,
-OpenSearch, Solr) register behind the same SPI when their client libraries
-are importable; none are baked into this image, so they gate cleanly.
+External stores speak their native HTTP surfaces directly (no SDKs):
+JDBC/SQLite (:mod:`.jdbc`), OpenSearch/Elasticsearch (:mod:`.opensearch`),
+Pinecone (:mod:`.pinecone`), Milvus/Zilliz (:mod:`.milvus`), Solr
+(:mod:`.solr`), and Astra/DataStax Data API (:mod:`.astra`).
 
 Query format for the in-memory store: a JSON object (the reference sends
 store-native queries through the same string field, e.g. SQL for JDBC):
@@ -34,6 +35,21 @@ from langstream_tpu.api.agent import AgentSink, SingleRecordProcessor
 from langstream_tpu.api.application import AssetDefinition
 from langstream_tpu.api.record import MutableRecord, Record
 from langstream_tpu.core.expressions import evaluate, evaluate_accessor
+
+
+def bind_json_query(query: str, params: list[Any]) -> dict[str, Any]:
+    """Bind positional ``?`` placeholders into a JSON query (values, incl.
+    arrays) — the store-agnostic half of the reference's
+    ``InterpolationUtils.buildObjectFromJson``."""
+    parts = query.split("?")
+    if len(parts) - 1 != len(params) and len(parts) > 1:
+        raise ValueError(
+            f"query has {len(parts) - 1} placeholders, {len(params)} params given"
+        )
+    out = parts[0]
+    for part, param in zip(parts[1:], params):
+        out += json.dumps(param) + part
+    return json.loads(out)
 
 
 class DataSource:
@@ -168,18 +184,7 @@ class InMemoryVectorStore(DataSource):
 
     # -- DataSource ------------------------------------------------------
 
-    @staticmethod
-    def _bind(query: str, params: list[Any]) -> dict[str, Any]:
-        # JSON query with positional `?` placeholders (values, incl. arrays)
-        parts = query.split("?")
-        if len(parts) - 1 != len(params) and len(parts) > 1:
-            raise ValueError(
-                f"query has {len(parts) - 1} placeholders, {len(params)} params given"
-            )
-        out = parts[0]
-        for part, param in zip(parts[1:], params):
-            out += json.dumps(param) + part
-        return json.loads(out)
+    _bind = staticmethod(bind_json_query)
 
     async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
         q = self._bind(query, params)
@@ -274,6 +279,22 @@ def resolve_datasource(
         from langstream_tpu.agents.opensearch import OpenSearchDataSource
 
         return OpenSearchDataSource(resource)
+    if service == "pinecone":
+        from langstream_tpu.agents.pinecone import PineconeDataSource
+
+        return PineconeDataSource(resource)
+    if service == "milvus":
+        from langstream_tpu.agents.milvus import MilvusDataSource
+
+        return MilvusDataSource(resource)
+    if service == "solr":
+        from langstream_tpu.agents.solr import SolrDataSource
+
+        return SolrDataSource(resource)
+    if service in ("astra-vector-db", "astra", "cassandra"):
+        from langstream_tpu.agents.astra import AstraVectorDataSource
+
+        return AstraVectorDataSource(resource)
     raise RuntimeError(f"unsupported datasource service {service!r}")
 
 

@@ -76,11 +76,18 @@ class S3CodeStorage(CodeStorage):
         from langstream_tpu.agents.s3_impl import SyncS3Client
 
         self.bucket = configuration.get("bucket-name", "langstream-code-storage")
+        region = configuration.get("region", "") or "us-east-1"
+        # no endpoint configured = real AWS S3 for that region (the behavior
+        # the boto3-based predecessor had); MinIO et al. set it explicitly
+        endpoint = (
+            configuration.get("endpoint")
+            or f"https://s3.{region}.amazonaws.com"
+        )
         self.client = SyncS3Client(
-            endpoint=configuration.get("endpoint", "http://localhost:9000"),
+            endpoint=endpoint,
             access_key=configuration.get("access-key", ""),
             secret_key=configuration.get("secret-key", ""),
-            region=configuration.get("region", "") or "us-east-1",
+            region=region,
         )
         self._bucket_ready = False
 
@@ -129,14 +136,22 @@ class AzureBlobCodeStorage(CodeStorage):
         conn = configuration.get("storage-account-connection-string")
         account = configuration.get("storage-account-name")
         key = configuration.get("storage-account-key")
+        sas = configuration.get("sas-token")
         if conn and not (account and key):
             parts = parse_connection_string(str(conn))
             account = parts.get("AccountName")
             key = parts.get("AccountKey")
+        if not sas and not (account and key):
+            # fail at config time, not at the first 401 in a deployer Job
+            raise ValueError(
+                "azure code storage needs sas-token, storage-account-name/"
+                "storage-account-key, or a connection string carrying "
+                "AccountName+AccountKey"
+            )
         self.client = SyncAzureBlobClient(
             endpoint, container,
             account=account, account_key=key,
-            sas_token=configuration.get("sas-token"),
+            sas_token=sas,
         )
         self._container_ready = False
 

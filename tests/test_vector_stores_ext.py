@@ -1,0 +1,611 @@
+"""Pinecone / Milvus / Solr / Astra vector stores against local fake
+services (parity: the reference's per-store ``*AssetQueryWriteIT`` suites).
+Each fake implements the store's real wire surface (Pinecone data plane,
+Milvus RESTful v2, Solr JSON API, Astra JSON Data API) with brute-force
+cosine scoring, so datasource + writer + asset manager are exercised over
+genuine HTTP round trips.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from langstream_tpu.api.application import AssetDefinition
+
+
+def _cosine(a, b) -> float:
+    a = np.asarray(a, dtype=np.float32)
+    b = np.asarray(b, dtype=np.float32)
+    na = float(np.linalg.norm(a)) or 1.0
+    nb = float(np.linalg.norm(b)) or 1.0
+    return float(a @ b / (na * nb))
+
+
+class _FakeHttp:
+    async def start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self.handle)
+        self.app_runner = web.AppRunner(app)
+        await self.app_runner.setup()
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        site = web.TCPSite(self.app_runner, "127.0.0.1", self.port)
+        await site.start()
+        return self
+
+    async def stop(self):
+        await self.app_runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Pinecone
+# ---------------------------------------------------------------------------
+
+
+class FakePinecone(_FakeHttp):
+    def __init__(self):
+        self.namespaces: dict[str, dict[str, dict]] = {}
+        self.api_keys: list[str] = []
+
+    async def handle(self, request):
+        from aiohttp import web
+
+        self.api_keys.append(request.headers.get("Api-Key", ""))
+        body = await request.json() if request.can_read_body else {}
+        ns = self.namespaces.setdefault(body.get("namespace", ""), {})
+        if request.path == "/vectors/upsert":
+            for v in body["vectors"]:
+                ns[v["id"]] = v
+            return web.json_response({"upsertedCount": len(body["vectors"])})
+        if request.path == "/vectors/delete":
+            for vid in body.get("ids", []):
+                ns.pop(vid, None)
+            return web.json_response({})
+        if request.path == "/query":
+            qv = body["vector"]
+            flt = body.get("filter") or {}
+            matches = []
+            for v in ns.values():
+                meta = v.get("metadata") or {}
+                if not all(
+                    meta.get(k) == (c["$eq"] if isinstance(c, dict) else c)
+                    for k, c in flt.items()
+                ):
+                    continue
+                m = {"id": v["id"], "score": _cosine(qv, v["values"])}
+                if body.get("includeMetadata"):
+                    m["metadata"] = meta
+                if body.get("includeValues"):
+                    m["values"] = v["values"]
+                matches.append(m)
+            matches.sort(key=lambda m: -m["score"])
+            return web.json_response({"matches": matches[: body.get("topK", 10)]})
+        return web.Response(status=404)
+
+
+def test_pinecone_datasource_roundtrip(run_async):
+    from langstream_tpu.agents.pinecone import PineconeDataSource
+
+    async def main():
+        fake = await FakePinecone().start()
+        try:
+            ds = PineconeDataSource(
+                {
+                    "configuration": {
+                        "service": "pinecone",
+                        "api-key": "pk-test",
+                        "endpoint": f"http://127.0.0.1:{fake.port}",
+                        "index-name": "docs",
+                    }
+                }
+            )
+            await ds.upsert("default", "a", [1, 0, 0], {"text": "alpha", "genre": "x"})
+            await ds.upsert("default", "b", [0, 1, 0], {"text": "beta", "genre": "y"})
+            rows = await ds.fetch_data(
+                '{"vector": ?, "topK": 2, "includeMetadata": true}', [[1, 0, 0]]
+            )
+            assert rows[0]["id"] == "a" and rows[0]["text"] == "alpha"
+            assert rows[0]["similarity"] > rows[1]["similarity"]
+            # filtered query
+            rows = await ds.fetch_data(
+                '{"vector": ?, "topK": 2, "filter": {"genre": {"$eq": "y"}}}',
+                [[1, 0, 0]],
+            )
+            assert [r["id"] for r in rows] == ["b"]
+            await ds.delete_item("default", "a")
+            rows = await ds.fetch_data('{"vector": ?, "topK": 5}', [[1, 0, 0]])
+            assert [r["id"] for r in rows] == ["b"]
+            assert all(k == "pk-test" for k in fake.api_keys)
+            await ds.close()
+        finally:
+            await fake.stop()
+
+    run_async(main())
+
+
+def test_pinecone_pipeline_sink_and_query(run_async):
+    """Full pipeline lane: vector-db-sink writes into Pinecone, then
+    query-vector-db reads back — through the YAML planner + local runner."""
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    async def main():
+        fake = await FakePinecone().start()
+        try:
+            configuration = f"""
+configuration:
+  resources:
+    - type: "vector-database"
+      name: "pc"
+      configuration:
+        service: "pinecone"
+        api-key: "pk-test"
+        endpoint: "http://127.0.0.1:{fake.port}"
+        index-name: "docs"
+"""
+            pipeline = """
+topics:
+  - name: "docs-in"
+  - name: "query-in"
+  - name: "query-out"
+pipeline:
+  - name: "write"
+    type: "vector-db-sink"
+    input: "docs-in"
+    configuration:
+      datasource: "pc"
+      collection-name: "default"
+      fields:
+        - name: "id"
+          expression: "value.id"
+        - name: "vector"
+          expression: "value.embedding"
+        - name: "text"
+          expression: "value.text"
+  - name: "lookup"
+    type: "query-vector-db"
+    input: "query-in"
+    output: "query-out"
+    configuration:
+      datasource: "pc"
+      query: '{"vector": ?, "topK": 1, "includeMetadata": true}'
+      fields:
+        - "value.embedding"
+      output-field: "value.results"
+"""
+            import tempfile
+            from pathlib import Path
+
+            appdir = Path(tempfile.mkdtemp())
+            (appdir / "pipeline.yaml").write_text(pipeline)
+            (appdir / "configuration.yaml").write_text(configuration)
+            (appdir / "instance.yaml").write_text(
+                "instance:\n  streamingCluster:\n    type: memory\n"
+            )
+            runner = LocalApplicationRunner.from_directory(appdir)
+            async with runner:
+                await runner.produce(
+                    "docs-in",
+                    {"id": "d1", "embedding": [1.0, 0.0], "text": "hello"},
+                )
+                import asyncio
+
+                for _ in range(100):
+                    if self_docs := fake.namespaces.get("default"):
+                        if "d1" in self_docs:
+                            break
+                    await asyncio.sleep(0.05)
+                await runner.produce("query-in", {"embedding": [1.0, 0.0]})
+                msgs = await runner.wait_for_messages("query-out", 1)
+                results = msgs[0].value["results"]
+                assert results[0]["id"] == "d1"
+                assert results[0]["text"] == "hello"
+        finally:
+            await fake.stop()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Milvus
+# ---------------------------------------------------------------------------
+
+
+class FakeMilvus(_FakeHttp):
+    def __init__(self):
+        self.collections: dict[str, dict] = {}
+        self.auth: list[str] = []
+
+    async def handle(self, request):
+        from aiohttp import web
+
+        self.auth.append(request.headers.get("Authorization", ""))
+        body = await request.json() if request.can_read_body else {}
+        name = body.get("collectionName", "")
+        if request.path == "/v2/vectordb/collections/create":
+            self.collections[name] = {"rows": {}, "meta": body}
+            return web.json_response({"code": 0, "data": {}})
+        if request.path == "/v2/vectordb/collections/has":
+            return web.json_response(
+                {"code": 0, "data": {"has": name in self.collections}}
+            )
+        coll = self.collections.setdefault(name, {"rows": {}, "meta": {}})
+        if request.path in (
+            "/v2/vectordb/entities/upsert",
+            "/v2/vectordb/entities/insert",
+        ):
+            for row in body["data"]:
+                coll["rows"][str(row.get("id"))] = row
+            return web.json_response({"code": 0, "data": {"upsertCount": 1}})
+        if request.path == "/v2/vectordb/entities/delete":
+            flt = body.get("filter", "")
+            # fake supports the writer's shape: id in [...]
+            if "id in [" in flt:
+                ids = json.loads(flt.split("id in ", 1)[1].replace("'", '"'))
+                for i in ids:
+                    coll["rows"].pop(str(i), None)
+            return web.json_response({"code": 0, "data": {}})
+        if request.path == "/v2/vectordb/entities/search":
+            qv = body["data"][0]
+            scored = [
+                {
+                    **{k: v for k, v in row.items() if k != "vector"},
+                    "distance": _cosine(qv, row.get("vector", qv)),
+                }
+                for row in coll["rows"].values()
+            ]
+            scored.sort(key=lambda r: -r["distance"])
+            return web.json_response(
+                {"code": 0, "data": scored[: body.get("limit", 10)]}
+            )
+        return web.Response(status=404)
+
+
+def test_milvus_datasource_writer_and_asset(run_async):
+    from langstream_tpu.agents.milvus import (
+        MilvusCollectionAssetManager,
+        MilvusDataSource,
+    )
+
+    async def main():
+        fake = await FakeMilvus().start()
+        try:
+            resource = {
+                "configuration": {
+                    "service": "milvus",
+                    "url": f"http://127.0.0.1:{fake.port}",
+                    "user": "root",
+                    "password": "pw",
+                }
+            }
+            ds = MilvusDataSource(resource)
+            # asset manager provisions the collection
+            mgr = MilvusCollectionAssetManager()
+            asset = AssetDefinition(
+                id="asset-1",
+                name="docs",
+                asset_type="milvus-collection",
+                creation_mode="create-if-not-exists",
+                config={
+                    "collection-name": "docs",
+                    "datasource": resource,
+                    "create-statements": [
+                        '{"collectionName": "docs", "dimension": 3}'
+                    ],
+                },
+            )
+            assert not await mgr.asset_exists(asset)
+            await mgr.deploy_asset(asset)
+            assert await mgr.asset_exists(asset)
+            assert fake.collections["docs"]["meta"]["dimension"] == 3
+
+            await ds.upsert("docs", 1, [1, 0, 0], {"text": "alpha"})
+            await ds.upsert("docs", 2, [0, 1, 0], {"text": "beta"})
+            rows = await ds.fetch_data(
+                '{"collection-name": "docs", "vectors": ?, "top-k": 2}',
+                [[1, 0, 0]],
+            )
+            assert rows[0]["text"] == "alpha"
+            assert rows[0]["similarity"] >= rows[1]["similarity"]
+            await ds.delete_item("docs", 1)
+            rows = await ds.fetch_data(
+                '{"collection-name": "docs", "vectors": ?, "top-k": 5}',
+                [[1, 0, 0]],
+            )
+            assert [r["text"] for r in rows] == ["beta"]
+            # bearer token from user/password
+            assert all(a == "Bearer root:pw" for a in fake.auth if a)
+            await ds.close()
+        finally:
+            await fake.stop()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Solr
+# ---------------------------------------------------------------------------
+
+
+class FakeSolr(_FakeHttp):
+    def __init__(self):
+        self.collections: dict[str, dict[str, dict]] = {}
+        self.schema_calls: list[dict] = []
+
+    async def handle(self, request):
+        from aiohttp import web
+
+        parts = [p for p in request.path.split("/") if p]
+        if request.path == "/api/collections" and request.method == "POST":
+            body = await request.json()
+            self.collections[body.get("name", "")] = {}
+            return web.json_response({"ok": True})
+        if len(parts) >= 3 and parts[0] == "solr":
+            coll_name = parts[1]
+            tail = parts[2]
+            if tail == "schema" and request.method == "POST":
+                self.schema_calls.append(await request.json())
+                return web.json_response({"ok": True})
+            if coll_name not in self.collections:
+                return web.Response(status=404)
+            coll = self.collections[coll_name]
+            if tail == "select":
+                form = await request.post()
+                q = form.get("q", "*:*")
+                docs = list(coll.values())
+                if q.startswith("{!knn"):
+                    # {!knn f=<field> topK=<k>}[vector]
+                    import re
+
+                    m = re.match(r"\{!knn f=(\S+) topK=(\d+)\}(.*)", q)
+                    field, topk, vec = m.group(1), int(m.group(2)), json.loads(m.group(3))
+                    docs = [
+                        {**d, "score": _cosine(vec, d.get(field, vec))}
+                        for d in docs
+                    ]
+                    docs.sort(key=lambda d: -d["score"])
+                    docs = docs[:topk]
+                return web.json_response({"response": {"docs": docs}})
+            if tail == "update":
+                body = await request.json()
+                if isinstance(body, dict) and "delete" in body:
+                    target = body["delete"]
+                    coll.pop(str(target.get("id")), None)
+                else:
+                    for doc in body:
+                        coll[str(doc["id"])] = doc
+                return web.json_response({"ok": True})
+        return web.Response(status=404)
+
+
+def test_solr_datasource_writer_and_asset(run_async):
+    from langstream_tpu.agents.solr import (
+        SolrCollectionAssetManager,
+        SolrDataSource,
+    )
+
+    async def main():
+        fake = await FakeSolr().start()
+        try:
+            resource = {
+                "configuration": {
+                    "service": "solr",
+                    "host": "127.0.0.1",
+                    "port": fake.port,
+                    "collection-name": "documents",
+                }
+            }
+            mgr = SolrCollectionAssetManager()
+            asset = AssetDefinition(
+                id="asset-1",
+                name="documents",
+                asset_type="solr-collection",
+                creation_mode="create-if-not-exists",
+                config={
+                    "datasource": resource,
+                    "create-statements": [
+                        {
+                            "api": "/api/collections",
+                            "body": '"name": "documents", "numShards": 1',
+                        },
+                        {
+                            "api": "/schema",
+                            "body": {
+                                "add-field-type": {
+                                    "name": "knn_vector",
+                                    "class": "solr.DenseVectorField",
+                                    "vectorDimension": 3,
+                                }
+                            },
+                        },
+                    ],
+                },
+            )
+            assert not await mgr.asset_exists(asset)
+            await mgr.deploy_asset(asset)
+            assert await mgr.asset_exists(asset)
+            assert fake.schema_calls and "add-field-type" in fake.schema_calls[0]
+
+            ds = SolrDataSource(resource)
+            await ds.upsert("documents", "a", [1, 0, 0], {"text": "alpha"})
+            await ds.upsert("documents", "b", [0, 1, 0], {"text": "beta"})
+            rows = await ds.fetch_data(
+                '{"q": "{!knn f=embeddings topK=1}?", "fl": "id,text"}',
+                [[1.0, 0.0, 0.0]],
+            )
+            assert len(rows) == 1 and rows[0]["text"] == "alpha"
+            await ds.delete_item("documents", "a")
+            rows = await ds.fetch_data('{"q": "*:*"}', [])
+            assert [r["id"] for r in rows] == ["b"]
+            await ds.close()
+        finally:
+            await fake.stop()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Astra (JSON Data API)
+# ---------------------------------------------------------------------------
+
+
+class FakeAstra(_FakeHttp):
+    def __init__(self):
+        self.keyspaces: dict[str, dict[str, dict[str, dict]]] = {}
+        self.tokens: list[str] = []
+
+    async def handle(self, request):
+        from aiohttp import web
+
+        self.tokens.append(request.headers.get("Token", ""))
+        parts = [p for p in request.path.split("/") if p]
+        # /api/json/v1/{keyspace}[/{collection}]
+        if parts[:3] != ["api", "json", "v1"]:
+            return web.Response(status=404)
+        keyspace = self.keyspaces.setdefault(parts[3], {})
+        body = await request.json()
+        command, payload = next(iter(body.items()))
+        if len(parts) == 4:
+            if command == "createCollection":
+                keyspace[payload["name"]] = {}
+                return web.json_response({"status": {"ok": 1}})
+            if command == "findCollections":
+                return web.json_response(
+                    {"status": {"collections": sorted(keyspace)}}
+                )
+            return web.Response(status=400)
+        coll = keyspace.setdefault(parts[4], {})
+        if command == "insertOne":
+            doc = payload["document"]
+            coll[str(doc.get("_id"))] = doc
+            return web.json_response({"status": {"insertedIds": [doc.get("_id")]}})
+        if command == "findOneAndUpdate":
+            _id = str(payload["filter"].get("_id"))
+            doc = coll.setdefault(_id, {"_id": payload["filter"].get("_id")})
+            doc.update(payload["update"].get("$set", {}))
+            return web.json_response({"data": {"document": doc}})
+        if command == "deleteOne":
+            _id = str(payload["filter"].get("_id"))
+            coll.pop(_id, None)
+            return web.json_response({"status": {"deletedCount": 1}})
+        if command == "find":
+            docs = list(coll.values())
+            flt = payload.get("filter") or {}
+            docs = [
+                d for d in docs if all(d.get(k) == v for k, v in flt.items())
+            ]
+            sort = payload.get("sort") or {}
+            options = payload.get("options") or {}
+            if "$vector" in sort:
+                qv = sort["$vector"]
+                docs = [
+                    {**d, "$similarity": _cosine(qv, d.get("$vector", qv))}
+                    for d in docs
+                ]
+                docs.sort(key=lambda d: -d["$similarity"])
+                if not options.get("includeSimilarity"):
+                    docs = [
+                        {k: v for k, v in d.items() if k != "$similarity"}
+                        for d in docs
+                    ]
+            docs = docs[: options.get("limit", 20)]
+            return web.json_response({"data": {"documents": docs}})
+        return web.Response(status=400)
+
+
+def test_astra_datasource_writer_and_asset(run_async):
+    from langstream_tpu.agents.astra import (
+        AstraCollectionAssetManager,
+        AstraVectorDataSource,
+    )
+
+    async def main():
+        fake = await FakeAstra().start()
+        try:
+            resource = {
+                "configuration": {
+                    "service": "astra-vector-db",
+                    "token": "AstraCS:test",
+                    "endpoint": f"http://127.0.0.1:{fake.port}",
+                }
+            }
+            mgr = AstraCollectionAssetManager()
+            asset = AssetDefinition(
+                id="asset-1",
+                name="docs",
+                asset_type="astra-collection",
+                creation_mode="create-if-not-exists",
+                config={
+                    "collection-name": "docs",
+                    "vector-dimension": 3,
+                    "datasource": resource,
+                },
+            )
+            assert not await mgr.asset_exists(asset)
+            await mgr.deploy_asset(asset)
+            assert await mgr.asset_exists(asset)
+
+            ds = AstraVectorDataSource(resource)
+            await ds.upsert("docs", "a", [1, 0, 0], {"text": "alpha"})
+            await ds.upsert("docs", "b", [0, 1, 0], {"text": "beta"})
+            rows = await ds.fetch_data(
+                '{"collection-name": "docs", "vector": ?, "max": 2, '
+                '"include-similarity": true}',
+                [[1, 0, 0]],
+            )
+            assert rows[0]["id"] == "a" and rows[0]["text"] == "alpha"
+            assert rows[0]["similarity"] >= rows[1]["similarity"]
+            # structured write lane actions
+            await ds.execute_write(
+                '{"collection-name": "docs", "action": "insertOne", '
+                '"document": {"_id": "c", "text": "gamma", "$vector": ?}}',
+                [[0, 0, 1]],
+            )
+            await ds.execute_write(
+                '{"collection-name": "docs", "action": "deleteOne", '
+                '"filter": {"_id": "a"}}',
+                [],
+            )
+            rows = await ds.fetch_data(
+                '{"collection-name": "docs", "vector": ?, "max": 5}', [[0, 0, 1]]
+            )
+            assert rows[0]["id"] == "c"
+            assert all(t == "AstraCS:test" for t in fake.tokens)
+            await ds.close()
+        finally:
+            await fake.stop()
+
+    run_async(main())
+
+
+def test_resolve_datasource_services():
+    """Every new service resolves through the shared resource lookup."""
+    from langstream_tpu.agents.vector import resolve_datasource
+
+    resources = {
+        "pc": {"type": "vector-database", "name": "pc",
+               "configuration": {"service": "pinecone", "api-key": "k",
+                                 "endpoint": "http://x"}},
+        "mv": {"type": "vector-database", "name": "mv",
+               "configuration": {"service": "milvus", "url": "http://x"}},
+        "sl": {"type": "datasource", "name": "sl",
+               "configuration": {"service": "solr", "host": "x"}},
+        "as": {"type": "vector-database", "name": "as",
+               "configuration": {"service": "astra-vector-db",
+                                 "token": "t", "endpoint": "http://x"}},
+    }
+    from langstream_tpu.agents.astra import AstraVectorDataSource
+    from langstream_tpu.agents.milvus import MilvusDataSource
+    from langstream_tpu.agents.pinecone import PineconeDataSource
+    from langstream_tpu.agents.solr import SolrDataSource
+
+    assert isinstance(resolve_datasource("pc", resources), PineconeDataSource)
+    assert isinstance(resolve_datasource("mv", resources), MilvusDataSource)
+    assert isinstance(resolve_datasource("sl", resources), SolrDataSource)
+    assert isinstance(resolve_datasource("as", resources), AstraVectorDataSource)
