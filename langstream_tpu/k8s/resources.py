@@ -30,6 +30,7 @@ from langstream_tpu.k8s.crds import AgentCustomResource
 
 AGENT_PORT = 8080  # /metrics + /info (parity: AgentRunner.java:96-110)
 COORDINATOR_PORT = 8476  # jax.distributed coordinator
+LOCKSTEP_PORT = 7077  # leader->follower step-descriptor channel (serving/lockstep.py)
 
 
 # accelerator → (GKE accelerator label, chips per host, topology by chips)
@@ -52,6 +53,17 @@ TPU_TOPOLOGIES: dict[str, tuple[str, int, dict[int, str]]] = {
         {4: "2x2x1", 8: "2x2x2", 16: "2x2x4", 32: "2x4x4", 64: "4x4x4"},
     ),
 }
+
+
+def _lockstep_token(spec: Any) -> str:
+    """Join token for the lockstep channel: HMAC of the slice identity keyed
+    by the agent config checksum (cluster-internal secret material)."""
+    import hashlib
+    import hmac as _hmac
+
+    key = (spec.agent_config_secret_ref_checksum or "unconfigured").encode()
+    msg = f"{spec.tenant}/{spec.application_id}/{spec.agent_id}".encode()
+    return _hmac.new(key, msg, hashlib.sha256).hexdigest()
 
 
 def mesh_chips(device_mesh: dict[str, int] | None) -> int:
@@ -114,6 +126,7 @@ class AgentResourcesFactory:
                 "ports": [
                     {"name": "http", "port": AGENT_PORT},
                     {"name": "coordinator", "port": COORDINATOR_PORT},
+                    {"name": "lockstep", "port": LOCKSTEP_PORT},
                 ],
             },
         }
@@ -197,6 +210,14 @@ class AgentResourcesFactory:
                     "name": "LS_COORDINATOR_ADDRESS",
                     "value": f"{name}-0.{service}:{COORDINATOR_PORT}",
                 },
+                # lockstep control channel: followers replay the leader's
+                # jitted dispatches from this port (serving/lockstep.py)
+                {"name": "LS_LOCKSTEP_PORT", "value": str(LOCKSTEP_PORT)},
+                # join auth for the channel: deterministic (a random value
+                # would diff the spec and roll the pods every reconcile) but
+                # derived from the config-secret checksum, which only pods
+                # holding the mounted config know
+                {"name": "LS_LOCKSTEP_TOKEN", "value": _lockstep_token(spec)},
             ]
         if logical_replica is not None:
             env.append(

@@ -48,3 +48,16 @@ def local_mesh(tp: int | None = None, dp: int = 1, sp: int = 1) -> Mesh:
     if tp is None:
         tp = n // (dp * sp)
     return make_mesh({"dp": dp, "sp": sp, "tp": tp})
+
+
+def put_global(x, sharding):
+    """``jax.device_put`` that also works in multi-controller runs: every
+    process holds the full host value (identical by construction — same
+    PRNG/checkpoint on every host) and contributes its addressable shards
+    via ``make_array_from_callback``. Single-process: plain device_put."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )

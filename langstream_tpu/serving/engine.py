@@ -101,6 +101,28 @@ class ServingConfig:
     # elsewhere), or force "xla" | "pallas" | "pallas-interpret"
     paged_kernel: str = "auto"
 
+    def to_dict(self) -> dict[str, Any]:
+        """Kebab-case dict that :meth:`from_dict` round-trips — the lockstep
+        handshake ships this so followers build the identical engine."""
+        return {
+            "model": self.model,
+            "slots": self.slots,
+            "max-seq-len": self.max_seq_len,
+            "tokenizer": self.tokenizer,
+            "checkpoint": self.checkpoint,
+            "mesh": dict(self.mesh),
+            "max-tokens": self.default_max_tokens,
+            "seed": self.seed,
+            "decode-chunk": self.decode_chunk,
+            "prefill-batch": self.prefill_batch,
+            "quantize": self.quantize,
+            "kv-layout": self.kv_layout,
+            "kv-block-size": self.kv_block_size,
+            "kv-pool-fraction": self.kv_pool_fraction,
+            "kv-pool-blocks": self.kv_pool_blocks,
+            "paged-kernel": self.paged_kernel,
+        }
+
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ServingConfig":
         mesh = tuple((k, int(v)) for k, v in (d.get("mesh") or {}).items())
@@ -184,7 +206,7 @@ class TpuServingEngine:
         with cls._instances_lock:
             cls._instances.clear()
 
-    def __init__(self, config: ServingConfig):
+    def __init__(self, config: ServingConfig, lockstep_role: str | None = None):
         self.config = config
         if config.model not in _MODEL_CONFIGS:
             raise ValueError(
@@ -205,6 +227,35 @@ class TpuServingEngine:
             from langstream_tpu.parallel.mesh import make_mesh
 
             self.mesh = make_mesh(dict(config.mesh))
+
+        # multi-host slice: process 0 leads (broadcasts every dispatch over
+        # the lockstep channel, serving/lockstep.py); followers are built by
+        # LockstepFollower with lockstep_role="follower" and replay them.
+        # Every process then issues identical jit calls — the requirement of
+        # JAX multi-controller execution (SURVEY §7 hard part (c)).
+        self._lockstep = None
+        if (
+            lockstep_role != "follower"
+            and self.mesh is not None
+            and jax.process_count() > 1
+        ):
+            import json as _json
+            import os as _os
+
+            from langstream_tpu.serving.lockstep import LockstepLeader
+
+            port = int(_os.environ.get("LS_LOCKSTEP_PORT", "0")) or None
+            self._lockstep = LockstepLeader(
+                {"config_json": _json.dumps(config.to_dict())},
+                expected_followers=jax.process_count() - 1,
+                port=port,
+                token=_os.environ.get("LS_LOCKSTEP_TOKEN", ""),
+            )
+            log.info(
+                "lockstep leader on :%d awaiting %d followers",
+                self._lockstep.port, jax.process_count() - 1,
+            )
+            self._lockstep.wait_ready()
 
         self._init_model()
 
@@ -287,10 +338,11 @@ class TpuServingEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from langstream_tpu.models.quant import quantize_specs
+            from langstream_tpu.parallel.mesh import put_global
 
             specs = quantize_specs(llama_param_specs(mc), self.params)
             self.params = jax.tree.map(
-                lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+                lambda p, s: put_global(p, NamedSharding(self.mesh, s)),
                 self.params,
                 specs,
                 is_leaf=lambda x: isinstance(x, P),
@@ -305,12 +357,29 @@ class TpuServingEngine:
                 cspec = NamedSharding(
                     self.mesh, kv_cache_spec(self.mesh.axis_names)
                 )
-            cache_k = jax.device_put(cache_k, cspec)
-            cache_v = jax.device_put(cache_v, cspec)
+            cache_k = put_global(cache_k, cspec)
+            cache_v = put_global(cache_v, cspec)
         self.cache_k, self.cache_v = cache_k, cache_v
 
         mc_static = mc
         K = self.config.decode_chunk
+
+        # sampled tokens/logprobs come back to the leader host every chunk;
+        # under a (possibly multi-host) mesh they inherit the dp sharding of
+        # the logits, which a multi-controller leader cannot fetch — pin them
+        # replicated (XLA: one tiny all-gather on ICI per chunk)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            _rep = NamedSharding(self.mesh, P())
+
+            def _fetchable(*arrays):
+                return tuple(
+                    jax.lax.with_sharding_constraint(a, _rep) for a in arrays
+                )
+        else:
+            def _fetchable(*arrays):
+                return arrays
 
         paged = self.block_mgr is not None
         # flash kernel only on the unsharded path: pallas_call has no SPMD
@@ -335,12 +404,13 @@ class TpuServingEngine:
                             use_top_p=use_top_p, top_ps=topps,
                         )
 
-                    return llama_decode_chunk_paged(
+                    out = llama_decode_chunk_paged(
                         mc_static, params, tokens, lengths, active,
                         cache_k, cache_v, tables, sample_fn, key, K,
                         num_read_blocks=window,
                         kernel=self.paged_read_kernel,
                     )
+                    return _fetchable(out[0], out[1]) + out[2:]
 
                 return _decode_chunk
 
@@ -360,10 +430,11 @@ class TpuServingEngine:
                         use_top_p=use_top_p, top_ps=topps,
                     )
 
-                return llama_decode_chunk(
+                out = llama_decode_chunk(
                     mc_static, params, tokens, lengths, active,
                     cache_k, cache_v, sample_fn, key, K, window=window,
                 )
+                return _fetchable(out[0], out[1]) + out[2:]
 
             return _decode_chunk
 
@@ -382,9 +453,11 @@ class TpuServingEngine:
                         mc_static, params, tokens, lengths, cache_k, cache_v,
                         tables, use_flash=prefill_flash,
                     )
-                    next_tokens, logprobs = sample_tokens(
-                        logits, key, temps, topks,
-                        use_top_p=use_top_p, top_ps=topps,
+                    next_tokens, logprobs = _fetchable(
+                        *sample_tokens(
+                            logits, key, temps, topks,
+                            use_top_p=use_top_p, top_ps=topps,
+                        )
                     )
                     return next_tokens, logprobs, ck, cv
 
@@ -397,8 +470,11 @@ class TpuServingEngine:
                     mc_static, params, tokens, lengths, cache_k, cache_v, slot_ids,
                     use_flash=prefill_flash,
                 )
-                next_tokens, logprobs = sample_tokens(
-                    logits, key, temps, topks, use_top_p=use_top_p, top_ps=topps
+                next_tokens, logprobs = _fetchable(
+                    *sample_tokens(
+                        logits, key, temps, topks,
+                        use_top_p=use_top_p, top_ps=topps,
+                    )
                 )
                 return next_tokens, logprobs, ck, cv
 
@@ -504,6 +580,8 @@ class TpuServingEngine:
         self._wake.set()
         if self._loop_task is not None:
             await self._loop_task
+        if self._lockstep is not None:
+            self._lockstep.close()
         self._executor.shutdown(wait=False)
         # evict from the singleton cache: a closed engine must not be handed
         # out again (its loop would exit immediately, stranding requests)
@@ -544,6 +622,13 @@ class TpuServingEngine:
                 # free the slots, keep serving (callers see the exception)
                 log.exception("serving engine step failed")
                 self._fail_inflight(e)
+                from langstream_tpu.serving.lockstep import LockstepBroken
+
+                if isinstance(e, LockstepBroken):
+                    # a lost follower is unrecoverable for this process
+                    # group — stop serving so the slice restarts as a unit
+                    log.error("lockstep group broken; engine stops serving")
+                    self._stop = True
 
     def _fail_inflight(self, error: Exception) -> None:
         for slot_id, slot in enumerate(self.slots):
@@ -582,9 +667,12 @@ class TpuServingEngine:
         base_max = int(self._lengths[active].max())
         paged = self.block_mgr is not None
 
-        def _grow_blocks(chunk_index: int) -> jax.Array | None:
+        def _grow_blocks(chunk_index: int) -> np.ndarray | None:
             """Paged: allocate blocks covering every active slot through the
-            (chunk_index+1)-th speculative chunk; return the block tables."""
+            (chunk_index+1)-th speculative chunk; returns a host snapshot of
+            the block tables (the dispatch converts it device-side — keeping
+            it numpy here lets the lockstep broadcast ship it without a
+            device→host round-trip)."""
             if not paged:
                 return None
             S = self.model_config.max_seq_len
@@ -592,15 +680,39 @@ class TpuServingEngine:
                 if self.slots[slot_id].request is not None:
                     need = min(int(self._lengths[slot_id]) + (chunk_index + 1) * K, S)
                     self.block_mgr.ensure_capacity(slot_id, need)
-            return jnp.asarray(self.block_mgr.tables)
+            return self.block_mgr.tables.copy()
 
-        def _dispatch(tokens, lengths, key, window, tables):
+        def _dispatch(tokens, lengths, key, window, tables, first=False):
             # async JAX dispatch: returns device arrays without blocking
             decode_fn = self._decode_fn(use_top_p, window)
+            if self._lockstep is not None:
+                # runs on the single dispatch thread → broadcast order is
+                # dispatch order. Speculative chunks ("decode_cont") carry
+                # only control: followers chain their own device-resident
+                # tokens/lengths outputs, so nothing syncs to host here.
+                desc: dict[str, Any] = {
+                    "op": "decode" if first else "decode_cont",
+                    "use_top_p": bool(use_top_p),
+                    "window": window,
+                    "key": np.asarray(key),
+                }
+                if tables is not None:
+                    desc["tables"] = tables  # host snapshot from _grow_blocks
+                if first:
+                    desc.update(
+                        tokens=np.asarray(self._current),
+                        lengths=np.asarray(self._lengths),
+                        active=active_mask,
+                        temps=np.asarray(self._temps),
+                        topks=np.asarray(self._topks),
+                        topps=np.asarray(self._topps),
+                    )
+                self._lockstep.broadcast(desc)
             self.profiler.on_decode_chunk()
+            tables_dev = jnp.asarray(tables) if tables is not None else None
             args = (
                 (self.params, self.cache_k, self.cache_v,
-                 tokens, lengths, amask, tables, key, temps, topks, topps)
+                 tokens, lengths, amask, tables_dev, key, temps, topks, topps)
                 if paged
                 else (self.params, self.cache_k, self.cache_v,
                       tokens, lengths, amask, key, temps, topks, topps)
@@ -622,7 +734,7 @@ class TpuServingEngine:
             self._executor,
             partial(
                 _dispatch, jnp.asarray(self._current), jnp.asarray(self._lengths),
-                key1, _bucket_for(base_max), _grow_blocks(0),
+                key1, _bucket_for(base_max), _grow_blocks(0), first=True,
             ),
         )
         chunk_index = 0
@@ -719,11 +831,26 @@ class TpuServingEngine:
             if self.block_mgr is not None:
                 # per-batch-row block tables (duplicate padded rows write
                 # identical values to identical blocks — harmless)
-                sel = jnp.asarray(self.block_mgr.tables[slot_ids])
+                sel_np = self.block_mgr.tables[slot_ids]
             else:
-                sel = jnp.asarray(slot_ids)
+                sel_np = slot_ids
+            sel = jnp.asarray(sel_np)
 
             def _run():
+                if self._lockstep is not None:
+                    self._lockstep.broadcast(
+                        {
+                            "op": "prefill",
+                            "use_top_p": bool((topps < 1.0).any()),
+                            "tokens": padded,
+                            "lengths": lengths,
+                            "sel": np.asarray(sel_np),
+                            "key": np.asarray(key),
+                            "temps": temps,
+                            "topks": topks,
+                            "topps": topps,
+                        }
+                    )
                 args = (
                     self.params, self.cache_k, self.cache_v,
                     jnp.asarray(padded), jnp.asarray(lengths),

@@ -1,0 +1,81 @@
+"""Multi-host lockstep serving: two real OS processes, each owning 4
+virtual CPU devices, form a JAX distributed group; the leader serves
+requests while the follower replays the leader's step descriptors — and the
+generated token streams must equal a single-process run of the identical
+config (SURVEY §7 hard part (c); VERDICT r2 item 1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _sub_env() -> dict[str, str]:
+    """Subprocess env: the demo module forces its own CPU platform and
+    4-device flag — the parent's test flags must not leak in."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.slow
+def test_two_process_lockstep_decode_matches_single_process(tmp_path):
+    coordinator_port = _free_port()
+    lockstep_port = _free_port()
+    out = tmp_path / "leader_tokens.json"
+    env = _sub_env()
+
+    follower = subprocess.Popen(
+        [
+            sys.executable, "-m", "langstream_tpu.serving.lockstep_demo",
+            "--index", "1", "--coordinator-port", str(coordinator_port),
+            "--lockstep-port", str(lockstep_port),
+        ],
+        env=env, stderr=subprocess.PIPE,
+    )
+    leader = subprocess.Popen(
+        [
+            sys.executable, "-m", "langstream_tpu.serving.lockstep_demo",
+            "--index", "0", "--coordinator-port", str(coordinator_port),
+            "--lockstep-port", str(lockstep_port), "--out", str(out),
+        ],
+        env=env, stderr=subprocess.PIPE,
+    )
+    try:
+        _, leader_err = leader.communicate(timeout=300)
+        _, follower_err = follower.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        leader.kill()
+        follower.kill()
+        raise
+    assert leader.returncode == 0, leader_err.decode()[-2000:]
+    assert follower.returncode == 0, follower_err.decode()[-2000:]
+    assert b"follower replayed" in follower_err
+
+    lockstep_tokens = json.loads(out.read_text())
+    # same config, one process, all 8 devices local: the golden stream
+    from langstream_tpu.serving.lockstep_demo import (
+        run_single_process_reference,
+    )
+
+    reference_tokens = run_single_process_reference(8)
+    assert lockstep_tokens == reference_tokens
+    assert len(lockstep_tokens) == 3
+    assert all(len(stream) > 0 for stream in lockstep_tokens)
