@@ -186,9 +186,12 @@ class _GrpcAgentBase:
         producers: dict[str, Any] = {}
         try:
             async for msg in call:
-                record = record_from_proto(msg.record)
                 ack = self.pb2.TopicProducerAck(record_id=msg.record_id)
                 try:
+                    # decode inside the guarded block: a malformed record
+                    # must become a failed ack, not a dead pump (a dead pump
+                    # leaves the sidecar's write awaiting forever)
+                    record = record_from_proto(msg.record)
                     if self.context is None:
                         raise RuntimeError("agent context not set")
                     if msg.topic not in producers:
@@ -204,6 +207,13 @@ class _GrpcAgentBase:
                 await call.write(ack)
         except (asyncio.CancelledError, grpc.aio.AioRpcError):
             pass
+        finally:
+            # end the stream on any exit so the server fails still-pending
+            # writes instead of leaving them suspended on a silent channel
+            try:
+                call.cancel()
+            except Exception:
+                pass
 
     async def _restart_transport(self) -> bool:
         """Respawn a dead sidecar and reconnect (parity: the reference's

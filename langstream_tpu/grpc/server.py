@@ -77,6 +77,11 @@ class ExternalAgentService:
         self._inflight_source: dict[int, Record] = {}
         self._producer_queue: asyncio.Queue = asyncio.Queue()
         self._producer_id = iter(range(1, 1 << 62))
+        # writes awaiting their runtime ack, keyed by record_id — the
+        # at-least-once half of the topic-producer lane (parity: the
+        # reference returns TopicProducerWriteResult per write,
+        # ``agent.proto:73-76`` there)
+        self._producer_pending: dict[int, asyncio.Future] = {}
 
     async def start(self) -> None:
         from langstream_tpu.agents.python_custom import _load_user_class
@@ -95,11 +100,23 @@ class ExternalAgentService:
             await _maybe_await(self.delegate.close())
 
     async def queue_topic_producer_record(self, topic: str, record: Any) -> None:
+        """Queue a record for the runtime to publish and wait for its ack —
+        user code's ``await producer.write(record)`` returns only once the
+        runtime confirmed the write (raises on a failed one). Blocks until a
+        runtime is connected, exactly like a broker producer awaiting its
+        broker."""
         from langstream_tpu.agents.python_custom import _coerce_result
         from langstream_tpu.api.record import make_record
 
         coerced = _coerce_result(record, make_record())
-        await self._producer_queue.put((next(self._producer_id), topic, coerced))
+        rid = next(self._producer_id)
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._producer_pending[rid] = future
+        await self._producer_queue.put((rid, topic, coerced))
+        try:
+            await future
+        finally:
+            self._producer_pending.pop(rid, None)
 
     # ---- RPC handlers ----------------------------------------------------
 
@@ -212,8 +229,16 @@ class ExternalAgentService:
 
     async def topic_producer_records(self, request_iterator, context):
         async def consume_acks():
-            async for _ack in request_iterator:
-                pass  # at-most-once fire-and-forget acks for now
+            async for ack in request_iterator:
+                future = self._producer_pending.get(ack.record_id)
+                if future is None or future.done():
+                    continue
+                if ack.error:
+                    future.set_exception(
+                        RuntimeError(f"topic producer write failed: {ack.error}")
+                    )
+                else:
+                    future.set_result(None)
 
         consumer = asyncio.ensure_future(consume_acks())
         try:
@@ -224,6 +249,15 @@ class ExternalAgentService:
                 yield msg
         finally:
             consumer.cancel()
+            # the runtime went away: in-flight writes must not hang — fail
+            # them so user code can retry once the stream is re-established
+            for future in list(self._producer_pending.values()):
+                if not future.done():
+                    future.set_exception(
+                        RuntimeError(
+                            "runtime disconnected before acking the write"
+                        )
+                    )
 
 
 class AgentServer:

@@ -277,3 +277,118 @@ def test_full_pipeline_with_real_sidecar_subprocess(user_module, tmp_path, run_a
             assert msgs[0].value == "ping!!"
 
     run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# topic-producer ack round trip (at-least-once for sidecar writes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def producer_module(tmp_path):
+    pkg = tmp_path / "python"
+    pkg.mkdir()
+    (pkg / "sideagents.py").write_text(
+        textwrap.dedent(
+            '''
+            class SideWriter:
+                def set_context(self, ctx):
+                    self.ctx = ctx
+
+                async def process(self, record):
+                    producer = self.ctx.get_topic_producer("side")
+                    await producer.write((record.value + "-side", None, None))
+                    return [(record.value + "-done", None, None)]
+            '''
+        )
+    )
+    return tmp_path
+
+
+class _AckContext:
+    """Runtime context double whose topic producer can be told to fail."""
+
+    def __init__(self, fail: bool = False):
+        self.written = []
+        self.fail = fail
+
+    def get_topic_producer(self, topic):
+        ctx = self
+
+        class _Handle:
+            async def write(self, record):
+                if ctx.fail:
+                    raise RuntimeError("broker down")
+                ctx.written.append((topic, record))
+
+        return _Handle()
+
+    def critical_failure(self, error):
+        pass
+
+
+def test_topic_producer_write_acked(producer_module, run_async):
+    """A sidecar's producer.write only returns after the runtime acked the
+    publish (parity: TopicProducerWriteResult, reference agent.proto:73-76)."""
+    from langstream_tpu.grpc.client import GrpcAgentProcessor
+
+    async def main():
+        processor = GrpcAgentProcessor()
+        config = {
+            "className": "sideagents.SideWriter",
+            "__application_directory__": str(producer_module),
+        }
+        server = AgentServer(config)
+        port = await server.start()
+        await processor.init({**config, "endpoint": f"127.0.0.1:{port}"})
+        await processor.setup(_AckContext())
+        await processor.start()
+        sink = _CollectingSink()
+        processor.process([make_record(value="a")], sink)
+        for _ in range(100):
+            if sink.results:
+                break
+            await asyncio.sleep(0.05)
+        assert sink.results[0].results[0].value == "a-done"
+        # the side write really reached the runtime's producer before the
+        # process result was emitted
+        assert processor.context.written[0][0] == "side"
+        assert processor.context.written[0][1].value == "a-side"
+        await processor.close()
+        await server.stop()
+
+    run_async(main())
+
+
+def test_topic_producer_write_failure_surfaces_in_sidecar(
+    producer_module, run_async
+):
+    """A failed runtime-side publish raises inside the sidecar user code —
+    not silently dropped (the round-2 behavior this replaces)."""
+    from langstream_tpu.grpc.client import GrpcAgentProcessor
+
+    async def main():
+        processor = GrpcAgentProcessor()
+        config = {
+            "className": "sideagents.SideWriter",
+            "__application_directory__": str(producer_module),
+        }
+        server = AgentServer(config)
+        port = await server.start()
+        await processor.init({**config, "endpoint": f"127.0.0.1:{port}"})
+        await processor.setup(_AckContext(fail=True))
+        await processor.start()
+        sink = _CollectingSink()
+        processor.process([make_record(value="a")], sink)
+        for _ in range(100):
+            if sink.errors:
+                break
+            await asyncio.sleep(0.05)
+        (failed, error), = sink.errors
+        assert failed.value == "a"
+        assert "topic producer write failed" in str(error)
+        assert "broker down" in str(error)
+        await processor.close()
+        await server.stop()
+
+    run_async(main())
