@@ -42,6 +42,76 @@ def _load_state_dict(path: Path) -> dict:
     raise FileNotFoundError(f"no weight files under {path}")
 
 
+def save_llama_checkpoint(
+    params: dict, config: LlamaConfig, checkpoint_dir: str
+) -> None:
+    """Write a stacked-layer param tree back out as an HF-format Llama
+    checkpoint (``pytorch_model.bin`` with standard tensor names plus a
+    minimal ``config.json``) — the inverse of :func:`load_llama_checkpoint`,
+    so checkpoints round-trip between this framework and the HF ecosystem."""
+    import json
+
+    import torch
+
+    path = Path(checkpoint_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    c = config
+    layers = params["layers"]
+
+    def t(a: np.ndarray, transpose: bool = True) -> "torch.Tensor":
+        a = a.astype(np.float32, copy=False)
+        return torch.from_numpy(a.T.copy() if transpose else a.copy())
+
+    state: dict = {
+        "model.embed_tokens.weight": t(
+            np.asarray(params["embed"]), transpose=False
+        ),
+        "model.norm.weight": t(np.asarray(params["final_norm"]), transpose=False),
+        "lm_head.weight": t(np.asarray(params["lm_head"])),
+    }
+    names = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    # one device→host transfer per stacked tensor, indexed per layer after
+    # (not L transfers of the full stack)
+    host = {ours: np.asarray(layers[ours]) for ours in names}
+    for i in range(c.layers):
+        for ours, (hf_name, transpose) in names.items():
+            state[f"model.layers.{i}.{hf_name}"] = t(
+                host[ours][i], transpose=transpose
+            )
+    torch.save(state, path / "pytorch_model.bin")
+    (path / "config.json").write_text(
+        json.dumps(
+            {
+                "architectures": ["LlamaForCausalLM"],
+                "model_type": "llama",
+                "vocab_size": c.vocab_size,
+                "hidden_size": c.hidden,
+                "num_hidden_layers": c.layers,
+                "num_attention_heads": c.heads,
+                "num_key_value_heads": c.kv_heads,
+                "head_dim": c.head_dim,
+                "intermediate_size": c.intermediate,
+                "rope_theta": c.rope_theta,
+                "rms_norm_eps": c.norm_eps,
+                "max_position_embeddings": c.max_seq_len,
+                "tie_word_embeddings": False,
+                "torch_dtype": "float32",
+            },
+            indent=2,
+        )
+    )
+
+
 def load_llama_checkpoint(checkpoint_dir: str, config: LlamaConfig) -> dict:
     path = Path(checkpoint_dir)
     state = _load_state_dict(path)

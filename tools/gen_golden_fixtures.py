@@ -1,0 +1,102 @@
+"""Generate golden model-math fixtures from HuggingFace's Llama reference.
+
+Run ONCE (the outputs are checked in under ``tests/fixtures/llama_tiny_golden``):
+
+    python tools/gen_golden_fixtures.py
+
+Produces, for the tiny config (matching ``LlamaConfig.tiny``):
+- ``pytorch_model.bin`` — HF-format state dict (the checkpoint loader's
+  input format), deterministic random init;
+- ``golden.npz`` — prompt token ids, HF all-position logits (fp32, eager
+  attention), and HF greedy continuations.
+
+The test suite loads the weights through
+``langstream_tpu.models.checkpoints.load_llama_checkpoint`` and asserts the
+JAX forward/prefill/decode reproduce these outputs — pinning RoPE layout,
+GQA grouping, normalization placement, and the HF tensor-name mapping to an
+independent implementation (a wrong-RoPE mutation fails this, where the
+repo's internal equivalence tests would pass symmetrically).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import torch
+
+import sys
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT = REPO / "tests" / "fixtures" / "llama_tiny_golden"
+
+from langstream_tpu.models.llama import LlamaConfig as _JaxConfig  # noqa: E402
+
+_TINY = _JaxConfig.tiny(max_seq_len=128)  # the config the tests pin against
+VOCAB = _TINY.vocab_size
+HIDDEN = _TINY.hidden
+LAYERS = _TINY.layers
+HEADS = _TINY.heads
+KV_HEADS = _TINY.kv_heads
+INTERMEDIATE = _TINY.intermediate
+ROPE_THETA = _TINY.rope_theta
+NORM_EPS = _TINY.norm_eps
+MAX_SEQ = _TINY.max_seq_len
+assert _TINY.head_dim == HIDDEN // HEADS, "HF derives head_dim = hidden/heads"
+
+
+def main() -> None:
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        vocab_size=VOCAB,
+        hidden_size=HIDDEN,
+        num_hidden_layers=LAYERS,
+        num_attention_heads=HEADS,
+        num_key_value_heads=KV_HEADS,
+        intermediate_size=INTERMEDIATE,
+        rope_theta=ROPE_THETA,
+        rms_norm_eps=NORM_EPS,
+        max_position_embeddings=MAX_SEQ,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        mlp_bias=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(1234)
+    model = LlamaForCausalLM(config)
+    model.eval()
+
+    rng = np.random.default_rng(42)
+    # two prompts of different lengths (right-padding handled caller-side)
+    prompts = [
+        rng.integers(0, VOCAB, size=17).tolist(),
+        rng.integers(0, VOCAB, size=9).tolist(),
+    ]
+
+    OUT.mkdir(parents=True, exist_ok=True)
+    torch.save(model.state_dict(), OUT / "pytorch_model.bin")
+
+    arrays: dict[str, np.ndarray] = {}
+    with torch.no_grad():
+        for p, tokens in enumerate(prompts):
+            ids = torch.tensor([tokens], dtype=torch.long)
+            logits = model(ids).logits[0].float().numpy()  # (S, V)
+            arrays[f"prompt_{p}"] = np.asarray(tokens, dtype=np.int32)
+            arrays[f"logits_{p}"] = logits
+            generated = model.generate(
+                ids, max_new_tokens=8, do_sample=False,
+                pad_token_id=0,
+                # explicit mask: without it HF infers (ids != pad_token_id),
+                # silently masking any real token id 0 in the prompt
+                attention_mask=torch.ones_like(ids),
+            )[0, len(tokens):].numpy().astype(np.int32)
+            arrays[f"greedy_{p}"] = generated
+    np.savez(OUT / "golden.npz", **arrays)
+    print(f"wrote {OUT}/pytorch_model.bin and golden.npz "
+          f"({len(prompts)} prompts)")
+
+
+if __name__ == "__main__":
+    main()
