@@ -250,6 +250,7 @@ def prefill_forward(
     tokens: jax.Array,       # (B, P) int32, right-padded
     lengths: jax.Array,      # (B,) true lengths
     use_flash: bool | None = None,
+    mesh: Mesh | None = None,  # flash under a mesh runs via shard_map
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared prompt forward (the single source of the prefill layer math):
     returns (last-token logits (B,V), ks, vs) where ks/vs are the roped
@@ -285,7 +286,8 @@ def prefill_forward(
             from langstream_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(
-                q, k, v, causal=True, interpret=(flash == "interpret")
+                q, k, v, causal=True, interpret=(flash == "interpret"),
+                mesh=mesh,
             )
             out = out.reshape(B, Pn, c.heads * c.head_dim)
         else:
@@ -321,10 +323,8 @@ def llama_prefill(
     cache_k: jax.Array,      # (L, slots, S, K, D)
     cache_v: jax.Array,
     slot_ids: jax.Array,     # (B,) which cache slots to fill
-    use_flash: bool | None = None,  # None = auto (LS_TPU_FLASH); False when
-                                    # params are mesh-sharded: pallas_call has
-                                    # no SPMD partitioning rule, so under
-                                    # pjit-TP it would replicate, not shard
+    use_flash: bool | None = None,  # None = auto (LS_TPU_FLASH)
+    mesh: Mesh | None = None,  # kernel runs per-shard via shard_map
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process prompts, fill the KV cache, return last-token logits (B, V).
 
@@ -333,7 +333,9 @@ def llama_prefill(
     new row is written before it is ever attended to.
     """
     Pn = tokens.shape[1]
-    logits, ks, vs = prefill_forward(config, params, tokens, lengths, use_flash)
+    logits, ks, vs = prefill_forward(
+        config, params, tokens, lengths, use_flash, mesh=mesh
+    )
     new_k = cache_k.at[:, slot_ids, :Pn].set(ks)
     new_v = cache_v.at[:, slot_ids, :Pn].set(vs)
     return logits, new_k, new_v

@@ -45,6 +45,7 @@ def llama_prefill_paged(
     pool_v: jax.Array,
     block_tables: jax.Array,  # (B, max_blocks) int32 — rows for THIS batch
     use_flash: bool | None = None,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prompt forward + paged cache fill: the shared
     :func:`~langstream_tpu.models.llama.prefill_forward` layer math with the
@@ -53,7 +54,7 @@ def llama_prefill_paged(
 
     c = config
     B, Pn = tokens.shape
-    logits, ks, vs = prefill_forward(c, params, tokens, lengths, use_flash)
+    logits, ks, vs = prefill_forward(c, params, tokens, lengths, use_flash, mesh=mesh)
     KhD = c.kv_heads * c.head_dim
     L = ks.shape[0]
     valid = (jnp.arange(Pn)[None, :] < lengths[:, None])
@@ -115,6 +116,7 @@ def llama_decode_chunk_paged(
     num_steps: int,
     num_read_blocks: int,     # static block-sweep bucket (covers max length)
     kernel: str = "xla",      # "xla" | "pallas" | "pallas-interpret"
+    mesh=None,                # Pallas kernel runs per-shard via shard_map
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps against the paged pool; same two-segment
     discipline as the dense ``llama_decode_chunk`` (pool read-only, new K/V
@@ -126,17 +128,48 @@ def llama_decode_chunk_paged(
     kbuf0 = jnp.zeros((c.layers, B, num_steps, c.kv_heads, c.head_dim), c.dtype)
     vbuf0 = jnp.zeros_like(kbuf0)
 
+    def _kernel_partial(q, ck_l, cv_l, tables, lengths, kv_heads):
+        return paged_attention_partial(
+            q, ck_l, cv_l, tables, lengths,
+            num_read_blocks=num_read_blocks,
+            kv_heads=kv_heads, head_dim=c.head_dim,
+            scale=1.0 / math.sqrt(c.head_dim),
+            interpret=(kernel == "pallas-interpret"),
+        )
+
     def cache_partial(q, ck_l, cv_l):
         if kernel == "xla":
             return _cache_partial_xla(
                 c, q, ck_l, cv_l, block_tables, base_lengths, num_read_blocks
             )
-        return paged_attention_partial(
-            q, ck_l, cv_l, block_tables, base_lengths,
-            num_read_blocks=num_read_blocks,
-            kv_heads=c.kv_heads, head_dim=c.head_dim,
-            scale=1.0 / math.sqrt(c.head_dim),
-            interpret=(kernel == "pallas-interpret"),
+        if mesh is not None and len(mesh.devices.flatten()) > 1:
+            # pallas_call has no SPMD rule: shard_map it — slots on dp, heads
+            # on tp (the pool's flattened Kh*D axis splits on head boundaries
+            # because Kh % tp == 0), each device sweeping its own shard
+            from functools import partial as _partial
+
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            axes = mesh.axis_names
+            dp = "dp" if "dp" in axes and mesh.shape["dp"] > 1 else None
+            tp = "tp" if "tp" in axes and mesh.shape["tp"] > 1 else None
+            tp_size = mesh.shape["tp"] if tp else 1
+            return shard_map(
+                _partial(_kernel_partial, kv_heads=c.kv_heads // tp_size),
+                mesh=mesh,
+                in_specs=(
+                    P(dp, tp, None),    # q (B, H, D)
+                    P(None, None, tp),  # k_pool (nb, bs, Kh*D)
+                    P(None, None, tp),  # v_pool
+                    P(dp, None),        # block tables (B, max_blocks)
+                    P(dp),              # lengths (B,)
+                ),
+                out_specs=(P(dp, tp, None), P(dp, tp), P(dp, tp)),
+                check_rep=False,
+            )(q, ck_l, cv_l, block_tables, base_lengths)
+        return _kernel_partial(
+            q, ck_l, cv_l, block_tables, base_lengths, c.kv_heads
         )
 
     def step(carry, step_idx):

@@ -183,15 +183,55 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    mesh=None,  # jax.sharding.Mesh: run the kernel per-shard via shard_map
 ) -> jax.Array:
     """Flash attention over ``(batch, seq, heads, head_dim)`` tensors.
 
     GQA: ``H`` may be a multiple of ``Kh``. Sequences are padded up to the
     block size internally (causal masking keeps padded keys invisible to
     real queries in the self-attention case ``Sq == Sk``).
+
+    Under a ``mesh``, ``pallas_call`` has no SPMD partitioning rule, so the
+    call is wrapped in ``shard_map`` with heads on the ``tp`` axis — each
+    device runs the kernel on its own head shard (attention is
+    embarrassingly parallel over heads; GQA group structure is preserved
+    because Q heads and KV heads shard by the same factor).
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if mesh is not None:
+        from functools import partial as _partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axes = mesh.axis_names
+        H_, Kh_, B_ = q.shape[2], k.shape[2], q.shape[0]
+        tp = (
+            "tp"
+            if "tp" in axes and mesh.shape["tp"] > 1
+            and H_ % mesh.shape["tp"] == 0 and Kh_ % mesh.shape["tp"] == 0
+            else None
+        )
+        dp = (
+            "dp"
+            if "dp" in axes and mesh.shape["dp"] > 1
+            and B_ % mesh.shape["dp"] == 0
+            else None
+        )
+        if tp is not None or dp is not None:
+            spec = P(dp, None, tp, None)
+            inner = _partial(
+                flash_attention,
+                causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+                interpret=interpret, mesh=None,
+            )
+            return shard_map(
+                inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_rep=False,
+            )(q, k, v)
+        # no shardable axis (tiny batch on a dp-only mesh): the plain call
+        # below is replicated per device by pjit — correct, just not sharded
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     if causal and Sq != Sk:

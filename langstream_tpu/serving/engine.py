@@ -321,13 +321,9 @@ class TpuServingEngine:
             cache_k, cache_v = init_paged_kv_cache(mc, self.paged_layout)
             kernel = self.config.paged_kernel
             if kernel == "auto":
-                # pallas_call has no SPMD partition rule → XLA gather path
-                # under a mesh; the kernel is the single-chip TPU fast path
-                kernel = (
-                    "pallas"
-                    if self.mesh is None and jax.default_backend() == "tpu"
-                    else "xla"
-                )
+                # the Pallas kernel is the TPU fast path; under a mesh it
+                # runs per-shard via shard_map (slots on dp, heads on tp)
+                kernel = "pallas" if jax.default_backend() == "tpu" else "xla"
             self.paged_read_kernel = kernel
         elif self.config.kv_layout != "dense":
             raise ValueError(f"unknown kv_layout {self.config.kv_layout!r}")
@@ -382,10 +378,10 @@ class TpuServingEngine:
                 return arrays
 
         paged = self.block_mgr is not None
-        # flash kernel only on the unsharded path: pallas_call has no SPMD
-        # partition rule, so under a mesh XLA would replicate it per chip
-        # instead of sharding heads
-        prefill_flash = False if self.mesh is not None else None
+        # None = auto (LS_TPU_FLASH env); under a mesh the kernel runs
+        # per-shard through shard_map (heads on tp), so TP serving keeps it
+        prefill_flash = None
+        mesh_static = self.mesh
 
         def _make_decode(use_top_p: bool, window: int | None):
             """``window``: dense → cache-row bucket (None = full cache);
@@ -409,6 +405,7 @@ class TpuServingEngine:
                         cache_k, cache_v, tables, sample_fn, key, K,
                         num_read_blocks=window,
                         kernel=self.paged_read_kernel,
+                        mesh=mesh_static,
                     )
                     return _fetchable(out[0], out[1]) + out[2:]
 
@@ -451,7 +448,7 @@ class TpuServingEngine:
 
                     logits, ck, cv = llama_prefill_paged(
                         mc_static, params, tokens, lengths, cache_k, cache_v,
-                        tables, use_flash=prefill_flash,
+                        tables, use_flash=prefill_flash, mesh=mesh_static,
                     )
                     next_tokens, logprobs = _fetchable(
                         *sample_tokens(
@@ -468,7 +465,7 @@ class TpuServingEngine:
                          key, temps, topks, topps):
                 logits, ck, cv = llama_prefill(
                     mc_static, params, tokens, lengths, cache_k, cache_v, slot_ids,
-                    use_flash=prefill_flash,
+                    use_flash=prefill_flash, mesh=mesh_static,
                 )
                 next_tokens, logprobs = _fetchable(
                     *sample_tokens(

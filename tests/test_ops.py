@@ -98,3 +98,62 @@ def test_llama_prefill_flash_matches_einsum(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(gv)[:, slot, :n], np.asarray(wv)[:, slot, :n], atol=1e-5
         )
+
+
+def test_flash_sharded_matches_unsharded():
+    """flash_attention under a dp×tp mesh (shard_map per-shard kernels,
+    interpret mode) ≡ the single-device kernel — the path TP serving uses
+    now that the mesh no longer disables flash prefill."""
+    from langstream_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    q, k, v = _qkv(B=2, S=64, H=8, Kh=4, D=32)
+    want = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                           interpret=True)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                          interpret=True, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_sharded_under_jit_with_sharded_params(monkeypatch):
+    """The kernel wrapped in shard_map composes with jit over a mesh: a
+    prefill through llama_prefill with TP-sharded weights and flash on must
+    match the einsum path."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from langstream_tpu.models.llama import (
+        LlamaConfig, init_kv_cache, init_llama_params, llama_param_specs,
+        llama_prefill,
+    )
+    from langstream_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    c = dataclasses.replace(LlamaConfig.tiny(max_seq_len=64), dtype=jnp.float32)
+    params = init_llama_params(c)
+    sharded = jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, llama_param_specs(c), is_leaf=lambda x: isinstance(x, P),
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, c.vocab_size, (2, 32)), jnp.int32
+    )
+    lengths = jnp.asarray([32, 17], jnp.int32)
+    ck, cv = init_kv_cache(c, slots=2)
+
+    ref, _, _ = jax.jit(
+        lambda p, t, ln, k, v: llama_prefill(
+            c, p, t, ln, k, v, jnp.asarray([0, 1]), use_flash=False
+        )
+    )(params, tokens, lengths, ck, cv)
+    monkeypatch.setenv("LS_TPU_FLASH", "interpret")
+    got, _, _ = jax.jit(
+        lambda p, t, ln, k, v: llama_prefill(
+            c, p, t, ln, k, v, jnp.asarray([0, 1]), use_flash=None,
+            mesh=mesh,
+        )
+    )(sharded, tokens, lengths, ck, cv)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )

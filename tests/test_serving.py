@@ -648,3 +648,29 @@ def test_decode_roofline_model():
     assert r8.cache_bytes_per_step == 16 * 64 * 256 * 8 * 128 * 2 * 2
     assert r8.min_step_ms() > 0
     assert 0 < r8.utilization(achieved_step_ms=10 * r8.min_step_ms()) <= 0.11
+
+
+def test_mesh_engine_serves_with_kernels_on(run_async, monkeypatch):
+    """TP engine with BOTH Pallas kernels enabled (flash prefill via
+    shard_map + paged decode read via shard_map, interpret mode on CPU):
+    the r2 special cases that disabled kernels under a mesh are gone."""
+    monkeypatch.setenv("LS_TPU_FLASH", "interpret")
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        config = ServingConfig(
+            model="tiny", slots=4, max_seq_len=64, decode_chunk=2,
+            default_max_tokens=6, kv_layout="paged", kv_block_size=8,
+            paged_kernel="pallas-interpret",
+            mesh=(("dp", 2), ("tp", 2)),
+        )
+        engine = TpuServingEngine.get_or_create(config)
+        results = await asyncio.gather(
+            *(engine.generate(f"kernels on {i}", {"max-tokens": 6})
+              for i in range(3))
+        )
+        await engine.close()
+        for r in results:
+            assert 0 < len(r["tokens"]) <= 6
+
+    run_async(main())
