@@ -14,7 +14,7 @@ from langstream_tpu.core.planner import register_agent_type
 from langstream_tpu.agents import transform, text, flow, ai, vector, http, storage
 from langstream_tpu.agents import jdbc, opensearch  # noqa: F401  (asset managers)
 from langstream_tpu.agents import astra, milvus, solr  # noqa: F401  (asset managers)
-from langstream_tpu.agents import python_custom, webcrawler
+from langstream_tpu.agents import connect, python_custom, webcrawler
 
 SOURCE = ComponentType.SOURCE
 PROCESSOR = ComponentType.PROCESSOR
@@ -59,6 +59,9 @@ _FACTORIES = {
     "local-storage-source": storage.LocalStorageSource,
     "s3-source": storage.make_s3_source,
     "azure-blob-storage-source": storage.make_azure_source,
+    # Kafka-Connect-style bridge (reference: KafkaConnectCodeProvider.java:26)
+    "sink": connect.ConnectSinkBridge,
+    "source": connect.ConnectSourceBridge,
     # custom python (in-process; no gRPC hop needed — see python_custom.py)
     "python-processor": python_custom.PythonProcessorAgent,
     "python-function": python_custom.PythonProcessorAgent,
@@ -119,6 +122,8 @@ _METADATA = {
     "experimental-python-service": (SERVICE, False),
     "grpc-python-source": (SOURCE, True),
     "grpc-python-sink": (SINK, True),
+    "source": (SOURCE, True),
+    "sink": (SINK, True),
 }
 
 AgentCodeRegistry.register_provider(

@@ -7,6 +7,9 @@ Importing this package registers the built-in streaming runtimes with
   embedded Kafka plays in the reference's ``langstream docker run`` tester).
 - ``kafka`` — only when a Kafka client library is importable (none is baked
   into this image; the implementation is gated, not stubbed).
+- ``pulsar`` — likewise gated on the ``pulsar`` client library
+  (``runtime/pulsar_broker.py``; semantics unit-tested against a fake
+  client, same strategy as kafka).
 """
 
 from langstream_tpu.api.topics import TopicConnectionsRuntimeRegistry
@@ -25,6 +28,15 @@ try:  # pragma: no cover - kafka client not in the image
     from langstream_tpu.runtime.kafka_broker import KafkaTopicConnectionsRuntime
 
     TopicConnectionsRuntimeRegistry.register("kafka", KafkaTopicConnectionsRuntime)
+except ImportError:
+    pass
+
+try:  # pragma: no cover - pulsar client not in the image
+    import pulsar  # noqa: F401
+
+    from langstream_tpu.runtime.pulsar_broker import PulsarTopicConnectionsRuntime
+
+    TopicConnectionsRuntimeRegistry.register("pulsar", PulsarTopicConnectionsRuntime)
 except ImportError:
     pass
 
