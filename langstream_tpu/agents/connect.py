@@ -22,8 +22,10 @@ way Connect persists them to its offsets topic):
 
     class MySourceConnector:
         def start(self, props): ...
-        def poll(self):             # → [{value, key?, topic?, sourcePartition?,
+        def poll(self):             # → [{value, key?, sourcePartition?,
             ...                     #     sourceOffset?, headers?}]
+            # (records go to the pipeline's configured output topic; the
+            # topic SPI's source lane has no per-record topic routing)
         def commit(self, offsets): ...
         def stop(self): ...
 
@@ -178,14 +180,18 @@ class ConnectSinkBridge(AgentSink):
 
     async def _flush(self) -> None:
         # one flusher at a time; records appended while a put is in flight
-        # ride the next put (that's where multi-record batches come from)
+        # ride the next put (that's where multi-record batches come from).
+        # Records leave the pending batch only AFTER the connector accepted
+        # them: a failed put leaves them queued, so a concurrent writer's
+        # flush retries them instead of silently dropping them (duplicates
+        # on retry are the at-least-once contract, loss is not)
         async with self._flush_lock:
             while self._batch:
                 batch = self._batch[: self.batch_size]
-                del self._batch[: len(batch)]
                 await _maybe_async(self.connector.put(batch))
                 if hasattr(self.connector, "flush"):
                     await _maybe_async(self.connector.flush())
+                del self._batch[: len(batch)]
 
 
 class ConnectSourceBridge(AgentSource):

@@ -2,11 +2,14 @@
 
 Parity: ``langstream-agent-webcrawler``
 (``agents/webcrawler/WebCrawlerSource.java:61,110``): seeded BFS crawl with
-allowed-domains, max-depth/max-urls, robots.txt respect, and a
+allowed-domains, max-depth/max-urls, robots.txt respect, **sitemap
+ingestion** (``Sitemap:`` lines in robots.txt enqueue the sitemap; crawled
+sitemap XML — urlset or sitemapindex — enqueues its ``<loc>`` entries
+instead of being emitted, ``WebCrawler.java:149,361``), and a
 **checkpointed frontier** persisted to the agent's state directory
 (``:164-199``, ``LocalDiskStatusStorage:430``) so a restarted replica resumes
 where it left off. HTML parsing/link extraction uses the stdlib parser
-(the reference uses Jsoup).
+(the reference uses Jsoup; sitemap parsing replaces its crawler-commons).
 """
 
 from __future__ import annotations
@@ -107,6 +110,7 @@ class WebCrawlerSource(AgentSource):
         if not self.handle_robots or netloc in self._robots_disallow:
             return
         rules: list[str] = []
+        sitemaps: list[str] = []
         try:
             async with self._session.get(
                 f"{scheme}://{netloc}/robots.txt", timeout=5
@@ -123,9 +127,43 @@ class WebCrawlerSource(AgentSource):
                             path = line.split(":", 1)[1].strip()
                             if path:
                                 rules.append(path)
+                        elif line.lower().startswith("sitemap:"):
+                            # sitemap directives are user-agent independent
+                            sitemaps.append(line.split(":", 1)[1].strip())
         except Exception:
             pass
         self._robots_disallow[netloc] = rules
+        # the first sight of a host's robots.txt enqueues its sitemaps
+        # (WebCrawler.java:361) — depth 0: sitemap entries are roots
+        for sitemap in sitemaps:
+            if sitemap not in self._visited:
+                self._frontier.append((sitemap, 0))
+
+    @staticmethod
+    def _is_sitemap(url: str, content_type: str, body: str) -> bool:
+        path = urllib.parse.urlparse(url).path.lower()
+        if path.endswith(".xml") and "sitemap" in path:
+            return True
+        head = body[:512].lstrip()
+        return ("xml" in content_type or path.endswith(".xml")) and (
+            "<urlset" in head or "<sitemapindex" in head
+        )
+
+    def _ingest_sitemap(self, url: str, body: str, depth: int) -> None:
+        """urlset → enqueue page URLs; sitemapindex → enqueue child
+        sitemaps. Namespace-agnostic (<loc> under any xmlns)."""
+        import xml.etree.ElementTree as ET
+
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            return
+        for loc in root.iter():
+            if not loc.tag.endswith("loc") or not (loc.text or "").strip():
+                continue
+            target = urllib.parse.urljoin(url, loc.text.strip())
+            if target not in self._visited and self._allowed(target):
+                self._frontier.append((target, depth))
 
     async def read(self) -> list[Record]:
         if not self._frontier or len(self._visited) >= self.max_urls:
@@ -145,6 +183,12 @@ class WebCrawlerSource(AgentSource):
                 body = await resp.text(errors="replace")
         except Exception:
             self._save_state()
+            return []
+        if self._is_sitemap(url, content_type, body):
+            # sitemaps feed the frontier; they are not documents
+            self._ingest_sitemap(url, body, depth)
+            self._save_state()
+            await asyncio.sleep(self.min_time_between_requests)
             return []
         if depth < self.max_depth and "html" in content_type:
             extractor = _LinkExtractor()

@@ -1,0 +1,76 @@
+"""Control-plane service entrypoint (the deploy manifests run this).
+
+    python -m langstream_tpu.controlplane
+
+Env:
+- ``LS_MODE``: ``k8s`` (CRs + operator, the in-cluster default) or
+  ``local`` (in-process agents — the dev/docker-compose mode).
+- ``LS_PORT`` (default 8090), ``LS_RUNTIME_IMAGE``,
+- ``LS_CODE_STORAGE``: JSON code-storage config (type/configuration),
+- ``LS_STORE_PATH``: filesystem store dir for local mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+
+
+async def main() -> None:
+    from langstream_tpu.controlplane.server import (
+        ControlPlaneServer,
+        LocalComputeRuntime,
+    )
+
+    mode = os.environ.get("LS_MODE", "k8s")
+    port = int(os.environ.get("LS_PORT", "8090"))
+    code_storage = (
+        json.loads(os.environ["LS_CODE_STORAGE"])
+        if os.environ.get("LS_CODE_STORAGE")
+        else None
+    )
+    if mode == "k8s":
+        from langstream_tpu.k8s.client import HttpKubeApi
+        from langstream_tpu.k8s.compute import KubernetesComputeRuntime
+        from langstream_tpu.k8s.stores import KubernetesApplicationStore
+
+        api = HttpKubeApi.in_cluster()
+        image = os.environ.get("LS_RUNTIME_IMAGE", "langstream-tpu/runtime:latest")
+        store = KubernetesApplicationStore(api, runtime_image=image)
+        compute = KubernetesComputeRuntime(
+            api, image=image, code_storage_config=code_storage
+        )
+    else:
+        from langstream_tpu.controlplane.stores import (
+            FileSystemApplicationStore,
+            InMemoryApplicationStore,
+        )
+
+        path = os.environ.get("LS_STORE_PATH")
+        store = (
+            FileSystemApplicationStore(path) if path else InMemoryApplicationStore()
+        )
+        compute = LocalComputeRuntime()
+
+    server = ControlPlaneServer(
+        store=store, compute=compute, port=port,
+        host=os.environ.get("LS_BIND", "0.0.0.0"),
+    )
+    await server.start()
+    logging.getLogger(__name__).info(
+        "control plane up on :%d (mode=%s)", port, mode
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.stop()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(main())

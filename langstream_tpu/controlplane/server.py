@@ -119,6 +119,16 @@ class LocalComputeRuntime:
         if self.gateway_registry is not None:
             # gateways resolve against the *resolved* application
             self.gateway_registry.register(stored.tenant, stored.name, application)
+            # dev-mode agent-proxy targets: a service agent that declares
+            # ``service-port`` is reachable on localhost here (in-cluster the
+            # registry falls back to the agent's headless-service name)
+            for agent in application.all_agents():
+                port = (agent.configuration or {}).get("service-port")
+                if port:
+                    self.gateway_registry.register_service_uri(
+                        stored.tenant, stored.name, agent.id,
+                        f"http://127.0.0.1:{int(port)}",
+                    )
 
     async def undeploy(self, tenant: str, name: str) -> None:
         key = (tenant, name)
@@ -184,11 +194,14 @@ class ControlPlaneServer:
         port: int = 8090,
         archetypes_path: str | None = None,
         admin_auth: dict[str, Any] | None = None,
+        host: str = "127.0.0.1",
     ):
         self.store = store or InMemoryApplicationStore()
         self.compute = compute or LocalComputeRuntime()
         self.port = port
+        self.host = host
         self.archetypes_path = archetypes_path
+        self.admin_auth = admin_auth
         middlewares = []
         if admin_auth:
             # admin JWT on every /api route (parity: TokenAuthFilter)
@@ -241,7 +254,7 @@ class ControlPlaneServer:
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
         log.info("control plane listening on :%d", self.port)
 
@@ -428,6 +441,21 @@ class ControlPlaneServer:
         )
         if stored is None:
             raise web.HTTPNotFound()
+        if request.query.get("files") == "true":
+            # full view for in-cluster peers (the api-gateway's registry
+            # sync needs files + instance to parse the app the way the
+            # compute runtime did). Secrets ride along ONLY when admin auth
+            # is enabled — then the auth middleware has already vetted this
+            # request; on an unauthenticated control plane the full view
+            # must not become a secrets-disclosure endpoint.
+            full = {
+                **stored.public_view(),
+                "files": stored.files,
+                "instance": stored.instance,
+            }
+            if self.admin_auth:
+                full["secrets"] = stored.secrets
+            return web.json_response(full)
         return web.json_response(stored.public_view())
 
     async def _list_apps(self, request: web.Request) -> web.Response:

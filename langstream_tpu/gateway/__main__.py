@@ -1,0 +1,112 @@
+"""API-gateway service entrypoint (the deploy manifests run this).
+
+    python -m langstream_tpu.gateway
+
+Env: ``LS_PORT`` (default 8091), ``LS_CONTROL_PLANE_URL`` — the gateway
+keeps its application registry in sync by polling the control plane's
+application list (the reference's gateway reads the same store the
+webservice writes; over HTTP here so the two services stay independently
+deployable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+
+log = logging.getLogger(__name__)
+
+
+async def _sync_registry(registry, control_plane_url: str) -> None:
+    """Poll the control plane and keep the gateway registry consistent:
+    deployed apps (re)register, deleted apps unregister. When the control
+    plane runs with admin auth, ``LS_CONTROL_PLANE_TOKEN`` carries the
+    bearer token; that same auth is what entitles the sync to the full view
+    including secrets (placeholder resolution for gateway auth configs)."""
+    import aiohttp
+
+    from langstream_tpu.controlplane.server import parse_stored
+    from langstream_tpu.controlplane.stores import StoredApplication
+
+    headers = {}
+    token = os.environ.get("LS_CONTROL_PLANE_TOKEN")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    known: dict[tuple[str, str], str] = {}
+    async with aiohttp.ClientSession(headers=headers) as session:
+        while True:
+            try:
+                async with session.get(
+                    f"{control_plane_url}/api/tenants"
+                ) as resp:
+                    tenants = await resp.json()
+                current: set[tuple[str, str]] = set()
+                for tenant in tenants:
+                    async with session.get(
+                        f"{control_plane_url}/api/applications/{tenant}"
+                    ) as resp:
+                        apps = await resp.json()
+                    for app_name in apps:
+                        current.add((tenant, app_name))
+                        async with session.get(
+                            f"{control_plane_url}/api/applications/{tenant}/"
+                            f"{app_name}?files=true"
+                        ) as resp:
+                            body = await resp.json()
+                        files = body.get("files") or {}
+                        fingerprint = str(sorted(files.items()))
+                        if known.get((tenant, app_name)) == fingerprint:
+                            continue
+                        stored = StoredApplication(
+                            tenant=tenant,
+                            name=app_name,
+                            files=files,
+                            instance=body.get("instance"),
+                            secrets=body.get("secrets"),
+                        )
+                        registry.register(
+                            tenant, app_name, parse_stored(stored)
+                        )
+                        known[(tenant, app_name)] = fingerprint
+                # deleted apps must stop resolving (their gateways would
+                # otherwise keep serving stale topic access forever)
+                for tenant, app_name in set(known) - current:
+                    registry.unregister(tenant, app_name)
+                    del known[(tenant, app_name)]
+            except Exception as e:
+                log.warning("registry sync failed: %s", e)
+            await asyncio.sleep(5)
+
+
+async def main() -> None:
+    from langstream_tpu.gateway.server import GatewayRegistry, GatewayServer
+
+    port = int(os.environ.get("LS_PORT", "8091"))
+    registry = GatewayRegistry()
+    server = GatewayServer(
+        registry=registry, port=port,
+        host=os.environ.get("LS_BIND", "0.0.0.0"),
+    )
+    await server.start()
+    log.info("api gateway up on :%d", port)
+    sync_task = None
+    control_plane = os.environ.get("LS_CONTROL_PLANE_URL")
+    if control_plane:
+        sync_task = asyncio.ensure_future(
+            _sync_registry(registry, control_plane.rstrip("/"))
+        )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    if sync_task is not None:
+        sync_task.cancel()
+    await server.stop()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(main())

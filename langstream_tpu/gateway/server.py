@@ -46,14 +46,39 @@ class GatewayRegistry:
     cluster config). Backed by the application store in the control plane,
     or by directly-registered local apps in dev mode."""
 
+    #: the port service agents listen on in-cluster (parity: the executor
+    #: service URI the reference's KubernetesApplicationStore builds)
+    AGENT_SERVICE_PORT = 8790
+
     def __init__(self) -> None:
         self._apps: dict[tuple[str, str], Application] = {}
+        self._service_uris: dict[tuple[str, str, str], str] = {}
 
     def register(self, tenant: str, app_id: str, application: Application) -> None:
         self._apps[(tenant, app_id)] = application
 
     def unregister(self, tenant: str, app_id: str) -> None:
         self._apps.pop((tenant, app_id), None)
+        for key in [k for k in self._service_uris if k[:2] == (tenant, app_id)]:
+            del self._service_uris[key]
+
+    def register_service_uri(
+        self, tenant: str, app_id: str, agent_id: str, uri: str
+    ) -> None:
+        """Dev-mode/in-process agents register where they listen; in-cluster
+        the naming-convention fallback below needs no registration."""
+        self._service_uris[(tenant, app_id, agent_id)] = uri.rstrip("/")
+
+    def service_uri(self, tenant: str, app_id: str, agent_id: str) -> str:
+        explicit = self._service_uris.get((tenant, app_id, agent_id))
+        if explicit:
+            return explicit
+        # k8s: the agent's headless service lives in the TENANT namespace
+        # (cluster_runtime.tenant_namespace), not the gateway's own — the
+        # qualified name is what resolves from the gateway pod
+        name = f"{app_id}-{agent_id}".lower().replace("_", "-")
+        namespace = f"langstream-{tenant}".lower()
+        return f"http://{name}.{namespace}.svc:{self.AGENT_SERVICE_PORT}"
 
     def resolve(
         self, tenant: str, app_id: str, gateway_id: str
@@ -72,9 +97,11 @@ class GatewayRegistry:
 
 
 class GatewayServer:
-    def __init__(self, registry: GatewayRegistry | None = None, port: int = 8091):
+    def __init__(self, registry: GatewayRegistry | None = None, port: int = 8091,
+                 host: str = "127.0.0.1"):
         self.registry = registry or GatewayRegistry()
         self.port = port
+        self.host = host
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -102,11 +129,14 @@ class GatewayServer:
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
         log.info("gateway listening on :%d", self.port)
 
     async def stop(self) -> None:
+        proxy_client = getattr(self, "_proxy_client", None)
+        if proxy_client is not None and not proxy_client.closed:
+            await proxy_client.close()
         if self._runner is not None:
             await self._runner.cleanup()
 
@@ -425,6 +455,64 @@ class GatewayServer:
             log.exception("chat push loop failed")
 
     # ------------------------------------------------------------------
+    # service gateway: agent proxy
+    # ------------------------------------------------------------------
+
+    _HOP_HEADERS = {
+        "connection", "keep-alive", "proxy-authenticate",
+        "proxy-authorization", "te", "trailers", "transfer-encoding",
+        "upgrade", "host", "content-length",
+    }
+
+    async def _proxy_session(self):
+        """One shared upstream session (connection pooling on the proxy hot
+        path); closed in :meth:`stop`."""
+        import aiohttp
+
+        if getattr(self, "_proxy_client", None) is None or self._proxy_client.closed:
+            self._proxy_client = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60)
+            )
+        return self._proxy_client
+
+    async def _proxy_to_agent(
+        self, request: web.Request, tenant: str, app_id: str, agent_id: str
+    ) -> web.Response:
+        import aiohttp
+
+        base = self.registry.service_uri(tenant, app_id, agent_id)
+        tail = request.match_info.get("tail", "")
+        url = f"{base}/{tail}" if tail else base
+        if request.query_string:
+            url += f"?{request.query_string}"
+        headers = {
+            k: v
+            for k, v in request.headers.items()
+            if k.lower() not in self._HOP_HEADERS
+        }
+        body = await request.read() if request.can_read_body else None
+        try:
+            session = await self._proxy_session()
+            async with session.request(
+                request.method, url, data=body, headers=headers,
+                allow_redirects=False,
+            ) as upstream:
+                payload = await upstream.read()
+                out_headers = {
+                    k: v
+                    for k, v in upstream.headers.items()
+                    if k.lower() not in self._HOP_HEADERS
+                }
+                return web.Response(
+                    status=upstream.status, body=payload,
+                    headers=out_headers,
+                )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            raise web.HTTPBadGateway(
+                reason=f"agent {agent_id!r} service unreachable: {e}"
+            )
+
+    # ------------------------------------------------------------------
     # service gateway: request/response over topics
     # ------------------------------------------------------------------
 
@@ -439,11 +527,19 @@ class GatewayServer:
         except AuthenticationException as e:
             raise web.HTTPUnauthorized(reason=str(e))
         service = gateway.service_options
+        agent_id = service.get("agent-id")
+        if agent_id:
+            # agent-proxy mode (parity: GatewayResource.java:235-241):
+            # forward the request to the agent's service URI verbatim
+            return await self._proxy_to_agent(
+                request, tenant, app_id, agent_id
+            )
         input_topic = service.get("input-topic")
         output_topic = service.get("output-topic")
         if not input_topic or not output_topic:
             raise web.HTTPBadRequest(
-                reason="service gateway needs input-topic/output-topic"
+                reason="service gateway needs input-topic/output-topic "
+                "(topic mode) or agent-id (proxy mode)"
             )
         import uuid
 

@@ -1,0 +1,112 @@
+"""Kubernetes compute runtime for the control plane.
+
+The in-cluster twin of
+:class:`langstream_tpu.controlplane.server.LocalComputeRuntime` (same
+duck-typed interface the ControlPlaneServer drives: ``deploy`` /
+``undeploy`` / ``agent_info`` / ``logs`` / ``close``): instead of running
+agents in-process, it plans the application and writes Agent custom
+resources + config Secrets for the operator to reconcile into
+StatefulSets — the role the reference's webservice plays against
+``langstream-k8s-deployer`` (``ApplicationLifecycleService`` →
+``AppResourcesFactory``).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Any
+
+from langstream_tpu.controlplane.stores import StoredApplication
+from langstream_tpu.core.codestorage import make_code_storage, zip_directory
+from langstream_tpu.core.deployer import ApplicationDeployer
+from langstream_tpu.k8s.client import KubeApi
+from langstream_tpu.k8s.cluster_runtime import KubernetesClusterRuntime
+
+log = logging.getLogger(__name__)
+
+
+class KubernetesComputeRuntime:
+    """Plans apps and manages their Agent CRs in the cluster."""
+
+    def __init__(
+        self,
+        api: KubeApi,
+        image: str = "langstream-tpu/runtime:latest",
+        code_storage_config: dict[str, Any] | None = None,
+    ):
+        self.api = api
+        self.code_storage_config = code_storage_config
+        self.code_storage = (
+            make_code_storage(code_storage_config) if code_storage_config else None
+        )
+        self.runtime = KubernetesClusterRuntime(
+            api, image=image, code_storage=code_storage_config
+        )
+        self.deployer = ApplicationDeployer()
+        self.logs: dict[tuple[str, str], deque[str]] = {}
+        self._plans: dict[tuple[str, str], Any] = {}
+
+    def append_log(self, tenant: str, name: str, line: str) -> None:
+        self.logs.setdefault((tenant, name), deque(maxlen=1000)).append(line)
+
+    async def deploy(self, stored: StoredApplication, application=None) -> None:
+        from langstream_tpu.controlplane.server import parse_stored
+
+        if application is None:
+            application = parse_stored(stored)
+        key = (stored.tenant, stored.name)
+        plan = self.deployer.create_implementation(stored.name, application)
+        await self.deployer.setup(plan)
+
+        code_archive_id = None
+        if self.code_storage is not None:
+            # ship the application package so agent pods' init containers
+            # can download custom-agent code
+            import io
+            import zipfile
+
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+                for filename, content in stored.files.items():
+                    zf.writestr(f"app/{filename}", content)
+            code_archive_id = self.code_storage.store(
+                stored.tenant, stored.name, buf.getvalue()
+            )
+        crs = self.runtime.deploy(stored.tenant, plan, code_archive_id)
+        self._plans[key] = plan
+        self.append_log(
+            *key, f"wrote {len(crs)} agent CRs (operator reconciles them)"
+        )
+
+    async def undeploy(self, tenant: str, name: str) -> None:
+        from langstream_tpu.k8s.cluster_runtime import tenant_namespace
+
+        key = (tenant, name)
+        plan = self._plans.pop(key, None)
+        if plan is not None:
+            self.runtime.delete(tenant, plan)
+        else:
+            # control plane restarted since deploy: delete by listing the
+            # application's live CRs instead of re-planning
+            namespace = tenant_namespace(tenant)
+            for existing in self.runtime.current_agents(tenant, name):
+                cr_name = existing["metadata"]["name"]
+                self.api.delete("Agent", namespace, cr_name)
+                self.api.delete("Secret", namespace, f"{cr_name}-config")
+        self.logs.pop(key, None)
+
+    def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
+        """Agent CR specs + operator-written statuses."""
+        return [
+            {
+                "agent-id": cr["spec"].get("agentId"),
+                "type": "k8s-agent",
+                "status": cr.get("status", {}),
+                "resources": cr["spec"].get("resources", {}),
+            }
+            for cr in self.runtime.current_agents(tenant, name)
+        ]
+
+    async def close(self) -> None:
+        pass

@@ -297,3 +297,32 @@ class Operator:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+def main() -> None:
+    """Operator service entrypoint (the deploy manifests run
+    ``python -m langstream_tpu.k8s.operator``). Env: ``LS_ACCELERATOR``
+    (v5e|v5p|v4), ``LS_RECONCILE_INTERVAL`` seconds."""
+    import os
+    import signal
+
+    from langstream_tpu.k8s.client import HttpKubeApi
+
+    logging.basicConfig(level=logging.INFO)
+    operator = Operator(
+        HttpKubeApi.in_cluster(),
+        interval=float(os.environ.get("LS_RECONCILE_INTERVAL", "2.0")),
+        accelerator=os.environ.get("LS_ACCELERATOR", "v5e"),
+    )
+
+    async def _run() -> None:
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, operator.stop)
+        await operator.run()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

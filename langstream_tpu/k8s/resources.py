@@ -29,6 +29,7 @@ from typing import Any
 from langstream_tpu.k8s.crds import AgentCustomResource
 
 AGENT_PORT = 8080  # /metrics + /info (parity: AgentRunner.java:96-110)
+AGENT_SERVICE_PORT = 8790  # custom service agents (gateway agent-proxy target)
 COORDINATOR_PORT = 8476  # jax.distributed coordinator
 LOCKSTEP_PORT = 7077  # leader->follower step-descriptor channel (serving/lockstep.py)
 
@@ -127,6 +128,9 @@ class AgentResourcesFactory:
                     {"name": "http", "port": AGENT_PORT},
                     {"name": "coordinator", "port": COORDINATOR_PORT},
                     {"name": "lockstep", "port": LOCKSTEP_PORT},
+                    # custom service agents listen here; the api-gateway's
+                    # agent-proxy mode forwards to this port by service name
+                    {"name": "agent-service", "port": AGENT_SERVICE_PORT},
                 ],
             },
         }

@@ -437,3 +437,111 @@ def test_cli_dev_mode_smoke(tmp_path, run_async):
         assert set(files) == {"pipeline.yaml", "gateways.yaml"}
 
     run_async(main())
+
+
+def test_service_gateway_agent_proxy_mode(run_async):
+    """service gateway with agent-id proxies requests to the agent's
+    service URI (parity: GatewayResource.java:235-241) — method, tail path,
+    query, body, and response status/headers forwarded."""
+    from aiohttp import web as aioweb
+
+    gateways_proxy = """
+gateways:
+  - id: "svc"
+    type: service
+    service-options:
+      agent-id: "my-service"
+"""
+
+    async def main():
+        # a fake agent service
+        seen = []
+
+        async def agent_handle(request):
+            seen.append(
+                (request.method, request.path_qs, await request.text())
+            )
+            return aioweb.json_response(
+                {"from": "agent"}, status=201, headers={"X-Agent": "yes"}
+            )
+
+        agent_app = aioweb.Application()
+        agent_app.router.add_route("*", "/{tail:.*}", agent_handle)
+        agent_runner = aioweb.AppRunner(agent_app)
+        await agent_runner.setup()
+        agent_port = free_port()
+        await aioweb.TCPSite(agent_runner, "127.0.0.1", agent_port).start()
+        try:
+            async with Servers() as s:
+                async with s.session.put(s.api("/api/tenants/t1")):
+                    pass
+                payload = {
+                    "files": {
+                        "pipeline.yaml": PIPELINE,
+                        "gateways.yaml": gateways_proxy,
+                    },
+                    "instance": INSTANCE,
+                }
+                async with s.session.post(
+                    s.api("/api/applications/t1/app1"), json=payload
+                ) as r:
+                    assert r.status == 200, await r.text()
+                s.registry.register_service_uri(
+                    "t1", "app1", "my-service", f"http://127.0.0.1:{agent_port}"
+                )
+                url = (
+                    f"http://127.0.0.1:{s.gw_port}"
+                    "/api/gateways/service/t1/app1/svc/v1/predict?x=1"
+                )
+                async with s.session.post(url, json={"q": "hi"}) as resp:
+                    assert resp.status == 201
+                    assert resp.headers["X-Agent"] == "yes"
+                    assert await resp.json() == {"from": "agent"}
+                method, path_qs, body = seen[0]
+                assert method == "POST"
+                assert path_qs == "/v1/predict?x=1"
+                assert "hi" in body
+                # GET without a body forwards too (topic mode is POST-only)
+                async with s.session.get(url) as resp:
+                    assert resp.status == 201
+                # unreachable agent → 502, not a hang
+                s.registry.register_service_uri(
+                    "t1", "app1", "my-service", "http://127.0.0.1:1"
+                )
+                async with s.session.get(url) as resp:
+                    assert resp.status == 502
+        finally:
+            await agent_runner.cleanup()
+
+    run_async(main())
+
+
+def test_k8s_compute_runtime_writes_agent_crs(run_async):
+    """The in-cluster compute runtime: deploy plans the app and writes
+    Agent CRs + config Secrets; undeploy removes them (the role the
+    reference's webservice plays against langstream-k8s-deployer)."""
+    from langstream_tpu.controlplane.stores import StoredApplication
+    from langstream_tpu.k8s.client import InMemoryKubeApi
+    from langstream_tpu.k8s.compute import KubernetesComputeRuntime
+
+    async def main():
+        api = InMemoryKubeApi()
+        compute = KubernetesComputeRuntime(api, image="img:1")
+        stored = StoredApplication(
+            tenant="t1",
+            name="app1",
+            files={"pipeline.yaml": PIPELINE},
+            instance=INSTANCE,
+        )
+        await compute.deploy(stored)
+        agents = api.list("Agent", "langstream-t1")
+        assert len(agents) == 1
+        assert agents[0]["spec"]["applicationId"] == "app1"
+        secrets = api.list("Secret", "langstream-t1")
+        assert any("-config" in s["metadata"]["name"] for s in secrets)
+        info = compute.agent_info("t1", "app1")
+        assert info and info[0]["agent-id"]
+        await compute.undeploy("t1", "app1")
+        assert api.list("Agent", "langstream-t1") == []
+
+    run_async(main())

@@ -1,0 +1,162 @@
+"""Render the installable k8s manifests into ``deploy/k8s/``.
+
+    python tools/render_deploy.py
+
+The rendered YAML is CHECKED IN (parity: the reference ships ``helm/`` with
+CRDs and values examples) so `kubectl apply -f deploy/k8s/` installs the
+control plane, api-gateway, and operator without running any Python — the
+generator exists so the manifests never drift from the Python factories
+(CRDs come straight from ``langstream_tpu.k8s.crds.crd_manifests``).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT = REPO / "deploy" / "k8s"
+
+NAMESPACE = "langstream-tpu"
+IMAGE = "langstream-tpu/runtime:latest"
+
+
+def deployment(name: str, command: list[str], env: list[dict], sa: str) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": NAMESPACE},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "serviceAccountName": sa,
+                    "containers": [
+                        {
+                            "name": name,
+                            "image": IMAGE,
+                            "command": command,
+                            "env": env,
+                            "ports": [{"containerPort": 8090 if "control" in name else 8091}],
+                            "resources": {
+                                "requests": {"cpu": "200m", "memory": "512Mi"}
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def service(name: str, port: int) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": NAMESPACE},
+        "spec": {
+            "selector": {"app": name},
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def rbac() -> list[dict]:
+    rules_control_plane = [
+        {"apiGroups": ["langstream.tpu"], "resources": ["applications", "agents"],
+         "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["secrets", "configmaps", "namespaces"],
+         "verbs": ["*"]},
+    ]
+    rules_operator = rules_control_plane + [
+        {"apiGroups": ["apps"], "resources": ["statefulsets"], "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["services", "persistentvolumeclaims",
+                                          "pods"], "verbs": ["*"]},
+        {"apiGroups": ["batch"], "resources": ["jobs"], "verbs": ["*"]},
+    ]
+    out = []
+    for name, rules in (
+        ("langstream-control-plane", rules_control_plane),
+        ("langstream-operator", rules_operator),
+    ):
+        out += [
+            {"apiVersion": "v1", "kind": "ServiceAccount",
+             "metadata": {"name": name, "namespace": NAMESPACE}},
+            {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+             "metadata": {"name": name}, "rules": rules},
+            {"apiVersion": "rbac.authorization.k8s.io/v1",
+             "kind": "ClusterRoleBinding",
+             "metadata": {"name": name},
+             "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                         "kind": "ClusterRole", "name": name},
+             "subjects": [{"kind": "ServiceAccount", "name": name,
+                           "namespace": NAMESPACE}]},
+        ]
+    return out
+
+
+def main() -> None:
+    from langstream_tpu.k8s.crds import crd_manifests
+
+    OUT.mkdir(parents=True, exist_ok=True)
+
+    def write(name: str, docs: list[dict]) -> None:
+        (OUT / name).write_text(yaml.safe_dump_all(docs, sort_keys=False))
+        print(f"wrote deploy/k8s/{name} ({len(docs)} documents)")
+
+    write("00-namespace.yaml", [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": NAMESPACE}},
+    ])
+    write("01-crds.yaml", crd_manifests())
+    write("02-rbac.yaml", rbac())
+    write("03-control-plane.yaml", [
+        deployment(
+            "langstream-control-plane",
+            ["python", "-m", "langstream_tpu.controlplane"],
+            [
+                {"name": "LS_MODE", "value": "k8s"},
+                {"name": "LS_PORT", "value": "8090"},
+                {"name": "LS_RUNTIME_IMAGE", "value": IMAGE},
+                # point at an in-cluster S3 (e.g. minio) or Azure blob store;
+                # see values-example.yaml
+                {"name": "LS_CODE_STORAGE", "valueFrom": {"configMapKeyRef": {
+                    "name": "langstream-config", "key": "code-storage",
+                    "optional": True}}},
+            ],
+            "langstream-control-plane",
+        ),
+        service("langstream-control-plane", 8090),
+    ])
+    write("04-api-gateway.yaml", [
+        deployment(
+            "langstream-api-gateway",
+            ["python", "-m", "langstream_tpu.gateway"],
+            [
+                {"name": "LS_PORT", "value": "8091"},
+                {"name": "LS_CONTROL_PLANE_URL",
+                 "value": "http://langstream-control-plane:8090"},
+            ],
+            "langstream-control-plane",
+        ),
+        service("langstream-api-gateway", 8091),
+    ])
+    write("05-operator.yaml", [
+        deployment(
+            "langstream-operator",
+            ["python", "-m", "langstream_tpu.k8s.operator"],
+            [
+                {"name": "LS_ACCELERATOR", "value": "v5e"},
+            ],
+            "langstream-operator",
+        ),
+    ])
+
+
+if __name__ == "__main__":
+    main()
