@@ -1,0 +1,39 @@
+"""Connect-style connectors: the shapes the bridge agents drive
+(start/poll/commit for sources, start/put/flush for sinks)."""
+
+import json
+import os
+
+
+class JsonlFileSource:
+    def start(self, props):
+        self.path = props["file"]
+        offsets = props.get("__offsets__") or {}
+        self.position = int(
+            offsets.get(json.dumps({"file": self.path}), {}).get("line", 0)
+        )
+
+    def poll(self):
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            lines = f.readlines()
+        if self.position >= len(lines):
+            return []
+        line = lines[self.position]
+        self.position += 1
+        return [{
+            "value": json.loads(line),
+            "sourcePartition": {"file": self.path},
+            "sourceOffset": {"line": self.position},
+        }]
+
+
+class JsonlFileSink:
+    def start(self, props):
+        self.path = props["file"]
+
+    def put(self, records):
+        with open(self.path, "a") as f:
+            for record in records:
+                f.write(json.dumps(record["value"]["payload"]) + "\n")

@@ -1,0 +1,24 @@
+"""A service agent running its own aiohttp server; the gateway's
+agent-proxy mode forwards /api/gateways/service/... requests here."""
+
+from aiohttp import web
+
+
+class EchoService:
+    def init(self, config):
+        self.port = int(config.get("service-port", 9876))
+
+    async def main(self):
+        app = web.Application()
+
+        async def echo(request):
+            body = await request.json() if request.can_read_body else {}
+            return web.json_response({"service": "echo", "got": body})
+
+        app.router.add_route("*", "/{tail:.*}", echo)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", self.port)
+        await site.start()
+        import asyncio
+        await asyncio.Event().wait()

@@ -151,19 +151,24 @@ class WebCrawlerSource(AgentSource):
 
     def _ingest_sitemap(self, url: str, body: str, depth: int) -> None:
         """urlset → enqueue page URLs; sitemapindex → enqueue child
-        sitemaps. Namespace-agnostic (<loc> under any xmlns)."""
+        sitemaps. Only the DIRECT ``<loc>`` of each ``<url>``/``<sitemap>``
+        entry counts — extension locs (``<image:loc>``, ``<video:loc>``)
+        nest one level deeper and must not enqueue media as pages."""
         import xml.etree.ElementTree as ET
 
         try:
             root = ET.fromstring(body)
         except ET.ParseError:
             return
-        for loc in root.iter():
-            if not loc.tag.endswith("loc") or not (loc.text or "").strip():
-                continue
-            target = urllib.parse.urljoin(url, loc.text.strip())
-            if target not in self._visited and self._allowed(target):
-                self._frontier.append((target, depth))
+        for entry in root:  # <url> or <sitemap> elements
+            for loc in entry:
+                if not loc.tag.endswith("}loc") and loc.tag != "loc":
+                    continue
+                if not (loc.text or "").strip():
+                    continue
+                target = urllib.parse.urljoin(url, loc.text.strip())
+                if target not in self._visited and self._allowed(target):
+                    self._frontier.append((target, depth))
 
     async def read(self) -> list[Record]:
         if not self._frontier or len(self._visited) >= self.max_urls:

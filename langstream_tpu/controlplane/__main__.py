@@ -7,7 +7,10 @@ Env:
   ``local`` (in-process agents — the dev/docker-compose mode).
 - ``LS_PORT`` (default 8090), ``LS_RUNTIME_IMAGE``,
 - ``LS_CODE_STORAGE``: JSON code-storage config (type/configuration),
-- ``LS_STORE_PATH``: filesystem store dir for local mode.
+- ``LS_STORE_PATH``: filesystem store dir for local mode,
+- ``LS_ADMIN_AUTH``: JSON admin-JWT validator config — enables bearer-token
+  auth on every /api route (and thereby the full application view with
+  secrets that the api-gateway's registry sync uses).
 """
 
 from __future__ import annotations
@@ -55,9 +58,15 @@ async def main() -> None:
         )
         compute = LocalComputeRuntime()
 
+    admin_auth = (
+        json.loads(os.environ["LS_ADMIN_AUTH"])
+        if os.environ.get("LS_ADMIN_AUTH")
+        else None
+    )
     server = ControlPlaneServer(
         store=store, compute=compute, port=port,
         host=os.environ.get("LS_BIND", "0.0.0.0"),
+        admin_auth=admin_auth,
     )
     await server.start()
     logging.getLogger(__name__).info(

@@ -30,11 +30,56 @@ async def _sync_registry(registry, control_plane_url: str) -> None:
     from langstream_tpu.controlplane.server import parse_stored
     from langstream_tpu.controlplane.stores import StoredApplication
 
+    from langstream_tpu.core.placeholders import resolve_placeholders
+
     headers = {}
     token = os.environ.get("LS_CONTROL_PLANE_TOKEN")
     if token:
         headers["Authorization"] = f"Bearer {token}"
     known: dict[tuple[str, str], str] = {}
+
+    async def sync_one(session, tenant: str, app_name: str) -> None:
+        async with session.get(
+            f"{control_plane_url}/api/applications/{tenant}/"
+            f"{app_name}?files=true"
+        ) as resp:
+            body = await resp.json()
+        files = body.get("files") or {}
+        # fingerprint the whole deployable state: instance/secrets-only
+        # updates (broker moves, credential rotation) must propagate too
+        fingerprint = str(
+            (sorted(files.items()), body.get("instance"), body.get("secrets"))
+        )
+        if known.get((tenant, app_name)) == fingerprint:
+            return
+        stored = StoredApplication(
+            tenant=tenant,
+            name=app_name,
+            files=files,
+            instance=body.get("instance"),
+            secrets=body.get("secrets"),
+        )
+        application = parse_stored(stored)
+        # the gateway serves the RESOLVED app (auth configs and streaming
+        # clusters reference ${secrets.*}/${globals.*}) — exactly what the
+        # compute runtime resolves before deploying. Fail CLOSED on
+        # unresolvable placeholders: serving a gateway whose auth secret is
+        # the literal '${secrets...}' string would let anyone who reads the
+        # config pass authentication.
+        try:
+            resolve_placeholders(application)
+        except Exception as e:
+            log.error(
+                "app %s/%s not served: %s. The control plane withholds "
+                "secrets unless admin auth is enabled — set LS_ADMIN_AUTH "
+                "on the control plane and LS_CONTROL_PLANE_TOKEN here.",
+                tenant, app_name, e,
+            )
+            known[(tenant, app_name)] = fingerprint  # don't retry-spam
+            return
+        registry.register(tenant, app_name, application)
+        known[(tenant, app_name)] = fingerprint
+
     async with aiohttp.ClientSession(headers=headers) as session:
         while True:
             try:
@@ -50,26 +95,14 @@ async def _sync_registry(registry, control_plane_url: str) -> None:
                         apps = await resp.json()
                     for app_name in apps:
                         current.add((tenant, app_name))
-                        async with session.get(
-                            f"{control_plane_url}/api/applications/{tenant}/"
-                            f"{app_name}?files=true"
-                        ) as resp:
-                            body = await resp.json()
-                        files = body.get("files") or {}
-                        fingerprint = str(sorted(files.items()))
-                        if known.get((tenant, app_name)) == fingerprint:
-                            continue
-                        stored = StoredApplication(
-                            tenant=tenant,
-                            name=app_name,
-                            files=files,
-                            instance=body.get("instance"),
-                            secrets=body.get("secrets"),
-                        )
-                        registry.register(
-                            tenant, app_name, parse_stored(stored)
-                        )
-                        known[(tenant, app_name)] = fingerprint
+                        try:
+                            # one broken app must not block the rest of the
+                            # sync (or the unregistration pass below)
+                            await sync_one(session, tenant, app_name)
+                        except Exception as e:
+                            log.warning(
+                                "sync of %s/%s failed: %s", tenant, app_name, e
+                            )
                 # deleted apps must stop resolving (their gateways would
                 # otherwise keep serving stale topic access forever)
                 for tenant, app_name in set(known) - current:

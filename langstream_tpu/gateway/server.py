@@ -75,10 +75,22 @@ class GatewayRegistry:
             return explicit
         # k8s: the agent's headless service lives in the TENANT namespace
         # (cluster_runtime.tenant_namespace), not the gateway's own — the
-        # qualified name is what resolves from the gateway pod
+        # qualified name is what resolves from the gateway pod. The port is
+        # the agent's own declared service-port (a headless service resolves
+        # to pod IPs, so the declared Service ports don't constrain it);
+        # AGENT_SERVICE_PORT is only the convention-default.
+        port = self.AGENT_SERVICE_PORT
+        app = self._apps.get((tenant, app_id))
+        if app is not None:
+            for agent in app.all_agents():
+                if agent.id == agent_id:
+                    port = int(
+                        (agent.configuration or {}).get("service-port", port)
+                    )
+                    break
         name = f"{app_id}-{agent_id}".lower().replace("_", "-")
         namespace = f"langstream-{tenant}".lower()
-        return f"http://{name}.{namespace}.svc:{self.AGENT_SERVICE_PORT}"
+        return f"http://{name}.{namespace}.svc:{port}"
 
     def resolve(
         self, tenant: str, app_id: str, gateway_id: str
@@ -462,6 +474,10 @@ class GatewayServer:
         "connection", "keep-alive", "proxy-authenticate",
         "proxy-authorization", "te", "trailers", "transfer-encoding",
         "upgrade", "host", "content-length",
+        # aiohttp auto-decompresses upstream bodies, so forwarding the
+        # upstream Content-Encoding would declare an encoding the payload
+        # no longer has
+        "content-encoding",
     }
 
     async def _proxy_session(self):

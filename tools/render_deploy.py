@@ -128,12 +128,22 @@ def main() -> None:
                 {"name": "LS_CODE_STORAGE", "valueFrom": {"configMapKeyRef": {
                     "name": "langstream-config", "key": "code-storage",
                     "optional": True}}},
+                {"name": "LS_ADMIN_AUTH", "valueFrom": {"configMapKeyRef": {
+                    "name": "langstream-config", "key": "admin-auth",
+                    "optional": True}}},
             ],
             "langstream-control-plane",
         ),
         service("langstream-control-plane", 8090),
     ])
     write("04-api-gateway.yaml", [
+        # the gateway needs NO kubernetes API access (it polls the control
+        # plane over HTTP) and is the internet-facing component — its own
+        # rule-less ServiceAccount keeps a compromise worthless
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": "langstream-api-gateway",
+                      "namespace": NAMESPACE},
+         "automountServiceAccountToken": False},
         deployment(
             "langstream-api-gateway",
             ["python", "-m", "langstream_tpu.gateway"],
@@ -141,8 +151,11 @@ def main() -> None:
                 {"name": "LS_PORT", "value": "8091"},
                 {"name": "LS_CONTROL_PLANE_URL",
                  "value": "http://langstream-control-plane:8090"},
+                {"name": "LS_CONTROL_PLANE_TOKEN", "valueFrom": {
+                    "secretKeyRef": {"name": "langstream-admin-token",
+                                     "key": "token", "optional": True}}},
             ],
-            "langstream-control-plane",
+            "langstream-api-gateway",
         ),
         service("langstream-api-gateway", 8091),
     ])
