@@ -1,0 +1,3 @@
+from langstream_tpu.admin.client import AdminClient, AdminApiError
+
+__all__ = ["AdminClient", "AdminApiError"]

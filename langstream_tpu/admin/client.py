@@ -1,0 +1,159 @@
+"""Admin client: a reusable facade over the control-plane REST API.
+
+Parity: ``langstream-admin-client`` (``AdminClient.java`` + per-resource
+``...Cmd`` classes) — the reference ships a standalone library with retry
+policies that both its CLI and tests drive; previously the HTTP calls were
+inlined in the CLI here. Retries: idempotent requests (GET/PUT/DELETE and
+explicitly-marked others) back off exponentially on connection errors and
+5xx; non-idempotent POSTs retry only on connection errors raised before the
+request was sent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+_IDEMPOTENT = {"GET", "PUT", "DELETE", "HEAD"}
+
+
+class AdminApiError(RuntimeError):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"{status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class AdminClient:
+    """One instance per control plane; safe to share across tasks."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: str | None = None,
+        *,
+        retries: int = 3,
+        backoff_s: float = 0.5,
+        timeout_s: float = 60.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+        self._session = None
+
+    async def _client(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            headers = {}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            self._session = aiohttp.ClientSession(
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s),
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def request(
+        self, method: str, path: str, *, retry_safe: bool | None = None, **kwargs
+    ) -> Any:
+        import aiohttp
+
+        method = method.upper()
+        idempotent = retry_safe if retry_safe is not None else method in _IDEMPOTENT
+        url = f"{self.base_url}{path}"
+        last: Exception | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                session = await self._client()
+                async with session.request(method, url, **kwargs) as resp:
+                    text = await resp.text()
+                    if resp.status >= 500 and idempotent and attempt < self.retries:
+                        last = AdminApiError(resp.status, text[:500])
+                        raise last
+                    if resp.status >= 300:
+                        raise AdminApiError(resp.status, text)
+                    try:
+                        return json.loads(text)
+                    except json.JSONDecodeError:
+                        return text
+            except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
+                # connection-level failures are safe to retry for any verb:
+                # the request either never reached the server or is being
+                # re-issued against an idempotent endpoint
+                if not idempotent and not isinstance(
+                    e, aiohttp.ClientConnectorError
+                ):
+                    raise
+                last = e
+            except AdminApiError as e:
+                if not (e.status >= 500 and idempotent):
+                    raise
+                last = e
+            if attempt < self.retries:
+                delay = self.backoff_s * (2**attempt)
+                log.debug("retrying %s %s in %.1fs (%s)", method, path, delay, last)
+                await asyncio.sleep(delay)
+        raise last  # retries exhausted
+
+    # ---- tenants ----------------------------------------------------------
+
+    async def list_tenants(self) -> list[str]:
+        return await self.request("GET", "/api/tenants")
+
+    async def put_tenant(self, tenant: str, config: dict | None = None) -> Any:
+        return await self.request("PUT", f"/api/tenants/{tenant}", json=config)
+
+    async def delete_tenant(self, tenant: str) -> Any:
+        return await self.request("DELETE", f"/api/tenants/{tenant}")
+
+    # ---- applications ------------------------------------------------------
+
+    async def list_applications(self, tenant: str) -> list[str]:
+        return await self.request("GET", f"/api/applications/{tenant}")
+
+    async def get_application(
+        self, tenant: str, name: str, *, files: bool = False
+    ) -> dict:
+        suffix = "?files=true" if files else ""
+        return await self.request(
+            "GET", f"/api/applications/{tenant}/{name}{suffix}"
+        )
+
+    async def deploy_application(
+        self, tenant: str, name: str, payload: dict
+    ) -> dict:
+        return await self.request(
+            "POST", f"/api/applications/{tenant}/{name}", json=payload
+        )
+
+    async def update_application(
+        self, tenant: str, name: str, payload: dict
+    ) -> dict:
+        return await self.request(
+            "PATCH", f"/api/applications/{tenant}/{name}", json=payload,
+            retry_safe=True,  # update re-validates against the stored app
+        )
+
+    async def delete_application(self, tenant: str, name: str) -> Any:
+        return await self.request("DELETE", f"/api/applications/{tenant}/{name}")
+
+    async def application_logs(self, tenant: str, name: str) -> Any:
+        return await self.request(
+            "GET", f"/api/applications/{tenant}/{name}/logs"
+        )
+
+    async def application_agents(self, tenant: str, name: str) -> Any:
+        return await self.request(
+            "GET", f"/api/applications/{tenant}/{name}/agents"
+        )

@@ -60,17 +60,40 @@ def _ws_connect(session, url: str):
 
 
 async def _request(method: str, url: str, **kwargs):
+    """All CLI HTTP goes through the AdminClient facade (retry policies,
+    auth header) — parity: the reference CLI delegating to admin-client.
+    The bearer token comes from the profile (``token``) or
+    ``LS_ADMIN_TOKEN``; ``apps update``'s PATCH is revalidated server-side,
+    so it rides the retry-safe lane the facade marks for it."""
+    import asyncio as _asyncio
+    import os as _os
+    from urllib.parse import urlsplit
+
     import aiohttp
 
-    async with aiohttp.ClientSession() as session:
-        async with session.request(method, url, **kwargs) as resp:
-            text = await resp.text()
-            if resp.status >= 300:
-                raise click.ClickException(f"{resp.status}: {text}")
-            try:
-                return json.loads(text)
-            except json.JSONDecodeError:
-                return text
+    from langstream_tpu.admin import AdminApiError, AdminClient
+
+    parts = urlsplit(url)
+    base = f"{parts.scheme}://{parts.netloc}"
+    path = parts.path + (f"?{parts.query}" if parts.query else "")
+    token = (
+        kwargs.pop("token", None)
+        or _profile().get("token")
+        or _os.environ.get("LS_ADMIN_TOKEN")
+    )
+    client = AdminClient(base, token=token)
+    try:
+        return await client.request(
+            method, path,
+            retry_safe=True if method.upper() == "PATCH" else None,
+            **kwargs,
+        )
+    except AdminApiError as e:
+        raise click.ClickException(str(e))
+    except (OSError, aiohttp.ClientError, _asyncio.TimeoutError) as e:
+        raise click.ClickException(f"control plane unreachable: {e}")
+    finally:
+        await client.close()
 
 
 @click.group()

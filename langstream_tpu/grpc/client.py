@@ -70,8 +70,14 @@ class SidecarProcess:
         if env.get("PYTHONPATH"):
             python_paths.append(env["PYTHONPATH"])
         env["PYTHONPATH"] = os.pathsep.join(python_paths)
+        # NAR-equivalent dependency isolation: an app that pins
+        # requirements.txt gets its own venv, and its sidecars run on that
+        # interpreter (runtime/isolation.py)
+        from langstream_tpu.runtime.isolation import ensure_app_interpreter
+
+        interpreter = ensure_app_interpreter(app_dir)
         self.process = subprocess.Popen(
-            [sys.executable, "-m", "langstream_tpu.grpc.server", path],
+            [interpreter, "-m", "langstream_tpu.grpc.server", path],
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL if os.environ.get(
                 "LS_SIDECAR_QUIET") else None,

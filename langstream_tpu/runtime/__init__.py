@@ -40,6 +40,17 @@ try:  # pragma: no cover - pulsar client not in the image
 except ImportError:
     pass
 
+try:  # pragma: no cover - pravega binding not in the image
+    import pravega_client  # noqa: F401
+
+    from langstream_tpu.runtime.pravega_broker import PravegaTopicConnectionsRuntime
+
+    TopicConnectionsRuntimeRegistry.register(
+        "pravega", PravegaTopicConnectionsRuntime
+    )
+except ImportError:
+    pass
+
 from langstream_tpu.runtime.runner import AgentRunner  # noqa: E402
 from langstream_tpu.runtime.local_runner import LocalApplicationRunner  # noqa: E402
 

@@ -1,0 +1,327 @@
+"""Pravega streaming runtime (gated on the ``pravega_client`` binding).
+
+Parity: ``langstream-pravega-runtime`` —
+``PravegaTopicConnectionsRuntimeProvider.java`` (writers with routing keys,
+per-consumer reader groups, position-addressed readers, scope/stream admin)
+— registered for streamingCluster ``type: pravega`` when the client binding
+is importable, the same gating as kafka/pulsar.
+
+Cluster configuration (reference keys, ``PravegaClientUtils.java:37-57``)::
+
+    streamingCluster:
+      type: pravega
+      configuration:
+        client:
+          controller-uri: "tcp://localhost:9090"
+          scope: "langstream"
+
+Event encoding: Pravega events are opaque byte payloads with no headers, so
+one JSON envelope carries the whole record (``value``/``key``/``headers``
+with kind tags; raw bytes base64) — the same role the reference's
+ObjectMapper serialization plays. Delivery semantics: the binding hands out
+segment *slices*; a reader that dies before releasing a slice gets its
+events redelivered to the group — at-least-once at slice granularity, which
+the contiguity tracker upstream already tolerates (duplicates allowed,
+loss not).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import uuid
+from typing import Any
+
+from langstream_tpu.api.record import Record, SimpleRecord, now_millis
+from langstream_tpu.api.topics import (
+    OFFSET_HEADER,
+    TopicAdmin,
+    TopicConnectionsRuntime,
+    TopicConsumer,
+    TopicOffset,
+    TopicProducer,
+    TopicReader,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _pravega():
+    import pravega_client
+
+    return pravega_client
+
+
+def _cluster_config(configuration: dict[str, Any]) -> dict[str, Any]:
+    cfg = configuration.get("configuration", configuration) or {}
+    client = cfg.get("client", cfg)
+    return {
+        "controller_uri": client.get("controller-uri", "tcp://localhost:9090"),
+        "scope": client.get("scope", "langstream"),
+    }
+
+
+def record_to_event(record: Record) -> tuple[bytes, str | None]:
+    """→ (event payload bytes, routing key)."""
+
+    def enc(value: Any) -> Any:
+        if isinstance(value, bytes):
+            return {"__b64__": base64.b64encode(value).decode("ascii")}
+        return value
+
+    envelope = {
+        "value": enc(record.value),
+        "key": enc(record.key),
+        "headers": {
+            k: enc(v) for k, v in record.headers if k != OFFSET_HEADER
+        },
+        "timestamp": record.timestamp,
+    }
+    routing_key = None
+    if record.key is not None:
+        routing_key = (
+            record.key if isinstance(record.key, str) else json.dumps(record.key)
+        )
+    return json.dumps(envelope).encode("utf-8"), routing_key
+
+
+def event_to_record(data: bytes, stream: str, position: Any) -> Record:
+    def dec(value: Any) -> Any:
+        if isinstance(value, dict) and set(value) == {"__b64__"}:
+            return base64.b64decode(value["__b64__"])
+        return value
+
+    envelope = json.loads(data)
+    headers = tuple(
+        (k, dec(v)) for k, v in (envelope.get("headers") or {}).items()
+    ) + ((OFFSET_HEADER, TopicOffset(stream, 0, str(position))),)
+    return SimpleRecord(
+        value=dec(envelope.get("value")),
+        key=dec(envelope.get("key")),
+        headers=headers,
+        origin=stream,
+        timestamp=envelope.get("timestamp") or now_millis(),
+    )
+
+
+class PravegaTopicConsumer(TopicConsumer):
+    """One reader in a per-agent reader group (parity: the reference's
+    ``reader-{uuid}`` groups). Slice events buffer locally; ``commit``
+    releases fully-consumed slices back to the group."""
+
+    def __init__(self, manager_factory, scope: str, stream: str, group: str):
+        self._manager_factory = manager_factory
+        self.scope = scope
+        self.stream = stream
+        self.group = group
+        self._reader = None
+        self._slice = None
+        self._pending: dict[str, Any] = {}  # position → slice holding it
+        self._counter = 0
+        self._total_out = 0
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+
+        def _open():
+            manager = self._manager_factory()
+            rg = manager.create_reader_group(self.group, self.scope, self.stream)
+            return rg.create_reader(f"reader-{uuid.uuid4()}")
+
+        self._reader = await loop.run_in_executor(None, _open)
+
+    async def close(self) -> None:
+        if self._reader is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self._reader.reader_offline)
+            self._reader = None
+
+    async def read(self) -> list[Record]:
+        loop = asyncio.get_running_loop()
+        if self._slice is None:
+            self._slice = await loop.run_in_executor(
+                None, lambda: self._reader.get_segment_slice()
+            )
+            if self._slice is None:
+                return []
+        event = await loop.run_in_executor(
+            None, lambda: next(iter(self._slice), None)
+        )
+        if event is None:
+            # slice drained; release once everything it held is committed
+            if not any(s is self._slice for s in self._pending.values()):
+                await loop.run_in_executor(
+                    None, self._reader.release_segment, self._slice
+                )
+            self._slice = None
+            return []
+        self._counter += 1
+        position = f"{self.stream}:{self._counter}"
+        record = event_to_record(event.data(), self.stream, position)
+        self._pending[position] = self._slice
+        self._total_out += 1
+        return [record]
+
+    async def commit(self, records: list[Record]) -> None:
+        loop = asyncio.get_running_loop()
+        for record in records:
+            offset = record.header(OFFSET_HEADER)
+            if offset is None:
+                continue
+            done_slice = self._pending.pop(str(offset.offset), None)
+            # release a drained slice whose last pending event just committed
+            if (
+                done_slice is not None
+                and done_slice is not self._slice
+                and not any(s is done_slice for s in self._pending.values())
+            ):
+                await loop.run_in_executor(
+                    None, self._reader.release_segment, done_slice
+                )
+
+    def total_out(self) -> int:
+        return self._total_out
+
+
+class PravegaTopicProducer(TopicProducer):
+    def __init__(self, manager_factory, scope: str, stream: str):
+        self._manager_factory = manager_factory
+        self.scope = scope
+        self.stream = stream
+        self._writer = None
+        self._total_in = 0
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._writer = await loop.run_in_executor(
+            None,
+            lambda: self._manager_factory().create_writer(self.scope, self.stream),
+        )
+
+    async def close(self) -> None:
+        self._writer = None
+
+    async def write(self, record: Record) -> None:
+        payload, routing_key = record_to_event(record)
+        loop = asyncio.get_running_loop()
+
+        def _write():
+            if routing_key is not None:
+                self._writer.write_event_bytes(payload, routing_key=routing_key)
+            else:
+                self._writer.write_event_bytes(payload)
+
+        await loop.run_in_executor(None, _write)
+        self._total_in += 1
+
+    def total_in(self) -> int:
+        return self._total_in
+
+
+class PravegaTopicReader(TopicReader):
+    """Ephemeral reader group per reader (the reference does the same for
+    gateway consumers, ``PravegaTopicConnectionsRuntimeProvider.java:112``).
+    ``latest`` readers skip whatever is already in the stream."""
+
+    def __init__(self, manager_factory, scope: str, stream: str, position: str):
+        self._consumer = PravegaTopicConsumer(
+            manager_factory, scope, stream, f"reader-{uuid.uuid4()}"
+        )
+        self.position = position
+
+    async def start(self) -> None:
+        await self._consumer.start()
+        if self.position == "latest":
+            # drain the backlog so only new events surface. A single empty
+            # read only means a SLICE boundary (the consumer returns [] when
+            # a slice drains even with more backlog slices behind it) — two
+            # consecutive empties mean the stream itself is drained.
+            empty_streak = 0
+            while empty_streak < 2:
+                if await self._consumer.read():
+                    empty_streak = 0
+                else:
+                    empty_streak += 1
+
+    async def close(self) -> None:
+        await self._consumer.close()
+
+    async def read(self, timeout: float | None = None) -> list[Record]:
+        return await self._consumer.read()
+
+
+class PravegaTopicAdmin(TopicAdmin):
+    def __init__(self, manager_factory, scope: str):
+        self._manager_factory = manager_factory
+        self.scope = scope
+
+    async def create_topic(
+        self, name: str, partitions: int = 1, config: dict[str, Any] | None = None
+    ) -> None:
+        loop = asyncio.get_running_loop()
+
+        def _create():
+            manager = self._manager_factory()
+            manager.create_scope(self.scope)
+            manager.create_stream(self.scope, name, max(1, partitions))
+
+        await loop.run_in_executor(None, _create)
+
+    async def delete_topic(self, name: str) -> None:
+        loop = asyncio.get_running_loop()
+
+        def _delete():
+            manager = self._manager_factory()
+            manager.seal_stream(self.scope, name)
+            manager.delete_stream(self.scope, name)
+
+        await loop.run_in_executor(None, _delete)
+
+
+class PravegaTopicConnectionsRuntime(TopicConnectionsRuntime):
+    def __init__(self) -> None:
+        self._config: dict[str, Any] = {}
+        self._manager = None
+
+    def init(self, streaming_cluster_configuration: dict[str, Any]) -> None:
+        self._config = _cluster_config(streaming_cluster_configuration)
+
+    def _manager_factory(self):
+        if self._manager is None:
+            self._manager = _pravega().StreamManager(
+                self._config["controller_uri"]
+            )
+        return self._manager
+
+    def create_consumer(
+        self, agent_id: str, config: dict[str, Any]
+    ) -> TopicConsumer:
+        group = config.get("group") or f"langstream-{agent_id}"
+        return PravegaTopicConsumer(
+            self._manager_factory, self._config["scope"], config["topic"], group
+        )
+
+    def create_producer(
+        self, agent_id: str, config: dict[str, Any]
+    ) -> TopicProducer:
+        return PravegaTopicProducer(
+            self._manager_factory, self._config["scope"], config["topic"]
+        )
+
+    def create_reader(
+        self,
+        config: dict[str, Any],
+        initial_position: str = "latest",
+    ) -> TopicReader:
+        return PravegaTopicReader(
+            self._manager_factory, self._config["scope"], config["topic"],
+            initial_position,
+        )
+
+    def create_topic_admin(self) -> TopicAdmin:
+        return PravegaTopicAdmin(self._manager_factory, self._config["scope"])
+
+    async def close(self) -> None:
+        self._manager = None

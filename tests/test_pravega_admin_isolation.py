@@ -1,0 +1,266 @@
+"""Pravega runtime semantics (fake client binding), the admin-client
+facade's retry policies, and venv-per-app dependency isolation."""
+
+from __future__ import annotations
+
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+from langstream_tpu.api.record import make_record
+
+
+# ---------------------------------------------------------------------------
+# fake pravega_client binding
+# ---------------------------------------------------------------------------
+
+
+class _FakeEvent:
+    def __init__(self, payload: bytes):
+        self._payload = payload
+
+    def data(self) -> bytes:
+        return self._payload
+
+
+class _FakeSlice:
+    def __init__(self, events):
+        self._events = list(events)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._events:
+            raise StopIteration
+        return self._events.pop(0)
+
+
+def install_fake_pravega():
+    mod = types.ModuleType("pravega_client")
+    streams: dict[tuple[str, str], list[bytes]] = {}
+    groups: dict[str, dict] = {}
+    released: list = []
+
+    class _Reader:
+        def __init__(self, state, key):
+            self.state = state
+            self.key = key
+
+        def get_segment_slice(self):
+            backlog = streams.get(self.key, [])
+            if self.state["cursor"] >= len(backlog):
+                return _FakeSlice([])
+            events = [
+                _FakeEvent(p) for p in backlog[self.state["cursor"]:]
+            ]
+            self.state["cursor"] = len(backlog)
+            return _FakeSlice(events)
+
+        def release_segment(self, sl):
+            released.append(sl)
+
+        def reader_offline(self):
+            pass
+
+    class _ReaderGroup:
+        def __init__(self, name, scope, stream):
+            self.state = groups.setdefault(name, {"cursor": 0})
+            self.key = (scope, stream)
+
+        def create_reader(self, reader_id):
+            return _Reader(self.state, self.key)
+
+    class _Writer:
+        def __init__(self, scope, stream):
+            self.key = (scope, stream)
+
+        def write_event_bytes(self, payload, routing_key=None):
+            streams.setdefault(self.key, []).append(bytes(payload))
+
+    class StreamManager:
+        def __init__(self, uri):
+            self.uri = uri
+            self.scopes: set[str] = set()
+            self.created: list[tuple[str, str, int]] = []
+
+        def create_scope(self, scope):
+            self.scopes.add(scope)
+
+        def create_stream(self, scope, stream, segments):
+            self.created.append((scope, stream, segments))
+            streams.setdefault((scope, stream), [])
+
+        def seal_stream(self, scope, stream):
+            pass
+
+        def delete_stream(self, scope, stream):
+            streams.pop((scope, stream), None)
+
+        def create_reader_group(self, name, scope, stream):
+            return _ReaderGroup(name, scope, stream)
+
+        def create_writer(self, scope, stream):
+            return _Writer(scope, stream)
+
+    mod.StreamManager = StreamManager
+    mod._streams = streams
+    mod._released = released
+    return mod
+
+
+@pytest.fixture()
+def fake_pravega(monkeypatch):
+    mod = install_fake_pravega()
+    monkeypatch.setitem(sys.modules, "pravega_client", mod)
+    return mod
+
+
+def test_pravega_roundtrip_and_admin(fake_pravega, run_async):
+    from langstream_tpu.runtime.pravega_broker import (
+        PravegaTopicConnectionsRuntime,
+    )
+
+    async def main():
+        runtime = PravegaTopicConnectionsRuntime()
+        runtime.init(
+            {
+                "configuration": {
+                    "client": {"controller-uri": "tcp://fake:9090",
+                               "scope": "ls"}
+                }
+            }
+        )
+        admin = runtime.create_topic_admin()
+        await admin.create_topic("events", partitions=2)
+        assert ("ls", "events", 2) in runtime._manager.created
+
+        producer = runtime.create_producer("a", {"topic": "events"})
+        await producer.start()
+        await producer.write(
+            make_record(value={"n": 1}, key="k", headers={"raw": b"\x00\x01"})
+        )
+        await producer.write(make_record(value="text"))
+
+        consumer = runtime.create_consumer("a", {"topic": "events"})
+        await consumer.start()
+        first = (await consumer.read())[0]
+        assert first.value == {"n": 1}
+        assert first.key == "k"
+        assert first.header("raw") == b"\x00\x01"  # bytes survive the envelope
+        second = (await consumer.read())[0]
+        assert second.value == "text"
+        await consumer.commit([first, second])
+        # drained slice with everything committed gets released to the group
+        assert await consumer.read() == []
+        assert fake_pravega._released
+
+        # reader positions
+        reader = runtime.create_reader(
+            {"topic": "events"}, initial_position="earliest"
+        )
+        await reader.start()
+        got = []
+        for _ in range(3):
+            got += [r.value for r in await reader.read(timeout=0.01)]
+        assert got == [{"n": 1}, "text"]
+        latest = runtime.create_reader(
+            {"topic": "events"}, initial_position="latest"
+        )
+        await latest.start()
+        assert await latest.read(timeout=0.01) == []
+        await producer.write(make_record(value="new"))
+        assert [r.value for r in await latest.read(timeout=0.01)] == ["new"]
+        await runtime.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# admin client
+# ---------------------------------------------------------------------------
+
+
+def test_admin_client_retries_and_auth(run_async):
+    import socket
+
+    from aiohttp import web
+
+    from langstream_tpu.admin import AdminApiError, AdminClient
+
+    calls = []
+
+    async def handle(request):
+        calls.append((request.method, request.path,
+                      request.headers.get("Authorization")))
+        if request.path == "/api/tenants" and len(
+            [c for c in calls if c[1] == "/api/tenants"]
+        ) < 3:
+            return web.Response(status=503, text="busy")  # retried (GET)
+        if request.path == "/api/applications/t/boom":
+            return web.Response(status=500, text="kaput")  # POST: no retry
+        return web.json_response(["t1"])
+
+    async def main():
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handle)
+        app_runner = web.AppRunner(app)
+        await app_runner.setup()
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        await web.TCPSite(app_runner, "127.0.0.1", port).start()
+        try:
+            client = AdminClient(
+                f"http://127.0.0.1:{port}", token="tok", backoff_s=0.01
+            )
+            # two 503s then success: the GET retried through
+            assert await client.list_tenants() == ["t1"]
+            assert all(a == "Bearer tok" for _, _, a in calls)
+            # a 500 on a POST is NOT retried
+            with pytest.raises(AdminApiError) as err:
+                await client.deploy_application("t", "boom", {})
+            assert err.value.status == 500
+            assert (
+                len([c for c in calls if c[1] == "/api/applications/t/boom"])
+                == 1
+            )
+            await client.close()
+        finally:
+            await app_runner.cleanup()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# venv-per-app isolation
+# ---------------------------------------------------------------------------
+
+
+def test_app_without_requirements_uses_base_interpreter(tmp_path):
+    from langstream_tpu.runtime.isolation import ensure_app_interpreter
+
+    assert ensure_app_interpreter(None) == sys.executable
+    (tmp_path / "python").mkdir()
+    assert ensure_app_interpreter(tmp_path) == sys.executable
+
+
+def test_app_with_requirements_gets_own_venv(tmp_path):
+    """An app pinning requirements gets its own interpreter; re-calls are
+    idempotent until the requirements change."""
+    from langstream_tpu.runtime.isolation import ensure_app_interpreter
+
+    (tmp_path / "python").mkdir()
+    reqs = tmp_path / "python" / "requirements.txt"
+    reqs.write_text("")  # no packages: provisions the venv without network
+    interpreter = ensure_app_interpreter(tmp_path)
+    assert interpreter != sys.executable
+    assert Path(interpreter).exists()
+    assert str(tmp_path) in interpreter
+    marker = tmp_path / ".venv" / ".requirements.sha256"
+    stamp = marker.read_text()
+    # idempotent: same interpreter, marker untouched
+    assert ensure_app_interpreter(tmp_path) == interpreter
+    assert marker.read_text() == stamp
