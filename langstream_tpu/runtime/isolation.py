@@ -69,6 +69,13 @@ def ensure_app_interpreter(app_dir: str | Path | None) -> str:
     if python.exists() and marker.exists() and marker.read_text() == digest:
         return str(python)
     log.info("provisioning app venv at %s (requirements changed)", venv_dir)
+    if venv_dir.exists():
+        # changed requirements rebuild from scratch: an in-place reinstall
+        # would leave packages dropped from the pin list behind, making the
+        # environment diverge from a fresh deploy of the same app
+        import shutil
+
+        shutil.rmtree(venv_dir)
     subprocess.run(
         [sys.executable, "-m", "venv", "--system-site-packages", str(venv_dir)],
         check=True,

@@ -111,13 +111,19 @@ class PravegaTopicConsumer(TopicConsumer):
     ``reader-{uuid}`` groups). Slice events buffer locally; ``commit``
     releases fully-consumed slices back to the group."""
 
-    def __init__(self, manager_factory, scope: str, stream: str, group: str):
+    def __init__(self, manager_factory, scope: str, stream: str, group: str,
+                 track_pending: bool = True):
         self._manager_factory = manager_factory
         self.scope = scope
         self.stream = stream
         self.group = group
+        # TopicReaders never commit, so tracking their pending events would
+        # grow without bound and pin slices forever — they run untracked
+        # (drained slices release immediately)
+        self._track_pending = track_pending
         self._reader = None
         self._slice = None
+        self._slice_future = None  # in-flight get_segment_slice, if any
         self._pending: dict[str, Any] = {}  # position → slice holding it
         self._counter = 0
         self._total_out = 0
@@ -138,12 +144,25 @@ class PravegaTopicConsumer(TopicConsumer):
             await loop.run_in_executor(None, self._reader.reader_offline)
             self._reader = None
 
-    async def read(self) -> list[Record]:
+    async def read(self, timeout: float | None = None) -> list[Record]:
         loop = asyncio.get_running_loop()
         if self._slice is None:
-            self._slice = await loop.run_in_executor(
-                None, lambda: self._reader.get_segment_slice()
-            )
+            # get_segment_slice blocks until the broker hands a slice out; a
+            # bounded read must NOT abandon the blocked call (a second call
+            # would double-consume), so the in-flight future is kept and
+            # re-awaited on the next read
+            if self._slice_future is None:
+                self._slice_future = loop.run_in_executor(
+                    None, lambda: self._reader.get_segment_slice()
+                )
+            if timeout is not None:
+                done, _ = await asyncio.wait(
+                    {self._slice_future}, timeout=timeout
+                )
+                if not done:
+                    return []
+            self._slice = self._slice_future.result() if self._slice_future.done() else await self._slice_future
+            self._slice_future = None
             if self._slice is None:
                 return []
         event = await loop.run_in_executor(
@@ -160,7 +179,8 @@ class PravegaTopicConsumer(TopicConsumer):
         self._counter += 1
         position = f"{self.stream}:{self._counter}"
         record = event_to_record(event.data(), self.stream, position)
-        self._pending[position] = self._slice
+        if self._track_pending:
+            self._pending[position] = self._slice
         self._total_out += 1
         return [record]
 
@@ -200,7 +220,10 @@ class PravegaTopicProducer(TopicProducer):
             lambda: self._manager_factory().create_writer(self.scope, self.stream),
         )
 
-    async def close(self) -> None:
+    async def close(self) -> None:  # durable shutdown: flush buffered writes
+        if self._writer is not None and hasattr(self._writer, "flush"):
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self._writer.flush)
         self._writer = None
 
     async def write(self, record: Record) -> None:
@@ -209,9 +232,16 @@ class PravegaTopicProducer(TopicProducer):
 
         def _write():
             if routing_key is not None:
-                self._writer.write_event_bytes(payload, routing_key=routing_key)
+                result = self._writer.write_event_bytes(
+                    payload, routing_key=routing_key
+                )
             else:
-                self._writer.write_event_bytes(payload)
+                result = self._writer.write_event_bytes(payload)
+            # the binding queues writes and returns a future; durability =
+            # the broker acked, and the tracker upstream commits the source
+            # offset when this returns — so block on the ack here
+            if hasattr(result, "result"):
+                result.result()
 
         await loop.run_in_executor(None, _write)
         self._total_in += 1
@@ -227,7 +257,8 @@ class PravegaTopicReader(TopicReader):
 
     def __init__(self, manager_factory, scope: str, stream: str, position: str):
         self._consumer = PravegaTopicConsumer(
-            manager_factory, scope, stream, f"reader-{uuid.uuid4()}"
+            manager_factory, scope, stream, f"reader-{uuid.uuid4()}",
+            track_pending=False,  # readers never commit
         )
         self.position = position
 
@@ -237,10 +268,16 @@ class PravegaTopicReader(TopicReader):
             # drain the backlog so only new events surface. A single empty
             # read only means a SLICE boundary (the consumer returns [] when
             # a slice drains even with more backlog slices behind it) — two
-            # consecutive empties mean the stream itself is drained.
+            # consecutive bounded empties mean the backlog is drained. The
+            # whole drain is deadline-bounded: under continuous writes,
+            # "latest" means "roughly now", not "hang until writers pause".
+            deadline = asyncio.get_running_loop().time() + 5.0
             empty_streak = 0
-            while empty_streak < 2:
-                if await self._consumer.read():
+            while (
+                empty_streak < 2
+                and asyncio.get_running_loop().time() < deadline
+            ):
+                if await self._consumer.read(timeout=0.2):
                     empty_streak = 0
                 else:
                     empty_streak += 1
@@ -249,7 +286,9 @@ class PravegaTopicReader(TopicReader):
         await self._consumer.close()
 
     async def read(self, timeout: float | None = None) -> list[Record]:
-        return await self._consumer.read()
+        return await self._consumer.read(
+            timeout=timeout if timeout is not None else 0.5
+        )
 
 
 class PravegaTopicAdmin(TopicAdmin):

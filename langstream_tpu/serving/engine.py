@@ -383,9 +383,10 @@ class TpuServingEngine:
         prefill_flash = None
         mesh_static = self.mesh
 
-        def _make_decode(use_top_p: bool, window: int | None):
+        def _make_decode(sampler_mode: tuple, window: int | None):
             """``window``: dense → cache-row bucket (None = full cache);
             paged → number of block-table columns to sweep."""
+            use_top_p, use_top_k, all_greedy = sampler_mode
             if paged:
                 @partial(jax.jit, donate_argnums=(1, 2))
                 def _decode_chunk(params, cache_k, cache_v, tokens, lengths,
@@ -398,6 +399,7 @@ class TpuServingEngine:
                         return sample_tokens(
                             logits, sub, temps, topks,
                             use_top_p=use_top_p, top_ps=topps,
+                            use_top_k=use_top_k, all_greedy=all_greedy,
                         )
 
                     out = llama_decode_chunk_paged(
@@ -425,6 +427,7 @@ class TpuServingEngine:
                     return sample_tokens(
                         logits, sub, temps, topks,
                         use_top_p=use_top_p, top_ps=topps,
+                        use_top_k=use_top_k, all_greedy=all_greedy,
                     )
 
                 out = llama_decode_chunk(
@@ -437,7 +440,8 @@ class TpuServingEngine:
 
         self._make_decode = _make_decode
 
-        def _make_prefill(use_top_p: bool):
+        def _make_prefill(sampler_mode: tuple):
+            use_top_p, use_top_k, all_greedy = sampler_mode
             if paged:
                 @partial(jax.jit, donate_argnums=(1, 2))
                 def _prefill(params, cache_k, cache_v, tokens, lengths, tables,
@@ -454,6 +458,7 @@ class TpuServingEngine:
                         *sample_tokens(
                             logits, key, temps, topks,
                             use_top_p=use_top_p, top_ps=topps,
+                            use_top_k=use_top_k, all_greedy=all_greedy,
                         )
                     )
                     return next_tokens, logprobs, ck, cv
@@ -471,24 +476,41 @@ class TpuServingEngine:
                     *sample_tokens(
                         logits, key, temps, topks,
                         use_top_p=use_top_p, top_ps=topps,
+                        use_top_k=use_top_k, all_greedy=all_greedy,
                     )
                 )
                 return next_tokens, logprobs, ck, cv
 
             return _prefill
 
-        # top-p costs a vocab sort per step, so it's a separate compiled
-        # variant selected only when an active request asks for it; decode
-        # additionally specialises per attention window bucket (compiled
-        # lazily on first use of each bucket)
-        self._decode_chunk_fns: dict[tuple[bool, int | None], Any] = {}
-        self._prefill_fns = {p: _make_prefill(p) for p in (False, True)}
+        self._make_prefill = _make_prefill
+        # the sampler's expensive passes (top-p vocab sort, top-k selection
+        # sweep, any sampling at all for greedy-only batches) are compiled
+        # in only when an active request needs them; decode additionally
+        # specialises per attention window bucket. All variants compile
+        # lazily on first use.
+        self._decode_chunk_fns: dict[tuple[tuple, int | None], Any] = {}
+        self._prefill_fns: dict[tuple, Any] = {}
 
-    def _decode_fn(self, use_top_p: bool, window: int | None):
-        key = (use_top_p, window)
+    def _decode_fn(self, sampler_mode: tuple, window: int | None):
+        key = (sampler_mode, window)
         if key not in self._decode_chunk_fns:
-            self._decode_chunk_fns[key] = self._make_decode(use_top_p, window)
+            self._decode_chunk_fns[key] = self._make_decode(sampler_mode, window)
         return self._decode_chunk_fns[key]
+
+    def _prefill_fn(self, sampler_mode: tuple):
+        if sampler_mode not in self._prefill_fns:
+            self._prefill_fns[sampler_mode] = self._make_prefill(sampler_mode)
+        return self._prefill_fns[sampler_mode]
+
+    @staticmethod
+    def _sampler_mode(temps, topks, topps) -> tuple:
+        """(use_top_p, use_top_k, all_greedy) for the given active rows —
+        the static specialization key for compiled sampler variants."""
+        use_top_p = bool((topps < 1.0).any())
+        use_top_k = bool((topks > 0).any())
+        all_greedy = bool((temps <= 0).all()) and not use_top_p and not use_top_k
+        return (use_top_p, use_top_k, all_greedy)
 
     def _window_for(self, max_len: int) -> int | None:
         """Smallest power-of-two cache window covering ``max_len`` rows (the
@@ -657,7 +679,10 @@ class TpuServingEngine:
         temps = jnp.asarray(self._temps)
         topks = jnp.asarray(self._topks)
         topps = jnp.asarray(self._topps)
-        use_top_p = bool((self._topps[active_mask] < 1.0).any())
+        sampler_mode = self._sampler_mode(
+            self._temps[active_mask], self._topks[active_mask],
+            self._topps[active_mask],
+        )
         K = self.config.decode_chunk
         # host-tracked longest active sequence: each dispatched chunk grows
         # it by K; the attention window bucket follows
@@ -681,7 +706,7 @@ class TpuServingEngine:
 
         def _dispatch(tokens, lengths, key, window, tables, first=False):
             # async JAX dispatch: returns device arrays without blocking
-            decode_fn = self._decode_fn(use_top_p, window)
+            decode_fn = self._decode_fn(sampler_mode, window)
             if self._lockstep is not None:
                 # runs on the single dispatch thread → broadcast order is
                 # dispatch order. Speculative chunks ("decode_cont") carry
@@ -689,7 +714,7 @@ class TpuServingEngine:
                 # tokens/lengths outputs, so nothing syncs to host here.
                 desc: dict[str, Any] = {
                     "op": "decode" if first else "decode_cont",
-                    "use_top_p": bool(use_top_p),
+                    "sampler_mode": list(sampler_mode),
                     "window": window,
                     "key": np.asarray(key),
                 }
@@ -715,7 +740,7 @@ class TpuServingEngine:
                       tokens, lengths, amask, key, temps, topks, topps)
             )
             self.profiler.dump_hlo(
-                f"decode_chunk_w{window}_topp{int(use_top_p)}", decode_fn, *args
+                f"decode_chunk_w{window}_s{sampler_mode}", decode_fn, *args
             )
             chunk_t, chunk_lp, t, l, ck, cv = decode_fn(*args)
             self.cache_k, self.cache_v = ck, cv
@@ -823,7 +848,8 @@ class TpuServingEngine:
                 topks[i] = request.top_k
                 topps[i] = request.top_p
             key = self._split_key()
-            prefill_fn = self._prefill_fns[bool((topps < 1.0).any())]
+            prefill_mode = self._sampler_mode(temps, topks, topps)
+            prefill_fn = self._prefill_fn(prefill_mode)
 
             if self.block_mgr is not None:
                 # per-batch-row block tables (duplicate padded rows write
@@ -838,7 +864,7 @@ class TpuServingEngine:
                     self._lockstep.broadcast(
                         {
                             "op": "prefill",
-                            "use_top_p": bool((topps < 1.0).any()),
+                            "sampler_mode": list(prefill_mode),
                             "tokens": padded,
                             "lengths": lengths,
                             "sel": np.asarray(sel_np),

@@ -338,7 +338,7 @@ class LockstepFollower:
             if op in ("decode", "decode_cont"):
                 if op == "decode":
                     burst = {
-                        "use_top_p": bool(desc["use_top_p"]),
+                        "sampler_mode": tuple(bool(x) for x in desc["sampler_mode"]),
                         "active": jnp.asarray(desc["active"]),
                         "temps": jnp.asarray(desc["temps"]),
                         "topks": jnp.asarray(desc["topks"]),
@@ -349,7 +349,7 @@ class LockstepFollower:
                 else:
                     tokens, lengths = carry_tokens, carry_lengths
                 window = desc.get("window")
-                fn = engine._decode_fn(burst["use_top_p"], window)
+                fn = engine._decode_fn(burst["sampler_mode"], window)
                 args = [
                     engine.params, engine.cache_k, engine.cache_v,
                     tokens, lengths, burst["active"],
@@ -364,7 +364,9 @@ class LockstepFollower:
                 carry_tokens, carry_lengths = out[2], out[3]
                 engine.cache_k, engine.cache_v = out[4], out[5]
             elif op == "prefill":
-                fn = engine._prefill_fns[bool(desc["use_top_p"])]
+                fn = engine._prefill_fn(
+                    tuple(bool(x) for x in desc["sampler_mode"])
+                )
                 out = fn(
                     engine.params, engine.cache_k, engine.cache_v,
                     jnp.asarray(desc["tokens"]), jnp.asarray(desc["lengths"]),

@@ -5,9 +5,15 @@ token ids (B int32) and their logprobs cross the host boundary per step —
 never the (B, vocab) logits (HBM→host bandwidth is the TTFT killer at high
 slot counts).
 
-Supports greedy (temperature 0), temperature, and top-k. Top-p is
-implemented via sorted cumulative mass; it costs a vocab sort per step, so
-it's compiled in only when a request asks for it (static flag).
+Supports greedy (temperature 0), temperature, and top-k. The expensive
+machinery is compiled in only when a request in the batch actually asks
+for it (static flags the engine derives per decode burst):
+
+- ``use_top_p``: the sorted-cumulative-mass pass costs a vocab sort/step;
+- ``use_top_k``: ``lax.top_k`` over the vocab is a k-deep selection sweep
+  per step — pure waste for the (common) greedy/temperature-only batch;
+- ``all_greedy``: temperature 0 everywhere → only the argmax and the
+  sampled token's logprob are computed; no categorical draw at all.
 """
 
 from __future__ import annotations
@@ -23,23 +29,33 @@ def sample_tokens(
     top_ks: jax.Array,        # (B,) 0 = off
     use_top_p: bool = False,
     top_ps: jax.Array | None = None,  # (B,) 1.0 = off
+    use_top_k: bool = True,
+    all_greedy: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """→ (tokens (B,) int32, logprobs (B,) float32 of the sampled token)."""
     B, V = logits.shape
     greedy_tokens = jnp.argmax(logits, axis=-1)
 
+    def token_logprob(tokens: jax.Array) -> jax.Array:
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(logprobs, tokens[:, None], axis=1).squeeze(1)
+
+    if all_greedy:
+        return greedy_tokens.astype(jnp.int32), token_logprob(greedy_tokens)
+
     temps = jnp.maximum(temperatures, 1e-6)[:, None]
     scaled = logits / temps
-
-    # top-k: mask everything below the k-th largest (k dynamic per row via
-    # a fixed K_MAX window — vocab-sized sort avoided)
-    K_MAX = 64
-    top_vals, _ = jax.lax.top_k(scaled, K_MAX)  # (B, K_MAX) descending
-    k_idx = jnp.clip(top_ks - 1, 0, K_MAX - 1)
-    kth_val = jnp.take_along_axis(top_vals, k_idx[:, None], axis=1)
-    apply_topk = (top_ks > 0)[:, None]
     neg = jnp.finfo(scaled.dtype).min
-    scaled = jnp.where(apply_topk & (scaled < kth_val), neg, scaled)
+
+    if use_top_k:
+        # top-k: mask everything below the k-th largest (k dynamic per row
+        # via a fixed K_MAX window — vocab-sized sort avoided)
+        K_MAX = 64
+        top_vals, _ = jax.lax.top_k(scaled, K_MAX)  # (B, K_MAX) descending
+        k_idx = jnp.clip(top_ks - 1, 0, K_MAX - 1)
+        kth_val = jnp.take_along_axis(top_vals, k_idx[:, None], axis=1)
+        apply_topk = (top_ks > 0)[:, None]
+        scaled = jnp.where(apply_topk & (scaled < kth_val), neg, scaled)
 
     if use_top_p:
         assert top_ps is not None
@@ -55,8 +71,4 @@ def sample_tokens(
 
     sampled = jax.random.categorical(key, scaled, axis=-1)
     tokens = jnp.where(temperatures <= 0, greedy_tokens, sampled)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    token_logprobs = jnp.take_along_axis(
-        logprobs, tokens[:, None], axis=1
-    ).squeeze(1)
-    return tokens.astype(jnp.int32), token_logprobs
+    return tokens.astype(jnp.int32), token_logprob(tokens)

@@ -674,3 +674,56 @@ def test_mesh_engine_serves_with_kernels_on(run_async, monkeypatch):
             assert 0 < len(r["tokens"]) <= 6
 
     run_async(main())
+
+
+def test_sampler_mode_specializations_agree():
+    """The cheap compiled variants must equal the full sampler on inputs
+    they claim to cover: all_greedy ≡ full path at temperature 0; dropping
+    the top-k sweep is identity when no row requests top-k."""
+    from langstream_tpu.serving.sampler import sample_tokens
+
+    key = jax.random.PRNGKey(7)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (5, 301), jnp.float32)
+    zero_t = jnp.zeros((5,), jnp.float32)
+    no_k = jnp.zeros((5,), jnp.int32)
+
+    full_tokens, full_lps = sample_tokens(logits, key, zero_t, no_k)
+    fast_tokens, fast_lps = sample_tokens(
+        logits, key, zero_t, no_k, use_top_k=False, all_greedy=True
+    )
+    np.testing.assert_array_equal(np.asarray(full_tokens), np.asarray(fast_tokens))
+    np.testing.assert_allclose(np.asarray(full_lps), np.asarray(fast_lps), rtol=1e-6)
+
+    # sampled path without top-k rows: dropping the sweep changes nothing
+    temps = jnp.full((5,), 0.8, jnp.float32)
+    with_k, _ = sample_tokens(logits, key, temps, no_k, use_top_k=True)
+    without_k, _ = sample_tokens(logits, key, temps, no_k, use_top_k=False)
+    np.testing.assert_array_equal(np.asarray(with_k), np.asarray(without_k))
+
+    # top-k actually constrains when requested
+    ks = jnp.full((5,), 2, jnp.int32)
+    constrained, _ = sample_tokens(
+        logits, jax.random.PRNGKey(9), jnp.full((5,), 5.0), ks, use_top_k=True
+    )
+    top2 = np.argsort(-np.asarray(logits), axis=-1)[:, :2]
+    for row, token in enumerate(np.asarray(constrained)):
+        assert token in top2[row]
+
+
+def test_engine_sampler_mode_derivation():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    mode = TpuServingEngine._sampler_mode(
+        np.zeros(3, np.float32), np.zeros(3, np.int32), np.ones(3, np.float32)
+    )
+    assert mode == (False, False, True)  # pure greedy batch
+    mode = TpuServingEngine._sampler_mode(
+        np.array([0.0, 0.7], np.float32), np.array([0, 40], np.int32),
+        np.ones(2, np.float32),
+    )
+    assert mode == (False, True, False)  # one sampling row with top-k
+    mode = TpuServingEngine._sampler_mode(
+        np.array([0.7], np.float32), np.array([0], np.int32),
+        np.array([0.9], np.float32),
+    )
+    assert mode == (True, False, False)  # top-p requested
