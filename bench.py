@@ -69,9 +69,14 @@ async def run_decode_bench(kv_layout: str, requests: int) -> dict:
 
     engine = TpuServingEngine.get_or_create(_serving_config(kv_layout))
 
-    # warmup: compile prefill bucket + decode step
+    # warmup at FULL length: the decode window bucket grows with sequence
+    # length, so short warmups would leave later buckets to compile inside
+    # the measured run (a 30s stall mid-measurement)
     await asyncio.gather(
-        *(engine.generate(PROMPT, {"max-tokens": 8}) for _ in range(WARMUP_REQUESTS))
+        *(
+            engine.generate(PROMPT, {"max-tokens": MAX_TOKENS})
+            for _ in range(WARMUP_REQUESTS)
+        )
     )
 
     start = time.monotonic()

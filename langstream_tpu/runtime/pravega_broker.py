@@ -124,6 +124,7 @@ class PravegaTopicConsumer(TopicConsumer):
         self._reader = None
         self._slice = None
         self._slice_future = None  # in-flight get_segment_slice, if any
+        self._timed_out = False  # last empty read was a timeout, not a drain
         self._pending: dict[str, Any] = {}  # position → slice holding it
         self._counter = 0
         self._total_out = 0
@@ -139,6 +140,21 @@ class PravegaTopicConsumer(TopicConsumer):
         self._reader = await loop.run_in_executor(None, _open)
 
     async def close(self) -> None:
+        if self._slice_future is not None and not self._slice_future.done():
+            # don't block shutdown on the blocked call, but don't abandon
+            # its result either: release a late slice, swallow a late error
+            reader = self._reader
+
+            def _dispose(fut) -> None:
+                try:
+                    late = fut.result()
+                    if late is not None and reader is not None:
+                        reader.release_segment(late)
+                except Exception:
+                    pass
+
+            self._slice_future.add_done_callback(_dispose)
+            self._slice_future = None
         if self._reader is not None:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, self._reader.reader_offline)
@@ -160,11 +176,19 @@ class PravegaTopicConsumer(TopicConsumer):
                     {self._slice_future}, timeout=timeout
                 )
                 if not done:
+                    self._timed_out = True
                     return []
-            self._slice = self._slice_future.result() if self._slice_future.done() else await self._slice_future
-            self._slice_future = None
+            try:
+                self._slice = await self._slice_future
+            finally:
+                # a failed call is safe to retry (nothing was consumed);
+                # clearing here keeps a transient broker error from wedging
+                # every later read on the same cached exception
+                self._slice_future = None
+            self._timed_out = False
             if self._slice is None:
                 return []
+        self._timed_out = False
         event = await loop.run_in_executor(
             None, lambda: next(iter(self._slice), None)
         )
@@ -265,22 +289,24 @@ class PravegaTopicReader(TopicReader):
     async def start(self) -> None:
         await self._consumer.start()
         if self.position == "latest":
-            # drain the backlog so only new events surface. A single empty
-            # read only means a SLICE boundary (the consumer returns [] when
-            # a slice drains even with more backlog slices behind it) — two
-            # consecutive bounded empties mean the backlog is drained. The
-            # whole drain is deadline-bounded: under continuous writes,
-            # "latest" means "roughly now", not "hang until writers pause".
-            deadline = asyncio.get_running_loop().time() + 5.0
-            empty_streak = 0
-            while (
-                empty_streak < 2
-                and asyncio.get_running_loop().time() < deadline
-            ):
-                if await self._consumer.read(timeout=0.2):
-                    empty_streak = 0
-                else:
-                    empty_streak += 1
+            # drain the backlog so only new events surface. Empty reads come
+            # in two flavors the streak must distinguish: a SLICE-DRAIN
+            # empty (more backlog may follow immediately) and a TIMEOUT
+            # empty (nothing available right now). The drain ends on a
+            # timeout *after data has flowed* (backlog consumed) — a slow
+            # first slice delivery must not end it early, or history would
+            # replay as live events. An entirely idle stream exits on the
+            # deadline; under continuous writes the deadline also bounds
+            # the wait ("latest" means roughly-now, not writers-paused).
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 5.0
+            got_any = False
+            while loop.time() < deadline:
+                if await self._consumer.read(timeout=0.25):
+                    got_any = True
+                    continue
+                if got_any and self._consumer._timed_out:
+                    break
 
     async def close(self) -> None:
         await self._consumer.close()

@@ -513,12 +513,22 @@ class TpuServingEngine:
         return (use_top_p, use_top_k, all_greedy)
 
     def _window_for(self, max_len: int) -> int | None:
-        """Smallest power-of-two cache window covering ``max_len`` rows (the
-        chunk's new tokens live in the chunk buffer, not the window)."""
+        """Smallest 128-multiple cache window covering ``max_len`` rows (the
+        chunk's new tokens live in the chunk buffer, not the window).
+
+        Decode is cache-read bound, so window granularity is read traffic:
+        power-of-two buckets read up to 2× the needed rows near bucket
+        edges. Hybrid granularity bounds BOTH costs: 128-multiples up to
+        1024 rows (excess <128 rows/slot where most serving lengths live),
+        powers of two beyond (a long-context engine would otherwise compile
+        a fresh ~30s decode variant every 128 generated tokens)."""
         S = self.model_config.max_seq_len
-        w = 128
-        while w < max_len:
-            w *= 2
+        if max_len <= 1024:
+            w = max(128, -(-max_len // 128) * 128)
+        else:
+            w = 2048
+            while w < max_len:
+                w *= 2
         return None if w >= S else w
 
     def _read_blocks_for(self, max_len: int) -> int:
