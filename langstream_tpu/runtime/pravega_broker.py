@@ -144,14 +144,23 @@ class PravegaTopicConsumer(TopicConsumer):
             # don't block shutdown on the blocked call, but don't abandon
             # its result either: release a late slice, swallow a late error
             reader = self._reader
+            loop = asyncio.get_running_loop()
 
             def _dispose(fut) -> None:
+                # runs on the event loop when the abandoned blocking call
+                # finally resolves: route the (blocking) release back to an
+                # executor thread, never run broker RPCs on the loop
                 try:
                     late = fut.result()
-                    if late is not None and reader is not None:
-                        reader.release_segment(late)
                 except Exception:
-                    pass
+                    return
+                if late is not None and reader is not None:
+                    try:
+                        loop.run_in_executor(
+                            None, reader.release_segment, late
+                        )
+                    except RuntimeError:
+                        pass  # loop already closed at shutdown
 
             self._slice_future.add_done_callback(_dispose)
             self._slice_future = None
@@ -160,8 +169,17 @@ class PravegaTopicConsumer(TopicConsumer):
             await loop.run_in_executor(None, self._reader.reader_offline)
             self._reader = None
 
+    def last_empty_was_timeout(self) -> bool:
+        """True when the most recent empty ``read`` hit its timeout (nothing
+        available) rather than a slice boundary (more may follow at once)."""
+        return self._timed_out
+
     async def read(self, timeout: float | None = None) -> list[Record]:
         loop = asyncio.get_running_loop()
+        # default every path to "not a timeout"; only the timeout return
+        # flips it — new empty-return paths then fail safe (drain keeps
+        # going on deadline rather than breaking early)
+        self._timed_out = False
         if self._slice is None:
             # get_segment_slice blocks until the broker hands a slice out; a
             # bounded read must NOT abandon the blocked call (a second call
@@ -185,10 +203,8 @@ class PravegaTopicConsumer(TopicConsumer):
                 # clearing here keeps a transient broker error from wedging
                 # every later read on the same cached exception
                 self._slice_future = None
-            self._timed_out = False
             if self._slice is None:
                 return []
-        self._timed_out = False
         event = await loop.run_in_executor(
             None, lambda: next(iter(self._slice), None)
         )
@@ -301,11 +317,20 @@ class PravegaTopicReader(TopicReader):
             loop = asyncio.get_running_loop()
             deadline = loop.time() + 5.0
             got_any = False
+            idle_timeouts = 0
             while loop.time() < deadline:
                 if await self._consumer.read(timeout=0.25):
                     got_any = True
                     continue
-                if got_any and self._consumer._timed_out:
+                if not self._consumer.last_empty_was_timeout():
+                    continue  # slice boundary: more backlog may follow
+                if got_any:
+                    break  # backlog consumed, nothing more available
+                idle_timeouts += 1
+                if idle_timeouts >= 4:
+                    # an idle stream: ~1s is enough to say "no backlog";
+                    # the 5s deadline is only for slow first-slice delivery
+                    # of real backlog (history must not replay as live)
                     break
 
     async def close(self) -> None:

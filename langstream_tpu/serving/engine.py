@@ -100,6 +100,11 @@ class ServingConfig:
     # paged read path: "auto" (Pallas kernel on single-chip TPU, XLA gather
     # elsewhere), or force "xla" | "pallas" | "pallas-interpret"
     paged_kernel: str = "auto"
+    # dense decode read path: "auto" (Pallas paged-read kernel over the
+    # dense cache viewed as identity-mapped blocks on single-chip TPU; XLA
+    # einsum elsewhere/under meshes), or force "xla" | "pallas" |
+    # "pallas-interpret"
+    dense_kernel: str = "auto"
 
     def to_dict(self) -> dict[str, Any]:
         """Kebab-case dict that :meth:`from_dict` round-trips — the lockstep
@@ -121,6 +126,7 @@ class ServingConfig:
             "kv-pool-fraction": self.kv_pool_fraction,
             "kv-pool-blocks": self.kv_pool_blocks,
             "paged-kernel": self.paged_kernel,
+            "dense-kernel": self.dense_kernel,
         }
 
     @classmethod
@@ -149,6 +155,7 @@ class ServingConfig:
                 else None
             ),
             paged_kernel=d.get("paged-kernel", d.get("paged_kernel", "auto")),
+            dense_kernel=d.get("dense-kernel", d.get("dense_kernel", "auto")),
         )
 
 
@@ -329,6 +336,32 @@ class TpuServingEngine:
             raise ValueError(f"unknown kv_layout {self.config.kv_layout!r}")
         else:
             cache_k, cache_v = init_kv_cache(mc, self.config.slots)
+            kernel = self.config.dense_kernel
+            if kernel == "auto":
+                # the paged Pallas read kernel doubles as the dense fast
+                # path (identity block tables); meshes keep the XLA einsum
+                kernel = (
+                    "pallas"
+                    if self.mesh is None
+                    and jax.default_backend() == "tpu"
+                    and mc.max_seq_len % 128 == 0
+                    else "xla"
+                )
+            elif kernel != "xla":
+                # forced kernels fail fast at construction, not inside a
+                # jitted trace at first decode
+                if self.mesh is not None:
+                    raise ValueError(
+                        "dense_kernel=pallas runs per-device; under a mesh "
+                        "keep dense_kernel=xla (the paged layout has the "
+                        "shard_map'd kernel)"
+                    )
+                if mc.max_seq_len % 128 != 0:
+                    raise ValueError(
+                        f"dense_kernel=pallas needs max_seq_len divisible by "
+                        f"128, got {mc.max_seq_len}"
+                    )
+            self.dense_read_kernel = kernel
 
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -387,6 +420,19 @@ class TpuServingEngine:
             """``window``: dense → cache-row bucket (None = full cache);
             paged → number of block-table columns to sweep."""
             use_top_p, use_top_k, all_greedy = sampler_mode
+
+            def _sample_fn_for(temps, topks, topps):
+                # ONE definition for all three decode variants (paged,
+                # dense-pallas, dense-xla) — they must sample identically
+                def sample_fn(logits, sub):
+                    return sample_tokens(
+                        logits, sub, temps, topks,
+                        use_top_p=use_top_p, top_ps=topps,
+                        use_top_k=use_top_k, all_greedy=all_greedy,
+                    )
+
+                return sample_fn
+
             if paged:
                 @partial(jax.jit, donate_argnums=(1, 2))
                 def _decode_chunk(params, cache_k, cache_v, tokens, lengths,
@@ -395,13 +441,7 @@ class TpuServingEngine:
                         llama_decode_chunk_paged,
                     )
 
-                    def sample_fn(logits, sub):
-                        return sample_tokens(
-                            logits, sub, temps, topks,
-                            use_top_p=use_top_p, top_ps=topps,
-                            use_top_k=use_top_k, all_greedy=all_greedy,
-                        )
-
+                    sample_fn = _sample_fn_for(temps, topks, topps)
                     out = llama_decode_chunk_paged(
                         mc_static, params, tokens, lengths, active,
                         cache_k, cache_v, tables, sample_fn, key, K,
@@ -423,16 +463,23 @@ class TpuServingEngine:
                 covering the longest active sequence."""
                 from langstream_tpu.models.llama import llama_decode_chunk
 
-                def sample_fn(logits, sub):
-                    return sample_tokens(
-                        logits, sub, temps, topks,
-                        use_top_p=use_top_p, top_ps=topps,
-                        use_top_k=use_top_k, all_greedy=all_greedy,
+                if self.dense_read_kernel != "xla":
+                    from langstream_tpu.models.llama_paged import (
+                        llama_decode_chunk_dense_pallas,
                     )
+
+                    out = llama_decode_chunk_dense_pallas(
+                        mc_static, params, tokens, lengths, active,
+                        cache_k, cache_v, _sample_fn_for(temps, topks, topps),
+                        key, K,
+                        window=window, kernel=self.dense_read_kernel,
+                    )
+                    return _fetchable(out[0], out[1]) + out[2:]
 
                 out = llama_decode_chunk(
                     mc_static, params, tokens, lengths, active,
-                    cache_k, cache_v, sample_fn, key, K, window=window,
+                    cache_k, cache_v, _sample_fn_for(temps, topks, topps),
+                    key, K, window=window,
                 )
                 return _fetchable(out[0], out[1]) + out[2:]
 
