@@ -125,6 +125,7 @@ class PravegaTopicConsumer(TopicConsumer):
         self._slice = None
         self._slice_future = None  # in-flight get_segment_slice, if any
         self._timed_out = False  # last empty read was a timeout, not a drain
+        self._slices_received = 0  # slices the broker has handed out
         self._pending: dict[str, Any] = {}  # position → slice holding it
         self._counter = 0
         self._total_out = 0
@@ -155,10 +156,14 @@ class PravegaTopicConsumer(TopicConsumer):
                 except Exception:
                     return
                 if late is not None and reader is not None:
+                    def _release() -> None:
+                        try:
+                            reader.release_segment(late)
+                        except Exception:
+                            pass  # reader already offline at shutdown
+
                     try:
-                        loop.run_in_executor(
-                            None, reader.release_segment, late
-                        )
+                        loop.run_in_executor(None, _release)
                     except RuntimeError:
                         pass  # loop already closed at shutdown
 
@@ -198,6 +203,8 @@ class PravegaTopicConsumer(TopicConsumer):
                     return []
             try:
                 self._slice = await self._slice_future
+                if self._slice is not None:
+                    self._slices_received += 1
             finally:
                 # a failed call is safe to retry (nothing was consumed);
                 # clearing here keeps a transient broker error from wedging
@@ -316,21 +323,19 @@ class PravegaTopicReader(TopicReader):
             # the wait ("latest" means roughly-now, not writers-paused).
             loop = asyncio.get_running_loop()
             deadline = loop.time() + 5.0
-            got_any = False
-            idle_timeouts = 0
             while loop.time() < deadline:
                 if await self._consumer.read(timeout=0.25):
-                    got_any = True
                     continue
                 if not self._consumer.last_empty_was_timeout():
                     continue  # slice boundary: more backlog may follow
-                if got_any:
-                    break  # backlog consumed, nothing more available
-                idle_timeouts += 1
-                if idle_timeouts >= 4:
-                    # an idle stream: ~1s is enough to say "no backlog";
-                    # the 5s deadline is only for slow first-slice delivery
-                    # of real backlog (history must not replay as live)
+                if self._consumer._slices_received > 0:
+                    # the broker HAS delivered slices and now nothing more
+                    # is immediately available: drained. Before any slice
+                    # arrives, a timeout is ambiguous (slow backlog delivery
+                    # vs idle stream) — correctness wins, so only the
+                    # deadline ends that wait (history must never replay as
+                    # live events; an idle stream pays the deadline once at
+                    # connect).
                     break
 
     async def close(self) -> None:
