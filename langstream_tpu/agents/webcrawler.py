@@ -52,6 +52,13 @@ class WebCrawlerSource(AgentSource):
         )
         self.user_agent = configuration.get("user-agent", "langstream-tpu-crawler")
         self.handle_robots = bool(configuration.get("handle-robots-file", True))
+        # full re-crawl cadence (parity: WebCrawlerSource reindex interval):
+        # once the frontier drains, wait this long, then restart from the
+        # seeds with a fresh visited set. 0 = crawl once and idle.
+        self.reindex_interval = float(
+            configuration.get("reindex-interval-seconds", 0)
+        )
+        self._drained_at: float | None = None
         self._frontier: list[tuple[str, int]] = []
         self._visited: set[str] = set()
         self._robots_disallow: dict[str, list[str]] = {}
@@ -172,8 +179,25 @@ class WebCrawlerSource(AgentSource):
 
     async def read(self) -> list[Record]:
         if not self._frontier or len(self._visited) >= self.max_urls:
+            if self.reindex_interval > 0 and self._visited:
+                import time as _time
+
+                now = _time.monotonic()
+                if self._drained_at is None:
+                    self._drained_at = now
+                elif now - self._drained_at >= self.reindex_interval:
+                    # reindex: restart from the seeds with fresh state —
+                    # including the robots cache, or changed Disallow rules
+                    # and sitemap entries would never be re-ingested
+                    self._drained_at = None
+                    self._visited.clear()
+                    self._robots_disallow.clear()
+                    self._frontier = [(u, 0) for u in self.seed_urls]
+                    self._save_state()
+                    return []
             await asyncio.sleep(0.5)
             return []
+        self._drained_at = None
         url, depth = self._frontier.pop(0)
         if url in self._visited:
             return []

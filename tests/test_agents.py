@@ -393,7 +393,9 @@ def test_rag_vector_roundtrip(tmp_path, run_async):
             # wait for the sink to land all three
             from langstream_tpu.agents.vector import InMemoryVectorStore
 
-            for _ in range(100):
+            # generous deadline: the embedding encoder compiles on first
+            # use, and a loaded full-suite run can make that slow on CPU
+            for _ in range(600):
                 store = InMemoryVectorStore.get("vdb")
                 if len(store.collection("docs").ids) == 3:
                     break

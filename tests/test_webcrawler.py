@@ -156,3 +156,44 @@ def test_plain_crawl_and_link_following(run_async):
             await site.stop()
 
     run_async(main())
+
+
+def test_reindex_interval_recrawls_from_seeds(run_async):
+    async def main():
+        site = await FakeSite({}).start()
+        site.pages["/"] = ("text/html", "<html>v1</html>")
+        try:
+            source = WebCrawlerSource()
+            await source.init(
+                {
+                    "seed-urls": [f"{site.base}/"],
+                    "allowed-domains": [f"127.0.0.1:{site.port}"],
+                    "handle-robots-file": False,
+                    "min-time-between-requests": 1,
+                    "reindex-interval-seconds": 0.2,
+                }
+            )
+
+            class _Ctx:
+                def get_persistent_state_directory(self):
+                    return None
+
+            await source.setup(_Ctx())
+            await source.start()
+            first = await _drain(source, 2)
+            assert [r.header("url") for r in first] == [f"{site.base}/"]
+            site.pages["/"] = ("text/html", "<html>v2</html>")
+            import asyncio as _a
+
+            await _a.sleep(0.3)
+            again = []
+            for _ in range(6):
+                again += await source.read()
+                if again:
+                    break
+            assert [r.value for r in again] == ["<html>v2</html>"]
+            await source.close()
+        finally:
+            await site.stop()
+
+    run_async(main())

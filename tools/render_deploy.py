@@ -67,17 +67,26 @@ def service(name: str, port: int) -> dict:
 
 
 def rbac() -> list[dict]:
+    # least privilege: explicit verb lists (tenant namespaces are created
+    # dynamically, so the grants must be cluster-scoped, but nothing here
+    # needs wildcard verbs — and namespaces are never deleted by the
+    # components, only created for new tenants)
+    crud = ["get", "list", "watch", "create", "update", "patch", "delete"]
     rules_control_plane = [
         {"apiGroups": ["langstream.tpu"], "resources": ["applications", "agents"],
-         "verbs": ["*"]},
-        {"apiGroups": [""], "resources": ["secrets", "configmaps", "namespaces"],
-         "verbs": ["*"]},
+         "verbs": crud},
+        {"apiGroups": [""], "resources": ["secrets", "configmaps"],
+         "verbs": crud},
+        {"apiGroups": [""], "resources": ["namespaces"],
+         "verbs": ["get", "list", "watch", "create"]},
     ]
     rules_operator = rules_control_plane + [
-        {"apiGroups": ["apps"], "resources": ["statefulsets"], "verbs": ["*"]},
-        {"apiGroups": [""], "resources": ["services", "persistentvolumeclaims",
-                                          "pods"], "verbs": ["*"]},
-        {"apiGroups": ["batch"], "resources": ["jobs"], "verbs": ["*"]},
+        {"apiGroups": ["apps"], "resources": ["statefulsets"], "verbs": crud},
+        {"apiGroups": [""], "resources": ["services", "persistentvolumeclaims"],
+         "verbs": crud},
+        {"apiGroups": [""], "resources": ["pods"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["batch"], "resources": ["jobs"], "verbs": crud},
     ]
     out = []
     for name, rules in (
