@@ -86,6 +86,9 @@ def run_process(
 def demo_config(total_devices: int):
     from langstream_tpu.serving.engine import ServingConfig
 
+    # LS_DEMO_KV=paged exercises the block-pool cache across the process
+    # boundary (block tables ride the lockstep descriptors)
+    kv_layout = os.environ.get("LS_DEMO_KV", "dense")
     return ServingConfig(
         model="tiny",
         slots=4,
@@ -93,6 +96,8 @@ def demo_config(total_devices: int):
         decode_chunk=4,
         prefill_batch=2,
         seed=0,
+        kv_layout=kv_layout,
+        kv_block_size=16,
         # tiny model: 2 kv heads caps tp at 2; the rest of the devices go dp
         mesh=(("dp", total_devices // 2), ("tp", 2)),
     )

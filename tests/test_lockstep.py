@@ -36,11 +36,13 @@ def _sub_env() -> dict[str, str]:
 
 
 @pytest.mark.slow
-def test_two_process_lockstep_decode_matches_single_process(tmp_path):
+@pytest.mark.parametrize("kv_layout", ["dense", "paged"])
+def test_two_process_lockstep_decode_matches_single_process(tmp_path, kv_layout):
     coordinator_port = _free_port()
     lockstep_port = _free_port()
     out = tmp_path / "leader_tokens.json"
     env = _sub_env()
+    env["LS_DEMO_KV"] = kv_layout
 
     follower = subprocess.Popen(
         [
@@ -75,7 +77,11 @@ def test_two_process_lockstep_decode_matches_single_process(tmp_path):
         run_single_process_reference,
     )
 
-    reference_tokens = run_single_process_reference(8)
+    os.environ["LS_DEMO_KV"] = kv_layout
+    try:
+        reference_tokens = run_single_process_reference(8)
+    finally:
+        os.environ.pop("LS_DEMO_KV", None)
     assert lockstep_tokens == reference_tokens
     assert len(lockstep_tokens) == 3
     assert all(len(stream) > 0 for stream in lockstep_tokens)

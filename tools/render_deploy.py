@@ -69,16 +69,21 @@ def service(name: str, port: int) -> dict:
 def rbac() -> list[dict]:
     # least privilege: explicit verb lists (tenant namespaces are created
     # dynamically, so the grants must be cluster-scoped, but nothing here
-    # needs wildcard verbs — and namespaces are never deleted by the
-    # components, only created for new tenants)
+    # needs wildcard verbs)
     crud = ["get", "list", "watch", "create", "update", "patch", "delete"]
     rules_control_plane = [
         {"apiGroups": ["langstream.tpu"], "resources": ["applications", "agents"],
          "verbs": crud},
+        # status subresources are distinct RBAC resources; reconcilers and
+        # the store write them (k8s/client.py update_status)
+        {"apiGroups": ["langstream.tpu"],
+         "resources": ["applications/status", "agents/status"],
+         "verbs": ["get", "update", "patch"]},
         {"apiGroups": [""], "resources": ["secrets", "configmaps"],
          "verbs": crud},
-        {"apiGroups": [""], "resources": ["namespaces"],
-         "verbs": ["get", "list", "watch", "create"]},
+        # tenant lifecycle: namespaces are created on tenant create,
+        # re-applied on tenant update, and deleted on tenant delete
+        {"apiGroups": [""], "resources": ["namespaces"], "verbs": crud},
     ]
     rules_operator = rules_control_plane + [
         {"apiGroups": ["apps"], "resources": ["statefulsets"], "verbs": crud},
