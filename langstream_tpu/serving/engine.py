@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from langstream_tpu.api.metrics import PrometheusMetricsReporter
 from langstream_tpu.models.llama import (
     LlamaConfig,
     init_kv_cache,
@@ -283,6 +284,26 @@ class TpuServingEngine:
         self._pending_emits: list = []
         self._finished_requests: list = []
         self.total_generated = 0
+        # Prometheus serving metrics (ride the pod's /metrics endpoint next
+        # to the per-agent counters; labeled by model)
+        reporter = PrometheusMetricsReporter(
+            prefix="langstream_serving", agent_id=config.model
+        )
+        self._m_tokens = reporter.counter(
+            "tokens_generated_total", "tokens generated by the engine"
+        )
+        self._m_requests = reporter.counter(
+            "requests_completed_total", "completed generation requests"
+        )
+        self._m_ttft = reporter.gauge(
+            "last_ttft_seconds", "time to first token of the last request"
+        )
+        self._m_active = reporter.gauge(
+            "slots_active", "decode slots currently generating"
+        )
+        self._m_queued = reporter.gauge(
+            "queued_requests", "requests awaiting a free slot"
+        )
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
 
@@ -685,6 +706,8 @@ class TpuServingEngine:
                 if not self._queue.empty():
                     await self._admit(loop)
                 active = [i for i, s in enumerate(self.slots) if not s.free]
+                self._m_active(len(active))
+                self._m_queued(self._queue.qsize())
                 if not active:
                     if self._queue.empty():
                         self._wake.clear()
@@ -956,6 +979,7 @@ class TpuServingEngine:
                 request.first_token_time = now
                 self._emit_token(slot_id, int(next_np[i]), float(logprob_np[i]))
                 admitted_slots.append(slot_id)
+            self._m_tokens(len(batch))
             await self._flush_emits(admitted_slots)
 
     def _process_chunk(
@@ -965,6 +989,7 @@ class TpuServingEngine:
         True if any slot finished (→ admission opportunity)."""
         K = chunk_tokens.shape[0]
         finished_any = False
+        emitted_before = self.total_generated
         for slot_id in active:
             for k in range(K):
                 slot = self.slots[slot_id]
@@ -975,6 +1000,8 @@ class TpuServingEngine:
                 self._current[slot_id] = token
                 if self._emit_token(slot_id, token, float(chunk_lps[k, slot_id])):
                     finished_any = True
+        # one prometheus update per chunk, not per token (host hot path)
+        self._m_tokens(self.total_generated - emitted_before)
         return finished_any
 
     def _emit_token(self, slot_id: int, token: int, logprob: float) -> bool:
@@ -1017,6 +1044,9 @@ class TpuServingEngine:
                 await result
         finished, self._finished_requests = self._finished_requests, []
         for request, is_eos in finished:
+            self._m_requests()
+            if request.first_token_time is not None:
+                self._m_ttft(request.first_token_time - request.enqueue_time)
             text = self.tokenizer.decode(request.generated)
             if not request.future.done():
                 request.future.set_result(
@@ -1101,6 +1131,9 @@ class EmbeddingEngine:
                 is_leaf=lambda x: isinstance(x, P),
             )
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-embed")
+        self._m_embeddings = PrometheusMetricsReporter(
+            prefix="langstream_serving", agent_id=model
+        ).counter("embeddings_total", "embedding vectors computed")
         cfg = self.config
 
         @jax.jit
@@ -1131,4 +1164,5 @@ class EmbeddingEngine:
                 self._encode_fn(self.params, jnp.asarray(tokens), jnp.asarray(mask))
             ),
         )
+        self._m_embeddings(len(texts))
         return out.tolist()
