@@ -1,0 +1,37 @@
+"""A LangChain-BaseLoader-shaped directory loader as a python-source.
+
+Mirrors the loader contract the reference's langchain-document-loader
+example wraps (lazy_load() → Document(page_content, metadata)): each file
+matching the glob becomes one record whose value is the page content and
+whose headers carry the metadata. Files are emitted once; the source then
+idles (re-deploy to re-ingest).
+"""
+
+import pathlib
+
+
+class DirectoryLoader:
+    def init(self, configuration):
+        self.path = pathlib.Path(configuration.get("path", "."))
+        self.glob = configuration.get("glob", "*")
+        self._pending = None
+
+    async def read(self):
+        if self._pending is None:
+            files = sorted(self.path.glob(self.glob)) if self.path.is_dir() else []
+            self._pending = [
+                (
+                    f.read_text(errors="replace"),
+                    str(f),
+                    {"source": str(f), "loader": "DirectoryLoader"},
+                )
+                for f in files
+            ]
+        if self._pending:
+            return [self._pending.pop(0)]
+        # idle poll (like the reference's S3Source idle wait) — async so the
+        # in-process runtime's event loop keeps serving other agents
+        import asyncio
+
+        await asyncio.sleep(0.5)
+        return []

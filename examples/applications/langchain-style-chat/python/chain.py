@@ -1,0 +1,36 @@
+"""LangChain-style chain stages as in-process python agents.
+
+The reference's langchain-chat example wires a LangChain chain (prompt |
+model | parser) to OpenAI inside one python-processor. Here each stage is
+its own agent: these two classes are the prompt template and the output
+parser, and the model between them is the pipeline's ai-text-completions
+step on the in-tree TPU engine.
+"""
+
+import json
+
+
+class PromptTemplate:
+    def init(self, configuration):
+        self.template = configuration.get("template", "Question: {question}")
+
+    def process(self, record):
+        value = record.value() if callable(record.value) else record.value
+        if isinstance(value, (bytes, str)):
+            try:
+                value = json.loads(value)
+            except (ValueError, TypeError):
+                value = {"question": value}
+        if not isinstance(value, dict):
+            value = {"question": str(value)}
+        question = str(value.get("question", ""))
+        return [{**value, "prompt": self.template.format(question=question)}]
+
+
+class StrOutputParser:
+    def process(self, record):
+        value = record.value() if callable(record.value) else record.value
+        if isinstance(value, (bytes, str)):
+            value = json.loads(value)
+        answer = str(value.get("completion", "")).strip()
+        return [{"question": value.get("question", ""), "answer": answer}]

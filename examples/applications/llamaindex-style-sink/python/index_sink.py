@@ -1,0 +1,37 @@
+"""LlamaIndex-node-shaped sink: inserts (id, text, vector, metadata) rows.
+
+The reference example hands records to LlamaIndex's VectorStoreIndex
+backed by Cassandra; this sink writes the same node shape into a local
+sqlite table (swap db-path for any JDBC datasource the framework knows),
+which the query-vector-db agent can then search with the cosine UDF.
+"""
+
+import json
+import sqlite3
+import uuid
+
+
+class VectorIndexSink:
+    def init(self, configuration):
+        self.conn = sqlite3.connect(configuration.get("db-path", ":memory:"))
+        self.table = configuration.get("table", "nodes")
+        self.conn.execute(
+            f"CREATE TABLE IF NOT EXISTS {self.table} "
+            "(id TEXT PRIMARY KEY, text TEXT, vector TEXT, metadata TEXT)"
+        )
+
+    def write(self, record):
+        value = record.value() if callable(record.value) else record.value
+        if isinstance(value, (bytes, str)):
+            value = json.loads(value)
+        headers = dict(getattr(record, "headers", lambda: [])() or [])
+        self.conn.execute(
+            f"INSERT OR REPLACE INTO {self.table} VALUES (?, ?, ?, ?)",
+            (
+                str(value.get("id") or uuid.uuid4()),
+                value.get("text", ""),
+                json.dumps(value.get("embeddings", [])),
+                json.dumps({k: str(v) for k, v in headers.items()}),
+            ),
+        )
+        self.conn.commit()

@@ -186,16 +186,24 @@ class InMemoryVectorStore(DataSource):
 
     _bind = staticmethod(bind_json_query)
 
+    @staticmethod
+    def _coll_name(q: dict[str, Any]) -> str:
+        # "collection-name" accepted as an alias: the reference's sample
+        # queries use it (e.g. its Astra JSON-API shape), and example apps
+        # written against those YAMLs should hit the named collection, not
+        # silently search an empty "default".
+        return q.get("collection") or q.get("collection-name") or "default"
+
     async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
         q = self._bind(query, params)
-        coll = self.collection(q.get("collection", "default"))
+        coll = self.collection(self._coll_name(q))
         return coll.search(
             q.get("vector"), int(q.get("top-k", q.get("topK", 10))), q.get("filter")
         )
 
     async def execute_write(self, query: str, params: list[Any]) -> None:
         q = self._bind(query, params)
-        coll = self.collection(q.get("collection", "default"))
+        coll = self.collection(self._coll_name(q))
         if q.get("delete"):
             coll.delete(q.get("id"))
             return

@@ -345,6 +345,9 @@ def evaluate(
     return _Evaluator(context_names(record, extra)).run(tree)
 
 
+_ACCESSOR_MISS = object()
+
+
 def evaluate_accessor(
     accessor: str, record: MutableRecord, extra: Mapping[str, Any] | None = None
 ) -> Any:
@@ -352,6 +355,15 @@ def evaluate_accessor(
     if re.fullmatch(r"[A-Za-z_][\w]*(\.[\w]+)*", accessor or ""):
         if accessor.split(".", 1)[0] in ("value", "key", "properties", "origin", "timestamp"):
             return record.get_field(accessor)
+    # Dotted paths whose segments contain hyphens (gateway headers like
+    # properties.langstream-client-session-id) are valid field accessors but
+    # would parse as subtraction in the EL; resolve as an accessor first and
+    # only hand genuine misses to the evaluator.
+    if re.fullmatch(r"[A-Za-z_][\w]*(\.[\w][\w-]*)+", accessor or ""):
+        if accessor.split(".", 1)[0] in ("value", "key", "properties", "origin", "timestamp"):
+            hit = record.get_field(accessor, _ACCESSOR_MISS)
+            if hit is not _ACCESSOR_MISS:
+                return hit
     return evaluate(accessor, record, extra)
 
 

@@ -72,6 +72,18 @@ def test_accessor_fast_path():
     assert evaluate_accessor("value.a.b + 1", r) == 2
 
 
+def test_accessor_hyphenated_segments():
+    """Gateway headers like langstream-client-session-id are reachable as
+    dotted accessors; misses still evaluate as EL (subtraction)."""
+    r = rec(
+        value={"a": 7, "b": 3},
+        props={"langstream-client-session-id": "s1"},
+    )
+    assert evaluate_accessor("properties.langstream-client-session-id", r) == "s1"
+    assert evaluate_accessor("value.a - value.b", r) == 4
+    assert evaluate_accessor("value.a-value.b", r) == 4  # miss → EL fallback
+
+
 def test_template_basic():
     r = rec(value={"question": "what?"})
     assert render_template("Q: {{ value.question }}", r) == "Q: what?"
