@@ -405,13 +405,24 @@ class QueryAgent(SingleRecordProcessor):
         params = [
             evaluate_accessor(f, mutable) for f in cfg.get("fields", [])
         ]
+        out_field = cfg.get("output-field", "value.query_results")
+        if cfg.get("mode") == "execute":
+            # writes go through execute_write so the datasource COMMITS
+            # (fetch_data on JDBC leaves an open deferred transaction that
+            # both loses the write on restart and locks the database file);
+            # parity: QueryStep.java's executeStatement mode
+            execute = getattr(self.datasource, "execute_write", None)
+            if execute is not None:
+                await execute(cfg.get("query", ""), params)
+                mutable.set_field(out_field, {"count": 1})
+            else:
+                results = await self.datasource.fetch_data(
+                    cfg.get("query", ""), params
+                )
+                mutable.set_field(out_field, {"count": len(results)})
+            return [mutable.to_record()]
         results = await self.datasource.fetch_data(cfg.get("query", ""), params)
         if cfg.get("only-first"):
             results = results[:1]
-        mutable.set_field(cfg.get("output-field", "value.query_results"), results)
-        if cfg.get("mode") == "execute":
-            mutable.set_field(
-                cfg.get("output-field", "value.query_results"),
-                {"count": len(results)},
-            )
+        mutable.set_field(out_field, results)
         return [mutable.to_record()]

@@ -222,6 +222,15 @@ def _swiglu(x, w_gate, w_up, w_down):
     return jnp.einsum("...i,ih->...h", gate * up, _w(w_down))
 
 
+def _default_ffn(h, lp, valid=None):
+    """The dense SwiGLU FFN sub-block. ``ffn`` hooks on the forward/prefill/
+    decode entry points default to this; the MoE family swaps in its routed
+    expert FFN (models/moe.py) and reuses every attention/cache path here.
+    ``valid`` marks real positions — pointwise FFNs ignore it, routed ones
+    must not let pad/inactive positions consume expert capacity."""
+    return _swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+
+
 def attention_block(config, x, lp, cos, sin, attention):
     """Pre-norm attention sub-block + residual: the piece shared verbatim by
     the dense, MoE, and pipeline-stage forwards (they differ only in FFN and
@@ -251,12 +260,15 @@ def prefill_forward(
     lengths: jax.Array,      # (B,) true lengths
     use_flash: bool | None = None,
     mesh: Mesh | None = None,  # flash under a mesh runs via shard_map
+    ffn=None,                # (h (B,P,H), lp) -> (B,P,H); default dense SwiGLU
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared prompt forward (the single source of the prefill layer math):
     returns (last-token logits (B,V), ks, vs) where ks/vs are the roped
     per-layer K/V ``(L, B, P, Kh, D)`` for the caller's cache layout —
     dense (:func:`llama_prefill`) or paged (``llama_prefill_paged``)."""
     c = config
+    if ffn is None:
+        ffn = _default_ffn
     B, Pn = tokens.shape
     x = embedding_take(params["embed"], tokens)  # (B, P, H)
     positions = jnp.arange(Pn)[None, :].repeat(B, axis=0)
@@ -267,6 +279,9 @@ def prefill_forward(
     causal = q_idx >= k_idx
     valid = k_idx < lengths[:, None, None]  # (B, 1, P) keys within length
     mask = causal[None, :, :] & valid
+    # (B, P) real-token mask for the FFN hook: routed (MoE) FFNs must not
+    # let right-padding consume expert capacity
+    pos_valid = jnp.arange(Pn)[None, :] < lengths[:, None]
     neg = jnp.finfo(jnp.float32).min
 
     flash = _flash_mode(Pn) if use_flash is None else ("compiled" if use_flash else None)
@@ -302,7 +317,7 @@ def prefill_forward(
             out = out.reshape(B, Pn, c.heads * c.head_dim)
         x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
-        x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + ffn(h2, lp, pos_valid)
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
@@ -325,6 +340,7 @@ def llama_prefill(
     slot_ids: jax.Array,     # (B,) which cache slots to fill
     use_flash: bool | None = None,  # None = auto (LS_TPU_FLASH)
     mesh: Mesh | None = None,  # kernel runs per-shard via shard_map
+    ffn=None,                # pluggable FFN sub-block (MoE family hook)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process prompts, fill the KV cache, return last-token logits (B, V).
 
@@ -334,7 +350,7 @@ def llama_prefill(
     """
     Pn = tokens.shape[1]
     logits, ks, vs = prefill_forward(
-        config, params, tokens, lengths, use_flash, mesh=mesh
+        config, params, tokens, lengths, use_flash, mesh=mesh, ffn=ffn
     )
     new_k = cache_k.at[:, slot_ids, :Pn].set(ks)
     new_v = cache_v.at[:, slot_ids, :Pn].set(vs)
@@ -353,6 +369,7 @@ def llama_decode_step(
     lengths: jax.Array,    # (B,) tokens already in cache per slot
     cache_k: jax.Array,    # (L, B, S, K, D)
     cache_v: jax.Array,
+    ffn=None,              # (h (B,H), lp) -> (B,H); default dense SwiGLU
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for every slot; returns logits (B, V) + new caches.
 
@@ -361,6 +378,8 @@ def llama_decode_step(
     logits the engine ignores (no dynamic shapes).
     """
     c = config
+    if ffn is None:
+        ffn = _default_ffn
     B = tokens.shape[0]
     S = cache_k.shape[2]
     x = embedding_take(params["embed"], tokens)  # (B, H)
@@ -391,7 +410,7 @@ def llama_decode_step(
         out = out.reshape(B, c.heads * c.head_dim)
         x = x + out @ _w(lp["wo"])
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
-        x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + ffn(h2, lp)
         return x, (ck_l, cv_l)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -418,6 +437,7 @@ def llama_decode_chunk(
                                 # smallest bucket covering max(base_lengths),
                                 # so short sequences don't pay full-S HBM
                                 # traffic (decode is cache-read bound)
+    ffn=None,                   # (h (B,H), lp) -> (B,H); default dense SwiGLU
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps with a two-segment KV layout.
 
@@ -432,6 +452,8 @@ def llama_decode_chunk(
     final_lengths, cache_k, cache_v) with the buffer committed.
     """
     c = config
+    if ffn is None:
+        ffn = _default_ffn
     B = tokens0.shape[0]
     full_k, full_v = cache_k, cache_v
     if window is not None and window < cache_k.shape[2]:
@@ -486,7 +508,7 @@ def llama_decode_chunk(
             out = out.reshape(B, c.heads * c.head_dim)
             x = x + out @ _w(lp["wo"])
             h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
-            x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+            x = x + ffn(h2, lp, active)
             return x, (kbuf_l, vbuf_l)
 
         x, (kbuf, vbuf) = jax.lax.scan(

@@ -23,9 +23,9 @@ import jax.numpy as jnp
 from langstream_tpu.models.llama import (
     LlamaConfig,
     _apply_rope,
+    _default_ffn,
     _rms_norm,
     _rope,
-    _swiglu,
 )
 from langstream_tpu.models.paged import gather_kv, write_rows
 from langstream_tpu.models.quant import as_weight as _w, embedding_take
@@ -46,6 +46,7 @@ def llama_prefill_paged(
     block_tables: jax.Array,  # (B, max_blocks) int32 — rows for THIS batch
     use_flash: bool | None = None,
     mesh=None,
+    ffn=None,                 # pluggable FFN sub-block (MoE family hook)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prompt forward + paged cache fill: the shared
     :func:`~langstream_tpu.models.llama.prefill_forward` layer math with the
@@ -54,7 +55,9 @@ def llama_prefill_paged(
 
     c = config
     B, Pn = tokens.shape
-    logits, ks, vs = prefill_forward(c, params, tokens, lengths, use_flash, mesh=mesh)
+    logits, ks, vs = prefill_forward(
+        c, params, tokens, lengths, use_flash, mesh=mesh, ffn=ffn
+    )
     KhD = c.kv_heads * c.head_dim
     L = ks.shape[0]
     valid = (jnp.arange(Pn)[None, :] < lengths[:, None])
@@ -117,11 +120,14 @@ def llama_decode_chunk_paged(
     num_read_blocks: int,     # static block-sweep bucket (covers max length)
     kernel: str = "xla",      # "xla" | "pallas" | "pallas-interpret"
     mesh=None,                # Pallas kernel runs per-shard via shard_map
+    ffn=None,                 # (h (B,H), lp) -> (B,H); default dense SwiGLU
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps against the paged pool; same two-segment
     discipline as the dense ``llama_decode_chunk`` (pool read-only, new K/V
     in a chunk buffer, one scatter commit at the end)."""
     c = config
+    if ffn is None:
+        ffn = _default_ffn
     B = tokens0.shape[0]
     KhD = c.kv_heads * c.head_dim
     adv = active.astype(jnp.int32)
@@ -221,7 +227,7 @@ def llama_decode_chunk_paged(
             out = out.reshape(B, c.heads * c.head_dim)
             x = x + out @ _w(lp["wo"])
             h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
-            x = x + _swiglu(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+            x = x + ffn(h2, lp, active)
             return x, (kbuf_l, vbuf_l)
 
         x, (kbuf, vbuf) = jax.lax.scan(
@@ -265,6 +271,7 @@ def llama_decode_chunk_dense_pallas(
     window: int | None,
     kernel: str = "pallas",
     block_size: int = 128,
+    ffn=None,                 # pluggable FFN sub-block (MoE family hook)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Dense-cache decode through the PAGED Pallas read kernel.
 
@@ -292,7 +299,7 @@ def llama_decode_chunk_dense_pallas(
     out = llama_decode_chunk_paged(
         c, params, tokens0, base_lengths, active, pool_k, pool_v, tables,
         sample_fn, key, num_steps, num_read_blocks=num_read_blocks,
-        kernel=kernel,
+        kernel=kernel, ffn=ffn,
     )
     chunk_t, chunk_lp, final_t, final_l, pk, pv = out
     return (

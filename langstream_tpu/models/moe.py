@@ -154,22 +154,33 @@ def shard_moe_params(params: dict, config: MoEConfig, mesh: Mesh) -> dict:
 def top2_gating(
     router_logits: jax.Array,  # (B, S, E) float32
     capacity: int,
+    valid: jax.Array | None = None,  # (B, S) bool; invalid positions take no
+                                     # capacity and get zero combine weight
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """GShard top-2 gating with static capacity.
 
     Returns (dispatch (B,S,E,C) bool, combine (B,S,E,C) float32,
     aux_loss scalar — the load-balancing loss from the GShard/Switch papers).
+
+    ``valid`` matters under serving: right-padded prefill positions and
+    inactive decode slots would otherwise queue for (and evict real tokens
+    from) expert capacity, making a prompt's logits depend on its batch
+    neighbours' padding.
     """
     B, S, E = router_logits.shape
     probs = jax.nn.softmax(router_logits, axis=-1)  # (B, S, E)
 
     idx1 = jnp.argmax(probs, axis=-1)                       # (B, S)
     mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)      # (B, S, E)
+    if valid is not None:
+        mask1 = mask1 * valid[..., None].astype(probs.dtype)
     p1 = jnp.sum(probs * mask1, axis=-1)                    # (B, S)
 
     probs2 = probs * (1.0 - mask1)
     idx2 = jnp.argmax(probs2, axis=-1)
     mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
+    if valid is not None:
+        mask2 = mask2 * valid[..., None].astype(probs.dtype)
     p2 = jnp.sum(probs * mask2, axis=-1)
 
     # renormalise the two winners (Mixtral semantics)
@@ -208,6 +219,7 @@ def moe_ffn(
     w_down: jax.Array,       # (E, I, H)
     capacity: int,
     ep_constrain=None,       # applied to (E, C', H) expert-major tensors
+    valid: jax.Array | None = None,  # (B, S) bool — see top2_gating
 ) -> tuple[jax.Array, jax.Array]:
     """Top-2 MoE feed-forward; returns (output (B,S,H), aux_loss).
 
@@ -218,7 +230,7 @@ def moe_ffn(
     router_logits = jnp.einsum(
         "bsh,he->bse", x.astype(jnp.float32), router_w
     )
-    dispatch, combine, aux = top2_gating(router_logits, capacity)
+    dispatch, combine, aux = top2_gating(router_logits, capacity, valid=valid)
     dispatch = dispatch.astype(x.dtype)
     if ep_constrain is None:
         ep_constrain = lambda t: t  # noqa: E731
@@ -303,6 +315,41 @@ def moe_forward_sharded(
         constrain=lambda x: jax.lax.with_sharding_constraint(x, x_spec),
         ep_constrain=lambda t: jax.lax.with_sharding_constraint(t, e_spec),
     )
+
+
+def moe_serving_ffn(config: MoEConfig, ep_constrain=None):
+    """FFN callback for the shared llama serving paths (prefill_forward /
+    llama_decode_chunk / the paged twins): routes each position through the
+    top-2 expert mix. Accepts ``(B, H)`` decode activations or ``(B, S, H)``
+    prefill activations; understands int8-quantized expert weights.
+
+    This is what makes MoE a *served* family, not just a trainable one —
+    the reference can only reach MoE models through SaaS providers
+    (``HuggingFaceProvider.java:47``); here Mixtral-class models run on the
+    same continuous-batching engine as the dense Llamas.
+    """
+    from langstream_tpu.models.quant import as_weight
+
+    def ffn(h: jax.Array, lp: dict, valid: jax.Array | None = None) -> jax.Array:
+        squeeze = h.ndim == 2
+        x = h[:, None, :] if squeeze else h
+        if valid is not None and valid.ndim == 1:
+            valid = valid[:, None]  # decode: (B,) active → (B, 1)
+        B, S, _H = x.shape
+        capacity = config.capacity(B * S)
+        out, _aux = moe_ffn(
+            x,
+            lp["router"],
+            as_weight(lp["w_gate"]),
+            as_weight(lp["w_up"]),
+            as_weight(lp["w_down"]),
+            capacity,
+            ep_constrain=ep_constrain,
+            valid=valid,
+        )
+        return out[:, 0, :] if squeeze else out
+
+    return ffn
 
 
 def moe_param_count(config: MoEConfig) -> int:

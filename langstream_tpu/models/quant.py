@@ -127,3 +127,29 @@ def quantize_llama_params(params: dict) -> dict:
         "final_norm": params["final_norm"],
         "lm_head": quantize_tensor(params["lm_head"], axis=0),
     }
+
+
+def quantize_moe_params(params: dict) -> dict:
+    """MoE twin of :func:`quantize_llama_params`: attention/embed/lm_head as
+    the dense model; expert weights per-(layer, expert, output-channel); the
+    router stays float32 (tiny, and routing decisions are numerically
+    delicate — see ``init_moe_params``)."""
+    layers = params["layers"]
+    return {
+        "embed": quantize_tensor(params["embed"], axis=1),
+        "layers": {
+            "attn_norm": layers["attn_norm"],
+            "wq": quantize_tensor(layers["wq"], axis=1),
+            "wk": quantize_tensor(layers["wk"], axis=1),
+            "wv": quantize_tensor(layers["wv"], axis=1),
+            "wo": quantize_tensor(layers["wo"], axis=1),
+            "mlp_norm": layers["mlp_norm"],
+            "router": layers["router"],
+            # (L, E, H, I) contract H; (L, E, I, H) contract I
+            "w_gate": quantize_tensor(layers["w_gate"], axis=2),
+            "w_up": quantize_tensor(layers["w_up"], axis=2),
+            "w_down": quantize_tensor(layers["w_down"], axis=2),
+        },
+        "final_norm": params["final_norm"],
+        "lm_head": quantize_tensor(params["lm_head"], axis=0),
+    }

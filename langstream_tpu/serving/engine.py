@@ -70,6 +70,29 @@ _MODEL_CONFIGS = {
     "llama-3-70b": LlamaConfig.llama3_70b,
 }
 
+# MoE (Mixtral-family) models serve on the same engine: identical attention
+# and cache geometry, routed-expert FFN plugged into the shared layer math
+# (models/moe.py `moe_serving_ffn`). Lazy: moe.py imports only when used.
+_MOE_MODELS = ("moe-tiny", "moe-8x7b", "mixtral-8x7b")
+
+
+def _resolve_model_config(name: str, max_seq_len: int):
+    if name in _MOE_MODELS:
+        from langstream_tpu.models.moe import MoEConfig
+
+        factory = {
+            "moe-tiny": MoEConfig.tiny,
+            "moe-8x7b": MoEConfig.mixtral_8x7b,
+            "mixtral-8x7b": MoEConfig.mixtral_8x7b,
+        }[name]
+        return factory(max_seq_len=max_seq_len)
+    if name not in _MODEL_CONFIGS:
+        raise ValueError(
+            f"unknown model {name!r}; known: "
+            f"{sorted(_MODEL_CONFIGS) + sorted(_MOE_MODELS)}"
+        )
+    return _MODEL_CONFIGS[name](max_seq_len=max_seq_len)
+
 
 @dataclasses.dataclass(frozen=True)
 class ServingConfig:
@@ -216,13 +239,10 @@ class TpuServingEngine:
 
     def __init__(self, config: ServingConfig, lockstep_role: str | None = None):
         self.config = config
-        if config.model not in _MODEL_CONFIGS:
-            raise ValueError(
-                f"unknown model {config.model!r}; known: {sorted(_MODEL_CONFIGS)}"
-            )
-        self.model_config: LlamaConfig = _MODEL_CONFIGS[config.model](
-            max_seq_len=config.max_seq_len
+        self.model_config = _resolve_model_config(
+            config.model, config.max_seq_len
         )
+        self.is_moe = config.model in _MOE_MODELS
         self.tokenizer: Tokenizer = load_tokenizer(config.tokenizer)
         if self.tokenizer.vocab_size > self.model_config.vocab_size:
             raise ValueError(
@@ -313,7 +333,34 @@ class TpuServingEngine:
 
     def _init_model(self) -> None:
         mc = self.model_config
-        if self.config.checkpoint:
+        self._ffn = None  # default dense SwiGLU inside the llama layer math
+        if self.is_moe:
+            from langstream_tpu.models.moe import init_moe_params, moe_serving_ffn
+
+            ep_constrain = None
+            if self.mesh is not None and "ep" in self.mesh.axis_names:
+                # pin expert-major (E, C, H) intermediates to the ep axis so
+                # GSPMD resolves the flanking einsums as token all-to-alls
+                # over ICI instead of all-gathering the expert weights
+                # (mirrors moe_forward_sharded's training-side constraints)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                e_spec = NamedSharding(self.mesh, P("ep", None, None))
+                ep_constrain = lambda t: jax.lax.with_sharding_constraint(  # noqa: E731
+                    t, e_spec
+                )
+            self._ffn = moe_serving_ffn(mc, ep_constrain=ep_constrain)
+            if self.config.checkpoint:
+                raise ValueError(
+                    "MoE checkpoint loading is not implemented yet; remove "
+                    "'checkpoint' or use a dense model"
+                )
+            log.warning(
+                "model %r: using random-init weights (offline/dev mode)",
+                self.config.model,
+            )
+            self.params = init_moe_params(mc)
+        elif self.config.checkpoint:
             from langstream_tpu.models.checkpoints import load_llama_checkpoint
 
             self.params = load_llama_checkpoint(self.config.checkpoint, mc)
@@ -324,9 +371,13 @@ class TpuServingEngine:
             )
             self.params = init_llama_params(mc)
         if self.config.quantize == "int8":
-            from langstream_tpu.models.quant import quantize_llama_params
+            from langstream_tpu.models.quant import (
+                quantize_llama_params,
+                quantize_moe_params,
+            )
 
-            self.params = quantize_llama_params(self.params)
+            quantize = quantize_moe_params if self.is_moe else quantize_llama_params
+            self.params = quantize(self.params)
         elif self.config.quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {self.config.quantize!r}")
 
@@ -390,7 +441,46 @@ class TpuServingEngine:
             from langstream_tpu.models.quant import quantize_specs
             from langstream_tpu.parallel.mesh import put_global
 
-            specs = quantize_specs(llama_param_specs(mc), self.params)
+            if self.is_moe:
+                from langstream_tpu.models.moe import moe_param_specs
+
+                base_specs = moe_param_specs(mc)
+            else:
+                base_specs = llama_param_specs(mc)
+            # ONLY the optional "ep" axis is forgiven when absent (an MoE
+            # engine on a pure-tp mesh keeps experts replicated — a
+            # legitimate, if memory-hungry, layout). Any other missing spec
+            # axis is a misconfigured mesh and must fail loudly, not
+            # silently replicate the weights.
+            axes = set(self.mesh.axis_names)
+
+            def _present(entry):
+                if entry is None:
+                    return None
+                names = entry if isinstance(entry, tuple) else (entry,)
+                missing = [a for a in names if a not in axes]
+                for a in missing:
+                    if a != "ep":
+                        raise ValueError(
+                            f"model {self.config.model!r} shards over mesh "
+                            f"axis {a!r} but the configured mesh has axes "
+                            f"{sorted(axes)}; add {a!r} to the mesh"
+                        )
+                    log.warning(
+                        "mesh has no 'ep' axis: expert weights will be "
+                        "replicated on every device"
+                    )
+                kept = tuple(a for a in names if a in axes)
+                if isinstance(entry, tuple):
+                    return kept or None
+                return kept[0] if kept else None
+
+            base_specs = jax.tree.map(
+                lambda p: P(*(_present(e) for e in p)) if isinstance(p, P) else p,
+                base_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            specs = quantize_specs(base_specs, self.params)
             self.params = jax.tree.map(
                 lambda p, s: put_global(p, NamedSharding(self.mesh, s)),
                 self.params,
@@ -412,6 +502,7 @@ class TpuServingEngine:
         self.cache_k, self.cache_v = cache_k, cache_v
 
         mc_static = mc
+        ffn_static = self._ffn  # None = dense SwiGLU; MoE routes experts
         K = self.config.decode_chunk
 
         # sampled tokens/logprobs come back to the leader host every chunk;
@@ -468,7 +559,7 @@ class TpuServingEngine:
                         cache_k, cache_v, tables, sample_fn, key, K,
                         num_read_blocks=window,
                         kernel=self.paged_read_kernel,
-                        mesh=mesh_static,
+                        mesh=mesh_static, ffn=ffn_static,
                     )
                     return _fetchable(out[0], out[1]) + out[2:]
 
@@ -494,13 +585,14 @@ class TpuServingEngine:
                         cache_k, cache_v, _sample_fn_for(temps, topks, topps),
                         key, K,
                         window=window, kernel=self.dense_read_kernel,
+                        ffn=ffn_static,
                     )
                     return _fetchable(out[0], out[1]) + out[2:]
 
                 out = llama_decode_chunk(
                     mc_static, params, tokens, lengths, active,
                     cache_k, cache_v, _sample_fn_for(temps, topks, topps),
-                    key, K, window=window,
+                    key, K, window=window, ffn=ffn_static,
                 )
                 return _fetchable(out[0], out[1]) + out[2:]
 
@@ -521,6 +613,7 @@ class TpuServingEngine:
                     logits, ck, cv = llama_prefill_paged(
                         mc_static, params, tokens, lengths, cache_k, cache_v,
                         tables, use_flash=prefill_flash, mesh=mesh_static,
+                        ffn=ffn_static,
                     )
                     next_tokens, logprobs = _fetchable(
                         *sample_tokens(
@@ -538,7 +631,7 @@ class TpuServingEngine:
                          key, temps, topks, topps):
                 logits, ck, cv = llama_prefill(
                     mc_static, params, tokens, lengths, cache_k, cache_v, slot_ids,
-                    use_flash=prefill_flash, mesh=mesh_static,
+                    use_flash=prefill_flash, mesh=mesh_static, ffn=ffn_static,
                 )
                 next_tokens, logprobs = _fetchable(
                     *sample_tokens(

@@ -396,3 +396,45 @@ def test_opensearch_doc_crud_and_errors(run_async):
             await fake.stop()
 
     run_async(main())
+
+
+def test_query_agent_execute_mode_commits(run_async, tmp_path):
+    """mode: execute must route through DataSource.execute_write so the
+    write COMMITS — fetch_data would leave sqlite in an open deferred
+    transaction (write lost on restart, database file locked for every
+    other connection). Proven by reading through a second connection."""
+    import sqlite3
+
+    from langstream_tpu.agents.ai import QueryAgent
+    from langstream_tpu.api.record import make_record
+
+    db = str(tmp_path / "exec.db")
+    sqlite3.connect(db).executescript(
+        "CREATE TABLE notes (body TEXT); "
+    )
+
+    async def main():
+        agent = QueryAgent()
+        await agent.init(
+            {
+                "datasource": "db",
+                "mode": "execute",
+                "query": "INSERT INTO notes (body) VALUES (?)",
+                "fields": ["value.body"],
+                "output-field": "value.stored",
+                "__resources__": {
+                    "db": {
+                        "type": "datasource",
+                        "name": "db",
+                        "configuration": {"service": "jdbc", "url": db},
+                    }
+                },
+            }
+        )
+        out = await agent.process_record(make_record(value={"body": "hello"}))
+        assert out[0].value["stored"] == {"count": 1}
+        # an INDEPENDENT connection must see the committed row
+        rows = sqlite3.connect(db).execute("SELECT body FROM notes").fetchall()
+        assert rows == [("hello",)]
+
+    run_async(main())
