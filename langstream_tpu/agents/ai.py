@@ -413,8 +413,12 @@ class QueryAgent(SingleRecordProcessor):
             # parity: QueryStep.java's executeStatement mode
             execute = getattr(self.datasource, "execute_write", None)
             if execute is not None:
-                await execute(cfg.get("query", ""), params)
-                mutable.set_field(out_field, {"count": 1})
+                affected = await execute(cfg.get("query", ""), params)
+                # datasources that can't report affected rows return None
+                mutable.set_field(
+                    out_field,
+                    {"count": affected if isinstance(affected, int) and affected >= 0 else 1},
+                )
             else:
                 results = await self.datasource.fetch_data(
                     cfg.get("query", ""), params

@@ -151,13 +151,16 @@ class JdbcDataSource(DataSource):
                         pass
         return rows
 
-    async def execute_write(self, query: str, params: list[Any]) -> None:
+    async def execute_write(self, query: str, params: list[Any]) -> int:
+        """Run a DML statement, commit, return the affected-row count."""
+
         def go():
             conn = self._conn()
-            conn.execute(query, [self._to_sql(p) for p in params])
+            cur = conn.execute(query, [self._to_sql(p) for p in params])
             conn.commit()
+            return cur.rowcount
 
-        await self._run(go)
+        return await self._run(go)
 
     async def executemany(self, query: str, rows: list[list[Any]]) -> None:
         def go():

@@ -1,19 +1,24 @@
-"""Checkpoint loading for Llama-family weights (local files only).
+"""Checkpoint loading for Llama- and Mixtral-family weights (local files).
 
 Supports HF-format directories (``*.safetensors`` or ``pytorch_model*.bin``)
-with standard Llama tensor names, converted into our stacked-layer layout.
-No network egress exists in this environment, so loading is gated on the
-files being present; the serving engine falls back to random init otherwise.
+with standard Llama/Mixtral tensor names, converted into our stacked-layer
+layout. No network egress exists in this environment, so loading is gated on
+the files being present; the serving engine falls back to random init
+otherwise.
 """
 
 from __future__ import annotations
 
 from pathlib import Path
+from typing import TYPE_CHECKING
 
 import jax.numpy as jnp
 import numpy as np
 
 from langstream_tpu.models.llama import LlamaConfig
+
+if TYPE_CHECKING:
+    from langstream_tpu.models.moe import MoEConfig
 
 
 def _load_state_dict(path: Path) -> dict:
@@ -42,6 +47,74 @@ def _load_state_dict(path: Path) -> dict:
     raise FileNotFoundError(f"no weight files under {path}")
 
 
+# shared HF↔ours conventions (used by both the Llama and Mixtral pairs —
+# attention tensors are identical across the two families)
+
+_ATTN_NAMES = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+}
+
+
+def _torch_tensor(a: np.ndarray, transpose: bool = True):
+    import torch
+
+    a = a.astype(np.float32, copy=False)
+    return torch.from_numpy(a.T.copy() if transpose else a.copy())
+
+
+def _getter(state: dict):
+    """Resolve a tensor by name, tolerating the ``model.`` prefix."""
+
+    def g(name: str) -> np.ndarray:
+        key = name if name in state else f"model.{name}"
+        return np.asarray(state[key])
+
+    return g
+
+
+def _stack_layers(g, fmt: str, layers: int, dt, transpose: bool = True):
+    mats = []
+    for i in range(layers):
+        m = g(fmt.format(i=i))
+        mats.append(m.T if transpose else m)
+    return jnp.asarray(np.stack(mats), dtype=dt)
+
+
+def _load_attn_layers(g, layers: int, dt) -> dict:
+    return {
+        ours: _stack_layers(g, "layers.{i}." + hf, layers, dt, transpose)
+        for ours, (hf, transpose) in _ATTN_NAMES.items()
+    }
+
+
+def _load_head_tensors(state: dict, g, dt) -> dict:
+    return {
+        "embed": jnp.asarray(g("embed_tokens.weight"), dtype=dt),
+        "final_norm": jnp.asarray(g("norm.weight"), dtype=dt),
+        "lm_head": jnp.asarray(
+            np.asarray(state.get("lm_head.weight", g("embed_tokens.weight"))).T,
+            dtype=dt,
+        ),
+    }
+
+
+def _save_head_tensors(params: dict) -> dict:
+    return {
+        "model.embed_tokens.weight": _torch_tensor(
+            np.asarray(params["embed"]), transpose=False
+        ),
+        "model.norm.weight": _torch_tensor(
+            np.asarray(params["final_norm"]), transpose=False
+        ),
+        "lm_head.weight": _torch_tensor(np.asarray(params["lm_head"])),
+    }
+
+
 def save_llama_checkpoint(
     params: dict, config: LlamaConfig, checkpoint_dir: str
 ) -> None:
@@ -58,24 +131,9 @@ def save_llama_checkpoint(
     c = config
     layers = params["layers"]
 
-    def t(a: np.ndarray, transpose: bool = True) -> "torch.Tensor":
-        a = a.astype(np.float32, copy=False)
-        return torch.from_numpy(a.T.copy() if transpose else a.copy())
-
-    state: dict = {
-        "model.embed_tokens.weight": t(
-            np.asarray(params["embed"]), transpose=False
-        ),
-        "model.norm.weight": t(np.asarray(params["final_norm"]), transpose=False),
-        "lm_head.weight": t(np.asarray(params["lm_head"])),
-    }
+    state = _save_head_tensors(params)
     names = {
-        "attn_norm": ("input_layernorm.weight", False),
-        "wq": ("self_attn.q_proj.weight", True),
-        "wk": ("self_attn.k_proj.weight", True),
-        "wv": ("self_attn.v_proj.weight", True),
-        "wo": ("self_attn.o_proj.weight", True),
-        "mlp_norm": ("post_attention_layernorm.weight", False),
+        **_ATTN_NAMES,
         "w_gate": ("mlp.gate_proj.weight", True),
         "w_up": ("mlp.up_proj.weight", True),
         "w_down": ("mlp.down_proj.weight", True),
@@ -85,7 +143,7 @@ def save_llama_checkpoint(
     host = {ours: np.asarray(layers[ours]) for ours in names}
     for i in range(c.layers):
         for ours, (hf_name, transpose) in names.items():
-            state[f"model.layers.{i}.{hf_name}"] = t(
+            state[f"model.layers.{i}.{hf_name}"] = _torch_tensor(
                 host[ours][i], transpose=transpose
             )
     torch.save(state, path / "pytorch_model.bin")
@@ -113,38 +171,141 @@ def save_llama_checkpoint(
 
 
 def load_llama_checkpoint(checkpoint_dir: str, config: LlamaConfig) -> dict:
-    path = Path(checkpoint_dir)
-    state = _load_state_dict(path)
+    state = _load_state_dict(Path(checkpoint_dir))
     c = config
     dt = c.dtype
+    g = _getter(state)
+    head = _load_head_tensors(state, g, dt)
+    return {
+        "embed": head["embed"],
+        "layers": {
+            **_load_attn_layers(g, c.layers, dt),
+            "w_gate": _stack_layers(g, "layers.{i}.mlp.gate_proj.weight", c.layers, dt),
+            "w_up": _stack_layers(g, "layers.{i}.mlp.up_proj.weight", c.layers, dt),
+            "w_down": _stack_layers(g, "layers.{i}.mlp.down_proj.weight", c.layers, dt),
+        },
+        "final_norm": head["final_norm"],
+        "lm_head": head["lm_head"],
+    }
 
-    def g(name: str) -> np.ndarray:
-        key = name if name in state else f"model.{name}"
-        return np.asarray(state[key])
 
-    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
-        mats = []
-        for i in range(c.layers):
-            m = g(fmt.format(i=i))
-            mats.append(m.T if transpose else m)
-        return jnp.asarray(np.stack(mats), dtype=dt)
+# ---------------------------------------------------------------------------
+# Mixtral (MoE) checkpoints
+# ---------------------------------------------------------------------------
+
+# HF Mixtral layout ↔ ours: attention tensors match Llama; the FFN becomes
+# block_sparse_moe — gate.weight (E, H) is the router, and each expert e has
+# w1 (gate, I×H), w2 (down, H×I), w3 (up, I×H). Ours stacks them as
+# w_gate/w_up (L, E, H, I) and w_down (L, E, I, H); router (L, H, E) f32.
+
+
+def save_moe_checkpoint(
+    params: dict, config: "MoEConfig", checkpoint_dir: str
+) -> None:
+    """HF-Mixtral-format writer — the inverse of :func:`load_moe_checkpoint`
+    so MoE checkpoints round-trip with the HF ecosystem."""
+    import json
+
+    import torch
+
+    path = Path(checkpoint_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    c = config
+    layers = params["layers"]
+
+    state = _save_head_tensors(params)
+    host = {ours: np.asarray(layers[ours]) for ours in _ATTN_NAMES}
+    router = np.asarray(layers["router"])          # (L, H, E)
+    w_gate = np.asarray(layers["w_gate"])          # (L, E, H, I)
+    w_up = np.asarray(layers["w_up"])
+    w_down = np.asarray(layers["w_down"])          # (L, E, I, H)
+    for i in range(c.layers):
+        for ours, (hf_name, transpose) in _ATTN_NAMES.items():
+            state[f"model.layers.{i}.{hf_name}"] = _torch_tensor(
+                host[ours][i], transpose
+            )
+        state[f"model.layers.{i}.block_sparse_moe.gate.weight"] = _torch_tensor(
+            router[i]
+        )
+        for e in range(c.experts):
+            base = f"model.layers.{i}.block_sparse_moe.experts.{e}"
+            state[f"{base}.w1.weight"] = _torch_tensor(w_gate[i, e])
+            state[f"{base}.w3.weight"] = _torch_tensor(w_up[i, e])
+            state[f"{base}.w2.weight"] = _torch_tensor(w_down[i, e])
+    torch.save(state, path / "pytorch_model.bin")
+    (path / "config.json").write_text(
+        json.dumps(
+            {
+                "architectures": ["MixtralForCausalLM"],
+                "model_type": "mixtral",
+                "vocab_size": c.vocab_size,
+                "hidden_size": c.hidden,
+                "num_hidden_layers": c.layers,
+                "num_attention_heads": c.heads,
+                "num_key_value_heads": c.kv_heads,
+                "head_dim": c.head_dim,
+                "intermediate_size": c.moe_intermediate,
+                "num_local_experts": c.experts,
+                "num_experts_per_tok": c.experts_per_token,
+                "rope_theta": c.rope_theta,
+                "rms_norm_eps": c.norm_eps,
+                "max_position_embeddings": c.max_seq_len,
+                "tie_word_embeddings": False,
+                "torch_dtype": "float32",
+            },
+            indent=2,
+        )
+    )
+
+
+def load_moe_checkpoint(checkpoint_dir: str, config: "MoEConfig") -> dict:
+    state = _load_state_dict(Path(checkpoint_dir))
+    c = config
+    dt = c.dtype
+    g = _getter(state)
+    head = _load_head_tensors(state, g, dt)
+
+    def stack_experts(w: str) -> jnp.ndarray:
+        # (L, E, in, out): HF stores each expert as (out, in). Cast each
+        # expert matrix to the model dtype as it is read — stacking a full
+        # mixtral-8x7b expert tensor in f32 first would add ~60 GB of peak
+        # host memory per projection.
+        return jnp.stack(
+            [
+                jnp.stack(
+                    [
+                        jnp.asarray(
+                            g(
+                                f"layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
+                            ).T,
+                            dtype=dt,
+                        )
+                        for e in range(c.experts)
+                    ]
+                )
+                for i in range(c.layers)
+            ]
+        )
 
     return {
-        "embed": jnp.asarray(g("embed_tokens.weight"), dtype=dt),
+        "embed": head["embed"],
         "layers": {
-            "attn_norm": stack("layers.{i}.input_layernorm.weight", transpose=False),
-            "wq": stack("layers.{i}.self_attn.q_proj.weight"),
-            "wk": stack("layers.{i}.self_attn.k_proj.weight"),
-            "wv": stack("layers.{i}.self_attn.v_proj.weight"),
-            "wo": stack("layers.{i}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight", transpose=False),
-            "w_gate": stack("layers.{i}.mlp.gate_proj.weight"),
-            "w_up": stack("layers.{i}.mlp.up_proj.weight"),
-            "w_down": stack("layers.{i}.mlp.down_proj.weight"),
+            **_load_attn_layers(g, c.layers, dt),
+            # router stays float32 (routing decisions are numerically
+            # delicate — matches init_moe_params)
+            "router": jnp.asarray(
+                np.stack(
+                    [
+                        g(f"layers.{i}.block_sparse_moe.gate.weight").T
+                        for i in range(c.layers)
+                    ]
+                ),
+                dtype=jnp.float32,
+            ),
+            "w_gate": stack_experts("w1"),
+            "w_up": stack_experts("w3"),
+            "w_down": stack_experts("w2"),
         },
-        "final_norm": jnp.asarray(g("norm.weight"), dtype=dt),
-        "lm_head": jnp.asarray(
-            np.asarray(state.get("lm_head.weight", g("embed_tokens.weight"))).T,
-            dtype=dt,
-        ),
+        "final_norm": head["final_norm"],
+        "lm_head": head["lm_head"],
     }

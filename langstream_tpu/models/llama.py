@@ -260,7 +260,8 @@ def prefill_forward(
     lengths: jax.Array,      # (B,) true lengths
     use_flash: bool | None = None,
     mesh: Mesh | None = None,  # flash under a mesh runs via shard_map
-    ffn=None,                # (h (B,P,H), lp) -> (B,P,H); default dense SwiGLU
+    ffn=None,                # (h (B,P,H), lp, valid=None) -> (B,P,H);
+                             # default dense SwiGLU
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared prompt forward (the single source of the prefill layer math):
     returns (last-token logits (B,V), ks, vs) where ks/vs are the roped
@@ -369,17 +370,23 @@ def llama_decode_step(
     lengths: jax.Array,    # (B,) tokens already in cache per slot
     cache_k: jax.Array,    # (L, B, S, K, D)
     cache_v: jax.Array,
-    ffn=None,              # (h (B,H), lp) -> (B,H); default dense SwiGLU
+    ffn=None,              # (h (B,H), lp, valid=None) -> (B,H); default SwiGLU
+    active: jax.Array | None = None,  # (B,) bool — forwarded to the FFN hook
+                                      # so routed (MoE) FFNs don't let dead
+                                      # slots consume expert capacity
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for every slot; returns logits (B, V) + new caches.
 
     The new K/V is written at position ``lengths`` per slot; attention spans
-    positions 0..lengths inclusive. Inactive slots simply produce garbage
-    logits the engine ignores (no dynamic shapes).
+    positions 0..lengths inclusive. Inactive slots produce garbage logits
+    the engine ignores (no dynamic shapes) — but with a routed FFN pass
+    ``active`` too, or dead slots' garbage competes for expert capacity.
     """
     c = config
     if ffn is None:
         ffn = _default_ffn
+    if active is None:
+        active = jnp.ones(tokens.shape[0], dtype=bool)
     B = tokens.shape[0]
     S = cache_k.shape[2]
     x = embedding_take(params["embed"], tokens)  # (B, H)
@@ -410,7 +417,7 @@ def llama_decode_step(
         out = out.reshape(B, c.heads * c.head_dim)
         x = x + out @ _w(lp["wo"])
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
-        x = x + ffn(h2, lp)
+        x = x + ffn(h2, lp, active)
         return x, (ck_l, cv_l)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -437,7 +444,8 @@ def llama_decode_chunk(
                                 # smallest bucket covering max(base_lengths),
                                 # so short sequences don't pay full-S HBM
                                 # traffic (decode is cache-read bound)
-    ffn=None,                   # (h (B,H), lp) -> (B,H); default dense SwiGLU
+    ffn=None,                   # (h (B,H), lp, valid=None) -> (B,H);
+                                # default dense SwiGLU
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps with a two-segment KV layout.
 

@@ -120,7 +120,8 @@ def llama_decode_chunk_paged(
     num_read_blocks: int,     # static block-sweep bucket (covers max length)
     kernel: str = "xla",      # "xla" | "pallas" | "pallas-interpret"
     mesh=None,                # Pallas kernel runs per-shard via shard_map
-    ffn=None,                 # (h (B,H), lp) -> (B,H); default dense SwiGLU
+    ffn=None,                 # (h (B,H), lp, valid=None) -> (B,H);
+                              # default dense SwiGLU
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps against the paged pool; same two-segment
     discipline as the dense ``llama_decode_chunk`` (pool read-only, new K/V

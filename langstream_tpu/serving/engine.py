@@ -351,15 +351,15 @@ class TpuServingEngine:
                 )
             self._ffn = moe_serving_ffn(mc, ep_constrain=ep_constrain)
             if self.config.checkpoint:
-                raise ValueError(
-                    "MoE checkpoint loading is not implemented yet; remove "
-                    "'checkpoint' or use a dense model"
+                from langstream_tpu.models.checkpoints import load_moe_checkpoint
+
+                self.params = load_moe_checkpoint(self.config.checkpoint, mc)
+            else:
+                log.warning(
+                    "model %r: using random-init weights (offline/dev mode)",
+                    self.config.model,
                 )
-            log.warning(
-                "model %r: using random-init weights (offline/dev mode)",
-                self.config.model,
-            )
-            self.params = init_moe_params(mc)
+                self.params = init_moe_params(mc)
         elif self.config.checkpoint:
             from langstream_tpu.models.checkpoints import load_llama_checkpoint
 
