@@ -287,6 +287,39 @@ def prefill_forward(
 
     flash = _flash_mode(Pn) if use_flash is None else ("compiled" if use_flash else None)
 
+    # Sequence-parallel prefill: with an ``sp`` axis in the mesh the prompt's
+    # sequence dimension shards over it and attention runs as a ring
+    # collective (ppermute K/V rotation + online softmax, parallel/ring.py).
+    # This is the long-context serving path: prefill FLOPs and activation
+    # memory split ~sp-ways (the KV cache itself stays in the engine's
+    # dp/tp layout — decode is unchanged). Takes priority over the Pallas
+    # flash kernel, which keeps the sequence resident per device.
+    sp_ring = (
+        mesh is not None
+        and "sp" in mesh.axis_names
+        and mesh.shape["sp"] > 1
+        and Pn % mesh.shape["sp"] == 0
+    )
+    if sp_ring:
+        # degrade per-axis like the flash path: a batch that doesn't divide
+        # dp (e.g. one queued request on a dp>1 mesh) replicates over dp
+        # instead of crashing the prefill; heads that don't divide tp stay
+        # unsharded in the ring
+        sp_dp = (
+            "dp"
+            if "dp" in mesh.axis_names and B % mesh.shape["dp"] == 0
+            else None
+        )
+        sp_tp = (
+            "tp"
+            if "tp" in mesh.axis_names
+            and c.kv_heads % mesh.shape["tp"] == 0
+            and c.heads % mesh.shape["tp"] == 0
+            else None
+        )
+        x_spec = NamedSharding(mesh, P(sp_dp, "sp", None))
+        x = jax.lax.with_sharding_constraint(x, x_spec)
+
     def layer(carry, lp):
         x = carry
         h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
@@ -295,7 +328,19 @@ def prefill_forward(
         v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"])).reshape(B, Pn, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        if flash is not None:
+        if sp_ring:
+            # causality alone hides right-padded keys from every real query
+            # row (padded rows sit after all real rows); their outputs are
+            # garbage the caller discards, their cache rows are overwritten
+            # before ever being attended to (same argument as flash below)
+            from langstream_tpu.parallel.ring import ring_attention
+
+            out = ring_attention(
+                q, k, v, mesh, causal=True,
+                batch_axis=sp_dp, head_axis=sp_tp,
+            )
+            out = out.reshape(B, Pn, c.heads * c.head_dim)
+        elif flash is not None:
             # Pallas blocked attention: no (B,H,P,P) score matrix in HBM.
             # Causality alone hides right-padded keys from every real query
             # row; padded rows' outputs are garbage the caller discards.
@@ -319,6 +364,8 @@ def prefill_forward(
         x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + ffn(h2, lp, pos_valid)
+        if sp_ring:
+            x = jax.lax.with_sharding_constraint(x, x_spec)
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
