@@ -67,6 +67,133 @@ def llama_prefill_paged(
     return logits, pool_k, pool_v
 
 
+def llama_prefill_continue_paged(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,         # (B, P2) SUFFIX tokens, right-padded
+    start_lengths: jax.Array,  # (B,) tokens already in the pool per slot
+    suffix_lengths: jax.Array, # (B,) true suffix lengths
+    pool_k: jax.Array,         # (L, nb, bs, KhD)
+    pool_v: jax.Array,
+    block_tables: jax.Array,   # (B, max_blocks)
+    num_read_blocks: int,      # static: block columns covering max(start)
+    ffn=None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill CONTINUATION: process a prompt suffix whose prefix K/V is
+    already in the paged pool (positions ``[0, start)`` per slot).
+
+    Two uses: (a) **automatic prefix caching** — requests sharing a prompt
+    prefix (system preambles, RAG templates, chat history) skip recomputing
+    it, attending to the shared blocks instead; (b) **chunked prefill** —
+    long prompts in bounded pieces. Attention per suffix query merges two
+    segments with the online-softmax combine: the pool window masked to
+    columns ``< start``, and causal self-attention among the suffix.
+    Suffix K/V is committed at ``start`` offsets (the same
+    :func:`write_rows` the decode chunk uses). Returns the last REAL suffix
+    token's logits plus the updated pools.
+
+    No reference analogue: the reference's completions are SaaS calls
+    (``ChatCompletionsStep.java``), so prompt caching was the provider's
+    problem; in-tree serving makes it ours.
+    """
+    from langstream_tpu.models.llama import _default_ffn
+
+    c = config
+    if ffn is None:
+        ffn = _default_ffn
+    B, P2 = tokens.shape
+    KhD = c.kv_heads * c.head_dim
+    G = c.heads // c.kv_heads
+    x = embedding_take(params["embed"], tokens)  # (B, P2, H)
+    positions = start_lengths[:, None] + jnp.arange(P2)[None, :]
+    cos, sin = _rope(positions, c.head_dim, c.rope_theta)
+    W = num_read_blocks * pool_k.shape[2]
+    # pool columns valid per row: w < start
+    hist_mask = (jnp.arange(W)[None, :] < start_lengths[:, None])  # (B, W)
+    # suffix causal + padding: query i sees suffix keys j<=i with j < len
+    q_idx = jnp.arange(P2)[:, None]
+    k_idx = jnp.arange(P2)[None, :]
+    suf_mask = (q_idx >= k_idx)[None] & (
+        k_idx[None] < suffix_lengths[:, None, None]
+    )  # (B, P2, P2)
+    pos_valid = jnp.arange(P2)[None, :] < suffix_lengths[:, None]  # (B, P2)
+    scale = 1.0 / math.sqrt(c.head_dim)
+
+    def layer(x, layer_in):
+        lp, ck_l, cv_l = layer_in
+        h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"])).reshape(
+            B, P2, c.heads, c.head_dim
+        )
+        k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"])).reshape(
+            B, P2, c.kv_heads, c.head_dim
+        )
+        v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"])).reshape(
+            B, P2, c.kv_heads, c.head_dim
+        )
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        qg = q.reshape(B, P2, c.kv_heads, G, c.head_dim)
+
+        # segment 1: pool history (gathered window, masked to < start)
+        kw = gather_kv(ck_l[None], block_tables, num_read_blocks)[0]
+        vw = gather_kv(cv_l[None], block_tables, num_read_blocks)[0]
+        kw = kw.reshape(B, W, c.kv_heads, c.head_dim)
+        vw = vw.reshape(B, W, c.kv_heads, c.head_dim)
+        s_h = jnp.einsum("bqkgd,bwkd->bkgqw", qg, kw).astype(jnp.float32) * scale
+        s_h = jnp.where(hist_mask[:, None, None, None, :], s_h, NEG_INF)
+        m_h = jnp.max(s_h, axis=-1)
+        shift_h = jnp.where(m_h <= NEG_INF, 0.0, m_h)
+        p_h = jnp.where(
+            hist_mask[:, None, None, None, :],
+            jnp.exp(s_h - shift_h[..., None]), 0.0,
+        )
+        l_h = jnp.sum(p_h, axis=-1)
+        acc_h = jnp.einsum(
+            "bkgqw,bwkd->bkgqd", p_h.astype(vw.dtype), vw
+        ).astype(jnp.float32)
+
+        # segment 2: causal self-attention among the suffix
+        s_s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * scale
+        s_s = jnp.where(suf_mask[:, None, None, :, :], s_s, NEG_INF)
+        m_s = jnp.max(s_s, axis=-1)
+        shift_s = jnp.where(m_s <= NEG_INF, 0.0, m_s)
+        p_s = jnp.where(
+            suf_mask[:, None, None, :, :],
+            jnp.exp(s_s - shift_s[..., None]), 0.0,
+        )
+        l_s = jnp.sum(p_s, axis=-1)
+        acc_s = jnp.einsum(
+            "bkgqs,bskd->bkgqd", p_s.astype(v.dtype), v
+        ).astype(jnp.float32)
+
+        out = merge_partial_attention(
+            [(acc_h, m_h, l_h), (acc_s, m_s, l_s)]
+        ).astype(x.dtype)  # (B, Kh, G, P2, D)
+        out = out.transpose(0, 3, 1, 2, 4).reshape(B, P2, c.heads * c.head_dim)
+        x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
+        h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
+        x = x + ffn(h2, lp, pos_valid)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], pool_k, pool_v))
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    last = jnp.take_along_axis(
+        x, (suffix_lengths - 1)[:, None, None].clip(0), axis=1
+    ).squeeze(1)
+    logits = jnp.einsum("bh,hv->bv", last, _w(params["lm_head"])).astype(
+        jnp.float32
+    )
+    L = c.layers
+    pool_k = write_rows(
+        pool_k, ks.reshape(L, B, P2, KhD), block_tables, start_lengths, pos_valid
+    )
+    pool_v = write_rows(
+        pool_v, vs.reshape(L, B, P2, KhD), block_tables, start_lengths, pos_valid
+    )
+    return logits, pool_k, pool_v
+
+
 def _cache_partial_xla(
     c: LlamaConfig,
     q: jax.Array,             # (B, H, D)

@@ -150,17 +150,146 @@ class BlockManager:
 
     Block 0 is reserved as the scatter scratch target for masked writes and
     is never allocated.
+
+    **Automatic prefix caching** (vLLM-style): full blocks of committed
+    prompts are content-addressed by a chained digest of their tokens.
+    A new request whose prompt starts with a cached chain adopts those
+    blocks read-only (refcounted — decode never writes below its start
+    position, so sharing is safe) and prefills only the suffix. Cache-only
+    blocks (refcount held just by the cache) are evicted LRU when the free
+    list runs dry, so caching never reduces admissible capacity.
     """
 
     def __init__(self, layout: PagedLayout, slots: int):
         self.layout = layout
         self._free = list(range(layout.num_blocks - 1, 0, -1))  # block 0 reserved
         self._reserved = 0
+        # per-slot: shared (adopted, refcounted) prefix blocks + owned tail
+        self._slot_shared: list[list[int]] = [[] for _ in range(slots)]
         self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
         self._slot_reservation = [0] * slots
         self.tables = np.zeros(
             (slots, layout.max_blocks_per_slot), dtype=np.int32
         )
+        # prefix cache: chain digest -> block id (insertion order = LRU),
+        # block refcounts (slot adoptions + cache membership), reverse map,
+        # and the chain topology (parent digest + child count) so eviction
+        # is leaf-first — evicting a chain HEAD would orphan its cached
+        # descendants (match_prefix walks from the head and stops at the
+        # first miss), leaving unreachable blocks pinned in the pool
+        self._prefix: dict[bytes, int] = {}
+        self._refs: dict[int, int] = {}
+        self._block_digest: dict[int, bytes] = {}
+        self._parent: dict[bytes, bytes] = {}
+        self._nchildren: dict[bytes, int] = {}
+
+    # -- prefix cache --------------------------------------------------
+
+    def _digests(self, prompt_tokens) -> list[bytes]:
+        """Chained content digests, one per FULL block of the prompt."""
+        import hashlib
+
+        bs = self.layout.block_size
+        out: list[bytes] = []
+        prev = b""
+        for i in range(len(prompt_tokens) // bs):
+            block = prompt_tokens[i * bs : (i + 1) * bs]
+            h = hashlib.blake2b(digest_size=16)
+            h.update(prev)
+            h.update(np.asarray(block, dtype=np.int64).tobytes())
+            prev = h.digest()
+            out.append(prev)
+        return out
+
+    def match_prefix(self, prompt_tokens) -> tuple[list[int], int]:
+        """Longest cached chain covering at most ``len(prompt)-1`` tokens
+        (at least one token must prefill to produce logits). Returns
+        (blocks, reused_token_count) WITHOUT claiming them — call
+        :meth:`adopt_prefix` after admission."""
+        bs = self.layout.block_size
+        limit = (len(prompt_tokens) - 1) // bs
+        blocks: list[int] = []
+        for d in self._digests(prompt_tokens)[:limit]:
+            b = self._prefix.get(d)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks, len(blocks) * bs
+
+    def adopt_prefix(self, slot: int, blocks: list[int]) -> None:
+        """Install shared prefix blocks at the head of a slot's table."""
+        assert not self._slot_shared[slot] and not self._slot_blocks[slot]
+        for i, b in enumerate(blocks):
+            self._refs[b] = self._refs.get(b, 0) + 1
+            self.tables[slot, i] = b
+            # LRU touch
+            d = self._block_digest.get(b)
+            if d is not None and d in self._prefix:
+                self._prefix[d] = self._prefix.pop(d)
+        self._slot_shared[slot] = list(blocks)
+
+    def register_prefix(self, slot: int, prompt_tokens) -> None:
+        """After a committed prefill: publish the slot's full prompt blocks
+        into the cache (first writer wins per digest)."""
+        table = self._slot_shared[slot] + self._slot_blocks[slot]
+        prev = b""
+        for i, d in enumerate(self._digests(prompt_tokens)):
+            if i >= len(table):
+                break
+            if d in self._prefix:
+                self._prefix[d] = self._prefix.pop(d)  # LRU touch
+                prev = d
+                continue
+            b = table[i]
+            if b in self._block_digest:
+                break  # block already published under another digest:
+                       # deeper chain links would dangle — stop here
+            self._prefix[d] = b
+            self._block_digest[b] = d
+            self._refs[b] = self._refs.get(b, 0) + 1
+            self._parent[d] = prev
+            self._nchildren.setdefault(d, 0)
+            if prev:
+                self._nchildren[prev] = self._nchildren.get(prev, 0) + 1
+            prev = d
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used cache-only LEAF block (no cached
+        children) to the free list — heads stay until their chains drain."""
+        for d, b in list(self._prefix.items()):  # insertion order = LRU
+            if self._refs.get(b, 0) != 1:  # a slot still reads it
+                continue
+            if self._nchildren.get(d, 0) > 0:  # interior: would orphan tail
+                continue
+            del self._prefix[d]
+            del self._block_digest[b]
+            parent = self._parent.pop(d, b"")
+            self._nchildren.pop(d, None)
+            if parent and parent in self._nchildren:
+                self._nchildren[parent] -= 1
+            self._unref(b)
+            return True
+        return False
+
+    # -- refcounted block lifecycle (every live block holds ≥1 ref:
+    # its owning/adopting slots and, once published, the cache) ---------
+
+    def _alloc(self) -> int:
+        if not self._free and not self._evict_one():
+            raise RuntimeError(
+                "paged KV pool exhausted despite reservation accounting"
+            )
+        b = self._free.pop()
+        self._refs[b] = 1
+        return b
+
+    def _unref(self, b: int) -> None:
+        n = self._refs.get(b, 0) - 1
+        if n <= 0:
+            self._refs.pop(b, None)
+            self._free.append(b)
+        else:
+            self._refs[b] = n
 
     # -- admission -----------------------------------------------------
 
@@ -205,23 +334,20 @@ class BlockManager:
         if self._slot_reservation[slot]:
             need = min(need, self._slot_reservation[slot])
         changed = False
-        while len(self._slot_blocks[slot]) < need:
-            if not self._free:
-                raise RuntimeError(
-                    "paged KV pool exhausted despite reservation accounting"
-                )
-            b = self._free.pop()
-            idx = len(self._slot_blocks[slot])
+        while len(self._slot_shared[slot]) + len(self._slot_blocks[slot]) < need:
+            b = self._alloc()
+            idx = len(self._slot_shared[slot]) + len(self._slot_blocks[slot])
             self._slot_blocks[slot].append(b)
             self.tables[slot, idx] = b
             changed = True
         return changed
 
     def release(self, slot: int) -> None:
-        blocks = self._slot_blocks[slot]
-        self._free.extend(reversed(blocks))
+        for b in self._slot_shared[slot] + self._slot_blocks[slot]:
+            self._unref(b)
         self._reserved -= self._slot_reservation[slot]
         self._slot_reservation[slot] = 0
+        self._slot_shared[slot] = []
         self._slot_blocks[slot] = []
         self.tables[slot, :] = 0
 
@@ -232,5 +358,9 @@ class BlockManager:
             "num_blocks": self.layout.num_blocks,
             "free_blocks": len(self._free),
             "reserved_blocks": self._reserved,
-            "live_blocks": sum(len(b) for b in self._slot_blocks),
+            "live_blocks": sum(
+                len(s) + len(b)
+                for s, b in zip(self._slot_shared, self._slot_blocks)
+            ),
+            "cached_prefix_blocks": len(self._prefix),
         }

@@ -94,6 +94,13 @@ def _resolve_model_config(name: str, max_seq_len: int):
     return _MODEL_CONFIGS[name](max_seq_len=max_seq_len)
 
 
+def _parse_bool(v: Any) -> bool:
+    """YAML/env values arrive as strings; bool("false") is True, so parse."""
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
 @dataclasses.dataclass(frozen=True)
 class ServingConfig:
     model: str = "tiny"
@@ -129,6 +136,16 @@ class ServingConfig:
     # einsum elsewhere/under meshes), or force "xla" | "pallas" |
     # "pallas-interpret"
     dense_kernel: str = "auto"
+    # automatic prefix caching (paged layout only): full prompt blocks are
+    # content-addressed; requests sharing a prefix (system preambles, RAG
+    # templates, chat history) adopt the cached blocks read-only and
+    # prefill just the suffix — the TTFT lever for shared-prefix traffic
+    prefix_cache: bool = True
+    # suffixes longer than this skip the cache and take the full prefill:
+    # the continuation path materializes O(suffix²) scores (no flash/ring
+    # variant yet), so very long suffixes are cheaper on the flash path
+    # than quadratic on the continuation path
+    prefix_cache_max_suffix: int = 1024
 
     def to_dict(self) -> dict[str, Any]:
         """Kebab-case dict that :meth:`from_dict` round-trips — the lockstep
@@ -151,6 +168,8 @@ class ServingConfig:
             "kv-pool-blocks": self.kv_pool_blocks,
             "paged-kernel": self.paged_kernel,
             "dense-kernel": self.dense_kernel,
+            "prefix-cache": self.prefix_cache,
+            "prefix-cache-max-suffix": self.prefix_cache_max_suffix,
         }
 
     @classmethod
@@ -180,6 +199,15 @@ class ServingConfig:
             ),
             paged_kernel=d.get("paged-kernel", d.get("paged_kernel", "auto")),
             dense_kernel=d.get("dense-kernel", d.get("dense_kernel", "auto")),
+            prefix_cache=_parse_bool(
+                d.get("prefix-cache", d.get("prefix_cache", True))
+            ),
+            prefix_cache_max_suffix=int(
+                d.get(
+                    "prefix-cache-max-suffix",
+                    d.get("prefix_cache_max_suffix", 1024),
+                )
+            ),
         )
 
 
@@ -645,6 +673,37 @@ class TpuServingEngine:
             return _prefill
 
         self._make_prefill = _make_prefill
+
+        def _make_prefill_continue(sampler_mode: tuple, nrb: int):
+            """Suffix prefill against cached prefix blocks (paged only):
+            the automatic-prefix-caching fast path. ``nrb`` is the static
+            block-window bucket covering the longest reused prefix."""
+            use_top_p, use_top_k, all_greedy = sampler_mode
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def _prefill_cont(params, cache_k, cache_v, tokens, starts,
+                              suffix_lengths, tables, key, temps, topks, topps):
+                from langstream_tpu.models.llama_paged import (
+                    llama_prefill_continue_paged,
+                )
+
+                logits, ck, cv = llama_prefill_continue_paged(
+                    mc_static, params, tokens, starts, suffix_lengths,
+                    cache_k, cache_v, tables, num_read_blocks=nrb,
+                    ffn=ffn_static,
+                )
+                next_tokens, logprobs = _fetchable(
+                    *sample_tokens(
+                        logits, key, temps, topks,
+                        use_top_p=use_top_p, top_ps=topps,
+                        use_top_k=use_top_k, all_greedy=all_greedy,
+                    )
+                )
+                return next_tokens, logprobs, ck, cv
+
+            return _prefill_cont
+
+        self._make_prefill_continue = _make_prefill_continue
         # the sampler's expensive passes (top-p vocab sort, top-k selection
         # sweep, any sampling at all for greedy-only batches) are compiled
         # in only when an active request needs them; decode additionally
@@ -652,6 +711,7 @@ class TpuServingEngine:
         # lazily on first use.
         self._decode_chunk_fns: dict[tuple[tuple, int | None], Any] = {}
         self._prefill_fns: dict[tuple, Any] = {}
+        self._prefill_continue_fns: dict[tuple[tuple, int], Any] = {}
 
     def _decode_fn(self, sampler_mode: tuple, window: int | None):
         key = (sampler_mode, window)
@@ -663,6 +723,14 @@ class TpuServingEngine:
         if sampler_mode not in self._prefill_fns:
             self._prefill_fns[sampler_mode] = self._make_prefill(sampler_mode)
         return self._prefill_fns[sampler_mode]
+
+    def _prefill_continue_fn(self, sampler_mode: tuple, nrb: int):
+        key = (sampler_mode, nrb)
+        if key not in self._prefill_continue_fns:
+            self._prefill_continue_fns[key] = self._make_prefill_continue(
+                sampler_mode, nrb
+            )
+        return self._prefill_continue_fns[key]
 
     @staticmethod
     def _sampler_mode(temps, topks, topps) -> tuple:
@@ -961,12 +1029,20 @@ class TpuServingEngine:
     async def _admit(self, loop) -> None:
         """Admit queued requests in batched prefill calls (grouped by
         prompt-length bucket, count padded to a power of two by repeating
-        the last row — a duplicate write of identical K/V is a no-op)."""
+        the last row — a duplicate write of identical K/V is a no-op).
+
+        With the paged prefix cache on, each request first matches its
+        prompt against cached block chains; matched requests adopt the
+        shared blocks and prefill only the SUFFIX (grouped by suffix-length
+        bucket, dispatched through the continuation path)."""
+        use_prefix = (
+            self.block_mgr is not None and self.config.prefix_cache
+        )
         while not self._queue.empty():
             free = [i for i, s in enumerate(self.slots) if s.free]
             if not free:
                 return
-            batch: list[tuple[int, _Request]] = []
+            batch: list[tuple[int, _Request, int]] = []  # (slot, req, reuse)
             bucket = None
             while (
                 not self._queue.empty()
@@ -981,7 +1057,24 @@ class TpuServingEngine:
                     # (Requests that could NEVER fit are rejected up front in
                     # generate(), so this always unblocks eventually.)
                     break
-                b = _bucket(len(request.prompt_tokens), hi=self.model_config.max_seq_len)
+                if use_prefix:
+                    blocks, reuse = self.block_mgr.match_prefix(
+                        request.prompt_tokens
+                    )
+                    if (
+                        reuse
+                        and len(request.prompt_tokens) - reuse
+                        > self.config.prefix_cache_max_suffix
+                    ):
+                        # the continuation path is quadratic in the suffix;
+                        # past the cap the flash/ring full prefill wins
+                        blocks, reuse = [], 0
+                else:
+                    blocks, reuse = [], 0
+                b = _bucket(
+                    len(request.prompt_tokens) - reuse,
+                    hi=self.model_config.max_seq_len,
+                )
                 if bucket is None:
                     bucket = b
                 elif b != bucket:
@@ -994,10 +1087,12 @@ class TpuServingEngine:
                     self.block_mgr.admit(
                         slot_id, len(request.prompt_tokens) + request.max_tokens + 1
                     )
-                batch.append((slot_id, request))
+                    if blocks:
+                        self.block_mgr.adopt_prefix(slot_id, blocks)
+                batch.append((slot_id, request, reuse))
             if not batch:
                 return
-            for slot_id, request in batch:
+            for slot_id, request, _reuse in batch:
                 self.slots[slot_id].request = request
                 if self.block_mgr is not None:
                     self.block_mgr.ensure_capacity(
@@ -1006,23 +1101,26 @@ class TpuServingEngine:
             Bp = 1
             while Bp < len(batch):
                 Bp *= 2
+            use_continue = any(r > 0 for _, _, r in batch)
             padded = np.zeros((Bp, bucket), dtype=np.int32)
             lengths = np.zeros(Bp, dtype=np.int32)
+            starts = np.zeros(Bp, dtype=np.int32)
             slot_ids = np.zeros(Bp, dtype=np.int32)
             temps = np.zeros(Bp, dtype=np.float32)
             topks = np.zeros(Bp, dtype=np.int32)
             topps = np.ones(Bp, dtype=np.float32)
             for i in range(Bp):
-                slot_id, request = batch[min(i, len(batch) - 1)]
-                padded[i, : len(request.prompt_tokens)] = request.prompt_tokens
-                lengths[i] = len(request.prompt_tokens)
+                slot_id, request, reuse = batch[min(i, len(batch) - 1)]
+                suffix = request.prompt_tokens[reuse:]
+                padded[i, : len(suffix)] = suffix
+                lengths[i] = len(suffix)
+                starts[i] = reuse
                 slot_ids[i] = slot_id
                 temps[i] = request.temperature
                 topks[i] = request.top_k
                 topps[i] = request.top_p
             key = self._split_key()
             prefill_mode = self._sampler_mode(temps, topks, topps)
-            prefill_fn = self._prefill_fn(prefill_mode)
 
             if self.block_mgr is not None:
                 # per-batch-row block tables (duplicate padded rows write
@@ -1031,39 +1129,64 @@ class TpuServingEngine:
             else:
                 sel_np = slot_ids
             sel = jnp.asarray(sel_np)
+            if use_continue:
+                nrb = self._read_blocks_for(int(starts.max()))
+                prefill_fn = self._prefill_continue_fn(prefill_mode, nrb)
+            else:
+                prefill_fn = self._prefill_fn(prefill_mode)
 
             def _run():
                 if self._lockstep is not None:
-                    self._lockstep.broadcast(
-                        {
-                            "op": "prefill",
-                            "sampler_mode": list(prefill_mode),
-                            "tokens": padded,
-                            "lengths": lengths,
-                            "sel": np.asarray(sel_np),
-                            "key": np.asarray(key),
-                            "temps": temps,
-                            "topks": topks,
-                            "topps": topps,
-                        }
+                    desc = {
+                        "sampler_mode": list(prefill_mode),
+                        "tokens": padded,
+                        "lengths": lengths,
+                        "sel": np.asarray(sel_np),
+                        "key": np.asarray(key),
+                        "temps": temps,
+                        "topks": topks,
+                        "topps": topps,
+                    }
+                    if use_continue:
+                        desc.update(
+                            {"op": "prefill_continue", "starts": starts,
+                             "nrb": nrb}
+                        )
+                    else:
+                        desc["op"] = "prefill"
+                    self._lockstep.broadcast(desc)
+                if use_continue:
+                    args = (
+                        self.params, self.cache_k, self.cache_v,
+                        jnp.asarray(padded), jnp.asarray(starts),
+                        jnp.asarray(lengths), sel, key,
+                        jnp.asarray(temps), jnp.asarray(topks),
+                        jnp.asarray(topps),
                     )
-                args = (
-                    self.params, self.cache_k, self.cache_v,
-                    jnp.asarray(padded), jnp.asarray(lengths),
-                    sel, key,
-                    jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
-                )
+                else:
+                    args = (
+                        self.params, self.cache_k, self.cache_v,
+                        jnp.asarray(padded), jnp.asarray(lengths),
+                        sel, key,
+                        jnp.asarray(temps), jnp.asarray(topks),
+                        jnp.asarray(topps),
+                    )
                 self.profiler.dump_hlo(f"prefill_p{bucket}_b{Bp}", prefill_fn, *args)
                 return prefill_fn(*args)
 
             next_tokens, logprobs, self.cache_k, self.cache_v = (
                 await loop.run_in_executor(self._executor, _run)
             )
+            if use_prefix:
+                for slot_id, request, _reuse in batch:
+                    self.block_mgr.register_prefix(
+                        slot_id, request.prompt_tokens
+                    )
             next_np = np.asarray(next_tokens)
             logprob_np = np.asarray(logprobs)
             now = time.monotonic()
             admitted_slots = []
-            for i, (slot_id, request) in enumerate(batch):
+            for i, (slot_id, request, _reuse) in enumerate(batch):
                 self._lengths[slot_id] = len(request.prompt_tokens)
                 self._current[slot_id] = int(next_np[i])
                 self._temps[slot_id] = request.temperature

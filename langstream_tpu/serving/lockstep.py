@@ -375,6 +375,22 @@ class LockstepFollower:
                     jnp.asarray(desc["topps"]),
                 )
                 engine.cache_k, engine.cache_v = out[2], out[3]
+            elif op == "prefill_continue":
+                # prefix-cache suffix prefill: block adoption is host state
+                # the leader already resolved — the follower just replays
+                # the same jit with the same tables/starts
+                fn = engine._prefill_continue_fn(
+                    tuple(bool(x) for x in desc["sampler_mode"]),
+                    int(desc["nrb"]),
+                )
+                out = fn(
+                    engine.params, engine.cache_k, engine.cache_v,
+                    jnp.asarray(desc["tokens"]), jnp.asarray(desc["starts"]),
+                    jnp.asarray(desc["lengths"]), jnp.asarray(desc["sel"]),
+                    jnp.asarray(desc["key"]), jnp.asarray(desc["temps"]),
+                    jnp.asarray(desc["topks"]), jnp.asarray(desc["topps"]),
+                )
+                engine.cache_k, engine.cache_v = out[2], out[3]
             else:
                 raise RuntimeError(f"unknown lockstep op {op!r}")
             steps += 1

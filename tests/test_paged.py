@@ -464,3 +464,173 @@ def test_engine_dense_pallas_kernel_serves(run_async):
         assert 0 < len(r["tokens"]) <= 6
 
     run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# automatic prefix caching
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_continue_matches_full_prefill():
+    """Prefilling a prefix then continuing with the suffix must reproduce
+    the one-shot prefill — logits and committed pool rows. f32 so the
+    comparison is tight (bf16 differs only by accumulation order between
+    the dense softmax and the two-segment online-softmax merge)."""
+    import dataclasses
+
+    from langstream_tpu.models.llama import LlamaConfig, init_llama_params
+    from langstream_tpu.models.llama_paged import (
+        llama_prefill_continue_paged,
+        llama_prefill_paged,
+    )
+    from langstream_tpu.models.paged import (
+        BlockManager,
+        PagedLayout,
+        init_paged_kv_cache,
+    )
+
+    c = dataclasses.replace(
+        LlamaConfig.tiny(max_seq_len=64), dtype=jnp.float32
+    )
+    params = init_llama_params(c, jax.random.PRNGKey(1))
+    layout = PagedLayout.for_model(64, 2, block_size=8)
+    prompt = jnp.array(
+        [[5, 9, 17, 3, 11, 2, 7, 1, 13, 21, 6, 4, 19, 8]], jnp.int32
+    )
+    n = prompt.shape[1]
+
+    bm = BlockManager(layout, 2)
+    bm.admit(0, 32)
+    bm.ensure_capacity(0, n)
+    pk, pv = init_paged_kv_cache(c, layout)
+    tables = jnp.asarray(bm.tables[[0]])
+    ref_logits, pk1, pv1 = llama_prefill_paged(
+        c, params, prompt, jnp.array([n]), pk, pv, tables
+    )
+
+    bm2 = BlockManager(layout, 2)
+    bm2.admit(0, 32)
+    bm2.ensure_capacity(0, n)
+    pk2, pv2 = init_paged_kv_cache(c, layout)
+    t2 = jnp.asarray(bm2.tables[[0]])
+    _, pk2, pv2 = llama_prefill_paged(
+        c, params, prompt[:, :8], jnp.array([8]), pk2, pv2, t2
+    )
+    suffix = jnp.zeros((1, 8), jnp.int32).at[:, :6].set(prompt[:, 8:])
+    cont_logits, pk2, pv2 = llama_prefill_continue_paged(
+        c, params, suffix, jnp.array([8]), jnp.array([6]), pk2, pv2, t2,
+        num_read_blocks=1,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(cont_logits), rtol=2e-4, atol=2e-4
+    )
+    b = np.asarray(t2[0, :2])
+    np.testing.assert_allclose(
+        np.asarray(pk1[:, b]), np.asarray(pk2[:, b]), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(pv1[:, b]), np.asarray(pv2[:, b]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_prefix_cache_engine_reuses_and_matches(run_async):
+    """Second request with a shared system preamble adopts cached blocks
+    (block tables share head entries; prefill runs on the suffix) and the
+    generation matches a prefix-cache-off engine token-for-token."""
+    import asyncio
+
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    preamble = "you are a helpful assistant. answer briefly and precisely. "
+    prompts = [preamble + "what is a tpu?", preamble + "name a jax transform."]
+
+    def cfg(prefix_cache):
+        return ServingConfig(
+            model="tiny", slots=4, max_seq_len=128, decode_chunk=4,
+            default_max_tokens=10, kv_layout="paged", kv_block_size=16,
+            kv_pool_fraction=0.75, paged_kernel="xla",
+            prefix_cache=prefix_cache,
+        )
+
+    async def run(prefix_cache):
+        engine = TpuServingEngine.get_or_create(cfg(prefix_cache))
+        outs = []
+        for p in prompts:  # sequential: the 2nd must hit the 1st's blocks
+            outs.append(await engine.generate(p, {"max-tokens": 10}))
+        stats = engine.stats()
+        await engine.close()
+        return [o["tokens"] for o in outs], stats
+
+    cached_tokens, stats = run_async(run(True))
+    assert stats["kv"]["cached_prefix_blocks"] > 0
+    plain_tokens, _ = run_async(run(False))
+    # short horizon: the cached path computes attention via the two-segment
+    # online-softmax merge while the plain path uses one dense softmax —
+    # bf16 accumulation-order noise can flip a late near-tie argmax (the
+    # exact math is pinned by test_prefill_continue_matches_full_prefill
+    # in f32)
+    assert [t[:6] for t in cached_tokens] == [t[:6] for t in plain_tokens]
+
+
+def test_prefix_cache_config_parsing():
+    """String config values must parse as booleans ('false' disables)."""
+    from langstream_tpu.serving.engine import ServingConfig
+
+    assert ServingConfig.from_dict({"prefix-cache": "false"}).prefix_cache is False
+    assert ServingConfig.from_dict({"prefix-cache": "true"}).prefix_cache is True
+    assert ServingConfig.from_dict({}).prefix_cache is True
+    assert (
+        ServingConfig.from_dict({"prefix-cache-max-suffix": "256"})
+        .prefix_cache_max_suffix
+        == 256
+    )
+
+
+def test_prefix_cache_eviction_under_pressure(run_async):
+    """Cache-held blocks must never block admission: when the pool runs
+    dry the LRU cache-only blocks are evicted and every request completes."""
+    import asyncio
+
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=4, max_seq_len=128, decode_chunk=4,
+                default_max_tokens=8, kv_layout="paged", kv_block_size=16,
+                kv_pool_blocks=7, paged_kernel="xla", prefix_cache=True,
+            )
+        )
+        results = []
+        for i in range(6):  # distinct prompts: every finish caches blocks
+            results.append(
+                await engine.generate(
+                    f"request number {i} with some padding text", {"max-tokens": 8}
+                )
+            )
+        await engine.close()
+        assert all(0 < len(r["tokens"]) <= 8 for r in results)
+
+    run_async(main())
+
+
+def test_prefix_cache_leaf_first_eviction():
+    """Eviction drains chains tail-first: dropping a chain HEAD would leave
+    cached descendants unreachable (match walks from the head), pinning
+    pool blocks that can never match again."""
+    from langstream_tpu.models.paged import BlockManager, PagedLayout
+
+    lay = PagedLayout(block_size=4, num_blocks=10, max_blocks_per_slot=8)
+    bm = BlockManager(lay, 4)
+    p = list(range(1, 13))  # 3 full blocks -> chain d0-d1-d2
+    bm.admit(0, 12)
+    bm.ensure_capacity(0, 12)
+    bm.register_prefix(0, p)
+    bm.release(0)
+    assert bm.stats()["cached_prefix_blocks"] == 3
+    assert bm._evict_one()
+    _, reuse = bm.match_prefix(p)
+    assert reuse == 8  # head d0,d1 still matchable; leaf d2 evicted
+    assert bm._evict_one()
+    _, reuse = bm.match_prefix(p)
+    assert reuse == 4
