@@ -185,12 +185,13 @@ class BlockManager:
 
     # -- prefix cache --------------------------------------------------
 
-    def _digests(self, prompt_tokens) -> list[bytes]:
-        """Chained content digests, one per FULL block of the prompt."""
+    def _digests(self, prompt_tokens):
+        """Chained content digests, one per FULL block of the prompt.
+        Lazy: callers that stop early (first cache miss, table bound) pay
+        only for the digests they actually walk."""
         import hashlib
 
         bs = self.layout.block_size
-        out: list[bytes] = []
         prev = b""
         for i in range(len(prompt_tokens) // bs):
             block = prompt_tokens[i * bs : (i + 1) * bs]
@@ -198,8 +199,7 @@ class BlockManager:
             h.update(prev)
             h.update(np.asarray(block, dtype=np.int64).tobytes())
             prev = h.digest()
-            out.append(prev)
-        return out
+            yield prev
 
     def match_prefix(self, prompt_tokens) -> tuple[list[int], int]:
         """Longest cached chain covering at most ``len(prompt)-1`` tokens
@@ -209,7 +209,9 @@ class BlockManager:
         bs = self.layout.block_size
         limit = (len(prompt_tokens) - 1) // bs
         blocks: list[int] = []
-        for d in self._digests(prompt_tokens)[:limit]:
+        for i, d in enumerate(self._digests(prompt_tokens)):
+            if i >= limit:
+                break
             b = self._prefix.get(d)
             if b is None:
                 break
@@ -358,9 +360,14 @@ class BlockManager:
             "num_blocks": self.layout.num_blocks,
             "free_blocks": len(self._free),
             "reserved_blocks": self._reserved,
-            "live_blocks": sum(
-                len(s) + len(b)
-                for s, b in zip(self._slot_shared, self._slot_blocks)
+            # distinct physical blocks: shared prefix blocks adopted by
+            # several slots count once (live + free + cache-only ≤ usable)
+            "live_blocks": len(
+                {
+                    b
+                    for s, o in zip(self._slot_shared, self._slot_blocks)
+                    for b in (*s, *o)
+                }
             ),
             "cached_prefix_blocks": len(self._prefix),
         }

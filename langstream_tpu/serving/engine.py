@@ -1171,7 +1171,10 @@ class TpuServingEngine:
                         jnp.asarray(temps), jnp.asarray(topks),
                         jnp.asarray(topps),
                     )
-                self.profiler.dump_hlo(f"prefill_p{bucket}_b{Bp}", prefill_fn, *args)
+                variant = f"_cont_nrb{nrb}" if use_continue else ""
+                self.profiler.dump_hlo(
+                    f"prefill_p{bucket}_b{Bp}{variant}", prefill_fn, *args
+                )
                 return prefill_fn(*args)
 
             next_tokens, logprobs, self.cache_k, self.cache_v = (
