@@ -102,22 +102,23 @@ def llama_prefill_continue_paged(
     if ffn is None:
         ffn = _default_ffn
     B, P2 = tokens.shape
+    bs = pool_k.shape[2]
     KhD = c.kv_heads * c.head_dim
     G = c.heads // c.kv_heads
     x = embedding_take(params["embed"], tokens)  # (B, P2, H)
     positions = start_lengths[:, None] + jnp.arange(P2)[None, :]
     cos, sin = _rope(positions, c.head_dim, c.rope_theta)
-    W = num_read_blocks * pool_k.shape[2]
-    # pool columns valid per row: w < start
-    hist_mask = (jnp.arange(W)[None, :] < start_lengths[:, None])  # (B, W)
-    # suffix causal + padding: query i sees suffix keys j<=i with j < len
-    q_idx = jnp.arange(P2)[:, None]
-    k_idx = jnp.arange(P2)[None, :]
-    suf_mask = (q_idx >= k_idx)[None] & (
-        k_idx[None] < suffix_lengths[:, None, None]
-    )  # (B, P2, P2)
     pos_valid = jnp.arange(P2)[None, :] < suffix_lengths[:, None]  # (B, P2)
     scale = 1.0 / math.sqrt(c.head_dim)
+    # suffix key-block size: online-softmax over key blocks bounds score
+    # memory at O(P2·sbs) per step instead of O(P2·(start+P2)) — this is
+    # what keeps arbitrarily long suffixes (chunked prefill) HBM-safe. The
+    # block must divide P2 exactly (dynamic_slice clamps at the edge and
+    # would misalign the position mask), so take gcd(P2, 128): power-of-two
+    # engine buckets get the full 128; awkward widths degrade the block
+    # size, never the memory bound.
+    sbs = math.gcd(P2, 128)
+    n_suffix_blocks = P2 // sbs
 
     def layer(x, layer_in):
         lp, ck_l, cv_l = layer_in
@@ -135,41 +136,63 @@ def llama_prefill_continue_paged(
         k = _apply_rope(k, cos, sin)
         qg = q.reshape(B, P2, c.kv_heads, G, c.head_dim)
 
-        # segment 1: pool history (gathered window, masked to < start)
-        kw = gather_kv(ck_l[None], block_tables, num_read_blocks)[0]
-        vw = gather_kv(cv_l[None], block_tables, num_read_blocks)[0]
-        kw = kw.reshape(B, W, c.kv_heads, c.head_dim)
-        vw = vw.reshape(B, W, c.kv_heads, c.head_dim)
-        s_h = jnp.einsum("bqkgd,bwkd->bkgqw", qg, kw).astype(jnp.float32) * scale
-        s_h = jnp.where(hist_mask[:, None, None, None, :], s_h, NEG_INF)
-        m_h = jnp.max(s_h, axis=-1)
-        shift_h = jnp.where(m_h <= NEG_INF, 0.0, m_h)
-        p_h = jnp.where(
-            hist_mask[:, None, None, None, :],
-            jnp.exp(s_h - shift_h[..., None]), 0.0,
-        )
-        l_h = jnp.sum(p_h, axis=-1)
-        acc_h = jnp.einsum(
-            "bkgqw,bwkd->bkgqd", p_h.astype(vw.dtype), vw
-        ).astype(jnp.float32)
+        m0 = jnp.full((B, c.kv_heads, G, P2), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, c.kv_heads, G, P2), jnp.float32)
+        o0 = jnp.zeros((B, c.kv_heads, G, P2, c.head_dim), jnp.float32)
 
-        # segment 2: causal self-attention among the suffix
-        s_s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * scale
-        s_s = jnp.where(suf_mask[:, None, None, :, :], s_s, NEG_INF)
-        m_s = jnp.max(s_s, axis=-1)
-        shift_s = jnp.where(m_s <= NEG_INF, 0.0, m_s)
-        p_s = jnp.where(
-            suf_mask[:, None, None, :, :],
-            jnp.exp(s_s - shift_s[..., None]), 0.0,
-        )
-        l_s = jnp.sum(p_s, axis=-1)
-        acc_s = jnp.einsum(
-            "bkgqs,bskd->bkgqd", p_s.astype(v.dtype), v
-        ).astype(jnp.float32)
+        def online_update(carry, k_blk, v_blk, mask_blk):
+            # one flash-attention style block update: k/v (B, T, Kh, D),
+            # mask (B, 1, 1, P2?, T) broadcastable over (B,Kh,G,P2,T)
+            o, l, m = carry
+            s = jnp.einsum("bqkgd,btkd->bkgqt", qg, k_blk).astype(
+                jnp.float32
+            ) * scale
+            s = jnp.where(mask_blk, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            shift = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+            p = jnp.where(mask_blk, jnp.exp(s - shift[..., None]), 0.0)
+            alpha = jnp.exp(jnp.where(m <= NEG_INF, NEG_INF, m - shift))
+            l = l * alpha + p.sum(axis=-1)
+            o = o * alpha[..., None] + jnp.einsum(
+                "bkgqt,btkd->bkgqd", p.astype(v_blk.dtype), v_blk
+            ).astype(jnp.float32)
+            return o, l, m_new
 
-        out = merge_partial_attention(
-            [(acc_h, m_h, l_h), (acc_s, m_s, l_s)]
-        ).astype(x.dtype)  # (B, Kh, G, P2, D)
+        # segment 1: pool history, one table column (= one block) at a time
+        def hist_step(carry, j):
+            cols = block_tables[:, j]                       # (B,)
+            k_blk = jnp.take(ck_l, cols, axis=0).reshape(
+                B, bs, c.kv_heads, c.head_dim
+            )
+            v_blk = jnp.take(cv_l, cols, axis=0).reshape(
+                B, bs, c.kv_heads, c.head_dim
+            )
+            w_pos = j * bs + jnp.arange(bs)                 # (bs,)
+            mask = (w_pos[None, :] < start_lengths[:, None])[
+                :, None, None, None, :
+            ]
+            return online_update(carry, k_blk, v_blk, mask), None
+
+        carry, _ = jax.lax.scan(
+            hist_step, (o0, l0, m0), jnp.arange(num_read_blocks)
+        )
+
+        # segment 2: causal self-attention among the suffix, key-blocked
+        def suf_step(carry, t):
+            k_blk = jax.lax.dynamic_slice_in_dim(k, t * sbs, sbs, axis=1)
+            v_blk = jax.lax.dynamic_slice_in_dim(v, t * sbs, sbs, axis=1)
+            k_pos = t * sbs + jnp.arange(sbs)
+            mask = (
+                (jnp.arange(P2)[:, None] >= k_pos[None, :])[None]
+                & (k_pos[None, None, :] < suffix_lengths[:, None, None])
+            )[:, None, None, :, :]
+            return online_update(carry, k_blk, v_blk, mask), None
+
+        (o, l, m), _ = jax.lax.scan(
+            suf_step, carry, jnp.arange(n_suffix_blocks)
+        )
+        inv = jnp.where(l > 0.0, 1.0 / jnp.maximum(l, 1e-30), 0.0)
+        out = (o * inv[..., None]).astype(x.dtype)  # (B, Kh, G, P2, D)
         out = out.transpose(0, 3, 1, 2, 4).reshape(B, P2, c.heads * c.head_dim)
         x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)

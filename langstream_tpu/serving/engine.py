@@ -141,11 +141,13 @@ class ServingConfig:
     # templates, chat history) adopt the cached blocks read-only and
     # prefill just the suffix — the TTFT lever for shared-prefix traffic
     prefix_cache: bool = True
-    # suffixes longer than this skip the cache and take the full prefill:
-    # the continuation path materializes O(suffix²) scores (no flash/ring
-    # variant yet), so very long suffixes are cheaper on the flash path
-    # than quadratic on the continuation path
-    prefix_cache_max_suffix: int = 1024
+    # suffixes longer than this skip the cache and take the full prefill.
+    # The continuation path is memory-bounded (blocked online softmax), so
+    # this is a kernel-efficiency trade, not an OOM guard: the full prefill
+    # rides the Pallas flash kernel / sp ring, the continuation path is XLA
+    # einsums — past the cap, recomputing the prefix on the faster kernel
+    # beats skipping it on the slower one
+    prefix_cache_max_suffix: int = 4096
 
     def to_dict(self) -> dict[str, Any]:
         """Kebab-case dict that :meth:`from_dict` round-trips — the lockstep
@@ -205,7 +207,7 @@ class ServingConfig:
             prefix_cache_max_suffix=int(
                 d.get(
                     "prefix-cache-max-suffix",
-                    d.get("prefix_cache_max_suffix", 1024),
+                    d.get("prefix_cache_max_suffix", 4096),
                 )
             ),
         )
@@ -351,6 +353,14 @@ class TpuServingEngine:
         )
         self._m_queued = reporter.gauge(
             "queued_requests", "requests awaiting a free slot"
+        )
+        self._m_prefix_hits = reporter.counter(
+            "prefix_cache_hits_total",
+            "admissions that adopted cached prefix blocks",
+        )
+        self._m_prefix_tokens = reporter.counter(
+            "prefix_cache_tokens_reused_total",
+            "prompt tokens served from cached prefix blocks (prefill skipped)",
         )
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
@@ -1066,8 +1076,8 @@ class TpuServingEngine:
                         and len(request.prompt_tokens) - reuse
                         > self.config.prefix_cache_max_suffix
                     ):
-                        # the continuation path is quadratic in the suffix;
-                        # past the cap the flash/ring full prefill wins
+                        # long suffix, small saving: the flash/ring full
+                        # prefill beats the XLA continuation path
                         blocks, reuse = [], 0
                 else:
                     blocks, reuse = [], 0
@@ -1181,10 +1191,13 @@ class TpuServingEngine:
                 await loop.run_in_executor(self._executor, _run)
             )
             if use_prefix:
-                for slot_id, request, _reuse in batch:
+                for slot_id, request, reuse in batch:
                     self.block_mgr.register_prefix(
                         slot_id, request.prompt_tokens
                     )
+                    if reuse:
+                        self._m_prefix_hits(1)
+                        self._m_prefix_tokens(reuse)
             next_np = np.asarray(next_tokens)
             logprob_np = np.asarray(logprobs)
             now = time.monotonic()

@@ -634,3 +634,57 @@ def test_prefix_cache_leaf_first_eviction():
     assert bm._evict_one()
     _, reuse = bm.match_prefix(p)
     assert reuse == 4
+
+
+def test_prefill_continue_long_suffix_blocked():
+    """Multi-block suffix (suffix > sbs=128) through the blocked
+    online-softmax continuation must match the one-shot prefill — the
+    memory-bounded path that lets long suffixes keep the prefix cache."""
+    import dataclasses
+
+    from langstream_tpu.models.llama import LlamaConfig, init_llama_params
+    from langstream_tpu.models.llama_paged import (
+        llama_prefill_continue_paged,
+        llama_prefill_paged,
+    )
+    from langstream_tpu.models.paged import (
+        BlockManager,
+        PagedLayout,
+        init_paged_kv_cache,
+    )
+
+    c = dataclasses.replace(
+        LlamaConfig.tiny(max_seq_len=512), dtype=jnp.float32
+    )
+    params = init_llama_params(c, jax.random.PRNGKey(2))
+    layout = PagedLayout.for_model(512, 2, block_size=64)
+    rng = np.random.RandomState(0)
+    n = 64 + 250  # 64-token cached prefix + 250-token suffix (2 key blocks)
+    prompt = jnp.asarray(rng.randint(1, 300, size=(1, n)), jnp.int32)
+
+    bm = BlockManager(layout, 2)
+    bm.admit(0, n + 8)
+    bm.ensure_capacity(0, n)
+    pk, pv = init_paged_kv_cache(c, layout)
+    tables = jnp.asarray(bm.tables[[0]])
+    ref_logits, _, _ = llama_prefill_paged(
+        c, params, prompt, jnp.array([n]), pk, pv, tables, use_flash=False
+    )
+
+    bm2 = BlockManager(layout, 2)
+    bm2.admit(0, n + 8)
+    bm2.ensure_capacity(0, n)
+    pk2, pv2 = init_paged_kv_cache(c, layout)
+    t2 = jnp.asarray(bm2.tables[[0]])
+    _, pk2, pv2 = llama_prefill_paged(
+        c, params, prompt[:, :64], jnp.array([64]), pk2, pv2, t2,
+        use_flash=False,
+    )
+    suffix = jnp.zeros((1, 256), jnp.int32).at[:, :250].set(prompt[:, 64:])
+    cont_logits, _, _ = llama_prefill_continue_paged(
+        c, params, suffix, jnp.array([64]), jnp.array([250]), pk2, pv2, t2,
+        num_read_blocks=1,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(cont_logits), rtol=5e-4, atol=5e-4
+    )
