@@ -158,23 +158,33 @@ def llama_prefill_continue_paged(
             ).astype(jnp.float32)
             return o, l, m_new
 
-        # segment 1: pool history, one table column (= one block) at a time
-        def hist_step(carry, j):
-            cols = block_tables[:, j]                       # (B,)
+        # segment 1: pool history, ~128 rows of table columns per step (one
+        # tiny per-pool-block step would serialize the sweep ~128/bs-fold
+        # deeper for the same score memory)
+        cps = max(1, 128 // bs)                             # columns/step
+        n_hist_steps = -(-num_read_blocks // cps)
+
+        def hist_step(carry, t):
+            col_idx = t * cps + jnp.arange(cps)             # (cps,)
+            safe = jnp.minimum(col_idx, num_read_blocks - 1)
+            cols = jnp.take(block_tables, safe, axis=1)     # (B, cps)
             k_blk = jnp.take(ck_l, cols, axis=0).reshape(
-                B, bs, c.kv_heads, c.head_dim
+                B, cps * bs, c.kv_heads, c.head_dim
             )
             v_blk = jnp.take(cv_l, cols, axis=0).reshape(
-                B, bs, c.kv_heads, c.head_dim
+                B, cps * bs, c.kv_heads, c.head_dim
             )
-            w_pos = j * bs + jnp.arange(bs)                 # (bs,)
+            # positions from the UNclamped indices: a clamped (duplicate)
+            # tail column computes positions ≥ num_read_blocks·bs, which the
+            # < start mask can never admit (start ≤ num_read_blocks·bs)
+            w_pos = (col_idx[:, None] * bs + jnp.arange(bs)[None, :]).reshape(-1)
             mask = (w_pos[None, :] < start_lengths[:, None])[
                 :, None, None, None, :
             ]
             return online_update(carry, k_blk, v_blk, mask), None
 
         carry, _ = jax.lax.scan(
-            hist_step, (o0, l0, m0), jnp.arange(num_read_blocks)
+            hist_step, (o0, l0, m0), jnp.arange(n_hist_steps)
         )
 
         # segment 2: causal self-attention among the suffix, key-blocked

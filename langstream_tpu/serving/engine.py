@@ -141,6 +141,12 @@ class ServingConfig:
     # templates, chat history) adopt the cached blocks read-only and
     # prefill just the suffix — the TTFT lever for shared-prefix traffic
     prefix_cache: bool = True
+    # chunked prefill (paged layout only): prompts whose to-prefill length
+    # exceeds this are admitted immediately but prefilled prefill_chunk
+    # tokens at a time through the continuation path, INTERLEAVED with
+    # decode bursts — a long prompt no longer stalls every active stream
+    # for its whole prefill (head-of-line blocking). 0 disables.
+    prefill_chunk: int = 0
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -172,6 +178,7 @@ class ServingConfig:
             "dense-kernel": self.dense_kernel,
             "prefix-cache": self.prefix_cache,
             "prefix-cache-max-suffix": self.prefix_cache_max_suffix,
+            "prefill-chunk": self.prefill_chunk,
         }
 
     @classmethod
@@ -210,12 +217,19 @@ class ServingConfig:
                     d.get("prefix_cache_max_suffix", 4096),
                 )
             ),
+            prefill_chunk=int(
+                d.get("prefill-chunk", d.get("prefill_chunk", 0))
+            ),
         )
 
 
 @dataclasses.dataclass
 class _Slot:
     request: "_Request | None" = None
+    # chunked prefill: tokens committed so far / mid-prefill flag (the slot
+    # holds its reservation but is excluded from decode until done)
+    prefilling: bool = False
+    prefill_done: int = 0
 
     @property
     def free(self) -> bool:
@@ -419,6 +433,11 @@ class TpuServingEngine:
         elif self.config.quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {self.config.quantize!r}")
 
+        if self.config.prefill_chunk > 0 and self.config.kv_layout != "paged":
+            raise ValueError(
+                "prefill-chunk requires kv-layout=paged (chunked prefill "
+                "commits through the paged continuation path)"
+            )
         self.block_mgr = None
         if self.config.kv_layout == "paged":
             from langstream_tpu.models.paged import (
@@ -870,17 +889,28 @@ class TpuServingEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _has_prefilling(self) -> bool:
+        return any(s.prefilling for s in self.slots)
+
     async def _run_loop(self) -> None:
         loop = asyncio.get_running_loop()
         while not self._stop:
             try:
                 if not self._queue.empty():
                     await self._admit(loop)
-                active = [i for i, s in enumerate(self.slots) if not s.free]
+                if self._has_prefilling():
+                    # one bounded chunk per loop pass: long prefills make
+                    # progress without stalling the decode bursts below
+                    await self._advance_prefills(loop)
+                active = [
+                    i
+                    for i, s in enumerate(self.slots)
+                    if not s.free and not s.prefilling
+                ]
                 self._m_active(len(active))
                 self._m_queued(self._queue.qsize())
                 if not active:
-                    if self._queue.empty():
+                    if self._queue.empty() and not self._has_prefilling():
                         self._wake.clear()
                         try:
                             await asyncio.wait_for(self._wake.wait(), timeout=1.0)
@@ -906,6 +936,8 @@ class TpuServingEngine:
             if request is not None and not request.future.done():
                 request.future.set_exception(error)
             slot.request = None
+            slot.prefilling = False
+            slot.prefill_done = 0
             if self.block_mgr is not None:
                 self.block_mgr.release(slot_id)
         self._lengths[:] = 0
@@ -1027,7 +1059,12 @@ class TpuServingEngine:
             finished = self._process_chunk(chunk_t, chunk_lp, active)
             await self._flush_emits(active)
             out = await next_out_task
-            if finished or not self._queue.empty() or self._stop:
+            if (
+                finished
+                or not self._queue.empty()
+                or self._stop
+                or self._has_prefilling()  # interleave: yield to prefill chunks
+            ):
                 # drain the speculative chunk, then hand back to the loop
                 chunk_t, chunk_lp = await loop.run_in_executor(
                     self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))
@@ -1035,6 +1072,103 @@ class TpuServingEngine:
                 self._process_chunk(chunk_t, chunk_lp, active)
                 await self._flush_emits(active)
                 return
+
+    async def _advance_prefills(self, loop) -> None:
+        """One bounded chunk of progress for every mid-prefill slot, batched
+        through the continuation path. Intermediate chunks commit K/V only;
+        the FINAL chunk's sampled token (from the prompt's last position) is
+        the request's first generated token — the slot then joins decode."""
+        pre = [i for i, s in enumerate(self.slots) if s.prefilling]
+        if not pre:
+            return
+        C = self.config.prefill_chunk
+        Bp = 1
+        while Bp < len(pre):
+            Bp *= 2
+        tokens = np.zeros((Bp, C), dtype=np.int32)
+        starts = np.zeros(Bp, dtype=np.int32)
+        suffix_lens = np.zeros(Bp, dtype=np.int32)
+        slot_ids = np.zeros(Bp, dtype=np.int32)
+        temps = np.zeros(Bp, dtype=np.float32)
+        topks = np.zeros(Bp, dtype=np.int32)
+        topps = np.ones(Bp, dtype=np.float32)
+        for i in range(Bp):
+            slot_id = pre[min(i, len(pre) - 1)]
+            slot = self.slots[slot_id]
+            request = slot.request
+            chunk = request.prompt_tokens[
+                slot.prefill_done : slot.prefill_done + C
+            ]
+            tokens[i, : len(chunk)] = chunk
+            starts[i] = slot.prefill_done
+            suffix_lens[i] = len(chunk)
+            slot_ids[i] = slot_id
+            temps[i] = request.temperature
+            topks[i] = request.top_k
+            topps[i] = request.top_p
+        mode = self._sampler_mode(temps, topks, topps)
+        nrb = self._read_blocks_for(max(int(starts.max()), 1))
+        fn = self._prefill_continue_fn(mode, nrb)
+        sel_np = self.block_mgr.tables[slot_ids]
+        key = self._split_key()
+
+        def _run():
+            if self._lockstep is not None:
+                self._lockstep.broadcast(
+                    {
+                        "op": "prefill_continue",
+                        "sampler_mode": list(mode),
+                        "nrb": nrb,
+                        "tokens": tokens,
+                        "starts": starts,
+                        "lengths": suffix_lens,
+                        "sel": sel_np,
+                        "key": np.asarray(key),
+                        "temps": temps,
+                        "topks": topks,
+                        "topps": topps,
+                    }
+                )
+            return fn(
+                self.params, self.cache_k, self.cache_v,
+                jnp.asarray(tokens), jnp.asarray(starts),
+                jnp.asarray(suffix_lens), jnp.asarray(sel_np), key,
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+            )
+
+        next_tokens, logprobs, self.cache_k, self.cache_v = (
+            await loop.run_in_executor(self._executor, _run)
+        )
+        next_np = np.asarray(next_tokens)
+        logprob_np = np.asarray(logprobs)
+        now = time.monotonic()
+        done_slots = []
+        for i, slot_id in enumerate(pre):
+            slot = self.slots[slot_id]
+            request = slot.request
+            slot.prefill_done += int(suffix_lens[i])
+            if slot.prefill_done >= len(request.prompt_tokens):
+                self._lengths[slot_id] = len(request.prompt_tokens)
+                self._current[slot_id] = int(next_np[i])
+                self._temps[slot_id] = request.temperature
+                self._topks[slot_id] = request.top_k
+                self._topps[slot_id] = request.top_p
+                request.first_token_time = now
+                slot.prefilling = False
+                # register BEFORE emitting: a max-tokens=1 / instant-EOS
+                # request is released inside _emit_token, and registering
+                # against a released slot's empty table publishes nothing
+                if self.config.prefix_cache:
+                    self.block_mgr.register_prefix(
+                        slot_id, request.prompt_tokens
+                    )
+                self._emit_token(
+                    slot_id, int(next_np[i]), float(logprob_np[i])
+                )
+                done_slots.append(slot_id)
+                self._m_tokens(1)
+        if done_slots:
+            await self._flush_emits(done_slots)
 
     async def _admit(self, loop) -> None:
         """Admit queued requests in batched prefill calls (grouped by
@@ -1081,10 +1215,35 @@ class TpuServingEngine:
                         blocks, reuse = [], 0
                 else:
                     blocks, reuse = [], 0
-                b = _bucket(
-                    len(request.prompt_tokens) - reuse,
-                    hi=self.model_config.max_seq_len,
-                )
+                to_prefill = len(request.prompt_tokens) - reuse
+                if (
+                    self.block_mgr is not None
+                    and self.config.prefill_chunk > 0
+                    and to_prefill > self.config.prefill_chunk
+                ):
+                    # chunked prefill: claim the slot + reservation now, but
+                    # feed the prompt through _advance_prefills one bounded
+                    # chunk per loop pass instead of one monolithic prefill
+                    slot_id = free.pop(len(batch))
+                    self._queue.get_nowait()
+                    self.block_mgr.admit(
+                        slot_id,
+                        len(request.prompt_tokens) + request.max_tokens + 1,
+                    )
+                    if blocks:
+                        self.block_mgr.adopt_prefix(slot_id, blocks)
+                    self.block_mgr.ensure_capacity(
+                        slot_id, len(request.prompt_tokens)
+                    )
+                    slot = self.slots[slot_id]
+                    slot.request = request
+                    slot.prefilling = True
+                    slot.prefill_done = reuse
+                    if reuse:
+                        self._m_prefix_hits(1)
+                        self._m_prefix_tokens(reuse)
+                    continue
+                b = _bucket(to_prefill, hi=self.model_config.max_seq_len)
                 if bucket is None:
                     bucket = b
                 elif b != bucket:
@@ -1259,6 +1418,8 @@ class TpuServingEngine:
             self._pending_emits.append((request, token, logprob, done))
         if done:
             slot.request = None
+            slot.prefilling = False
+            slot.prefill_done = 0
             self._lengths[slot_id] = 0
             if self.block_mgr is not None:
                 # safe while a speculative chunk is in flight: it writes via
