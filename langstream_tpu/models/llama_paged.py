@@ -78,6 +78,9 @@ def llama_prefill_continue_paged(
     block_tables: jax.Array,   # (B, max_blocks)
     num_read_blocks: int,      # static: block columns covering max(start)
     ffn=None,
+    return_all_logits: bool = False,  # (B, P2, V) instead of last-token —
+                                      # the speculative verify step scores
+                                      # every draft position
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill CONTINUATION: process a prompt suffix whose prefix K/V is
     already in the paged pool (positions ``[0, start)`` per slot).
@@ -211,12 +214,17 @@ def llama_prefill_continue_paged(
 
     x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], pool_k, pool_v))
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    last = jnp.take_along_axis(
-        x, (suffix_lengths - 1)[:, None, None].clip(0), axis=1
-    ).squeeze(1)
-    logits = jnp.einsum("bh,hv->bv", last, _w(params["lm_head"])).astype(
-        jnp.float32
-    )
+    if return_all_logits:
+        logits = jnp.einsum("bph,hv->bpv", x, _w(params["lm_head"])).astype(
+            jnp.float32
+        )
+    else:
+        last = jnp.take_along_axis(
+            x, (suffix_lengths - 1)[:, None, None].clip(0), axis=1
+        ).squeeze(1)
+        logits = jnp.einsum("bh,hv->bv", last, _w(params["lm_head"])).astype(
+            jnp.float32
+        )
     L = c.layers
     pool_k = write_rows(
         pool_k, ks.reshape(L, B, P2, KhD), block_tables, start_lengths, pos_valid
@@ -225,6 +233,70 @@ def llama_prefill_continue_paged(
         pool_v, vs.reshape(L, B, P2, KhD), block_tables, start_lengths, pos_valid
     )
     return logits, pool_k, pool_v
+
+
+def llama_verify_chunk_paged(
+    config: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,         # (B, D1): [current, draft_0 .. draft_{D1-2}]
+    base_lengths: jax.Array,   # (B,) tokens in the pool per slot
+    active: jax.Array,         # (B,) bool
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    num_read_blocks: int,
+    ffn=None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Greedy speculative VERIFY step (prompt-lookup decoding).
+
+    One forward over ``D1 = 1 + drafts`` positions per slot scores every
+    draft in parallel; in-jit greedy acceptance keeps the longest prefix of
+    drafts the model itself would have produced, plus the model's one bonus
+    token after it. Drafts cost nothing when wrong (acceptance only ever
+    emits model-argmax tokens, so output streams are IDENTICAL to plain
+    greedy decode — speculation changes latency, never content).
+
+    Returns (emitted (B, D1) — model argmax at every position,
+    emit_counts (B,) — how many leading emitted tokens are real (1..D1),
+    next_tokens (B,), new_lengths (B,), pool_k, pool_v, logprobs (B, D1)).
+
+    K/V for all D1 positions is committed; rows past ``new_lengths`` hold
+    rejected drafts but every read masks to < length and the next step's
+    writes land exactly at ``new_lengths`` — the standard stale-row
+    argument of the prefill paths.
+    """
+    c = config
+    B, D1 = tokens.shape
+    # inactive rows get suffix length 0: their writes redirect to the
+    # scratch block instead of committing garbage through their REAL block
+    # tables (a mid-chunked-prefill slot, or shared prefix blocks, would
+    # otherwise be silently corrupted — the decode chunk masks its commit
+    # with `active` for exactly this reason)
+    suffix_lengths = jnp.where(active, D1, 0).astype(jnp.int32)
+    logits, pool_k, pool_v = llama_prefill_continue_paged(
+        c, params, tokens, base_lengths,
+        suffix_lengths, pool_k, pool_v, block_tables,
+        num_read_blocks, ffn=ffn, return_all_logits=True,
+    )  # logits (B, D1, V)
+    model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, D1)
+    logprobs = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), model_next[..., None], axis=-1
+    ).squeeze(-1)
+    # draft j (= input position j+1) is accepted iff every earlier draft
+    # matched and the model's token at position j equals it
+    drafts = tokens[:, 1:]                                   # (B, D1-1)
+    match = model_next[:, :-1] == drafts                     # (B, D1-1)
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    adv = jnp.where(active, accepted + 1, 0)                 # tokens emitted
+    new_lengths = base_lengths + adv
+    next_tokens = jnp.where(
+        active,
+        jnp.take_along_axis(
+            model_next, jnp.maximum(adv - 1, 0)[:, None], axis=1
+        ).squeeze(1),
+        tokens[:, 0],
+    )
+    return model_next, adv, next_tokens, new_lengths, pool_k, pool_v, logprobs
 
 
 def _cache_partial_xla(

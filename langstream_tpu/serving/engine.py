@@ -141,6 +141,14 @@ class ServingConfig:
     # templates, chat history) adopt the cached blocks read-only and
     # prefill just the suffix — the TTFT lever for shared-prefix traffic
     prefix_cache: bool = True
+    # prompt-lookup speculative decoding (paged layout, greedy bursts,
+    # single-host): each step drafts N continuation tokens by matching the
+    # context's last bigram earlier in the context (strong on RAG /
+    # summarization / code where output copies input) and verifies them in
+    # ONE forward; greedy acceptance emits only tokens the model would
+    # have produced anyway, so streams are bit-identical to plain decode —
+    # accepted drafts just arrive ~k tokens per step. 0 disables.
+    speculative_drafts: int = 0
     # chunked prefill (paged layout only): prompts whose to-prefill length
     # exceeds this are admitted immediately but prefilled prefill_chunk
     # tokens at a time through the continuation path, INTERLEAVED with
@@ -179,6 +187,7 @@ class ServingConfig:
             "prefix-cache": self.prefix_cache,
             "prefix-cache-max-suffix": self.prefix_cache_max_suffix,
             "prefill-chunk": self.prefill_chunk,
+            "speculative-drafts": self.speculative_drafts,
         }
 
     @classmethod
@@ -220,6 +229,9 @@ class ServingConfig:
             prefill_chunk=int(
                 d.get("prefill-chunk", d.get("prefill_chunk", 0))
             ),
+            speculative_drafts=int(
+                d.get("speculative-drafts", d.get("speculative_drafts", 0))
+            ),
         )
 
 
@@ -250,6 +262,11 @@ class _Request:
     loop: asyncio.AbstractEventLoop | None = None
     enqueue_time: float = 0.0
     first_token_time: float | None = None
+    # prompt-lookup speculation: bigram -> most recent first-element index,
+    # maintained incrementally (amortized O(1)/token; a backward rescan per
+    # verify step would be O(context) on the event-loop thread)
+    bigram_index: dict = dataclasses.field(default_factory=dict)
+    bigram_covered: int = 0
 
 
 def _bucket(n: int, lo: int = 32, hi: int = 32768) -> int:
@@ -376,6 +393,15 @@ class TpuServingEngine:
             "prefix_cache_tokens_reused_total",
             "prompt tokens served from cached prefix blocks (prefill skipped)",
         )
+        self._m_spec_steps = reporter.counter(
+            "speculative_steps_total", "speculative verify steps run"
+        )
+        self._m_spec_accepted = reporter.counter(
+            "speculative_drafts_accepted_total",
+            "draft tokens accepted by verify steps (free extra tokens)",
+        )
+        self.spec_steps = 0
+        self.spec_accepted = 0
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
 
@@ -437,6 +463,14 @@ class TpuServingEngine:
             raise ValueError(
                 "prefill-chunk requires kv-layout=paged (chunked prefill "
                 "commits through the paged continuation path)"
+            )
+        if (
+            self.config.speculative_drafts > 0
+            and self.config.kv_layout != "paged"
+        ):
+            raise ValueError(
+                "speculative-drafts requires kv-layout=paged (the verify "
+                "step commits through the paged continuation path)"
             )
         self.block_mgr = None
         if self.config.kv_layout == "paged":
@@ -733,6 +767,27 @@ class TpuServingEngine:
             return _prefill_cont
 
         self._make_prefill_continue = _make_prefill_continue
+
+        def _make_verify(nrb: int):
+            """Speculative greedy verify step (prompt-lookup decoding); the
+            draft count specializes via the tokens shape at trace time."""
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def _verify(params, cache_k, cache_v, tokens, lengths, active,
+                        tables):
+                from langstream_tpu.models.llama_paged import (
+                    llama_verify_chunk_paged,
+                )
+
+                return llama_verify_chunk_paged(
+                    mc_static, params, tokens, lengths, active,
+                    cache_k, cache_v, tables, num_read_blocks=nrb,
+                    ffn=ffn_static,
+                )
+
+            return _verify
+
+        self._make_verify = _make_verify
         # the sampler's expensive passes (top-p vocab sort, top-k selection
         # sweep, any sampling at all for greedy-only batches) are compiled
         # in only when an active request needs them; decode additionally
@@ -741,6 +796,7 @@ class TpuServingEngine:
         self._decode_chunk_fns: dict[tuple[tuple, int | None], Any] = {}
         self._prefill_fns: dict[tuple, Any] = {}
         self._prefill_continue_fns: dict[tuple[tuple, int], Any] = {}
+        self._verify_fns: dict[int, Any] = {}
 
     def _decode_fn(self, sampler_mode: tuple, window: int | None):
         key = (sampler_mode, window)
@@ -760,6 +816,11 @@ class TpuServingEngine:
                 sampler_mode, nrb
             )
         return self._prefill_continue_fns[key]
+
+    def _verify_fn(self, nrb: int):
+        if nrb not in self._verify_fns:
+            self._verify_fns[nrb] = self._make_verify(nrb)
+        return self._verify_fns[nrb]
 
     @staticmethod
     def _sampler_mode(temps, topks, topps) -> tuple:
@@ -860,6 +921,11 @@ class TpuServingEngine:
         }
         if self.block_mgr is not None:
             out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
+        if self.config.speculative_drafts > 0:
+            out["speculative"] = {
+                "steps": self.spec_steps,
+                "drafts_accepted": self.spec_accepted,
+            }
         return out
 
     async def close(self) -> None:
@@ -917,7 +983,19 @@ class TpuServingEngine:
                         except asyncio.TimeoutError:
                             pass
                     continue
-                await self._decode_burst(loop, active)
+                if (
+                    self.config.speculative_drafts > 0
+                    and self.block_mgr is not None
+                    and self._lockstep is None  # host drafts break replay
+                    and self._sampler_mode(
+                        self._temps[active], self._topks[active],
+                        self._topps[active],
+                    )
+                    == (False, False, True)  # greedy acceptance only
+                ):
+                    await self._speculative_burst(loop, active)
+                else:
+                    await self._decode_burst(loop, active)
             except Exception as e:  # device/runtime error: fail in-flight work,
                 # free the slots, keep serving (callers see the exception)
                 log.exception("serving engine step failed")
@@ -947,6 +1025,116 @@ class TpuServingEngine:
                 request.future.set_exception(error)
         self._pending_emits.clear()
         self._finished_requests.clear()
+
+    def _draft_tokens(self, slot_id: int, num_drafts: int) -> list[int]:
+        """Prompt-lookup draft: continue the context's most recent bigram
+        match. Unmatched slots get zero drafts — greedy verify accepts a
+        draft only when the model would have emitted it anyway, so a bad
+        draft costs nothing but the verified position."""
+        request = self.slots[slot_id].request
+        ctx = request.prompt_tokens + request.generated
+        n = len(ctx)
+        # index new bigrams whose SECOND element sits at <= n-2 (the final
+        # bigram is the query; it enters the index once the context grows)
+        idx = request.bigram_index
+        for i in range(max(request.bigram_covered, 1), n - 1):
+            idx[(ctx[i - 1], ctx[i])] = i - 1
+        request.bigram_covered = max(request.bigram_covered, n - 1)
+        if n >= 3:
+            pos = idx.get((ctx[-2], ctx[-1]))
+            if pos is not None:
+                cont = ctx[pos + 2 : pos + 2 + num_drafts]
+                return list(cont) + [0] * (num_drafts - len(cont))
+        return [0] * num_drafts
+
+    async def _speculative_burst(self, loop, active: list[int]) -> None:
+        """Greedy prompt-lookup speculative decoding: per step, each active
+        slot's drafted continuation is verified in one forward over D+1
+        positions; accepted drafts emit as a burst of tokens. Streams are
+        identical to plain greedy decode — only the tokens-per-step ratio
+        changes. Host round-trips per step (drafts need the emitted
+        context), so this path trades the pipelined chunk loop for up to
+        (D+1)x tokens per forward; workloads that copy from their context
+        (RAG, summarization, code edits) win, others see ~plain speed."""
+        D = self.config.speculative_drafts
+        D1 = D + 1
+        S = self.model_config.max_seq_len
+        while True:
+            live = [
+                i for i in active
+                if self.slots[i].request is not None
+                and not self.slots[i].prefilling
+            ]
+            if not live:
+                return
+            tokens = np.zeros((self.config.slots, D1), dtype=np.int32)
+            for slot_id in live:
+                self.block_mgr.ensure_capacity(
+                    slot_id, min(int(self._lengths[slot_id]) + D1, S)
+                )
+                tokens[slot_id, 0] = self._current[slot_id]
+                tokens[slot_id, 1:] = self._draft_tokens(slot_id, D)
+            tables = self.block_mgr.tables.copy()
+            active_mask = np.zeros(self.config.slots, dtype=bool)
+            active_mask[live] = True
+            nrb = self._read_blocks_for(
+                max(int(self._lengths[live].max()) if live else 1, 1)
+            )
+            fn = self._verify_fn(nrb)
+
+            def _run():
+                out = fn(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(tokens), jnp.asarray(self._lengths),
+                    jnp.asarray(active_mask), jnp.asarray(tables),
+                )
+                self.cache_k, self.cache_v = out[4], out[5]
+                return (
+                    np.asarray(out[0]), np.asarray(out[1]),
+                    np.asarray(out[2]), np.asarray(out[3]),
+                    np.asarray(out[6]),
+                )
+
+            emitted, adv, nxt, new_lengths, logprobs = (
+                await loop.run_in_executor(self._executor, _run)
+            )
+            self._m_spec_steps(1)
+            self.spec_steps += 1
+            finished = False
+            emitted_before = self.total_generated  # _emit_token counts each
+            for slot_id in live:
+                a = int(adv[slot_id])
+                base = int(self._lengths[slot_id])
+                done = False
+                for j in range(a):
+                    # advance the length BEFORE each emit so the emit-side
+                    # max_seq_len stop guard sees the true context size
+                    # (plain decode increments per step; a stale base would
+                    # let accepted drafts run past the cap and diverge from
+                    # the bit-identical-to-greedy invariant)
+                    self._lengths[slot_id] = base + j + 1
+                    done = self._emit_token(
+                        slot_id,
+                        int(emitted[slot_id, j]),
+                        float(logprobs[slot_id, j]),
+                    )
+                    if j > 0:
+                        self._m_spec_accepted(1)
+                        self.spec_accepted += 1
+                    if done:
+                        finished = True
+                        break
+                if not done:
+                    self._current[slot_id] = int(nxt[slot_id])
+            self._m_tokens(self.total_generated - emitted_before)
+            await self._flush_emits(live)
+            if (
+                finished
+                or not self._queue.empty()
+                or self._stop
+                or self._has_prefilling()
+            ):
+                return
 
     async def _decode_burst(self, loop, active: list[int]) -> None:
         """Pipelined chunk decoding: chunk k+1 is dispatched from chunk k's

@@ -1,0 +1,226 @@
+"""Prompt-lookup speculative decoding (greedy, paged).
+
+The invariant everything rests on: greedy acceptance emits only tokens the
+model's own argmax produces, so speculative streams are IDENTICAL to plain
+decode — speculation changes tokens-per-forward, never content. No
+reference analogue (completions were SaaS calls); this is in-tree serving
+tech on the TPU engine.
+"""
+
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engines():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    TpuServingEngine.reset_instances()
+    yield
+    TpuServingEngine.reset_instances()
+
+
+def greedy(logits, key):
+    t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return t, jnp.zeros_like(t, dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# verify chunk (model level, f32 for exactness)
+# ---------------------------------------------------------------------------
+
+
+def test_verify_chunk_acceptance_semantics():
+    """Correct drafts advance len(drafts)+1 in one forward; wrong drafts
+    degrade to exactly one plain greedy step; the committed cache continues
+    the reference stream either way."""
+    from langstream_tpu.models.llama import LlamaConfig, init_llama_params
+    from langstream_tpu.models.llama_paged import (
+        llama_decode_chunk_paged,
+        llama_prefill_paged,
+        llama_verify_chunk_paged,
+    )
+    from langstream_tpu.models.paged import (
+        BlockManager,
+        PagedLayout,
+        init_paged_kv_cache,
+    )
+
+    c = dataclasses.replace(LlamaConfig.tiny(max_seq_len=128), dtype=jnp.float32)
+    params = init_llama_params(c, jax.random.PRNGKey(5))
+    layout = PagedLayout.for_model(128, 2, block_size=16)
+    prompt = jnp.array([[5, 9, 17, 3, 11, 2, 7, 1]], jnp.int32)
+    n = 8
+
+    def fresh():
+        bm = BlockManager(layout, 2)
+        bm.admit(0, 40)
+        bm.ensure_capacity(0, 24)
+        pk, pv = init_paged_kv_cache(c, layout)
+        t = jnp.asarray(bm.tables[[0]])
+        logits, pk, pv = llama_prefill_paged(
+            c, params, prompt, jnp.array([n]), pk, pv, t, use_flash=False
+        )
+        return logits, pk, pv, t
+
+    # reference greedy continuation
+    logits, pk, pv, t = fresh()
+    tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    ct, _, _, _, pk, pv = llama_decode_chunk_paged(
+        c, params, tok0, jnp.array([n]), jnp.array([True]), pk, pv, t,
+        greedy, jax.random.PRNGKey(0), 6, num_read_blocks=2,
+    )
+    ref = [int(tok0[0])] + [int(x) for x in np.asarray(ct)[:, 0]]
+
+    # all-correct drafts: adv = drafts+1, emits = ref continuation
+    _, pk2, pv2, t2 = fresh()
+    good = jnp.array([[ref[0]] + ref[1:5]], jnp.int32)
+    em, adv, nxt, nl, pk2, pv2, _ = llama_verify_chunk_paged(
+        c, params, good, jnp.array([n]), jnp.array([True]), pk2, pv2, t2, 2
+    )
+    assert int(adv[0]) == 5
+    assert [int(x) for x in np.asarray(em)[0]] == ref[1:6]
+    assert int(nxt[0]) == ref[5] and int(nl[0]) == n + 5
+    # the committed cache continues the reference stream
+    ct2, _, _, _, _, _ = llama_decode_chunk_paged(
+        c, params, jnp.asarray([ref[5]]), jnp.array([n + 5]),
+        jnp.array([True]), pk2, pv2, t2, greedy, jax.random.PRNGKey(0), 1,
+        num_read_blocks=2,
+    )
+    assert int(np.asarray(ct2)[0, 0]) == ref[6]
+
+    # wrong drafts: exactly one plain step
+    _, pk3, pv3, t3 = fresh()
+    wrong = jnp.array([[ref[0], 333, 334, 335, 336]], jnp.int32)
+    em, adv, nxt, nl, _, _, _ = llama_verify_chunk_paged(
+        c, params, wrong, jnp.array([n]), jnp.array([True]), pk3, pv3, t3, 2
+    )
+    assert int(adv[0]) == 1
+    assert int(np.asarray(em)[0, 0]) == ref[1] and int(nl[0]) == n + 1
+
+
+# ---------------------------------------------------------------------------
+# engine level
+# ---------------------------------------------------------------------------
+
+BASE = dict(
+    model="tiny", slots=4, max_seq_len=256, decode_chunk=4,
+    kv_layout="paged", kv_block_size=16, paged_kernel="xla",
+)
+REPETITIVE = "the cat sat on the mat. " * 6
+
+
+def _gen(cfg_kwargs, prompt, options):
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def run():
+        eng = TpuServingEngine(ServingConfig(**cfg_kwargs))
+        try:
+            out = await eng.generate(prompt, options)
+            return out, eng.stats()
+        finally:
+            await eng.close()
+
+    return asyncio.run(run())
+
+
+def test_speculative_stream_identical_and_accepts():
+    r0, _ = _gen(BASE, REPETITIVE, {"max-tokens": 24})
+    r1, stats = _gen(
+        {**BASE, "speculative_drafts": 4}, REPETITIVE, {"max-tokens": 24}
+    )
+    assert r0["tokens"] == r1["tokens"]
+    assert stats["speculative"]["steps"] > 0
+    # repetitive text: fewer forwards than tokens (drafts accepted)
+    assert stats["speculative"]["drafts_accepted"] > 0
+    assert stats["speculative"]["steps"] < 24
+
+
+def test_speculative_sampled_requests_fall_back():
+    """Non-greedy requests route through the plain decode burst (greedy
+    acceptance doesn't apply); they must still complete."""
+    r, stats = _gen(
+        {**BASE, "speculative_drafts": 4},
+        REPETITIVE,
+        {"max-tokens": 12, "temperature": 0.8, "top-k": 20},
+    )
+    assert len(r["tokens"]) == 12
+    assert stats["speculative"]["steps"] == 0
+
+
+def test_speculative_concurrent_requests_complete():
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        eng = TpuServingEngine(
+            ServingConfig(**{**BASE, "speculative_drafts": 4})
+        )
+        try:
+            outs = await asyncio.gather(
+                *(
+                    eng.generate(REPETITIVE + f" q{i}", {"max-tokens": 10})
+                    for i in range(6)
+                )
+            )
+        finally:
+            await eng.close()
+        assert all(len(o["tokens"]) == 10 for o in outs)
+
+    asyncio.run(main())
+
+
+def test_speculative_requires_paged():
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    with pytest.raises(ValueError, match="speculative"):
+        TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=64,
+                kv_layout="dense", speculative_drafts=4,
+            )
+        )
+
+
+def test_speculative_with_chunked_prefill_and_prefix_cache():
+    """All three schedulers at once: a long prompt chunk-prefills while
+    another slot decodes speculatively; the verify step's commits must not
+    touch the mid-prefill slot's blocks (inactive rows redirect to
+    scratch). Both streams must equal a plain engine's."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    short = REPETITIVE
+    long_ = "copy this exact phrase again and again. " * 24
+
+    def run(spec, chunk):
+        async def main():
+            eng = TpuServingEngine(
+                ServingConfig(
+                    model="tiny", slots=4, max_seq_len=2048, decode_chunk=2,
+                    kv_layout="paged", kv_block_size=16, paged_kernel="xla",
+                    speculative_drafts=spec, prefill_chunk=chunk,
+                    prefix_cache=True,
+                )
+            )
+            try:
+                short_task = asyncio.ensure_future(
+                    eng.generate(short, {"max-tokens": 24})
+                )
+                await asyncio.sleep(0.05)  # short request starts decoding
+                long_out = await eng.generate(long_, {"max-tokens": 12})
+                short_out = await short_task
+            finally:
+                await eng.close()
+            return short_out["tokens"], long_out["tokens"]
+
+        return asyncio.run(main())
+
+    plain = run(0, 0)
+    combined = run(4, 64)
+    assert plain[0][:8] == combined[0][:8]   # short stream unchanged
+    assert plain[1][:8] == combined[1][:8]   # long stream unchanged
