@@ -271,8 +271,15 @@ def llama_verify_chunk_paged(
     # scratch block instead of committing garbage through their REAL block
     # tables (a mid-chunked-prefill slot, or shared prefix blocks, would
     # otherwise be silently corrupted — the decode chunk masks its commit
-    # with `active` for exactly this reason)
-    suffix_lengths = jnp.where(active, D1, 0).astype(jnp.int32)
+    # with `active` for exactly this reason). Rows are also capped at the
+    # context limit: positions ≥ max_seq_len would clamp to the slot's
+    # LAST table column in write_rows and overwrite committed K/V (the
+    # engine's emit guard stops streams before any such position's token
+    # is ever emitted, so capping the write loses nothing).
+    room = jnp.maximum(c.max_seq_len - base_lengths, 0)
+    suffix_lengths = jnp.where(
+        active, jnp.minimum(D1, room), 0
+    ).astype(jnp.int32)
     logits, pool_k, pool_v = llama_prefill_continue_paged(
         c, params, tokens, base_lengths,
         suffix_lengths, pool_k, pool_v, block_tables,

@@ -141,8 +141,8 @@ class ServingConfig:
     # templates, chat history) adopt the cached blocks read-only and
     # prefill just the suffix — the TTFT lever for shared-prefix traffic
     prefix_cache: bool = True
-    # prompt-lookup speculative decoding (paged layout, greedy bursts,
-    # single-host): each step drafts N continuation tokens by matching the
+    # prompt-lookup speculative decoding (paged layout, greedy bursts):
+    # each step drafts N continuation tokens by matching the
     # context's last bigram earlier in the context (strong on RAG /
     # summarization / code where output copies input) and verifies them in
     # ONE forward; greedy acceptance emits only tokens the model would
@@ -779,11 +779,13 @@ class TpuServingEngine:
                     llama_verify_chunk_paged,
                 )
 
-                return llama_verify_chunk_paged(
+                out = llama_verify_chunk_paged(
                     mc_static, params, tokens, lengths, active,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
                     ffn=ffn_static,
                 )
+                # the leader host reads everything but the pools each step
+                return _fetchable(*out[:4]) + out[4:6] + _fetchable(out[6])
 
             return _verify
 
@@ -986,7 +988,6 @@ class TpuServingEngine:
                 if (
                     self.config.speculative_drafts > 0
                     and self.block_mgr is not None
-                    and self._lockstep is None  # host drafts break replay
                     and self._sampler_mode(
                         self._temps[active], self._topks[active],
                         self._topps[active],
@@ -1081,11 +1082,25 @@ class TpuServingEngine:
                 max(int(self._lengths[live].max()) if live else 1, 1)
             )
             fn = self._verify_fn(nrb)
+            lengths_np = self._lengths.copy()
 
             def _run():
+                if self._lockstep is not None:
+                    # drafts are plain host data: followers replay the same
+                    # verify jit from the broadcast descriptor
+                    self._lockstep.broadcast(
+                        {
+                            "op": "verify",
+                            "nrb": nrb,
+                            "tokens": tokens,
+                            "lengths": lengths_np,
+                            "active": active_mask,
+                            "tables": tables,
+                        }
+                    )
                 out = fn(
                     self.params, self.cache_k, self.cache_v,
-                    jnp.asarray(tokens), jnp.asarray(self._lengths),
+                    jnp.asarray(tokens), jnp.asarray(lengths_np),
                     jnp.asarray(active_mask), jnp.asarray(tables),
                 )
                 self.cache_k, self.cache_v = out[4], out[5]

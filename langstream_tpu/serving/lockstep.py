@@ -375,6 +375,16 @@ class LockstepFollower:
                     jnp.asarray(desc["topps"]),
                 )
                 engine.cache_k, engine.cache_v = out[2], out[3]
+            elif op == "verify":
+                # speculative verify: drafts are host data the leader
+                # already broadcast — replay the same jit
+                fn = engine._verify_fn(int(desc["nrb"]))
+                out = fn(
+                    engine.params, engine.cache_k, engine.cache_v,
+                    jnp.asarray(desc["tokens"]), jnp.asarray(desc["lengths"]),
+                    jnp.asarray(desc["active"]), jnp.asarray(desc["tables"]),
+                )
+                engine.cache_k, engine.cache_v = out[4], out[5]
             elif op == "prefill_continue":
                 # prefix-cache suffix prefill: block adoption is host state
                 # the leader already resolved — the follower just replays

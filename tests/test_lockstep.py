@@ -36,13 +36,19 @@ def _sub_env() -> dict[str, str]:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("kv_layout", ["dense", "paged"])
-def test_two_process_lockstep_decode_matches_single_process(tmp_path, kv_layout):
+@pytest.mark.parametrize(
+    "kv_layout,spec",
+    [("dense", 0), ("paged", 0), ("paged", 4)],
+)
+def test_two_process_lockstep_decode_matches_single_process(
+    tmp_path, kv_layout, spec
+):
     coordinator_port = _free_port()
     lockstep_port = _free_port()
     out = tmp_path / "leader_tokens.json"
     env = _sub_env()
     env["LS_DEMO_KV"] = kv_layout
+    env["LS_DEMO_SPEC"] = str(spec)
 
     follower = subprocess.Popen(
         [
@@ -78,10 +84,12 @@ def test_two_process_lockstep_decode_matches_single_process(tmp_path, kv_layout)
     )
 
     os.environ["LS_DEMO_KV"] = kv_layout
+    os.environ["LS_DEMO_SPEC"] = str(spec)
     try:
         reference_tokens = run_single_process_reference(8)
     finally:
         os.environ.pop("LS_DEMO_KV", None)
+        os.environ.pop("LS_DEMO_SPEC", None)
     assert lockstep_tokens == reference_tokens
     assert len(lockstep_tokens) == 3
     assert all(len(stream) > 0 for stream in lockstep_tokens)

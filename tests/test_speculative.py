@@ -224,3 +224,20 @@ def test_speculative_with_chunked_prefill_and_prefix_cache():
     combined = run(4, 64)
     assert plain[0][:8] == combined[0][:8]   # short stream unchanged
     assert plain[1][:8] == combined[1][:8]   # long stream unchanged
+
+
+def test_speculative_at_context_cap_matches_plain():
+    """Near max_seq_len, a verify chunk wider than the remaining room must
+    not write past the cap (write_rows' block clamp would overwrite
+    committed rows in the slot's last block): streams stay identical to
+    plain greedy decode right up to the forced stop."""
+    cfg = dict(
+        model="tiny", slots=2, max_seq_len=64, decode_chunk=2,
+        kv_layout="paged", kv_block_size=16, paged_kernel="xla",
+        kv_pool_blocks=12,  # room for a full-context request + scratch
+    )
+    # prompt long enough that generation runs into the context cap
+    prompt = "the cat sat on the mat. the cat sat on the "
+    r0, _ = _gen(cfg, prompt, {"max-tokens": 60})
+    r1, _ = _gen({**cfg, "speculative_drafts": 4}, prompt, {"max-tokens": 60})
+    assert r0["tokens"] == r1["tokens"]

@@ -1,0 +1,83 @@
+"""Generate golden MoE fixtures from HuggingFace's Mixtral reference.
+
+Run ONCE (outputs checked in under ``tests/fixtures/moe_tiny_golden``):
+
+    python tools/gen_moe_golden_fixtures.py
+
+Same role as ``gen_golden_fixtures.py`` for the dense family: the MoE
+forward (router softmax, top-2 renormalized combine, expert SwiGLU,
+shared attention) and the Mixtral checkpoint-name mapping get pinned to
+an independent implementation. HF routes every token dropless; the test
+raises ``capacity_factor`` so the GShard capacity path is in its
+drop-free regime where the two formulations agree exactly.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import torch
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT = REPO / "tests" / "fixtures" / "moe_tiny_golden"
+
+from langstream_tpu.models.moe import MoEConfig as _JaxConfig  # noqa: E402
+
+_TINY = _JaxConfig.tiny(max_seq_len=128)
+
+
+def main() -> None:
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    config = MixtralConfig(
+        vocab_size=_TINY.vocab_size,
+        hidden_size=_TINY.hidden,
+        num_hidden_layers=_TINY.layers,
+        num_attention_heads=_TINY.heads,
+        num_key_value_heads=_TINY.kv_heads,
+        intermediate_size=_TINY.moe_intermediate,
+        num_local_experts=_TINY.experts,
+        num_experts_per_tok=_TINY.experts_per_token,
+        rope_theta=_TINY.rope_theta,
+        rms_norm_eps=_TINY.norm_eps,
+        max_position_embeddings=_TINY.max_seq_len,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        sliding_window=None,
+        attn_implementation="eager",
+        router_jitter_noise=0.0,
+    )
+    torch.manual_seed(4321)
+    model = MixtralForCausalLM(config)
+    model.eval()
+
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(0, _TINY.vocab_size, size=13).tolist(),
+        rng.integers(0, _TINY.vocab_size, size=7).tolist(),
+    ]
+
+    OUT.mkdir(parents=True, exist_ok=True)
+    torch.save(model.state_dict(), OUT / "pytorch_model.bin")
+
+    fixtures: dict[str, np.ndarray] = {}
+    with torch.no_grad():
+        for i, prompt in enumerate(prompts):
+            ids = torch.tensor([prompt], dtype=torch.long)
+            logits = model(ids).logits[0].float().numpy()
+            fixtures[f"prompt_{i}"] = np.asarray(prompt, dtype=np.int32)
+            fixtures[f"logits_{i}"] = logits
+            greedy = model.generate(
+                ids, max_new_tokens=6, do_sample=False,
+                pad_token_id=0,
+            )[0, len(prompt):].numpy()
+            fixtures[f"greedy_{i}"] = greedy.astype(np.int32)
+    np.savez(OUT / "golden.npz", **fixtures)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
