@@ -12,12 +12,21 @@ Resource shape (``configuration.yaml``):
       - type: "tpu-serving-configuration"
         name: "tpu"
         configuration:
-          model: "llama-1b"            # tiny | llama-1b | llama3-8b | llama3-70b
+          model: "llama-1b"            # tiny | llama-1b | llama3-8b |
+                                       # llama3-70b | moe-8x7b/mixtral-8x7b
           slots: 8
           max-seq-len: 2048
           tokenizer: null              # byte-level fallback; or local HF dir
           checkpoint: null             # local weights dir; random init otherwise
-          mesh: {dp: 1, tp: 8}         # omit for single device
+          mesh: {dp: 1, tp: 8}         # omit for single device; `sp` makes
+                                       # long prefills sequence-parallel,
+                                       # `ep` shards MoE experts
+          quantize: "int8"             # weight-only int8 (or null = bf16)
+          kv-layout: "paged"           # or "dense"; paged enables the three
+                                       # serving schedulers below
+          prefix-cache: true           # shared prompt prefixes skip prefill
+          prefill-chunk: 0             # >0: long prompts interleave with decode
+          speculative-drafts: 0        # >0: prompt-lookup speculation (greedy)
           embeddings-model: "minilm-l6"
 """
 
