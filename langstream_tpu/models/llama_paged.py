@@ -81,6 +81,10 @@ def llama_prefill_continue_paged(
     return_all_logits: bool = False,  # (B, P2, V) instead of last-token —
                                       # the speculative verify step scores
                                       # every draft position
+    kernel: str = "xla",  # history-segment read: "xla" (blocked gather,
+                          # every backend/mesh) | "pallas" |
+                          # "pallas-interpret" (multi-query scalar-prefetch
+                          # kernel, single-chip TPU fast path)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill CONTINUATION: process a prompt suffix whose prefix K/V is
     already in the paged pool (positions ``[0, start)`` per slot).
@@ -161,34 +165,71 @@ def llama_prefill_continue_paged(
             ).astype(jnp.float32)
             return o, l, m_new
 
-        # segment 1: pool history, ~128 rows of table columns per step (one
-        # tiny per-pool-block step would serialize the sweep ~128/bs-fold
-        # deeper for the same score memory)
-        cps = max(1, 128 // bs)                             # columns/step
-        n_hist_steps = -(-num_read_blocks // cps)
-
-        def hist_step(carry, t):
-            col_idx = t * cps + jnp.arange(cps)             # (cps,)
-            safe = jnp.minimum(col_idx, num_read_blocks - 1)
-            cols = jnp.take(block_tables, safe, axis=1)     # (B, cps)
-            k_blk = jnp.take(ck_l, cols, axis=0).reshape(
-                B, cps * bs, c.kv_heads, c.head_dim
+        if kernel != "xla":
+            # multi-query scalar-prefetch kernel: no densified gather, the
+            # block table drives the DMA (ops/paged_attention.py)
+            from langstream_tpu.ops.paged_attention import (
+                paged_attention_multiquery_partial,
             )
-            v_blk = jnp.take(cv_l, cols, axis=0).reshape(
-                B, cps * bs, c.kv_heads, c.head_dim
-            )
-            # positions from the UNclamped indices: a clamped (duplicate)
-            # tail column computes positions ≥ num_read_blocks·bs, which the
-            # < start mask can never admit (start ≤ num_read_blocks·bs)
-            w_pos = (col_idx[:, None] * bs + jnp.arange(bs)[None, :]).reshape(-1)
-            mask = (w_pos[None, :] < start_lengths[:, None])[
-                :, None, None, None, :
-            ]
-            return online_update(carry, k_blk, v_blk, mask), None
 
-        carry, _ = jax.lax.scan(
-            hist_step, (o0, l0, m0), jnp.arange(n_hist_steps)
-        )
+            # keep (t_block·G)-row MXU tiles even for narrow suffixes
+            # (speculative verify runs D1 = 1+drafts wide): history
+            # attention is mask-uniform across queries, so padded rows
+            # compute harmless extra attention that is sliced away
+            tb = min(16, -(-P2 // 8) * 8)
+            P2p = -(-P2 // tb) * tb
+            qk = (
+                jnp.pad(q, ((0, 0), (0, P2p - P2), (0, 0), (0, 0)))
+                if P2p != P2
+                else q
+            )
+            acc_h, m_h, l_h = paged_attention_multiquery_partial(
+                qk, ck_l, cv_l, block_tables, start_lengths,
+                num_read_blocks=num_read_blocks,
+                kv_heads=c.kv_heads, head_dim=c.head_dim, t_block=tb,
+                scale=scale, interpret=(kernel == "pallas-interpret"),
+            )
+            acc_h = acc_h[:, :P2]
+            m_h, l_h = m_h[:, :P2], l_h[:, :P2]
+            # (B, P2, H[, D]) → the (B, Kh, G, P2[, D]) carry layout
+            carry = (
+                acc_h.reshape(B, P2, c.kv_heads, G, c.head_dim).transpose(
+                    0, 2, 3, 1, 4
+                ),
+                l_h.reshape(B, P2, c.kv_heads, G).transpose(0, 2, 3, 1),
+                m_h.reshape(B, P2, c.kv_heads, G).transpose(0, 2, 3, 1),
+            )
+        else:
+            # segment 1: pool history, ~128 rows of table columns per step
+            # (one tiny per-pool-block step would serialize the sweep
+            # ~128/bs-fold deeper for the same score memory)
+            cps = max(1, 128 // bs)                         # columns/step
+            n_hist_steps = -(-num_read_blocks // cps)
+
+            def hist_step(carry, t):
+                col_idx = t * cps + jnp.arange(cps)         # (cps,)
+                safe = jnp.minimum(col_idx, num_read_blocks - 1)
+                cols = jnp.take(block_tables, safe, axis=1)  # (B, cps)
+                k_blk = jnp.take(ck_l, cols, axis=0).reshape(
+                    B, cps * bs, c.kv_heads, c.head_dim
+                )
+                v_blk = jnp.take(cv_l, cols, axis=0).reshape(
+                    B, cps * bs, c.kv_heads, c.head_dim
+                )
+                # positions from the UNclamped indices: a clamped
+                # (duplicate) tail column computes positions ≥
+                # num_read_blocks·bs, which the < start mask never admits
+                w_pos = (
+                    col_idx[:, None] * bs + jnp.arange(bs)[None, :]
+                ).reshape(-1)
+                mask = (w_pos[None, :] < start_lengths[:, None])[
+                    :, None, None, None, :
+                ]
+                return online_update(carry, k_blk, v_blk, mask), None
+
+            carry, _ = jax.lax.scan(
+                hist_step, (o0, l0, m0), jnp.arange(n_hist_steps)
+            )
 
         # segment 2: causal self-attention among the suffix, key-blocked
         def suf_step(carry, t):
@@ -246,6 +287,7 @@ def llama_verify_chunk_paged(
     block_tables: jax.Array,
     num_read_blocks: int,
     ffn=None,
+    kernel: str = "xla",  # history read (see llama_prefill_continue_paged)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Greedy speculative VERIFY step (prompt-lookup decoding).
 
@@ -283,7 +325,7 @@ def llama_verify_chunk_paged(
     logits, pool_k, pool_v = llama_prefill_continue_paged(
         c, params, tokens, base_lengths,
         suffix_lengths, pool_k, pool_v, block_tables,
-        num_read_blocks, ffn=ffn, return_all_logits=True,
+        num_read_blocks, ffn=ffn, return_all_logits=True, kernel=kernel,
     )  # logits (B, D1, V)
     model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, D1)
     logprobs = jnp.take_along_axis(

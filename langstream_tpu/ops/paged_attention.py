@@ -186,6 +186,199 @@ def paged_attention_partial(
     return acc, m[:, :, 0], l[:, :, 0]
 
 
+def _paged_mq_kernel(
+    tables_ref,   # SMEM (B, max_blocks) int32
+    starts_ref,   # SMEM (B,) int32 — history rows per slot
+    q_ref,        # (1, tb, H, D)
+    k_ref,        # (1, bs, KhD)
+    v_ref,        # (1, bs, KhD)
+    acc_out,      # (1, tb*H, D) f32
+    m_out,        # (1, tb*H, 8) f32 — narrow HBM output, lane 0 is read
+    l_out,        # (1, tb*H, 8) f32
+    m_ref,        # VMEM (tb*H, 128) f32
+    l_ref,        # VMEM (tb*H, 128) f32
+    acc_ref,      # VMEM (tb*H, D) f32
+    *,
+    scale: float,
+    block_size: int,
+    kv_heads: int,
+    head_dim: int,
+    t_block: int,
+):
+    b = pl.program_id(0)
+    ji = pl.program_id(2)
+    num_j = pl.num_programs(2)
+    length = starts_ref[b]
+    start = ji * block_size
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(start < length)
+    def _accumulate():
+        T = t_block
+        D = head_dim
+        H = acc_ref.shape[0] // T
+        G = H // kv_heads
+        q = q_ref[0]                                     # (T, H, D)
+        k = k_ref[0].reshape(block_size, kv_heads, D)
+        v = v_ref[0].reshape(block_size, kv_heads, D)
+        # rows per kv head: T query positions × G grouped heads — every
+        # history key is visible to every suffix query (rows < start), so
+        # unlike causal attention the mask is uniform across the T axis
+        qg = (
+            q.reshape(T, kv_heads, G, D)
+            .transpose(1, 0, 2, 3)
+            .reshape(kv_heads, T * G, D)
+        )
+        kb = k.transpose(1, 0, 2)                        # (Kh, bs, D)
+        s = jax.lax.dot_general(
+            qg, kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # (Kh, T*G, bs)
+        cols = start + jax.lax.broadcasted_iota(
+            jnp.int32, (kv_heads, T * G, block_size), 2
+        )
+        mask = cols < length
+        s = jnp.where(mask, s, NEG_INF)
+        # working layout (T*H,) = (Kh, T, G) flattened to match acc rows
+        TH = T * kv_heads * G
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=2).reshape(TH)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - shift.reshape(kv_heads, T * G)[..., None])
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - shift))
+        l_ref[:] = jnp.broadcast_to(
+            (l_prev * alpha + jnp.sum(p, axis=2).reshape(TH))[:, None],
+            l_ref.shape,
+        )
+        vb = v.transpose(1, 0, 2)                        # (Kh, bs, D)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                                # (Kh, T*G, D)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv.reshape(TH, D)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(ji == num_j - 1)
+    def _finalize():
+        acc_out[0] = acc_ref[:]
+        m_out[0] = m_ref[:, :8]
+        l_out[0] = l_ref[:, :8]
+
+
+def paged_attention_multiquery_partial(
+    q: jax.Array,             # (B, T, H, D) — T suffix queries per slot
+    k_pool: jax.Array,        # (nb, bs, Kh*D)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    starts: jax.Array,        # (B,) int32 — history rows per slot
+    *,
+    num_read_blocks: int,
+    kv_heads: int,
+    head_dim: int,
+    t_block: int = 16,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Multi-query twin of :func:`paged_attention_partial`: T suffix
+    queries per slot attend the slot's paged HISTORY (rows ``< starts``) —
+    the continuation-prefill / speculative-verify hot read. History is
+    mask-uniform across the T axis (causality among the suffix itself is
+    the caller's separate XLA segment), so the kernel is the single-query
+    sweep with a query-block grid axis and (T·G)-row MXU tiles instead of
+    G-row ones.
+
+    Returns ``(acc (B,T,H,D) f32, m (B,T,H) f32, l (B,T,H) f32)``.
+    ``T`` must be a multiple of ``t_block``.
+    """
+    B, T, H, D = q.shape
+    nb, bs, KhD = k_pool.shape
+    if T % t_block:
+        raise ValueError(f"T={T} must be a multiple of t_block={t_block}")
+    nt = T // t_block
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    THb = t_block * H
+    kernel = functools.partial(
+        _paged_mq_kernel,
+        scale=scale,
+        block_size=bs,
+        kv_heads=kv_heads,
+        head_dim=head_dim,
+        t_block=t_block,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, nt, num_read_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, t_block, H, D),
+                lambda b, t, j, tables, starts: (b, t, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, KhD),
+                lambda b, t, j, tables, starts: (tables[b, j], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, KhD),
+                lambda b, t, j, tables, starts: (tables[b, j], 0, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, THb, D), lambda b, t, j, tables, starts: (b, t, 0)
+            ),
+            # m/l outputs are narrow (callers read one lane): the scratch
+            # keeps the 128-lane compute layout, but materializing
+            # (B, T·H, 128) f32 in HBM would be a 16× transient that now
+            # scales with the suffix length
+            pl.BlockSpec(
+                (1, THb, 8), lambda b, t, j, tables, starts: (b, t, 0)
+            ),
+            pl.BlockSpec(
+                (1, THb, 8), lambda b, t, j, tables, starts: (b, t, 0)
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((THb, 128), jnp.float32),
+            pltpu.VMEM((THb, 128), jnp.float32),
+            pltpu.VMEM((THb, D), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nt * THb, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, nt * THb, 8), jnp.float32),
+            jax.ShapeDtypeStruct((B, nt * THb, 8), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_tables, starts, q, k_pool, v_pool)
+    # kernel rows are (Kh, t, G)-major per t-block → back to (B, T, H)
+    G = H // kv_heads
+
+    def unflatten(x, *trail):
+        x = x.reshape(B, nt, kv_heads, t_block, G, *trail)
+        x = x.transpose(0, 1, 3, 2, 4, *range(5, 5 + len(trail)))
+        return x.reshape(B, T, H, *trail)
+
+    acc = unflatten(acc, D)
+    m = unflatten(m[:, :, 0])
+    l = unflatten(l[:, :, 0])
+    return acc, m, l
+
+
 def merge_partial_attention(
     parts: list[tuple[jax.Array, jax.Array, jax.Array]],
 ) -> jax.Array:

@@ -753,7 +753,7 @@ class TpuServingEngine:
                 logits, ck, cv = llama_prefill_continue_paged(
                     mc_static, params, tokens, starts, suffix_lengths,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
-                    ffn=ffn_static,
+                    ffn=ffn_static, kernel=self._continuation_kernel(),
                 )
                 next_tokens, logprobs = _fetchable(
                     *sample_tokens(
@@ -782,7 +782,7 @@ class TpuServingEngine:
                 out = llama_verify_chunk_paged(
                     mc_static, params, tokens, lengths, active,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
-                    ffn=ffn_static,
+                    ffn=ffn_static, kernel=self._continuation_kernel(),
                 )
                 # the leader host reads everything but the pools each step
                 return _fetchable(*out[:4]) + out[4:6] + _fetchable(out[6])
@@ -818,6 +818,16 @@ class TpuServingEngine:
                 sampler_mode, nrb
             )
         return self._prefill_continue_fns[key]
+
+    def _continuation_kernel(self) -> str:
+        """History-read kernel for continuation/verify: the multi-query
+        Pallas kernel on single-chip TPU, XLA gather elsewhere (meshes keep
+        XLA — pallas_call has no SPMD rule and these paths aren't
+        shard_map'd yet)."""
+        if self.block_mgr is None or self.mesh is not None:
+            return "xla"
+        # paged_read_kernel is resolved away from "auto" at init
+        return self.paged_read_kernel
 
     def _verify_fn(self, nrb: int):
         if nrb not in self._verify_fns:

@@ -241,3 +241,16 @@ def test_speculative_at_context_cap_matches_plain():
     r0, _ = _gen(cfg, prompt, {"max-tokens": 60})
     r1, _ = _gen({**cfg, "speculative_drafts": 4}, prompt, {"max-tokens": 60})
     assert r0["tokens"] == r1["tokens"]
+
+
+def test_speculative_with_pallas_interpret_kernel():
+    """The engine's speculative path with the multi-query Pallas kernel
+    (interpret mode) produces the same stream as the XLA path."""
+    r0, _ = _gen(BASE, REPETITIVE, {"max-tokens": 12})
+    r1, stats = _gen(
+        {**BASE, "speculative_drafts": 4, "paged_kernel": "pallas-interpret"},
+        REPETITIVE,
+        {"max-tokens": 12},
+    )
+    assert r0["tokens"] == r1["tokens"]
+    assert stats["speculative"]["steps"] > 0
