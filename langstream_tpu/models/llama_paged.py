@@ -84,7 +84,9 @@ def llama_prefill_continue_paged(
     kernel: str = "xla",  # history-segment read: "xla" (blocked gather,
                           # every backend/mesh) | "pallas" |
                           # "pallas-interpret" (multi-query scalar-prefetch
-                          # kernel, single-chip TPU fast path)
+                          # kernel; under a mesh it runs per-shard via
+                          # shard_map — slots on dp, heads on tp)
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill CONTINUATION: process a prompt suffix whose prefix K/V is
     already in the paged pool (positions ``[0, start)`` per slot).
@@ -183,12 +185,37 @@ def llama_prefill_continue_paged(
                 if P2p != P2
                 else q
             )
-            acc_h, m_h, l_h = paged_attention_multiquery_partial(
-                qk, ck_l, cv_l, block_tables, start_lengths,
-                num_read_blocks=num_read_blocks,
-                kv_heads=c.kv_heads, head_dim=c.head_dim, t_block=tb,
-                scale=scale, interpret=(kernel == "pallas-interpret"),
-            )
+
+            def mq_partial(q_, ck_, cv_, tables_, starts_, kv_heads):
+                return paged_attention_multiquery_partial(
+                    q_, ck_, cv_, tables_, starts_,
+                    num_read_blocks=num_read_blocks,
+                    kv_heads=kv_heads, head_dim=c.head_dim, t_block=tb,
+                    scale=scale, interpret=(kernel == "pallas-interpret"),
+                )
+
+            if mesh is not None and len(mesh.devices.flatten()) > 1:
+                # pallas_call has no SPMD rule: shared mesh wrapper — slots
+                # on dp, heads on tp, per-axis degradation
+                from langstream_tpu.ops.paged_attention import (
+                    shard_mapped_paged_read,
+                )
+
+                acc_h, m_h, l_h = shard_mapped_paged_read(
+                    mq_partial, mesh,
+                    kv_heads=c.kv_heads, batch=B,
+                    q_spec_tail=(None, "tp", None),       # (B, P2p, H, D)
+                    out_spec_tails=(
+                        (None, "tp", None),               # acc (B,T,H,D)
+                        (None, "tp"),                     # m (B,T,H)
+                        (None, "tp"),                     # l (B,T,H)
+                    ),
+                )(qk, ck_l, cv_l, block_tables, start_lengths)
+            else:
+                acc_h, m_h, l_h = mq_partial(
+                    qk, ck_l, cv_l, block_tables, start_lengths,
+                    kv_heads=c.kv_heads,
+                )
             acc_h = acc_h[:, :P2]
             m_h, l_h = m_h[:, :P2], l_h[:, :P2]
             # (B, P2, H[, D]) → the (B, Kh, G, P2[, D]) carry layout
@@ -288,6 +315,7 @@ def llama_verify_chunk_paged(
     num_read_blocks: int,
     ffn=None,
     kernel: str = "xla",  # history read (see llama_prefill_continue_paged)
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Greedy speculative VERIFY step (prompt-lookup decoding).
 
@@ -326,6 +354,7 @@ def llama_verify_chunk_paged(
         c, params, tokens, base_lengths,
         suffix_lengths, pool_k, pool_v, block_tables,
         num_read_blocks, ffn=ffn, return_all_logits=True, kernel=kernel,
+        mesh=mesh,
     )  # logits (B, D1, V)
     model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, D1)
     logprobs = jnp.take_along_axis(
@@ -431,30 +460,17 @@ def llama_decode_chunk_paged(
                 c, q, ck_l, cv_l, block_tables, base_lengths, num_read_blocks
             )
         if mesh is not None and len(mesh.devices.flatten()) > 1:
-            # pallas_call has no SPMD rule: shard_map it — slots on dp, heads
-            # on tp (the pool's flattened Kh*D axis splits on head boundaries
-            # because Kh % tp == 0), each device sweeping its own shard
-            from functools import partial as _partial
+            # pallas_call has no SPMD rule: shared mesh wrapper — slots on
+            # dp, heads on tp, per-axis degradation
+            from langstream_tpu.ops.paged_attention import (
+                shard_mapped_paged_read,
+            )
 
-            from jax.experimental.shard_map import shard_map
-            from jax.sharding import PartitionSpec as P
-
-            axes = mesh.axis_names
-            dp = "dp" if "dp" in axes and mesh.shape["dp"] > 1 else None
-            tp = "tp" if "tp" in axes and mesh.shape["tp"] > 1 else None
-            tp_size = mesh.shape["tp"] if tp else 1
-            return shard_map(
-                _partial(_kernel_partial, kv_heads=c.kv_heads // tp_size),
-                mesh=mesh,
-                in_specs=(
-                    P(dp, tp, None),    # q (B, H, D)
-                    P(None, None, tp),  # k_pool (nb, bs, Kh*D)
-                    P(None, None, tp),  # v_pool
-                    P(dp, None),        # block tables (B, max_blocks)
-                    P(dp),              # lengths (B,)
-                ),
-                out_specs=(P(dp, tp, None), P(dp, tp), P(dp, tp)),
-                check_rep=False,
+            return shard_mapped_paged_read(
+                _kernel_partial, mesh,
+                kv_heads=c.kv_heads, batch=B,
+                q_spec_tail=("tp", None),                  # (B, H, D)
+                out_spec_tails=(("tp", None), ("tp",), ("tp",)),
             )(q, ck_l, cv_l, block_tables, base_lengths)
         return _kernel_partial(
             q, ck_l, cv_l, block_tables, base_lengths, c.kv_heads

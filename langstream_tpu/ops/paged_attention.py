@@ -40,6 +40,13 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
 def _paged_kernel(
+    # NOTE: _paged_mq_kernel below is this kernel's multi-query twin
+    # (this one is its t_block=1 special case). They are kept separate ON
+    # PURPOSE for now: this kernel is the recorded decode benchmark's hot
+    # path, validated on real hardware, and consolidating the two must be
+    # done with the device microbenchmark in hand (round-4 item) — not
+    # blind. Any fix to the online-softmax discipline here must be
+    # mirrored there until they merge.
     tables_ref,   # SMEM (B, max_blocks) int32
     lengths_ref,  # SMEM (B,) int32
     q_ref,        # (1, H, D)
@@ -377,6 +384,61 @@ def paged_attention_multiquery_partial(
     m = unflatten(m[:, :, 0])
     l = unflatten(l[:, :, 0])
     return acc, m, l
+
+
+def shard_mapped_paged_read(
+    fn,                       # per-shard partial fn(..., kv_heads=) → 3-tuple
+    mesh,
+    *,
+    kv_heads: int,
+    batch: int,
+    q_spec_tail: tuple,       # q PartitionSpec entries AFTER the batch axis
+    out_spec_tails: tuple,    # per-output spec entries after the batch axis
+):
+    """Shared mesh wrapper for the paged read kernels (decode single-query
+    and continuation multi-query): slots on ``dp``, heads on ``tp`` (the
+    pool's fused Kh·D axis splits on head boundaries), degrading an axis to
+    replicated when the batch doesn't divide ``dp`` or the KV heads don't
+    divide ``tp``. One copy so the two call sites can't drift."""
+    from functools import partial as _partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = mesh.axis_names
+    dp = (
+        "dp"
+        if "dp" in axes and mesh.shape["dp"] > 1 and batch % mesh.shape["dp"] == 0
+        else None
+    )
+    tp = (
+        "tp"
+        if "tp" in axes
+        and mesh.shape["tp"] > 1
+        and kv_heads % mesh.shape["tp"] == 0
+        else None
+    )
+    tp_size = mesh.shape["tp"] if tp else 1
+
+    def sub(entry):
+        return {"dp": dp, "tp": tp}.get(entry, entry) if entry else None
+
+    q_spec = P(dp, *(sub(e) for e in q_spec_tail))
+    return shard_map(
+        _partial(fn, kv_heads=kv_heads // tp_size),
+        mesh=mesh,
+        in_specs=(
+            q_spec,
+            P(None, None, tp),  # k pool (nb, bs, Kh·D)
+            P(None, None, tp),  # v pool
+            P(dp, None),        # block tables (B, max_blocks)
+            P(dp),              # lengths/starts (B,)
+        ),
+        out_specs=tuple(
+            P(dp, *(sub(e) for e in tail)) for tail in out_spec_tails
+        ),
+        check_vma=False,
+    )
 
 
 def merge_partial_attention(

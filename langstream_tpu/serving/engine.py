@@ -754,6 +754,7 @@ class TpuServingEngine:
                     mc_static, params, tokens, starts, suffix_lengths,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
                     ffn=ffn_static, kernel=self._continuation_kernel(),
+                    mesh=mesh_static,
                 )
                 next_tokens, logprobs = _fetchable(
                     *sample_tokens(
@@ -783,6 +784,7 @@ class TpuServingEngine:
                     mc_static, params, tokens, lengths, active,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
                     ffn=ffn_static, kernel=self._continuation_kernel(),
+                    mesh=mesh_static,
                 )
                 # the leader host reads everything but the pools each step
                 return _fetchable(*out[:4]) + out[4:6] + _fetchable(out[6])
@@ -821,10 +823,9 @@ class TpuServingEngine:
 
     def _continuation_kernel(self) -> str:
         """History-read kernel for continuation/verify: the multi-query
-        Pallas kernel on single-chip TPU, XLA gather elsewhere (meshes keep
-        XLA — pallas_call has no SPMD rule and these paths aren't
-        shard_map'd yet)."""
-        if self.block_mgr is None or self.mesh is not None:
+        Pallas kernel on TPU (per-shard via shard_map under a mesh — slots
+        on dp, heads on tp), XLA gather elsewhere."""
+        if self.block_mgr is None:
             return "xla"
         # paged_read_kernel is resolved away from "auto" at init
         return self.paged_read_kernel

@@ -901,3 +901,73 @@ def test_continuation_pallas_kernel_matches_xla():
     np.testing.assert_allclose(
         outs["xla"][1], outs["pallas-interpret"][1], rtol=1e-4, atol=1e-4
     )
+
+
+def test_continuation_pallas_kernel_sharded_matches_xla():
+    """The multi-query kernel under a dp×tp mesh (shard_map, interpret)
+    matches the XLA continuation path — the TP-serving prefix-cache /
+    verify read keeps the kernel."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from langstream_tpu.models.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_param_specs,
+    )
+    from langstream_tpu.models.llama_paged import (
+        llama_prefill_continue_paged,
+        llama_prefill_paged,
+    )
+    from langstream_tpu.models.paged import (
+        BlockManager,
+        PagedLayout,
+        init_paged_kv_cache,
+        paged_cache_spec,
+    )
+    from langstream_tpu.parallel.mesh import make_mesh
+
+    c = dataclasses.replace(LlamaConfig.tiny(max_seq_len=128), dtype=jnp.float32)
+    params = init_llama_params(c, jax.random.PRNGKey(1))
+    layout = PagedLayout.for_model(128, 4, block_size=16)
+    rng = np.random.RandomState(3)
+    prompt = jnp.asarray(rng.randint(1, 300, size=(2, 48)), jnp.int32)
+    suffix = jnp.asarray(rng.randint(1, 300, size=(2, 16)), jnp.int32)
+
+    def setup(mesh=None):
+        bm = BlockManager(layout, 4)
+        for s in (0, 1):
+            bm.admit(s, 72)
+            bm.ensure_capacity(s, 64)
+        pk, pv = init_paged_kv_cache(c, layout)
+        t = jnp.asarray(bm.tables[[0, 1]])
+        p = params
+        if mesh is not None:
+            p = jax.tree.map(
+                lambda w, s: jax.device_put(w, NamedSharding(mesh, s)),
+                params, llama_param_specs(c),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            cspec = NamedSharding(mesh, paged_cache_spec(mesh.axis_names))
+            pk, pv = jax.device_put(pk, cspec), jax.device_put(pv, cspec)
+        _, pk, pv = llama_prefill_paged(
+            c, p, prompt, jnp.array([48, 48]), pk, pv, t, use_flash=False
+        )
+        return p, pk, pv, t
+
+    p0, pk, pv, t = setup()
+    ref, _, _ = llama_prefill_continue_paged(
+        c, p0, suffix, jnp.array([48, 48]), jnp.array([16, 16]), pk, pv, t,
+        num_read_blocks=3, kernel="xla",
+    )
+
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    p1, pk, pv, t = setup(mesh)
+    got, _, _ = llama_prefill_continue_paged(
+        c, p1, suffix, jnp.array([48, 48]), jnp.array([16, 16]), pk, pv, t,
+        num_read_blocks=3, kernel="pallas-interpret", mesh=mesh,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=1e-3, atol=1e-3
+    )
