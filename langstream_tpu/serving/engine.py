@@ -14,6 +14,13 @@ Execution model:
   record loop; the engine itself is agnostic to commits.
 - **Sharding**: with a mesh, params are TP-sharded (Megatron), cache shards
   KV heads on ``tp`` and slots on ``dp``; XLA places the collectives on ICI.
+  An ``sp`` axis makes long prefills sequence-parallel (ring attention);
+  ``ep`` shards MoE experts.
+- **Paged serving schedulers** (``kv-layout: paged``): automatic prefix
+  caching (shared prompt prefixes adopt content-addressed blocks; suffix-
+  only prefill), chunked prefill (long prompts interleave with decode
+  bursts), and prompt-lookup speculative decoding (greedy bursts verify
+  drafted continuations — streams bit-identical to plain decode).
 
 JAX calls are dispatched through a single-thread executor so the asyncio
 event loop (broker I/O, gateways) never blocks on device execution —
