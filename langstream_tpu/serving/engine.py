@@ -1299,6 +1299,17 @@ class TpuServingEngine:
         through the continuation path. Intermediate chunks commit K/V only;
         the FINAL chunk's sampled token (from the prompt's last position) is
         the request's first generated token — the slot then joins decode."""
+        # a cancelled caller's prefill stops here: release the slot AND its
+        # worst-case block reservation instead of burning the remaining
+        # chunks for a dead request (under paged backpressure that
+        # reservation is exactly what blocks live admissions)
+        for i, s in enumerate(self.slots):
+            if s.prefilling and s.request.future.cancelled():
+                s.request = None
+                s.prefilling = False
+                s.prefill_done = 0
+                if self.block_mgr is not None:
+                    self.block_mgr.release(i)
         pre = [i for i, s in enumerate(self.slots) if s.prefilling]
         if not pre:
             return
@@ -1414,6 +1425,9 @@ class TpuServingEngine:
                 and len(batch) < min(len(free), self.config.prefill_batch)
             ):
                 request = self._queue._queue[0]  # peek
+                if request.future.cancelled():
+                    self._queue.get_nowait()  # caller gave up while queued
+                    continue
                 if self.block_mgr is not None and not self.block_mgr.can_admit(
                     len(request.prompt_tokens) + request.max_tokens + 1
                 ):
@@ -1632,6 +1646,9 @@ class TpuServingEngine:
             is_eos
             or len(request.generated) >= request.max_tokens
             or self._lengths[slot_id] + 1 >= self.model_config.max_seq_len
+            # caller gave up (client disconnect / task cancel): stop
+            # burning the slot on tokens nobody will read
+            or request.future.cancelled()
         )
         # streaming consumers always get a final last=True emission (the
         # tokenizer hides the EOS id itself), so chunk streams terminate
@@ -1658,6 +1675,11 @@ class TpuServingEngine:
                 await result
         finished, self._finished_requests = self._finished_requests, []
         for request, is_eos in finished:
+            if request.future.cancelled():
+                # aborted by the caller: not a served request — keep it out
+                # of the request-rate/TTFT metrics (a disconnect storm must
+                # not read as healthy throughput) and skip the decode
+                continue
             self._m_requests()
             if request.first_token_time is not None:
                 self._m_ttft(request.first_token_time - request.enqueue_time)

@@ -839,3 +839,94 @@ def test_engine_sampler_mode_derivation():
         np.array([0.9], np.float32),
     )
     assert mode == (True, False, False)  # top-p requested
+
+
+def test_cancelled_request_frees_slot():
+    """A caller that cancels generate() mid-stream stops consuming its
+    slot at the next emission; other requests keep streaming and new ones
+    admit into the freed slot."""
+    import asyncio
+
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        eng = TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=128, decode_chunk=2,
+                kv_layout="paged", kv_block_size=16, paged_kernel="xla",
+                kv_pool_blocks=20,  # room for the doomed worst case
+            )
+        )
+        try:
+            seen = asyncio.Event()
+
+            async def on_token(token, logprob, last):
+                seen.set()
+
+            doomed = asyncio.ensure_future(
+                eng.generate("a b c d", {"max-tokens": 100},
+                             on_token=on_token)
+            )
+            survivor = asyncio.ensure_future(
+                eng.generate("x y z", {"max-tokens": 16})
+            )
+            await asyncio.wait_for(seen.wait(), 120)
+            doomed.cancel()
+            out = await survivor
+            # tolerant count: the random-init model may emit EOS early
+            assert 0 < len(out["tokens"]) <= 16
+            # the doomed slot must free well before its 100-token budget
+            for _ in range(200):
+                if eng.stats()["active"] == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.stats()["active"] == 0, eng.stats()
+            # a follow-up request admits into the freed capacity
+            out2 = await eng.generate("again", {"max-tokens": 4})
+            assert 0 < len(out2["tokens"]) <= 4
+        finally:
+            await eng.close()
+
+    asyncio.run(main())
+
+
+def test_cancelled_chunked_prefill_releases_reservation():
+    """Cancelling a request mid-chunked-prefill frees its slot and its
+    worst-case block reservation — under paged backpressure that
+    reservation is what blocks live admissions."""
+    import asyncio
+
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        eng = TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=512, decode_chunk=2,
+                kv_layout="paged", kv_block_size=16, paged_kernel="xla",
+                prefill_chunk=32,
+            )
+        )
+        try:
+            doomed = asyncio.ensure_future(
+                eng.generate("a long chunked prompt " * 16, {"max-tokens": 8})
+            )
+            # wait until the slot is claimed for chunked prefill
+            for _ in range(400):
+                if any(s.prefilling for s in eng.slots):
+                    break
+                await asyncio.sleep(0.02)
+            assert any(s.prefilling for s in eng.slots)
+            doomed.cancel()
+            for _ in range(400):
+                stats = eng.stats()
+                if stats["kv"]["reserved_blocks"] == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.stats()["kv"]["reserved_blocks"] == 0, eng.stats()
+            # capacity is genuinely free again
+            out = await eng.generate("fresh", {"max-tokens": 4})
+            assert 0 < len(out["tokens"]) <= 4
+        finally:
+            await eng.close()
+
+    asyncio.run(main())
