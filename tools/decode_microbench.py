@@ -93,22 +93,88 @@ def measure(name, mc, B, K, window, quantize, sampler, iters):
     del run, params, ck, cv, out
 
 
+def measure_continuation(name, mc, B, start, suffix, quantize, kernel, iters):
+    """Time the prefix-cache continuation / chunked-prefill forward (and,
+    at suffix=D1-small widths, the speculative verify shape) against the
+    paged pool, for the XLA and multi-query-Pallas history reads."""
+    from langstream_tpu.models.llama_paged import llama_prefill_continue_paged
+    from langstream_tpu.models.paged import (
+        BlockManager,
+        PagedLayout,
+        init_paged_kv_cache,
+    )
+
+    params = init_llama_params(mc)
+    if quantize:
+        params = quantize_llama_params(params)
+    layout = PagedLayout.for_model(mc.max_seq_len, B, block_size=64)
+    bm = BlockManager(layout, B)
+    for s in range(B):
+        bm.admit(s, start + suffix + 8)
+        bm.ensure_capacity(s, start + suffix)
+    tables = jnp.asarray(bm.tables)
+    pk, pv = init_paged_kv_cache(mc, layout)
+    tokens = jnp.zeros((B, suffix), jnp.int32)
+    starts = jnp.full((B,), start, jnp.int32)
+    sufl = jnp.full((B,), suffix, jnp.int32)
+    nrb = max(1, -(-start // layout.block_size))
+
+    @jax.jit
+    def run(params, pk, pv, tokens, starts, sufl, tables):
+        return llama_prefill_continue_paged(
+            mc, params, tokens, starts, sufl, pk, pv, tables,
+            num_read_blocks=nrb, kernel=kernel,
+        )
+
+    out = run(params, pk, pv, tokens, starts, sufl, tables)
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(params, pk, pv, tokens, starts, sufl, tables)
+    np.asarray(out[0])
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    print(json.dumps({
+        "name": name, "B": B, "start": start, "suffix": suffix,
+        "kernel": kernel, "quant": quantize, "call_ms": round(ms, 2),
+    }), flush=True)
+    del run, params, pk, pv, out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument(
+        "--phase", choices=["decode", "continuation", "all"], default="all"
+    )
     args = ap.parse_args()
     mc = LlamaConfig.llama_1b(max_seq_len=1024)
 
-    # bench shape baseline
-    measure("baseline-int8", mc, 64, 96, 512, "int8", "full", args.iters)
-    measure("bf16", mc, 64, 96, 512, None, "full", args.iters)
-    measure("greedy-sampler", mc, 64, 96, 512, "int8", "greedy", args.iters)
-    for w in (128, 256, 1024):
-        measure(f"window-{w}", mc, 64, 96, w, "int8", "full", args.iters)
-    for b in (8, 16, 32):
-        measure(f"batch-{b}", mc, b, 96, 512, "int8", "full", args.iters)
-    for k in (8, 32):
-        measure(f"ksteps-{k}", mc, 64, k, 512, "int8", "full", args.iters)
+    if args.phase in ("decode", "all"):
+        # bench shape baseline
+        measure("baseline-int8", mc, 64, 96, 512, "int8", "full", args.iters)
+        measure("bf16", mc, 64, 96, 512, None, "full", args.iters)
+        measure("greedy-sampler", mc, 64, 96, 512, "int8", "greedy", args.iters)
+        for w in (128, 256, 1024):
+            measure(f"window-{w}", mc, 64, 96, w, "int8", "full", args.iters)
+        for b in (8, 16, 32):
+            measure(f"batch-{b}", mc, b, 96, 512, "int8", "full", args.iters)
+        for k in (8, 32):
+            measure(f"ksteps-{k}", mc, 64, k, 512, "int8", "full", args.iters)
+
+    if args.phase in ("continuation", "all"):
+        # prefix-cache hit: long cached prefix, short question suffix
+        for kern in ("xla", "pallas"):
+            measure_continuation(
+                f"cont-hit-{kern}", mc, 16, 512, 64, "int8", kern, args.iters
+            )
+            # chunked-prefill chunk: mid prompt, full-width chunk
+            measure_continuation(
+                f"cont-chunk-{kern}", mc, 8, 512, 512, "int8", kern, args.iters
+            )
+            # speculative verify shape: D1 = 5
+            measure_continuation(
+                f"verify-d5-{kern}", mc, 64, 512, 8, "int8", kern, args.iters
+            )
 
 
 if __name__ == "__main__":
