@@ -32,7 +32,26 @@ import asyncio
 import json
 import os
 import sys
+import threading
 import time
+
+# Persistent XLA compilation cache: the engine compiles many specialized
+# variants (per window bucket / sampler mode / phase engine); over a
+# tunneled chip each compile is a slow server round-trip. Must be set
+# before the first `import jax` anywhere in the process.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+if os.environ.get("JAX_PLATFORMS"):
+    # the environment's TPU plugin overrides JAX_PLATFORMS at interpreter
+    # start; the config knob re-asserts it (CPU smoke runs: BENCH_MODEL=tiny
+    # JAX_PLATFORMS=cpu)
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 SLOTS = int(os.environ.get("BENCH_SLOTS", "64"))
@@ -59,6 +78,55 @@ PROMPT = "Benchmarking the TPU serving engine end to end. " * 4
 
 _FORCE_XLA = os.environ.get("BENCH_FORCE_XLA") == "1"
 
+# Wall-clock budget per phase (a wedged device tunnel hangs inside JAX
+# calls — exceptions alone can't bound a phase) and for the whole record.
+# A timed-out phase is annotated and abandoned; its blocked executor
+# thread is left behind and the record moves on.
+PHASE_BUDGET_S = float(os.environ.get("BENCH_PHASE_TIMEOUT_S", "720"))
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "2700"))
+_DEADLINE = time.monotonic() + TOTAL_BUDGET_S
+
+
+def _probe_device(timeout_s: float = 150.0) -> str | None:
+    """Compile + run one tiny op and fetch it, bounded by ``timeout_s``.
+
+    Returns None when the device answered, else a diagnostic string. Runs
+    in a daemon thread: if the tunnel is wedged the JAX call blocks
+    forever and can't be cancelled — the probe thread is abandoned."""
+    result: dict = {}
+
+    def _go():
+        try:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            x = jnp.ones((128, 128))
+            np.asarray(jax.jit(lambda a: a @ a)(x))  # true host fence
+            result["ok"] = True
+        except Exception as e:  # pragma: no cover - device-dependent
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_go, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if result.get("ok"):
+        return None
+    if t.is_alive():
+        return f"device unresponsive after {timeout_s:.0f}s (tunnel wedged?)"
+    return result.get("error", "device probe failed")
+
+
+async def _phase(coro, budget_s: float | None = None):
+    """Run one bench phase under both the per-phase and global budgets."""
+    budget = min(budget_s or PHASE_BUDGET_S, max(_DEADLINE - time.monotonic(), 30.0))
+    try:
+        return await asyncio.wait_for(coro, timeout=budget)
+    except asyncio.TimeoutError:
+        raise TimeoutError(
+            f"phase exceeded {budget:.0f}s wall budget (device hang?)"
+        ) from None
+
 
 async def _close_all_engines() -> None:
     """Fully close every live engine (reset_instances only clears the
@@ -83,6 +151,9 @@ def _serving_config(kv_layout: str):
         max_seq_len=MAX_SEQ,
         default_max_tokens=MAX_TOKENS,
         decode_chunk=DECODE_CHUNK,
+        # saturated-throughput phases pin the heavy chunk length: adaptive
+        # light chunks are the sub-saturation TTFT posture (gateway phase)
+        decode_chunk_light=0,
         quantize=QUANTIZE,
         kv_layout=kv_layout,
         dense_kernel="xla" if _FORCE_XLA else "auto",
@@ -190,6 +261,10 @@ async def run_gateway_phase() -> dict:
         "max-seq-len": MAX_SEQ,
         "max-tokens": MAX_TOKENS,
         "decode-chunk": DECODE_CHUNK,
+        # TTFT phase: short sequential chunks under light load, and the
+        # engine pre-compiles both regimes before the first real request
+        "decode-chunk-light": 8,
+        "warmup-on-start": True,
         "quantize": QUANTIZE,
         "kv-layout": KV_LAYOUT,
     }
@@ -205,27 +280,37 @@ async def run_gateway_phase() -> dict:
     )
 
 
+async def _cleanup_engines() -> None:
+    """Bounded engine teardown: closing an engine whose loop is blocked on
+    a wedged device would itself hang; give up after 60s and move on (the
+    stuck instances are dropped from the registry so later phases build
+    fresh ones)."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    try:
+        await asyncio.wait_for(_close_all_engines(), timeout=60)
+    except Exception:
+        TpuServingEngine.reset_instances()
+
+
 async def run_bench() -> dict:
     detail: dict = {
         "decode_chunk": DECODE_CHUNK,
         "slots": SLOTS,
         "max_tokens": MAX_TOKENS,
     }
-    if RUN_GATEWAY:
-        # no phase may take the whole record down: a failed phase logs to
-        # stderr and annotates detail, the others still report
-        try:
-            gateway = await run_gateway_phase()
-            detail["gateway"] = gateway
-            detail["gateway_ttft_p50_s"] = gateway["gateway_ttft_p50_s"]
-        except Exception as e:
-            import traceback
+    probe = await asyncio.get_event_loop().run_in_executor(
+        None, _probe_device
+    )
+    if probe is not None:
+        detail["device_probe"] = probe
+        print(f"device probe failed: {probe}", file=sys.stderr)
 
-            traceback.print_exc(file=sys.stderr)
-            detail["gateway"] = {"error": f"{type(e).__name__}: {e}"}
-
+    # no phase may take the whole record down: a failed phase logs to
+    # stderr and annotates detail, the others still report. The headline
+    # decode phase runs FIRST so a mid-run device wedge still records it.
     try:
-        headline = await run_decode_bench(KV_LAYOUT, BENCH_REQUESTS)
+        headline = await _phase(run_decode_bench(KV_LAYOUT, BENCH_REQUESTS))
     except Exception as e:
         # the dense fast path routes through the Pallas kernel on TPU; if a
         # compiled-kernel issue surfaces only on real hardware, fall back to
@@ -235,11 +320,11 @@ async def run_bench() -> dict:
         traceback.print_exc(file=sys.stderr)
         print("headline phase failed; retrying with XLA kernels",
               file=sys.stderr)
-        await _close_all_engines()  # free the failed engine's HBM + loop
+        await _cleanup_engines()  # free the failed engine's HBM + loop
         global _FORCE_XLA
         _FORCE_XLA = True
         try:
-            headline = await run_decode_bench(KV_LAYOUT, BENCH_REQUESTS)
+            headline = await _phase(run_decode_bench(KV_LAYOUT, BENCH_REQUESTS))
             headline["kernel_fallback"] = f"xla (pallas failed: {e})"
         except Exception as retry_error:
             traceback.print_exc(file=sys.stderr)
@@ -250,9 +335,24 @@ async def run_bench() -> dict:
             }
     detail[KV_LAYOUT] = headline
 
+    if RUN_GATEWAY:
+        try:
+            await _cleanup_engines()
+            gateway = await _phase(run_gateway_phase())
+            detail["gateway"] = gateway
+            detail["gateway_ttft_p50_s"] = gateway["gateway_ttft_p50_s"]
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            detail["gateway"] = {"error": f"{type(e).__name__}: {e}"}
+
     if RUN_PAGED and KV_LAYOUT != "paged":
         try:
-            detail["paged"] = await run_decode_bench("paged", BENCH_REQUESTS // 2)
+            await _cleanup_engines()
+            detail["paged"] = await _phase(
+                run_decode_bench("paged", BENCH_REQUESTS // 2)
+            )
         except Exception as e:
             import traceback
 
@@ -263,14 +363,16 @@ async def run_bench() -> dict:
         try:
             # never inherit a wedged engine from a failed earlier phase:
             # get_or_create would hand back the same stuck instance
-            await _close_all_engines()
-            detail["prefix_cache"] = await run_prefix_cache_phase()
+            await _cleanup_engines()
+            detail["prefix_cache"] = await _phase(
+                run_prefix_cache_phase(), budget_s=min(PHASE_BUDGET_S, 420)
+            )
         except Exception as e:
             import traceback
 
             traceback.print_exc(file=sys.stderr)
             detail["prefix_cache"] = {"error": f"{type(e).__name__}: {e}"}
-        await _close_all_engines()
+        await _cleanup_engines()
 
     wdtype = "int8-weights" if QUANTIZE == "int8" else "bf16"
     return {
@@ -286,6 +388,12 @@ async def run_bench() -> dict:
 def main() -> None:
     result = asyncio.run(run_bench())
     print(json.dumps(result))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # abandoned phase threads (blocked on a wedged device) are non-daemon;
+    # a normal interpreter exit would join them forever — the record is
+    # printed, leave unconditionally
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,14 @@ Resource shape (``configuration.yaml``):
           prefix-cache: true           # shared prompt prefixes skip prefill
           prefill-chunk: 0             # >0: long prompts interleave with decode
           speculative-drafts: 0        # >0: prompt-lookup speculation (greedy)
+          decode-chunk: 16             # fused decode steps per dispatch
+          decode-chunk-light: 8        # short sequential chunks while active
+                                       # slots <= light-load-slots (the TTFT
+                                       # regime; 0 = always decode-chunk)
+          light-load-slots: null       # default slots // 8
+          warmup-on-start: false       # true: pre-compile both chunk regimes
+                                       # + padded prefill shapes on the first
+                                       # request (serving pods want this)
           embeddings-model: "minilm-l6"
 """
 

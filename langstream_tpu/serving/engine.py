@@ -123,6 +123,21 @@ class ServingConfig:
     # steps per sync multiplies throughput by ~K at a K-token batching
     # cost in streaming latency
     decode_chunk: int = 16
+    # adaptive decode chunking for the TTFT regime: while the active slot
+    # count is <= light_load_slots (default slots // 8 — well under
+    # capacity, where admission latency matters and throughput headroom is
+    # free), bursts fuse only decode_chunk_light steps and dispatch them
+    # SEQUENTIALLY (no speculative chunk in flight), so a newly arrived
+    # request waits at most decode_chunk_light steps for prefill instead
+    # of up to 2 x decode_chunk. Past the threshold the engine reverts to
+    # pipelined decode_chunk bursts. 0 disables (always heavy chunks).
+    decode_chunk_light: int = 8
+    light_load_slots: int | None = None
+    # pre-compile the serving-path jit variants on the first request (a
+    # lone probe + a concurrent wave past the light-load threshold): real
+    # traffic then never waits on a compile. First-compiles on TPU are
+    # tens of seconds — one landing mid-traffic convoys the whole queue.
+    warmup_on_start: bool = False
     # max requests prefilled in one batched call
     prefill_batch: int = 8
     # weight-only quantization: None (bf16) or "int8" (scales TP-shard
@@ -183,6 +198,9 @@ class ServingConfig:
             "max-tokens": self.default_max_tokens,
             "seed": self.seed,
             "decode-chunk": self.decode_chunk,
+            "decode-chunk-light": self.decode_chunk_light,
+            "light-load-slots": self.light_load_slots,
+            "warmup-on-start": self.warmup_on_start,
             "prefill-batch": self.prefill_batch,
             "quantize": self.quantize,
             "kv-layout": self.kv_layout,
@@ -211,6 +229,18 @@ class ServingConfig:
             default_max_tokens=int(d.get("max-tokens", 128)),
             seed=int(d.get("seed", 0)),
             decode_chunk=int(d.get("decode-chunk", 16)),
+            decode_chunk_light=int(
+                d.get("decode-chunk-light", d.get("decode_chunk_light", 8))
+            ),
+            light_load_slots=(
+                int(lls)
+                if (lls := d.get("light-load-slots", d.get("light_load_slots")))
+                is not None
+                else None
+            ),
+            warmup_on_start=_parse_bool(
+                d.get("warmup-on-start", d.get("warmup_on_start", False))
+            ),
             prefill_batch=int(d.get("prefill-batch", 8)),
             kv_layout=d.get("kv-layout", d.get("kv_layout", "dense")),
             kv_block_size=int(d.get("kv-block-size", d.get("kv_block_size", 64))),
@@ -409,6 +439,10 @@ class TpuServingEngine:
         )
         self.spec_steps = 0
         self.spec_accepted = 0
+        # adaptive-chunk observability: dispatches per regime
+        self._light_chunks = 0
+        self._heavy_chunks = 0
+        self._warmed = False
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
 
@@ -601,7 +635,6 @@ class TpuServingEngine:
 
         mc_static = mc
         ffn_static = self._ffn  # None = dense SwiGLU; MoE routes experts
-        K = self.config.decode_chunk
 
         # sampled tokens/logprobs come back to the leader host every chunk;
         # under a (possibly multi-host) mesh they inherit the dp sharding of
@@ -626,10 +659,14 @@ class TpuServingEngine:
         prefill_flash = None
         mesh_static = self.mesh
 
-        def _make_decode(sampler_mode: tuple, window: int | None):
+        def _make_decode(sampler_mode: tuple, window: int | None,
+                         k_steps: int = 0):
             """``window``: dense → cache-row bucket (None = full cache);
-            paged → number of block-table columns to sweep."""
+            paged → number of block-table columns to sweep. ``k_steps``:
+            fused steps per dispatch (0 → config.decode_chunk); light-load
+            bursts compile a short variant."""
             use_top_p, use_top_k, all_greedy = sampler_mode
+            K = k_steps or self.config.decode_chunk
 
             def _sample_fn_for(temps, topks, topps):
                 # ONE definition for all three decode variants (paged,
@@ -804,16 +841,31 @@ class TpuServingEngine:
         # in only when an active request needs them; decode additionally
         # specialises per attention window bucket. All variants compile
         # lazily on first use.
-        self._decode_chunk_fns: dict[tuple[tuple, int | None], Any] = {}
+        self._decode_chunk_fns: dict[tuple[tuple, int | None, int], Any] = {}
         self._prefill_fns: dict[tuple, Any] = {}
         self._prefill_continue_fns: dict[tuple[tuple, int], Any] = {}
         self._verify_fns: dict[int, Any] = {}
 
-    def _decode_fn(self, sampler_mode: tuple, window: int | None):
-        key = (sampler_mode, window)
+    def _decode_fn(self, sampler_mode: tuple, window: int | None,
+                   k_steps: int = 0):
+        k_steps = k_steps or self.config.decode_chunk
+        key = (sampler_mode, window, k_steps)
         if key not in self._decode_chunk_fns:
-            self._decode_chunk_fns[key] = self._make_decode(sampler_mode, window)
+            self._decode_chunk_fns[key] = self._make_decode(
+                sampler_mode, window, k_steps
+            )
         return self._decode_chunk_fns[key]
+
+    def _light_threshold(self) -> int:
+        """Active-slot count at or below which bursts run short sequential
+        chunks (the TTFT regime); 0 when adaptive chunking is disabled or
+        the light chunk wouldn't actually be shorter."""
+        cfg = self.config
+        if cfg.decode_chunk_light <= 0 or cfg.decode_chunk_light >= cfg.decode_chunk:
+            return 0
+        if cfg.light_load_slots is not None:
+            return cfg.light_load_slots
+        return max(1, cfg.slots // 8)
 
     def _prefill_fn(self, sampler_mode: tuple):
         if sampler_mode not in self._prefill_fns:
@@ -891,6 +943,11 @@ class TpuServingEngine:
         per token (sync or async). Returns
         ``{"tokens", "text", "logprobs", "num_prompt_tokens", "ttft"}``."""
         options = options or {}
+        if self.config.warmup_on_start and not self._warmed:
+            # flag first: warmup()'s own generate calls must not recurse,
+            # and concurrent first arrivals just queue behind the warmup
+            self._warmed = True
+            await self.warmup()
         tokens = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -931,6 +988,33 @@ class TpuServingEngine:
         self._wake.set()
         return await request.future
 
+    async def warmup(self) -> dict[str, int]:
+        """Compile the serving-path jit variants before real traffic: a
+        lone greedy request (light-regime burst, single-row prefill), then
+        a concurrent wave one past the light-load threshold (heavy-regime
+        burst, power-of-two padded prefill rows, prefix-cache continuation
+        when enabled). Greedy only — non-greedy sampler variants compile
+        on first use; greedy is what the latency-sensitive paths serve.
+        Prompts in other prefill-length buckets still pay one compile on
+        first sight. Warmup tokens count toward engine metrics (they ran
+        on the chips)."""
+        self._warmed = True
+        text = "engine warmup probe text. " * 4
+        k = max(self.config.decode_chunk, self.config.decode_chunk_light) + 1
+        opts = {"max-tokens": k, "temperature": 0}
+        await self.generate(text, dict(opts))
+        wave = min(
+            self.config.slots,
+            max(2, self._light_threshold() + 1, self.config.prefill_batch),
+        )
+        await asyncio.gather(
+            *(self.generate(text, dict(opts)) for _ in range(wave))
+        )
+        return {
+            "decode_variants": len(self._decode_chunk_fns),
+            "prefill_variants": len(self._prefill_fns),
+        }
+
     def stats(self) -> dict[str, Any]:
         out = {
             "model": self.config.model,
@@ -938,6 +1022,10 @@ class TpuServingEngine:
             "active": sum(1 for s in self.slots if not s.free),
             "queued": self._queue.qsize(),
             "total-generated": self.total_generated,
+            "decode-chunks": {
+                "light": self._light_chunks,
+                "heavy": self._heavy_chunks,
+            },
         }
         if self.block_mgr is not None:
             out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
@@ -1175,7 +1263,15 @@ class TpuServingEngine:
         host round-trip (the dominant per-chunk cost on tunneled chips, and
         a real cost on local ones) overlaps device compute. Slots that
         finish inside a speculative chunk burn a few wasted steps; the host
-        discards their tail. The burst ends when admission work appears."""
+        discards their tail. The burst ends when admission work appears.
+
+        Light-load regime (active slots <= ``_light_threshold``): the burst
+        fuses only ``decode_chunk_light`` steps per dispatch and runs them
+        SEQUENTIALLY — no speculative chunk in flight — so an arriving
+        request reaches prefill after at most one short chunk instead of
+        two long ones. The device idles for one host round-trip between
+        chunks, which is free precisely when the engine is under-loaded;
+        past the threshold the pipelined big-chunk path takes over."""
         key1 = self._split_key()
         active_mask = np.zeros(self.config.slots, dtype=bool)
         active_mask[active] = True
@@ -1187,7 +1283,11 @@ class TpuServingEngine:
             self._temps[active_mask], self._topks[active_mask],
             self._topps[active_mask],
         )
-        K = self.config.decode_chunk
+        light = len(active) <= self._light_threshold()
+        K = (
+            self.config.decode_chunk_light if light
+            else self.config.decode_chunk
+        )
         # host-tracked longest active sequence: each dispatched chunk grows
         # it by K; the attention window bucket follows
         base_max = int(self._lengths[active].max())
@@ -1210,7 +1310,7 @@ class TpuServingEngine:
 
         def _dispatch(tokens, lengths, key, window, tables, first=False):
             # async JAX dispatch: returns device arrays without blocking
-            decode_fn = self._decode_fn(sampler_mode, window)
+            decode_fn = self._decode_fn(sampler_mode, window, K)
             if self._lockstep is not None:
                 # runs on the single dispatch thread → broadcast order is
                 # dispatch order. Speculative chunks ("decode_cont") carry
@@ -1220,6 +1320,7 @@ class TpuServingEngine:
                     "op": "decode" if first else "decode_cont",
                     "sampler_mode": list(sampler_mode),
                     "window": window,
+                    "k": K,
                     "key": np.asarray(key),
                 }
                 if tables is not None:
@@ -1234,6 +1335,10 @@ class TpuServingEngine:
                         topps=np.asarray(self._topps),
                     )
                 self._lockstep.broadcast(desc)
+            if light:
+                self._light_chunks += 1
+            else:
+                self._heavy_chunks += 1
             self.profiler.on_decode_chunk()
             tables_dev = jnp.asarray(tables) if tables is not None else None
             args = (
@@ -1264,6 +1369,28 @@ class TpuServingEngine:
             ),
         )
         chunk_index = 0
+        if light:
+            while True:
+                chunk_t, chunk_lp = await loop.run_in_executor(
+                    self._executor,
+                    lambda o=out: (np.asarray(o[0]), np.asarray(o[1])),
+                )
+                finished = self._process_chunk(chunk_t, chunk_lp, active)
+                await self._flush_emits(active)
+                if (
+                    finished
+                    or not self._queue.empty()
+                    or self._stop
+                    or self._has_prefilling()
+                ):
+                    return
+                base_max += K
+                chunk_index += 1
+                out = await loop.run_in_executor(
+                    self._executor,
+                    partial(_dispatch, out[2], out[3], self._split_key(),
+                            _bucket_for(base_max), _grow_blocks(chunk_index)),
+                )
         while True:
             # speculate the next chunk from device state
             base_max += K

@@ -349,7 +349,9 @@ class LockstepFollower:
                 else:
                     tokens, lengths = carry_tokens, carry_lengths
                 window = desc.get("window")
-                fn = engine._decode_fn(burst["sampler_mode"], window)
+                fn = engine._decode_fn(
+                    burst["sampler_mode"], window, int(desc.get("k", 0))
+                )
                 args = [
                     engine.params, engine.cache_k, engine.cache_v,
                     tokens, lengths, burst["active"],

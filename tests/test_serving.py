@@ -577,6 +577,73 @@ def test_engine_respects_max_tokens_and_seq_len(run_async):
     run_async(main())
 
 
+def test_adaptive_chunk_regimes(run_async):
+    """A lone request decodes in short sequential chunks (the TTFT regime);
+    saturating the slots flips bursts to pipelined heavy chunks. Chunking
+    must not change the math: greedy tokens match across regimes and match
+    a fixed-chunk engine."""
+
+    async def main():
+        from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=4, max_seq_len=64,
+                decode_chunk=8, decode_chunk_light=2, light_load_slots=1,
+            )
+        )
+        r1 = await engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+        chunks = engine.stats()["decode-chunks"]
+        assert chunks["light"] > 0 and chunks["heavy"] == 0
+        results = await asyncio.gather(
+            *(engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+              for _ in range(4))
+        )
+        assert engine.stats()["decode-chunks"]["heavy"] > 0
+        for r in results:
+            assert r["tokens"] == r1["tokens"]
+        await engine.close()
+
+        fixed = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=4, max_seq_len=64,
+                decode_chunk=8, decode_chunk_light=0,
+            )
+        )
+        r2 = await fixed.generate("abc", {"max-tokens": 6, "temperature": 0})
+        assert r2["tokens"] == r1["tokens"]
+        assert fixed.stats()["decode-chunks"]["light"] == 0
+        await fixed.close()
+
+    run_async(main())
+
+
+def test_warmup_on_start_compiles_both_regimes(run_async):
+    """warmup-on-start: the first request triggers a lone probe plus a
+    concurrent wave, so BOTH chunk regimes (and their jit variants) exist
+    before real traffic — a first compile mid-traffic convoys the queue."""
+
+    async def main():
+        from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=4, max_seq_len=128,
+                decode_chunk=8, decode_chunk_light=2, light_load_slots=1,
+                warmup_on_start=True,
+            )
+        )
+        r = await engine.generate("abc", {"max-tokens": 4, "temperature": 0})
+        assert r["tokens"]
+        chunks = engine.stats()["decode-chunks"]
+        assert chunks["light"] > 0 and chunks["heavy"] > 0
+        k_variants = {key[2] for key in engine._decode_chunk_fns}
+        assert {2, 8} <= k_variants
+        await engine.close()
+
+    run_async(main())
+
+
 def test_engine_top_p_and_stream_termination(run_async):
     async def main():
         engine = _engine()

@@ -107,10 +107,17 @@ def measure_continuation(name, mc, B, start, suffix, quantize, kernel, iters):
     params = init_llama_params(mc)
     if quantize:
         params = quantize_llama_params(params)
-    layout = PagedLayout.for_model(mc.max_seq_len, B, block_size=64)
+    # size the pool for exactly this shape: the default half-of-dense pool
+    # can't hold B slots of start+suffix tokens at the wider shapes, and
+    # reservations past max_seq_len can never fit any pool
+    need = min(start + suffix + 8, mc.max_seq_len)
+    blocks_per_slot = -(-need // 64)
+    layout = PagedLayout.for_model(
+        mc.max_seq_len, B, block_size=64, num_blocks=B * blocks_per_slot + 1
+    )
     bm = BlockManager(layout, B)
     for s in range(B):
-        bm.admit(s, start + suffix + 8)
+        bm.admit(s, need)
         bm.ensure_capacity(s, start + suffix)
     tables = jnp.asarray(bm.tables)
     pk, pv = init_paged_kv_cache(mc, layout)

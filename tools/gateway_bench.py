@@ -161,9 +161,18 @@ async def run_gateway_bench(
                             "e2e": time.monotonic() - t0,
                         }
 
-        # warmup compiles prefill + decode variants
+        # warmup compiles prefill + decode variants: sequential requests
+        # cover the light-load regime (and the engine's own warmup-on-start
+        # wave, when configured), then a concurrent wave drives the active
+        # slot count past the light threshold so the heavy-chunk burst and
+        # padded prefill batches compile BEFORE measurement — a first
+        # compile landing mid-run convoys every queued request behind it
         for i in range(warmup):
             await one_request(10_000 + i)
+        wave = min(int(serving.get("slots", 8) or 8), 16)
+        await asyncio.gather(
+            *(one_request(20_000 + i) for i in range(wave))
+        )
 
         rng = random.Random(seed)
         tasks: list[asyncio.Task] = []
