@@ -61,8 +61,8 @@ MODEL = os.environ.get("BENCH_MODEL", "llama-1b")
 MAX_SEQ = int(os.environ.get("BENCH_MAX_SEQ", "1024"))
 MAX_TOKENS = int(os.environ.get("BENCH_MAX_TOKENS", "192"))
 DECODE_CHUNK = int(os.environ.get("BENCH_DECODE_CHUNK", "96"))
-WARMUP_REQUESTS = 8
-BENCH_REQUESTS = 192
+WARMUP_REQUESTS = int(os.environ.get("BENCH_WARMUP_REQUESTS", "8"))
+BENCH_REQUESTS = int(os.environ.get("BENCH_REQUESTS", "192"))
 BASELINE_TOK_S = 2000.0
 # weight-only int8 is the engine's serving default posture (≈ lossless);
 # BENCH_QUANTIZE=none reverts to bf16

@@ -65,7 +65,8 @@ class AdminClient:
             await self._session.close()
 
     async def request(
-        self, method: str, path: str, *, retry_safe: bool | None = None, **kwargs
+        self, method: str, path: str, *, retry_safe: bool | None = None,
+        binary: bool = False, **kwargs
     ) -> Any:
         import aiohttp
 
@@ -77,12 +78,19 @@ class AdminClient:
             try:
                 session = await self._client()
                 async with session.request(method, url, **kwargs) as resp:
-                    text = await resp.text()
+                    raw = await resp.read()
+                    text = (
+                        ""
+                        if binary and resp.status < 300
+                        else raw.decode("utf-8", errors="replace")
+                    )
                     if resp.status >= 500 and idempotent and attempt < self.retries:
                         last = AdminApiError(resp.status, text[:500])
                         raise last
                     if resp.status >= 300:
                         raise AdminApiError(resp.status, text)
+                    if binary:
+                        return raw
                     try:
                         return json.loads(text)
                     except json.JSONDecodeError:

@@ -257,6 +257,27 @@ def apps_delete(name, tenant, api_url) -> None:
     click.echo(json.dumps(out))
 
 
+@apps.command("download")
+@click.argument("name")
+@click.option("-o", "--output", default=None, type=click.Path(),
+              help="output zip path (default <name>.zip)")
+@click.option("--tenant", default=None)
+@click.option("--api-url", default=None)
+def apps_download(name, output, tenant, api_url) -> None:
+    """Download the deployed application's code archive as a zip."""
+    tenant = tenant or _profile().get("tenant", "default")
+    data = asyncio.run(
+        _request(
+            "GET",
+            f"{_api_url(api_url)}/api/applications/{tenant}/{name}/code",
+            binary=True,
+        )
+    )
+    target = Path(output or f"{name}.zip")
+    target.write_bytes(data)
+    click.echo(f"wrote {target} ({len(data)} bytes)")
+
+
 @apps.command("logs")
 @click.argument("name")
 @click.option("--tenant", default=None)

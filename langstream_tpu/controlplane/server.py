@@ -237,6 +237,7 @@ class ControlPlaneServer:
                 web.get("/api/applications/{tenant}/{name}", self._get_app),
                 web.delete("/api/applications/{tenant}/{name}", self._delete_app),
                 web.get("/api/applications/{tenant}/{name}/logs", self._logs),
+                web.get("/api/applications/{tenant}/{name}/code", self._download_code),
                 web.get("/api/applications/{tenant}/{name}/agents", self._agents),
                 # archetypes (parity: ArchetypeResource)
                 web.get("/api/archetypes/{tenant}", self._list_archetypes),
@@ -457,6 +458,31 @@ class ControlPlaneServer:
                 full["secrets"] = stored.secrets
             return web.json_response(full)
         return web.json_response(stored.public_view())
+
+    async def _download_code(self, request: web.Request) -> web.Response:
+        """The deployed application directory back as a zip — the code
+        archive, without instance/secrets (parity:
+        ``ApplicationResource.java:467`` code download)."""
+        stored = self.store.get_application(
+            request.match_info["tenant"], request.match_info["name"]
+        )
+        if stored is None:
+            raise web.HTTPNotFound()
+        import io
+        import zipfile
+
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for fname, content in sorted(stored.files.items()):
+                zf.writestr(fname, content)
+        return web.Response(
+            body=buf.getvalue(),
+            content_type="application/zip",
+            headers={
+                "Content-Disposition":
+                    f'attachment; filename="{stored.name}.zip"'
+            },
+        )
 
     async def _list_apps(self, request: web.Request) -> web.Response:
         tenant = request.match_info["tenant"]

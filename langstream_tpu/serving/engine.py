@@ -306,6 +306,15 @@ class _Request:
     bigram_covered: int = 0
 
 
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (batch-row padding: compiling one jit
+    variant per exact row count is a compile per new size)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 def _bucket(n: int, lo: int = 32, hi: int = 32768) -> int:
     b = lo
     while b < n and b < hi:
@@ -442,7 +451,7 @@ class TpuServingEngine:
         # adaptive-chunk observability: dispatches per regime
         self._light_chunks = 0
         self._heavy_chunks = 0
-        self._warmed = False
+        self._warmup_task: asyncio.Task | None = None
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
 
@@ -938,16 +947,24 @@ class TpuServingEngine:
         prompt: str | list[int],
         options: dict[str, Any] | None = None,
         on_token: Callable[[int, float, bool], Any] | None = None,
+        _warmup_probe: bool = False,
     ) -> dict[str, Any]:
         """Generate a completion. ``on_token(token_id, logprob, last)`` fires
         per token (sync or async). Returns
-        ``{"tokens", "text", "logprobs", "num_prompt_tokens", "ttft"}``."""
+        ``{"tokens", "text", "logprobs", "num_prompt_tokens", "ttft"}``.
+
+        ``_warmup_probe`` is internal: warmup()'s own generate calls skip
+        the warmup gate below (they ARE the warmup)."""
         options = options or {}
-        if self.config.warmup_on_start and not self._warmed:
-            # flag first: warmup()'s own generate calls must not recurse,
-            # and concurrent first arrivals just queue behind the warmup
-            self._warmed = True
-            await self.warmup()
+        if self.config.warmup_on_start and not _warmup_probe:
+            # one shared guarded task: every early arrival awaits it, so
+            # the probe/wave shapes aren't perturbed by real traffic and
+            # real requests only start once the variants exist. A warmup
+            # failure is logged, never surfaced as a request failure.
+            if self._warmup_task is None:
+                self._warmup_task = asyncio.ensure_future(self._warmup_safely())
+            if not self._warmup_task.done():
+                await asyncio.shield(self._warmup_task)
         tokens = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -998,22 +1015,36 @@ class TpuServingEngine:
         Prompts in other prefill-length buckets still pay one compile on
         first sight. Warmup tokens count toward engine metrics (they ran
         on the chips)."""
-        self._warmed = True
         text = "engine warmup probe text. " * 4
         k = max(self.config.decode_chunk, self.config.decode_chunk_light) + 1
         opts = {"max-tokens": k, "temperature": 0}
-        await self.generate(text, dict(opts))
+        await self.generate(text, dict(opts), _warmup_probe=True)
         wave = min(
             self.config.slots,
             max(2, self._light_threshold() + 1, self.config.prefill_batch),
         )
         await asyncio.gather(
-            *(self.generate(text, dict(opts)) for _ in range(wave))
+            *(
+                self.generate(text, dict(opts), _warmup_probe=True)
+                for _ in range(wave)
+            )
         )
         return {
             "decode_variants": len(self._decode_chunk_fns),
             "prefill_variants": len(self._prefill_fns),
         }
+
+    async def _warmup_safely(self) -> None:
+        """warmup() for the on-start gate: failures are logged, not raised
+        — a broken warmup must degrade to lazy compiles, not fail the
+        first real request that happened to trigger it."""
+        try:
+            variants = await self.warmup()
+            log.info("engine warmup complete: %s", variants)
+        except Exception:
+            log.exception(
+                "engine warmup failed; serving continues with lazy compiles"
+            )
 
     def stats(self) -> dict[str, Any]:
         out = {
@@ -1441,9 +1472,7 @@ class TpuServingEngine:
         if not pre:
             return
         C = self.config.prefill_chunk
-        Bp = 1
-        while Bp < len(pre):
-            Bp *= 2
+        Bp = _pow2(len(pre))
         tokens = np.zeros((Bp, C), dtype=np.int32)
         starts = np.zeros(Bp, dtype=np.int32)
         suffix_lens = np.zeros(Bp, dtype=np.int32)
@@ -1629,9 +1658,7 @@ class TpuServingEngine:
                     self.block_mgr.ensure_capacity(
                         slot_id, len(request.prompt_tokens)
                     )
-            Bp = 1
-            while Bp < len(batch):
-                Bp *= 2
+            Bp = _pow2(len(batch))
             use_continue = any(r > 0 for _, _, r in batch)
             padded = np.zeros((Bp, bucket), dtype=np.int32)
             lengths = np.zeros(Bp, dtype=np.int32)
@@ -1915,8 +1942,14 @@ class EmbeddingEngine:
         ids = [[t % V for t in row] for row in ids]
         bucket = _bucket(max(len(r) for r in ids), lo=16, hi=max_pos)
         B = len(ids)
-        tokens = np.zeros((B, bucket), dtype=np.int32)
-        mask = np.zeros((B, bucket), dtype=np.int32)
+        # pad rows to a power of two: the time-flushed micro-batcher emits
+        # arbitrary batch sizes, and compiling one encoder per exact size
+        # is a mid-traffic compile per new size (tens of seconds on TPU) —
+        # log2 buckets bound the variants. All-zero-mask padding rows are
+        # safe (pooling and norm are guarded) and sliced off below.
+        Bp = _pow2(B)
+        tokens = np.zeros((Bp, bucket), dtype=np.int32)
+        mask = np.zeros((Bp, bucket), dtype=np.int32)
         for i, row in enumerate(ids):
             tokens[i, : len(row)] = row
             mask[i, : len(row)] = 1
@@ -1928,4 +1961,4 @@ class EmbeddingEngine:
             ),
         )
         self._m_embeddings(len(texts))
-        return out.tolist()
+        return out[:B].tolist()

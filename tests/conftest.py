@@ -30,6 +30,12 @@ from langstream_tpu.runtime.memory_broker import MemoryBroker  # noqa: E402
 from langstream_tpu.agents.vector import InMemoryVectorStore  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (multi-process or subprocess)"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_brokers():
     """Isolate broker + vector-store state between tests."""

@@ -1,0 +1,62 @@
+"""Driver-contract test for ``bench.py``: the end-of-round benchmark must
+print exactly one JSON line with the fields the driver records, even on a
+CPU-only machine (tiny model smoke shape). Guards the record machinery —
+phase budgets, device probe, engine teardown between phases, os._exit —
+which otherwise only runs on the real chip at round end."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_prints_one_json_record(tmp_path):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_MODEL="tiny",
+        BENCH_SLOTS="4",
+        BENCH_MAX_SEQ="128",
+        BENCH_MAX_TOKENS="8",
+        BENCH_DECODE_CHUNK="4",
+        BENCH_WARMUP_REQUESTS="2",
+        BENCH_REQUESTS="8",
+        # decode phase only: the gateway/paged/prefix phases have their own
+        # coverage (tools/gateway_bench.py main, tests/test_paged.py) and
+        # would triple this test's runtime
+        BENCH_GATEWAY="0",
+        BENCH_PAGED="0",
+        BENCH_PREFIX="0",
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "jax_cache"),
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [
+        line for line in proc.stdout.splitlines() if line.startswith("{")
+    ]
+    assert len(json_lines) == 1, proc.stdout
+    record = json.loads(json_lines[0])
+    assert record["unit"] == "tok/s/chip"
+    assert record["value"] > 0
+    # vs_baseline is rounded to 3 decimals in the record
+    assert record["vs_baseline"] == pytest.approx(
+        record["value"] / 2000.0, abs=5e-4
+    )
+    detail = record["detail"]
+    assert detail["dense"]["tok_s"] == record["value"]
+    assert "roofline" in detail["dense"]
+    # CPU run: the device probe must not have failed the record
+    assert detail["dense"].get("error") is None

@@ -160,6 +160,31 @@ def test_tenant_and_app_lifecycle(run_async):
             async with s.session.get(s.api("/api/applications/t1/app1/agents")) as r:
                 agents = await r.json()
                 assert len(agents) == 1 and agents[0]["type"] == "compute"
+            # code download: the deployed app dir back as a zip (no
+            # instance/secrets in the archive)
+            async with s.session.get(s.api("/api/applications/t1/app1/code")) as r:
+                assert r.status == 200
+                assert r.content_type == "application/zip"
+                blob = await r.read()
+            import io
+            import zipfile
+
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                assert sorted(zf.namelist()) == ["gateways.yaml", "pipeline.yaml"]
+                assert zf.read("pipeline.yaml").decode() == PIPELINE
+            async with s.session.get(s.api("/api/applications/t1/nope/code")) as r:
+                assert r.status == 404
+            # the CLI's `apps download` lane: AdminClient binary fetch
+            from langstream_tpu.admin import AdminClient
+
+            client = AdminClient(f"http://127.0.0.1:{s.api_port}")
+            try:
+                raw = await client.request(
+                    "GET", "/api/applications/t1/app1/code", binary=True
+                )
+                assert raw == blob
+            finally:
+                await client.close()
             # delete
             async with s.session.delete(s.api("/api/applications/t1/app1")) as r:
                 assert r.status == 200

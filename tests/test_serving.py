@@ -707,6 +707,11 @@ def test_embedding_engine(run_async):
         assert vecs[0] != vecs[2]
         norm = sum(v * v for v in vecs[0]) ** 0.5
         assert abs(norm - 1.0) < 1e-3
+        # batch-size padding: a different batch size reuses the same
+        # power-of-two variant and padding rows don't leak into results
+        solo = await engine.embed(["hello world"])
+        assert len(solo) == 1
+        assert solo[0] == pytest.approx(vecs[0], abs=1e-5)
 
     run_async(main())
 

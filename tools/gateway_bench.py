@@ -169,10 +169,11 @@ async def run_gateway_bench(
         # compile landing mid-run convoys every queued request behind it
         for i in range(warmup):
             await one_request(10_000 + i)
-        wave = min(int(serving.get("slots", 8) or 8), 16)
-        await asyncio.gather(
-            *(one_request(20_000 + i) for i in range(wave))
-        )
+        if warmup > 0:
+            wave = min(int(serving.get("slots", 8) or 8), 16)
+            await asyncio.gather(
+                *(one_request(20_000 + i) for i in range(wave))
+            )
 
         rng = random.Random(seed)
         tasks: list[asyncio.Task] = []
