@@ -372,6 +372,56 @@ def archetypes_deploy(
     click.echo(json.dumps(out, indent=2))
 
 
+@cli.group("python")
+def python_group() -> None:
+    """Per-application Python tooling (parity: `langstream python ...`)."""
+
+
+@python_group.command("install-requirements")
+@click.option("-app", "--application", "app", required=True,
+              type=click.Path(exists=True))
+def python_install_requirements(app) -> None:
+    """Provision the app's isolated venv from python/requirements.txt and
+    print the interpreter its sidecar agents will run on (parity:
+    load-pip-requirements; here deps install into a venv-per-app instead
+    of the shared lib dir, the NAR-isolation answer)."""
+    from langstream_tpu.runtime.isolation import (
+        ensure_app_interpreter,
+        requirements_file,
+    )
+
+    if requirements_file(app) is None:
+        click.echo("no python/requirements.txt: sidecars use the base "
+                   "interpreter")
+    interpreter = ensure_app_interpreter(app)
+    click.echo(interpreter)
+
+
+@python_group.command(
+    "run-tests",
+    context_settings={"ignore_unknown_options": True},
+)
+@click.option("-app", "--application", "app", required=True,
+              type=click.Path(exists=True))
+@click.argument("pytest_args", nargs=-1, type=click.UNPROCESSED)
+def python_run_tests(app, pytest_args) -> None:
+    """Run the application's python/ test suite on the app's interpreter
+    (the venv when requirements are pinned)."""
+    import subprocess
+
+    from langstream_tpu.runtime.isolation import ensure_app_interpreter
+
+    code_dir = Path(app) / "python"
+    if not code_dir.is_dir():
+        raise click.ClickException(f"{app} has no python/ directory")
+    interpreter = ensure_app_interpreter(app)
+    result = subprocess.run(
+        [interpreter, "-m", "pytest", *(pytest_args or ("-q",))],
+        cwd=code_dir,
+    )
+    raise SystemExit(result.returncode)
+
+
 @cli.group()
 def docs() -> None:
     """Generated documentation."""

@@ -469,18 +469,22 @@ class ControlPlaneServer:
         if stored is None:
             raise web.HTTPNotFound()
         import io
+        import re
         import zipfile
 
         buf = io.BytesIO()
         with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
             for fname, content in sorted(stored.files.items()):
                 zf.writestr(fname, content)
+        # app names come straight from the URL path: header-unsafe chars
+        # (quotes, control bytes, non-latin-1) would malform the header or
+        # 500 the response — keep a conservative subset for the filename
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", stored.name) or "application"
         return web.Response(
             body=buf.getvalue(),
             content_type="application/zip",
             headers={
-                "Content-Disposition":
-                    f'attachment; filename="{stored.name}.zip"'
+                "Content-Disposition": f'attachment; filename="{safe}.zip"'
             },
         )
 

@@ -957,14 +957,17 @@ class TpuServingEngine:
         the warmup gate below (they ARE the warmup)."""
         options = options or {}
         if self.config.warmup_on_start and not _warmup_probe:
-            # one shared guarded task: every early arrival awaits it, so
-            # the probe/wave shapes aren't perturbed by real traffic and
-            # real requests only start once the variants exist. A warmup
-            # failure is logged, never surfaced as a request failure.
-            if self._warmup_task is None:
-                self._warmup_task = asyncio.ensure_future(self._warmup_safely())
-            if not self._warmup_task.done():
-                await asyncio.shield(self._warmup_task)
+            # one shared task (also credited to explicit warmup() calls):
+            # every early arrival awaits it, so the probe/wave shapes
+            # aren't perturbed by real traffic and real requests only
+            # start once the variants exist. A warmup failure is logged,
+            # never surfaced as a request failure.
+            task = self._warmup_begun()
+            if not task.done():
+                try:
+                    await asyncio.shield(task)
+                except Exception:
+                    pass  # logged by the task callback; lazy compiles take over
         tokens = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -1005,16 +1008,44 @@ class TpuServingEngine:
         self._wake.set()
         return await request.future
 
+    def _warmup_begun(self) -> "asyncio.Task":
+        """The one shared warmup task: created on first need (explicit
+        warmup() call or the warmup_on_start gate), credited to both — an
+        explicit pre-warm means the gate has nothing left to do."""
+        if self._warmup_task is None:
+            self._warmup_task = asyncio.ensure_future(self._do_warmup())
+
+            def _log_done(task: asyncio.Task) -> None:
+                if task.cancelled():
+                    return
+                if task.exception() is not None:
+                    log.error(
+                        "engine warmup failed; serving continues with "
+                        "lazy compiles",
+                        exc_info=task.exception(),
+                    )
+                else:
+                    log.info("engine warmup complete: %s", task.result())
+
+            self._warmup_task.add_done_callback(_log_done)
+        return self._warmup_task
+
     async def warmup(self) -> dict[str, int]:
-        """Compile the serving-path jit variants before real traffic: a
-        lone greedy request (light-regime burst, single-row prefill), then
-        a concurrent wave one past the light-load threshold (heavy-regime
-        burst, power-of-two padded prefill rows, prefix-cache continuation
-        when enabled). Greedy only — non-greedy sampler variants compile
-        on first use; greedy is what the latency-sensitive paths serve.
-        Prompts in other prefill-length buckets still pay one compile on
-        first sight. Warmup tokens count toward engine metrics (they ran
-        on the chips)."""
+        """Compile the serving-path jit variants before real traffic (see
+        :meth:`_do_warmup`). Idempotent: shares one task with the
+        warmup_on_start gate, so pre-warming explicitly never repeats the
+        probe/wave."""
+        return await asyncio.shield(self._warmup_begun())
+
+    async def _do_warmup(self) -> dict[str, int]:
+        """A lone greedy request (light-regime burst, single-row prefill),
+        then a concurrent wave one past the light-load threshold
+        (heavy-regime burst, power-of-two padded prefill rows,
+        prefix-cache continuation when enabled). Greedy only — non-greedy
+        sampler variants compile on first use; greedy is what the
+        latency-sensitive paths serve. Prompts in other prefill-length
+        buckets still pay one compile on first sight. Warmup tokens count
+        toward engine metrics (they ran on the chips)."""
         text = "engine warmup probe text. " * 4
         k = max(self.config.decode_chunk, self.config.decode_chunk_light) + 1
         opts = {"max-tokens": k, "temperature": 0}
@@ -1033,18 +1064,6 @@ class TpuServingEngine:
             "decode_variants": len(self._decode_chunk_fns),
             "prefill_variants": len(self._prefill_fns),
         }
-
-    async def _warmup_safely(self) -> None:
-        """warmup() for the on-start gate: failures are logged, not raised
-        — a broken warmup must degrade to lazy compiles, not fail the
-        first real request that happened to trigger it."""
-        try:
-            variants = await self.warmup()
-            log.info("engine warmup complete: %s", variants)
-        except Exception:
-            log.exception(
-                "engine warmup failed; serving continues with lazy compiles"
-            )
 
     def stats(self) -> dict[str, Any]:
         out = {

@@ -264,3 +264,37 @@ def test_app_with_requirements_gets_own_venv(tmp_path):
     # idempotent: same interpreter, marker untouched
     assert ensure_app_interpreter(tmp_path) == interpreter
     assert marker.read_text() == stamp
+
+
+@pytest.mark.slow
+def test_cli_python_run_tests(tmp_path):
+    """`python run-tests` runs the app's python/ suite on the app's
+    interpreter and propagates pytest's exit code (parity:
+    `langstream python run-tests`)."""
+    import os
+    import subprocess
+
+    code = tmp_path / "python"
+    code.mkdir()
+    (code / "test_app_agent.py").write_text(
+        "def test_ok():\n    assert True\n"
+    )
+    repo = str(Path(__file__).resolve().parent.parent)
+    env = {**os.environ, "PYTHONPATH": repo}
+    out = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu.cli", "python", "run-tests",
+         "-app", str(tmp_path), "-q"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "1 passed" in out.stdout
+
+    (code / "test_app_agent.py").write_text(
+        "def test_fails():\n    assert False\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu.cli", "python", "run-tests",
+         "-app", str(tmp_path), "-q"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.returncode != 0

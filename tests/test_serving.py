@@ -639,6 +639,11 @@ def test_warmup_on_start_compiles_both_regimes(run_async):
         assert chunks["light"] > 0 and chunks["heavy"] > 0
         k_variants = {key[2] for key in engine._decode_chunk_fns}
         assert {2, 8} <= k_variants
+        # idempotent: an explicit warmup() call shares the gate's task and
+        # does not re-run the probe/wave
+        generated = engine.total_generated
+        await engine.warmup()
+        assert engine.total_generated == generated
         await engine.close()
 
     run_async(main())
