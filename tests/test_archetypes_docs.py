@@ -175,3 +175,14 @@ def test_docs_generation():
     assert "## `compute-ai-embeddings`" in md
     assert "| `batch-size` |" in md
     assert render_json().startswith("{")
+
+
+def test_committed_agent_reference_is_fresh():
+    """docs/AGENTS.md is a committed artifact of `cli docs agents` — it
+    must match the generator, or the reference drifts from the code."""
+    from pathlib import Path
+
+    committed = (
+        Path(__file__).resolve().parent.parent / "docs" / "AGENTS.md"
+    ).read_text()
+    assert committed == render_markdown() + "\n" or committed == render_markdown()
