@@ -15,6 +15,9 @@
 4. **Prefix-cache TTFT**: cold vs warm time-to-first-token for requests
    sharing a long preamble (paged layout; warm requests adopt the cached
    prefix blocks and prefill only the question suffix).
+5. **int8-KV decode throughput**: the dense workload with the int8 KV
+   cache (per-row scales folded into scores/probs) — halved cache-read
+   bytes halve the roofline floor.
 
 Phases share one engine config, so the jitted programs compile once.
 Env knobs: BENCH_SLOTS, BENCH_DECODE_CHUNK, BENCH_QUANTIZE (int8|none),
@@ -72,6 +75,7 @@ KV_LAYOUT = os.environ.get("BENCH_KV", "dense").strip().lower()
 RUN_GATEWAY = os.environ.get("BENCH_GATEWAY", "1") != "0"
 RUN_PAGED = os.environ.get("BENCH_PAGED", "1") != "0"
 RUN_PREFIX = os.environ.get("BENCH_PREFIX", "1") != "0"
+RUN_KV_INT8 = os.environ.get("BENCH_KV_INT8", "1") != "0"
 
 PROMPT = "Benchmarking the TPU serving engine end to end. " * 4
 
@@ -142,7 +146,7 @@ async def _close_all_engines() -> None:
             pass
 
 
-def _serving_config(kv_layout: str):
+def _serving_config(kv_layout: str, kv_quantize: str | None = None):
     from langstream_tpu.serving.engine import ServingConfig
 
     return ServingConfig(
@@ -156,16 +160,21 @@ def _serving_config(kv_layout: str):
         decode_chunk_light=0,
         quantize=QUANTIZE,
         kv_layout=kv_layout,
+        kv_quantize=kv_quantize,
         dense_kernel="xla" if _FORCE_XLA else "auto",
         paged_kernel="xla" if _FORCE_XLA else "auto",
     )
 
 
-async def run_decode_bench(kv_layout: str, requests: int) -> dict:
+async def run_decode_bench(
+    kv_layout: str, requests: int, kv_quantize: str | None = None
+) -> dict:
     """Saturated decode throughput for one KV layout."""
     from langstream_tpu.serving.engine import TpuServingEngine
 
-    engine = TpuServingEngine.get_or_create(_serving_config(kv_layout))
+    engine = TpuServingEngine.get_or_create(
+        _serving_config(kv_layout, kv_quantize)
+    )
 
     # warmup at FULL length: the decode window bucket grows with sequence
     # length, so short warmups would leave later buckets to compile inside
@@ -196,11 +205,13 @@ async def run_decode_bench(kv_layout: str, requests: int) -> dict:
     mean_len = prompt_tokens + MAX_TOKENS / 2
     window = engine._window_for(int(mean_len)) or MAX_SEQ
     roof = decode_step_bytes(
-        engine.model_config, slots=SLOTS, window=window, quantize=QUANTIZE
+        engine.model_config, slots=SLOTS, window=window, quantize=QUANTIZE,
+        kv_quantize=kv_quantize,
     )
     achieved_step_ms = SLOTS / tok_s * 1e3  # all slots advance one token/step
     out = {
         "kv_layout": kv_layout,
+        **({"kv_quantize": kv_quantize} if kv_quantize else {}),
         "tok_s": round(tok_s, 1),
         "requests": requests,
         "total_tokens": total_tokens,
@@ -358,6 +369,21 @@ async def run_bench() -> dict:
 
             traceback.print_exc(file=sys.stderr)
             detail["paged"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if RUN_KV_INT8:
+        # same saturated workload on the int8 KV cache: halved cache-read
+        # bytes halve the roofline floor — this records what that buys
+        try:
+            await _cleanup_engines()
+            detail["kv_int8"] = await _phase(
+                run_decode_bench("dense", BENCH_REQUESTS // 2,
+                                 kv_quantize="int8")
+            )
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            detail["kv_int8"] = {"error": f"{type(e).__name__}: {e}"}
 
     if RUN_PREFIX:
         try:

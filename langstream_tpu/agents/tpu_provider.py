@@ -22,6 +22,9 @@ Resource shape (``configuration.yaml``):
                                        # long prefills sequence-parallel,
                                        # `ep` shards MoE experts
           quantize: "int8"             # weight-only int8 (or null = bf16)
+          kv-quantize: null            # "int8": per-row int8 KV cache halves
+                                       # decode's cache-read HBM traffic
+                                       # (dense layout)
           kv-layout: "paged"           # or "dense"; paged enables the three
                                        # serving schedulers below
           prefix-cache: true           # shared prompt prefixes skip prefill

@@ -31,6 +31,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from langstream_tpu.models.kvquant import (
+    cache_scores,
+    cache_seq_len,
+    cache_slice_window,
+    cache_values,
+    cache_write_rows,
+    is_quant_cache,
+    quantize_rows,
+)
 from langstream_tpu.models.quant import as_weight as _w, embedding_take
 
 
@@ -400,8 +409,9 @@ def llama_prefill(
     logits, ks, vs = prefill_forward(
         config, params, tokens, lengths, use_flash, mesh=mesh, ffn=ffn
     )
-    new_k = cache_k.at[:, slot_ids, :Pn].set(ks)
-    new_v = cache_v.at[:, slot_ids, :Pn].set(vs)
+    idx = (slice(None), slot_ids, slice(None, Pn))
+    new_k = cache_write_rows(cache_k, ks, idx)
+    new_v = cache_write_rows(cache_v, vs, idx)
     return logits, new_k, new_v
 
 
@@ -435,7 +445,7 @@ def llama_decode_step(
     if active is None:
         active = jnp.ones(tokens.shape[0], dtype=bool)
     B = tokens.shape[0]
-    S = cache_k.shape[2]
+    S = cache_seq_len(cache_k)
     x = embedding_take(params["embed"], tokens)  # (B, H)
     cos, sin = _rope(lengths, c.head_dim, c.rope_theta)  # (B, half)
     k_idx = jnp.arange(S)[None, :]
@@ -453,14 +463,13 @@ def llama_decode_step(
         v = (h @ _w(lp["wv"])).reshape(B, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        ck_l = ck_l.at[batch_idx, lengths].set(k)
-        cv_l = cv_l.at[batch_idx, lengths].set(v)
+        ck_l = cache_write_rows(ck_l, k, (batch_idx, lengths))
+        cv_l = cache_write_rows(cv_l, v, (batch_idx, lengths))
         qg = q.reshape(B, c.kv_heads, G, c.head_dim)
-        scores = jnp.einsum("bkgd,bskd->bkgs", qg, ck_l).astype(jnp.float32)
-        scores = scores / math.sqrt(c.head_dim)
+        scores = cache_scores(qg, ck_l) / math.sqrt(c.head_dim)
         scores = jnp.where(key_mask[:, None, None, :], scores, neg)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bkgs,bskd->bkgd", probs, cv_l)
+        out = cache_values(probs, cv_l)
         out = out.reshape(B, c.heads * c.head_dim)
         x = x + out @ _w(lp["wo"])
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
@@ -511,12 +520,12 @@ def llama_decode_chunk(
         ffn = _default_ffn
     B = tokens0.shape[0]
     full_k, full_v = cache_k, cache_v
-    if window is not None and window < cache_k.shape[2]:
+    if window is not None and window < cache_seq_len(cache_k):
         # static slice: XLA reads only these rows; the commit below still
         # targets the full cache (valid because base_lengths < window)
-        cache_k = jax.lax.slice_in_dim(cache_k, 0, window, axis=2)
-        cache_v = jax.lax.slice_in_dim(cache_v, 0, window, axis=2)
-    S = cache_k.shape[2]
+        cache_k = cache_slice_window(cache_k, window)
+        cache_v = cache_slice_window(cache_v, window)
+    S = cache_seq_len(cache_k)
     G = c.heads // c.kv_heads
     adv = active.astype(jnp.int32)
     neg = jnp.finfo(jnp.float32).min
@@ -547,7 +556,7 @@ def llama_decode_chunk(
                 vbuf_l, v[:, None], step_idx, axis=1
             )
             qg = q.reshape(B, c.kv_heads, G, c.head_dim)
-            s_cache = jnp.einsum("bkgd,bskd->bkgs", qg, ck_l).astype(jnp.float32)
+            s_cache = cache_scores(qg, ck_l)
             s_buf = jnp.einsum("bkgd,btkd->bkgt", qg, kbuf_l).astype(jnp.float32)
             scale = 1.0 / math.sqrt(c.head_dim)
             s_cache = jnp.where(
@@ -557,7 +566,7 @@ def llama_decode_chunk(
             s_all = jnp.concatenate([s_cache, s_buf], axis=-1)
             probs = jax.nn.softmax(s_all, axis=-1).astype(x.dtype)
             p_cache, p_buf = probs[..., :S], probs[..., S:]
-            out = jnp.einsum("bkgs,bskd->bkgd", p_cache, cv_l) + jnp.einsum(
+            out = cache_values(p_cache, cv_l) + jnp.einsum(
                 "bkgt,btkd->bkgd", p_buf, vbuf_l
             )
             out = out.reshape(B, c.heads * c.head_dim)
@@ -579,15 +588,26 @@ def llama_decode_chunk(
         step, (tokens0, kbuf0, vbuf0, key), jnp.arange(num_steps)
     )
 
-    # commit: one write of the chunk buffer into the cache per slot
-    def commit_lb(c_lb, buf_lb, start):  # (S,K,D), (num_steps,K,D)
-        return jax.lax.dynamic_update_slice(c_lb, buf_lb, (start, 0, 0))
+    # commit: one write of the chunk buffer into the cache per slot. The
+    # buffer stays bf16 through the scan (it is tiny and re-read every
+    # step); an int8 cache quantises it once here.
+    def commit_leaf(full_leaf, buf_leaf):
+        def commit_lb(c_lb, b_lb, start):  # (S, ...), (num_steps, ...)
+            return jax.lax.dynamic_update_slice(
+                c_lb, b_lb, (start,) + (0,) * (c_lb.ndim - 1)
+            )
 
-    commit = jax.vmap(  # over layers
-        jax.vmap(commit_lb, in_axes=(0, 0, 0)), in_axes=(0, 0, None)
-    )
-    out_k = commit(full_k, kbuf, base_lengths)
-    out_v = commit(full_v, vbuf, base_lengths)
+        f = jax.vmap(  # over layers
+            jax.vmap(commit_lb, in_axes=(0, 0, 0)), in_axes=(0, 0, None)
+        )
+        return f(full_leaf, buf_leaf, base_lengths)
+
+    if is_quant_cache(full_k):
+        out_k = jax.tree.map(commit_leaf, full_k, quantize_rows(kbuf))
+        out_v = jax.tree.map(commit_leaf, full_v, quantize_rows(vbuf))
+    else:
+        out_k = commit_leaf(full_k, kbuf)
+        out_v = commit_leaf(full_v, vbuf)
     final_lengths = base_lengths + num_steps * adv
     return chunk_tokens, chunk_lps, final_tokens, final_lengths, out_k, out_v
 

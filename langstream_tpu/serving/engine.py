@@ -143,6 +143,11 @@ class ServingConfig:
     # weight-only quantization: None (bf16) or "int8" (scales TP-shard
     # with their weights, so the mesh posture keeps the int8 default)
     quantize: str | None = None
+    # KV-cache quantization (dense layout): None (bf16) or "int8" —
+    # per-(position, head)-row absmax int8 halves the cache-read HBM
+    # traffic that dominates the decode roofline; the scale folds into
+    # scores/probs so no bf16 cache is ever materialised (models/kvquant.py)
+    kv_quantize: str | None = None
     # KV cache layout: "dense" reserves slots × max_seq_len rows up front;
     # "paged" shares a block pool sized kv_pool_fraction of that, with
     # worst-case admission reservations (models/paged.py)
@@ -203,6 +208,7 @@ class ServingConfig:
             "warmup-on-start": self.warmup_on_start,
             "prefill-batch": self.prefill_batch,
             "quantize": self.quantize,
+            "kv-quantize": self.kv_quantize,
             "kv-layout": self.kv_layout,
             "kv-block-size": self.kv_block_size,
             "kv-pool-fraction": self.kv_pool_fraction,
@@ -220,6 +226,7 @@ class ServingConfig:
         mesh = tuple((k, int(v)) for k, v in (d.get("mesh") or {}).items())
         return cls(
             quantize=d.get("quantize"),
+            kv_quantize=d.get("kv-quantize", d.get("kv_quantize")),
             model=d.get("model", "tiny"),
             slots=int(d.get("slots", 8)),
             max_seq_len=int(d.get("max-seq-len", d.get("max_seq_len", 512))),
@@ -509,6 +516,15 @@ class TpuServingEngine:
         elif self.config.quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {self.config.quantize!r}")
 
+        if self.config.kv_quantize not in (None, "none", "int8"):
+            raise ValueError(
+                f"unknown kv_quantize mode {self.config.kv_quantize!r}"
+            )
+        if self.config.kv_quantize == "int8" and self.config.kv_layout != "dense":
+            raise ValueError(
+                "kv-quantize=int8 currently requires kv-layout=dense (the "
+                "paged block pool and its kernels are bf16)"
+            )
         if self.config.prefill_chunk > 0 and self.config.kv_layout != "paged":
             raise ValueError(
                 "prefill-chunk requires kv-layout=paged (chunked prefill "
@@ -548,21 +564,34 @@ class TpuServingEngine:
         elif self.config.kv_layout != "dense":
             raise ValueError(f"unknown kv_layout {self.config.kv_layout!r}")
         else:
-            cache_k, cache_v = init_kv_cache(mc, self.config.slots)
+            if self.config.kv_quantize == "int8":
+                from langstream_tpu.models.kvquant import init_kv_cache_int8
+
+                cache_k, cache_v = init_kv_cache_int8(mc, self.config.slots)
+            else:
+                cache_k, cache_v = init_kv_cache(mc, self.config.slots)
             kernel = self.config.dense_kernel
             if kernel == "auto":
                 # the paged Pallas read kernel doubles as the dense fast
-                # path (identity block tables); meshes keep the XLA einsum
+                # path (identity block tables); meshes keep the XLA einsum,
+                # and so does the int8 cache (the scale-folded einsum read
+                # IS the fused fast path — the Pallas kernel is bf16-only)
                 kernel = (
                     "pallas"
                     if self.mesh is None
                     and jax.default_backend() == "tpu"
                     and mc.max_seq_len % 128 == 0
+                    and self.config.kv_quantize != "int8"
                     else "xla"
                 )
             elif kernel != "xla":
                 # forced kernels fail fast at construction, not inside a
                 # jitted trace at first decode
+                if self.config.kv_quantize == "int8":
+                    raise ValueError(
+                        "dense_kernel=pallas reads a bf16 cache; with "
+                        "kv-quantize=int8 keep dense_kernel=xla"
+                    )
                 if self.mesh is not None:
                     raise ValueError(
                         "dense_kernel=pallas runs per-device; under a mesh "
@@ -634,12 +663,23 @@ class TpuServingEngine:
                 cspec = NamedSharding(
                     self.mesh, paged_cache_spec(self.mesh.axis_names)
                 )
+                cache_k = put_global(cache_k, cspec)
+                cache_v = put_global(cache_v, cspec)
             else:
-                cspec = NamedSharding(
-                    self.mesh, kv_cache_spec(self.mesh.axis_names)
-                )
-            cache_k = put_global(cache_k, cspec)
-            cache_v = put_global(cache_v, cspec)
+                spec = kv_cache_spec(self.mesh.axis_names)
+                if isinstance(cache_k, dict):
+                    # int8 cache pytree: data (L,B,S,K,D) takes the full
+                    # spec, scales (L,B,S,K) the same minus the head_dim axis
+                    sharding = {
+                        "q": NamedSharding(self.mesh, spec),
+                        "s": NamedSharding(self.mesh, P(*spec[:4])),
+                    }
+                    cache_k = jax.tree.map(put_global, cache_k, sharding)
+                    cache_v = jax.tree.map(put_global, cache_v, sharding)
+                else:
+                    cspec = NamedSharding(self.mesh, spec)
+                    cache_k = put_global(cache_k, cspec)
+                    cache_v = put_global(cache_v, cspec)
         self.cache_k, self.cache_v = cache_k, cache_v
 
         mc_static = mc

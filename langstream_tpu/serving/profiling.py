@@ -162,6 +162,7 @@ def decode_step_bytes(
     window: int,
     quantize: str | None = None,
     kv_dtype_bytes: int = 2,
+    kv_quantize: str | None = None,
 ) -> DecodeRoofline:
     """Bytes that MUST cross HBM for one decode step of ``slots`` slots with
     an attention window of ``window`` cache rows per slot.
@@ -175,9 +176,12 @@ def decode_step_bytes(
 
     n_params = param_count(c)
     wbytes = n_params * (1 if quantize == "int8" else 2)
-    cache = (
-        c.layers * slots * window * c.kv_heads * c.head_dim * kv_dtype_bytes * 2
-    )
+    if kv_quantize == "int8":
+        # int8 row + one f32 scale per (position, head) row
+        row_bytes = c.head_dim + 4
+    else:
+        row_bytes = c.head_dim * kv_dtype_bytes
+    cache = c.layers * slots * window * c.kv_heads * row_bytes * 2
     return DecodeRoofline(
         weight_bytes=wbytes,
         cache_bytes_per_step=cache,

@@ -1,0 +1,216 @@
+"""int8 KV cache (models/kvquant.py): quantisation math, decode-path
+equivalence against the dequantised reference, and the serving engine
+end-to-end on the quantised cache."""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from langstream_tpu.models.kvquant import (
+    dequantize_rows,
+    init_kv_cache_int8,
+    quantize_rows,
+)
+from langstream_tpu.models.llama import (
+    LlamaConfig,
+    init_kv_cache,
+    init_llama_params,
+    llama_decode_chunk,
+    llama_decode_step,
+    llama_prefill,
+)
+
+
+def _greedy(logits, key):
+    t = jnp.argmax(logits, -1).astype(jnp.int32)
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, -1), t[:, None], 1
+    ).squeeze(1)
+    return t, lp
+
+
+def test_quantize_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 7, 128), jnp.float32)
+    q = quantize_rows(x)
+    assert q["q"].dtype == jnp.int8 and q["s"].shape == (3, 7)
+    back = dequantize_rows(q, jnp.float32)
+    # absmax int8: error per element <= half a quantisation step
+    step = np.asarray(q["s"])[..., None]
+    assert np.all(np.abs(np.asarray(back - x)) <= step * 0.51)
+
+
+def test_quantize_zero_rows_are_stable():
+    q = quantize_rows(jnp.zeros((2, 4, 16)))
+    assert np.all(np.asarray(q["q"]) == 0)
+    assert np.all(np.isfinite(np.asarray(q["s"])))
+    assert np.all(np.asarray(dequantize_rows(q)) == 0)
+
+
+def _prefilled(mc, params, quantized: bool):
+    B = 4
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(1, 250, (B, 16)), dtype=jnp.int32)
+    lengths = jnp.array([16, 12, 9, 16], jnp.int32)
+    init = init_kv_cache_int8 if quantized else init_kv_cache
+    ck, cv = init(mc, B)
+    logits, ck, cv = llama_prefill(
+        mc, params, tokens, lengths, ck, cv, jnp.arange(B)
+    )
+    return logits, lengths, ck, cv
+
+
+def test_prefill_logits_unchanged_by_kv_quantization():
+    """Prefill attends over its own fresh bf16 K/V — quantisation only
+    affects what later steps READ back, never the prefill logits."""
+    mc = LlamaConfig.tiny(max_seq_len=64)
+    params = init_llama_params(mc)
+    logits8, _, _, _ = _prefilled(mc, params, True)
+    logitsf, _, _, _ = _prefilled(mc, params, False)
+    assert np.array_equal(np.asarray(logits8), np.asarray(logitsf))
+
+
+def test_decode_chunk_matches_dequantized_reference():
+    """The fused int8 read path (scales folded into scores/probs) must
+    equal a bf16 cache holding the dequantised values — this isolates the
+    arithmetic from the quantisation error itself."""
+    mc = LlamaConfig.tiny(max_seq_len=64)
+    params = init_llama_params(mc)
+    logits8, lengths, ck8, cv8 = _prefilled(mc, params, True)
+    ck_ref = dequantize_rows(ck8, mc.dtype)
+    cv_ref = dequantize_rows(cv8, mc.dtype)
+    t0 = jnp.argmax(logits8, -1).astype(jnp.int32)
+    active = jnp.ones(4, bool)
+    key = jax.random.PRNGKey(0)
+    out8 = llama_decode_chunk(
+        mc, params, t0, lengths, active, ck8, cv8, _greedy, key, 6
+    )
+    ref = llama_decode_chunk(
+        mc, params, t0, lengths, active, ck_ref, cv_ref, _greedy, key, 6
+    )
+    # not bit-identical: the fused path applies scales in f32 where the
+    # reference rounds the dequantised cache to bf16 first — a near-tie
+    # argmax flip cascades through the rest of that slot's greedy stream,
+    # so sequences are a loose sanity floor, not an exactness check (the
+    # exact arithmetic claims are the step-logit and chunk-vs-step tests)
+    match = (np.asarray(out8[0]) == np.asarray(ref[0])).mean()
+    assert match >= 0.5, f"token match {match:.2f} vs dequantised reference"
+    # chunk step 0 agrees with the single-step path on the same int8 cache
+    # (near-identical math: the chunk holds the current row bf16 in its
+    # buffer where the step quantises it — deterministic under this seed)
+    step_logits, _, _ = llama_decode_step(
+        mc, params, t0, lengths, ck8, cv8
+    )
+    assert np.array_equal(
+        np.asarray(out8[0][0]), np.asarray(jnp.argmax(step_logits, -1))
+    )
+    # windowed variant agrees too (window slicing slices both leaves)
+    out_w = llama_decode_chunk(
+        mc, params, t0, lengths, active, ck8, cv8, _greedy, key, 6, window=32
+    )
+    assert np.array_equal(np.asarray(out_w[0]), np.asarray(out8[0]))
+
+
+def test_decode_step_close_to_dequantized_reference():
+    mc = LlamaConfig.tiny(max_seq_len=64)
+    params = init_llama_params(mc)
+    logits8, lengths, ck8, cv8 = _prefilled(mc, params, True)
+    t0 = jnp.argmax(logits8, -1).astype(jnp.int32)
+    l8, _, _ = llama_decode_step(mc, params, t0, lengths, ck8, cv8)
+    lr, _, _ = llama_decode_step(
+        mc, params, t0, lengths,
+        dequantize_rows(ck8, mc.dtype), dequantize_rows(cv8, mc.dtype),
+    )
+    assert np.abs(np.asarray(l8) - np.asarray(lr)).max() < 0.25
+
+
+def test_engine_serves_on_int8_kv(run_async):
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=4, max_seq_len=64, decode_chunk=4,
+                kv_quantize="int8",
+            )
+        )
+        r1 = await engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+        r2 = await engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+        assert r1["tokens"] == r2["tokens"]  # deterministic greedy
+        # continuous batching on the quantised cache
+        results = await asyncio.gather(
+            *(engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+              for _ in range(6))
+        )
+        for r in results:
+            assert r["tokens"] == r1["tokens"]
+        await engine.close()
+
+    run_async(main())
+
+
+def test_engine_int8_kv_first_token_matches_bf16(run_async):
+    """First generated token comes from prefill logits, which quantisation
+    does not touch — it must match the bf16-cache engine exactly."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        e8 = TpuServingEngine.get_or_create(
+            ServingConfig(model="tiny", slots=2, max_seq_len=64,
+                          kv_quantize="int8")
+        )
+        r8 = await e8.generate("hello", {"max-tokens": 4, "temperature": 0})
+        await e8.close()
+        ef = TpuServingEngine.get_or_create(
+            ServingConfig(model="tiny", slots=2, max_seq_len=64)
+        )
+        rf = await ef.generate("hello", {"max-tokens": 4, "temperature": 0})
+        await ef.close()
+        assert r8["tokens"][0] == rf["tokens"][0]
+
+    run_async(main())
+
+
+def test_engine_rejects_unsupported_kv_quantize_combos():
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    with pytest.raises(ValueError, match="kv-layout=dense"):
+        TpuServingEngine(
+            ServingConfig(model="tiny", kv_layout="paged", kv_quantize="int8")
+        )
+    with pytest.raises(ValueError, match="kv_quantize"):
+        TpuServingEngine(ServingConfig(model="tiny", kv_quantize="fp8"))
+    with pytest.raises(ValueError, match="dense_kernel=xla"):
+        TpuServingEngine(
+            ServingConfig(
+                model="tiny", max_seq_len=128, kv_quantize="int8",
+                dense_kernel="pallas-interpret",
+            )
+        )
+
+
+def test_sharded_int8_kv_decode_matches_single_device(run_async):
+    """The dict cache shards over the mesh (data + scales) and the fused
+    read path produces the same greedy tokens as the unsharded engine."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        base = dict(
+            model="tiny", slots=4, max_seq_len=64, decode_chunk=4,
+            kv_quantize="int8",
+        )
+        single = TpuServingEngine.get_or_create(ServingConfig(**base))
+        r1 = await single.generate("abcd", {"max-tokens": 6, "temperature": 0})
+        await single.close()
+        meshed = TpuServingEngine.get_or_create(
+            ServingConfig(**base, mesh=(("dp", 2), ("tp", 2)))
+        )
+        r2 = await meshed.generate("abcd", {"max-tokens": 6, "temperature": 0})
+        await meshed.close()
+        assert r1["tokens"] == r2["tokens"]
+
+    run_async(main())
