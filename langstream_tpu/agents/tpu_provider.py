@@ -24,7 +24,7 @@ Resource shape (``configuration.yaml``):
           quantize: "int8"             # weight-only int8 (or null = bf16)
           kv-quantize: null            # "int8": per-row int8 KV cache halves
                                        # decode's cache-read HBM traffic
-                                       # (dense layout)
+                                       # (dense + paged layouts)
           kv-layout: "paged"           # or "dense"; paged enables the three
                                        # serving schedulers below
           prefix-cache: true           # shared prompt prefixes skip prefill

@@ -111,7 +111,13 @@ def llama_prefill_continue_paged(
     if ffn is None:
         ffn = _default_ffn
     B, P2 = tokens.shape
-    bs = pool_k.shape[2]
+    quant = isinstance(pool_k, dict)
+    bs = (pool_k["q"] if quant else pool_k).shape[2]
+    if quant and kernel != "xla":
+        raise ValueError(
+            "int8 paged pools read through the XLA gather path; the Pallas "
+            "kernels are bf16-only (kernel='xla')"
+        )
     KhD = c.kv_heads * c.head_dim
     G = c.heads // c.kv_heads
     x = embedding_take(params["embed"], tokens)  # (B, P2, H)
@@ -149,12 +155,24 @@ def llama_prefill_continue_paged(
         l0 = jnp.zeros((B, c.kv_heads, G, P2), jnp.float32)
         o0 = jnp.zeros((B, c.kv_heads, G, P2, c.head_dim), jnp.float32)
 
+        # the kvquant helpers work on (B, Kh, G', T/D) — fold the query
+        # axis into G (one source of truth for the int8 scale-folding
+        # identities; the reshapes touch only score-sized tensors)
+        qg_flat = qg.transpose(0, 2, 3, 1, 4).reshape(
+            B, c.kv_heads, G * P2, c.head_dim
+        )
+
         def online_update(carry, k_blk, v_blk, mask_blk):
-            # one flash-attention style block update: k/v (B, T, Kh, D),
-            # mask (B, 1, 1, P2?, T) broadcastable over (B,Kh,G,P2,T)
+            # one flash-attention style block update: k/v (B, T, Kh, D) —
+            # bf16 arrays, or int8 {"q","s"} pairs read through the fused
+            # kvquant helpers — mask (B, 1, 1, P2?, T) broadcastable over
+            # (B,Kh,G,P2,T)
+            from langstream_tpu.models.kvquant import cache_scores, cache_values
+
             o, l, m = carry
-            s = jnp.einsum("bqkgd,btkd->bkgqt", qg, k_blk).astype(
-                jnp.float32
+            T = (k_blk["s"] if isinstance(k_blk, dict) else k_blk).shape[1]
+            s = cache_scores(qg_flat, k_blk).reshape(
+                B, c.kv_heads, G, P2, T
             ) * scale
             s = jnp.where(mask_blk, s, NEG_INF)
             m_new = jnp.maximum(m, s.max(axis=-1))
@@ -162,9 +180,10 @@ def llama_prefill_continue_paged(
             p = jnp.where(mask_blk, jnp.exp(s - shift[..., None]), 0.0)
             alpha = jnp.exp(jnp.where(m <= NEG_INF, NEG_INF, m - shift))
             l = l * alpha + p.sum(axis=-1)
-            o = o * alpha[..., None] + jnp.einsum(
-                "bkgqt,btkd->bkgqd", p.astype(v_blk.dtype), v_blk
-            ).astype(jnp.float32)
+            update = cache_values(
+                p.astype(qg.dtype).reshape(B, c.kv_heads, G * P2, T), v_blk
+            ).reshape(B, c.kv_heads, G, P2, c.head_dim)
+            o = o * alpha[..., None] + update.astype(jnp.float32)
             return o, l, m_new
 
         if kernel != "xla":
@@ -237,12 +256,23 @@ def llama_prefill_continue_paged(
                 col_idx = t * cps + jnp.arange(cps)         # (cps,)
                 safe = jnp.minimum(col_idx, num_read_blocks - 1)
                 cols = jnp.take(block_tables, safe, axis=1)  # (B, cps)
-                k_blk = jnp.take(ck_l, cols, axis=0).reshape(
-                    B, cps * bs, c.kv_heads, c.head_dim
-                )
-                v_blk = jnp.take(cv_l, cols, axis=0).reshape(
-                    B, cps * bs, c.kv_heads, c.head_dim
-                )
+
+                def take_blk(pool_l):
+                    if isinstance(pool_l, dict):
+                        return {
+                            "q": jnp.take(pool_l["q"], cols, axis=0).reshape(
+                                B, cps * bs, c.kv_heads, c.head_dim
+                            ),
+                            "s": jnp.take(pool_l["s"], cols, axis=0).reshape(
+                                B, cps * bs, c.kv_heads
+                            ),
+                        }
+                    return jnp.take(pool_l, cols, axis=0).reshape(
+                        B, cps * bs, c.kv_heads, c.head_dim
+                    )
+
+                k_blk = take_blk(ck_l)
+                v_blk = take_blk(cv_l)
                 # positions from the UNclamped indices: a clamped
                 # (duplicate) tail column computes positions ≥
                 # num_read_blocks·bs, which the < start mask never admits
@@ -323,8 +353,13 @@ def llama_verify_chunk_paged(
     draft in parallel; in-jit greedy acceptance keeps the longest prefix of
     drafts the model itself would have produced, plus the model's one bonus
     token after it. Drafts cost nothing when wrong (acceptance only ever
-    emits model-argmax tokens, so output streams are IDENTICAL to plain
-    greedy decode — speculation changes latency, never content).
+    emits model-argmax tokens, so on a bf16 pool output streams are
+    IDENTICAL to plain greedy decode — speculation changes latency, never
+    content). On an int8 pool the guarantee is per-forward, not
+    cross-engine: a position reads as fresh bf16 before commit and as
+    quantised int8 after, and verify commits at different boundaries than
+    the fixed decode chunk — near-tie argmaxes may differ (~1e-2 logit
+    scale) from a non-speculative engine's stream.
 
     Returns (emitted (B, D1) — model argmax at every position,
     emit_counts (B,) — how many leading emitted tokens are real (1..D1),
@@ -377,29 +412,45 @@ def llama_verify_chunk_paged(
     return model_next, adv, next_tokens, new_lengths, pool_k, pool_v, logprobs
 
 
+def _gather_layer_window(c, pool_l, block_tables, num_read_blocks):
+    """Densify one layer's window: (B, W, Kh, D) bf16, or the int8
+    {"q": (B,W,Kh,D), "s": (B,W,Kh)} pair ready for the kvquant helpers."""
+    add_l = lambda a: a[None]
+    drop_l = lambda a: a[0]
+    if isinstance(pool_l, dict):
+        w = gather_kv(jax.tree.map(add_l, pool_l), block_tables, num_read_blocks)
+        B, W = w["s"].shape[1:3]
+        return {
+            "q": w["q"][0].reshape(B, W, c.kv_heads, c.head_dim),
+            "s": w["s"][0],
+        }
+    w = drop_l(gather_kv(add_l(pool_l), block_tables, num_read_blocks))
+    B, W = w.shape[:2]
+    return w.reshape(B, W, c.kv_heads, c.head_dim)
+
+
 def _cache_partial_xla(
     c: LlamaConfig,
     q: jax.Array,             # (B, H, D)
-    ck_l: jax.Array,          # (nb, bs, KhD)
-    cv_l: jax.Array,
+    ck_l,                     # (nb, bs, KhD) array or int8 {"q","s"} pool
+    cv_l,
     block_tables: jax.Array,  # (B, max_blocks)
     lengths: jax.Array,       # (B,)
     num_read_blocks: int,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Reference paged read: gather the window densely, compute partial
     softmax stats. Works on every backend and under pjit meshes (gathers
-    shard like any XLA op); pays one densified copy."""
+    shard like any XLA op); pays one densified copy. int8 pools read
+    through the fused kvquant helpers (scales onto scores/probs)."""
+    from langstream_tpu.models.kvquant import cache_scores, cache_values
+
     B, H, D = q.shape
-    bs = ck_l.shape[1]
-    W = num_read_blocks * bs
-    kw = gather_kv(ck_l[None], block_tables, num_read_blocks)[0]  # (B, W, KhD)
-    vw = gather_kv(cv_l[None], block_tables, num_read_blocks)[0]
-    kw = kw.reshape(B, W, c.kv_heads, c.head_dim)
-    vw = vw.reshape(B, W, c.kv_heads, c.head_dim)
+    kw = _gather_layer_window(c, ck_l, block_tables, num_read_blocks)
+    vw = _gather_layer_window(c, cv_l, block_tables, num_read_blocks)
+    W = (kw["s"] if isinstance(kw, dict) else kw).shape[1]
     G = c.heads // c.kv_heads
     qg = q.reshape(B, c.kv_heads, G, c.head_dim)
-    s = jnp.einsum("bkgd,bskd->bkgs", qg, kw).astype(jnp.float32)
-    s = s / math.sqrt(c.head_dim)
+    s = cache_scores(qg, kw) / math.sqrt(c.head_dim)
     mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, :]
     s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1)                                   # (B, Kh, G)
@@ -407,7 +458,7 @@ def _cache_partial_xla(
     p = jnp.exp(s - shift[..., None])
     p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bkgs,bskd->bkgd", p.astype(vw.dtype), vw).astype(jnp.float32)
+    acc = cache_values(p.astype(q.dtype), vw).astype(jnp.float32)
     return (
         acc.reshape(B, H, D),
         m.reshape(B, H),
@@ -439,6 +490,11 @@ def llama_decode_chunk_paged(
     c = config
     if ffn is None:
         ffn = _default_ffn
+    if isinstance(pool_k, dict) and kernel != "xla":
+        raise ValueError(
+            "int8 paged pools read through the XLA gather path; the Pallas "
+            "kernels are bf16-only (kernel='xla')"
+        )
     B = tokens0.shape[0]
     KhD = c.kv_heads * c.head_dim
     adv = active.astype(jnp.int32)

@@ -79,6 +79,21 @@ def init_paged_kv_cache(
     return jnp.zeros(shape, dtype=c.dtype), jnp.zeros(shape, dtype=c.dtype)
 
 
+def init_paged_kv_cache_int8(
+    config, layout: PagedLayout
+) -> tuple[dict, dict]:
+    """int8 pools: data as :func:`init_paged_kv_cache` plus one f32 scale
+    per (block row, kv-head) — the paged twin of
+    :func:`langstream_tpu.models.kvquant.init_kv_cache_int8`."""
+    c = config
+    base = (c.layers, layout.num_blocks, layout.block_size)
+    make = lambda: {
+        "q": jnp.zeros(base + (c.kv_heads * c.head_dim,), dtype=jnp.int8),
+        "s": jnp.zeros(base + (c.kv_heads,), dtype=jnp.float32),
+    }
+    return make(), make()
+
+
 def paged_cache_spec(mesh_axes: tuple[str, ...]):
     """Pool (L, nb, bs, Kh*D): the trailing fused head axis shards on tp.
     Blocks are NOT sharded on dp (any slot may use any block), so paged
@@ -95,18 +110,20 @@ def paged_cache_spec(mesh_axes: tuple[str, ...]):
 
 
 def write_rows(
-    cache: jax.Array,       # (L, nb, bs, KhD)
-    rows: jax.Array,        # (L, B, T, KhD) — new K or V rows per slot
+    cache,                  # (L, nb, bs, KhD) array, or int8 {"q","s"} pools
+    rows: jax.Array,        # (L, B, T, KhD) — new bf16 K or V rows per slot
     block_tables: jax.Array,  # (B, max_blocks) int32
     starts: jax.Array,      # (B,) first sequence position of rows[;, b]
     valid: jax.Array,       # (B, T) bool — rows beyond a slot's true count
-) -> jax.Array:
+):
     """Scatter ``rows`` into the pool at each slot's block-mapped positions.
 
     Invalid rows are redirected to a scratch row (block 0 never backs live
-    data; see BlockManager) so the scatter stays shape-static.
+    data; see BlockManager) so the scatter stays shape-static. An int8 pool
+    quantises the rows here — write sites stay layout-agnostic.
     """
-    L, nb, bs, KhD = cache.shape
+    quant = isinstance(cache, dict)
+    nb, bs, KhD = (cache["q"] if quant else cache).shape[1:]
     B, T = rows.shape[1], rows.shape[2]
     pos = starts[:, None] + jnp.arange(T)[None, :]          # (B, T)
     # clamp: invalid rows may compute positions past the table; they're
@@ -118,24 +135,49 @@ def write_rows(
     # invalid rows land in block 0 (reserved scratch, never allocated), so
     # the scatter stays shape-static and garbage never touches live data
     flat = jnp.where(valid, flat, 0).reshape(-1)             # (B*T,)
-    flat_rows = rows.reshape(L, B * T, KhD)
-    flat_cache = cache.reshape(L, nb * bs, KhD)
-    updated = flat_cache.at[:, flat].set(flat_rows)
-    return updated.reshape(L, nb, bs, KhD)
+
+    def scatter(pool, new_rows):  # trailing dims: KhD / Kh
+        L = new_rows.shape[0]
+        tail = pool.shape[3:]
+        flat_cache = pool.reshape((L, nb * bs) + tail)
+        flat_rows = new_rows.reshape((L, B * T) + tail)
+        return flat_cache.at[:, flat].set(flat_rows).reshape(pool.shape)
+
+    if not quant:
+        return scatter(cache, rows)
+    from langstream_tpu.models.kvquant import quantize_rows
+
+    L = rows.shape[0]
+    Kh = cache["s"].shape[3]
+    q = quantize_rows(rows.reshape(L, B, T, Kh, KhD // Kh))
+    return {
+        "q": scatter(cache["q"], q["q"].reshape(L, B, T, KhD)),
+        "s": scatter(cache["s"], q["s"]),
+    }
 
 
 def gather_kv(
-    cache: jax.Array,         # (L, nb, bs, KhD)
+    cache,                    # (L, nb, bs, KhD) array or int8 {"q","s"} pool
     block_tables: jax.Array,  # (B, max_blocks)
     num_read_blocks: int,     # static: table columns to read (window bucket)
-) -> jax.Array:
+):
     """XLA reference read: densify the first ``num_read_blocks`` blocks of
-    every slot → ``(L, B, num_read_blocks*bs, KhD)``."""
-    L, nb, bs, KhD = cache.shape
+    every slot → ``(L, B, num_read_blocks*bs, KhD)`` (int8 pools gather
+    data and scales alike — trailing dims pass through)."""
     tables = block_tables[:, :num_read_blocks]               # (B, nrb)
-    gathered = jnp.take(cache, tables, axis=1)               # (L, B, nrb, bs, KhD)
     B = tables.shape[0]
-    return gathered.reshape(L, B, num_read_blocks * bs, KhD)
+
+    def gather(pool):
+        bs = pool.shape[2]
+        tail = pool.shape[3:]
+        gathered = jnp.take(pool, tables, axis=1)  # (L, B, nrb, bs, tail)
+        return gathered.reshape(
+            (pool.shape[0], B, num_read_blocks * bs) + tail
+        )
+
+    if isinstance(cache, dict):
+        return jax.tree.map(gather, cache)
+    return gather(cache)
 
 
 # ---------------------------------------------------------------------------

@@ -143,10 +143,11 @@ class ServingConfig:
     # weight-only quantization: None (bf16) or "int8" (scales TP-shard
     # with their weights, so the mesh posture keeps the int8 default)
     quantize: str | None = None
-    # KV-cache quantization (dense layout): None (bf16) or "int8" —
-    # per-(position, head)-row absmax int8 halves the cache-read HBM
-    # traffic that dominates the decode roofline; the scale folds into
-    # scores/probs so no bf16 cache is ever materialised (models/kvquant.py)
+    # KV-cache quantization (dense AND paged layouts): None (bf16) or
+    # "int8" — per-(position, head)-row absmax int8 halves the cache-read
+    # HBM traffic that dominates the decode roofline; the scale folds into
+    # scores/probs so no bf16 cache is ever materialised (models/kvquant.py).
+    # int8 reads go through the fused XLA path (Pallas kernels are bf16)
     kv_quantize: str | None = None
     # KV cache layout: "dense" reserves slots × max_seq_len rows up front;
     # "paged" shares a block pool sized kv_pool_fraction of that, with
@@ -520,11 +521,6 @@ class TpuServingEngine:
             raise ValueError(
                 f"unknown kv_quantize mode {self.config.kv_quantize!r}"
             )
-        if self.config.kv_quantize == "int8" and self.config.kv_layout != "dense":
-            raise ValueError(
-                "kv-quantize=int8 currently requires kv-layout=dense (the "
-                "paged block pool and its kernels are bf16)"
-            )
         if self.config.prefill_chunk > 0 and self.config.kv_layout != "paged":
             raise ValueError(
                 "prefill-chunk requires kv-layout=paged (chunked prefill "
@@ -554,12 +550,31 @@ class TpuServingEngine:
                 num_blocks=self.config.kv_pool_blocks,
             )
             self.block_mgr = BlockManager(self.paged_layout, self.config.slots)
-            cache_k, cache_v = init_paged_kv_cache(mc, self.paged_layout)
+            if self.config.kv_quantize == "int8":
+                from langstream_tpu.models.paged import init_paged_kv_cache_int8
+
+                cache_k, cache_v = init_paged_kv_cache_int8(
+                    mc, self.paged_layout
+                )
+            else:
+                cache_k, cache_v = init_paged_kv_cache(mc, self.paged_layout)
             kernel = self.config.paged_kernel
             if kernel == "auto":
                 # the Pallas kernel is the TPU fast path; under a mesh it
-                # runs per-shard via shard_map (slots on dp, heads on tp)
-                kernel = "pallas" if jax.default_backend() == "tpu" else "xla"
+                # runs per-shard via shard_map (slots on dp, heads on tp).
+                # int8 pools read through the fused XLA gather (the Pallas
+                # kernels are bf16-only).
+                kernel = (
+                    "pallas"
+                    if jax.default_backend() == "tpu"
+                    and self.config.kv_quantize != "int8"
+                    else "xla"
+                )
+            elif kernel != "xla" and self.config.kv_quantize == "int8":
+                raise ValueError(
+                    "paged_kernel=pallas reads a bf16 pool; with "
+                    "kv-quantize=int8 keep paged_kernel=xla"
+                )
             self.paged_read_kernel = kernel
         elif self.config.kv_layout != "dense":
             raise ValueError(f"unknown kv_layout {self.config.kv_layout!r}")
@@ -663,8 +678,16 @@ class TpuServingEngine:
                 cspec = NamedSharding(
                     self.mesh, paged_cache_spec(self.mesh.axis_names)
                 )
-                cache_k = put_global(cache_k, cspec)
-                cache_v = put_global(cache_v, cspec)
+                if isinstance(cache_k, dict):
+                    # the same (..., tp) spec fits both leaves: data ends in
+                    # the fused Kh*D axis, scales in Kh — both shard on tp
+                    place = lambda cache: jax.tree.map(
+                        lambda a: put_global(a, cspec), cache
+                    )
+                    cache_k, cache_v = place(cache_k), place(cache_v)
+                else:
+                    cache_k = put_global(cache_k, cspec)
+                    cache_v = put_global(cache_v, cspec)
             else:
                 spec = kv_cache_spec(self.mesh.axis_names)
                 if isinstance(cache_k, dict):

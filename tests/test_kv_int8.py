@@ -178,10 +178,6 @@ def test_engine_int8_kv_first_token_matches_bf16(run_async):
 def test_engine_rejects_unsupported_kv_quantize_combos():
     from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
 
-    with pytest.raises(ValueError, match="kv-layout=dense"):
-        TpuServingEngine(
-            ServingConfig(model="tiny", kv_layout="paged", kv_quantize="int8")
-        )
     with pytest.raises(ValueError, match="kv_quantize"):
         TpuServingEngine(ServingConfig(model="tiny", kv_quantize="fp8"))
     with pytest.raises(ValueError, match="dense_kernel=xla"):
@@ -191,6 +187,86 @@ def test_engine_rejects_unsupported_kv_quantize_combos():
                 dense_kernel="pallas-interpret",
             )
         )
+    with pytest.raises(ValueError, match="paged_kernel=xla"):
+        TpuServingEngine(
+            ServingConfig(
+                model="tiny", max_seq_len=128, kv_layout="paged",
+                kv_quantize="int8", paged_kernel="pallas-interpret",
+            )
+        )
+
+
+def test_paged_write_gather_roundtrip_int8():
+    """Rows written through the int8 pool come back (gather + dequantise)
+    within one quantisation step of the originals."""
+    from langstream_tpu.models.paged import (
+        PagedLayout,
+        gather_kv,
+        init_paged_kv_cache_int8,
+        write_rows,
+    )
+
+    mc = LlamaConfig.tiny(max_seq_len=64)
+    layout = PagedLayout.for_model(64, 4, block_size=16)
+    pool_k, _ = init_paged_kv_cache_int8(mc, layout)
+    L, B, T = mc.layers, 2, 20
+    KhD = mc.kv_heads * mc.head_dim
+    rows = jax.random.normal(jax.random.PRNGKey(3), (L, B, T, KhD), jnp.float32)
+    tables = jnp.asarray(
+        [[1, 2, 0, 0], [3, 4, 0, 0]], dtype=jnp.int32
+    )
+    valid = jnp.ones((B, T), bool)
+    pool_k = write_rows(pool_k, rows, tables, jnp.zeros((B,), jnp.int32), valid)
+    got = gather_kv(pool_k, tables, 2)  # dict: (L,B,32,KhD)/(L,B,32,Kh)
+    back = dequantize_rows(
+        {
+            "q": got["q"].reshape(L, B, 32, mc.kv_heads, mc.head_dim),
+            "s": got["s"],
+        },
+        jnp.float32,
+    ).reshape(L, B, 32, KhD)
+    step = np.asarray(got["s"])[..., :, None].repeat(mc.head_dim, -1).reshape(
+        L, B, 32, KhD
+    )
+    diff = np.abs(np.asarray(back[:, :, :T]) - np.asarray(rows))
+    assert np.all(diff <= step[:, :, :T] * 0.51)
+
+
+def test_engine_serves_paged_int8_with_schedulers(run_async):
+    """The full paged posture on the int8 pool: prefix cache + speculative
+    decoding + chunked prefill all read/write through the quantised pool,
+    and speculation keeps its bit-identical-to-greedy invariant within the
+    quantised engine."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        base = dict(
+            model="tiny", slots=4, max_seq_len=128, decode_chunk=4,
+            kv_layout="paged", kv_block_size=16, kv_quantize="int8",
+            prefix_cache=True, prefill_chunk=16,
+        )
+        plain = TpuServingEngine.get_or_create(ServingConfig(**base))
+        prompt = "a shared preamble for the paged int8 cache. " * 3
+        r1 = await plain.generate(prompt + "one", {"max-tokens": 8, "temperature": 0})
+        r2 = await plain.generate(prompt + "two", {"max-tokens": 8, "temperature": 0})
+        assert r1["tokens"] and r2["tokens"]
+        stats = plain.stats()
+        assert stats["kv"]["layout"] == "paged"
+        await plain.close()
+
+        spec = TpuServingEngine.get_or_create(
+            ServingConfig(**base, speculative_drafts=3)
+        )
+        r3 = await spec.generate(prompt + "one", {"max-tokens": 8, "temperature": 0})
+        # the bf16 bit-identical-to-greedy invariant is per-forward on an
+        # int8 pool: commit-boundary rounding differs between the verify
+        # and fixed-chunk engines, so only the FIRST token (sampled from
+        # the unquantised prefill) is structurally equal across engines
+        assert r3["tokens"][0] == r1["tokens"][0]
+        assert len(r3["tokens"]) == len(r1["tokens"])
+        await spec.close()
+
+    run_async(main())
 
 
 def test_sharded_int8_kv_decode_matches_single_device(run_async):
