@@ -72,23 +72,52 @@ def _render_chat_prompt(messages: list[dict[str, str]]) -> str:
 
 class _StreamAdapter:
     """Bridges engine on_token callbacks to the agents' chunk consumers,
-    detokenising incrementally (only complete UTF-8 prefixes are emitted)."""
+    detokenising incrementally (only complete UTF-8 prefixes are emitted).
+    Stop sequences are excluded from the stream: text that could still
+    grow into a stop match is held back, and a match truncates the stream
+    at its start (mirroring the engine's final-text truncation)."""
 
-    def __init__(self, tokenizer, consumer: StreamingChunksConsumer):
+    def __init__(self, tokenizer, consumer: StreamingChunksConsumer,
+                 stop: list[str] | None = None):
+        from langstream_tpu.serving.engine import _normalize_stop
+
         self.tokenizer = tokenizer
         self.consumer = consumer
+        self.stop = _normalize_stop(stop)
         self.ids: list[int] = []
         self.emitted = ""
         self.index = 0
+        self.closed = False
+
+    def _stop_holdback(self, text: str) -> int:
+        """Chars at the end of ``text`` that are a prefix of some stop
+        string — unsafe to emit until the match resolves either way."""
+        hold = 0
+        for s in self.stop:
+            for k in range(min(len(s) - 1, len(text)), 0, -1):
+                if s.startswith(text[-k:]):
+                    hold = max(hold, k)
+                    break
+        return hold
 
     async def on_token(self, token: int, logprob: float, last: bool) -> None:
+        if self.closed:
+            return
         self.ids.append(token)
         text = self.tokenizer.decode(self.ids)
         # hold back a trailing replacement char (partial multi-byte sequence)
         safe = text[:-1] if text.endswith("�") and not last else text
+        if self.stop:
+            hits = [i for i in (safe.find(s) for s in self.stop) if i >= 0]
+            if hits:
+                safe = safe[: min(hits)]
+                last = True
+            elif not last:
+                safe = safe[: len(safe) - self._stop_holdback(safe)]
         delta = safe[len(self.emitted):]
         if delta or last:
             self.emitted = safe
+            self.closed = last
             result = self.consumer(Chunk(delta, self.index, last=last))
             if hasattr(result, "__await__"):
                 await result
@@ -106,7 +135,9 @@ class TpuCompletionsService(CompletionsService):
         consumer: StreamingChunksConsumer | None,
     ) -> CompletionResult:
         adapter = (
-            _StreamAdapter(self.engine.tokenizer, consumer)
+            _StreamAdapter(
+                self.engine.tokenizer, consumer, stop=options.get("stop")
+            )
             if consumer is not None
             else None
         )

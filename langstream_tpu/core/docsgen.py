@@ -27,12 +27,17 @@ CONFIG_DOCS: dict[str, dict[str, str]] = {
         "stream-response-completion-field": "field for streamed chunk text",
         "min-chunks-per-message": "chunk batching: 1, then N, then 2N tokens…",
         "max-tokens / temperature / top-k / top-p": "sampling controls",
+        "stop": "stop sequences: generation halts when any appears; the "
+                "match is excluded from text and stream",
+        "presence-penalty / frequency-penalty": "OpenAI-style penalties "
+                "over output tokens (in-jit, counts ride the decode chunk)",
     },
     "ai-text-completions": {
         "model": "model name",
         "prompt": "list of template strings joined into the prompt",
         "completion-field": "destination field",
         "logprobs / logprobs-field / tokens-field": "per-token outputs (FLARE)",
+        "stop": "stop sequences (as in ai-chat-completions)",
     },
     "compute-ai-embeddings": {
         "model": "encoder model (minilm-l6, tiny-encoder)",

@@ -502,6 +502,11 @@ def llama_decode_chunk(
                                 # traffic (decode is cache-read bound)
     ffn=None,                   # (h (B,H), lp, valid=None) -> (B,H);
                                 # default dense SwiGLU
+    sample_extras=None,         # (presences, frequencies, counts0 (B, V)):
+                                # penalty sampling — counts ride the step
+                                # carry (each sampled token updates them);
+                                # sample_fn is then called (logits, key,
+                                # counts). None = plain (logits, key).
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps with a two-segment KV layout.
 
@@ -532,9 +537,15 @@ def llama_decode_chunk(
     cache_mask = (jnp.arange(S)[None, :] < base_lengths[:, None])  # (B, S) static per chunk
     kbuf0 = jnp.zeros((c.layers, B, num_steps, c.kv_heads, c.head_dim), c.dtype)
     vbuf0 = jnp.zeros_like(kbuf0)
+    pen = sample_extras is not None
+    counts0 = sample_extras[2] if pen else None
 
     def step(carry, step_idx):
-        tokens, kbuf, vbuf, key = carry
+        if pen:
+            tokens, kbuf, vbuf, key, counts = carry
+        else:
+            tokens, kbuf, vbuf, key = carry
+            counts = None
         key, sub = jax.random.split(key)
         x = embedding_take(params["embed"], tokens)  # (B, H)
         positions = base_lengths + step_idx * adv
@@ -580,13 +591,25 @@ def llama_decode_chunk(
         )
         x = _rms_norm(x, params["final_norm"], c.norm_eps)
         logits = (x @ _w(params["lm_head"])).astype(jnp.float32)
-        nxt, lp = sample_fn(logits, sub)
+        if pen:
+            nxt, lp = sample_fn(logits, sub, counts)
+        else:
+            nxt, lp = sample_fn(logits, sub)
         nxt = jnp.where(active, nxt, tokens)
+        if pen:
+            counts = counts.at[jnp.arange(B), nxt].add(adv)
+            return (nxt, kbuf, vbuf, key, counts), (nxt, lp)
         return (nxt, kbuf, vbuf, key), (nxt, lp)
 
-    (final_tokens, kbuf, vbuf, _), (chunk_tokens, chunk_lps) = jax.lax.scan(
-        step, (tokens0, kbuf0, vbuf0, key), jnp.arange(num_steps)
+    carry0 = (
+        (tokens0, kbuf0, vbuf0, key, counts0)
+        if pen
+        else (tokens0, kbuf0, vbuf0, key)
     )
+    out_carry, (chunk_tokens, chunk_lps) = jax.lax.scan(
+        step, carry0, jnp.arange(num_steps)
+    )
+    final_tokens, kbuf, vbuf = out_carry[0], out_carry[1], out_carry[2]
 
     # commit: one write of the chunk buffer into the cache per slot. The
     # buffer stays bf16 through the scan (it is tiny and re-read every

@@ -483,6 +483,8 @@ def llama_decode_chunk_paged(
     mesh=None,                # Pallas kernel runs per-shard via shard_map
     ffn=None,                 # (h (B,H), lp, valid=None) -> (B,H);
                               # default dense SwiGLU
+    sample_extras=None,       # (presences, frequencies, counts0) — see
+                              # llama_decode_chunk
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps against the paged pool; same two-segment
     discipline as the dense ``llama_decode_chunk`` (pool read-only, new K/V
@@ -500,6 +502,8 @@ def llama_decode_chunk_paged(
     adv = active.astype(jnp.int32)
     kbuf0 = jnp.zeros((c.layers, B, num_steps, c.kv_heads, c.head_dim), c.dtype)
     vbuf0 = jnp.zeros_like(kbuf0)
+    pen = sample_extras is not None
+    counts0 = sample_extras[2] if pen else None
 
     def _kernel_partial(q, ck_l, cv_l, tables, lengths, kv_heads):
         return paged_attention_partial(
@@ -533,7 +537,11 @@ def llama_decode_chunk_paged(
         )
 
     def step(carry, step_idx):
-        tokens, kbuf, vbuf, key = carry
+        if pen:
+            tokens, kbuf, vbuf, key, counts = carry
+        else:
+            tokens, kbuf, vbuf, key = carry
+            counts = None
         key, sub = jax.random.split(key)
         x = embedding_take(params["embed"], tokens)
         positions = base_lengths + step_idx * adv
@@ -589,13 +597,25 @@ def llama_decode_chunk_paged(
         )
         x = _rms_norm(x, params["final_norm"], c.norm_eps)
         logits = (x @ _w(params["lm_head"])).astype(jnp.float32)
-        nxt, lp_ = sample_fn(logits, sub)
+        if pen:
+            nxt, lp_ = sample_fn(logits, sub, counts)
+        else:
+            nxt, lp_ = sample_fn(logits, sub)
         nxt = jnp.where(active, nxt, tokens)
+        if pen:
+            counts = counts.at[jnp.arange(B), nxt].add(adv)
+            return (nxt, kbuf, vbuf, key, counts), (nxt, lp_)
         return (nxt, kbuf, vbuf, key), (nxt, lp_)
 
-    (final_tokens, kbuf, vbuf, _), (chunk_tokens, chunk_lps) = jax.lax.scan(
-        step, (tokens0, kbuf0, vbuf0, key), jnp.arange(num_steps)
+    carry0 = (
+        (tokens0, kbuf0, vbuf0, key, counts0)
+        if pen
+        else (tokens0, kbuf0, vbuf0, key)
     )
+    out_carry, (chunk_tokens, chunk_lps) = jax.lax.scan(
+        step, carry0, jnp.arange(num_steps)
+    )
+    final_tokens, kbuf, vbuf = out_carry[0], out_carry[1], out_carry[2]
 
     L = c.layers
     valid = jnp.broadcast_to(active[:, None], (B, num_steps))
@@ -626,6 +646,7 @@ def llama_decode_chunk_dense_pallas(
     kernel: str = "pallas",
     block_size: int = 128,
     ffn=None,                 # pluggable FFN sub-block (MoE family hook)
+    sample_extras=None,       # (presences, frequencies, counts0)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Dense-cache decode through the PAGED Pallas read kernel.
 
@@ -653,7 +674,7 @@ def llama_decode_chunk_dense_pallas(
     out = llama_decode_chunk_paged(
         c, params, tokens0, base_lengths, active, pool_k, pool_v, tables,
         sample_fn, key, num_steps, num_read_blocks=num_read_blocks,
-        kernel=kernel, ffn=ffn,
+        kernel=kernel, ffn=ffn, sample_extras=sample_extras,
     )
     chunk_t, chunk_lp, final_t, final_l, pk, pv = out
     return (

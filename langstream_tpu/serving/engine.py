@@ -302,6 +302,8 @@ class _Request:
     top_p: float
     on_token: Callable[[int, float, bool], Awaitable[None] | None] | None
     future: asyncio.Future
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     generated: list[int] = dataclasses.field(default_factory=list)
     logprobs: list[float] = dataclasses.field(default_factory=list)
     loop: asyncio.AbstractEventLoop | None = None
@@ -312,6 +314,21 @@ class _Request:
     # verify step would be O(context) on the event-loop thread)
     bigram_index: dict = dataclasses.field(default_factory=dict)
     bigram_covered: int = 0
+    # stop sequences (reference: ChatCompletionsConfig.stop): generation
+    # halts when any string appears in the decoded output; the final text
+    # is truncated at the match (the match itself excluded, OpenAI-style)
+    stop: list = dataclasses.field(default_factory=list)
+    stop_matched: bool = False
+
+
+def _normalize_stop(value) -> list[str]:
+    """One normalization for every stop-sequence consumer (engine + stream
+    adapter): a string becomes a singleton list, falsy entries drop."""
+    if not value:
+        return []
+    if isinstance(value, str):
+        value = [value]
+    return [s for s in value if s]
 
 
 def _pow2(n: int) -> int:
@@ -416,6 +433,8 @@ class TpuServingEngine:
         self._temps = np.zeros(config.slots, dtype=np.float32)
         self._topks = np.zeros(config.slots, dtype=np.int32)
         self._topps = np.ones(config.slots, dtype=np.float32)
+        self._pres = np.zeros(config.slots, dtype=np.float32)
+        self._freq = np.zeros(config.slots, dtype=np.float32)
         self._pending_emits: list = []
         self._finished_requests: list = []
         self.total_generated = 0
@@ -732,41 +751,58 @@ class TpuServingEngine:
         mesh_static = self.mesh
 
         def _make_decode(sampler_mode: tuple, window: int | None,
-                         k_steps: int = 0):
+                         k_steps: int = 0, use_pen: bool = False):
             """``window``: dense → cache-row bucket (None = full cache);
             paged → number of block-table columns to sweep. ``k_steps``:
             fused steps per dispatch (0 → config.decode_chunk); light-load
-            bursts compile a short variant."""
+            bursts compile a short variant. ``use_pen``: the variant takes
+            (presences, frequencies, counts) after topps and samples with
+            presence/frequency penalties."""
             use_top_p, use_top_k, all_greedy = sampler_mode
             K = k_steps or self.config.decode_chunk
 
-            def _sample_fn_for(temps, topks, topps):
+            def _sample_fn_for(temps, topks, topps, pres=None, freq=None):
                 # ONE definition for all three decode variants (paged,
                 # dense-pallas, dense-xla) — they must sample identically
-                def sample_fn(logits, sub):
-                    return sample_tokens(
-                        logits, sub, temps, topks,
-                        use_top_p=use_top_p, top_ps=topps,
-                        use_top_k=use_top_k, all_greedy=all_greedy,
-                    )
+                if use_pen:
+                    def sample_fn(logits, sub, counts):
+                        return sample_tokens(
+                            logits, sub, temps, topks,
+                            use_top_p=use_top_p, top_ps=topps,
+                            use_top_k=use_top_k, all_greedy=all_greedy,
+                            use_penalties=True, presences=pres,
+                            frequencies=freq, counts=counts,
+                        )
+                else:
+                    def sample_fn(logits, sub):
+                        return sample_tokens(
+                            logits, sub, temps, topks,
+                            use_top_p=use_top_p, top_ps=topps,
+                            use_top_k=use_top_k, all_greedy=all_greedy,
+                        )
 
                 return sample_fn
+
+            def _extras(pres, freq, counts):
+                return (pres, freq, counts) if use_pen else None
 
             if paged:
                 @partial(jax.jit, donate_argnums=(1, 2))
                 def _decode_chunk(params, cache_k, cache_v, tokens, lengths,
-                                  active, tables, key, temps, topks, topps):
+                                  active, tables, key, temps, topks, topps,
+                                  pres=None, freq=None, counts=None):
                     from langstream_tpu.models.llama_paged import (
                         llama_decode_chunk_paged,
                     )
 
-                    sample_fn = _sample_fn_for(temps, topks, topps)
+                    sample_fn = _sample_fn_for(temps, topks, topps, pres, freq)
                     out = llama_decode_chunk_paged(
                         mc_static, params, tokens, lengths, active,
                         cache_k, cache_v, tables, sample_fn, key, K,
                         num_read_blocks=window,
                         kernel=self.paged_read_kernel,
                         mesh=mesh_static, ffn=ffn_static,
+                        sample_extras=_extras(pres, freq, counts),
                     )
                     return _fetchable(out[0], out[1]) + out[2:]
 
@@ -774,7 +810,8 @@ class TpuServingEngine:
 
             @partial(jax.jit, donate_argnums=(1, 2))
             def _decode_chunk(params, cache_k, cache_v, tokens, lengths, active,
-                              key, temps, topks, topps):
+                              key, temps, topks, topps,
+                              pres=None, freq=None, counts=None):
                 """K fused decode steps; one host round-trip per chunk. The
                 big cache is read-only inside the chunk (llama_decode_chunk)
                 — per-step HBM traffic is params+cache *read* only, and the
@@ -782,6 +819,7 @@ class TpuServingEngine:
                 covering the longest active sequence."""
                 from langstream_tpu.models.llama import llama_decode_chunk
 
+                sample_fn = _sample_fn_for(temps, topks, topps, pres, freq)
                 if self.dense_read_kernel != "xla":
                     from langstream_tpu.models.llama_paged import (
                         llama_decode_chunk_dense_pallas,
@@ -789,17 +827,19 @@ class TpuServingEngine:
 
                     out = llama_decode_chunk_dense_pallas(
                         mc_static, params, tokens, lengths, active,
-                        cache_k, cache_v, _sample_fn_for(temps, topks, topps),
+                        cache_k, cache_v, sample_fn,
                         key, K,
                         window=window, kernel=self.dense_read_kernel,
                         ffn=ffn_static,
+                        sample_extras=_extras(pres, freq, counts),
                     )
                     return _fetchable(out[0], out[1]) + out[2:]
 
                 out = llama_decode_chunk(
                     mc_static, params, tokens, lengths, active,
-                    cache_k, cache_v, _sample_fn_for(temps, topks, topps),
+                    cache_k, cache_v, sample_fn,
                     key, K, window=window, ffn=ffn_static,
+                    sample_extras=_extras(pres, freq, counts),
                 )
                 return _fetchable(out[0], out[1]) + out[2:]
 
@@ -919,12 +959,12 @@ class TpuServingEngine:
         self._verify_fns: dict[int, Any] = {}
 
     def _decode_fn(self, sampler_mode: tuple, window: int | None,
-                   k_steps: int = 0):
+                   k_steps: int = 0, use_pen: bool = False):
         k_steps = k_steps or self.config.decode_chunk
-        key = (sampler_mode, window, k_steps)
+        key = (sampler_mode, window, k_steps, use_pen)
         if key not in self._decode_chunk_fns:
             self._decode_chunk_fns[key] = self._make_decode(
-                sampler_mode, window, k_steps
+                sampler_mode, window, k_steps, use_pen
             )
         return self._decode_chunk_fns[key]
 
@@ -1055,6 +1095,7 @@ class TpuServingEngine:
                 f"{self.paged_layout.block_size}); lower max-tokens or grow "
                 f"kv-pool-blocks/kv-pool-fraction"
             )
+        stop = _normalize_stop(options.get("stop"))
         request = _Request(
             prompt_tokens=tokens,
             max_tokens=max_tokens,
@@ -1065,6 +1106,9 @@ class TpuServingEngine:
             future=asyncio.get_running_loop().create_future(),
             loop=asyncio.get_running_loop(),
             enqueue_time=time.monotonic(),
+            stop=stop,
+            presence_penalty=float(options.get("presence-penalty", 0.0)),
+            frequency_penalty=float(options.get("frequency-penalty", 0.0)),
         )
         await self._queue.put(request)
         self._ensure_loop()
@@ -1212,6 +1256,12 @@ class TpuServingEngine:
                         self._topps[active],
                     )
                     == (False, False, True)  # greedy acceptance only
+                    # penalties change the argmax per emitted token — the
+                    # verify step has no counts, so route to plain decode
+                    and not (
+                        (self._pres[active] != 0).any()
+                        or (self._freq[active] != 0).any()
+                    )
                 ):
                     await self._speculative_burst(loop, active)
                 else:
@@ -1401,10 +1451,31 @@ class TpuServingEngine:
             self.config.decode_chunk_light if light
             else self.config.decode_chunk
         )
+        # presence/frequency penalties: the in-chunk token counts evolve in
+        # the scan carry but are NOT returned (the host rebuilds them from
+        # request.generated before each dispatch) — so penalty bursts run
+        # the SEQUENTIAL path: a pipelined speculative chunk would need the
+        # previous chunk's final counts before the host has its tokens
+        pen = bool(
+            (self._pres[active_mask] != 0).any()
+            or (self._freq[active_mask] != 0).any()
+        )
         # host-tracked longest active sequence: each dispatched chunk grows
         # it by K; the attention window bucket follows
         base_max = int(self._lengths[active].max())
         paged = self.block_mgr is not None
+
+        def _build_counts() -> np.ndarray:
+            counts = np.zeros(
+                (self.config.slots, self.model_config.vocab_size),
+                dtype=np.int32,
+            )
+            for slot_id in active:
+                request = self.slots[slot_id].request
+                if request is not None:
+                    for t in request.generated:
+                        counts[slot_id, t] += 1
+            return counts
 
         def _grow_blocks(chunk_index: int) -> np.ndarray | None:
             """Paged: allocate blocks covering every active slot through the
@@ -1423,7 +1494,8 @@ class TpuServingEngine:
 
         def _dispatch(tokens, lengths, key, window, tables, first=False):
             # async JAX dispatch: returns device arrays without blocking
-            decode_fn = self._decode_fn(sampler_mode, window, K)
+            decode_fn = self._decode_fn(sampler_mode, window, K, pen)
+            counts_np = _build_counts() if pen else None
             if self._lockstep is not None:
                 # runs on the single dispatch thread → broadcast order is
                 # dispatch order. Speculative chunks ("decode_cont") carry
@@ -1438,6 +1510,16 @@ class TpuServingEngine:
                 }
                 if tables is not None:
                     desc["tables"] = tables  # host snapshot from _grow_blocks
+                if pen:
+                    # penalty bursts are sequential, so every chunk ships
+                    # fresh host state (counts are (slots, vocab) — heavy,
+                    # but penalties are a per-request opt-in)
+                    desc.update(
+                        pen=True,
+                        pres=np.asarray(self._pres),
+                        freq=np.asarray(self._freq),
+                        counts=counts_np,
+                    )
                 if first:
                     desc.update(
                         tokens=np.asarray(self._current),
@@ -1461,6 +1543,11 @@ class TpuServingEngine:
                 else (self.params, self.cache_k, self.cache_v,
                       tokens, lengths, amask, key, temps, topks, topps)
             )
+            if pen:
+                args = args + (
+                    jnp.asarray(self._pres), jnp.asarray(self._freq),
+                    jnp.asarray(counts_np),
+                )
             self.profiler.dump_hlo(
                 f"decode_chunk_w{window}_s{sampler_mode}", decode_fn, *args
             )
@@ -1482,7 +1569,7 @@ class TpuServingEngine:
             ),
         )
         chunk_index = 0
-        if light:
+        if light or pen:
             while True:
                 chunk_t, chunk_lp = await loop.run_in_executor(
                     self._executor,
@@ -1623,6 +1710,8 @@ class TpuServingEngine:
                 self._temps[slot_id] = request.temperature
                 self._topks[slot_id] = request.top_k
                 self._topps[slot_id] = request.top_p
+                self._pres[slot_id] = request.presence_penalty
+                self._freq[slot_id] = request.frequency_penalty
                 request.first_token_time = now
                 slot.prefilling = False
                 # register BEFORE emitting: a max-tokens=1 / instant-EOS
@@ -1838,6 +1927,8 @@ class TpuServingEngine:
                 self._temps[slot_id] = request.temperature
                 self._topks[slot_id] = request.top_k
                 self._topps[slot_id] = request.top_p
+                self._pres[slot_id] = request.presence_penalty
+                self._freq[slot_id] = request.frequency_penalty
                 request.first_token_time = now
                 self._emit_token(slot_id, int(next_np[i]), float(logprob_np[i]))
                 admitted_slots.append(slot_id)
@@ -1877,9 +1968,23 @@ class TpuServingEngine:
         if not is_eos:
             request.generated.append(token)
             request.logprobs.append(logprob)
+        stop_matched = False
+        if request.stop and not is_eos:
+            # decode only a tail WINDOW per token — a full re-decode would
+            # be O(n^2) per request on the single-threaded emit hot path.
+            # Any new match must involve the newest token, so a window of
+            # max-stop-chars worth of tokens (plus margin for tokenizer
+            # boundary effects) always covers it; the authoritative
+            # truncation re-finds on the full final decode in _flush_emits.
+            window = max(len(s) for s in request.stop) + 8
+            tail = self.tokenizer.decode(request.generated[-window:])
+            if any(s in tail for s in request.stop):
+                request.stop_matched = True
+                stop_matched = True
         self.total_generated += 1
         done = bool(
             is_eos
+            or stop_matched
             or len(request.generated) >= request.max_tokens
             or self._lengths[slot_id] + 1 >= self.model_config.max_seq_len
             # caller gave up (client disconnect / task cancel): stop
@@ -1920,6 +2025,17 @@ class TpuServingEngine:
             if request.first_token_time is not None:
                 self._m_ttft(request.first_token_time - request.enqueue_time)
             text = self.tokenizer.decode(request.generated)
+            if request.stop_matched:
+                # OpenAI semantics: the stop match itself is excluded. The
+                # token list keeps every generated token (they are in the
+                # KV cache and were streamed); only the text truncates.
+                # The find runs on the FINAL decode — the detection window
+                # can render boundary chars differently.
+                hits = [
+                    i for i in (text.find(s) for s in request.stop) if i >= 0
+                ]
+                if hits:
+                    text = text[: min(hits)]
             if not request.future.done():
                 request.future.set_result(
                     {
@@ -1930,7 +2046,11 @@ class TpuServingEngine:
                         "num_completion_tokens": len(request.generated),
                         "ttft": (request.first_token_time or time.monotonic())
                         - request.enqueue_time,
-                        "finish_reason": "stop" if is_eos else "length",
+                        "finish_reason": (
+                            "stop"
+                            if is_eos or request.stop_matched
+                            else "length"
+                        ),
                     }
                 )
 

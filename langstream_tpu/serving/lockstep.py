@@ -349,8 +349,9 @@ class LockstepFollower:
                 else:
                     tokens, lengths = carry_tokens, carry_lengths
                 window = desc.get("window")
+                pen = bool(desc.get("pen"))
                 fn = engine._decode_fn(
-                    burst["sampler_mode"], window, int(desc.get("k", 0))
+                    burst["sampler_mode"], window, int(desc.get("k", 0)), pen
                 )
                 args = [
                     engine.params, engine.cache_k, engine.cache_v,
@@ -362,6 +363,13 @@ class LockstepFollower:
                     jnp.asarray(desc["key"]), burst["temps"],
                     burst["topks"], burst["topps"],
                 ]
+                if pen:
+                    # penalty bursts are sequential on the leader, so every
+                    # frame carries fresh pres/freq/counts host state
+                    args += [
+                        jnp.asarray(desc["pres"]), jnp.asarray(desc["freq"]),
+                        jnp.asarray(desc["counts"]),
+                    ]
                 out = fn(*args)
                 carry_tokens, carry_lengths = out[2], out[3]
                 engine.cache_k, engine.cache_v = out[4], out[5]

@@ -31,9 +31,24 @@ def sample_tokens(
     top_ps: jax.Array | None = None,  # (B,) 1.0 = off
     use_top_k: bool = True,
     all_greedy: bool = False,
+    use_penalties: bool = False,
+    presences: jax.Array | None = None,     # (B,) presence penalty
+    frequencies: jax.Array | None = None,   # (B,) frequency penalty
+    counts: jax.Array | None = None,        # (B, V) output-token counts
 ) -> tuple[jax.Array, jax.Array]:
     """→ (tokens (B,) int32, logprobs (B,) float32 of the sampled token)."""
     B, V = logits.shape
+    if use_penalties:
+        # OpenAI-style presence/frequency penalties over OUTPUT tokens
+        # (reference: ChatCompletionsConfig presence-penalty /
+        # frequency-penalty, forwarded to the provider — here the engine IS
+        # the provider). Applied before everything: greedy argmax and
+        # logprobs see the penalised distribution.
+        cf = counts.astype(logits.dtype)
+        logits = logits - (
+            presences[:, None] * (cf > 0).astype(logits.dtype)
+            + frequencies[:, None] * cf
+        )
     greedy_tokens = jnp.argmax(logits, axis=-1)
 
     def token_logprob(tokens: jax.Array) -> jax.Array:

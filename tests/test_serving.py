@@ -649,6 +649,131 @@ def test_warmup_on_start_compiles_both_regimes(run_async):
     run_async(main())
 
 
+def test_stop_sequences_truncate_and_free_slot(run_async):
+    """Reference parity (`ChatCompletionsConfig.stop`): generation halts
+    when a stop string appears; the final text excludes the match."""
+
+    async def main():
+        engine = _engine()
+        base = await engine.generate("abc", {"max-tokens": 10, "temperature": 0})
+        full = base["text"]
+        assert len(full) >= 3
+        stop = full[1:3]
+        r = await engine.generate(
+            "abc", {"max-tokens": 10, "temperature": 0, "stop": [stop]}
+        )
+        assert r["finish_reason"] == "stop"
+        assert stop not in r["text"]
+        assert r["text"] == full[: full.find(stop)]
+        assert r["num_completion_tokens"] <= base["num_completion_tokens"]
+        # a string form and a non-matching stop behave sanely
+        r2 = await engine.generate(
+            "abc", {"max-tokens": 10, "temperature": 0, "stop": stop}
+        )
+        assert r2["text"] == r["text"]
+        r3 = await engine.generate(
+            "abc",
+            {"max-tokens": 10, "temperature": 0, "stop": [" unlikely"]},
+        )
+        assert r3["text"] == full
+        await engine.close()
+
+    run_async(main())
+
+
+def test_presence_frequency_penalties():
+    """Sampler-level: penalties shift the (greedy) distribution away from
+    already-emitted tokens (reference: ChatCompletionsConfig penalties)."""
+    from langstream_tpu.serving.sampler import sample_tokens
+
+    V = 32
+    logits = np.zeros((1, V), np.float32)
+    logits[0, 5] = 10.0
+    logits[0, 7] = 8.0
+    counts = np.zeros((1, V), np.int32)
+    counts[0, 5] = 3
+    tokens, _ = sample_tokens(
+        jnp.asarray(logits), jax.random.PRNGKey(0),
+        jnp.zeros(1), jnp.zeros(1, jnp.int32), all_greedy=True,
+        use_penalties=True,
+        presences=jnp.asarray([1.0]), frequencies=jnp.asarray([5.0]),
+        counts=jnp.asarray(counts),
+    )
+    # token 5: 10 - 1 - 5*3 = -6 < token 7's 8 -> argmax moves
+    assert int(tokens[0]) == 7
+    # zero penalties leave the argmax alone even with counts present
+    tokens, _ = sample_tokens(
+        jnp.asarray(logits), jax.random.PRNGKey(0),
+        jnp.zeros(1), jnp.zeros(1, jnp.int32), all_greedy=True,
+        use_penalties=True,
+        presences=jnp.asarray([0.0]), frequencies=jnp.asarray([0.0]),
+        counts=jnp.asarray(counts),
+    )
+    assert int(tokens[0]) == 5
+
+
+@pytest.mark.parametrize("kv_layout", ["dense", "paged"])
+def test_engine_frequency_penalty_prevents_repeats(run_async, kv_layout):
+    """A strong frequency penalty makes every generated token distinct —
+    each emission forbids that token for the rest of the stream (counts
+    ride the decode-chunk carry; penalty bursts run sequentially)."""
+
+    async def main():
+        from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=4, max_seq_len=64, decode_chunk=4,
+                kv_layout=kv_layout,
+                kv_block_size=16 if kv_layout == "paged" else 64,
+            )
+        )
+        r = await engine.generate(
+            "abc",
+            {"max-tokens": 12, "temperature": 0, "frequency-penalty": 100.0},
+        )
+        assert len(r["tokens"]) >= 8
+        assert len(set(r["tokens"])) == len(r["tokens"]), r["tokens"]
+        # an unpenalised engine run still works afterwards (variant cache
+        # keys penalties separately)
+        r2 = await engine.generate("abc", {"max-tokens": 6, "temperature": 0})
+        assert r2["tokens"]
+        await engine.close()
+
+    run_async(main())
+
+
+def test_stop_sequences_held_back_from_stream(run_async):
+    """Streamed chunks never contain the stop text (hold-back + truncation
+    in the provider's stream adapter)."""
+    from langstream_tpu.agents.tpu_provider import _StreamAdapter
+    from langstream_tpu.models.tokenizer import ByteTokenizer
+
+    async def main():
+        tok = ByteTokenizer()
+        chunks: list = []
+
+        def consumer(chunk):
+            chunks.append(chunk)
+
+        adapter = _StreamAdapter(tok, consumer, stop=["XY"])
+        ids = [ord(c) for c in "abXYcd"]
+        for i, t in enumerate(ids):
+            await adapter.on_token(t, 0.0, last=(i == len(ids) - 1))
+        text = "".join(c.text for c in chunks)
+        assert text == "ab"
+        assert chunks[-1].last
+        # partial prefix at end-of-stream resolves (no match -> emitted)
+        chunks2: list = []
+        adapter2 = _StreamAdapter(tok, lambda c: chunks2.append(c), stop=["XY"])
+        ids2 = [ord(c) for c in "abX"]
+        for i, t in enumerate(ids2):
+            await adapter2.on_token(t, 0.0, last=(i == len(ids2) - 1))
+        assert "".join(c.text for c in chunks2) == "abX"
+
+    run_async(main())
+
+
 def test_engine_top_p_and_stream_termination(run_async):
     async def main():
         engine = _engine()
