@@ -383,20 +383,31 @@ def _run_degraded_cpu_pass(budget_s: float) -> dict:
         BENCH_TOTAL_TIMEOUT_S=str(max(int(budget_s) - 30, 60)),
         BENCH_PHASE_TIMEOUT_S="180",
     )
+    def _last_record(stdout: str | bytes | None, fallback: dict) -> dict:
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", errors="replace")
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                return json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+        return fallback
+
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             env=env, capture_output=True, text=True, timeout=budget_s,
         )
-        for line in reversed(proc.stdout.strip().splitlines()):
-            try:
-                return json.loads(line)
-            except (json.JSONDecodeError, ValueError):
-                continue
-        return {"error": f"no record line (rc={proc.returncode})",
-                "stderr_tail": proc.stderr[-500:]}
-    except subprocess.TimeoutExpired:
-        return {"error": f"degraded pass exceeded {budget_s:.0f}s"}
+        return _last_record(
+            proc.stdout,
+            {"error": f"no record line (rc={proc.returncode})",
+             "stderr_tail": proc.stderr[-500:]},
+        )
+    except subprocess.TimeoutExpired as te:
+        # the child emits after every phase: salvage its last record line
+        rec = _last_record(te.stdout, {})
+        rec["error"] = f"degraded pass exceeded {budget_s:.0f}s (partial record)"
+        return rec
     except Exception as e:
         return {"error": f"{type(e).__name__}: {e}"}
 
@@ -426,7 +437,7 @@ async def run_bench() -> dict:
         "max_tokens": MAX_TOKENS,
         **({"degraded": "cpu"} if DEGRADED else {}),
     }
-    headline: dict = {"tok_s": 0.0, "pending": True}
+    headline: dict = {"tok_s": 0.0}
 
     probe = await asyncio.get_event_loop().run_in_executor(None, _probe_device)
     _finalize_model_choice(probe_ok=probe is None)

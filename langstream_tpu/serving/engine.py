@@ -323,12 +323,15 @@ class _Request:
 
 def _normalize_stop(value) -> list[str]:
     """One normalization for every stop-sequence consumer (engine + stream
-    adapter): a string becomes a singleton list, falsy entries drop."""
+    adapter): a string becomes a singleton list, falsy entries drop, and
+    non-string truthy entries (e.g. ``stop: [42]`` from YAML) are coerced —
+    they would otherwise raise TypeError mid-request on the per-token
+    ``s in tail`` hot path."""
     if not value:
         return []
     if isinstance(value, str):
         value = [value]
-    return [s for s in value if s]
+    return [s if isinstance(s, str) else str(s) for s in value if s]
 
 
 def _pow2(n: int) -> int:
@@ -552,6 +555,21 @@ class TpuServingEngine:
             raise ValueError(
                 "speculative-drafts requires kv-layout=paged (the verify "
                 "step commits through the paged continuation path)"
+            )
+        if (
+            self.config.speculative_drafts > 0
+            and self.config.kv_quantize == "int8"
+        ):
+            # speculation's "never changes content" guarantee is weaker
+            # here: verify quantizes KV at different commit boundaries than
+            # the non-speculative path, so greedy streams may diverge
+            # bit-for-bit from speculation-off runs (documented at the
+            # model level, llama_paged.py) — surface it where the config is
+            # chosen, once per engine
+            log.info(
+                "speculative-drafts with kv-quantize=int8: greedy streams "
+                "may diverge from non-speculative runs (int8 KV commit-"
+                "boundary quantization differs under the verify path)"
             )
         self.block_mgr = None
         if self.config.kv_layout == "paged":
@@ -1477,18 +1495,26 @@ class TpuServingEngine:
                         counts[slot_id, t] += 1
             return counts
 
-        def _grow_blocks(chunk_index: int) -> np.ndarray | None:
-            """Paged: allocate blocks covering every active slot through the
-            (chunk_index+1)-th speculative chunk; returns a host snapshot of
-            the block tables (the dispatch converts it device-side — keeping
-            it numpy here lets the lockstep broadcast ship it without a
-            device→host round-trip)."""
+        def _grow_blocks(pending_chunks: int) -> np.ndarray | None:
+            """Paged: allocate blocks covering this dispatch's chunk plus
+            the ``pending_chunks`` dispatched-but-unprocessed chunks whose
+            tokens host ``_lengths`` doesn't reflect yet (0 in the
+            sequential path — lengths are current at each re-dispatch; 1
+            for a pipelined speculative dispatch). Indexing by cumulative
+            chunk count instead would over-reserve by one chunk per
+            processed chunk and needlessly evict shared prefix-cache
+            blocks. Returns a host snapshot of the block tables (the
+            dispatch converts it device-side — keeping it numpy here lets
+            the lockstep broadcast ship it without a device→host
+            round-trip)."""
             if not paged:
                 return None
             S = self.model_config.max_seq_len
             for slot_id in active:
                 if self.slots[slot_id].request is not None:
-                    need = min(int(self._lengths[slot_id]) + (chunk_index + 1) * K, S)
+                    need = min(
+                        int(self._lengths[slot_id]) + (pending_chunks + 1) * K, S
+                    )
                     self.block_mgr.ensure_capacity(slot_id, need)
             return self.block_mgr.tables.copy()
 
@@ -1586,20 +1612,24 @@ class TpuServingEngine:
                     return
                 base_max += K
                 chunk_index += 1
+                # sequential: the chunk just processed is in _lengths, so
+                # blocks grow with a fixed one-chunk lookahead
                 out = await loop.run_in_executor(
                     self._executor,
                     partial(_dispatch, out[2], out[3], self._split_key(),
-                            _bucket_for(base_max), _grow_blocks(chunk_index)),
+                            _bucket_for(base_max), _grow_blocks(0)),
                 )
         while True:
             # speculate the next chunk from device state
             base_max += K
             chunk_index += 1
             key_next = self._split_key()
+            # pipelined: exactly one dispatched chunk is still unprocessed
+            # when the speculative chunk is dispatched
             next_out_task = loop.run_in_executor(
                 self._executor,
                 partial(_dispatch, out[2], out[3], key_next,
-                        _bucket_for(base_max), _grow_blocks(chunk_index)),
+                        _bucket_for(base_max), _grow_blocks(1)),
             )
             chunk_t, chunk_lp = await loop.run_in_executor(
                 self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))
@@ -1972,11 +2002,15 @@ class TpuServingEngine:
         if request.stop and not is_eos:
             # decode only a tail WINDOW per token — a full re-decode would
             # be O(n^2) per request on the single-threaded emit hot path.
-            # Any new match must involve the newest token, so a window of
-            # max-stop-chars worth of tokens (plus margin for tokenizer
-            # boundary effects) always covers it; the authoritative
-            # truncation re-finds on the full final decode in _flush_emits.
-            window = max(len(s) for s in request.stop) + 8
+            # Any new match must involve the newest token; every token
+            # decodes from at least one UTF-8 byte, so a window of
+            # max-stop-BYTES tokens (plus margin for tokenizer boundary
+            # effects) always covers it — char count would undersize the
+            # window for multi-byte stop strings under the byte-level
+            # tokenizer (1 token per byte) and silently miss the stop. The
+            # authoritative truncation re-finds on the full final decode in
+            # _flush_emits.
+            window = max(len(s.encode("utf-8")) for s in request.stop) + 8
             tail = self.tokenizer.decode(request.generated[-window:])
             if any(s in tail for s in request.stop):
                 request.stop_matched = True

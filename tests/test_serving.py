@@ -681,6 +681,46 @@ def test_stop_sequences_truncate_and_free_slot(run_async):
     run_async(main())
 
 
+def test_stop_window_covers_multibyte_stop_strings(run_async):
+    """Regression (r3 advisor, medium): the per-token stop-detection window
+    must be sized from the stop string's encoded BYTE length — under the
+    byte-level tokenizer (one token per UTF-8 byte) a char-sized window
+    missed any stop longer than a few multi-byte chars and generation ran
+    to max-tokens."""
+
+    async def main():
+        from langstream_tpu.serving.engine import _Request
+
+        engine = _engine()
+        stop = "日本語のテスト"  # 7 chars, 21 UTF-8 bytes
+        assert len(stop.encode("utf-8")) > len(stop) + 8  # would miss pre-fix
+        req = _Request(
+            prompt_tokens=[engine.tokenizer.bos_id], max_tokens=100,
+            temperature=0.0, top_k=0, top_p=1.0, on_token=None,
+            future=asyncio.get_event_loop().create_future(), stop=[stop],
+        )
+        engine.slots[0].request = req
+        done = False
+        for b in ("abc" + stop).encode("utf-8"):
+            done = engine._emit_token(0, int(b), 0.0)
+            if done:
+                break
+        assert done and req.stop_matched
+        await engine.close()
+
+    run_async(main())
+
+
+def test_normalize_stop_coerces_non_strings():
+    """YAML can hand over non-string stop entries (``stop: [42]``); they
+    must be coerced up front, not TypeError on the per-token hot path."""
+    from langstream_tpu.serving.engine import _normalize_stop
+
+    assert _normalize_stop([42, "x", None, ""]) == ["42", "x"]
+    assert _normalize_stop("abc") == ["abc"]
+    assert _normalize_stop(None) == []
+
+
 def test_presence_frequency_penalties():
     """Sampler-level: penalties shift the (greedy) distribution away from
     already-emitted tokens (reference: ChatCompletionsConfig penalties)."""
