@@ -346,22 +346,37 @@ def llama_verify_chunk_paged(
     ffn=None,
     kernel: str = "xla",  # history read (see llama_prefill_continue_paged)
     mesh=None,
+    key: jax.Array | None = None,
+    temps: jax.Array | None = None,
+    topks: jax.Array | None = None,
+    topps: jax.Array | None = None,
+    sampler_mode: tuple | None = None,  # (use_top_p, use_top_k, all_greedy)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Greedy speculative VERIFY step (prompt-lookup decoding).
+    """Speculative VERIFY step (prompt-lookup decoding).
 
     One forward over ``D1 = 1 + drafts`` positions per slot scores every
-    draft in parallel; in-jit greedy acceptance keeps the longest prefix of
-    drafts the model itself would have produced, plus the model's one bonus
-    token after it. Drafts cost nothing when wrong (acceptance only ever
-    emits model-argmax tokens, so on a bf16 pool output streams are
-    IDENTICAL to plain greedy decode — speculation changes latency, never
-    content). On an int8 pool the guarantee is per-forward, not
-    cross-engine: a position reads as fresh bf16 before commit and as
-    quantised int8 after, and verify commits at different boundaries than
-    the fixed decode chunk — near-tie argmaxes may differ (~1e-2 logit
-    scale) from a non-speculative engine's stream.
+    draft in parallel. Two acceptance modes, selected by the static
+    ``sampler_mode`` (None or ``all_greedy`` → greedy):
 
-    Returns (emitted (B, D1) — model argmax at every position,
+    - **Greedy** (the default): in-jit greedy acceptance keeps the longest
+      prefix of drafts the model itself would have produced, plus the
+      model's one bonus token after it. Drafts cost nothing when wrong
+      (acceptance only ever emits model-argmax tokens, so on a bf16 pool
+      output streams are IDENTICAL to plain greedy decode — speculation
+      changes latency, never content). On an int8 pool the guarantee is
+      per-forward, not cross-engine: a position reads as fresh bf16 before
+      commit and as quantised int8 after, and verify commits at different
+      boundaries than the fixed decode chunk — near-tie argmaxes may
+      differ (~1e-2 logit scale) from a non-speculative engine's stream.
+    - **Sampled** (``sampler_mode`` set and not all-greedy): rejection
+      sampling against the deterministic prompt-lookup drafter
+      (``sampler.speculative_accept``) — draft ``d_j`` survives with the
+      target's filtered probability ``p_j(d_j)``; the first rejection
+      emits a residual sample; full acceptance earns a bonus sample. The
+      emitted stream is distributed exactly as plain sampling. Greedy
+      rows inside a mixed batch degenerate to the greedy rule.
+
+    Returns (emitted (B, D1) — the token to emit at each position,
     emit_counts (B,) — how many leading emitted tokens are real (1..D1),
     next_tokens (B,), new_lengths (B,), pool_k, pool_v, logprobs (B, D1)).
 
@@ -391,25 +406,42 @@ def llama_verify_chunk_paged(
         num_read_blocks, ffn=ffn, return_all_logits=True, kernel=kernel,
         mesh=mesh,
     )  # logits (B, D1, V)
-    model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, D1)
-    logprobs = jnp.take_along_axis(
-        jax.nn.log_softmax(logits, axis=-1), model_next[..., None], axis=-1
-    ).squeeze(-1)
-    # draft j (= input position j+1) is accepted iff every earlier draft
-    # matched and the model's token at position j equals it
     drafts = tokens[:, 1:]                                   # (B, D1-1)
-    match = model_next[:, :-1] == drafts                     # (B, D1-1)
-    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    logits_f32 = logits.astype(jnp.float32)
+    if sampler_mode is None or sampler_mode[2]:  # all-greedy
+        model_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, D1)
+        # draft j (= input position j+1) is accepted iff every earlier
+        # draft matched and the model's token at position j equals it
+        match = model_next[:, :-1] == drafts                 # (B, D1-1)
+        accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        emitted = model_next
+    else:
+        from langstream_tpu.serving.sampler import speculative_accept
+
+        use_top_p, use_top_k, _ = sampler_mode
+        accepted, fallback = speculative_accept(
+            logits_f32, drafts, key, temps, topks, topps,
+            use_top_p=use_top_p, use_top_k=use_top_k,
+        )
+        # emit accepted drafts verbatim, then the residual/bonus sample at
+        # the stop position (the only fallback column the engine reads)
+        pos = jnp.arange(D1)[None, :]
+        drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+        emitted = jnp.where(pos < accepted[:, None], drafts_pad, fallback)
+        emitted = emitted.astype(jnp.int32)
+    logprobs = jnp.take_along_axis(
+        jax.nn.log_softmax(logits_f32, axis=-1), emitted[..., None], axis=-1
+    ).squeeze(-1)
     adv = jnp.where(active, accepted + 1, 0)                 # tokens emitted
     new_lengths = base_lengths + adv
     next_tokens = jnp.where(
         active,
         jnp.take_along_axis(
-            model_next, jnp.maximum(adv - 1, 0)[:, None], axis=1
+            emitted, jnp.maximum(adv - 1, 0)[:, None], axis=1
         ).squeeze(1),
         tokens[:, 0],
     )
-    return model_next, adv, next_tokens, new_lengths, pool_k, pool_v, logprobs
+    return emitted, adv, next_tokens, new_lengths, pool_k, pool_v, logprobs
 
 
 def _gather_layer_window(c, pool_l, block_tables, num_read_blocks):

@@ -943,13 +943,15 @@ class TpuServingEngine:
 
         self._make_prefill_continue = _make_prefill_continue
 
-        def _make_verify(nrb: int):
-            """Speculative greedy verify step (prompt-lookup decoding); the
-            draft count specializes via the tokens shape at trace time."""
+        def _make_verify(nrb: int, sampler_mode: tuple):
+            """Speculative verify step (prompt-lookup decoding); the draft
+            count specializes via the tokens shape at trace time, the
+            acceptance rule (greedy vs rejection-sampled) via
+            ``sampler_mode``."""
 
             @partial(jax.jit, donate_argnums=(1, 2))
             def _verify(params, cache_k, cache_v, tokens, lengths, active,
-                        tables):
+                        tables, key, temps, topks, topps):
                 from langstream_tpu.models.llama_paged import (
                     llama_verify_chunk_paged,
                 )
@@ -958,7 +960,8 @@ class TpuServingEngine:
                     mc_static, params, tokens, lengths, active,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
                     ffn=ffn_static, kernel=self._continuation_kernel(),
-                    mesh=mesh_static,
+                    mesh=mesh_static, key=key, temps=temps, topks=topks,
+                    topps=topps, sampler_mode=sampler_mode,
                 )
                 # the leader host reads everything but the pools each step
                 return _fetchable(*out[:4]) + out[4:6] + _fetchable(out[6])
@@ -974,7 +977,7 @@ class TpuServingEngine:
         self._decode_chunk_fns: dict[tuple[tuple, int | None, int], Any] = {}
         self._prefill_fns: dict[tuple, Any] = {}
         self._prefill_continue_fns: dict[tuple[tuple, int], Any] = {}
-        self._verify_fns: dict[int, Any] = {}
+        self._verify_fns: dict[tuple[int, tuple], Any] = {}
 
     def _decode_fn(self, sampler_mode: tuple, window: int | None,
                    k_steps: int = 0, use_pen: bool = False):
@@ -1019,10 +1022,11 @@ class TpuServingEngine:
         # paged_read_kernel is resolved away from "auto" at init
         return self.paged_read_kernel
 
-    def _verify_fn(self, nrb: int):
-        if nrb not in self._verify_fns:
-            self._verify_fns[nrb] = self._make_verify(nrb)
-        return self._verify_fns[nrb]
+    def _verify_fn(self, nrb: int, sampler_mode: tuple):
+        key = (nrb, sampler_mode)
+        if key not in self._verify_fns:
+            self._verify_fns[key] = self._make_verify(nrb, sampler_mode)
+        return self._verify_fns[key]
 
     @staticmethod
     def _sampler_mode(temps, topks, topps) -> tuple:
@@ -1076,6 +1080,14 @@ class TpuServingEngine:
 
         ``_warmup_probe`` is internal: warmup()'s own generate calls skip
         the warmup gate below (they ARE the warmup)."""
+        if self._stop:
+            # closed, or stopped after a broken lockstep group: enqueueing
+            # would hang forever (the restarted loop exits immediately and
+            # never resolves the future) — fail loudly instead so the pod
+            # restarts the slice
+            raise RuntimeError(
+                "serving engine is stopped (closed or lockstep group broken)"
+            )
         options = options or {}
         if self.config.warmup_on_start and not _warmup_probe:
             # one shared task (also credited to explicit warmup() calls):
@@ -1269,13 +1281,11 @@ class TpuServingEngine:
                 if (
                     self.config.speculative_drafts > 0
                     and self.block_mgr is not None
-                    and self._sampler_mode(
-                        self._temps[active], self._topks[active],
-                        self._topps[active],
-                    )
-                    == (False, False, True)  # greedy acceptance only
-                    # penalties change the argmax per emitted token — the
-                    # verify step has no counts, so route to plain decode
+                    # greedy bursts use argmax acceptance; sampled bursts
+                    # use rejection sampling against the filtered target
+                    # distribution (distribution-exact). Penalties alone
+                    # stay on plain decode: they change the distribution
+                    # per EMITTED token and the verify step has no counts.
                     and not (
                         (self._pres[active] != 0).any()
                         or (self._freq[active] != 0).any()
@@ -1287,9 +1297,19 @@ class TpuServingEngine:
             except Exception as e:  # device/runtime error: fail in-flight work,
                 # free the slots, keep serving (callers see the exception)
                 log.exception("serving engine step failed")
-                self._fail_inflight(e)
                 from langstream_tpu.serving.lockstep import LockstepBroken
 
+                if self._lockstep is not None and not isinstance(e, LockstepBroken):
+                    # leading a multi-host group: ANY step failure is
+                    # group-fatal — followers may have replayed collectives
+                    # this process aborted mid-step (e.g. the coordination
+                    # service poisoned a pending collective after a member
+                    # died), so surviving state is unknowable. Wrap so
+                    # callers see one loud type either way.
+                    e = LockstepBroken(
+                        f"multi-host step failed: {type(e).__name__}: {e}"
+                    )
+                self._fail_inflight(e)
                 if isinstance(e, LockstepBroken):
                     # a lost follower is unrecoverable for this process
                     # group — stop serving so the slice restarts as a unit
@@ -1368,8 +1388,13 @@ class TpuServingEngine:
             nrb = self._read_blocks_for(
                 max(int(self._lengths[live].max()) if live else 1, 1)
             )
-            fn = self._verify_fn(nrb)
+            sampler_mode = self._sampler_mode(
+                self._temps[active_mask], self._topks[active_mask],
+                self._topps[active_mask],
+            )
+            fn = self._verify_fn(nrb, sampler_mode)
             lengths_np = self._lengths.copy()
+            key = self._split_key()
 
             def _run():
                 if self._lockstep is not None:
@@ -1379,16 +1404,23 @@ class TpuServingEngine:
                         {
                             "op": "verify",
                             "nrb": nrb,
+                            "sampler_mode": list(sampler_mode),
                             "tokens": tokens,
                             "lengths": lengths_np,
                             "active": active_mask,
                             "tables": tables,
+                            "key": np.asarray(key),
+                            "temps": np.asarray(self._temps),
+                            "topks": np.asarray(self._topks),
+                            "topps": np.asarray(self._topps),
                         }
                     )
                 out = fn(
                     self.params, self.cache_k, self.cache_v,
                     jnp.asarray(tokens), jnp.asarray(lengths_np),
                     jnp.asarray(active_mask), jnp.asarray(tables),
+                    key, jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    jnp.asarray(self._topps),
                 )
                 self.cache_k, self.cache_v = out[4], out[5]
                 return (

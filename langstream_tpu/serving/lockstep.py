@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import socket
 import struct
 import threading
@@ -298,8 +299,14 @@ class LockstepFollower:
                     raise
                 time.sleep(0.5)
 
-    def run(self) -> int:
-        """Returns the number of descriptors replayed (for tests/logs)."""
+    def run(self, die_after_steps: int | None = None) -> int:
+        """Returns the number of descriptors replayed (for tests/logs).
+
+        ``die_after_steps`` is fault injection (the failure tests' analogue
+        of the reference's mock fail-on-content agents): after replaying N
+        descriptors the process dies via ``os._exit`` — no socket shutdown,
+        no goodbye — exactly what a follower pod being OOM-killed mid-burst
+        looks like to the leader."""
         import jax.numpy as jnp
 
         from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
@@ -387,12 +394,18 @@ class LockstepFollower:
                 engine.cache_k, engine.cache_v = out[2], out[3]
             elif op == "verify":
                 # speculative verify: drafts are host data the leader
-                # already broadcast — replay the same jit
-                fn = engine._verify_fn(int(desc["nrb"]))
+                # already broadcast — replay the same jit (same key, so
+                # sampled acceptance matches bit-for-bit)
+                fn = engine._verify_fn(
+                    int(desc["nrb"]),
+                    tuple(bool(x) for x in desc["sampler_mode"]),
+                )
                 out = fn(
                     engine.params, engine.cache_k, engine.cache_v,
                     jnp.asarray(desc["tokens"]), jnp.asarray(desc["lengths"]),
                     jnp.asarray(desc["active"]), jnp.asarray(desc["tables"]),
+                    jnp.asarray(desc["key"]), jnp.asarray(desc["temps"]),
+                    jnp.asarray(desc["topks"]), jnp.asarray(desc["topps"]),
                 )
                 engine.cache_k, engine.cache_v = out[4], out[5]
             elif op == "prefill_continue":
@@ -414,5 +427,8 @@ class LockstepFollower:
             else:
                 raise RuntimeError(f"unknown lockstep op {op!r}")
             steps += 1
+            if die_after_steps is not None and steps >= die_after_steps:
+                log.error("fault injection: follower dying after %d steps", steps)
+                os._exit(3)
         sock.close()
         return steps

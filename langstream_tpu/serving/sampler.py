@@ -58,6 +58,28 @@ def sample_tokens(
     if all_greedy:
         return greedy_tokens.astype(jnp.int32), token_logprob(greedy_tokens)
 
+    scaled = filtered_logits(
+        logits, temperatures, top_ks,
+        use_top_p=use_top_p, top_ps=top_ps, use_top_k=use_top_k,
+    )
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    tokens = jnp.where(temperatures <= 0, greedy_tokens, sampled)
+    return tokens.astype(jnp.int32), token_logprob(tokens)
+
+
+def filtered_logits(
+    logits: jax.Array,        # (B, V) float32
+    temperatures: jax.Array,  # (B,)
+    top_ks: jax.Array,        # (B,) 0 = off
+    use_top_p: bool = False,
+    top_ps: jax.Array | None = None,
+    use_top_k: bool = True,
+) -> jax.Array:
+    """Temperature-scaled, top-k/top-p-masked logits — the exact
+    categorical distribution :func:`sample_tokens` draws from. Shared by
+    the decode sampler and the speculative verify's rejection sampler so
+    acceptance probabilities match what plain decode would sample."""
+    B, V = logits.shape
     temps = jnp.maximum(temperatures, 1e-6)[:, None]
     scaled = logits / temps
     neg = jnp.finfo(scaled.dtype).min
@@ -84,6 +106,69 @@ def sample_tokens(
         ].set(keep_sorted)
         scaled = jnp.where(keep, scaled, neg)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
-    tokens = jnp.where(temperatures <= 0, greedy_tokens, sampled)
-    return tokens.astype(jnp.int32), token_logprob(tokens)
+    return scaled
+
+
+def speculative_accept(
+    logits: jax.Array,        # (B, D1, V) float32 — verify forward outputs
+    drafts: jax.Array,        # (B, D1-1) int32 — deterministic draft tokens
+    key: jax.Array,
+    temperatures: jax.Array,  # (B,)
+    top_ks: jax.Array,        # (B,)
+    top_ps: jax.Array,        # (B,)
+    use_top_p: bool = False,
+    use_top_k: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """Rejection sampling for a DETERMINISTIC drafter (prompt-lookup).
+
+    The draft distribution is a point mass at the drafted token, so the
+    standard speculative-sampling rule reduces to: accept draft ``d_j``
+    with probability ``p_j(d_j)`` (the target's filtered probability); on
+    the first rejection emit a sample from the residual ``p_j`` with
+    ``d_j`` removed; if all drafts survive, emit a bonus sample from the
+    last position. The emitted stream is distributed EXACTLY as plain
+    autoregressive sampling from ``filtered_logits`` — speculation changes
+    latency, never the distribution.
+
+    Greedy rows (temperature <= 0) degenerate cleanly: the target becomes
+    a point mass at the argmax, so acceptance is ``draft == argmax`` and
+    every fallback is the argmax — identical to the pure-greedy verify.
+
+    Returns ``(accepted (B,) int32 — count of accepted drafts,
+    fallback (B, D1) int32 — the token to emit at each position if the
+    burst stops there: residual samples for draft positions, the bonus
+    sample at the last)``.
+    """
+    B, D1, V = logits.shape
+    flat = logits.reshape(B * D1, V)
+    rep = lambda a: jnp.repeat(a, D1, axis=0)
+    scaled = filtered_logits(
+        flat, rep(temperatures), rep(top_ks),
+        use_top_p=use_top_p, top_ps=rep(top_ps), use_top_k=use_top_k,
+    )
+    p = jax.nn.softmax(scaled, axis=-1)
+    # greedy rows: point mass at the (unfiltered) argmax
+    greedy_mask = (rep(temperatures) <= 0)[:, None]
+    onehot = jax.nn.one_hot(jnp.argmax(flat, axis=-1), V, dtype=p.dtype)
+    p = jnp.where(greedy_mask, onehot, p).reshape(B, D1, V)
+
+    key_u, key_fb = jax.random.split(key)
+    p_draft = jnp.take_along_axis(
+        p[:, :-1], drafts[..., None], axis=-1
+    ).squeeze(-1)                                            # (B, D1-1)
+    u = jax.random.uniform(key_u, (B, D1 - 1))
+    accept = u < p_draft
+    accepted = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+    # fallback per position: categorical over log p with the draft masked
+    # out (residual); the last position keeps full p (bonus sample). A
+    # masked position is only ever used at the first rejection, where
+    # p(draft) < 1 guarantees the residual has mass.
+    fb_logits = jnp.log(p + 1e-30)
+    neg = jnp.finfo(fb_logits.dtype).min
+    draft_hot = jax.nn.one_hot(drafts, V, dtype=bool)        # (B, D1-1, V)
+    fb_logits = fb_logits.at[:, :-1].set(
+        jnp.where(draft_hot, neg, fb_logits[:, :-1])
+    )
+    fallback = jax.random.categorical(key_fb, fb_logits, axis=-1)
+    return accepted.astype(jnp.int32), fallback.astype(jnp.int32)

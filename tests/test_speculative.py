@@ -1,10 +1,12 @@
-"""Prompt-lookup speculative decoding (greedy, paged).
+"""Prompt-lookup speculative decoding (paged).
 
-The invariant everything rests on: greedy acceptance emits only tokens the
-model's own argmax produces, so speculative streams are IDENTICAL to plain
-decode — speculation changes tokens-per-forward, never content. No
-reference analogue (completions were SaaS calls); this is in-tree serving
-tech on the TPU engine.
+The invariants everything rests on: greedy acceptance emits only tokens the
+model's own argmax produces, so greedy speculative streams are IDENTICAL to
+plain decode; sampled requests use rejection sampling against the filtered
+target distribution, so their streams are distributed EXACTLY as plain
+sampling — speculation changes tokens-per-forward, never content (greedy)
+or distribution (sampled). No reference analogue (completions were SaaS
+calls); this is in-tree serving tech on the TPU engine.
 """
 
 import asyncio
@@ -142,16 +144,134 @@ def test_speculative_stream_identical_and_accepts():
     assert stats["speculative"]["steps"] < 24
 
 
-def test_speculative_sampled_requests_fall_back():
-    """Non-greedy requests route through the plain decode burst (greedy
-    acceptance doesn't apply); they must still complete."""
+def test_speculative_sampled_requests_speculate():
+    """Non-greedy requests ALSO speculate (rejection sampling against the
+    filtered target); on a repetitive workload drafts land and steps are
+    fewer than tokens."""
     r, stats = _gen(
         {**BASE, "speculative_drafts": 4},
         REPETITIVE,
         {"max-tokens": 12, "temperature": 0.8, "top-k": 20},
     )
-    assert len(r["tokens"]) == 12
+    assert len(r["tokens"]) > 0
+    assert stats["speculative"]["steps"] > 0
+
+
+def test_speculative_penalty_requests_fall_back():
+    """Presence/frequency penalties change the distribution per EMITTED
+    token — the verify step has no running counts, so these route to the
+    plain decode burst and must still complete."""
+    r, stats = _gen(
+        {**BASE, "speculative_drafts": 4},
+        REPETITIVE,
+        {"max-tokens": 8, "temperature": 0.8, "presence-penalty": 0.5},
+    )
+    assert len(r["tokens"]) > 0
     assert stats["speculative"]["steps"] == 0
+
+
+def test_speculative_accept_first_token_distribution_exact():
+    """The rejection sampler is distribution-exact for a deterministic
+    drafter: over many keys, the first emitted token's histogram matches
+    direct sampling from the filtered target (and, conditional on the
+    first draft surviving, the second position matches too)."""
+    from langstream_tpu.serving.sampler import (
+        filtered_logits,
+        speculative_accept,
+    )
+
+    V, D1 = 8, 3
+    rng = np.random.RandomState(0)
+    logits_np = rng.randn(1, D1, V) * 2.0
+    logits = jnp.asarray(logits_np, jnp.float32)
+    # draft 0 = the mode of position 0 so acceptance is common enough to
+    # measure the conditional position-1 histogram; draft 1 arbitrary
+    drafts = jnp.array([[int(logits_np[0, 0].argmax()), 5]], jnp.int32)
+    temps = jnp.array([0.9], jnp.float32)
+    topks = jnp.array([0], jnp.int32)
+    topps = jnp.array([1.0], jnp.float32)
+
+    N = 8000
+    keys = jax.random.split(jax.random.PRNGKey(1), N)
+
+    def step(key):
+        acc, fb = speculative_accept(
+            logits, drafts, key, temps, topks, topps,
+            use_top_p=False, use_top_k=False,
+        )
+        first = jnp.where(acc[0] >= 1, drafts[0, 0], fb[0, 0])
+        second = jnp.where(acc[0] >= 2, drafts[0, 1], fb[0, 1])
+        return first, second, acc[0]
+
+    firsts, seconds, accs = jax.vmap(step)(keys)
+    firsts, seconds, accs = map(np.asarray, (firsts, seconds, accs))
+
+    def target(pos):
+        return np.asarray(
+            jax.nn.softmax(
+                filtered_logits(logits[:, pos], temps, topks, use_top_k=False)
+            )
+        )[0]
+
+    hist1 = np.bincount(firsts, minlength=V) / N
+    np.testing.assert_allclose(hist1, target(0), atol=0.03)
+    # conditional on draft 0 surviving, position 1 must follow its target
+    sel = accs >= 1
+    assert sel.sum() > 500  # the drafted token has real mass under seed 0
+    hist2 = np.bincount(seconds[sel], minlength=V) / sel.sum()
+    np.testing.assert_allclose(hist2, target(1), atol=0.05)
+
+
+def test_sampled_verify_greedy_rows_degenerate_to_argmax():
+    """A greedy row inside the SAMPLED verify variant (mixed batch) must
+    behave exactly like the pure-greedy variant: acceptance is
+    draft == argmax and every fallback is the argmax."""
+    from langstream_tpu.models.llama import LlamaConfig, init_llama_params
+    from langstream_tpu.models.llama_paged import (
+        llama_prefill_paged,
+        llama_verify_chunk_paged,
+    )
+    from langstream_tpu.models.paged import (
+        BlockManager,
+        PagedLayout,
+        init_paged_kv_cache,
+    )
+
+    c = dataclasses.replace(LlamaConfig.tiny(max_seq_len=128), dtype=jnp.float32)
+    params = init_llama_params(c, jax.random.PRNGKey(5))
+    layout = PagedLayout.for_model(128, 2, block_size=16)
+    prompt = jnp.array([[5, 9, 17, 3, 11, 2, 7, 1]], jnp.int32)
+    n = 8
+    drafts = jnp.array([[1, 333, 334, 335, 336]], jnp.int32)
+
+    def verify(sampler_mode):
+        bm = BlockManager(layout, 2)
+        bm.admit(0, 40)
+        bm.ensure_capacity(0, 24)
+        pk, pv = init_paged_kv_cache(c, layout)
+        t = jnp.asarray(bm.tables[[0]])
+        logits, pk, pv = llama_prefill_paged(
+            c, params, prompt, jnp.array([n]), pk, pv, t, use_flash=False
+        )
+        tokens = drafts.at[0, 0].set(jnp.argmax(logits[0]).astype(jnp.int32))
+        return llama_verify_chunk_paged(
+            c, params, tokens, jnp.array([n]), jnp.array([True]), pk, pv,
+            t, 2, key=jax.random.PRNGKey(7),
+            temps=jnp.array([0.0], jnp.float32),
+            topks=jnp.array([0], jnp.int32),
+            topps=jnp.array([1.0], jnp.float32),
+            sampler_mode=sampler_mode,
+        )
+
+    em_g, adv_g, nxt_g, nl_g, _, _, _ = verify((False, False, True))
+    em_s, adv_s, nxt_s, nl_s, _, _, _ = verify((False, False, False))
+    a = int(adv_g[0])
+    assert int(adv_s[0]) == a
+    assert int(nxt_s[0]) == int(nxt_g[0]) and int(nl_s[0]) == int(nl_g[0])
+    # only the first adv positions are ever read by the engine
+    assert (
+        np.asarray(em_s)[0, :a].tolist() == np.asarray(em_g)[0, :a].tolist()
+    )
 
 
 def test_speculative_concurrent_requests_complete():
