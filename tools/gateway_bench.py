@@ -97,9 +97,15 @@ async def run_gateway_bench(
     warmup: int = 6,
     arrival_rate_hz: float = 4.0,
     seed: int = 7,
+    instance_yaml: str | None = None,
 ) -> dict[str, Any]:
     """Returns {"gateway_ttft_p50_s", "gateway_ttft_p99_s", "e2e_p50_s",
-    "arrival_rate_hz", "requests"}."""
+    "arrival_rate_hz", "requests"}.
+
+    ``instance_yaml`` overrides the streaming cluster (default: the memory
+    broker) — ``BENCH_BROKER=tsb`` routes the whole chat path through a
+    real tsbroker process so a recorded perf number includes a real broker
+    transport."""
     import aiohttp
 
     from langstream_tpu.controlplane.server import (
@@ -132,7 +138,7 @@ async def run_gateway_bench(
                 ),
                 "gateways.yaml": GATEWAYS,
             },
-            "instance": INSTANCE,
+            "instance": instance_yaml or INSTANCE,
         }
         async with session.post(
             f"{api}/api/applications/bench/chatapp", json=payload
