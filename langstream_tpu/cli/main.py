@@ -290,6 +290,64 @@ def apps_logs(name, tenant, api_url) -> None:
     click.echo(out)
 
 
+@apps.command("ui")
+@click.argument("name")
+@click.option("--tenant", default=None)
+@click.option("--gateway", "gateway_id", default="chat",
+              help="chat gateway id in the app's gateways.yaml")
+@click.option("--gateway-url", default=None,
+              help="websocket gateway base (default: profile / ws://localhost:8091)")
+@click.option("--port", default=8092, show_default=True,
+              help="local port to serve the UI on (0 = ephemeral)")
+@click.option("--open/--no-open", "open_browser", default=True,
+              help="open the page in a browser")
+@click.option("--once", is_flag=True, hidden=True,
+              help="serve a single request then exit (tests)")
+def apps_ui(name, tenant, gateway_id, gateway_url, port, open_browser, once) -> None:
+    """Serve the bundled chat UI against an app's chat gateway (parity:
+    `langstream apps ui` serving langstream-cli's app-ui/index.html)."""
+    import http.server
+    import threading
+    import urllib.parse
+    import webbrowser
+
+    tenant = tenant or _profile().get("tenant", "default")
+    ws_base = _gateway_url(gateway_url)
+    page = (Path(__file__).parent / "app_ui.html").read_bytes()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib naming)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(page)))
+            self.end_headers()
+            self.wfile.write(page)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    actual_port = server.server_address[1]
+    query = urllib.parse.urlencode(
+        {"tenant": tenant, "app": name, "gw": gateway_id, "gateway": ws_base}
+    )
+    url = f"http://127.0.0.1:{actual_port}/?{query}"
+    click.echo(f"chat UI: {url}")
+    if open_browser:
+        threading.Thread(
+            target=webbrowser.open, args=(url,), daemon=True
+        ).start()
+    try:
+        if once:
+            server.handle_request()
+        else:
+            server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+
+
 @apps.command("diagram")
 @click.option("-app", "--application", "app", required=True, type=click.Path(exists=True))
 @click.option("-i", "--instance", default=None, type=click.Path(exists=True))

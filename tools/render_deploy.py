@@ -1,12 +1,21 @@
-"""Render the installable k8s manifests into ``deploy/k8s/``.
+"""Render the installable k8s manifests into ``deploy/k8s/`` — and, with
+``--helm``, an installable Helm chart into ``deploy/helm/langstream-tpu/``.
 
-    python tools/render_deploy.py
+    python tools/render_deploy.py            # plain manifests (kubectl apply)
+    python tools/render_deploy.py --helm     # Helm chart (helm install)
 
 The rendered YAML is CHECKED IN (parity: the reference ships ``helm/`` with
-CRDs and values examples) so `kubectl apply -f deploy/k8s/` installs the
-control plane, api-gateway, and operator without running any Python — the
-generator exists so the manifests never drift from the Python factories
-(CRDs come straight from ``langstream_tpu.k8s.crds.crd_manifests``).
+CRDs and values examples; the chart proper lives in a separate repo per
+``helm/README.md`` — here both live in-tree) so installation needs no
+Python — the generator exists so the manifests never drift from the Python
+factories (CRDs come straight from ``langstream_tpu.k8s.crds.crd_manifests``).
+
+The chart is produced from the SAME documents as the plain manifests:
+namespace/image/accelerator fields are swapped for ``{{ .Release.Namespace
+}}`` / ``{{ .Values.* }}`` template expressions, CRDs go under ``crds/``
+(Helm installs them before templates), and an optional ConfigMap template
+carries ``codeStorage`` / ``adminAuth`` from values (the hand-created
+ConfigMap of the kubectl path, see ``values-example.yaml``).
 """
 
 from __future__ import annotations
@@ -19,9 +28,11 @@ import yaml
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 OUT = REPO / "deploy" / "k8s"
+HELM_OUT = REPO / "deploy" / "helm" / "langstream-tpu"
 
 NAMESPACE = "langstream-tpu"
 IMAGE = "langstream-tpu/runtime:latest"
+CHART_VERSION = "0.4.0"
 
 
 def deployment(name: str, command: list[str], env: list[dict], sa: str) -> dict:
@@ -114,22 +125,19 @@ def rbac() -> list[dict]:
     return out
 
 
-def main() -> None:
+def render_documents() -> dict[str, list[dict]]:
+    """filename → manifest documents; one source of truth for both the
+    plain-kubectl tree and the Helm chart."""
     from langstream_tpu.k8s.crds import crd_manifests
 
-    OUT.mkdir(parents=True, exist_ok=True)
-
-    def write(name: str, docs: list[dict]) -> None:
-        (OUT / name).write_text(yaml.safe_dump_all(docs, sort_keys=False))
-        print(f"wrote deploy/k8s/{name} ({len(docs)} documents)")
-
-    write("00-namespace.yaml", [
+    docs: dict[str, list[dict]] = {}
+    docs["00-namespace.yaml"] = [
         {"apiVersion": "v1", "kind": "Namespace",
          "metadata": {"name": NAMESPACE}},
-    ])
-    write("01-crds.yaml", crd_manifests())
-    write("02-rbac.yaml", rbac())
-    write("03-control-plane.yaml", [
+    ]
+    docs["01-crds.yaml"] = crd_manifests()
+    docs["02-rbac.yaml"] = rbac()
+    docs["03-control-plane.yaml"] = [
         deployment(
             "langstream-control-plane",
             ["python", "-m", "langstream_tpu.controlplane"],
@@ -149,8 +157,8 @@ def main() -> None:
             "langstream-control-plane",
         ),
         service("langstream-control-plane", 8090),
-    ])
-    write("04-api-gateway.yaml", [
+    ]
+    docs["04-api-gateway.yaml"] = [
         # the gateway needs NO kubernetes API access (it polls the control
         # plane over HTTP) and is the internet-facing component — its own
         # rule-less ServiceAccount keeps a compromise worthless
@@ -172,8 +180,8 @@ def main() -> None:
             "langstream-api-gateway",
         ),
         service("langstream-api-gateway", 8091),
-    ])
-    write("05-operator.yaml", [
+    ]
+    docs["05-operator.yaml"] = [
         deployment(
             "langstream-operator",
             ["python", "-m", "langstream_tpu.k8s.operator"],
@@ -182,7 +190,115 @@ def main() -> None:
             ],
             "langstream-operator",
         ),
-    ])
+    ]
+    return docs
+
+
+def _rel(path: Path) -> Path:
+    return path.relative_to(REPO) if path.is_relative_to(REPO) else path
+
+
+def write_plain(out: Path) -> None:
+    out.mkdir(parents=True, exist_ok=True)
+    for name, docs in render_documents().items():
+        (out / name).write_text(yaml.safe_dump_all(docs, sort_keys=False))
+        print(f"wrote {_rel(out)}/{name} ({len(docs)} documents)")
+
+
+_CONFIG_TEMPLATE = """\
+{{- if .Values.codeStorage }}
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: langstream-config
+  namespace: {{ .Release.Namespace }}
+data:
+  code-storage: {{ .Values.codeStorage | toJson | quote }}
+  {{- if .Values.adminAuth }}
+  admin-auth: {{ .Values.adminAuth | toJson | quote }}
+  {{- end }}
+{{- end }}
+"""
+
+_NOTES = """\
+langstream-tpu installed into namespace {{ .Release.Namespace }}.
+
+Control plane:  http://langstream-control-plane.{{ .Release.Namespace }}:8090
+API gateway:    ws://langstream-api-gateway.{{ .Release.Namespace }}:8091
+
+Point the CLI at it:
+  python -m langstream_tpu.cli profiles set default \\
+      --web-service-url http://langstream-control-plane.{{ .Release.Namespace }}:8090
+
+RBAC note: ClusterRole/ClusterRoleBinding names are fixed (tenant
+namespaces are created dynamically, so grants are cluster-scoped) —
+install one release per cluster.
+"""
+
+
+def _helm_template(doc_yaml: str) -> str:
+    """Swap the concrete install-time choices for template expressions.
+    Values are quoted YAML-safely because the replacements sit in scalar
+    positions that were already plain strings."""
+    out = doc_yaml.replace(f"namespace: {NAMESPACE}", "namespace: {{ .Release.Namespace }}")
+    out = out.replace(f"image: {IMAGE}", "image: {{ .Values.image }}")
+    out = out.replace("value: v5e", "value: {{ .Values.accelerator | quote }}")
+    return out
+
+
+def write_helm(out: Path) -> None:
+    templates = out / "templates"
+    crds = out / "crds"
+    templates.mkdir(parents=True, exist_ok=True)
+    crds.mkdir(parents=True, exist_ok=True)
+
+    (out / "Chart.yaml").write_text(yaml.safe_dump({
+        "apiVersion": "v2",
+        "name": "langstream-tpu",
+        "description": "Event-driven LLM streaming platform with in-tree "
+                       "TPU serving (control plane, api-gateway, operator)",
+        "type": "application",
+        "version": CHART_VERSION,
+        "appVersion": CHART_VERSION,
+    }, sort_keys=False))
+    (out / "values.yaml").write_text(
+        "# Install-time configuration. See deploy/k8s/values-example.yaml\n"
+        "# for a worked codeStorage example.\n"
+        + yaml.safe_dump({
+            "image": IMAGE,
+            "accelerator": "v5e",
+            # JSON-able structures; null disables the ConfigMap template
+            "codeStorage": None,
+            "adminAuth": None,
+        }, sort_keys=False)
+    )
+
+    for name, docs in render_documents().items():
+        if name == "00-namespace.yaml":
+            continue  # helm install --create-namespace owns this
+        body = yaml.safe_dump_all(docs, sort_keys=False)
+        if name == "01-crds.yaml":
+            (crds / name).write_text(body)  # CRDs install pre-template, untemplated
+        else:
+            (templates / name).write_text(_helm_template(body))
+    (templates / "06-config.yaml").write_text(_CONFIG_TEMPLATE)
+    (templates / "NOTES.txt").write_text(_NOTES)
+    print(f"wrote helm chart under {_rel(out)}/")
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--helm", action="store_true",
+                    help="render the Helm chart instead of plain manifests")
+    ap.add_argument("--out", default=None,
+                    help="override the output directory")
+    args = ap.parse_args()
+    if args.helm:
+        write_helm(Path(args.out) if args.out else HELM_OUT)
+    else:
+        write_plain(Path(args.out) if args.out else OUT)
 
 
 if __name__ == "__main__":
