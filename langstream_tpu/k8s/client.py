@@ -37,6 +37,15 @@ KIND_ROUTES: dict[str, tuple[str, str, bool]] = {
 }
 
 
+class KubeConflictError(RuntimeError):
+    """409 from the API server: optimistic-concurrency loss (stale
+    resourceVersion) or create of an existing object."""
+
+
+class KubeNotFoundError(RuntimeError):
+    """404 on a write: the target (or its namespace) does not exist."""
+
+
 class KubeApi:
     """Minimal CRUD surface the control/data-plane layers need."""
 
@@ -120,7 +129,15 @@ class InMemoryKubeApi(KubeApi):
         existing = self.objects.get(key)
         if existing is not None and "status" not in obj and "status" in existing:
             obj = {**obj, "status": existing["status"]}
-        self.objects[key] = json.loads(json.dumps(obj))
+        obj = json.loads(json.dumps(obj))
+        # stable uid across updates (owner references point at it)
+        if existing is not None and existing.get("metadata", {}).get("uid"):
+            obj.setdefault("metadata", {})["uid"] = existing["metadata"]["uid"]
+        else:
+            import uuid
+
+            obj.setdefault("metadata", {}).setdefault("uid", str(uuid.uuid4()))
+        self.objects[key] = obj
         self.events.append(
             ("apply", kind, meta.get("namespace"), meta["name"])
         )
@@ -130,10 +147,27 @@ class InMemoryKubeApi(KubeApi):
 
     def delete(self, kind: str, namespace: str | None, name: str) -> bool:
         key = self._key(kind, namespace, name)
-        existed = self.objects.pop(key, None) is not None
-        if existed:
-            self.events.append(("delete", kind, namespace, name))
-        return existed
+        removed = self.objects.pop(key, None)
+        if removed is None:
+            return False
+        self.events.append(("delete", kind, namespace, name))
+        # garbage-collect dependents (what the real API server's GC
+        # controller does for ownerReferences; dev mode matches clusters)
+        uid = (removed.get("metadata") or {}).get("uid")
+        if uid:
+            doomed = [
+                (k, ns, n)
+                for (k, ns, n), o in list(self.objects.items())
+                if any(
+                    ref.get("uid") == uid
+                    for ref in (o.get("metadata") or {}).get(
+                        "ownerReferences", []
+                    )
+                )
+            ]
+            for k, ns, n in doomed:
+                self.delete(k, ns, n)
+        return True
 
     def update_status(self, obj: dict) -> dict:
         kind = obj["kind"]
@@ -209,14 +243,27 @@ class HttpKubeApi(KubeApi):
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            with urllib.request.urlopen(req, context=self.ssl_context) as resp:
+            context = self.ssl_context if url.startswith("https") else None
+            with urllib.request.urlopen(req, context=context) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else None
         except urllib.error.HTTPError as e:
-            if e.code == 404:
+            if e.code == 404 and method in ("GET", "DELETE"):
+                # object absence is an answer for reads/deletes; for a
+                # create/update a 404 is a real failure (e.g. the target
+                # namespace does not exist) and must not vanish into None
                 return None
+            detail = e.read()[:500]
+            if e.code == 404:
+                raise KubeNotFoundError(
+                    f"kube api {method} {url}: 404 {detail!r}"
+                ) from e
+            if e.code == 409:
+                raise KubeConflictError(
+                    f"kube api {method} {url}: 409 {detail!r}"
+                ) from e
             raise RuntimeError(
-                f"kube api {method} {url} failed: {e.code} {e.read()[:500]!r}"
+                f"kube api {method} {url} failed: {e.code} {detail!r}"
             ) from e
 
     def get(self, kind: str, namespace: str | None, name: str) -> dict | None:
@@ -235,20 +282,45 @@ class HttpKubeApi(KubeApi):
         result = self._request("GET", url) or {}
         return result.get("items", [])
 
+    RETRIES = 5
+
     def apply(self, obj: dict) -> dict:
+        """Create-or-replace with optimistic-concurrency retries: a 409
+        (another writer bumped resourceVersion between our GET and PUT, or
+        created the object before our POST) re-reads and retries — the
+        level-triggered reconcilers re-derive the full desired state, so
+        last-writer-wins on the spec is the correct outcome."""
         kind = obj["kind"]
         meta = obj["metadata"]
         namespace, name = meta.get("namespace"), meta["name"]
-        existing = self.get(kind, namespace, name)
-        if existing is None:
-            return self._request("POST", self._url(kind, namespace), obj)
-        # deep-copy before injecting resourceVersion: the caller's manifest
-        # must stay reusable (a stale resourceVersion poisons later applies)
-        obj = json.loads(json.dumps(obj))
-        obj.setdefault("metadata", {})["resourceVersion"] = existing["metadata"][
-            "resourceVersion"
-        ]
-        return self._request("PUT", self._url(kind, namespace, name), obj)
+        for _ in range(self.RETRIES):
+            existing = self.get(kind, namespace, name)
+            if existing is None:
+                try:
+                    return self._request("POST", self._url(kind, namespace), obj)
+                except KubeConflictError:
+                    continue  # created concurrently: retry as an update
+                # a POST 404 (missing namespace) is permanent — let the
+                # KubeNotFoundError propagate, retrying cannot fix it
+            try:
+                # deep-copy before injecting resourceVersion: the caller's
+                # manifest must stay reusable (a stale resourceVersion
+                # poisons later applies)
+                candidate = json.loads(json.dumps(obj))
+                candidate.setdefault("metadata", {})["resourceVersion"] = (
+                    existing["metadata"]["resourceVersion"]
+                )
+                return self._request(
+                    "PUT", self._url(kind, namespace, name), candidate
+                )
+            except KubeNotFoundError:
+                continue  # deleted underneath us: retry as a create
+            except KubeConflictError:
+                continue
+        raise KubeConflictError(
+            f"apply of {kind}/{name} kept conflicting after "
+            f"{self.RETRIES} attempts"
+        )
 
     def delete(self, kind: str, namespace: str | None, name: str) -> bool:
         return (
@@ -259,6 +331,48 @@ class HttpKubeApi(KubeApi):
         kind = obj["kind"]
         meta = obj["metadata"]
         url = self._url(kind, meta.get("namespace"), meta["name"]) + "/status"
-        current = self.get(kind, meta.get("namespace"), meta["name"]) or {}
-        merged = {**current, "status": obj.get("status") or {}}
-        return self._request("PUT", url, merged)
+        for _ in range(self.RETRIES):
+            current = self.get(kind, meta.get("namespace"), meta["name"])
+            if current is None:
+                raise KeyError(f"{kind}/{meta['name']} not found")
+            merged = {**current, "status": obj.get("status") or {}}
+            try:
+                return self._request("PUT", url, merged)
+            except KubeNotFoundError:
+                raise KeyError(f"{kind}/{meta['name']} not found") from None
+            except KubeConflictError:
+                continue
+        raise KubeConflictError(
+            f"status update of {kind}/{meta['name']} kept conflicting "
+            f"after {self.RETRIES} attempts"
+        )
+
+    def watch(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        resource_version: str | None = None,
+        timeout_s: float = 30.0,
+    ):
+        """Yield ``(event_type, object)`` from a server watch stream
+        (ADDED/MODIFIED/DELETED) until the server closes it — the
+        level-triggered poll loop's wake-up signal, not a state store."""
+        url = self._url(kind, namespace)
+        params = {"watch": "true", "timeoutSeconds": str(int(timeout_s))}
+        if resource_version is not None:
+            params["resourceVersion"] = str(resource_version)
+        url += "?" + "&".join(f"{k}={v}" for k, v in params.items())
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        context = self.ssl_context if url.startswith("https") else None
+        with urllib.request.urlopen(
+            req, context=context, timeout=timeout_s + 10
+        ) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                yield event.get("type"), event.get("object")

@@ -20,6 +20,7 @@ import asyncio
 import base64
 import json
 import logging
+import time
 from typing import Any
 
 from langstream_tpu.k8s.client import KubeApi
@@ -42,14 +43,18 @@ DELETING = "DELETING"
 
 def apply_if_changed(api: KubeApi, obj: dict[str, Any]) -> dict[str, Any]:
     """Level-triggered writes without churn: skip the PUT when the desired
-    spec/data/labels already match (every tick would otherwise rewrite every
-    object, hammering the API server and bumping resourceVersions)."""
+    spec/data/labels/ownerReferences already match (every tick would
+    otherwise rewrite every object, hammering the API server and bumping
+    resourceVersions). ownerReferences participate so dependents created
+    before owner-stamping existed still get their refs on the next tick —
+    without them, deleting the owning CR would orphan them forever."""
     meta = obj.get("metadata") or {}
     existing = api.get(obj["kind"], meta.get("namespace"), meta["name"])
+    existing_meta = (existing or {}).get("metadata") or {}
     if existing is not None and all(
         specs_equal(obj.get(k), existing.get(k)) for k in ("spec", "data")
-    ) and specs_equal(
-        (meta.get("labels")), ((existing.get("metadata") or {}).get("labels"))
+    ) and specs_equal(meta.get("labels"), existing_meta.get("labels")) and specs_equal(
+        meta.get("ownerReferences"), existing_meta.get("ownerReferences")
     ):
         return existing
     return api.apply(obj)
@@ -62,13 +67,36 @@ class AgentController:
         self.api = api
         self.accelerator = accelerator
 
+    @staticmethod
+    def _own(obj: dict[str, Any], cr_dict: dict[str, Any]) -> dict[str, Any]:
+        """Stamp the Agent CR as controller-owner so deleting the CR
+        cascades to its dependents via server-side garbage collection
+        (parity: fabric8 dependents in AgentController.java — dependents
+        carry owner references, the API server GC does the deletion)."""
+        meta = cr_dict.get("metadata") or {}
+        if meta.get("uid"):
+            obj.setdefault("metadata", {})["ownerReferences"] = [{
+                "apiVersion": "langstream.tpu/v1alpha1",
+                "kind": "Agent",
+                "name": meta["name"],
+                "uid": meta["uid"],
+                "controller": True,
+                "blockOwnerDeletion": True,
+            }]
+        return obj
+
     def reconcile(self, cr_dict: dict[str, Any]) -> str:
         cr = AgentCustomResource.from_dict(cr_dict)
-        service = AgentResourcesFactory.generate_headless_service(cr)
-        apply_if_changed(self.api, service)
-        statefulsets = AgentResourcesFactory.generate_statefulsets(
-            cr, accelerator=self.accelerator
+        service = self._own(
+            AgentResourcesFactory.generate_headless_service(cr), cr_dict
         )
+        apply_if_changed(self.api, service)
+        statefulsets = [
+            self._own(sts, cr_dict)
+            for sts in AgentResourcesFactory.generate_statefulsets(
+                cr, accelerator=self.accelerator
+            )
+        ]
         # prune StatefulSets from a previous shape (e.g. parallelism shrank
         # or the agent moved between single- and multi-host)
         wanted = {sts["metadata"]["name"] for sts in statefulsets}
@@ -262,12 +290,19 @@ class Operator:
         api: KubeApi,
         interval: float = 2.0,
         accelerator: str = "v5e",
+        watch: bool = False,
     ):
         self.api = api
         self.interval = interval
         self.apps = AppController(api)
         self.agents = AgentController(api, accelerator=accelerator)
         self._stop = asyncio.Event()
+        # watch mode: CR events wake the loop immediately instead of
+        # waiting out the poll interval (the poll remains as the resync
+        # backstop — informer semantics without an informer cache)
+        self.watch = watch and hasattr(api, "watch")
+        self._wake: asyncio.Event = asyncio.Event()
+        self._watch_threads: list = []
 
     def reconcile_once(self) -> dict[str, str]:
         statuses: dict[str, str] = {}
@@ -287,13 +322,50 @@ class Operator:
                 statuses[f"agent/{name}"] = f"RETRY: {e}"
         return statuses
 
+    def _start_watchers(self, loop: asyncio.AbstractEventLoop) -> None:
+        import threading
+
+        def _watch_kind(kind: str) -> None:
+            while not self._stop.is_set():
+                try:
+                    for _event, _obj in self.api.watch(kind, timeout_s=30):
+                        if self._stop.is_set():
+                            return
+                        loop.call_soon_threadsafe(self._wake.set)
+                except Exception:
+                    # watch streams are best-effort wake-ups; the poll
+                    # backstop guarantees progress — back off and redial
+                    if self._stop.is_set():
+                        return
+                    time.sleep(1.0)
+
+        for kind in ("Application", "Agent"):
+            t = threading.Thread(
+                target=_watch_kind, args=(kind,),
+                name=f"operator-watch-{kind}", daemon=True,
+            )
+            t.start()
+            self._watch_threads.append(t)
+
     async def run(self) -> None:
+        if self.watch:
+            self._start_watchers(asyncio.get_running_loop())
         while not self._stop.is_set():
-            self.reconcile_once()
+            self._wake.clear()
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.reconcile_once
+            )
+            stop_task = asyncio.ensure_future(self._stop.wait())
+            wake_task = asyncio.ensure_future(self._wake.wait())
             try:
-                await asyncio.wait_for(self._stop.wait(), timeout=self.interval)
-            except asyncio.TimeoutError:
-                pass
+                await asyncio.wait(
+                    {stop_task, wake_task},
+                    timeout=self.interval,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            finally:
+                stop_task.cancel()
+                wake_task.cancel()
 
     def stop(self) -> None:
         self._stop.set()
@@ -313,6 +385,7 @@ def main() -> None:
         HttpKubeApi.in_cluster(),
         interval=float(os.environ.get("LS_RECONCILE_INTERVAL", "2.0")),
         accelerator=os.environ.get("LS_ACCELERATOR", "v5e"),
+        watch=os.environ.get("LS_OPERATOR_WATCH", "1") != "0",
     )
 
     async def _run() -> None:

@@ -744,6 +744,7 @@ def test_render_deploy_helm_chart(tmp_path):
     # templates: substitute expressions like a minimal `helm template` run
     subs = {
         "{{ .Release.Namespace }}": "test-ns",
+        "{{ .Values.image | quote }}": '"img:1"',
         "{{ .Values.image }}": "img:1",
         "{{ .Values.accelerator | quote }}": '"v5e"',
     }
@@ -767,7 +768,8 @@ def test_render_deploy_helm_chart(tmp_path):
 
 
 def test_render_deploy_plain_matches_committed(tmp_path):
-    """The committed deploy/k8s tree must not drift from the generator."""
+    """Neither committed tree (deploy/k8s NOR deploy/helm) may drift from
+    the generator — the README instructs regenerating both."""
     import filecmp
     import subprocess
     import sys
@@ -783,3 +785,23 @@ def test_render_deploy_plain_matches_committed(tmp_path):
     committed = repo / "deploy" / "k8s"
     for f in sorted(out.glob("*.yaml")):
         assert filecmp.cmp(f, committed / f.name, shallow=False), f.name
+
+    chart_out = tmp_path / "chart"
+    subprocess.run(
+        [sys.executable, str(repo / "tools" / "render_deploy.py"),
+         "--helm", "--out", str(chart_out)],
+        check=True, capture_output=True,
+    )
+    committed_chart = repo / "deploy" / "helm" / "langstream-tpu"
+    rendered = sorted(
+        p.relative_to(chart_out) for p in chart_out.rglob("*") if p.is_file()
+    )
+    committed_files = sorted(
+        p.relative_to(committed_chart)
+        for p in committed_chart.rglob("*") if p.is_file()
+    )
+    assert rendered == committed_files
+    for rel in rendered:
+        assert filecmp.cmp(
+            chart_out / rel, committed_chart / rel, shallow=False
+        ), str(rel)

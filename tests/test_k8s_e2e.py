@@ -1,0 +1,346 @@
+"""k8s layer against a conformance-grade fake API server (r3 verdict #4).
+
+Everything here runs through :class:`HttpKubeApi` over real HTTP against
+``tests/fake_kube.py`` — a server that independently implements resource
+paths, optimistic concurrency (409 on stale resourceVersion), AlreadyExists
+conflicts, the status subresource, namespace existence requirements, label
+selectors, and chunked watch streams. The reference proves the same layer
+against K3s-in-docker (``LocalK3sContainer.java``, ``AppController.java:54``);
+no container runtime exists in this image, so this server is the
+conformance stand-in — crucially it is NOT the InMemoryKubeApi the
+operator/deployer were developed against.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+import yaml
+
+from langstream_tpu.k8s.client import HttpKubeApi, KubeConflictError
+
+from fake_kube import FakeKubeApiServer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def server():
+    with FakeKubeApiServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def api(server):
+    return HttpKubeApi(server.url)
+
+
+# ---------------------------------------------------------------------------
+# conformance: the semantics InMemoryKubeApi never exercised
+# ---------------------------------------------------------------------------
+
+
+def _ns(api, name="ns1"):
+    api.apply({"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": name}})
+    return name
+
+
+def _cm(name, ns, data):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": ns}, "data": data}
+
+
+def test_crud_roundtrip_and_resource_versions(api):
+    ns = _ns(api)
+    created = api.apply(_cm("a", ns, {"k": "1"}))
+    rv1 = created["metadata"]["resourceVersion"]
+    assert created["metadata"]["uid"]
+    updated = api.apply(_cm("a", ns, {"k": "2"}))
+    assert int(updated["metadata"]["resourceVersion"]) > int(rv1)
+    assert api.get("ConfigMap", ns, "a")["data"] == {"k": "2"}
+    assert api.delete("ConfigMap", ns, "a")
+    assert api.get("ConfigMap", ns, "a") is None
+    assert not api.delete("ConfigMap", ns, "a")
+
+
+def test_create_in_missing_namespace_is_404(api):
+    with pytest.raises(RuntimeError, match="404|not found"):
+        api._request(
+            "POST", api._url("ConfigMap", "ghost"), _cm("a", "ghost", {})
+        )
+
+
+def test_stale_resource_version_conflicts_and_apply_retries(api, server):
+    ns = _ns(api)
+    api.apply(_cm("a", ns, {"k": "1"}))
+    stale = api.get("ConfigMap", ns, "a")
+
+    # another writer moves the object forward
+    api.apply(_cm("a", ns, {"k": "2"}))
+
+    # a raw PUT with the stale resourceVersion must 409
+    stale["data"] = {"k": "stale"}
+    with pytest.raises(KubeConflictError):
+        api._request("PUT", api._url("ConfigMap", ns, "a"), stale)
+
+    # ...but apply() (re-read + retry) wins even when a racer keeps
+    # bumping the object between its GET and PUT
+    real_request = api._request
+    raced = {"n": 0}
+
+    def racing_request(method, url, body=None):
+        if method == "PUT" and raced["n"] < 2:
+            raced["n"] += 1
+            # bump the object server-side first, so THIS put is stale
+            fresh = real_request("GET", api._url("ConfigMap", ns, "a"))
+            fresh["data"] = {"k": f"racer-{raced['n']}"}
+            real_request("PUT", api._url("ConfigMap", ns, "a"), fresh)
+        return real_request(method, url, body)
+
+    api._request = racing_request
+    try:
+        final = api.apply(_cm("a", ns, {"k": "mine"}))
+    finally:
+        api._request = real_request
+    assert raced["n"] == 2
+    assert final["data"] == {"k": "mine"}
+    assert api.get("ConfigMap", ns, "a")["data"] == {"k": "mine"}
+
+
+def test_post_conflict_on_existing_object(api):
+    ns = _ns(api)
+    api.apply(_cm("a", ns, {}))
+    with pytest.raises(KubeConflictError):
+        api._request("POST", api._url("ConfigMap", ns), _cm("a", ns, {}))
+
+
+def test_status_subresource_isolation(api):
+    """Status PUTs never touch spec; spec PUTs never clobber status —
+    the CRDs declare the subresource and the controllers depend on it."""
+    from langstream_tpu.k8s.crds import AgentCustomResource, AgentSpec
+
+    ns = _ns(api, "langstream-t1")
+    cr = AgentCustomResource(
+        name="ag", namespace=ns,
+        spec=AgentSpec(agent_id="ag", application_id="app", tenant="t1"),
+    )
+    api.apply(cr.to_dict())
+    cr_dict = api.get("Agent", ns, "ag")
+    cr_dict["status"] = {"status": "DEPLOYING"}
+    api.update_status(cr_dict)
+    # spec-side apply with no status must keep DEPLOYING
+    again = cr.to_dict()
+    applied = api.apply(again)
+    assert applied["status"] == {"status": "DEPLOYING"}
+    # status PUT carrying a mutated spec must not change the spec
+    mutated = api.get("Agent", ns, "ag")
+    mutated["spec"]["agentId"] = "EVIL"
+    mutated["status"] = {"status": "DEPLOYED"}
+    api.update_status(mutated)
+    final = api.get("Agent", ns, "ag")
+    assert final["status"] == {"status": "DEPLOYED"}
+    assert final["spec"]["agentId"] == "ag"
+
+
+def test_label_selector_list(api):
+    ns = _ns(api)
+    obj = _cm("a", ns, {})
+    obj["metadata"]["labels"] = {"app": "x", "tier": "1"}
+    api.apply(obj)
+    obj2 = _cm("b", ns, {})
+    obj2["metadata"]["labels"] = {"app": "y"}
+    api.apply(obj2)
+    names = [o["metadata"]["name"]
+             for o in api.list("ConfigMap", ns, label_selector={"app": "x"})]
+    assert names == ["a"]
+
+
+def test_watch_stream_delivers_ordered_events(api, server):
+    ns = _ns(api)
+    got: list[tuple[str, str]] = []
+    started = threading.Event()
+
+    def watcher():
+        started.set()
+        for ev, obj in api.watch("ConfigMap", ns, timeout_s=10):
+            got.append((ev, obj["metadata"]["name"]))
+            if len(got) >= 3:
+                return
+
+    t = threading.Thread(target=watcher, daemon=True)
+    t.start()
+    started.wait(5)
+    time.sleep(0.2)  # let the stream attach
+    api.apply(_cm("w", ns, {"k": "1"}))
+    api.apply(_cm("w", ns, {"k": "2"}))
+    api.delete("ConfigMap", ns, "w")
+    t.join(15)
+    assert got == [("ADDED", "w"), ("MODIFIED", "w"), ("DELETED", "w")]
+
+
+# ---------------------------------------------------------------------------
+# the full control-plane story over HTTP: rendered manifests → app deploy →
+# operator → StatefulSet + pod-config → teardown
+# ---------------------------------------------------------------------------
+
+
+def _apply_rendered(api, filename: str) -> None:
+    for doc in yaml.safe_load_all(
+        (REPO / "deploy" / "k8s" / filename).read_text()
+    ):
+        if doc and doc["kind"] in ("Namespace", "CustomResourceDefinition",
+                                   "Secret", "ConfigMap"):
+            api.apply(doc)
+
+
+def test_app_deploy_to_statefulset_and_teardown(api, server):
+    from langstream_tpu.controlplane.stores import StoredApplication
+    from langstream_tpu.core.deployer import ApplicationDeployer
+    from langstream_tpu.core.parser import build_application_from_files
+    from langstream_tpu.k8s.cluster_runtime import KubernetesClusterRuntime
+    from langstream_tpu.k8s.operator import Operator
+    from langstream_tpu.k8s.stores import (
+        GLOBAL_NAMESPACE,
+        KubernetesApplicationStore,
+    )
+
+    # 0. the rendered install manifests go in first — the CRDs and the
+    # system namespace come from deploy/k8s/, not hand-built dicts
+    _apply_rendered(api, "00-namespace.yaml")
+    _apply_rendered(api, "01-crds.yaml")
+    assert api.get("Namespace", None, "langstream-tpu") is not None
+    assert len(api.list("CustomResourceDefinition")) == 2
+    api.apply({"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": GLOBAL_NAMESPACE}})
+
+    # 1. tenant + application through the k8s-backed store
+    store = KubernetesApplicationStore(api, runtime_image="img:1")
+    store.put_tenant("t1")
+    ns = "langstream-t1"
+    assert api.get("Namespace", None, ns) is not None
+    pipeline_yaml = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "annotate"
+    type: "compute"
+    input: "input-topic"
+    output: "output-topic"
+    configuration:
+      fields:
+        - name: "value.upper"
+          expression: "fn:uppercase(value.question)"
+"""
+    store.put_application(StoredApplication(
+        tenant="t1", name="myapp", files={"pipeline.yaml": pipeline_yaml},
+    ))
+    assert store.get_application("t1", "myapp") is not None
+
+    # 2. operator reconciles the Application CR: setup job, then deployer
+    operator = Operator(api)
+    operator.reconcile_once()
+    jobs = api.list("Job", ns, label_selector={"app": "langstream-tpu-setup"})
+    assert len(jobs) == 1, "setup job must exist after first reconcile"
+    jobs[0]["status"] = {"succeeded": 1}
+    api.update_status(jobs[0])
+    operator.reconcile_once()
+    deployers = api.list(
+        "Job", ns, label_selector={"app": "langstream-tpu-deployer"}
+    )
+    assert len(deployers) == 1
+
+    # 3. the deployer job's in-cluster half: plan the app and write Agent
+    # CRs + per-agent config Secrets (RuntimeDeployer role)
+    app = build_application_from_files({"pipeline.yaml": pipeline_yaml})
+    plan = ApplicationDeployer().create_implementation("myapp", app)
+    runtime = KubernetesClusterRuntime(api, image="img:1")
+    crs = runtime.deploy("t1", plan)
+    assert len(crs) == 1
+    agent_name = crs[0].name
+    deployers[0]["status"] = {"succeeded": 1}
+    api.update_status(deployers[0])
+
+    # 4. operator turns Agent CRs into StatefulSet + headless Service
+    statuses = operator.reconcile_once()
+    assert statuses[f"app/myapp"] == "DEPLOYED"
+    sts_list = api.list("StatefulSet", ns)
+    assert len(sts_list) == 1
+    sts = sts_list[0]
+    assert sts["spec"]["replicas"] == 1
+    assert api.list("Service", ns), "headless service must exist"
+
+    # 5. pod-config: the agent Secret carries a complete
+    # RuntimePodConfiguration for the pod entrypoint
+    secret = api.get("Secret", ns, f"{agent_name}-config")
+    assert secret is not None
+    pod_config = json.loads(base64.b64decode(secret["data"]["config"]))
+    assert pod_config["applicationId"] == "myapp"
+    assert pod_config["input"]["topic"] == "input-topic"
+    assert pod_config["output"]["topic"] == "output-topic"
+
+    # 6. STS readiness flows back into the Agent CR status
+    sts["status"] = {"readyReplicas": 1, "replicas": 1}
+    api.update_status(sts)
+    operator.reconcile_once()
+    agent_cr = api.get("Agent", ns, agent_name)
+    assert agent_cr["status"]["status"] == "DEPLOYED"
+
+    # 7. teardown: delete the agents and the application
+    runtime.delete("t1", plan)
+    operator.reconcile_once()
+    assert api.list("StatefulSet", ns) == []
+    assert api.get("Secret", ns, f"{agent_name}-config") is None
+    store.delete_application("t1", "myapp")
+    assert store.get_application("t1", "myapp") is None
+    store.delete_tenant("t1")
+    assert api.get("Namespace", None, ns) is None
+
+
+def test_operator_watch_mode_reconciles_without_waiting_for_poll(api, server):
+    """Watch-triggered reconcile: with a long poll interval, a fresh CR
+    still gets its StatefulSet promptly because the watch stream wakes the
+    loop (informer semantics; poll stays as the resync backstop)."""
+    import asyncio
+
+    from langstream_tpu.k8s.crds import AgentCustomResource, AgentSpec
+    from langstream_tpu.k8s.operator import Operator
+    from langstream_tpu.k8s.stores import KubernetesApplicationStore
+
+    _apply_rendered(api, "01-crds.yaml")
+    api.apply({"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": "langstream-system"}})
+    store = KubernetesApplicationStore(api)
+    store.put_tenant("t2")
+    ns = "langstream-t2"
+
+    async def main():
+        operator = Operator(api, interval=60.0, watch=True)
+        task = asyncio.ensure_future(operator.run())
+        await asyncio.sleep(0.5)  # first reconcile + watchers attach
+        cr = AgentCustomResource(
+            name="ag1", namespace=ns,
+            spec=AgentSpec(agent_id="ag1", application_id="app",
+                           tenant="t2"),
+        )
+        api.apply(cr.to_dict())
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if api.list("StatefulSet", ns):
+                break
+            await asyncio.sleep(0.2)
+        operator.stop()
+        await asyncio.wait_for(task, timeout=10)
+        assert api.list("StatefulSet", ns), (
+            "watch wake-up should reconcile long before the 60s poll"
+        )
+
+    asyncio.run(main())

@@ -242,6 +242,9 @@ def _helm_template(doc_yaml: str) -> str:
     positions that were already plain strings."""
     out = doc_yaml.replace(f"namespace: {NAMESPACE}", "namespace: {{ .Release.Namespace }}")
     out = out.replace(f"image: {IMAGE}", "image: {{ .Values.image }}")
+    # the control plane stamps LS_RUNTIME_IMAGE into every Agent CR — it
+    # must follow .Values.image too, or agent pods pull the default image
+    out = out.replace(f"value: {IMAGE}", "value: {{ .Values.image | quote }}")
     out = out.replace("value: v5e", "value: {{ .Values.accelerator | quote }}")
     return out
 
