@@ -197,6 +197,13 @@ class AgentResourcesFactory:
                 "name": "LS_POD_NAME",
                 "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
             },
+            # total logical replicas of this agent: runtimes with static
+            # partition assignment (wire kafka) split partitions on
+            # (ordinal, this) when the runner config doesn't already say
+            {
+                "name": "LS_NUM_REPLICAS",
+                "value": str(max(1, spec.resources.parallelism)),
+            },
         ]
         resources: dict[str, Any] = {
             "requests": {

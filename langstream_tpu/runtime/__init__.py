@@ -5,11 +5,14 @@ Importing this package registers the built-in streaming runtimes with
 
 - ``memory`` — the first-party in-process partitioned broker (the role the
   embedded Kafka plays in the reference's ``langstream docker run`` tester).
-- ``kafka`` — only when a Kafka client library is importable (none is baked
-  into this image; the implementation is gated, not stubbed).
-- ``pulsar`` — likewise gated on the ``pulsar`` client library
+- ``kafka`` — the SDK-backed runtime when ``confluent_kafka`` is
+  importable (dynamic consumer groups); otherwise the in-tree WIRE
+  runtime (``runtime/kafka_wire.py`` speaks the protocol itself —
+  record batches v2, produce/fetch/offsets — with static partition
+  assignment; same contiguous-commit semantics either way).
+- ``pulsar`` — gated on the ``pulsar`` client library
   (``runtime/pulsar_broker.py``; semantics unit-tested against a fake
-  client, same strategy as kafka).
+  client).
 """
 
 from langstream_tpu.api.topics import TopicConnectionsRuntimeRegistry
@@ -22,14 +25,16 @@ TopicConnectionsRuntimeRegistry.register("memory", MemoryTopicConnectionsRuntime
 # answer to the reference's external Kafka cluster.
 from langstream_tpu.runtime.tsb import TsbTopicConnectionsRuntime  # noqa: E402,F401
 
-try:  # pragma: no cover - kafka client not in the image
-    import confluent_kafka  # noqa: F401
+# ``type: kafka`` always registers: the selector picks the backend per the
+# ``client`` config key (wire|sdk|auto — auto prefers confluent_kafka when
+# importable, else the in-tree wire protocol).
+from langstream_tpu.runtime.kafka_wire_runtime import (  # noqa: E402
+    KafkaTopicConnectionsRuntimeSelector,
+)
 
-    from langstream_tpu.runtime.kafka_broker import KafkaTopicConnectionsRuntime
-
-    TopicConnectionsRuntimeRegistry.register("kafka", KafkaTopicConnectionsRuntime)
-except ImportError:
-    pass
+TopicConnectionsRuntimeRegistry.register(
+    "kafka", KafkaTopicConnectionsRuntimeSelector
+)
 
 try:  # pragma: no cover - pulsar client not in the image
     import pulsar  # noqa: F401

@@ -186,6 +186,23 @@ def record_headers_to_kafka(record: Record) -> list[tuple[str, bytes]]:
     return out
 
 
+def record_wire_payload(
+    record: Record,
+) -> tuple[bytes | None, bytes | None, list[tuple[str, bytes]]]:
+    """(key, value, headers) in the on-wire form BOTH kafka lanes share —
+    serializer inference plus the kind headers that make deserialization
+    reversible. One implementation so the SDK and wire runtimes can never
+    diverge on the format of the same ``type: kafka`` topic."""
+    value, vkind = serialize_datum_kind(record.value)
+    key, kkind = serialize_datum_kind(record.key)
+    headers = record_headers_to_kafka(record)
+    if vkind:
+        headers.append((VALUE_KIND_HEADER, vkind.encode()))
+    if kkind:
+        headers.append((KEY_KIND_HEADER, kkind.encode()))
+    return key, value, headers
+
+
 def kafka_message_to_record(msg: Any) -> Record:
     raw_headers = list(msg.headers() or [])
     kinds = {k: v for k, v in raw_headers if k in _KIND_HEADERS}
@@ -396,13 +413,7 @@ class KafkaTopicProducer(TopicProducer):
             else:
                 loop.call_soon_threadsafe(done.set_result, None)
 
-        value, vkind = serialize_datum_kind(record.value)
-        key, kkind = serialize_datum_kind(record.key)
-        headers = record_headers_to_kafka(record)
-        if vkind:
-            headers.append((VALUE_KIND_HEADER, vkind.encode()))
-        if kkind:
-            headers.append((KEY_KIND_HEADER, kkind.encode()))
+        key, value, headers = record_wire_payload(record)
         self._producer.produce(
             self.topic,
             value=value,

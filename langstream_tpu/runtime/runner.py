@@ -200,7 +200,16 @@ class AgentRunner:
 
         if node.input is not None:
             consumer = self.topics_runtime.create_consumer(
-                self.agent_id, {"topic": node.input.topic, "group": self.agent_id}
+                self.agent_id,
+                {
+                    "topic": node.input.topic,
+                    "group": self.agent_id,
+                    # replica identity: runtimes with static partition
+                    # assignment (wire kafka) split partitions on these;
+                    # group-rebalance runtimes ignore them
+                    "replica-index": self.replica,
+                    "num-replicas": max(1, node.resources.parallelism),
+                },
             )
             if node.input.deadletter_enabled:
                 self.deadletter_producer = (

@@ -1,0 +1,446 @@
+"""A fake Kafka broker speaking the server side of the wire protocol.
+
+The role the reference's Kafka testcontainer plays
+(``AbstractKafkaApplicationRunner.java:48-51``) — no broker binaries exist
+in this image, so the client in ``runtime/kafka_wire.py`` is proven against
+this server instead. The request parsing and the record-batch decoding are
+written INDEPENDENTLY here (own field-by-field parsing, own CRC check over
+the wire bytes), so a client-side encoding bug surfaces as a server-side
+parse/CRC failure rather than a self-consistent round-trip.
+
+Single-node cluster (node id 0 = this server); supports the same
+non-flexible API versions the client speaks: ApiVersions(0) Metadata(1)
+Produce(3) Fetch(4) ListOffsets(1) FindCoordinator(1) OffsetCommit(2)
+OffsetFetch(1) CreateTopics(1) DeleteTopics(1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+from dataclasses import dataclass, field
+
+from langstream_tpu.runtime.kafka_wire import (
+    API_API_VERSIONS,
+    API_CREATE_TOPICS,
+    API_DELETE_TOPICS,
+    API_FETCH,
+    API_FIND_COORDINATOR,
+    API_LIST_OFFSETS,
+    API_METADATA,
+    API_OFFSET_COMMIT,
+    API_OFFSET_FETCH,
+    API_PRODUCE,
+    ERR_NONE,
+    ERR_OFFSET_OUT_OF_RANGE,
+    ERR_TOPIC_ALREADY_EXISTS,
+    ERR_UNKNOWN_TOPIC_OR_PARTITION,
+    Reader,
+    Writer,
+    crc32c,
+)
+
+
+@dataclass
+class _StoredRecord:
+    offset: int
+    timestamp: int
+    key: bytes | None
+    value: bytes | None
+    headers: list[tuple[str, bytes | None]]
+
+
+@dataclass
+class _Partition:
+    records: list[_StoredRecord] = field(default_factory=list)
+
+    @property
+    def log_end(self) -> int:
+        return self.records[-1].offset + 1 if self.records else 0
+
+
+class FakeKafkaBroker:
+    def __init__(self) -> None:
+        self.topics: dict[str, dict[int, _Partition]] = {}
+        self.offsets: dict[tuple[str, str, int], int] = {}
+        self.requests: list[tuple[int, int]] = []  # (api_key, version) seen
+        self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self.host = "127.0.0.1"
+        self.port = 0
+
+    # -- lifecycle (runs its own loop thread so tests can drive a client
+    #    loop independently) ----------------------------------------------
+
+    def start(self) -> "FakeKafkaBroker":
+        started = threading.Event()
+
+        def _run() -> None:
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _serve():
+                self._server = await asyncio.start_server(
+                    self._client, self.host, 0
+                )
+                self.port = self._server.sockets[0].getsockname()[1]
+                started.set()
+
+            self._loop.run_until_complete(_serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        started.wait(10)
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(5)
+            self._loop = None
+
+    def __enter__(self) -> "FakeKafkaBroker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- independent server-side record-batch codec ------------------------
+
+    @staticmethod
+    def _parse_batches(data: bytes) -> list[tuple[int, bytes | None, bytes | None, list]]:
+        """Own parser: header field by field, CRC verified over the raw
+        bytes following the crc field."""
+        out = []
+        pos = 0
+        while pos + 61 <= len(data):
+            (base_offset,) = struct.unpack_from(">q", data, pos)
+            (batch_len,) = struct.unpack_from(">i", data, pos + 8)
+            body = data[pos + 12 : pos + 12 + batch_len]
+            pos += 12 + batch_len
+            magic = body[4]
+            assert magic == 2, f"client must send magic 2, got {magic}"
+            (crc,) = struct.unpack_from(">I", body, 5)
+            assert crc32c(body[9:]) == crc, "client batch CRC invalid"
+            r = Reader(body, 9)
+            attributes = r.i16()
+            assert attributes & 0x07 == 0, "unexpected compression"
+            r.i32()                       # lastOffsetDelta
+            base_ts = r.i64()
+            r.i64(); r.i64(); r.i16(); r.i32()
+            count = r.i32()
+            for _ in range(count):
+                length = r.varint()
+                rec = Reader(r.raw(length))
+                rec.i8()
+                ts_delta = rec.varint()
+                offset_delta = rec.varint()
+                klen = rec.varint()
+                key = rec.raw(klen) if klen >= 0 else None
+                vlen = rec.varint()
+                value = rec.raw(vlen) if vlen >= 0 else None
+                headers = []
+                for _h in range(rec.varint()):
+                    hklen = rec.varint()
+                    hk = rec.raw(hklen).decode()
+                    hvlen = rec.varint()
+                    hv = rec.raw(hvlen) if hvlen >= 0 else None
+                    headers.append((hk, hv))
+                out.append((base_ts + ts_delta, key, value, headers))
+        return out
+
+    @staticmethod
+    def _encode_batch(records: list[_StoredRecord]) -> bytes:
+        """Own encoder for fetch responses (one batch per contiguous run)."""
+        if not records:
+            return b""
+        base = records[0].offset
+        base_ts = records[0].timestamp
+        body = Writer()
+        for rec in records:
+            r = Writer()
+            r.i8(0)
+            r.varint(rec.timestamp - base_ts)
+            r.varint(rec.offset - base)
+            r.varint(-1 if rec.key is None else len(rec.key))
+            if rec.key is not None:
+                r.raw(rec.key)
+            r.varint(-1 if rec.value is None else len(rec.value))
+            if rec.value is not None:
+                r.raw(rec.value)
+            r.varint(len(rec.headers))
+            for hk, hv in rec.headers:
+                hkb = hk.encode()
+                r.varint(len(hkb))
+                r.raw(hkb)
+                r.varint(-1 if hv is None else len(hv))
+                if hv is not None:
+                    r.raw(hv)
+            encoded = r.done()
+            body.varint(len(encoded)).raw(encoded)
+        crc_part = (
+            Writer()
+            .i16(0)
+            .i32(records[-1].offset - base)
+            .i64(base_ts)
+            .i64(records[-1].timestamp)
+            .i64(-1).i16(-1).i32(-1)
+            .i32(len(records))
+            .raw(body.done())
+            .done()
+        )
+        return (
+            Writer()
+            .i64(base)
+            .i32(4 + 1 + 4 + len(crc_part))
+            .i32(-1)
+            .i8(2)
+            .u32(crc32c(crc_part))
+            .raw(crc_part)
+            .done()
+        )
+
+    # -- request handling --------------------------------------------------
+
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                size_raw = await reader.readexactly(4)
+                (size,) = struct.unpack(">i", size_raw)
+                frame = await reader.readexactly(size)
+                r = Reader(frame)
+                api_key = r.i16()
+                version = r.i16()
+                correlation = r.i32()
+                r.string()  # client id
+                self.requests.append((api_key, version))
+                payload = self._dispatch(api_key, version, r)
+                body = Writer().i32(correlation).raw(payload).done()
+                writer.write(struct.pack(">i", len(body)) + body)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _dispatch(self, api_key: int, version: int, r: Reader) -> bytes:
+        if api_key == API_API_VERSIONS:
+            w = Writer().i16(ERR_NONE)
+            keys = [
+                (API_PRODUCE, 0, 3), (API_FETCH, 0, 4),
+                (API_LIST_OFFSETS, 0, 1), (API_METADATA, 0, 1),
+                (API_OFFSET_COMMIT, 0, 2), (API_OFFSET_FETCH, 0, 1),
+                (API_FIND_COORDINATOR, 0, 1), (API_API_VERSIONS, 0, 0),
+                (API_CREATE_TOPICS, 0, 1), (API_DELETE_TOPICS, 0, 1),
+            ]
+            w.i32(len(keys))
+            for k, lo, hi in keys:
+                w.i16(k).i16(lo).i16(hi)
+            return w.done()
+
+        if api_key == API_METADATA:
+            assert version == 1
+            n = r.i32()
+            wanted = [r.string() for _ in range(n)] if n >= 0 else None
+            w = Writer()
+            w.i32(1).i32(0).string(self.host).i32(self.port).string(None)
+            w.i32(0)  # controller id
+            names = sorted(self.topics) if wanted is None else wanted
+            w.i32(len(names))
+            for name in names:
+                parts = self.topics.get(name)
+                w.i16(ERR_NONE if parts is not None
+                      else ERR_UNKNOWN_TOPIC_OR_PARTITION)
+                w.string(name)
+                w.raw(b"\x00")  # is_internal
+                if parts is None:
+                    w.i32(0)
+                    continue
+                w.i32(len(parts))
+                for pid in sorted(parts):
+                    w.i16(ERR_NONE).i32(pid).i32(0)
+                    w.i32(1).i32(0)   # replicas [0]
+                    w.i32(1).i32(0)   # isr [0]
+            return w.done()
+
+        if api_key == API_PRODUCE:
+            assert version == 3
+            r.string()               # transactional id
+            r.i16()                  # acks
+            r.i32()                  # timeout
+            w_topics = Writer()
+            topic_count = r.i32()
+            w_topics.i32(topic_count)
+            for _ in range(topic_count):
+                topic = r.string()
+                w_topics.string(topic)
+                part_count = r.i32()
+                w_topics.i32(part_count)
+                for _p in range(part_count):
+                    partition = r.i32()
+                    record_set = r.bytes_() or b""
+                    part = self.topics.get(topic, {}).get(partition)
+                    if part is None:
+                        w_topics.i32(partition).i16(
+                            ERR_UNKNOWN_TOPIC_OR_PARTITION
+                        ).i64(-1).i64(-1)
+                        continue
+                    base = part.log_end
+                    for i, (ts, key, value, headers) in enumerate(
+                        self._parse_batches(record_set)
+                    ):
+                        part.records.append(_StoredRecord(
+                            offset=base + i, timestamp=ts, key=key,
+                            value=value, headers=headers,
+                        ))
+                    w_topics.i32(partition).i16(ERR_NONE).i64(base).i64(-1)
+            return w_topics.done()
+
+        if api_key == API_FETCH:
+            assert version == 4
+            r.i32(); r.i32(); r.i32(); r.i32(); r.i8()
+            topic_count = r.i32()
+            w = Writer().i32(0)      # throttle
+            w.i32(topic_count)
+            for _ in range(topic_count):
+                topic = r.string()
+                w.string(topic)
+                part_count = r.i32()
+                w.i32(part_count)
+                for _p in range(part_count):
+                    partition = r.i32()
+                    fetch_offset = r.i64()
+                    r.i32()          # partition max bytes
+                    part = self.topics.get(topic, {}).get(partition)
+                    if part is None:
+                        w.i32(partition).i16(ERR_UNKNOWN_TOPIC_OR_PARTITION)
+                        w.i64(-1).i64(-1).i32(0).bytes_(b"")
+                        continue
+                    if fetch_offset > part.log_end:
+                        w.i32(partition).i16(ERR_OFFSET_OUT_OF_RANGE)
+                        w.i64(part.log_end).i64(part.log_end).i32(0).bytes_(b"")
+                        continue
+                    pending = [
+                        rec for rec in part.records if rec.offset >= fetch_offset
+                    ]
+                    w.i32(partition).i16(ERR_NONE)
+                    w.i64(part.log_end).i64(part.log_end)
+                    w.i32(0)         # aborted transactions
+                    w.bytes_(self._encode_batch(pending))
+            return w.done()
+
+        if api_key == API_LIST_OFFSETS:
+            assert version == 1
+            r.i32()
+            topic_count = r.i32()
+            w = Writer().i32(topic_count)
+            for _ in range(topic_count):
+                topic = r.string()
+                w.string(topic)
+                part_count = r.i32()
+                w.i32(part_count)
+                for _p in range(part_count):
+                    partition = r.i32()
+                    ts = r.i64()
+                    part = self.topics.get(topic, {}).get(partition)
+                    if part is None:
+                        w.i32(partition).i16(ERR_UNKNOWN_TOPIC_OR_PARTITION)
+                        w.i64(-1).i64(-1)
+                        continue
+                    first = part.records[0].offset if part.records else 0
+                    offset = first if ts == -2 else part.log_end
+                    w.i32(partition).i16(ERR_NONE).i64(-1).i64(offset)
+            return w.done()
+
+        if api_key == API_FIND_COORDINATOR:
+            assert version == 1
+            r.string()               # group
+            r.i8()                   # type
+            return (
+                Writer().i32(0).i16(ERR_NONE).string(None)
+                .i32(0).string(self.host).i32(self.port).done()
+            )
+
+        if api_key == API_OFFSET_COMMIT:
+            assert version == 2
+            group = r.string()
+            generation = r.i32()
+            member = r.string()
+            r.i64()                  # retention
+            assert generation == -1 and member == "", (
+                "client must use simple-consumer commits"
+            )
+            topic_count = r.i32()
+            w = Writer().i32(topic_count)
+            for _ in range(topic_count):
+                topic = r.string()
+                w.string(topic)
+                part_count = r.i32()
+                w.i32(part_count)
+                for _p in range(part_count):
+                    partition = r.i32()
+                    offset = r.i64()
+                    r.string()       # metadata
+                    self.offsets[(group, topic, partition)] = offset
+                    w.i32(partition).i16(ERR_NONE)
+            return w.done()
+
+        if api_key == API_OFFSET_FETCH:
+            assert version == 1
+            group = r.string()
+            topic_count = r.i32()
+            w = Writer().i32(topic_count)
+            for _ in range(topic_count):
+                topic = r.string()
+                w.string(topic)
+                part_count = r.i32()
+                w.i32(part_count)
+                for _p in range(part_count):
+                    partition = r.i32()
+                    offset = self.offsets.get((group, topic, partition), -1)
+                    w.i32(partition).i64(offset).string(None).i16(ERR_NONE)
+            return w.done()
+
+        if api_key == API_CREATE_TOPICS:
+            assert version == 1
+            topic_count = r.i32()
+            results = []
+            for _ in range(topic_count):
+                topic = r.string()
+                partitions = r.i32()
+                r.i16()              # replication
+                for _a in range(r.i32()):
+                    r.i32()
+                    r.array(lambda rr: rr.i32())
+                for _c in range(r.i32()):
+                    r.string(); r.string()
+                if topic in self.topics:
+                    results.append((topic, ERR_TOPIC_ALREADY_EXISTS))
+                else:
+                    self.topics[topic] = {
+                        p: _Partition() for p in range(max(partitions, 1))
+                    }
+                    results.append((topic, ERR_NONE))
+            r.i32()                  # timeout
+            r.i8()                   # validate_only
+            w = Writer().i32(len(results))
+            for topic, err in results:
+                w.string(topic).i16(err).string(None)
+            return w.done()
+
+        if api_key == API_DELETE_TOPICS:
+            assert version == 1
+            names = r.array(lambda rr: rr.string())
+            r.i32()                  # timeout
+            w = Writer().i32(0).i32(len(names))
+            for name in names:
+                err = ERR_NONE if self.topics.pop(name, None) is not None \
+                    else ERR_UNKNOWN_TOPIC_OR_PARTITION
+                w.string(name).i16(err)
+            return w.done()
+
+        raise AssertionError(f"unsupported api key {api_key} v{version}")
