@@ -11,7 +11,10 @@ isolate each suspect:
 - greedy-only sampler         → top-k lax.top_k cost
 - K sweep (8..96)             → per-chunk fixed cost vs per-step cost
 
-Usage: python tools/decode_microbench.py [--iters 5]
+Usage: python tools/decode_microbench.py [--iters 5] [--model MODEL]
+``--model`` picks the shape: ``llama-1b`` (default, the round-2/3 bench
+shape above), ``llama3-8b`` (the round-4 headline shape, same sweep), or
+``tiny`` (a CPU smoke of the tool itself — tiny shapes, xla kernels only).
 Prints one JSON line per variant: {"name", "step_ms", "chunk_ms"}.
 """
 
@@ -153,34 +156,59 @@ def main():
     ap.add_argument(
         "--phase", choices=["decode", "continuation", "all"], default="all"
     )
+    ap.add_argument(
+        "--model", choices=["llama-1b", "llama3-8b", "tiny"],
+        default="llama-1b",
+        help="tiny = CPU smoke of the tool itself; 8B = the r4 headline shape",
+    )
     args = ap.parse_args()
-    mc = LlamaConfig.llama_1b(max_seq_len=1024)
+    # full-size sweep shapes (identical for 1b and 8B so the ablation
+    # columns stay comparable across model sizes); tiny overrides all
+    B, K, W = 64, 96, 512
+    windows, batches, ksteps = (128, 256, 1024), (8, 16, 32), (8, 32)
+    if args.model == "tiny":
+        mc = LlamaConfig.tiny(max_seq_len=256)
+        B, K, W = 4, 8, 128
+        windows, batches, ksteps = (128,), (2,), (4,)
+    elif args.model == "llama3-8b":
+        mc = LlamaConfig.llama3_8b(max_seq_len=1024)
+    else:
+        mc = LlamaConfig.llama_1b(max_seq_len=1024)
 
     if args.phase in ("decode", "all"):
         # bench shape baseline
-        measure("baseline-int8", mc, 64, 96, 512, "int8", "full", args.iters)
-        measure("bf16", mc, 64, 96, 512, None, "full", args.iters)
-        measure("greedy-sampler", mc, 64, 96, 512, "int8", "greedy", args.iters)
-        for w in (128, 256, 1024):
-            measure(f"window-{w}", mc, 64, 96, w, "int8", "full", args.iters)
-        for b in (8, 16, 32):
-            measure(f"batch-{b}", mc, b, 96, 512, "int8", "full", args.iters)
-        for k in (8, 32):
-            measure(f"ksteps-{k}", mc, 64, k, 512, "int8", "full", args.iters)
+        measure("baseline-int8", mc, B, K, W, "int8", "full", args.iters)
+        measure("bf16", mc, B, K, W, None, "full", args.iters)
+        measure("greedy-sampler", mc, B, K, W, "int8", "greedy", args.iters)
+        for w in windows:
+            measure(f"window-{w}", mc, B, K, w, "int8", "full", args.iters)
+        for b in batches:
+            measure(f"batch-{b}", mc, b, K, W, "int8", "full", args.iters)
+        for k in ksteps:
+            measure(f"ksteps-{k}", mc, B, k, W, "int8", "full", args.iters)
 
     if args.phase in ("continuation", "all"):
+        kernels = ("xla",) if args.model == "tiny" else ("xla", "pallas")
+        # prior-round comparability: the full-size cont-hit shape stays
+        # 512-prefix/64-suffix exactly as rounds 2-3 recorded it
+        prefix, chunk, hit_suffix = (
+            (64, 16, 16) if args.model == "tiny" else (512, 512, 64)
+        )
         # prefix-cache hit: long cached prefix, short question suffix
-        for kern in ("xla", "pallas"):
+        for kern in kernels:
             measure_continuation(
-                f"cont-hit-{kern}", mc, 16, 512, 64, "int8", kern, args.iters
+                f"cont-hit-{kern}", mc, min(B, 16), prefix, hit_suffix,
+                "int8", kern, args.iters,
             )
             # chunked-prefill chunk: mid prompt, full-width chunk
             measure_continuation(
-                f"cont-chunk-{kern}", mc, 8, 512, 512, "int8", kern, args.iters
+                f"cont-chunk-{kern}", mc, min(B, 8), prefix, chunk, "int8",
+                kern, args.iters,
             )
             # speculative verify shape: D1 = 5
             measure_continuation(
-                f"verify-d5-{kern}", mc, 64, 512, 8, "int8", kern, args.iters
+                f"verify-d5-{kern}", mc, B, prefix, 8, "int8", kern,
+                args.iters,
             )
 
 
