@@ -681,6 +681,43 @@ def test_stop_sequences_truncate_and_free_slot(run_async):
     run_async(main())
 
 
+def test_long_context_pow2_window_lane(run_async):
+    """Long-context serving: beyond 1024 rows the attention window buckets
+    switch from 128-multiples to powers of two (engine._window_for) — a
+    long prompt must prefill, decode through the pow2 lane, and produce
+    the same stream as a fresh engine (determinism across bucket growth)."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        cfg = ServingConfig(
+            model="tiny", slots=2, max_seq_len=4096, decode_chunk=8
+        )
+        engine = TpuServingEngine(cfg)
+        # window bucketing: 128-multiples below 1024, pow2 above
+        assert engine._window_for(900) == 1024
+        assert engine._window_for(1100) == 2048
+        assert engine._window_for(3000) is None  # full length
+        # prompt lands just under the 1024 boundary; 48 decoded tokens
+        # carry the sequence across it, so decode re-dispatches under the
+        # grown 2048 pow2 bucket MID-GENERATION — the transition the pow2
+        # lane exists for
+        prompt = "tpu. " * 204  # ~1021 byte-tokens with BOS
+        r = await engine.generate(prompt, {"max-tokens": 48, "temperature": 0})
+        assert 960 < r["num_prompt_tokens"] <= 1024
+        assert r["num_prompt_tokens"] + len(r["tokens"]) > 1024
+        assert len(r["tokens"]) == 48
+        windows = {key[1] for key in engine._decode_chunk_fns}
+        assert {1024, 2048} <= windows, sorted(windows)
+        await engine.close()
+
+        engine2 = TpuServingEngine(cfg)
+        r2 = await engine2.generate(prompt, {"max-tokens": 48, "temperature": 0})
+        assert r2["tokens"] == r["tokens"]
+        await engine2.close()
+
+    run_async(main())
+
+
 def test_stop_window_covers_multibyte_stop_strings(run_async):
     """Regression (r3 advisor, medium): the per-token stop-detection window
     must be sized from the stop string's encoded BYTE length — under the
