@@ -386,3 +386,50 @@ def test_client_selection_knob(broker):
         KafkaTopicConnectionsRuntimeSelector().init({**base, "client": "sdk"})
     with pytest.raises(ValueError, match="not supported"):
         KafkaTopicConnectionsRuntimeSelector().init({**base, "client": "zzz"})
+
+
+def test_conn_redials_after_broker_drops_idle_connection():
+    """Brokers close idle connections (connections.max.idle.ms): a dead
+    socket must fail the in-flight call but never poison the connection —
+    the next call redials and succeeds."""
+    from langstream_tpu.runtime.kafka_wire import API_API_VERSIONS, _Conn
+
+    async def main():
+        calls = {"n": 0}
+
+        async def serve(reader, writer):
+            # serve exactly one request per connection, then slam it shut
+            import struct as _s
+
+            try:
+                (size,) = _s.unpack(">i", await reader.readexactly(4))
+                frame = await reader.readexactly(size)
+                r = Reader(frame)
+                r.i16(); r.i16()
+                cid = r.i32()
+                calls["n"] += 1
+                body = Writer().i32(cid).i16(0).i32(0).done()
+                writer.write(_s.pack(">i", len(body)) + body)
+                await writer.drain()
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        conn = _Conn("127.0.0.1", port, "t")
+        r1 = await conn.call(API_API_VERSIONS, 0, b"")
+        assert r1.i16() == 0
+        # the server closed the socket after responding; this call hits the
+        # dead connection, fails, AND drops the writer
+        with pytest.raises((OSError, asyncio.IncompleteReadError, ConnectionError)):
+            await conn.call(API_API_VERSIONS, 0, b"")
+        assert conn._writer is None  # poisoned socket was dropped
+        # redial transparently
+        r3 = await conn.call(API_API_VERSIONS, 0, b"")
+        assert r3.i16() == 0
+        assert calls["n"] >= 2
+        await conn.close()
+        server.close()
+        await server.wait_closed()
+
+    _run(main())
