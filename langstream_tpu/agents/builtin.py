@@ -9,12 +9,12 @@ from __future__ import annotations
 
 from langstream_tpu.api.agent import ComponentType
 from langstream_tpu.api.registry import AgentCodeProvider, AgentCodeRegistry
-from langstream_tpu.core.planner import register_agent_type
+from langstream_tpu.core.planner import register_agent_type, register_config_validator
 
 from langstream_tpu.agents import transform, text, flow, ai, vector, http, storage
 from langstream_tpu.agents import jdbc, opensearch  # noqa: F401  (asset managers)
 from langstream_tpu.agents import astra, milvus, solr  # noqa: F401  (asset managers)
-from langstream_tpu.agents import connect, python_custom, webcrawler
+from langstream_tpu.agents import camel, connect, python_custom, webcrawler
 
 SOURCE = ComponentType.SOURCE
 PROCESSOR = ComponentType.PROCESSOR
@@ -55,6 +55,7 @@ _FACTORIES = {
     "http-request": http.HttpRequestAgent,
     "langserve-invoke": http.LangServeInvokeAgent,
     # sources
+    "camel-source": camel.CamelSource,
     "webcrawler": webcrawler.WebCrawlerSource,
     "local-storage-source": storage.LocalStorageSource,
     "s3-source": storage.make_s3_source,
@@ -109,6 +110,7 @@ _FACTORIES.update(
 _METADATA = {
     # component type, composable
     "timer-source": (SOURCE, True),
+    "camel-source": (SOURCE, True),
     "webcrawler": (SOURCE, True),
     "local-storage-source": (SOURCE, True),
     "s3-source": (SOURCE, True),
@@ -133,3 +135,7 @@ AgentCodeRegistry.register_provider(
 for name in _FACTORIES:
     component_type, composable = _METADATA.get(name, (PROCESSOR, True))
     register_agent_type(name, component_type, composable)
+
+# planning-time config validation (unsupported camel schemes fail in the
+# planner with the descope rationale, not at pod start)
+register_config_validator("camel-source", camel.validate_camel_config)

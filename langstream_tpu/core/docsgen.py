@@ -119,6 +119,12 @@ CONFIG_DOCS: dict[str, dict[str, str]] = {
         "output-field": "field receiving the response",
         "allow-redirects": "follow redirects",
     },
+    "camel-source": {
+        "component-uri": "Camel component URI — native subset: timer:, file:",
+        "component-options": "map merged into the URI query string",
+        "key-header": "message header used as the record key",
+        "max-buffered-records": "bounded exchange buffer (default 100)",
+    },
     "webcrawler": {
         "seed-urls": "crawl entry points",
         "allowed-domains": "domain allowlist",

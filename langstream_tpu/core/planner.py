@@ -13,6 +13,7 @@ joined by implicit topics.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Callable
 
 from langstream_tpu.api.agent import ComponentType
 from langstream_tpu.api.application import (
@@ -62,17 +63,20 @@ class PlanningError(ValueError):
 # Agent types the framework deliberately does not carry, with the reason and
 # the supported alternative — using one fails AT PLANNING TIME with a clear
 # message instead of at pod start with a confusing import error. (r3 verdict
-# missing #2: camel had no counterpart and no planner-visible descope.)
-DESCOPED_AGENT_TYPES: dict[str, str] = {
-    "camel-source": (
-        "camel-source embeds Apache Camel's JVM connector ecosystem "
-        "(reference: langstream-agent-camel/.../CamelSource.java) and has no "
-        "Python counterpart here (deliberate descope, see README). Use the "
-        "Connect-style 'source' bridge agent, the 'webcrawler'/'s3-source'/"
-        "'azure-blob-storage-source' sources, 'http-request', or a custom "
-        "'python-source'."
-    ),
-}
+# missing #2. camel-source has since graduated from this table to a native
+# timer:/file: subset — agents/camel.py — whose unsupported schemes still
+# fail at planning via its registered config validator below.)
+DESCOPED_AGENT_TYPES: dict[str, str] = {}
+
+# Per-type configuration validators, run at planning time (parity: the
+# reference validates agent configs in the planner-side agent providers,
+# langstream-k8s-runtime/.../k8s/agents/*.java, not in the pod). A validator
+# raises ValueError; the planner wraps it with the agent/pipeline context.
+AGENT_CONFIG_VALIDATORS: dict[str, Callable[[dict], None]] = {}
+
+
+def register_config_validator(agent_type: str, validator: Callable[[dict], None]):
+    AGENT_CONFIG_VALIDATORS[agent_type] = validator
 
 
 class Planner:
@@ -116,6 +120,21 @@ class Planner:
                     f"agent {agent.id!r} in pipeline {pipeline.id!r}: "
                     f"{DESCOPED_AGENT_TYPES[agent.type]}"
                 )
+            validator = AGENT_CONFIG_VALIDATORS.get(agent.type)
+            if validator is not None:
+                try:
+                    validator(agent.configuration)
+                except PlanningError:
+                    raise
+                except Exception as e:
+                    # any validator crash IS a planning failure — wrap it so
+                    # the user always gets the agent/pipeline context instead
+                    # of a bare traceback (e.g. a string where a map belongs
+                    # raising AttributeError inside the validator)
+                    detail = str(e) if isinstance(e, ValueError) else f"{type(e).__name__}: {e}"
+                    raise PlanningError(
+                        f"agent {agent.id!r} in pipeline {pipeline.id!r}: {detail}"
+                    ) from None
 
         # 1. group consecutive fusable agents
         groups: list[list[AgentConfiguration]] = []

@@ -1,0 +1,244 @@
+"""camel-source: the native timer:/file: subset (agents/camel.py).
+
+Contract parity with the reference CamelSource
+(langstream-agent-camel/.../CamelSource.java): component-uri +
+component-options merging, key-header, bounded buffer drained by read(),
+ack-on-commit driving the file disposition (delete / move to .camel/ /
+noop-idempotent).
+"""
+
+import asyncio
+
+import pytest
+
+from langstream_tpu.agents.camel import (
+    CamelSource,
+    merge_component_options,
+    parse_camel_uri,
+    validate_camel_config,
+)
+
+
+async def _read_some(source, n, timeout=10.0):
+    out = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    while len(out) < n:
+        assert asyncio.get_event_loop().time() < deadline, f"only got {out}"
+        out.extend(await source.read())
+    return out
+
+
+async def _with_source(config, fn):
+    source = CamelSource()
+    await source.init(config)
+    await source.start()
+    try:
+        return await fn(source)
+    finally:
+        await source.close()
+
+
+def test_uri_parse_and_option_merge():
+    uri = merge_component_options("timer:tick?period=100", {"repeatCount": 3})
+    assert uri == "timer:tick?period=100&repeatCount=3"
+    scheme, path, opts = parse_camel_uri(uri)
+    assert (scheme, path) == ("timer", "tick")
+    assert opts == {"period": "100", "repeatCount": "3"}
+    # file:///abs/path style
+    _, path, _ = parse_camel_uri("file:///var/data?delete=true")
+    assert path == "/var/data"
+
+
+def test_validate_rejects_unsupported_scheme_and_missing_uri():
+    with pytest.raises(ValueError, match="descope"):
+        validate_camel_config({"component-uri": "jms:queue:foo"})
+    with pytest.raises(ValueError, match="component-uri"):
+        validate_camel_config({})
+    validate_camel_config({"component-uri": "timer:t?period=50"})
+    validate_camel_config(
+        {"component-uri": "file:/tmp/x", "component-options": {"delete": True}}
+    )
+
+
+def test_validate_checks_option_types_at_planning_time():
+    """Bad option *values* must fail at planning, not at pod start."""
+    with pytest.raises(ValueError, match="period"):
+        validate_camel_config({"component-uri": "timer:t?period=abc"})
+    with pytest.raises(ValueError, match="regex"):
+        validate_camel_config({"component-uri": "file:/tmp/x?include=*broken["})
+    with pytest.raises(ValueError, match="max-buffered-records"):
+        validate_camel_config(
+            {"component-uri": "timer:t", "max-buffered-records": "many"}
+        )
+    # the route consumes repeatCount with int(); nan/inf/negative never sleep
+    with pytest.raises(ValueError, match="repeatCount"):
+        validate_camel_config({"component-uri": "timer:t?repeatCount=2.5"})
+    for bad in ("timer:t?period=nan", "timer:t?period=inf", "timer:t?delay=-5"):
+        with pytest.raises(ValueError):
+            validate_camel_config({"component-uri": bad})
+    # maxsize<=0 would make asyncio.Queue unbounded — rejected
+    with pytest.raises(ValueError, match="max-buffered-records"):
+        validate_camel_config(
+            {"component-uri": "timer:t", "max-buffered-records": 0}
+        )
+    with pytest.raises(ValueError, match="component-options"):
+        validate_camel_config(
+            {"component-uri": "timer:t", "component-options": "delete=true"}
+        )
+
+
+def test_route_crash_surfaces_from_read(run_async):
+    """An exception inside the route task must surface from read(), not
+    leave the source silently producing nothing forever."""
+
+    async def run():
+        source = CamelSource()
+        await source.init({"component-uri": "timer:t?period=20&delay=0"})
+        source.options["period"] = "not-a-number"  # sabotage the route
+        await source.start()
+        try:
+            with pytest.raises(ValueError):
+                for _ in range(20):
+                    await source.read()
+        finally:
+            await source.close()
+
+    run_async(run())
+
+
+def test_failed_disposition_does_not_duplicate(tmp_path, run_async):
+    """If the post-commit move fails, the record must NOT be re-emitted in a
+    hot duplicate loop — the idempotent set covers all modes."""
+    import os
+
+    (tmp_path / "once.txt").write_text("only once")
+
+    async def scenario(source):
+        (record,) = await _read_some(source, 1)
+        os.chmod(tmp_path, 0o555)  # .camel/ becomes uncreatable
+        try:
+            await source.commit([record])  # disposition fails, logged
+            await asyncio.sleep(0.15)
+            assert await source.read() == []  # no duplicate
+        finally:
+            os.chmod(tmp_path, 0o755)
+
+    run_async(
+        _with_source({"component-uri": f"file:{tmp_path}?delay=30"}, scenario)
+    )
+
+
+def test_timer_component_headers_and_repeat_count(run_async):
+    async def scenario(source):
+        records = await _read_some(source, 2)
+        assert [r.header_map()["CamelTimerCounter"] for r in records[:2]] == [1, 2]
+        assert records[0].header_map()["CamelTimerName"] == "tick"
+        assert records[0].value is None
+        assert records[0].origin.startswith("timer:tick")
+        # repeatCount=2: no third record ever arrives
+        assert await source.read() == []
+        await source.commit(records)
+        return records
+
+    run_async(
+        _with_source(
+            {"component-uri": "timer:tick?period=30&delay=0&repeatCount=2"},
+            scenario,
+        )
+    )
+
+
+def test_file_component_delete_on_commit(tmp_path, run_async):
+    (tmp_path / "a.txt").write_text("alpha")
+    (tmp_path / "b.txt").write_text("beta")
+
+    async def scenario(source):
+        records = await _read_some(source, 2)
+        by_name = {r.header_map()["CamelFileNameOnly"]: r for r in records}
+        assert by_name["a.txt"].value == "alpha"
+        assert by_name["a.txt"].key == "a.txt"  # key-header
+        assert by_name["b.txt"].header_map()["CamelFileLength"] == 4
+        # nothing deleted before commit (at-least-once)
+        assert (tmp_path / "a.txt").exists()
+        await source.commit([by_name["a.txt"]])
+        assert not (tmp_path / "a.txt").exists()
+        assert (tmp_path / "b.txt").exists()
+
+    run_async(
+        _with_source(
+            {
+                "component-uri": f"file:{tmp_path}?delete=true&delay=30",
+                "key-header": "CamelFileNameOnly",
+            },
+            scenario,
+        )
+    )
+
+
+def test_file_component_default_moves_to_camel_dir(tmp_path, run_async):
+    (tmp_path / "doc.txt").write_text("payload")
+
+    async def scenario(source):
+        (record,) = await _read_some(source, 1)
+        await source.commit([record])
+        assert not (tmp_path / "doc.txt").exists()
+        assert (tmp_path / ".camel" / "doc.txt").read_text() == "payload"
+        # the .camel/ dir is never re-crawled
+        await asyncio.sleep(0.1)
+        assert await source.read() == []
+
+    run_async(
+        _with_source({"component-uri": f"file:{tmp_path}?delay=30"}, scenario)
+    )
+
+
+def test_file_component_noop_is_idempotent(tmp_path, run_async):
+    (tmp_path / "keep.txt").write_text("stay")
+
+    async def scenario(source):
+        (record,) = await _read_some(source, 1)
+        await source.commit([record])
+        assert (tmp_path / "keep.txt").exists()  # noop leaves it in place
+        assert await source.read() == []  # and never re-emits it
+        # a rewrite (new mtime) IS re-emitted
+        await asyncio.sleep(0.05)
+        (tmp_path / "keep.txt").write_text("stay v2")
+        (again,) = await _read_some(source, 1)
+        assert again.value == "stay v2"
+
+    run_async(
+        _with_source({"component-uri": f"file:{tmp_path}?noop=true&delay=30"}, scenario)
+    )
+
+
+def test_file_component_include_filter(tmp_path, run_async):
+    (tmp_path / "in.csv").write_text("x")
+    (tmp_path / "skip.log").write_text("y")
+
+    async def scenario(source):
+        (record,) = await _read_some(source, 1)
+        assert record.header_map()["CamelFileNameOnly"] == "in.csv"
+        await asyncio.sleep(0.1)
+        assert await source.read() == []
+
+    run_async(
+        _with_source(
+            {"component-uri": f"file:{tmp_path}", "component-options": {
+                "include": r".*\.csv", "delay": 30, "noop": "true"}},
+            scenario,
+        )
+    )
+
+
+def test_permanent_failure_leaves_file(tmp_path, run_async):
+    (tmp_path / "bad.txt").write_text("poison")
+
+    async def scenario(source):
+        (record,) = await _read_some(source, 1)
+        await source.permanent_failure(record, RuntimeError("boom"))
+        await source.commit([record])  # commit after failure: no disposition
+        assert (tmp_path / "bad.txt").exists()
+
+    run_async(
+        _with_source({"component-uri": f"file:{tmp_path}?delete=true&delay=30"}, scenario)
+    )

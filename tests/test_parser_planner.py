@@ -213,11 +213,9 @@ def test_plan_undeclared_topic_fails(tmp_path):
         build_execution_plan("app", app)
 
 
-def test_camel_source_fails_at_planning_with_descope_pointer(tmp_path):
-    """`camel-source` is a deliberate descope (README): the planner must
-    say so clearly at plan time, not fail at pod start (r3 verdict #7)."""
+def _camel_app(tmp_path, uri: str):
     pipeline = textwrap.dedent(
-        """
+        f"""
         topics:
           - name: "out-t"
             creation-mode: create-if-not-exists
@@ -226,14 +224,37 @@ def test_camel_source_fails_at_planning_with_descope_pointer(tmp_path):
             type: "camel-source"
             output: "out-t"
             configuration:
-              component-uri: "timer:tick"
+              component-uri: "{uri}"
         """
     )
     (tmp_path / "p.yaml").write_text(pipeline)
-    app = build_application_from_directory(tmp_path, instance=INSTANCE)
+    return build_application_from_directory(tmp_path, instance=INSTANCE)
+
+
+def test_camel_source_unsupported_scheme_fails_at_planning(tmp_path):
+    """Camel schemes outside the native timer:/file: subset are a deliberate
+    descope (README): the planner must say so clearly at plan time, not fail
+    at pod start (r3 verdict #7 / missing #2)."""
+    app = _camel_app(tmp_path, "kafka:my-topic?brokers=localhost:9092")
     from langstream_tpu.core.planner import PlanningError
 
     with pytest.raises(PlanningError, match="descope|Camel"):
+        build_execution_plan("app", app)
+
+
+def test_camel_source_supported_subset_plans(tmp_path):
+    """The timer:/file: subset (agents/camel.py) plans as a SOURCE."""
+    app = _camel_app(tmp_path, "timer:tick?period=250")
+    plan = build_execution_plan("app", app)
+    (agent,) = plan.agents.values()
+    assert agent.agent_type == "camel-source"
+
+
+def test_camel_source_missing_uri_fails_at_planning(tmp_path):
+    app = _camel_app(tmp_path, "")
+    from langstream_tpu.core.planner import PlanningError
+
+    with pytest.raises(PlanningError, match="component-uri"):
         build_execution_plan("app", app)
 
 
