@@ -11,16 +11,16 @@ itself.
 Scope (deliberate, documented): the NON-flexible protocol versions (no
 compact/tagged fields — simple fixed structs), record batches v2 (magic 2,
 CRC32C, zigzag-varint records — what every broker ≥ 0.11 speaks), and the
-"simple consumer" group mode: OffsetCommit/OffsetFetch with
-``generation_id = -1`` + empty member id, with **static partition
-assignment** (replica i of n owns partitions ≡ i mod n). Under the k8s
-runtime each agent replica is a StatefulSet ordinal, so static assignment
-is exact and rebalance-free; dynamic JoinGroup/SyncGroup rebalance remains
-on the ``confluent_kafka`` lane when that library is installed.
+both consumer group modes: the "simple consumer" (OffsetCommit/OffsetFetch
+with ``generation_id = -1`` + empty member id, static partition assignment
+— replica i of n owns partitions ≡ i mod n, exact under StatefulSet
+ordinals) and full dynamic membership (JoinGroup/SyncGroup/Heartbeat/
+LeaveGroup with the leader-side range assignor and generation-fenced
+commits — see :class:`~langstream_tpu.runtime.kafka_wire_runtime.GroupMembership`).
 
 APIs: ApiVersions(0) Metadata(1) Produce(3) Fetch(4) ListOffsets(1)
-FindCoordinator(1) OffsetCommit(2) OffsetFetch(1) CreateTopics(1)
-DeleteTopics(1).
+FindCoordinator(1) OffsetCommit(2) OffsetFetch(1) JoinGroup(2)
+Heartbeat(1) LeaveGroup(1) SyncGroup(1) CreateTopics(1) DeleteTopics(1).
 """
 
 from __future__ import annotations
@@ -38,6 +38,10 @@ API_METADATA = 3
 API_OFFSET_COMMIT = 8
 API_OFFSET_FETCH = 9
 API_FIND_COORDINATOR = 10
+API_JOIN_GROUP = 11
+API_HEARTBEAT = 12
+API_LEAVE_GROUP = 13
+API_SYNC_GROUP = 14
 API_API_VERSIONS = 18
 API_CREATE_TOPICS = 19
 API_DELETE_TOPICS = 20
@@ -47,12 +51,22 @@ ERR_NONE = 0
 ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_UNKNOWN_TOPIC_OR_PARTITION = 3
 ERR_NOT_LEADER = 6
+ERR_COORDINATOR_NOT_AVAILABLE = 15
+ERR_NOT_COORDINATOR = 16
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER_ID = 25
+ERR_REBALANCE_IN_PROGRESS = 27
 ERR_TOPIC_ALREADY_EXISTS = 36
 
 ERROR_NAMES = {
     ERR_OFFSET_OUT_OF_RANGE: "OFFSET_OUT_OF_RANGE",
     ERR_UNKNOWN_TOPIC_OR_PARTITION: "UNKNOWN_TOPIC_OR_PARTITION",
     ERR_NOT_LEADER: "NOT_LEADER_FOR_PARTITION",
+    ERR_COORDINATOR_NOT_AVAILABLE: "COORDINATOR_NOT_AVAILABLE",
+    ERR_NOT_COORDINATOR: "NOT_COORDINATOR",
+    ERR_ILLEGAL_GENERATION: "ILLEGAL_GENERATION",
+    ERR_UNKNOWN_MEMBER_ID: "UNKNOWN_MEMBER_ID",
+    ERR_REBALANCE_IN_PROGRESS: "REBALANCE_IN_PROGRESS",
     ERR_TOPIC_ALREADY_EXISTS: "TOPIC_ALREADY_EXISTS",
 }
 
@@ -323,6 +337,81 @@ def decode_record_batches(data: bytes) -> list[WireRecord]:
 
 
 # ---------------------------------------------------------------------------
+# consumer group protocol payloads ("consumer" embedded protocol v0) +
+# the range assignor. These are the opaque bytes carried inside
+# JoinGroup/SyncGroup — the broker never interprets them; the group LEADER
+# member computes the assignment client-side, exactly like the Java client
+# the reference's KafkaConsumerWrapper rides on.
+# ---------------------------------------------------------------------------
+
+
+def encode_subscription(topics: list[str]) -> bytes:
+    """ConsumerProtocolSubscription v0: version, topics, user_data."""
+    return (
+        Writer()
+        .i16(0)
+        .array(sorted(topics), lambda w, t: w.string(t))
+        .bytes_(None)
+        .done()
+    )
+
+
+def decode_subscription(data: bytes) -> list[str]:
+    r = Reader(data)
+    r.i16()                                   # version
+    return [r.string() for _ in range(r.i32())]
+
+
+def encode_assignment(parts: dict[str, list[int]]) -> bytes:
+    """ConsumerProtocolAssignment v0: version, [(topic, [partition])],
+    user_data."""
+    w = Writer().i16(0)
+
+    def _topic(wr: Writer, item) -> None:
+        topic, plist = item
+        wr.string(topic)
+        wr.array(sorted(plist), lambda w2, p: w2.i32(p))
+
+    w.array(sorted(parts.items()), _topic)
+    return w.bytes_(None).done()
+
+
+def decode_assignment(data: bytes) -> dict[str, list[int]]:
+    if not data:
+        return {}
+    r = Reader(data)
+    r.i16()                                   # version
+    out: dict[str, list[int]] = {}
+    for _ in range(r.i32()):
+        topic = r.string()
+        out[topic] = [r.i32() for _ in range(r.i32())]
+    return out
+
+
+def range_assign(
+    subscriptions: dict[str, list[str]],
+    partitions_by_topic: dict[str, list[int]],
+) -> dict[str, dict[str, list[int]]]:
+    """The Java client's RangeAssignor: per topic, subscribed members in
+    member-id order each take a contiguous range of the partition list,
+    with the first ``parts % members`` members taking one extra."""
+    out: dict[str, dict[str, list[int]]] = {m: {} for m in subscriptions}
+    for topic, partitions in sorted(partitions_by_topic.items()):
+        members = sorted(m for m, topics in subscriptions.items() if topic in topics)
+        if not members:
+            continue
+        parts = sorted(partitions)
+        quotient, remainder = divmod(len(parts), len(members))
+        pos = 0
+        for index, member in enumerate(members):
+            take = quotient + (1 if index < remainder else 0)
+            if take:
+                out[member][topic] = parts[pos : pos + take]
+            pos += take
+    return out
+
+
+# ---------------------------------------------------------------------------
 # connection + client
 # ---------------------------------------------------------------------------
 
@@ -410,6 +499,7 @@ class KafkaWireClient:
         self._bootstrap_conn: _Conn | None = None
         self.brokers: dict[int, tuple[str, int]] = {}
         self.topics: dict[str, dict[int, PartitionMeta]] = {}
+        self._group_coordinators: dict[str, int] = {}  # group -> node id
 
     async def _boot(self) -> _Conn:
         if self._bootstrap_conn is None:
@@ -619,6 +709,13 @@ class KafkaWireClient:
         raise KafkaProtocolError(-1, "empty ListOffsets response")
 
     async def find_coordinator(self, group: str) -> _Conn:
+        """Group-coordinator connection, cached per group: the heartbeat
+        hot path must not pay a FindCoordinator round trip every beat.
+        Invalidated by :meth:`_call_coordinator` on NOT_COORDINATOR or a
+        dead connection."""
+        node = self._group_coordinators.get(group)
+        if node is not None:
+            return await self._node(node)
         conn = await self._boot()
         w = Writer().string(group).i8(0)
         r = await conn.call(API_FIND_COORDINATOR, 1, w.done())
@@ -629,48 +726,45 @@ class KafkaWireClient:
         if error:
             raise KafkaProtocolError(error, f"find_coordinator {group}")
         self.brokers.setdefault(node, (host, port))
+        self._group_coordinators[group] = node
         return await self._node(node)
+
+    async def _call_coordinator(
+        self, group: str, api_key: int, version: int, payload: bytes
+    ) -> Reader:
+        """One coordinator RPC with a single re-lookup retry when the
+        cached coordinator moved or the connection died (the group-API
+        analogue of the NOT_LEADER metadata refresh on produce/fetch)."""
+        for attempt in (0, 1):
+            conn = await self.find_coordinator(group)
+            try:
+                return await conn.call(api_key, version, payload)
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                self._group_coordinators.pop(group, None)
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    @staticmethod
+    def _check_coordinator_error(error: int, group: str, context: str) -> None:
+        if error:
+            raise KafkaProtocolError(error, context)
+
+    def _invalidate_coordinator_on(self, group: str, error: int) -> None:
+        if error in (ERR_NOT_COORDINATOR, ERR_COORDINATOR_NOT_AVAILABLE):
+            self._group_coordinators.pop(group, None)
 
     async def offset_commit(
         self, group: str, offsets: dict[tuple[str, int], int]
     ) -> None:
-        """Simple-consumer commit: generation -1, empty member id."""
-        conn = await self.find_coordinator(group)
-        by_topic: dict[str, list[tuple[int, int]]] = {}
-        for (topic, partition), offset in offsets.items():
-            by_topic.setdefault(topic, []).append((partition, offset))
-        w = (
-            Writer()
-            .string(group)
-            .i32(-1)                          # generation (simple consumer)
-            .string("")                       # member id
-            .i64(-1)                          # retention
-        )
-
-        def _topic(wr: Writer, item) -> None:
-            topic, parts = item
-            wr.string(topic)
-            wr.array(parts, lambda w2, po: (
-                w2.i32(po[0]), w2.i64(po[1]), w2.string(None)
-            ))
-
-        w.array(list(by_topic.items()), _topic)
-        r = await conn.call(API_OFFSET_COMMIT, 2, w.done())
-        for _ in range(r.i32()):
-            topic = r.string()
-            for _p in range(r.i32()):
-                partition = r.i32()
-                error = r.i16()
-                if error:
-                    raise KafkaProtocolError(
-                        error, f"offset_commit {group} {topic}[{partition}]"
-                    )
+        """Simple-consumer commit — exactly the grouped commit with
+        generation -1 and an empty member id."""
+        await self.offset_commit_grouped(group, -1, "", offsets)
 
     async def offset_fetch(
         self, group: str, topic: str, partitions: list[int]
     ) -> dict[int, int]:
         """→ {partition: committed offset} (-1 = no commit)."""
-        conn = await self.find_coordinator(group)
         w = Writer().string(group)
 
         def _topic(wr: Writer, t: str) -> None:
@@ -678,7 +772,7 @@ class KafkaWireClient:
             wr.array(partitions, lambda w2, p: w2.i32(p))
 
         w.array([topic], _topic)
-        r = await conn.call(API_OFFSET_FETCH, 1, w.done())
+        r = await self._call_coordinator(group, API_OFFSET_FETCH, 1, w.done())
         out: dict[int, int] = {}
         for _ in range(r.i32()):
             r.string()
@@ -693,6 +787,142 @@ class KafkaWireClient:
                     )
                 out[partition] = offset
         return out
+
+    # -- consumer group membership (JoinGroup v2 / SyncGroup v1 /
+    #    Heartbeat v1 / LeaveGroup v1 — the non-flexible versions, like
+    #    every other API here) ----------------------------------------------
+
+    async def join_group(
+        self,
+        group: str,
+        member_id: str,
+        topics: list[str],
+        session_timeout_ms: int = 10000,
+        rebalance_timeout_ms: int = 30000,
+    ) -> dict[str, Any]:
+        """One JoinGroup round trip. Returns {generation, member_id, leader,
+        protocol, members: {member_id: [topics]} (leader only)}."""
+        w = (
+            Writer()
+            .string(group)
+            .i32(session_timeout_ms)
+            .i32(rebalance_timeout_ms)
+            .string(member_id)
+            .string("consumer")
+            .array(
+                [("range", encode_subscription(topics))],
+                lambda wr, p: (wr.string(p[0]), wr.bytes_(p[1])),
+            )
+        )
+        r = await self._call_coordinator(group, API_JOIN_GROUP, 2, w.done())
+        r.i32()                               # throttle
+        error = r.i16()
+        generation = r.i32()
+        protocol = r.string()
+        leader = r.string()
+        own_id = r.string()
+        members: dict[str, list[str]] = {}
+        for _ in range(r.i32()):
+            mid = r.string()
+            meta = r.bytes_()
+            members[mid] = decode_subscription(meta) if meta else []
+        if error:
+            self._invalidate_coordinator_on(group, error)
+            raise KafkaProtocolError(error, f"join_group {group}")
+        return {
+            "generation": generation,
+            "member_id": own_id,
+            "leader": leader,
+            "protocol": protocol,
+            "members": members,
+        }
+
+    async def sync_group(
+        self,
+        group: str,
+        generation: int,
+        member_id: str,
+        assignments: dict[str, dict[str, list[int]]] | None = None,
+    ) -> dict[str, list[int]]:
+        """Leader passes the computed assignments; followers pass None.
+        Returns this member's own {topic: [partitions]}."""
+        encoded = [
+            (mid, encode_assignment(parts))
+            for mid, parts in (assignments or {}).items()
+        ]
+        w = (
+            Writer()
+            .string(group)
+            .i32(generation)
+            .string(member_id)
+            .array(encoded, lambda wr, p: (wr.string(p[0]), wr.bytes_(p[1])))
+        )
+        r = await self._call_coordinator(group, API_SYNC_GROUP, 1, w.done())
+        r.i32()                               # throttle
+        error = r.i16()
+        assignment = r.bytes_()
+        if error:
+            self._invalidate_coordinator_on(group, error)
+            raise KafkaProtocolError(error, f"sync_group {group}")
+        return decode_assignment(assignment or b"")
+
+    async def heartbeat(self, group: str, generation: int, member_id: str) -> None:
+        w = Writer().string(group).i32(generation).string(member_id)
+        r = await self._call_coordinator(group, API_HEARTBEAT, 1, w.done())
+        r.i32()                               # throttle
+        error = r.i16()
+        if error:
+            self._invalidate_coordinator_on(group, error)
+            raise KafkaProtocolError(error, f"heartbeat {group}")
+
+    async def leave_group(self, group: str, member_id: str) -> None:
+        w = Writer().string(group).string(member_id)
+        r = await self._call_coordinator(group, API_LEAVE_GROUP, 1, w.done())
+        r.i32()                               # throttle
+        error = r.i16()
+        if error and error != ERR_UNKNOWN_MEMBER_ID:
+            raise KafkaProtocolError(error, f"leave_group {group}")
+
+    async def offset_commit_grouped(
+        self,
+        group: str,
+        generation: int,
+        member_id: str,
+        offsets: dict[tuple[str, int], int],
+    ) -> None:
+        """Commit as a dynamic group member: the coordinator fences stale
+        generations (ILLEGAL_GENERATION) so a zombie replica that missed a
+        rebalance cannot clobber the new owner's progress."""
+        by_topic: dict[str, list[tuple[int, int]]] = {}
+        for (topic, partition), offset in offsets.items():
+            by_topic.setdefault(topic, []).append((partition, offset))
+        w = (
+            Writer()
+            .string(group)
+            .i32(generation)
+            .string(member_id)
+            .i64(-1)                          # retention
+        )
+
+        def _topic(wr: Writer, item) -> None:
+            topic, parts = item
+            wr.string(topic)
+            wr.array(parts, lambda w2, po: (
+                w2.i32(po[0]), w2.i64(po[1]), w2.string(None)
+            ))
+
+        w.array(list(by_topic.items()), _topic)
+        r = await self._call_coordinator(group, API_OFFSET_COMMIT, 2, w.done())
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _p in range(r.i32()):
+                partition = r.i32()
+                error = r.i16()
+                if error:
+                    self._invalidate_coordinator_on(group, error)
+                    raise KafkaProtocolError(
+                        error, f"offset_commit {group} {topic}[{partition}]"
+                    )
 
     async def create_topic(
         self, topic: str, partitions: int = 1, replication: int = 1,

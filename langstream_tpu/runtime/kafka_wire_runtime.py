@@ -6,20 +6,24 @@ the same topic SPI — consumer with contiguous-prefix commits, producer with
 serializer inference, position-addressed reader, admin, dead-letter via the
 base class — backed by :mod:`.kafka_wire` instead of an SDK.
 
-Partition ownership is STATIC: replica ``i`` of ``n`` owns partitions
-``p ≡ i (mod n)`` (``replica-index`` / ``num-replicas`` in the consumer
-config, or the pod's ordinal env). Under the k8s runtime each replica is a
-StatefulSet ordinal, so assignment is exact and rebalance-free — the
-dynamic group-rebalance lane stays on ``confluent_kafka`` when installed
-(parity note: the reference leans on the Java client's group protocol,
-``KafkaConsumerWrapper.java:41``; the contiguous-commit semantics here are
-identical and shared via :class:`ContiguousOffsetTracker`).
+Partition ownership defaults to STATIC: replica ``i`` of ``n`` owns
+partitions ``p ≡ i (mod n)`` (``replica-index`` / ``num-replicas`` in the
+consumer config, or the pod's ordinal env). Under the k8s runtime each
+replica is a StatefulSet ordinal, so assignment is exact and
+rebalance-free. ``assignment: dynamic`` opts into the wire-spoken consumer
+group protocol instead — JoinGroup/SyncGroup/Heartbeat/LeaveGroup with the
+leader-side range assignor and generation-fenced commits
+(:class:`GroupMembership`) — matching the Java client's group membership
+the reference rides (``KafkaConsumerWrapper.java:41`` implements
+``ConsumerRebalanceListener``). The contiguous-commit semantics are
+identical in both modes and shared via :class:`ContiguousOffsetTracker`.
 """
 
 from __future__ import annotations
 
 import asyncio
 import os
+import time
 from typing import Any
 
 from langstream_tpu.api.record import Record, SimpleRecord, now_millis
@@ -42,10 +46,20 @@ from langstream_tpu.runtime.kafka_broker import (
     record_wire_payload,
 )
 from langstream_tpu.runtime.kafka_wire import (
+    ERR_ILLEGAL_GENERATION,
     ERR_OFFSET_OUT_OF_RANGE,
+    ERR_REBALANCE_IN_PROGRESS,
+    ERR_UNKNOWN_MEMBER_ID,
     KafkaProtocolError,
     KafkaWireClient,
     WireRecord,
+    range_assign,
+)
+
+_GROUP_ERRORS = (
+    ERR_ILLEGAL_GENERATION,
+    ERR_REBALANCE_IN_PROGRESS,
+    ERR_UNKNOWN_MEMBER_ID,
 )
 
 
@@ -76,8 +90,144 @@ def _wire_record_to_record(topic: str, rec: WireRecord) -> Record:
 
 
 
+class GroupMembership:
+    """Client half of the consumer group protocol: join → (leader computes
+    the range assignment) → sync → heartbeat cadence; rejoin on the group
+    error codes. This is the dynamic-rebalance lane the reference rides the
+    Java client for (``KafkaConsumerWrapper.java:41`` implements
+    ``ConsumerRebalanceListener``) — here it is spoken on the wire."""
+
+    def __init__(
+        self,
+        client: KafkaWireClient,
+        group: str,
+        topics: list[str],
+        session_timeout_ms: int = 10000,
+        heartbeat_interval_s: float = 0.5,
+    ):
+        self.client = client
+        self.group = group
+        self.topics = topics
+        self.session_timeout_ms = session_timeout_ms
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.member_id = ""
+        self.generation = -1
+        self.assignment: dict[str, list[int]] = {}
+        self._last_heartbeat = 0.0
+        self.rebalance_needed = False
+        self._hb_task: asyncio.Task | None = None
+
+    def _ensure_heartbeat_task(self) -> None:
+        """Heartbeats must keep flowing while the owner is busy processing
+        a batch — a session-timeout's worth of silence gets the member
+        evicted by a real coordinator (the Java client heartbeats from a
+        background thread for the same reason). ``_Conn.call`` serializes
+        on a lock, so this task can share the coordinator connection."""
+        if self._hb_task is not None and not self._hb_task.done():
+            return
+
+        async def beat() -> None:
+            while True:
+                await asyncio.sleep(self.heartbeat_interval_s)
+                if self.rebalance_needed or not self.member_id:
+                    continue                   # owner must rejoin first
+                self._last_heartbeat = time.monotonic()
+                try:
+                    await self.client.heartbeat(
+                        self.group, self.generation, self.member_id
+                    )
+                except KafkaProtocolError as e:
+                    if e.code in _GROUP_ERRORS:
+                        if e.code == ERR_UNKNOWN_MEMBER_ID:
+                            self.member_id = ""
+                        self.rebalance_needed = True
+                    # other codes: transient — next beat retries
+                except (ConnectionError, OSError):
+                    pass                       # redial happens on next call
+
+        self._hb_task = asyncio.get_running_loop().create_task(beat())
+
+    async def join(self) -> dict[str, list[int]]:
+        """Run join+sync rounds until the group is stable; returns this
+        member's {topic: [partitions]}."""
+        while True:
+            try:
+                info = await self.client.join_group(
+                    self.group, self.member_id, self.topics,
+                    session_timeout_ms=self.session_timeout_ms,
+                )
+            except KafkaProtocolError as e:
+                if e.code == ERR_UNKNOWN_MEMBER_ID:
+                    self.member_id = ""      # fenced: restart as a new member
+                    continue
+                raise
+            self.member_id = info["member_id"]
+            self.generation = info["generation"]
+            assignments = None
+            if info["leader"] == self.member_id:
+                subscribed = sorted(
+                    {t for topics in info["members"].values() for t in topics}
+                )
+                partitions = {
+                    t: await self.client.partitions_for(t) for t in subscribed
+                }
+                assignments = range_assign(info["members"], partitions)
+            try:
+                self.assignment = await self.client.sync_group(
+                    self.group, self.generation, self.member_id, assignments
+                )
+            except KafkaProtocolError as e:
+                if e.code in _GROUP_ERRORS:
+                    if e.code == ERR_UNKNOWN_MEMBER_ID:
+                        self.member_id = ""
+                    continue                 # another round started — rejoin
+                raise
+            self._last_heartbeat = time.monotonic()
+            self.rebalance_needed = False
+            self._ensure_heartbeat_task()
+            return self.assignment
+
+    async def heartbeat_if_due(self) -> bool:
+        """False → the group is rebalancing and the caller must rejoin."""
+        if self.rebalance_needed:
+            return False
+        now = time.monotonic()
+        if now - self._last_heartbeat < self.heartbeat_interval_s:
+            return True
+        self._last_heartbeat = now
+        try:
+            await self.client.heartbeat(self.group, self.generation, self.member_id)
+            return True
+        except KafkaProtocolError as e:
+            if e.code in _GROUP_ERRORS:
+                if e.code == ERR_UNKNOWN_MEMBER_ID:
+                    self.member_id = ""
+                return False
+            raise
+
+    async def leave(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._hb_task = None
+        if self.member_id:
+            try:
+                await self.client.leave_group(self.group, self.member_id)
+            except (KafkaProtocolError, ConnectionError, OSError):
+                pass
+            self.member_id = ""
+            self.generation = -1
+
+
 class WireKafkaTopicConsumer(TopicConsumer):
-    """Static-assignment group consumer with contiguous-prefix commits."""
+    """Group consumer with contiguous-prefix commits. Two assignment modes:
+    ``static`` (replica ``i`` of ``n`` owns partitions ``p ≡ i mod n`` —
+    exact under StatefulSet ordinals, rebalance-free) and ``dynamic`` (the
+    wire group protocol: join/sync/heartbeat with generation-fenced
+    commits, reference parity with the Java client's group membership)."""
 
     def __init__(
         self,
@@ -87,6 +237,8 @@ class WireKafkaTopicConsumer(TopicConsumer):
         replica_index: int = 0,
         num_replicas: int = 1,
         poll_timeout_ms: int = 500,
+        assignment: str = "static",
+        session_timeout_ms: int = 10000,
     ):
         self.topic = topic
         self.group = group
@@ -95,16 +247,39 @@ class WireKafkaTopicConsumer(TopicConsumer):
         self.poll_timeout_ms = poll_timeout_ms
         self.client = KafkaWireClient(bootstrap)
         self.tracker = ContiguousOffsetTracker()
+        self.membership = (
+            GroupMembership(
+                self.client, group, [topic],
+                session_timeout_ms=session_timeout_ms,
+            )
+            if assignment == "dynamic"
+            else None
+        )
         self._positions: dict[int, int] = {}
         self._committed: dict[int, int] = {}
         self._out = 0
+        self._rebalances = 0
 
     async def start(self) -> None:
-        partitions = await self.client.partitions_for(self.topic)
-        mine = [
-            p for p in partitions
-            if p % self.num_replicas == self.replica_index % self.num_replicas
-        ]
+        if self.membership is not None:
+            assignment = await self.membership.join()
+            await self._adopt_partitions(assignment.get(self.topic, []))
+        else:
+            partitions = await self.client.partitions_for(self.topic)
+            mine = [
+                p for p in partitions
+                if p % self.num_replicas == self.replica_index % self.num_replicas
+            ]
+            await self._adopt_partitions(mine)
+
+    async def _adopt_partitions(self, mine: list[int]) -> None:
+        """(Re)initialize positions from the committed offsets. On a
+        rebalance, in-flight uncommitted records of lost partitions are
+        simply redelivered to their new owner — the at-least-once contract
+        (parity: ``KafkaConsumerWrapper.java:82-112`` logs exactly this)."""
+        self._positions = {}
+        self._committed = {}
+        self.tracker = ContiguousOffsetTracker()
         committed = await self.client.offset_fetch(self.group, self.topic, mine)
         for p in mine:
             start = committed.get(p, -1)
@@ -115,11 +290,27 @@ class WireKafkaTopicConsumer(TopicConsumer):
             self.tracker.start_partition(self.topic, p, start)
 
     async def close(self) -> None:
+        if self.membership is not None:
+            await self.membership.leave()
         await self.client.close()
 
     async def read(self) -> list[Record]:
+        if self.membership is not None:
+            if not await self.membership.heartbeat_if_due():
+                # group is rebalancing: rejoin and adopt the new assignment;
+                # uncommitted in-flight records of partitions that moved are
+                # redelivered to their new owner (at-least-once)
+                assignment = await self.membership.join()
+                await self._adopt_partitions(assignment.get(self.topic, []))
+                self._rebalances += 1
         out: list[Record] = []
         partitions = sorted(self._positions)
+        if not partitions:
+            # owning no partitions is a normal group state (more members
+            # than partitions): sleep a poll instead of busy-spinning the
+            # caller's read loop at 100% CPU
+            await asyncio.sleep(self.poll_timeout_ms / 1000.0)
+            return out
         # every owned partition is polled every read — no partition can
         # starve behind a busy sibling (per-key ordering is per-partition,
         # so interleaving partitions in one batch is safe); the wait budget
@@ -177,7 +368,27 @@ class WireKafkaTopicConsumer(TopicConsumer):
             ):
                 self._committed[offset.partition] = next_pos
                 to_commit[(offset.topic, offset.partition)] = next_pos
-        if to_commit:
+        if not to_commit:
+            return
+        if self.membership is not None:
+            try:
+                await self.client.offset_commit_grouped(
+                    self.group,
+                    self.membership.generation,
+                    self.membership.member_id,
+                    to_commit,
+                )
+            except KafkaProtocolError as e:
+                if e.code in _GROUP_ERRORS:
+                    # fenced: these partitions moved in a rebalance this
+                    # member hasn't processed yet. Dropping the commit is
+                    # the correct at-least-once outcome — the new owner
+                    # resumes from the last successful commit and the
+                    # records are redelivered there; the next read()
+                    # rejoins.
+                    return
+                raise
+        else:
             await self.client.offset_commit(self.group, to_commit)
 
     def total_out(self) -> int:
@@ -392,6 +603,8 @@ class WireKafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
             replica_index=replica,
             num_replicas=replicas,
             poll_timeout_ms=int(float(config.get("poll-timeout", 0.5)) * 1000),
+            assignment=str(config.get("assignment", "static")).lower(),
+            session_timeout_ms=int(config.get("session-timeout-ms", 10000)),
         )
 
     def create_producer(self, agent_id: str, config: dict[str, Any]) -> TopicProducer:

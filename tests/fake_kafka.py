@@ -27,14 +27,21 @@ from langstream_tpu.runtime.kafka_wire import (
     API_DELETE_TOPICS,
     API_FETCH,
     API_FIND_COORDINATOR,
+    API_HEARTBEAT,
+    API_JOIN_GROUP,
+    API_LEAVE_GROUP,
     API_LIST_OFFSETS,
     API_METADATA,
     API_OFFSET_COMMIT,
     API_OFFSET_FETCH,
     API_PRODUCE,
+    API_SYNC_GROUP,
+    ERR_ILLEGAL_GENERATION,
     ERR_NONE,
     ERR_OFFSET_OUT_OF_RANGE,
+    ERR_REBALANCE_IN_PROGRESS,
     ERR_TOPIC_ALREADY_EXISTS,
+    ERR_UNKNOWN_MEMBER_ID,
     ERR_UNKNOWN_TOPIC_OR_PARTITION,
     Reader,
     Writer,
@@ -60,10 +67,34 @@ class _Partition:
         return self.records[-1].offset + 1 if self.records else 0
 
 
+@dataclass
+class _Group:
+    """Group-coordinator state machine: Empty → Joining ⇄ AwaitingSync →
+    Stable, mirroring the real coordinator's generations. A join round
+    completes when every member expected to rejoin has, or when
+    ``join_window`` elapses after the first joiner (dropping laggards —
+    the session-expiry analogue a test can rely on)."""
+
+    generation: int = 0
+    state: str = "Empty"
+    protocol: str = ""
+    leader: str = ""
+    members: dict[str, bytes] = field(default_factory=dict)
+    assignments: dict[str, bytes] = field(default_factory=dict)
+    joiners: dict[str, bytes] = field(default_factory=dict)
+    expected: set[str] = field(default_factory=set)
+    join_event: asyncio.Event = field(default_factory=asyncio.Event)
+    sync_event: asyncio.Event = field(default_factory=asyncio.Event)
+    member_seq: int = 0
+    round_id: int = 0
+
+
 class FakeKafkaBroker:
-    def __init__(self) -> None:
+    def __init__(self, join_window: float = 1.0) -> None:
         self.topics: dict[str, dict[int, _Partition]] = {}
         self.offsets: dict[tuple[str, str, int], int] = {}
+        self.groups: dict[str, _Group] = {}
+        self.join_window = join_window
         self.requests: list[tuple[int, int]] = []  # (api_key, version) seen
         self._server: asyncio.AbstractServer | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -203,6 +234,100 @@ class FakeKafkaBroker:
             .done()
         )
 
+    # -- group coordinator -------------------------------------------------
+
+    @staticmethod
+    def _begin_round(g: _Group, expected: set[str]) -> None:
+        g.state = "Joining"
+        g.round_id += 1
+        g.expected = set(expected)
+        g.joiners = {}
+        g.join_event = asyncio.Event()
+        g.sync_event = asyncio.Event()
+
+    @staticmethod
+    def _complete_join(g: _Group) -> None:
+        g.generation += 1
+        g.members = dict(g.joiners)
+        g.leader = sorted(g.members)[0]
+        g.state = "AwaitingSync"
+        g.assignments = {}
+        g.join_event.set()
+
+    async def _join_group(
+        self, group: str, member_id: str, protocols: list[tuple[str, bytes]]
+    ) -> bytes:
+        g = self.groups.setdefault(group, _Group())
+        if member_id == "":
+            g.member_seq += 1
+            member_id = f"member-{g.member_seq}"
+        if g.state != "Joining":
+            self._begin_round(g, set(g.members) | {member_id})
+        else:
+            g.expected.add(member_id)
+        g.protocol = protocols[0][0] if protocols else "range"
+        g.joiners[member_id] = protocols[0][1] if protocols else b""
+        if g.expected <= set(g.joiners):
+            self._complete_join(g)
+        else:
+            # wait for the stragglers; on window expiry whoever is present
+            # forms the generation (the session-expiry analogue). The round
+            # id pins the timeout to THIS round — a stale waiter must never
+            # cut a newer round short before its members assembled.
+            round_id = g.round_id
+            event = g.join_event
+            try:
+                await asyncio.wait_for(event.wait(), self.join_window)
+            except asyncio.TimeoutError:
+                if g.state == "Joining" and g.round_id == round_id:
+                    self._complete_join(g)
+        w = (
+            Writer().i32(0).i16(ERR_NONE).i32(g.generation)
+            .string(g.protocol).string(g.leader).string(member_id)
+        )
+        if member_id == g.leader:
+            w.array(
+                sorted(g.members.items()),
+                lambda wr, p: (wr.string(p[0]), wr.bytes_(p[1])),
+            )
+        else:
+            w.i32(0)
+        return w.done()
+
+    async def _sync_group(
+        self, group: str, generation: int, member_id: str,
+        assignments: dict[str, bytes],
+    ) -> bytes:
+        def _fail(err: int) -> bytes:
+            return Writer().i32(0).i16(err).bytes_(b"").done()
+
+        g = self.groups.get(group)
+        if g is None or member_id not in g.members:
+            return _fail(ERR_UNKNOWN_MEMBER_ID)
+        if g.state == "Joining":
+            return _fail(ERR_REBALANCE_IN_PROGRESS)
+        if generation != g.generation:
+            return _fail(ERR_ILLEGAL_GENERATION)
+        if member_id == g.leader:
+            g.assignments = dict(assignments)
+            g.state = "Stable"
+            g.sync_event.set()
+        else:
+            try:
+                await asyncio.wait_for(
+                    g.sync_event.wait(), self.join_window + 5.0
+                )
+            except asyncio.TimeoutError:
+                return _fail(ERR_REBALANCE_IN_PROGRESS)
+        # a new round may have started while this follower waited
+        if g.state != "Stable" or generation != g.generation:
+            return _fail(ERR_REBALANCE_IN_PROGRESS)
+        return (
+            Writer().i32(0).i16(ERR_NONE)
+            .bytes_(g.assignments.get(member_id, b""))
+            .done()
+        )
+
     # -- request handling --------------------------------------------------
 
     async def _client(self, reader: asyncio.StreamReader,
@@ -218,7 +343,7 @@ class FakeKafkaBroker:
                 correlation = r.i32()
                 r.string()  # client id
                 self.requests.append((api_key, version))
-                payload = self._dispatch(api_key, version, r)
+                payload = await self._dispatch(api_key, version, r)
                 body = Writer().i32(correlation).raw(payload).done()
                 writer.write(struct.pack(">i", len(body)) + body)
                 await writer.drain()
@@ -227,14 +352,16 @@ class FakeKafkaBroker:
         finally:
             writer.close()
 
-    def _dispatch(self, api_key: int, version: int, r: Reader) -> bytes:
+    async def _dispatch(self, api_key: int, version: int, r: Reader) -> bytes:
         if api_key == API_API_VERSIONS:
             w = Writer().i16(ERR_NONE)
             keys = [
                 (API_PRODUCE, 0, 3), (API_FETCH, 0, 4),
                 (API_LIST_OFFSETS, 0, 1), (API_METADATA, 0, 1),
                 (API_OFFSET_COMMIT, 0, 2), (API_OFFSET_FETCH, 0, 1),
-                (API_FIND_COORDINATOR, 0, 1), (API_API_VERSIONS, 0, 0),
+                (API_FIND_COORDINATOR, 0, 1), (API_JOIN_GROUP, 0, 2),
+                (API_HEARTBEAT, 0, 1), (API_LEAVE_GROUP, 0, 1),
+                (API_SYNC_GROUP, 0, 1), (API_API_VERSIONS, 0, 0),
                 (API_CREATE_TOPICS, 0, 1), (API_DELETE_TOPICS, 0, 1),
             ]
             w.i32(len(keys))
@@ -371,9 +498,17 @@ class FakeKafkaBroker:
             generation = r.i32()
             member = r.string()
             r.i64()                  # retention
-            assert generation == -1 and member == "", (
-                "client must use simple-consumer commits"
-            )
+            # simple-consumer commits (generation -1, empty member) are
+            # always accepted; dynamic-member commits are FENCED against
+            # the coordinator's generation so a zombie that missed a
+            # rebalance cannot clobber the new owner's progress
+            group_err = ERR_NONE
+            if generation != -1 or member != "":
+                g = self.groups.get(group)
+                if g is None or member not in g.members:
+                    group_err = ERR_UNKNOWN_MEMBER_ID
+                elif generation != g.generation:
+                    group_err = ERR_ILLEGAL_GENERATION
             topic_count = r.i32()
             w = Writer().i32(topic_count)
             for _ in range(topic_count):
@@ -385,9 +520,72 @@ class FakeKafkaBroker:
                     partition = r.i32()
                     offset = r.i64()
                     r.string()       # metadata
-                    self.offsets[(group, topic, partition)] = offset
-                    w.i32(partition).i16(ERR_NONE)
+                    if group_err == ERR_NONE:
+                        self.offsets[(group, topic, partition)] = offset
+                    w.i32(partition).i16(group_err)
             return w.done()
+
+        if api_key == API_JOIN_GROUP:
+            assert version == 2
+            group = r.string()
+            r.i32()                  # session timeout
+            r.i32()                  # rebalance timeout
+            member_id = r.string()
+            r.string()               # protocol type ("consumer")
+            protocols = []
+            for _ in range(r.i32()):
+                protocols.append((r.string(), r.bytes_() or b""))
+            return await self._join_group(group, member_id, protocols)
+
+        if api_key == API_SYNC_GROUP:
+            assert version == 1
+            group = r.string()
+            generation = r.i32()
+            member_id = r.string()
+            assignments = {}
+            for _ in range(r.i32()):
+                mid = r.string()
+                assignments[mid] = r.bytes_() or b""
+            return await self._sync_group(group, generation, member_id, assignments)
+
+        if api_key == API_HEARTBEAT:
+            assert version == 1
+            group = r.string()
+            generation = r.i32()
+            member_id = r.string()
+            g = self.groups.get(group)
+            if g is None or member_id not in (set(g.members) | set(g.joiners)):
+                err = ERR_UNKNOWN_MEMBER_ID
+            elif g.state == "Joining":
+                err = ERR_REBALANCE_IN_PROGRESS
+            elif generation != g.generation:
+                err = ERR_ILLEGAL_GENERATION
+            else:
+                err = ERR_NONE
+            return Writer().i32(0).i16(err).done()
+
+        if api_key == API_LEAVE_GROUP:
+            assert version == 1
+            group = r.string()
+            member_id = r.string()
+            g = self.groups.get(group)
+            if g is None or member_id not in (set(g.members) | set(g.joiners)):
+                return Writer().i32(0).i16(ERR_UNKNOWN_MEMBER_ID).done()
+            g.members.pop(member_id, None)
+            g.joiners.pop(member_id, None)
+            g.expected.discard(member_id)
+            if not g.members and not g.joiners:
+                g.state = "Empty"
+                g.leader = ""
+                g.join_event.set()
+                g.sync_event.set()
+            elif g.state == "Joining":
+                if g.expected and g.expected <= set(g.joiners):
+                    self._complete_join(g)
+            else:
+                # survivors discover the rebalance via heartbeat errors
+                self._begin_round(g, set(g.members))
+            return Writer().i32(0).i16(ERR_NONE).done()
 
         if api_key == API_OFFSET_FETCH:
             assert version == 1
