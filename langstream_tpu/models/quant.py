@@ -15,6 +15,7 @@ lossless in practice for decoder LMs of this size.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any
 
 import jax
@@ -126,6 +127,155 @@ def quantize_llama_params(params: dict) -> dict:
         },
         "final_norm": params["final_norm"],
         "lm_head": quantize_tensor(params["lm_head"], axis=0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# direct quantized random-init (never materializes the full-precision tree)
+# ---------------------------------------------------------------------------
+
+
+def _q8_normal(key, lead: int, shape: tuple, fan_in: int, axis: int):
+    """``(lead, *shape)`` random-normal weights generated DIRECTLY as int8
+    values + per-channel f32 scales, one ``shape``-sized f32 transient at a
+    time (``lax.map`` = sequential scan — XLA allocates a single chunk's
+    f32 buffer, quantizes it, and reuses it for the next chunk).
+
+    ``axis`` is the contraction axis WITHIN ``shape`` (the scale reduces it
+    to 1, matching :func:`quantize_tensor`'s keepdims layout).
+    """
+    scale = 1.0 / math.sqrt(fan_in)
+
+    def one(k):
+        w = jax.random.normal(k, shape, dtype=jnp.float32) * scale
+        amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+        s = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+        return q, s
+
+    return jax.lax.map(one, jax.random.split(key, lead))
+
+
+def _chunks(n: int, target: int = 32) -> int:
+    """Largest chunk count <= target that divides n (vocab chunking)."""
+    for d in range(min(target, n), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def _q8_embed(key, vocab: int, hidden: int, dtype):
+    """(vocab, hidden) embedding, per-ROW int8, generated in row chunks."""
+    nb = _chunks(vocab)
+    q, s = _q8_normal(key, nb, (vocab // nb, hidden), hidden, 1)
+    return QTensor(
+        q=q.reshape(vocab, hidden), s=s.reshape(vocab, 1), dtype=dtype
+    )
+
+
+def _q8_lm_head(key, hidden: int, vocab: int, dtype):
+    """(hidden, vocab) head, per-COLUMN int8 (contracts hidden), generated
+    in column chunks."""
+    nb = _chunks(vocab)
+    q, s = _q8_normal(key, nb, (hidden, vocab // nb), hidden, 0)
+    return QTensor(
+        q=jnp.moveaxis(q, 0, 1).reshape(hidden, vocab),
+        s=jnp.moveaxis(s, 0, 1).reshape(1, vocab),
+        dtype=dtype,
+    )
+
+
+def init_llama_params_q8(config, key: jax.Array | None = None) -> dict:
+    """Random-init Llama params ALREADY weight-quantized — the serving
+    engine's offline/dev init for ``quantize: int8`` postures.
+
+    Same tree/shapes/dtypes/scale-layout as
+    ``quantize_llama_params(init_llama_params(c))`` (identical bytes and
+    FLOPs at run time), but peak memory during init is the int8 tree plus
+    ONE layer's f32 transient (~in*out*4 bytes), never the full bf16/f32
+    tree. At the Llama-3-8B shape that is ~8 GB + 235 MB instead of the
+    >= 24 GB init→quantize peak that OOM'd a 16 GB v5e chip (round-4
+    benchmark root cause).
+
+    ``config`` is duck-typed (LlamaConfig fields only — vocab_size, hidden,
+    layers, heads, kv_heads, head_dim, intermediate, dtype).
+    """
+    key = key if key is not None else jax.random.PRNGKey(0)
+    c = config
+    keys = jax.random.split(key, 10)
+    qkv_dim = c.heads * c.head_dim
+    kv_dim = c.kv_heads * c.head_dim
+    L = c.layers
+
+    def stacked(k, rows, cols, fan_in):
+        # per-layer (rows, cols), contraction axis 0 — stacked (L, rows,
+        # cols) with scales (L, 1, cols), exactly quantize_tensor(axis=1)
+        q, s = _q8_normal(k, L, (rows, cols), fan_in, 0)
+        return QTensor(q=q, s=s, dtype=c.dtype)
+
+    return {
+        "embed": _q8_embed(keys[0], c.vocab_size, c.hidden, c.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, c.hidden), dtype=c.dtype),
+            "wq": stacked(keys[1], c.hidden, qkv_dim, c.hidden),
+            "wk": stacked(keys[2], c.hidden, kv_dim, c.hidden),
+            "wv": stacked(keys[3], c.hidden, kv_dim, c.hidden),
+            "wo": stacked(keys[4], qkv_dim, c.hidden, qkv_dim),
+            "mlp_norm": jnp.ones((L, c.hidden), dtype=c.dtype),
+            "w_gate": stacked(keys[5], c.hidden, c.intermediate, c.hidden),
+            "w_up": stacked(keys[6], c.hidden, c.intermediate, c.hidden),
+            "w_down": stacked(keys[7], c.intermediate, c.hidden, c.intermediate),
+        },
+        "final_norm": jnp.ones((c.hidden,), dtype=c.dtype),
+        "lm_head": _q8_lm_head(keys[8], c.hidden, c.vocab_size, c.dtype),
+    }
+
+
+def init_moe_params_q8(config, key: jax.Array | None = None) -> dict:
+    """MoE twin of :func:`init_llama_params_q8`: expert weights generated
+    per-(layer, expert) — a Mixtral-8x7B expert tensor is (32, 8, 4096,
+    14336); the chunked init's f32 transient is one (4096, 14336) slice
+    (235 MB), not the 60 GB stacked tensor. Router stays float32 exactly as
+    ``init_moe_params`` (tiny, numerically delicate)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    c = config
+    keys = jax.random.split(key, 12)
+    qkv_dim = c.heads * c.head_dim
+    kv_dim = c.kv_heads * c.head_dim
+    L, E, I = c.layers, c.experts, c.moe_intermediate
+
+    def stacked(k, rows, cols, fan_in):
+        q, s = _q8_normal(k, L, (rows, cols), fan_in, 0)
+        return QTensor(q=q, s=s, dtype=c.dtype)
+
+    def expert(k, rows, cols, fan_in):
+        # (L*E) chunks of (rows, cols) → (L, E, rows, cols) with scales
+        # (L, E, 1, cols): quantize_tensor(axis=2)'s layout
+        q, s = _q8_normal(k, L * E, (rows, cols), fan_in, 0)
+        return QTensor(
+            q=q.reshape(L, E, rows, cols),
+            s=s.reshape(L, E, 1, cols),
+            dtype=c.dtype,
+        )
+
+    return {
+        "embed": _q8_embed(keys[0], c.vocab_size, c.hidden, c.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, c.hidden), dtype=c.dtype),
+            "wq": stacked(keys[1], c.hidden, qkv_dim, c.hidden),
+            "wk": stacked(keys[2], c.hidden, kv_dim, c.hidden),
+            "wv": stacked(keys[3], c.hidden, kv_dim, c.hidden),
+            "wo": stacked(keys[4], qkv_dim, c.hidden, qkv_dim),
+            "mlp_norm": jnp.ones((L, c.hidden), dtype=c.dtype),
+            "router": jax.random.normal(
+                keys[5], (L, c.hidden, E), dtype=jnp.float32
+            ) * (1.0 / math.sqrt(c.hidden)),
+            "w_gate": expert(keys[6], c.hidden, I, c.hidden),
+            "w_up": expert(keys[7], c.hidden, I, c.hidden),
+            "w_down": expert(keys[8], I, c.hidden, I),
+        },
+        "final_norm": jnp.ones((c.hidden,), dtype=c.dtype),
+        "lm_head": _q8_lm_head(keys[9], c.hidden, c.vocab_size, c.dtype),
     }
 
 

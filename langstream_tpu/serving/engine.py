@@ -492,6 +492,11 @@ class TpuServingEngine:
     def _init_model(self) -> None:
         mc = self.model_config
         self._ffn = None  # default dense SwiGLU inside the llama layer math
+        # random-init + int8 postures generate the quantized tree DIRECTLY
+        # (init_llama_params_q8): the init→quantize sequence peaks at the
+        # full-precision tree PLUS the int8 copy (>= 24 GB at the 8B shape
+        # — certain OOM on a 16 GB chip, round-4 bench root cause)
+        quantized_at_init = False
         if self.is_moe:
             from langstream_tpu.models.moe import init_moe_params, moe_serving_ffn
 
@@ -517,7 +522,13 @@ class TpuServingEngine:
                     "model %r: using random-init weights (offline/dev mode)",
                     self.config.model,
                 )
-                self.params = init_moe_params(mc)
+                if self.config.quantize == "int8":
+                    from langstream_tpu.models.quant import init_moe_params_q8
+
+                    self.params = init_moe_params_q8(mc)
+                    quantized_at_init = True
+                else:
+                    self.params = init_moe_params(mc)
         elif self.config.checkpoint:
             from langstream_tpu.models.checkpoints import load_llama_checkpoint
 
@@ -527,15 +538,24 @@ class TpuServingEngine:
                 "no checkpoint configured for model %r: using random-init "
                 "weights (offline/dev mode)", self.config.model,
             )
-            self.params = init_llama_params(mc)
-        if self.config.quantize == "int8":
-            from langstream_tpu.models.quant import (
-                quantize_llama_params,
-                quantize_moe_params,
-            )
+            if self.config.quantize == "int8":
+                from langstream_tpu.models.quant import init_llama_params_q8
 
-            quantize = quantize_moe_params if self.is_moe else quantize_llama_params
-            self.params = quantize(self.params)
+                self.params = init_llama_params_q8(mc)
+                quantized_at_init = True
+            else:
+                self.params = init_llama_params(mc)
+        if self.config.quantize == "int8":
+            if not quantized_at_init:  # checkpoint / bf16-random-init trees
+                from langstream_tpu.models.quant import (
+                    quantize_llama_params,
+                    quantize_moe_params,
+                )
+
+                quantize = (
+                    quantize_moe_params if self.is_moe else quantize_llama_params
+                )
+                self.params = quantize(self.params)
         elif self.config.quantize not in (None, "none"):
             raise ValueError(f"unknown quantize mode {self.config.quantize!r}")
 
