@@ -18,15 +18,32 @@ ordinals) and full dynamic membership (JoinGroup/SyncGroup/Heartbeat/
 LeaveGroup with the leader-side range assignor and generation-fenced
 commits — see :class:`~langstream_tpu.runtime.kafka_wire_runtime.GroupMembership`).
 
+Security (what the reference's cloud instances need — e.g. its Astra
+example sets ``security.protocol: SASL_SSL`` + ``sasl.mechanism: PLAIN``,
+``examples/instances/astra.yaml:27-29``): TLS via ``ssl.SSLContext`` on the
+connection, SASL PLAIN and SCRAM-SHA-256/-512 (RFC 5802, stdlib hmac/
+hashlib) over SaslHandshake(v1) + SaslAuthenticate(v0). Fetch
+decompression: gzip (stdlib) and zstd (zstandard, present in this image)
+always; snappy/lz4 raise a clear error naming the missing codec library.
+Produce-side compression: optional gzip.
+
 APIs: ApiVersions(0) Metadata(1) Produce(3) Fetch(4) ListOffsets(1)
 FindCoordinator(1) OffsetCommit(2) OffsetFetch(1) JoinGroup(2)
-Heartbeat(1) LeaveGroup(1) SyncGroup(1) CreateTopics(1) DeleteTopics(1).
+Heartbeat(1) LeaveGroup(1) SyncGroup(1) SaslHandshake(1) ApiVersions(0)
+CreateTopics(1) DeleteTopics(1) SaslAuthenticate(0).
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
+import hashlib
+import hmac
+import re
+import secrets
+import ssl as ssl_module
 import struct
+import zlib
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -42,9 +59,11 @@ API_JOIN_GROUP = 11
 API_HEARTBEAT = 12
 API_LEAVE_GROUP = 13
 API_SYNC_GROUP = 14
+API_SASL_HANDSHAKE = 17
 API_API_VERSIONS = 18
 API_CREATE_TOPICS = 19
 API_DELETE_TOPICS = 20
+API_SASL_AUTHENTICATE = 36
 
 # error codes (subset)
 ERR_NONE = 0
@@ -56,7 +75,10 @@ ERR_NOT_COORDINATOR = 16
 ERR_ILLEGAL_GENERATION = 22
 ERR_UNKNOWN_MEMBER_ID = 25
 ERR_REBALANCE_IN_PROGRESS = 27
+ERR_UNSUPPORTED_SASL_MECHANISM = 33
+ERR_ILLEGAL_SASL_STATE = 34
 ERR_TOPIC_ALREADY_EXISTS = 36
+ERR_SASL_AUTHENTICATION_FAILED = 58
 
 ERROR_NAMES = {
     ERR_OFFSET_OUT_OF_RANGE: "OFFSET_OUT_OF_RANGE",
@@ -228,9 +250,18 @@ class WireRecord:
 def encode_record_batch(
     records: list[tuple[bytes | None, bytes | None, list[tuple[str, bytes | None]]]],
     base_timestamp: int,
+    compression: str | None = None,
 ) -> bytes:
     """``records``: (key, value, headers) triples → one batch with base
-    offset 0 (the broker rewrites offsets on append)."""
+    offset 0 (the broker rewrites offsets on append).
+
+    ``compression``: None or ``"gzip"`` (the codec every broker and every
+    client decompresses; producers wanting snappy/lz4/zstd on the wire
+    should use the SDK lane)."""
+    if compression not in (None, "gzip"):
+        raise ValueError(
+            f"produce compression {compression!r} not supported (gzip only)"
+        )
     body = Writer()
     for i, (key, value, headers) in enumerate(records):
         rec = Writer()
@@ -254,16 +285,21 @@ def encode_record_batch(
         encoded = rec.done()
         body.varint(len(encoded)).raw(encoded)
 
+    records_part = body.done()
+    attributes = 0
+    if compression == "gzip":
+        attributes = 1
+        records_part = _gzip_compress(records_part)
     # the part the CRC covers: attributes .. records
     crc_part = (
         Writer()
-        .i16(0)                               # attributes (no compression)
+        .i16(attributes)                      # compression codec in bits 0-2
         .i32(len(records) - 1)                # lastOffsetDelta
         .i64(base_timestamp)                  # baseTimestamp
         .i64(base_timestamp)                  # maxTimestamp
         .i64(-1).i16(-1).i32(-1)              # producer id/epoch/baseSequence
         .i32(len(records))
-        .raw(body.done())
+        .raw(records_part)
         .done()
     )
     head = (
@@ -278,9 +314,71 @@ def encode_record_batch(
     return head.done()
 
 
+def _gzip_compress(data: bytes) -> bytes:
+    co = zlib.compressobj(6, zlib.DEFLATED, 16 + zlib.MAX_WBITS)
+    return co.compress(data) + co.flush()
+
+
+_CODEC_NAMES = {1: "gzip", 2: "snappy", 3: "lz4", 4: "zstd"}
+
+
+def decompress_records(codec: int, data: bytes) -> bytes:
+    """Decompress a batch's records section. gzip rides stdlib zlib; zstd
+    the ``zstandard`` package (present in this image); snappy/lz4 need
+    libraries absent here — the error names the codec and the library so
+    the operator knows exactly what the producer must change (or install).
+    """
+    if codec == 1:  # gzip
+        return zlib.decompress(data, 16 + zlib.MAX_WBITS)
+    if codec == 4:  # zstd
+        try:
+            import zstandard
+        except ImportError:
+            raise KafkaProtocolError(
+                -1, "zstd-compressed batch but the 'zstandard' package is "
+                    "not installed"
+            ) from None
+        # streaming decompress: real producers (zstd-jni's output stream)
+        # emit frames WITHOUT the content-size header field, which the
+        # one-shot decompress() refuses
+        return zstandard.ZstdDecompressor().decompressobj().decompress(data)
+    if codec in (2, 3):  # snappy (xerial framing) / lz4 (frame format)
+        name = _CODEC_NAMES[codec]
+        lib = {"snappy": "python-snappy", "lz4": "lz4"}[name]
+        try:
+            if codec == 2:
+                import snappy  # noqa: F401
+            else:
+                import lz4.frame  # noqa: F401
+        except ImportError:
+            raise KafkaProtocolError(
+                -1,
+                f"{name}-compressed batch but the '{lib}' package is not "
+                f"installed in this image; reconfigure the producing side "
+                f"to gzip/zstd/none or install {lib}",
+            ) from None
+        if codec == 2:
+            import snappy
+
+            # java producers wrap snappy in xerial block framing
+            if data[:8] == b"\x82SNAPPY\x00":
+                r = Reader(data, 16)
+                chunks = []
+                while r.remaining() > 0:
+                    chunks.append(snappy.decompress(r.raw(r.i32())))
+                return b"".join(chunks)
+            return snappy.decompress(data)
+        import lz4.frame
+
+        return lz4.frame.decompress(data)
+    raise KafkaProtocolError(-1, f"unknown compression codec {codec}")
+
+
 def decode_record_batches(data: bytes) -> list[WireRecord]:
     """Decode a record set (possibly several batches back to back);
-    validates each batch's CRC32C."""
+    validates each batch's CRC32C. Compressed batches (gzip/zstd here;
+    snappy/lz4 with the libraries installed) are decompressed before
+    record parsing — see :func:`decompress_records`."""
     out: list[WireRecord] = []
     r = Reader(data)
     while r.remaining() >= 61:  # batch header floor
@@ -302,15 +400,16 @@ def decode_record_batches(data: bytes) -> list[WireRecord]:
             # control batch (transaction commit/abort markers from other
             # producers on a shared cluster) — never application records
             continue
-        if attributes & 0x07:
-            raise KafkaProtocolError(
-                -1, f"compressed batches unsupported (codec {attributes & 7})"
-            )
         batch.i32()                           # lastOffsetDelta
         base_ts = batch.i64()
         batch.i64()                           # maxTimestamp
         batch.i64(); batch.i16(); batch.i32() # producer id/epoch/seq
         count = batch.i32()
+        codec = attributes & 0x07
+        if codec:
+            batch = Reader(
+                decompress_records(codec, batch.raw(batch.remaining()))
+            )
         for _ in range(count):
             length = batch.varint()
             rec = Reader(batch.raw(length))
@@ -416,22 +515,278 @@ def range_assign(
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# security: TLS + SASL (PLAIN, SCRAM-SHA-256/-512)
+# ---------------------------------------------------------------------------
+
+
+_JAAS_FIELD = re.compile(r'(\w+)\s*=\s*"((?:[^"\\]|\\.)*)"')
+
+
+@dataclass
+class KafkaSecurity:
+    """Connection security for the wire client, mirroring the Java client
+    properties the reference's instances carry (``security.protocol``,
+    ``sasl.mechanism``, ``sasl.jaas.config``)."""
+
+    protocol: str = "PLAINTEXT"  # PLAINTEXT | SSL | SASL_PLAINTEXT | SASL_SSL
+    mechanism: str = "PLAIN"     # PLAIN | SCRAM-SHA-256 | SCRAM-SHA-512
+    username: str | None = None
+    password: str | None = None
+    ssl_cafile: str | None = None
+    ssl_verify: bool = True           # False → CERT_NONE (+ no hostname)
+    ssl_check_hostname: bool = True   # False → chain verified, name not
+                                      # (Java: empty endpoint-identification
+                                      # algorithm disables ONLY the name check)
+    ssl_context: ssl_module.SSLContext | None = None  # overrides the above
+
+    @property
+    def use_tls(self) -> bool:
+        return self.protocol in ("SSL", "SASL_SSL")
+
+    @property
+    def use_sasl(self) -> bool:
+        return self.protocol in ("SASL_PLAINTEXT", "SASL_SSL")
+
+    def build_ssl_context(self) -> ssl_module.SSLContext:
+        if self.ssl_context is not None:
+            return self.ssl_context
+        ctx = ssl_module.create_default_context(cafile=self.ssl_cafile)
+        if not self.ssl_check_hostname or not self.ssl_verify:
+            ctx.check_hostname = False
+        if not self.ssl_verify:
+            ctx.verify_mode = ssl_module.CERT_NONE
+        return ctx
+
+    @classmethod
+    def from_client_properties(
+        cls, props: dict[str, Any]
+    ) -> "KafkaSecurity | None":
+        """Java-client-style properties → KafkaSecurity (None = plaintext).
+
+        Credentials come from ``sasl.jaas.config`` (the reference's style:
+        ``PlainLoginModule required username="..." password="...";``) or
+        the flatter ``sasl.username``/``sasl.password`` pair."""
+        protocol = str(props.get("security.protocol", "PLAINTEXT")).upper()
+        if protocol == "PLAINTEXT":
+            return None
+        if protocol not in ("SSL", "SASL_PLAINTEXT", "SASL_SSL"):
+            raise ValueError(
+                f"security.protocol {protocol!r} not supported "
+                "(PLAINTEXT|SSL|SASL_PLAINTEXT|SASL_SSL)"
+            )
+        username = props.get("sasl.username")
+        password = props.get("sasl.password")
+        jaas = props.get("sasl.jaas.config")
+        if jaas and (username is None or password is None):
+            fields = {
+                # JAAS quoted values escape \" and \\ — unescape them, as
+                # the Java client does
+                k: re.sub(r"\\(.)", r"\1", v)
+                for k, v in _JAAS_FIELD.findall(str(jaas))
+            }
+            username = username or fields.get("username")
+            password = password or fields.get("password")
+        mechanism = str(props.get("sasl.mechanism", "PLAIN")).upper()
+        if protocol.startswith("SASL"):
+            if mechanism not in ("PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512"):
+                raise ValueError(
+                    f"sasl.mechanism {mechanism!r} not supported "
+                    "(PLAIN|SCRAM-SHA-256|SCRAM-SHA-512)"
+                )
+            if username is None or password is None:
+                raise ValueError(
+                    f"{protocol} requires credentials: set sasl.jaas.config "
+                    "(username=\"..\" password=\"..\") or "
+                    "sasl.username/sasl.password"
+                )
+        # "" disables endpoint identification (the HOSTNAME check only —
+        # the chain is still verified) in the Java client; honour it
+        ident = props.get("ssl.endpoint.identification.algorithm")
+        verify = str(props.get("ssl.verify", "true")).lower() not in (
+            "false", "0", "no"
+        )
+        return cls(
+            protocol=protocol,
+            mechanism=mechanism,
+            username=username,
+            password=password,
+            ssl_cafile=props.get("ssl.ca.location"),
+            ssl_verify=verify,
+            ssl_check_hostname=ident != "",
+        )
+
+
+def _scram_escape(name: str) -> str:
+    return name.replace("=", "=3D").replace(",", "=2C")
+
+
+class ScramClient:
+    """RFC 5802 client for SCRAM-SHA-256/-512 (stdlib only). Stateful over
+    the three-message exchange; verifies the server signature so a broker
+    that doesn't know the password is detected, not just the reverse."""
+
+    def __init__(self, mechanism: str, username: str, password: str,
+                 nonce: str | None = None):
+        self._hash = {
+            "SCRAM-SHA-256": hashlib.sha256,
+            "SCRAM-SHA-512": hashlib.sha512,
+        }[mechanism]
+        self._hash_name = self._hash().name
+        self.username = username
+        self.password = password.encode("utf-8")
+        self.nonce = nonce or secrets.token_urlsafe(24)
+        self._client_first_bare = ""
+        self._auth_message = b""
+        self._salted = b""
+
+    def client_first(self) -> bytes:
+        self._client_first_bare = (
+            f"n={_scram_escape(self.username)},r={self.nonce}"
+        )
+        return ("n,," + self._client_first_bare).encode("utf-8")
+
+    def client_final(self, server_first: bytes) -> bytes:
+        text = server_first.decode("utf-8")
+        fields = dict(p.split("=", 1) for p in text.split(","))
+        server_nonce, salt, iters = fields["r"], fields["s"], int(fields["i"])
+        if not server_nonce.startswith(self.nonce):
+            raise KafkaProtocolError(
+                ERR_SASL_AUTHENTICATION_FAILED,
+                "SCRAM server nonce does not extend the client nonce",
+            )
+        self._salted = hashlib.pbkdf2_hmac(
+            self._hash_name, self.password, base64.b64decode(salt), iters
+        )
+        client_key = hmac.new(self._salted, b"Client Key", self._hash).digest()
+        stored_key = self._hash(client_key).digest()
+        without_proof = f"c=biws,r={server_nonce}"
+        self._auth_message = ",".join(
+            [self._client_first_bare, text, without_proof]
+        ).encode("utf-8")
+        signature = hmac.new(stored_key, self._auth_message, self._hash).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+        return final.encode("utf-8")
+
+    def verify_server_final(self, server_final: bytes) -> None:
+        fields = dict(
+            p.split("=", 1) for p in server_final.decode("utf-8").split(",")
+        )
+        if "e" in fields:
+            raise KafkaProtocolError(
+                ERR_SASL_AUTHENTICATION_FAILED, f"SCRAM: {fields['e']}"
+            )
+        server_key = hmac.new(self._salted, b"Server Key", self._hash).digest()
+        expected = hmac.new(server_key, self._auth_message, self._hash).digest()
+        if not hmac.compare_digest(
+            base64.b64decode(fields["v"]), expected
+        ):
+            raise KafkaProtocolError(
+                ERR_SASL_AUTHENTICATION_FAILED,
+                "SCRAM server signature mismatch (broker does not know "
+                "the password?)",
+            )
+
+
 class _Conn:
     """One broker connection; requests are serialized (correlation ids
     still checked). The runtime's per-agent access pattern is sequential."""
 
-    def __init__(self, host: str, port: int, client_id: str):
+    def __init__(self, host: str, port: int, client_id: str,
+                 security: KafkaSecurity | None = None):
         self.host, self.port = host, port
         self.client_id = client_id
+        self.security = security
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._correlation = 0
         self._lock = asyncio.Lock()
 
     async def connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+        sec = self.security
+        if sec is not None and sec.use_tls:
+            ctx = sec.build_ssl_context()
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port, ssl=ctx,
+                server_hostname=self.host if ctx.check_hostname else None,
+            )
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port
+            )
+        if sec is not None and sec.use_sasl:
+            try:
+                await self._sasl_authenticate(sec)
+            except BaseException:
+                self._writer.close()
+                self._writer = self._reader = None
+                raise
+
+    async def _roundtrip(self, api_key: int, version: int,
+                         payload: bytes) -> Reader:
+        """One request/response WITHOUT the lock (connect-time SASL runs
+        inside ``call``'s lock already)."""
+        self._correlation += 1
+        cid = self._correlation
+        header = (
+            Writer()
+            .i16(api_key).i16(version).i32(cid)
+            .string(self.client_id)
+            .done()
         )
+        frame = header + payload
+        self._writer.write(struct.pack(">i", len(frame)) + frame)
+        await self._writer.drain()
+        (size,) = struct.unpack(">i", await self._reader.readexactly(4))
+        body = await self._reader.readexactly(size)
+        r = Reader(body)
+        got = r.i32()
+        if got != cid:
+            raise KafkaProtocolError(
+                -1, f"correlation mismatch (sent {cid}, got {got})"
+            )
+        return r
+
+    async def _sasl_call(self, token: bytes) -> bytes:
+        """SaslAuthenticate v0 exchange; raises on broker auth errors."""
+        r = await self._roundtrip(
+            API_SASL_AUTHENTICATE, 0, Writer().bytes_(token).done()
+        )
+        error = r.i16()
+        message = r.string()
+        auth_bytes = r.bytes_() or b""
+        if error:
+            raise KafkaProtocolError(
+                error, f"SASL authentication failed: {message or 'denied'}"
+            )
+        return auth_bytes
+
+    async def _sasl_authenticate(self, sec: KafkaSecurity) -> None:
+        r = await self._roundtrip(
+            API_SASL_HANDSHAKE, 1, Writer().string(sec.mechanism).done()
+        )
+        error = r.i16()
+        if error:
+            supported = r.array(lambda rr: rr.string())
+            raise KafkaProtocolError(
+                error,
+                f"broker rejected SASL mechanism {sec.mechanism} "
+                f"(supports: {supported})",
+            )
+        if sec.mechanism == "PLAIN":
+            token = (
+                b"\x00" + sec.username.encode("utf-8")
+                + b"\x00" + sec.password.encode("utf-8")
+            )
+            await self._sasl_call(token)
+        else:  # SCRAM
+            scram = ScramClient(sec.mechanism, sec.username, sec.password)
+            server_first = await self._sasl_call(scram.client_first())
+            server_final = await self._sasl_call(
+                scram.client_final(server_first)
+            )
+            scram.verify_server_final(server_final)
 
     async def close(self) -> None:
         if self._writer is not None:
@@ -446,39 +801,18 @@ class _Conn:
         async with self._lock:
             if self._writer is None:
                 await self.connect()
-            self._correlation += 1
-            cid = self._correlation
-            header = (
-                Writer()
-                .i16(api_key).i16(version).i32(cid)
-                .string(self.client_id)
-                .done()
-            )
-            frame = header + payload
             try:
-                self._writer.write(struct.pack(">i", len(frame)) + frame)
-                await self._writer.drain()
-                (size,) = struct.unpack(
-                    ">i", await self._reader.readexactly(4)
-                )
-                body = await self._reader.readexactly(size)
+                return await self._roundtrip(api_key, version, payload)
             except (OSError, asyncio.IncompleteReadError, ConnectionError):
                 # brokers drop idle connections (connections.max.idle.ms):
                 # a dead socket must not poison every later call — drop it
-                # so the next call redials
+                # so the next call redials (and re-authenticates)
                 try:
                     self._writer.close()
                 except Exception:
                     pass
                 self._writer = self._reader = None
                 raise
-            r = Reader(body)
-            got = r.i32()
-            if got != cid:
-                raise KafkaProtocolError(
-                    -1, f"correlation mismatch (sent {cid}, got {got})"
-                )
-            return r
 
 
 @dataclass
@@ -491,10 +825,12 @@ class KafkaWireClient:
     """Metadata-aware client: routes produce/fetch to partition leaders,
     refreshes metadata on NOT_LEADER / UNKNOWN_TOPIC errors."""
 
-    def __init__(self, bootstrap: str, client_id: str = "langstream-tpu"):
+    def __init__(self, bootstrap: str, client_id: str = "langstream-tpu",
+                 security: KafkaSecurity | None = None):
         host, _, port = bootstrap.partition(":")
         self.bootstrap = (host, int(port or 9092))
         self.client_id = client_id
+        self.security = security
         self._conns: dict[int, _Conn] = {}
         self._bootstrap_conn: _Conn | None = None
         self.brokers: dict[int, tuple[str, int]] = {}
@@ -503,7 +839,9 @@ class KafkaWireClient:
 
     async def _boot(self) -> _Conn:
         if self._bootstrap_conn is None:
-            self._bootstrap_conn = _Conn(*self.bootstrap, self.client_id)
+            self._bootstrap_conn = _Conn(
+                *self.bootstrap, self.client_id, security=self.security
+            )
             await self._bootstrap_conn.connect()
         return self._bootstrap_conn
 
@@ -518,7 +856,7 @@ class KafkaWireClient:
     async def _node(self, node_id: int) -> _Conn:
         if node_id not in self._conns:
             host, port = self.brokers.get(node_id, self.bootstrap)
-            conn = _Conn(host, port, self.client_id)
+            conn = _Conn(host, port, self.client_id, security=self.security)
             await conn.connect()
             self._conns[node_id] = conn
         return self._conns[node_id]
@@ -593,9 +931,10 @@ class KafkaWireClient:
         timestamp_ms: int,
         acks: int = -1,
         timeout_ms: int = 30000,
+        compression: str | None = None,
     ) -> int:
         """→ base offset assigned by the broker."""
-        batch = encode_record_batch(records, timestamp_ms)
+        batch = encode_record_batch(records, timestamp_ms, compression)
         for attempt in range(2):
             conn = await self._leader_conn(topic, partition)
             w = (

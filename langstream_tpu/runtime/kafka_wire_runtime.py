@@ -51,6 +51,7 @@ from langstream_tpu.runtime.kafka_wire import (
     ERR_REBALANCE_IN_PROGRESS,
     ERR_UNKNOWN_MEMBER_ID,
     KafkaProtocolError,
+    KafkaSecurity,
     KafkaWireClient,
     WireRecord,
     range_assign,
@@ -239,13 +240,14 @@ class WireKafkaTopicConsumer(TopicConsumer):
         poll_timeout_ms: int = 500,
         assignment: str = "static",
         session_timeout_ms: int = 10000,
+        security: KafkaSecurity | None = None,
     ):
         self.topic = topic
         self.group = group
         self.replica_index = replica_index
         self.num_replicas = max(1, num_replicas)
         self.poll_timeout_ms = poll_timeout_ms
-        self.client = KafkaWireClient(bootstrap)
+        self.client = KafkaWireClient(bootstrap, security=security)
         self.tracker = ContiguousOffsetTracker()
         self.membership = (
             GroupMembership(
@@ -396,9 +398,12 @@ class WireKafkaTopicConsumer(TopicConsumer):
 
 
 class WireKafkaTopicProducer(TopicProducer):
-    def __init__(self, bootstrap: str, topic: str):
+    def __init__(self, bootstrap: str, topic: str,
+                 security: KafkaSecurity | None = None,
+                 compression: str | None = None):
         self.topic = topic
-        self.client = KafkaWireClient(bootstrap)
+        self.client = KafkaWireClient(bootstrap, security=security)
+        self.compression = compression
         self._partitions: list[int] = []
         self._rr = 0
         self._in = 0
@@ -428,6 +433,7 @@ class WireKafkaTopicProducer(TopicProducer):
         await self.client.produce(
             self.topic, partition, [(key, value, headers)],
             timestamp_ms=record.timestamp or now_millis(),
+            compression=self.compression,
         )
         self._in += 1
 
@@ -438,10 +444,11 @@ class WireKafkaTopicProducer(TopicProducer):
 class WireKafkaTopicReader(TopicReader):
     """Position-addressed reader (gateway consume side); no group."""
 
-    def __init__(self, bootstrap: str, topic: str, initial_position: str):
+    def __init__(self, bootstrap: str, topic: str, initial_position: str,
+                 security: KafkaSecurity | None = None):
         self.topic = topic
         self.initial_position = initial_position
-        self.client = KafkaWireClient(bootstrap)
+        self.client = KafkaWireClient(bootstrap, security=security)
         self._positions: dict[int, int] = {}
 
     async def start(self) -> None:
@@ -468,15 +475,17 @@ class WireKafkaTopicReader(TopicReader):
 
 
 class WireKafkaTopicAdmin(TopicAdmin):
-    def __init__(self, bootstrap: str):
+    def __init__(self, bootstrap: str,
+                 security: KafkaSecurity | None = None):
         self.bootstrap = bootstrap
+        self.security = security
 
     async def create_topic(
         self, name: str, partitions: int = 1,
         options: dict[str, Any] | None = None,
     ) -> None:
         opts = options or {}
-        client = KafkaWireClient(self.bootstrap)
+        client = KafkaWireClient(self.bootstrap, security=self.security)
         try:
             await client.create_topic(
                 name,
@@ -490,7 +499,7 @@ class WireKafkaTopicAdmin(TopicAdmin):
             await client.close()
 
     async def delete_topic(self, name: str) -> None:
-        client = KafkaWireClient(self.bootstrap)
+        client = KafkaWireClient(self.bootstrap, security=self.security)
         try:
             await client.delete_topic(name)
         finally:
@@ -593,6 +602,28 @@ class WireKafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
             or conf.get("bootstrap")
             or "127.0.0.1:9092"
         ).split(",")[0]
+        # SASL/TLS: the reference's cloud instances put the Java client
+        # security properties in the same admin/consumer/producer maps
+        # (examples/instances/astra.yaml) — merge, admin lowest precedence
+        props = {
+            **admin,
+            **conf.get("consumer", {}),
+            **conf.get("producer", {}),
+        }
+        self.security = KafkaSecurity.from_client_properties(props)
+        ctype = str(
+            conf.get("producer", {}).get("compression.type", "none")
+        ).lower()
+        if ctype in ("none", ""):
+            self.compression = None
+        elif ctype == "gzip":
+            self.compression = "gzip"
+        else:
+            raise ValueError(
+                f"wire lane produce compression.type {ctype!r} not "
+                "supported (none|gzip); consumption decompresses "
+                "gzip/zstd regardless"
+            )
 
     def create_consumer(self, agent_id: str, config: dict[str, Any]) -> TopicConsumer:
         replica, replicas = _replica_hints(config)
@@ -605,17 +636,22 @@ class WireKafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
             poll_timeout_ms=int(float(config.get("poll-timeout", 0.5)) * 1000),
             assignment=str(config.get("assignment", "static")).lower(),
             session_timeout_ms=int(config.get("session-timeout-ms", 10000)),
+            security=self.security,
         )
 
     def create_producer(self, agent_id: str, config: dict[str, Any]) -> TopicProducer:
-        return WireKafkaTopicProducer(self.bootstrap, topic=config["topic"])
+        return WireKafkaTopicProducer(
+            self.bootstrap, topic=config["topic"], security=self.security,
+            compression=self.compression,
+        )
 
     def create_reader(
         self, config: dict[str, Any], initial_position: str = "latest"
     ) -> TopicReader:
         return WireKafkaTopicReader(
-            self.bootstrap, config["topic"], initial_position
+            self.bootstrap, config["topic"], initial_position,
+            security=self.security,
         )
 
     def create_topic_admin(self) -> TopicAdmin:
-        return WireKafkaTopicAdmin(self.bootstrap)
+        return WireKafkaTopicAdmin(self.bootstrap, security=self.security)

@@ -17,6 +17,10 @@ OffsetFetch(1) CreateTopics(1) DeleteTopics(1).
 from __future__ import annotations
 
 import asyncio
+import base64
+import hashlib
+import hmac
+import secrets
 import struct
 import threading
 from dataclasses import dataclass, field
@@ -35,6 +39,8 @@ from langstream_tpu.runtime.kafka_wire import (
     API_OFFSET_COMMIT,
     API_OFFSET_FETCH,
     API_PRODUCE,
+    API_SASL_AUTHENTICATE,
+    API_SASL_HANDSHAKE,
     API_SYNC_GROUP,
     ERR_ILLEGAL_GENERATION,
     ERR_NONE,
@@ -42,11 +48,77 @@ from langstream_tpu.runtime.kafka_wire import (
     ERR_REBALANCE_IN_PROGRESS,
     ERR_TOPIC_ALREADY_EXISTS,
     ERR_UNKNOWN_MEMBER_ID,
+    ERR_SASL_AUTHENTICATION_FAILED,
     ERR_UNKNOWN_TOPIC_OR_PARTITION,
+    ERR_UNSUPPORTED_SASL_MECHANISM,
     Reader,
     Writer,
     crc32c,
 )
+
+
+class _ScramServerState:
+    """Independent server side of SCRAM-SHA-256/-512 (own derivation — a
+    client bug shows up as a proof-verification failure here, not a
+    self-consistent round trip)."""
+
+    def __init__(self, mechanism: str, username: str, password: str):
+        self.hash = {
+            "SCRAM-SHA-256": hashlib.sha256,
+            "SCRAM-SHA-512": hashlib.sha512,
+        }[mechanism]
+        self.username = username
+        self.password = password
+        self.stage = "first"
+        self.salt = secrets.token_bytes(16)
+        self.iterations = 4096
+        self.client_first_bare = ""
+        self.server_first = ""
+
+    def handle_first(self, token: bytes) -> bytes:
+        text = token.decode("utf-8")
+        assert text.startswith("n,,"), f"unexpected GS2 header in {text!r}"
+        self.client_first_bare = text[3:]
+        fields = dict(p.split("=", 1) for p in self.client_first_bare.split(","))
+        user = fields["n"].replace("=2C", ",").replace("=3D", "=")
+        if user != self.username:
+            raise PermissionError(f"unknown user {user!r}")
+        server_nonce = fields["r"] + secrets.token_urlsafe(18)
+        self.server_first = (
+            f"r={server_nonce},"
+            f"s={base64.b64encode(self.salt).decode()},i={self.iterations}"
+        )
+        self.stage = "final"
+        return self.server_first.encode("utf-8")
+
+    def handle_final(self, token: bytes) -> bytes:
+        text = token.decode("utf-8")
+        without_proof, _, proof_b64 = text.rpartition(",p=")
+        fields = dict(p.split("=", 1) for p in without_proof.split(","))
+        server_nonce = dict(
+            p.split("=", 1) for p in self.server_first.split(",")
+        )["r"]
+        if fields.get("r") != server_nonce:
+            raise PermissionError("nonce mismatch")
+        salted = hashlib.pbkdf2_hmac(
+            self.hash().name, self.password.encode(), self.salt,
+            self.iterations,
+        )
+        client_key = hmac.new(salted, b"Client Key", self.hash).digest()
+        stored_key = self.hash(client_key).digest()
+        auth_message = ",".join(
+            [self.client_first_bare, self.server_first, without_proof]
+        ).encode("utf-8")
+        signature = hmac.new(stored_key, auth_message, self.hash).digest()
+        recovered = bytes(
+            a ^ b for a, b in zip(base64.b64decode(proof_b64), signature)
+        )
+        if self.hash(recovered).digest() != stored_key:
+            raise PermissionError("SCRAM proof invalid (bad password)")
+        server_key = hmac.new(salted, b"Server Key", self.hash).digest()
+        server_sig = hmac.new(server_key, auth_message, self.hash).digest()
+        self.stage = "done"
+        return b"v=" + base64.b64encode(server_sig)
 
 
 @dataclass
@@ -90,12 +162,22 @@ class _Group:
 
 
 class FakeKafkaBroker:
-    def __init__(self, join_window: float = 1.0) -> None:
+    def __init__(self, join_window: float = 1.0,
+                 sasl: dict[str, tuple[str, str]] | None = None,
+                 ssl_context=None) -> None:
+        """``sasl``: mechanism -> (username, password); when set, every
+        connection must SaslHandshake+SaslAuthenticate before any other
+        API (pre-auth requests close the connection, like a real broker).
+        ``ssl_context``: server-side ``ssl.SSLContext`` for TLS listeners.
+        """
         self.topics: dict[str, dict[int, _Partition]] = {}
         self.offsets: dict[tuple[str, str, int], int] = {}
         self.groups: dict[str, _Group] = {}
         self.join_window = join_window
+        self.sasl = sasl
+        self.ssl_context = ssl_context
         self.requests: list[tuple[int, int]] = []  # (api_key, version) seen
+        self.auth_failures = 0
         self._server: asyncio.AbstractServer | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
@@ -114,7 +196,7 @@ class FakeKafkaBroker:
 
             async def _serve():
                 self._server = await asyncio.start_server(
-                    self._client, self.host, 0
+                    self._client, self.host, 0, ssl=self.ssl_context
                 )
                 self.port = self._server.sockets[0].getsockname()[1]
                 started.set()
@@ -158,11 +240,18 @@ class FakeKafkaBroker:
             assert crc32c(body[9:]) == crc, "client batch CRC invalid"
             r = Reader(body, 9)
             attributes = r.i16()
-            assert attributes & 0x07 == 0, "unexpected compression"
+            codec = attributes & 0x07
+            assert codec in (0, 1), f"server only speaks gzip, got codec {codec}"
             r.i32()                       # lastOffsetDelta
             base_ts = r.i64()
             r.i64(); r.i64(); r.i16(); r.i32()
             count = r.i32()
+            if codec == 1:
+                # independent decompression: stdlib gzip (the client uses
+                # zlib.compressobj — a framing bug would fail here)
+                import gzip as _gzip
+
+                r = Reader(_gzip.decompress(r.raw(r.remaining())))
             for _ in range(count):
                 length = r.varint()
                 rec = Reader(r.raw(length))
@@ -332,6 +421,10 @@ class FakeKafkaBroker:
 
     async def _client(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        # per-connection SASL session: mechanism chosen by handshake, then
+        # token exchange, then (and only then) the normal APIs
+        session = {"authenticated": self.sasl is None, "scram": None,
+                   "mechanism": None}
         try:
             while True:
                 size_raw = await reader.readexactly(4)
@@ -343,7 +436,15 @@ class FakeKafkaBroker:
                 correlation = r.i32()
                 r.string()  # client id
                 self.requests.append((api_key, version))
-                payload = await self._dispatch(api_key, version, r)
+                if api_key in (API_SASL_HANDSHAKE, API_SASL_AUTHENTICATE):
+                    payload = self._dispatch_sasl(api_key, r, session)
+                elif not session["authenticated"]:
+                    # real brokers drop unauthenticated connections that
+                    # send normal APIs — the client sees a reset
+                    self.auth_failures += 1
+                    return
+                else:
+                    payload = await self._dispatch(api_key, version, r)
                 body = Writer().i32(correlation).raw(payload).done()
                 writer.write(struct.pack(">i", len(body)) + body)
                 await writer.drain()
@@ -351,6 +452,54 @@ class FakeKafkaBroker:
             pass
         finally:
             writer.close()
+
+    def _dispatch_sasl(self, api_key: int, r: Reader, session: dict) -> bytes:
+        if api_key == API_SASL_HANDSHAKE:
+            mechanism = r.string()
+            if self.sasl is None or mechanism not in self.sasl:
+                supported = sorted(self.sasl or {})
+                w = Writer().i16(ERR_UNSUPPORTED_SASL_MECHANISM)
+                w.array(supported, lambda wr, m: wr.string(m))
+                return w.done()
+            session["mechanism"] = mechanism
+            if mechanism.startswith("SCRAM"):
+                user, pw = self.sasl[mechanism]
+                session["scram"] = _ScramServerState(mechanism, user, pw)
+            return (
+                Writer().i16(ERR_NONE)
+                .array([mechanism], lambda wr, m: wr.string(m)).done()
+            )
+        # SaslAuthenticate v0: auth_bytes in, (error, message, bytes) out
+        token = r.bytes_() or b""
+
+        def _fail(msg: str) -> bytes:
+            self.auth_failures += 1
+            return (
+                Writer().i16(ERR_SASL_AUTHENTICATION_FAILED)
+                .string(msg).bytes_(b"").done()
+            )
+
+        mechanism = session.get("mechanism")
+        if mechanism is None:
+            return _fail("SaslAuthenticate before SaslHandshake")
+        if mechanism == "PLAIN":
+            parts = token.split(b"\x00")
+            user, pw = self.sasl["PLAIN"]
+            if len(parts) != 3 or parts[1].decode() != user \
+                    or parts[2].decode() != pw:
+                return _fail("invalid PLAIN credentials")
+            session["authenticated"] = True
+            return Writer().i16(ERR_NONE).string(None).bytes_(b"").done()
+        scram: _ScramServerState = session["scram"]
+        try:
+            if scram.stage == "first":
+                out = scram.handle_first(token)
+            else:
+                out = scram.handle_final(token)
+                session["authenticated"] = True
+            return Writer().i16(ERR_NONE).string(None).bytes_(out).done()
+        except (PermissionError, KeyError, ValueError, AssertionError) as e:
+            return _fail(str(e))
 
     async def _dispatch(self, api_key: int, version: int, r: Reader) -> bytes:
         if api_key == API_API_VERSIONS:
@@ -363,6 +512,7 @@ class FakeKafkaBroker:
                 (API_HEARTBEAT, 0, 1), (API_LEAVE_GROUP, 0, 1),
                 (API_SYNC_GROUP, 0, 1), (API_API_VERSIONS, 0, 0),
                 (API_CREATE_TOPICS, 0, 1), (API_DELETE_TOPICS, 0, 1),
+                (API_SASL_HANDSHAKE, 0, 1), (API_SASL_AUTHENTICATE, 0, 0),
             ]
             w.i32(len(keys))
             for k, lo, hi in keys:
