@@ -80,15 +80,27 @@ class TextExtractorAgent(SingleRecordProcessor):
     def _extract_bytes(self, raw: bytes) -> str:
         if raw[:4] == b"%PDF":
             try:
-                from pypdf import PdfReader  # optional
+                from pypdf import PdfReader  # optional, better coverage
                 import io
 
                 reader = PdfReader(io.BytesIO(raw))
                 return "\n".join(page.extract_text() or "" for page in reader.pages)
             except ImportError:
-                raise RuntimeError(
-                    "pdf extraction requires the optional 'pypdf' library"
-                )
+                # in-tree fallback: content-stream scanning (agents/
+                # pdftext.py documents its honest coverage — the common
+                # digitally-produced case works, scanned/CID-font PDFs
+                # need pypdf)
+                from langstream_tpu.agents.pdftext import extract_pdf_text
+
+                return extract_pdf_text(raw)
+        from langstream_tpu.agents.pdftext import (
+            extract_ooxml_text,
+            sniff_ooxml_kind,
+        )
+
+        kind = sniff_ooxml_kind(raw)
+        if kind is not None:
+            return extract_ooxml_text(raw, kind)
         text = raw.decode("utf-8", errors="replace")
         if "<html" in text.lower():
             return self._extract_html(text)

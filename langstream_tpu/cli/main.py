@@ -727,5 +727,10 @@ def run_local(name, app, instance, secrets, api_port, gateway_port,
         click.echo("\nstopped")
 
 
+from langstream_tpu.cli.mini import mini  # noqa: E402  (click group)
+
+cli.add_command(mini)
+
+
 if __name__ == "__main__":
     cli()

@@ -42,7 +42,9 @@ async def main() -> None:
 
         api = HttpKubeApi.in_cluster()
         image = os.environ.get("LS_RUNTIME_IMAGE", "langstream-tpu/runtime:latest")
-        store = KubernetesApplicationStore(api, runtime_image=image)
+        store = KubernetesApplicationStore(
+            api, runtime_image=image, code_storage_config=code_storage
+        )
         compute = KubernetesComputeRuntime(
             api, image=image, code_storage_config=code_storage
         )

@@ -50,6 +50,12 @@ class StoredApplication:
     # consumed by the tenant quota check (parity: per-tenant unit quotas,
     # ApplicationService.java:98-121)
     units: int = 0
+    # code-storage archive id, stamped by the compute runtime at deploy so
+    # the k8s store persists it into the Application CR — the operator's
+    # deployer Job must write the SAME Agent CRs (incl. code coordinates)
+    # the control plane's direct path writes, or the two lanes flap the
+    # StatefulSet template and restart agent pods
+    code_archive_id: str | None = None
 
     def public_view(self) -> dict[str, Any]:
         return {

@@ -213,6 +213,12 @@ class HttpKubeApi(KubeApi):
     def in_cluster(cls) -> "HttpKubeApi":
         import os
 
+        # mini-cluster lane: process-pods (k8s/kubelet.py) are plain OS
+        # processes, not containers — the kubelet hands them the API
+        # server address directly instead of a service-account mount
+        override = os.environ.get("LS_KUBE_API_URL")
+        if override:
+            return cls(override, token=os.environ.get("LS_KUBE_API_TOKEN"))
         host = os.environ["KUBERNETES_SERVICE_HOST"]
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         token = (cls.SA_DIR / "token").read_text().strip()

@@ -73,6 +73,10 @@ class KubernetesComputeRuntime:
             code_archive_id = self.code_storage.store(
                 stored.tenant, stored.name, buf.getvalue()
             )
+        # stamp the archive onto the stored app: the caller's follow-up
+        # put_application persists it into the Application CR, and the
+        # operator's deployer Job then writes byte-identical Agent CRs
+        stored.code_archive_id = code_archive_id
         crs = self.runtime.deploy(stored.tenant, plan, code_archive_id)
         self._plans[key] = plan
         self.append_log(

@@ -31,9 +31,14 @@ TENANT_CM_PREFIX = "langstream-tenant-"
 
 
 class KubernetesApplicationStore(ApplicationStore):
-    def __init__(self, api: KubeApi, runtime_image: str = ""):
+    def __init__(self, api: KubeApi, runtime_image: str = "",
+                 code_storage_config: dict | None = None):
         self.api = api
         self.runtime_image = runtime_image
+        # flows into ApplicationSpec.options so the operator's setup/
+        # deployer Jobs know where archives live (AppController reads
+        # options.codeStorage into the job config document)
+        self.code_storage_config = code_storage_config
 
     # ---- tenants (GlobalMetadataStore role) ------------------------------
 
@@ -100,6 +105,11 @@ class KubernetesApplicationStore(ApplicationStore):
                 tenant=app.tenant,
                 image=self.runtime_image,
                 application=serialized,
+                code_archive_id=app.code_archive_id,
+                options=(
+                    {"codeStorage": self.code_storage_config}
+                    if self.code_storage_config else {}
+                ),
             ),
             status={"status": app.status, "error": app.error},
         )

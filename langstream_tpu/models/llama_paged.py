@@ -114,10 +114,10 @@ def llama_prefill_continue_paged(
     quant = isinstance(pool_k, dict)
     bs = (pool_k["q"] if quant else pool_k).shape[2]
     if quant and kernel != "xla":
-        raise ValueError(
-            "int8 paged pools read through the XLA gather path; the Pallas "
-            "kernels are bf16-only (kernel='xla')"
-        )
+        # the multi-query history-read kernel has no int8 twin yet (the
+        # decode chunk's single-query kernel does); prefill continuations
+        # are a small share of traffic — degrade, don't crash
+        kernel = "xla"
     KhD = c.kv_heads * c.head_dim
     G = c.heads // c.kv_heads
     x = embedding_take(params["embed"], tokens)  # (B, P2, H)
@@ -524,11 +524,16 @@ def llama_decode_chunk_paged(
     c = config
     if ffn is None:
         ffn = _default_ffn
-    if isinstance(pool_k, dict) and kernel != "xla":
-        raise ValueError(
-            "int8 paged pools read through the XLA gather path; the Pallas "
-            "kernels are bf16-only (kernel='xla')"
-        )
+    if (
+        isinstance(pool_k, dict)
+        and kernel != "xla"
+        and mesh is not None
+        and len(mesh.devices.flatten()) > 1
+    ):
+        # the shard_map Pallas wrapper doesn't carry the int8 scale specs
+        # yet; multi-device int8 pools stay on the (sharding-aware) XLA
+        # gather. Single device reads through the in-kernel dequant twin.
+        kernel = "xla"
     B = tokens0.shape[0]
     KhD = c.kv_heads * c.head_dim
     adv = active.astype(jnp.int32)

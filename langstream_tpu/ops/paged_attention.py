@@ -121,10 +121,110 @@ def _paged_kernel(
         l_out[0] = l_ref[:]
 
 
+def _paged_kernel_q8(
+    # int8 twin of _paged_kernel: k/v arrive as int8 blocks with per-(row,
+    # kv-head) f32 scales. The k scale multiplies the SCORE (constant along
+    # D, factored out of the dot); the v scale folds into the probabilities
+    # before the value dot — exactly the fused-dequant discipline of the
+    # XLA path (models/kvquant.py cache_scores/cache_values), so the two
+    # lanes are numerically interchangeable.
+    tables_ref,   # SMEM (B, max_blocks) int32
+    lengths_ref,  # SMEM (B,) int32
+    q_ref,        # (1, H, D)
+    k_ref,        # (1, bs, KhD) int8
+    ks_ref,       # (1, bs, Kh) f32
+    v_ref,        # (1, bs, KhD) int8
+    vs_ref,       # (1, bs, Kh) f32
+    acc_out,      # (1, H, D) f32
+    m_out,        # (1, H, 128) f32
+    l_out,        # (1, H, 128) f32
+    m_ref,        # VMEM (H, 128) f32
+    l_ref,        # VMEM (H, 128) f32
+    acc_ref,      # VMEM (H, D) f32
+    *,
+    scale: float,
+    block_size: int,
+    kv_heads: int,
+    head_dim: int,
+):
+    b = pl.program_id(0)
+    ji = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    length = lengths_ref[b]
+    start = ji * block_size
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(start < length)
+    def _accumulate():
+        H, D = acc_ref.shape
+        G = H // kv_heads
+        q = q_ref[0]                                   # (H, D) bf16
+        # batch-LEADING layouts for both dots: Mosaic rejects batched
+        # matmuls whose int8-converted operand carries the batch dim in a
+        # non-leading position ("batch dims must be equal"), while the
+        # (Kh, bs, D) transpose compiles — chip-probed r5
+        k = jnp.transpose(
+            k_ref[0].astype(q.dtype).reshape(block_size, kv_heads, head_dim),
+            (1, 0, 2),
+        )                                              # (Kh, bs, D)
+        v = jnp.transpose(
+            v_ref[0].astype(q.dtype).reshape(block_size, kv_heads, head_dim),
+            (1, 0, 2),
+        )
+        ks = ks_ref[0]                                 # (bs, Kh) f32
+        vs = vs_ref[0]
+        qg = q.reshape(kv_heads, G, D)
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                              # (Kh, G, bs)
+        # dequant k: the scale is constant along D — apply to the score
+        s = s * jnp.transpose(ks)[:, None, :] * scale
+        s = s.reshape(H, block_size)
+        cols = start + jax.lax.broadcasted_iota(
+            jnp.int32, (H, block_size), 1
+        )
+        mask = cols < length
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, 0]                           # (H,)
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - shift[:, None])
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - shift))
+        l_ref[:] = jnp.broadcast_to(
+            (l_prev * alpha + jnp.sum(p, axis=1))[:, None], l_ref.shape
+        )
+        # dequant v: scale varies along the contracted row axis — fold it
+        # into the probabilities
+        pg = p.reshape(kv_heads, G, block_size)
+        pg = pg * jnp.transpose(vs)[:, None, :]
+        pv = jax.lax.dot_general(
+            pg.astype(q.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                              # (Kh, G, D)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv.reshape(H, D)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(ji == num_j - 1)
+    def _finalize():
+        acc_out[0] = acc_ref[:]
+        m_out[0] = m_ref[:]
+        l_out[0] = l_ref[:]
+
+
 def paged_attention_partial(
     q: jax.Array,             # (B, H, D)
-    k_pool: jax.Array,        # (nb, bs, Kh*D)
-    v_pool: jax.Array,
+    k_pool,                   # (nb, bs, Kh*D) bf16, or int8 {"q","s"} pool
+    v_pool,
     block_tables: jax.Array,  # (B, max_blocks) int32
     lengths: jax.Array,       # (B,) int32 — cache rows to attend per slot
     *,
@@ -138,7 +238,18 @@ def paged_attention_partial(
 
     Returns ``(acc (B,H,D) f32, m (B,H) f32, l (B,H) f32)`` for the caller
     to merge with other segments via :func:`merge_partial_attention`.
+
+    int8 pools (``{"q": int8, "s": f32}`` dicts) read through the in-kernel
+    fused-dequant twin — no densified bf16 window copy, which on the XLA
+    gather path costs more HBM traffic than the weights themselves at
+    serving batch sizes (r5 chip attribution).
     """
+    if isinstance(k_pool, dict):
+        return _paged_attention_partial_q8(
+            q, k_pool, v_pool, block_tables, lengths,
+            num_read_blocks=num_read_blocks, kv_heads=kv_heads,
+            head_dim=head_dim, scale=scale, interpret=interpret,
+        )
     B, H, D = q.shape
     nb, bs, KhD = k_pool.shape
     if scale is None:
@@ -190,6 +301,73 @@ def paged_attention_partial(
         ),
         interpret=interpret,
     )(block_tables, lengths, q, k_pool, v_pool)
+    return acc, m[:, :, 0], l[:, :, 0]
+
+
+def _paged_attention_partial_q8(
+    q: jax.Array,
+    k_pool: dict,
+    v_pool: dict,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    num_read_blocks: int,
+    kv_heads: int,
+    head_dim: int,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    B, H, D = q.shape
+    nb, bs, KhD = k_pool["q"].shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    kernel = functools.partial(
+        _paged_kernel_q8,
+        scale=scale,
+        block_size=bs,
+        kv_heads=kv_heads,
+        head_dim=head_dim,
+    )
+    block = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda b, j, tables, lengths: (tables[b, j], 0, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, num_read_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, H, D), lambda b, j, tables, lengths: (b, 0, 0)
+            ),
+            block((1, bs, KhD)),          # k int8
+            block((1, bs, kv_heads)),     # k scales
+            block((1, bs, KhD)),          # v int8
+            block((1, bs, kv_heads)),     # v scales
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, D), lambda b, j, tables, lengths: (b, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda b, j, tables, lengths: (b, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda b, j, tables, lengths: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_tables, lengths, q, k_pool["q"], k_pool["s"],
+      v_pool["q"], v_pool["s"])
     return acc, m[:, :, 0], l[:, :, 0]
 
 

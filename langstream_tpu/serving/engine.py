@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -482,6 +483,11 @@ class TpuServingEngine:
         self._light_chunks = 0
         self._heavy_chunks = 0
         self._warmup_task: asyncio.Task | None = None
+        # device-side upload caches (content-keyed): block tables and the
+        # sampler/active-mask tuple change rarely between chunks, and each
+        # re-upload is a synchronous ~70ms RPC over a tunneled chip
+        self._tables_dev_cache: tuple[bytes, Any] | None = None
+        self._sampler_dev_cache: tuple[bytes, Any] | None = None
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
 
@@ -617,20 +623,21 @@ class TpuServingEngine:
                 cache_k, cache_v = init_paged_kv_cache(mc, self.paged_layout)
             kernel = self.config.paged_kernel
             if kernel == "auto":
-                # the Pallas kernel is the TPU fast path; under a mesh it
-                # runs per-shard via shard_map (slots on dp, heads on tp).
-                # int8 pools read through the fused XLA gather (the Pallas
-                # kernels are bf16-only).
+                # the Pallas kernel is the TPU fast path for bf16 pools;
+                # under a mesh it runs per-shard via shard_map (slots on
+                # dp, heads on tp). int8 pools DEFAULT to the fused XLA
+                # gather: the in-kernel dequant twin exists
+                # (ops/paged_attention._paged_kernel_q8, equivalence-
+                # tested) but chip-measured SLOWER than the gather at the
+                # headline shape (62 vs 42 ms/step — Mosaic needs batch-
+                # leading dot layouts, and the per-block k/v transposes
+                # cost more than the densify they avoid); opt in with
+                # paged_kernel=pallas.
                 kernel = (
                     "pallas"
                     if jax.default_backend() == "tpu"
                     and self.config.kv_quantize != "int8"
                     else "xla"
-                )
-            elif kernel != "xla" and self.config.kv_quantize == "int8":
-                raise ValueError(
-                    "paged_kernel=pallas reads a bf16 pool; with "
-                    "kv-quantize=int8 keep paged_kernel=xla"
                 )
             self.paged_read_kernel = kernel
         elif self.config.kv_layout != "dense":
@@ -1257,6 +1264,17 @@ class TpuServingEngine:
             for key, inst in list(self._instances.items()):
                 if inst is self:
                     del self._instances[key]
+        # drop the HBM-heavy references NOW: a closed engine object can
+        # outlive close() (caller locals, task frames) and at the 8B shape
+        # its weights+KV are ~12GB — a second engine in the same process
+        # (speculation on/off comparison, model reload) must not OOM
+        # against a ghost (r5: the speculative bench child died exactly
+        # this way)
+        self.params = None
+        self.cache_k = self.cache_v = None
+        self._decode_chunk_fns.clear()
+        self._tables_dev_cache = None
+        self._sampler_dev_cache = None
 
     # ------------------------------------------------------------------
     # engine loop
@@ -1490,6 +1508,80 @@ class TpuServingEngine:
             ):
                 return
 
+    def _burst_should_yield(self, finished: bool) -> bool:
+        """End the decode burst only when the engine loop can actually make
+        progress elsewhere: a slot just freed (admission now possible),
+        queued work can land in an already-free slot, the engine is
+        stopping, or a prefill is mid-flight. A non-empty queue with ZERO
+        free slots must NOT end the burst — returning would tear down the
+        pipelined chunk stream and re-pay the per-burst device uploads on
+        every chunk (r5 chip attribution: each synchronous upload RPC costs
+        ~70ms over a tunneled chip, and the saturated bench held a full
+        admission queue for its whole duration — every chunk became its own
+        burst, serializing ~500ms of host RPCs against 787ms of device
+        compute)."""
+        if finished or self._stop or self._has_prefilling():
+            return True
+        if self._queue.empty():
+            return False
+        if os.environ.get("LS_TPU_STICKY_BURSTS", "1") == "0":
+            return True  # pre-r5 behavior (A/B knob): yield on any queue
+        return any(s.free for s in self.slots)
+
+    # jitted so the pack is ONE async dispatch (eager ops can take the
+    # slow per-op path on relay backends); shape-polymorphic via jit cache
+    _pack_chunk = staticmethod(jax.jit(
+        lambda t, l: jnp.concatenate([
+            t.reshape(-1),
+            jax.lax.bitcast_convert_type(l, jnp.int32).reshape(-1),
+        ])
+    ))
+
+    def _fetch_chunk(self, out) -> tuple[np.ndarray, np.ndarray]:
+        """ONE device→host transfer per chunk: tokens and bitcast logprobs
+        ride the same array (each np.asarray is a synchronous RPC over a
+        tunneled chip — two fetches is two round trips)."""
+        tokens, lps = out[0], out[1]
+        K, B = tokens.shape
+        packed = np.asarray(self._pack_chunk(tokens, lps))
+        return (
+            packed[: K * B].reshape(K, B),
+            packed[K * B:].view(np.float32).reshape(K, B),
+        )
+
+    def _tables_device(self, tables: np.ndarray | None):
+        """Device copy of the block tables, re-uploaded only when they
+        changed (most chunks allocate no new blocks; the upload RPC is the
+        cost that matters, not the 4KB payload)."""
+        if tables is None:
+            return None
+        raw = tables.tobytes()
+        cached = self._tables_dev_cache
+        if cached is None or cached[0] != raw:
+            self._tables_dev_cache = (raw, jnp.asarray(tables))
+        return self._tables_dev_cache[1]
+
+    def _sampler_device(self, active_mask: np.ndarray):
+        """Device copies of (active mask, temps, topks, topps), re-uploaded
+        only when the slot population changed (4 upload RPCs per burst
+        otherwise)."""
+        raw = (
+            active_mask.tobytes() + self._temps.tobytes()
+            + self._topks.tobytes() + self._topps.tobytes()
+        )
+        cached = self._sampler_dev_cache
+        if cached is None or cached[0] != raw:
+            self._sampler_dev_cache = (
+                raw,
+                (
+                    jnp.asarray(active_mask),
+                    jnp.asarray(self._temps),
+                    jnp.asarray(self._topks),
+                    jnp.asarray(self._topps),
+                ),
+            )
+        return self._sampler_dev_cache[1]
+
     async def _decode_burst(self, loop, active: list[int]) -> None:
         """Pipelined chunk decoding: chunk k+1 is dispatched from chunk k's
         *device-resident* outputs before k's tokens reach the host, so the
@@ -1508,10 +1600,7 @@ class TpuServingEngine:
         key1 = self._split_key()
         active_mask = np.zeros(self.config.slots, dtype=bool)
         active_mask[active] = True
-        amask = jnp.asarray(active_mask)
-        temps = jnp.asarray(self._temps)
-        topks = jnp.asarray(self._topks)
-        topps = jnp.asarray(self._topps)
+        amask, temps, topks, topps = self._sampler_device(active_mask)
         sampler_mode = self._sampler_mode(
             self._temps[active_mask], self._topks[active_mask],
             self._topps[active_mask],
@@ -1521,6 +1610,20 @@ class TpuServingEngine:
             self.config.decode_chunk_light if light
             else self.config.decode_chunk
         )
+        # never fuse far past the longest remaining budget: a 96-step chunk
+        # serving 48-token answers burns half its steps on finished slots
+        # (and doubles head-of-line latency for queued arrivals). Halving
+        # buckets keep the compile-variant count logarithmic.
+        max_remaining = 1
+        for slot_id in active:
+            request = self.slots[slot_id].request
+            if request is not None:
+                max_remaining = max(
+                    max_remaining,
+                    request.max_tokens - len(request.generated),
+                )
+        while K >= 2 * max(max_remaining, self.config.decode_chunk_light, 1):
+            K //= 2
         # presence/frequency penalties: the in-chunk token counts evolve in
         # the scan carry but are NOT returned (the host rebuilds them from
         # request.generated before each dispatch) — so penalty bursts run
@@ -1563,9 +1666,17 @@ class TpuServingEngine:
                 return None
             S = self.model_config.max_seq_len
             for slot_id in active:
-                if self.slots[slot_id].request is not None:
+                request = self.slots[slot_id].request
+                if request is not None:
+                    # the reservation can never need to exceed the request's
+                    # own budget: without this cap the pipelined lookahead
+                    # (+2K) overshoots into pool exhaustion on the last
+                    # chunks — on the r5 chip run that eviction churn cost
+                    # more than the pipelining won
+                    cap = len(request.prompt_tokens) + request.max_tokens + 1
                     need = min(
-                        int(self._lengths[slot_id]) + (pending_chunks + 1) * K, S
+                        int(self._lengths[slot_id]) + (pending_chunks + 1) * K,
+                        cap, S,
                     )
                     self.block_mgr.ensure_capacity(slot_id, need)
             return self.block_mgr.tables.copy()
@@ -1613,7 +1724,7 @@ class TpuServingEngine:
             else:
                 self._heavy_chunks += 1
             self.profiler.on_decode_chunk()
-            tables_dev = jnp.asarray(tables) if tables is not None else None
+            tables_dev = self._tables_device(tables)
             args = (
                 (self.params, self.cache_k, self.cache_v,
                  tokens, lengths, amask, tables_dev, key, temps, topks, topps)
@@ -1650,17 +1761,11 @@ class TpuServingEngine:
         if light or pen:
             while True:
                 chunk_t, chunk_lp = await loop.run_in_executor(
-                    self._executor,
-                    lambda o=out: (np.asarray(o[0]), np.asarray(o[1])),
+                    self._executor, partial(self._fetch_chunk, out)
                 )
                 finished = self._process_chunk(chunk_t, chunk_lp, active)
                 await self._flush_emits(active)
-                if (
-                    finished
-                    or not self._queue.empty()
-                    or self._stop
-                    or self._has_prefilling()
-                ):
+                if self._burst_should_yield(finished):
                     return
                 base_max += K
                 chunk_index += 1
@@ -1684,20 +1789,15 @@ class TpuServingEngine:
                         _bucket_for(base_max), _grow_blocks(1)),
             )
             chunk_t, chunk_lp = await loop.run_in_executor(
-                self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))
+                self._executor, partial(self._fetch_chunk, out)
             )
             finished = self._process_chunk(chunk_t, chunk_lp, active)
             await self._flush_emits(active)
             out = await next_out_task
-            if (
-                finished
-                or not self._queue.empty()
-                or self._stop
-                or self._has_prefilling()  # interleave: yield to prefill chunks
-            ):
+            if self._burst_should_yield(finished):
                 # drain the speculative chunk, then hand back to the loop
                 chunk_t, chunk_lp = await loop.run_in_executor(
-                    self._executor, lambda o=out: (np.asarray(o[0]), np.asarray(o[1]))
+                    self._executor, partial(self._fetch_chunk, out)
                 )
                 self._process_chunk(chunk_t, chunk_lp, active)
                 await self._flush_emits(active)
@@ -2025,16 +2125,73 @@ class TpuServingEngine:
         K = chunk_tokens.shape[0]
         finished_any = False
         emitted_before = self.total_generated
+        eos = self.tokenizer.eos_id
         for slot_id in active:
-            for k in range(K):
-                slot = self.slots[slot_id]
-                if slot.request is None:
-                    break  # finished mid-chunk; discard the tail
-                self._lengths[slot_id] += 1
-                token = int(chunk_tokens[k, slot_id])
-                self._current[slot_id] = token
-                if self._emit_token(slot_id, token, float(chunk_lps[k, slot_id])):
-                    finished_any = True
+            slot = self.slots[slot_id]
+            request = slot.request
+            if request is None:
+                continue
+            if (
+                request.stop
+                or request.on_token is not None
+                or request.future.cancelled()
+            ):
+                # slow path: per-token semantics (stop-string windows,
+                # stream emissions, cancellation checks)
+                for k in range(K):
+                    if slot.request is None:
+                        break  # finished mid-chunk; discard the tail
+                    self._lengths[slot_id] += 1
+                    token = int(chunk_tokens[k, slot_id])
+                    self._current[slot_id] = token
+                    if self._emit_token(
+                        slot_id, token, float(chunk_lps[k, slot_id])
+                    ):
+                        finished_any = True
+                continue
+            # fast path — the saturated-decode hot loop: one numpy pass per
+            # slot instead of K Python iterations (at 64 slots x 96 steps
+            # the per-token loop costs hundreds of ms per chunk on the
+            # single-threaded engine, rivaling the device time itself).
+            # Exact same semantics as _emit_token for this request shape:
+            # consume until eos / max-tokens / context-window, then finish.
+            toks = chunk_tokens[:, slot_id]
+            lengths0 = int(self._lengths[slot_id])
+            # consuming the t-th token (1-based): finishes at t == remaining
+            # (budget) or t == max_seq cap (window), whichever first
+            fin_at = min(
+                request.max_tokens - len(request.generated),
+                self.model_config.max_seq_len - 1 - lengths0,
+            )
+            upto = min(K, max(fin_at, 0))
+            eos_hits = np.nonzero(toks[:upto] == eos)[0]
+            if eos_hits.size:
+                consumed = int(eos_hits[0]) + 1
+                n_gen = consumed - 1  # the eos token itself is not emitted
+                done = True
+            else:
+                consumed = upto
+                n_gen = consumed
+                done = consumed == fin_at
+            if consumed:
+                request.generated.extend(toks[:n_gen].tolist())
+                request.logprobs.extend(
+                    chunk_lps[:n_gen, slot_id].tolist()
+                )
+                self.total_generated += consumed
+                self._lengths[slot_id] += consumed
+                self._current[slot_id] = int(toks[consumed - 1])
+            if done:
+                finished_any = True
+                slot.request = None
+                slot.prefilling = False
+                slot.prefill_done = 0
+                self._lengths[slot_id] = 0
+                if self.block_mgr is not None:
+                    self.block_mgr.release(slot_id)
+                self._finished_requests.append(
+                    (request, bool(eos_hits.size))
+                )
         # one prometheus update per chunk, not per token (host hot path)
         self._m_tokens(self.total_generated - emitted_before)
         return finished_any

@@ -268,6 +268,47 @@ def test_paged_kernel_partial_matches_xla_reference():
     )
 
 
+def test_paged_kernel_partial_q8_matches_xla_reference():
+    """The int8 kernel twin (in-kernel fused dequant) ≡ the XLA gather
+    path on the same int8 pool — the headline-posture read lane."""
+    from langstream_tpu.models.llama import LlamaConfig
+    from langstream_tpu.models.llama_paged import _cache_partial_xla
+    from langstream_tpu.ops.paged_attention import (
+        merge_partial_attention, paged_attention_partial,
+    )
+
+    c = LlamaConfig.tiny()
+    B, H, D, Kh = 3, c.heads, c.head_dim, c.kv_heads
+    bs, nb, nrb = 8, 10, 3
+    k1, k2, k3, k4, k5 = jax.random.split(jax.random.PRNGKey(1), 5)
+    q = jax.random.normal(k1, (B, H, D), dtype=jnp.bfloat16)
+    pool_k = {
+        "q": jax.random.randint(k2, (nb, bs, Kh * D), -127, 128, jnp.int8),
+        "s": jax.random.uniform(k3, (nb, bs, Kh), jnp.float32, 0.01, 0.1),
+    }
+    pool_v = {
+        "q": jax.random.randint(k4, (nb, bs, Kh * D), -127, 128, jnp.int8),
+        "s": jax.random.uniform(k5, (nb, bs, Kh), jnp.float32, 0.01, 0.1),
+    }
+    tables = jnp.array([[1, 2, 3, 0], [4, 5, 0, 0], [6, 7, 8, 9]], jnp.int32)
+    lengths = jnp.array([20, 9, 24], jnp.int32)
+
+    ref = _cache_partial_xla(c, q, pool_k, pool_v, tables, lengths, nrb)
+    got = paged_attention_partial(
+        q, pool_k, pool_v, tables, lengths,
+        num_read_blocks=nrb, kv_heads=Kh, head_dim=D, interpret=True,
+    )
+    out_ref = merge_partial_attention([ref])
+    out_got = merge_partial_attention([got])
+    np.testing.assert_allclose(
+        np.asarray(out_ref, dtype=np.float32),
+        np.asarray(out_got, dtype=np.float32),
+        rtol=5e-2, atol=5e-2,  # bf16 math with blocked vs full softmax
+                               # accumulation orders (abs diffs ~0.03 on
+                               # O(1-4) outputs)
+    )
+
+
 # ---------------------------------------------------------------------------
 # engine integration
 # ---------------------------------------------------------------------------
