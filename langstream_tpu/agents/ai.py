@@ -16,6 +16,7 @@ continuous-batching decode loop on this pod's chips".
 from __future__ import annotations
 
 import json
+import logging
 import math
 import uuid
 from typing import Any
@@ -34,6 +35,8 @@ from langstream_tpu.agents.services import (
     resolve_service_provider,
 )
 from langstream_tpu.core.expressions import evaluate_accessor, render_template
+
+log = logging.getLogger(__name__)
 
 
 class _AIAgentBase(SingleRecordProcessor):
@@ -164,6 +167,17 @@ class ChatCompletionsAgent(_AIAgentBase):
             mutable.properties[f"langstream-{header_name}"] = str(
                 getattr(result, attr)
             )
+        if result.ttft_s > 0:
+            # engine-measured decomposition: client TTFT minus this is the
+            # gateway/broker transport share
+            for header_name, attr in (
+                ("ttft-ms", "ttft_s"),
+                ("queue-wait-ms", "queue_wait_s"),
+                ("prefill-ms", "prefill_s"),
+            ):
+                mutable.properties[f"langstream-{header_name}"] = str(
+                    round(getattr(result, attr) * 1000, 3)
+                )
         return [mutable.to_record()]
 
 
@@ -234,12 +248,19 @@ class ComputeAIEmbeddingsAgent(AgentProcessor):
             num_buckets=int(configuration.get("concurrency", 4)),
             key_fn=lambda item: item[0].key,
         )
+        self._add_tasks: set = set()
 
     def process(self, records: list[Record], sink: RecordSink) -> None:
-        import asyncio
+        from langstream_tpu.core.asyncutil import spawn_retained
 
         for record in records:
-            asyncio.ensure_future(self.executor.add((record, sink)))
+            # an add() that raises (bucket closed mid-shutdown) must surface
+            spawn_retained(
+                self.executor.add((record, sink)),
+                self._add_tasks,
+                log,
+                "embeddings batch submit failed",
+            )
 
     async def _process_batch(self, items: list[tuple[Record, RecordSink]]) -> None:
         mutables = [MutableRecord.from_record(r) for r, _ in items]

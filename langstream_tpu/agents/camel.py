@@ -209,8 +209,10 @@ class CamelSource(AgentSource):
             self._route_task.cancel()
             try:
                 await self._route_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001
+                logger.debug("camel route task errored at close: %s", e)
             self._route_task = None
 
     async def read(self) -> list[Record]:

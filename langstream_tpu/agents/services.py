@@ -36,6 +36,11 @@ class CompletionResult:
     num_prompt_tokens: int = 0
     num_completion_tokens: int = 0
     finish_reason: str = "stop"
+    # engine-side TTFT decomposition (seconds); 0.0 when the provider
+    # doesn't measure it (HTTP providers, mock)
+    ttft_s: float = 0.0
+    queue_wait_s: float = 0.0
+    prefill_s: float = 0.0
 
 
 StreamingChunksConsumer = Callable[[Chunk], Any]

@@ -151,6 +151,9 @@ class TpuCompletionsService(CompletionsService):
             num_prompt_tokens=result["num_prompt_tokens"],
             num_completion_tokens=result["num_completion_tokens"],
             finish_reason=result["finish_reason"],
+            ttft_s=result.get("ttft", 0.0),
+            queue_wait_s=result.get("queue_wait", 0.0),
+            prefill_s=result.get("prefill", 0.0),
         )
 
     async def chat_completions(

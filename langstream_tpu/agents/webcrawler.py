@@ -16,12 +16,15 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import urllib.parse
 from html.parser import HTMLParser
 from typing import Any
 
 from langstream_tpu.api.agent import AgentSource
 from langstream_tpu.api.record import Record, make_record
+
+logger = logging.getLogger(__name__)
 
 
 class _LinkExtractor(HTMLParser):
@@ -137,8 +140,9 @@ class WebCrawlerSource(AgentSource):
                         elif line.lower().startswith("sitemap:"):
                             # sitemap directives are user-agent independent
                             sitemaps.append(line.split(":", 1)[1].strip())
-        except Exception:
-            pass
+        except Exception as e:
+            # unreachable/garbled robots.txt ⇒ crawl unrestricted, per RFC 9309
+            logger.debug("robots.txt fetch for %s failed: %s", netloc, e)
         self._robots_disallow[netloc] = rules
         # the first sight of a host's robots.txt enqueues its sitemaps
         # (WebCrawler.java:361) — depth 0: sitemap entries are roots
@@ -223,8 +227,8 @@ class WebCrawlerSource(AgentSource):
             extractor = _LinkExtractor()
             try:
                 extractor.feed(body)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("link extraction failed for %s: %s", url, e)
             for link in extractor.links:
                 absolute = urllib.parse.urljoin(url, link.split("#")[0])
                 if absolute not in self._visited and self._allowed(absolute):

@@ -1,0 +1,63 @@
+"""graftcheck: first-party static analysis for the langstream-tpu tree.
+
+Five rule families tuned to this codebase's actual failure modes:
+
+==========  ==============================================================
+JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
+            loop, Python branches on traced values, recompile traps
+ASYNC201/2  async-blocking: sync sleep/subprocess/socket/HTTP/file calls
+            inside ``async def`` in the serving stack
+ASYNC203-5  concurrency hygiene: unawaited coroutines, dropped task
+            handles, unlocked global writes in handlers
+SEC301      secret-leak: credentials interpolated into log lines
+EXC401/402  exception swallowing: bare/broad excepts that discard errors
+==========  ==============================================================
+
+Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),
+``--changed`` for files differing from HEAD only. Gate: the whole tree is
+linted in tier-1 by ``tests/test_graftcheck.py``. Policy, suppression
+syntax, and the baseline rules live in ``docs/ANALYSIS.md``.
+"""
+
+from __future__ import annotations
+
+from langstream_tpu.analysis.core import (
+    BASELINE_PATH,
+    BaselineEntry,
+    Finding,
+    Module,
+    Report,
+    Rule,
+    analyze_source,
+    iter_py_files,
+    load_baseline,
+    run,
+)
+from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
+from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
+from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
+from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
+
+ALL_RULES: list[Rule] = [
+    *_JAX_RULES,
+    *_ASYNC_RULES,
+    *_SEC_RULES,
+    *_EXC_RULES,
+]
+
+RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}
+
+__all__ = [
+    "ALL_RULES",
+    "RULES_BY_ID",
+    "BASELINE_PATH",
+    "BaselineEntry",
+    "Finding",
+    "Module",
+    "Report",
+    "Rule",
+    "analyze_source",
+    "iter_py_files",
+    "load_baseline",
+    "run",
+]

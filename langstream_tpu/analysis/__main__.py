@@ -1,0 +1,135 @@
+"""graftcheck CLI: ``python -m langstream_tpu.analysis [paths...]``.
+
+Modes:
+
+- no args — lint the whole ``langstream_tpu/`` tree against the baseline
+  (exactly what the tier-1 gate runs);
+- ``--changed`` — lint only files that differ from ``HEAD`` (inner-loop
+  mode: fast enough to run on every save);
+- explicit paths — lint those files/dirs;
+- ``--list-rules`` — print every rule id and summary;
+- ``--no-baseline`` — report baselined findings too (audit mode).
+
+Exit code 0 = clean, 1 = violations (or stale baseline entries), 2 = usage
+or parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+from langstream_tpu.analysis import (
+    ALL_RULES,
+    BASELINE_PATH,
+    iter_py_files,
+    load_baseline,
+    run,
+)
+from langstream_tpu.analysis.core import PACKAGE_ROOT, REPO_ROOT
+
+
+def _changed_files() -> list[Path]:
+    """Python files under the package that differ from HEAD (staged,
+    unstaged, or untracked)."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=True,
+    ).stdout
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=True,
+    ).stdout
+    files = []
+    for line in (out + untracked).splitlines():
+        line = line.strip()
+        if not line.endswith(".py"):
+            continue
+        path = REPO_ROOT / line
+        if path.exists() and PACKAGE_ROOT in path.resolve().parents:
+            files.append(path)
+    return sorted(set(files))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs HEAD",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print rules and exit"
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  [{rule.family}]  {rule.summary}")
+        return 0
+
+    if args.changed and args.paths:
+        parser.error("--changed and explicit paths are mutually exclusive")
+
+    files: list[Path] | None
+    if args.changed:
+        files = _changed_files()
+        if not files:
+            print("graftcheck: no changed python files under langstream_tpu/")
+            return 0
+    elif args.paths:
+        files = []
+        for raw in args.paths:
+            path = Path(raw)
+            if path.is_dir():
+                files.extend(iter_py_files(path))
+            elif path.suffix == ".py":
+                files.append(path)
+            else:
+                print(f"graftcheck: not a python file: {raw}", file=sys.stderr)
+                return 2
+    else:
+        files = None  # whole tree
+
+    baseline = [] if args.no_baseline else load_baseline()
+    report = run(ALL_RULES, files=files, baseline=baseline)
+
+    for err in report.parse_errors:
+        print(f"PARSE ERROR {err}")
+    for finding in report.new:
+        print(finding.format())
+    # a subset scan (--changed / explicit paths) can't see findings in the
+    # unscanned files, so unmatched baseline entries are expected there —
+    # staleness is only meaningful (and only fails) on the full-tree run
+    subset_scan = files is not None
+    stale = [] if (args.no_baseline or subset_scan) else report.stale_baseline
+    for entry in stale:
+        print(
+            f"STALE BASELINE {entry.rule} {entry.path} [{entry.symbol}]: "
+            f"no matching finding — remove it from {BASELINE_PATH.name}"
+        )
+
+    n_new, n_base = len(report.new), len(report.baselined)
+    scanned = "changed files" if args.changed else (
+        f"{len(files)} file(s)" if files is not None else "langstream_tpu/"
+    )
+    print(
+        f"graftcheck: {n_new} violation(s), {n_base} baselined, "
+        f"{len(stale)} stale baseline entr(ies) in {scanned}"
+    )
+    if report.parse_errors:
+        return 2
+    return 0 if not report.new and not stale else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,342 @@
+"""graftcheck core: findings, suppressions, baseline, and the file driver.
+
+The framework is deliberately tiny and stdlib-only (``ast`` + ``re`` +
+``json``): every rule receives a parsed :class:`Module` and yields
+:class:`Finding` objects. Three escape hatches keep the tier-1 gate honest
+without blocking legitimate code:
+
+- **inline suppressions** — ``# graftcheck: disable=RULE[,RULE] reason``
+  on the offending line (or the line directly above it). A suppression
+  *must* carry a reason; a bare one is itself reported (``GC000``).
+- **a checked-in baseline** — accepted legacy findings recorded by
+  ``(rule, path, symbol)`` so they survive line-number drift but go stale
+  (and fail the gate) when the offending symbol is deleted or renamed.
+- **per-rule fixtures** — ``tests/test_graftcheck.py`` holds a
+  true-positive and a true-negative snippet for every rule family.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+#: repository-relative root the default scan covers
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+REPO_ROOT = PACKAGE_ROOT.parent
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix, repo-relative
+    line: int
+    symbol: str  # dotted enclosing scope, e.g. "Engine._admit" or "<module>"
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.symbol}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    family: str
+    summary: str
+    check: Callable[["Module"], Iterator[Finding]]
+
+
+class Module:
+    """One parsed source file, with the shared context rules need."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            symbol=self.symbol_for(node),
+            message=message,
+        )
+
+    def symbol_for(self, node: ast.AST) -> str:
+        parts: list[str] = []
+        cur: ast.AST | None = node
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def scopes(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Enclosing function/class defs, innermost first."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        for scope in self.scopes(node):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return scope
+        return None
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*disable=(?P<rules>[A-Za-z0-9_,\-]+)(?:\s+(?P<reason>\S.*))?"
+)
+
+
+def parse_suppressions(
+    mod: Module,
+) -> tuple[dict[int, set[str]], list[Finding]]:
+    """Line → suppressed rule ids. A suppression with no reason is reported
+    as a GC000 finding (the reason is the audit trail the baseline policy
+    leans on)."""
+    by_line: dict[int, set[str]] = {}
+    problems: list[Finding] = []
+    # real COMMENT tokens only — the same text inside a string/docstring
+    # (e.g. documentation quoting the syntax) is not a suppression
+    try:
+        comments = [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(
+                io.StringIO(mod.source).readline
+            )
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = []
+    for idx, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if not m.group("reason"):
+            problems.append(
+                Finding(
+                    rule="GC000",
+                    path=mod.path,
+                    line=idx,
+                    symbol="<suppression>",
+                    message="suppression without a reason "
+                    "(write `# graftcheck: disable=RULE why`)",
+                )
+            )
+            continue
+        by_line.setdefault(idx, set()).update(rules)
+    return by_line, problems
+
+
+def is_suppressed(finding: Finding, by_line: dict[int, set[str]]) -> bool:
+    # the suppression may sit on the flagged line or the line directly
+    # above it (long statements put the comment on its own line)
+    for line in (finding.line, finding.line - 1):
+        rules = by_line.get(line)
+        if rules and (finding.rule in rules or "all" in rules):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str
+    symbol: str
+    reason: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+
+def load_baseline(path: Path | None = None) -> list[BaselineEntry]:
+    path = path or BASELINE_PATH
+    if not path.exists():
+        return []
+    entries = []
+    for raw in json.loads(path.read_text()):
+        entries.append(
+            BaselineEntry(
+                rule=raw["rule"],
+                path=raw["path"],
+                symbol=raw["symbol"],
+                reason=raw.get("reason", ""),
+            )
+        )
+    return entries
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Report:
+    new: list[Finding]            # violations (fail the gate)
+    baselined: list[Finding]      # matched a baseline entry
+    stale_baseline: list[BaselineEntry]  # entries matching nothing (fail)
+    parse_errors: list[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale_baseline and not self.parse_errors
+
+
+def analyze_source(
+    source: str,
+    path: str,
+    rules: Iterable[Rule],
+) -> list[Finding]:
+    """Findings for one source blob after inline suppressions (the fixture
+    entry point; the CLI goes through :func:`run`)."""
+    mod = Module(path, source)
+    suppressions, problems = parse_suppressions(mod)
+    findings = list(problems)
+    for rule in rules:
+        for finding in rule.check(mod):
+            if not is_suppressed(finding, suppressions):
+                findings.append(finding)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def iter_py_files(root: Path) -> Iterator[Path]:
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        yield path
+
+
+def run(
+    rules: Iterable[Rule],
+    files: Iterable[Path] | None = None,
+    baseline: list[BaselineEntry] | None = None,
+    repo_root: Path | None = None,
+) -> Report:
+    rules = list(rules)
+    repo_root = repo_root or REPO_ROOT
+    if files is None:
+        files = iter_py_files(PACKAGE_ROOT)
+    if baseline is None:
+        baseline = load_baseline()
+
+    findings: list[Finding] = []
+    parse_errors: list[str] = []
+    for file_path in files:
+        file_path = Path(file_path)
+        try:
+            rel = file_path.resolve().relative_to(repo_root).as_posix()
+        except ValueError:
+            rel = file_path.as_posix()
+        try:
+            source = file_path.read_text()
+        except (OSError, UnicodeDecodeError) as e:
+            parse_errors.append(f"{rel}: unreadable: {e}")
+            continue
+        try:
+            findings.extend(analyze_source(source, rel, rules))
+        except SyntaxError as e:
+            parse_errors.append(f"{rel}: syntax error: {e}")
+
+    by_key: dict[tuple[str, str, str], BaselineEntry] = {
+        e.key(): e for e in baseline
+    }
+    matched: set[tuple[str, str, str]] = set()
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    for finding in findings:
+        key = (finding.rule, finding.path, finding.symbol)
+        if key in by_key:
+            matched.add(key)
+            baselined.append(finding)
+        else:
+            new.append(finding)
+    stale = [e for e in baseline if e.key() not in matched]
+    return Report(
+        new=new,
+        baselined=baselined,
+        stale_baseline=stale,
+        parse_errors=parse_errors,
+    )
+
+
+# --------------------------------------------------------------------------
+# small AST helpers shared by the rule modules
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def walk_within(node: ast.AST) -> Iterator[ast.AST]:
+    yield from ast.walk(node)
+
+
+def body_is_noop(body: list[ast.stmt]) -> bool:
+    """True when an except body only discards control flow (pass/continue/
+    Ellipsis/bare ``return``): nothing is logged, re-raised, or recorded."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and stmt.value is None:
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+        ):
+            continue  # docstring / Ellipsis
+        return False
+    return True
+
+
+def name_parts(identifier: str) -> set[str]:
+    return {p for p in re.split(r"[_\W]+", identifier.lower()) if p}
+
+
+def referenced_names(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}

@@ -1,0 +1,282 @@
+"""Async-blocking and concurrency-hygiene rules.
+
+The gateway/runtime/control-plane stack is a single asyncio event loop per
+process: one synchronous sleep, socket read, or subprocess wait inside an
+``async def`` stalls every in-flight request behind it (the round-5
+TTFT-queuing signature). The hygiene rules catch the quieter failure
+modes: coroutines never awaited (the work silently doesn't happen) and
+task handles dropped on the floor (the exception disappears with them).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import (
+    Finding,
+    Module,
+    Rule,
+    call_name,
+)
+
+# call targets that block the calling thread — flagged inside async defs
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.call": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec` or an executor",
+    "os.system": "use `asyncio.create_subprocess_shell` or an executor",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "urllib.request.urlopen": "use aiohttp (already a dependency)",
+    "requests.get": "use aiohttp (already a dependency)",
+    "requests.post": "use aiohttp (already a dependency)",
+    "requests.put": "use aiohttp (already a dependency)",
+    "requests.delete": "use aiohttp (already a dependency)",
+    "requests.request": "use aiohttp (already a dependency)",
+}
+
+# synchronous file I/O helpers: cheap for one-shot config reads at startup,
+# an event-loop stall when a handler does them per request — flagged only
+# in the request-serving packages
+_FILE_IO_ATTRS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+_FILE_IO_PACKAGES = (
+    "langstream_tpu/gateway/",
+    "langstream_tpu/controlplane/",
+    "langstream_tpu/runtime/",
+)
+
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _async_functions(mod: Module) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def _nested_sync_nodes(fn: ast.AST) -> set[int]:
+    """ids of every node inside a function nested in ``fn``: a sync
+    ``def``'s calls don't block the loop directly (the helper may
+    legitimately run in an executor), and a nested ``async def`` is
+    visited on its own — rescanning it here would double-report its
+    findings. Computed once per async def, not per call."""
+    nodes: set[int] = set()
+    for inner in ast.walk(fn):
+        if (
+            isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and inner is not fn
+        ):
+            nodes.update(id(n) for n in ast.walk(inner))
+    return nodes
+
+
+def check_blocking_in_async(mod: Module) -> Iterator[Finding]:
+    for fn in _async_functions(mod):
+        nested = _nested_sync_nodes(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in nested:
+                continue
+            name = call_name(node)
+            if name in _BLOCKING_CALLS:
+                yield mod.finding(
+                    "ASYNC201",
+                    node,
+                    f"blocking call {name}() inside `async def {fn.name}` "
+                    f"stalls the event loop; {_BLOCKING_CALLS[name]}",
+                )
+
+
+def check_file_io_in_async(mod: Module) -> Iterator[Finding]:
+    if not mod.path.startswith(_FILE_IO_PACKAGES):
+        return
+    for fn in _async_functions(mod):
+        nested = _nested_sync_nodes(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in nested:
+                continue
+            offender = None
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FILE_IO_ATTRS
+            ):
+                offender = f".{node.func.attr}()"
+            elif call_name(node) == "open":
+                offender = "open()"
+            if offender is not None:
+                yield mod.finding(
+                    "ASYNC202",
+                    node,
+                    f"synchronous file I/O {offender} inside `async def "
+                    f"{fn.name}` in a request-serving package; offload "
+                    f"with `loop.run_in_executor` (or hoist to startup)",
+                )
+
+
+def check_unawaited_coroutine(mod: Module) -> Iterator[Finding]:
+    """A bare ``foo(...)`` / ``self.foo(...)`` statement calling an
+    ``async def`` defined in the same scope: the coroutine is created and
+    garbage-collected without ever running. ``self.foo`` is resolved
+    against the *enclosing class only* — another class's same-named sync
+    method must not alias it."""
+    module_async: set[str] = {
+        node.name
+        for node in ast.iter_child_nodes(mod.tree)
+        if isinstance(node, ast.AsyncFunctionDef)
+    }
+    class_async: dict[ast.ClassDef, set[str]] = {
+        node: {
+            child.name
+            for child in ast.iter_child_nodes(node)
+            if isinstance(child, ast.AsyncFunctionDef)
+        }
+        for node in ast.walk(mod.tree)
+        if isinstance(node, ast.ClassDef)
+    }
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Expr) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        target = None
+        if isinstance(call.func, ast.Name):
+            # bare name: module-level async defs plus async defs nested in
+            # any enclosing function scope
+            candidates = set(module_async)
+            for scope in mod.scopes(node):
+                if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    candidates |= {
+                        child.name
+                        for child in ast.iter_child_nodes(scope)
+                        if isinstance(child, ast.AsyncFunctionDef)
+                    }
+            if call.func.id in candidates:
+                target = call.func.id
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in {"self", "cls"}
+        ):
+            for scope in mod.scopes(node):
+                if isinstance(scope, ast.ClassDef):
+                    if call.func.attr in class_async.get(scope, set()):
+                        target = call.func.attr
+                    break
+        if target is not None:
+            yield mod.finding(
+                "ASYNC203",
+                node,
+                f"coroutine `{target}(...)` is never awaited: the call "
+                f"builds a coroutine object and drops it (await it, or "
+                f"wrap in `asyncio.create_task` and keep the handle)",
+            )
+
+
+def check_dropped_task(mod: Module) -> Iterator[Finding]:
+    """``asyncio.create_task(...)`` / ``ensure_future(...)`` as a bare
+    expression statement: nothing retains the task (the event loop holds
+    only a weak reference — it can be garbage-collected mid-flight) and
+    nothing ever observes its exception."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Expr) or not isinstance(node.value, ast.Call):
+            continue
+        name = call_name(node.value)
+        if name is None:
+            continue
+        leaf = name.split(".")[-1]
+        if leaf in _TASK_SPAWNERS:
+            yield mod.finding(
+                "ASYNC204",
+                node,
+                f"task handle from {leaf}(...) is dropped: the loop keeps "
+                f"only a weak ref (mid-flight GC) and its exception is "
+                f"never observed — keep the handle and add a "
+                f"done-callback, or await it",
+            )
+
+
+def check_global_write_in_async(mod: Module) -> Iterator[Finding]:
+    """``global X`` rebinding inside an ``async def`` without an enclosing
+    ``async with <lock>``: two interleaved handlers race the
+    read-modify-write."""
+    for fn in _async_functions(mod):
+        declared: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+        if not declared:
+            continue
+        guarded = _has_lock_guard(fn)
+        if guarded:
+            continue
+        for node in ast.walk(fn):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for target in targets:
+                if isinstance(target, ast.Name) and target.id in declared:
+                    yield mod.finding(
+                        "ASYNC205",
+                        node,
+                        f"write to module global `{target.id}` in `async "
+                        f"def {fn.name}` without a lock: interleaved "
+                        f"handlers race the update (guard with `async "
+                        f"with` on an asyncio.Lock)",
+                    )
+
+
+def _has_lock_guard(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.AsyncWith, ast.With)):
+            for item in node.items:
+                name = (
+                    call_name(item.context_expr)
+                    if isinstance(item.context_expr, ast.Call)
+                    else None
+                )
+                text = name or ""
+                if "lock" in text.lower():
+                    return True
+                if isinstance(item.context_expr, (ast.Name, ast.Attribute)):
+                    from langstream_tpu.analysis.core import dotted_name
+
+                    text = dotted_name(item.context_expr) or ""
+                    if "lock" in text.lower():
+                        return True
+    return False
+
+
+RULES = [
+    Rule(
+        id="ASYNC201",
+        family="async-blocking",
+        summary="blocking sleep/subprocess/socket/HTTP call inside async def",
+        check=check_blocking_in_async,
+    ),
+    Rule(
+        id="ASYNC202",
+        family="async-blocking",
+        summary="synchronous file I/O inside async def in a serving package",
+        check=check_file_io_in_async,
+    ),
+    Rule(
+        id="ASYNC203",
+        family="concurrency",
+        summary="coroutine created but never awaited",
+        check=check_unawaited_coroutine,
+    ),
+    Rule(
+        id="ASYNC204",
+        family="concurrency",
+        summary="create_task/ensure_future result dropped without a handle",
+        check=check_dropped_task,
+    ),
+    Rule(
+        id="ASYNC205",
+        family="concurrency",
+        summary="module-global write in an async handler without a lock",
+        check=check_global_write_in_async,
+    ),
+]

@@ -1,0 +1,82 @@
+"""Exception-swallowing rules.
+
+A broker poll loop or the runner hot loop that catches everything and
+discards it turns a persistent failure (auth expired, partition gone,
+broker down) into a silent busy-loop: the round-5 verdict's red test rode
+exactly this pattern. A swallow is fine when it is *visible* — logged, or
+suppressed inline with a stated reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import (
+    Finding,
+    Module,
+    Rule,
+    body_is_noop,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def check_bare_except(mod: Module) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler) or node.type is not None:
+            continue
+        if _handler_reraises(node):
+            continue  # `except: ... raise` is a legitimate cleanup shape
+        yield mod.finding(
+            "EXC401",
+            node,
+            "bare `except:` swallows everything including "
+            "KeyboardInterrupt/SystemExit and asyncio.CancelledError — "
+            "catch Exception (or narrower) and handle it visibly",
+        )
+
+
+def check_swallowed_exception(mod: Module) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler) or node.type is None:
+            continue
+        names: list[str] = []
+        for t in (
+            node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+        ):
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, ast.Attribute):
+                names.append(t.attr)
+        if not any(n in _BROAD for n in names):
+            continue  # narrow catches may legitimately be best-effort
+        if not body_is_noop(node.body):
+            continue
+        yield mod.finding(
+            "EXC402",
+            node,
+            "`except Exception: pass` swallows the error invisibly: a "
+            "persistent failure becomes a silent busy-loop — log it "
+            "(log.debug is enough) or suppress inline with a reason",
+        )
+
+
+RULES = [
+    Rule(
+        id="EXC401",
+        family="exception-swallowing",
+        summary="bare `except:` without re-raise",
+        check=check_bare_except,
+    ),
+    Rule(
+        id="EXC402",
+        family="exception-swallowing",
+        summary="broad except whose body discards the error without a trace",
+        check=check_swallowed_exception,
+    ),
+]

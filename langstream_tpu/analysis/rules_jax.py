@@ -1,0 +1,369 @@
+"""JAX-hazard rules: host syncs inside traced code, Python branches on
+traced values, recompile traps, and host syncs reachable from the engine
+decode hot loop.
+
+"Traced" is decided syntactically, per module: a function is traced when it
+is decorated with ``jax.jit`` / ``partial(jax.jit, ...)`` /
+``pl.pallas_call`` / ``shard_map`` (or wrapped in a call to one of those
+anywhere in the module), or when it is defined *inside* a traced function
+(closures over a trace are traced). Precision beats recall here: a missed
+callee in another module is a gap, a false positive in the tier-1 gate is
+a broken build.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import (
+    Finding,
+    Module,
+    Rule,
+    call_name,
+    dotted_name,
+)
+
+_TRACER_WRAPPERS = {"jit", "pallas_call", "shard_map", "checkify"}
+# conversions that force a device→host transfer (and a sync) when applied
+# to a tracer / device array
+_HOST_SYNC_ATTRS = {"item", "block_until_ready"}
+_HOST_SYNC_CALLS = {
+    "jax.device_get",
+    "device_get",
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "onp.asarray",
+    "onp.array",
+}
+
+# intra-module call-graph roots of the serving decode hot loop: everything
+# reachable from these runs once per decode chunk (or per token) on the
+# event-loop thread, where a host sync is the ms-per-step tax the round-5
+# bench measured
+_HOT_LOOP_FILES = ("serving/engine.py",)
+_HOT_LOOP_ROOTS = {
+    "_run_loop",
+    "_decode_loop",
+    "_decode_once",
+    "_admit",
+    "_process_chunk",
+    "_emit_token",
+    "_flush_emits",
+}
+
+
+def _is_wrapper_ref(node: ast.AST) -> bool:
+    """True for a reference to a tracing wrapper: ``jax.jit``, ``jit``,
+    ``pl.pallas_call``, ``shard_map`` …"""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    return name.split(".")[-1] in _TRACER_WRAPPERS
+
+
+def _wrapper_call(node: ast.AST) -> bool:
+    """True when ``node`` is a call whose result traces its argument:
+    ``jax.jit(f)``, ``partial(jax.jit, ...)``, ``jax.jit(static_argnums=..)``
+    used as a decorator."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _is_wrapper_ref(node.func):
+        return True
+    fname = dotted_name(node.func)
+    if fname and fname.split(".")[-1] == "partial":
+        return bool(node.args) and _is_wrapper_ref(node.args[0])
+    return False
+
+
+def traced_functions(mod: Module) -> set[ast.AST]:
+    """Function defs traced by jit/pallas/shard_map, plus everything
+    nested inside them. Cached on the module: three rules ask."""
+    cached = getattr(mod, "_traced_fns", None)
+    if cached is not None:
+        return cached
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    traced: set[ast.AST] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_wrapper_ref(deco) or _wrapper_call(deco):
+                    traced.add(node)
+        elif isinstance(node, ast.Call) and (
+            _is_wrapper_ref(node.func) or _wrapper_call(node.func)
+        ):
+            # jax.jit(f) / shard_map(f, mesh=...) somewhere in the module
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    traced.update(defs.get(arg.id, []))
+                elif isinstance(arg, (ast.FunctionDef, ast.Lambda)):
+                    traced.add(arg)
+
+    # closures defined inside a traced function trace with it
+    out: set[ast.AST] = set()
+    for fn in traced:
+        out.add(fn)
+        for inner in ast.walk(fn):
+            if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.add(inner)
+    mod._traced_fns = out
+    return out
+
+
+def _param_names(fn: ast.AST) -> set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def _static_param_names(mod: Module, fn: ast.AST) -> set[str]:
+    """Params a jit wrapper marks static (``static_argnums`` /
+    ``static_argnames``): branching on those is legal and cheap."""
+    static: set[str] = set()
+    positional = [
+        a.arg for a in fn.args.posonlyargs + fn.args.args
+    ]
+
+    def _collect(call: ast.Call) -> None:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        static.add(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        if 0 <= el.value < len(positional):
+                            static.add(positional[el.value])
+
+    for deco in getattr(fn, "decorator_list", []):
+        if isinstance(deco, ast.Call) and _wrapper_call(deco):
+            _collect(deco)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _wrapper_call(node):
+            if any(
+                isinstance(a, ast.Name) and a.id == getattr(fn, "name", None)
+                for a in node.args
+            ):
+                _collect(node)
+                for arg in node.args:
+                    if _wrapper_call(arg):
+                        _collect(arg)  # partial(jax.jit, static_argnums=...)
+    return static
+
+
+def _host_sync_call(call: ast.Call) -> str | None:
+    """The offending callable's printable name, or None."""
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _HOST_SYNC_ATTRS:
+        return f".{call.func.attr}()"
+    name = call_name(call)
+    if name in _HOST_SYNC_CALLS:
+        return name
+    return None
+
+
+def check_host_sync_in_traced(mod: Module) -> Iterator[Finding]:
+    traced = traced_functions(mod)
+    seen: set[int] = set()
+    for fn in traced:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            offender = _host_sync_call(node)
+            if offender is None:
+                # float(x)/int(x)/bool(x) on a traced parameter leaks the
+                # tracer to the host
+                fname = call_name(node)
+                if (
+                    fname in {"float", "int", "bool"}
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in _param_names(fn)
+                ):
+                    offender = f"{fname}(<traced arg>)"
+            if offender is not None:
+                seen.add(id(node))
+                yield mod.finding(
+                    "JAX101",
+                    node,
+                    f"host sync {offender} inside a jit/pallas-traced "
+                    f"function: forces a device round-trip per call (move "
+                    f"it outside the traced region)",
+                )
+
+
+def check_branch_on_traced(mod: Module) -> Iterator[Finding]:
+    traced = traced_functions(mod)
+    for fn in traced:
+        params = _param_names(fn)
+        static = _static_param_names(mod, fn)
+        dynamic = params - static
+        if not dynamic:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            else:
+                continue
+            if _branches_on(test, dynamic):
+                yield mod.finding(
+                    "JAX102",
+                    node,
+                    "Python branch on a traced value: a tracer has no "
+                    "concrete truth value under jit (use jnp.where / "
+                    "lax.cond, or mark the argument static)",
+                )
+
+
+def _branches_on(test: ast.expr, dynamic: set[str]) -> bool:
+    """True when the branch condition depends on a dynamic (traced)
+    parameter in a way that needs its VALUE. Static-shape inspection
+    (``x.shape``, ``x.ndim``, ``x.dtype``, ``x.size``, ``len(x)``),
+    ``is None`` checks, and ``isinstance`` are all trace-time constants."""
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Name) or node.id not in dynamic:
+            continue
+        parent_ok = False
+        # climb one level cheaply: re-walk the test to find the direct use
+        for ctx in ast.walk(test):
+            if isinstance(ctx, ast.Attribute) and ctx.value is node:
+                if ctx.attr in {"shape", "ndim", "dtype", "size"}:
+                    parent_ok = True
+            elif isinstance(ctx, ast.Call):
+                fname = call_name(ctx)
+                if fname in {"len", "isinstance"} and node in ast.walk(ctx):
+                    parent_ok = True
+            elif isinstance(ctx, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in ctx.ops
+            ):
+                if node is ctx.left or node in ctx.comparators:
+                    parent_ok = True
+        if not parent_ok:
+            return True
+    return False
+
+
+def check_mutable_default_in_traced(mod: Module) -> Iterator[Finding]:
+    """A jitted function with a mutable default (list/dict/set) is a
+    recompile trap: the default's identity is hashed by the jit cache when
+    the arg is static (unhashable → TypeError) and silently retraces when
+    it is not."""
+    traced = traced_functions(mod)
+    for fn in traced:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]
+        for default in defaults:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                    ast.DictComp, ast.SetComp)):
+                yield mod.finding(
+                    "JAX103",
+                    default,
+                    "mutable default argument on a jit-traced function: "
+                    "unhashable as a static arg and a fresh-object retrace "
+                    "trap otherwise (default to None)",
+                )
+
+
+def _local_call_targets(fn: ast.AST) -> set[str]:
+    """Names this function calls as ``foo(...)`` or ``self.foo(...)``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in {"self", "cls"}
+        ):
+            out.add(node.func.attr)
+    return out
+
+
+def check_host_sync_in_hot_loop(mod: Module) -> Iterator[Finding]:
+    """Host-sync primitives in any function reachable (intra-module,
+    name-based call graph) from the decode-loop roots of the serving
+    engine."""
+    if not mod.path.endswith(_HOT_LOOP_FILES):
+        return
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    reachable: set[str] = set()
+    frontier = [r for r in _HOT_LOOP_ROOTS if r in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for fn in defs[name]:
+            for callee in _local_call_targets(fn):
+                if callee in defs and callee not in reachable:
+                    frontier.append(callee)
+    for name in reachable:
+        for fn in defs[name]:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    offender = _host_sync_call(node)
+                    if offender is not None and offender not in (
+                        "np.asarray", "np.array", "numpy.asarray",
+                        "numpy.array", "onp.asarray", "onp.array",
+                    ):
+                        # np.asarray on an ALREADY-fetched chunk is the
+                        # sanctioned one-transfer-per-chunk pattern; the
+                        # per-element primitives are the tax
+                        yield mod.finding(
+                            "JAX104",
+                            node,
+                            f"host sync {offender} reachable from the "
+                            f"decode hot loop (roots: "
+                            f"{', '.join(sorted(_HOT_LOOP_ROOTS))}): "
+                            f"per-step host round-trips are the ms/step "
+                            f"overhead the decode bench measures",
+                        )
+
+
+RULES = [
+    Rule(
+        id="JAX101",
+        family="jax",
+        summary="host sync (.item()/device_get/np.asarray/...) inside a "
+        "jit- or pallas-traced function",
+        check=check_host_sync_in_traced,
+    ),
+    Rule(
+        id="JAX102",
+        family="jax",
+        summary="Python if/while/assert on a traced (non-static) argument",
+        check=check_branch_on_traced,
+    ),
+    Rule(
+        id="JAX103",
+        family="jax",
+        summary="mutable default argument on a jit-traced function",
+        check=check_mutable_default_in_traced,
+    ),
+    Rule(
+        id="JAX104",
+        family="jax",
+        summary="host-sync primitive reachable from the engine decode loop",
+        check=check_host_sync_in_hot_loop,
+    ),
+]

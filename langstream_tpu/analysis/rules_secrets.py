@@ -1,0 +1,149 @@
+"""Secret-leak rule: credentials interpolated into log lines.
+
+Scoped to the packages that actually handle credentials (the Kafka wire
+client's SASL exchange, the auth stack, the gateway): there, an identifier
+named ``token``/``password``/``key`` IS the secret, and a log line that
+interpolates it ships the credential to every log sink. Outside those
+paths ``token`` means an LLM token and ``key`` a record key — flagging the
+whole tree would drown the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from langstream_tpu.analysis.core import (
+    Finding,
+    Module,
+    Rule,
+    dotted_name,
+    name_parts,
+)
+
+#: files/dirs where identifiers with these names hold real credentials
+SENSITIVE_PATHS = (
+    "langstream_tpu/runtime/kafka_wire.py",
+    "langstream_tpu/runtime/kafka_wire_runtime.py",
+    "langstream_tpu/auth/",
+    "langstream_tpu/gateway/",
+    "langstream_tpu/admin/",
+)
+
+# identifier word-parts that mark a value as secret (split on underscores:
+# `sasl_password` → {sasl, password}); `key`/`token` alone are included
+# because inside SENSITIVE_PATHS they are the JWT / signing key
+_SECRET_PARTS = {
+    "password",
+    "passwd",
+    "secret",
+    "sasl",
+    "credential",
+    "credentials",
+    "token",
+    "jwt",
+    "bearer",
+    "apikey",
+    "key",
+}
+# word-parts that mark an identifier as NOT a credential even when paired
+# with one above (``token_count``, ``key_id``, ``num_tokens``)
+_BENIGN_PARTS = {"count", "counts", "num", "len", "id", "ids", "name",
+                 "names", "hash", "digest", "url", "path", "file", "error"}
+
+_LOGGER_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+}
+
+
+def _is_secret_identifier(identifier: str) -> bool:
+    parts = name_parts(identifier)
+    return bool(parts & _SECRET_PARTS) and not (parts & _BENIGN_PARTS)
+
+
+def _expr_secret_name(node: ast.expr) -> str | None:
+    """The secret-looking identifier an expression exposes, if any: a bare
+    name, an attribute (``cfg.sasl_password``), or a subscript with a
+    string key (``cfg["password"]``). Calls are NOT flagged — ``hash()``,
+    ``redact()``, ``len()`` of a secret are the sanctioned spellings."""
+    if isinstance(node, ast.Name) and _is_secret_identifier(node.id):
+        return node.id
+    if isinstance(node, ast.Attribute) and _is_secret_identifier(node.attr):
+        return dotted_name(node) or node.attr
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        if (
+            isinstance(sl, ast.Constant)
+            and isinstance(sl.value, str)
+            and _is_secret_identifier(sl.value)
+        ):
+            return f"[{sl.value!r}]"
+    return None
+
+
+def _is_log_call(call: ast.Call) -> bool:
+    if isinstance(call.func, ast.Name) and call.func.id == "print":
+        return True
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in _LOGGER_METHODS:
+        return False
+    base = dotted_name(call.func.value)
+    if base is None:
+        return False
+    leaf = base.split(".")[-1].lower()
+    return leaf in {"log", "logger", "logging"} or leaf.endswith("log")
+
+
+def check_secret_in_log(mod: Module) -> Iterator[Finding]:
+    if not mod.path.startswith(SENSITIVE_PATHS):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not _is_log_call(node):
+            continue
+        exposed: list[str] = []
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            # %-style / direct args: a secret passed whole
+            name = _expr_secret_name(arg)
+            if name:
+                exposed.append(name)
+            # f-strings: secrets inside FormattedValue expressions
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.FormattedValue):
+                    inner = _expr_secret_name(sub.value)
+                    if inner:
+                        exposed.append(inner)
+                elif (
+                    isinstance(sub, ast.Call)
+                    and sub is not arg
+                ):
+                    # .format(...) with secret args
+                    fname = dotted_name(sub.func) or ""
+                    if fname.endswith("format"):
+                        for fa in list(sub.args) + [
+                            k.value for k in sub.keywords
+                        ]:
+                            inner = _expr_secret_name(fa)
+                            if inner:
+                                exposed.append(inner)
+        for name in exposed:
+            yield mod.finding(
+                "SEC301",
+                node,
+                f"credential `{name}` interpolated into a log line: log "
+                f"sinks (pod.log, /logs, shipped aggregators) must never "
+                f"see secrets — log its presence or a digest instead",
+            )
+
+
+RULES = [
+    Rule(
+        id="SEC301",
+        family="secret-leak",
+        summary="password/token/sasl/secret/key value interpolated into a "
+        "log or print call in a credential-handling package",
+        check=check_secret_in_log,
+    ),
+]

@@ -96,7 +96,8 @@ async def _mini_up(
     }
     store = KubernetesApplicationStore(api, code_storage_config=code_storage)
     compute = KubernetesComputeRuntime(
-        api, code_storage_config=code_storage
+        api, code_storage_config=code_storage,
+        pods_root=data_dir / "kubelet",
     )
     control = ControlPlaneServer(
         store=store, compute=compute, port=api_port

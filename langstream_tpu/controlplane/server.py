@@ -165,7 +165,9 @@ class LocalComputeRuntime:
                 try:
                     buffer.append(self.format(record))
                 except Exception:
-                    pass
+                    # stderr via logging's own raiseExceptions machinery;
+                    # logging from inside a handler would recurse
+                    self.handleError(record)
 
         handler = _Capture(level=logging.INFO)
         handler.setFormatter(
@@ -176,6 +178,13 @@ class LocalComputeRuntime:
 
     def append_log(self, tenant: str, name: str, line: str) -> None:
         self.logs.setdefault((tenant, name), deque(maxlen=1000)).append(line)
+
+    def pod_logs(
+        self, tenant: str, name: str, tail: int = 200
+    ) -> dict[str, list[str]]:
+        """Dev mode runs agents in-process — there are no pods, so no
+        per-pod log files; everything lands in the framework buffer."""
+        return {}
 
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         runner = self.runners.get((tenant, name))
@@ -501,8 +510,19 @@ class ControlPlaneServer:
         return web.json_response({"status": "OK"})
 
     async def _logs(self, request: web.Request) -> web.Response:
-        key = (request.match_info["tenant"], request.match_info["name"])
-        lines = list(self.compute.logs.get(key, []))
+        """Framework log lines plus, in k8s mode, each pod's ``pod.log``
+        tail (parity: ``ApplicationResource.java:318`` streams the role
+        pods' container logs, not webservice-internal lines)."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        lines = list(self.compute.logs.get((tenant, name), []))
+        # pod.log reads are filesystem I/O — off the event loop
+        per_pod = await asyncio.to_thread(self.compute.pod_logs, tenant, name)
+        for pod_name, pod_lines in per_pod.items():
+            lines.append(f"---- pod {pod_name} (pod.log) ----")
+            lines.extend(pod_lines)
         return web.Response(text="\n".join(lines))
 
     async def _agents(self, request: web.Request) -> web.Response:

@@ -1,0 +1,29 @@
+"""Small asyncio helpers shared across the agent and runtime layers."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+
+def spawn_retained(
+    coro, tasks: set, log: logging.Logger, error_msg: str
+) -> asyncio.Task:
+    """Schedule ``coro`` and retain its task handle in ``tasks``.
+
+    The event loop keeps only a weak reference to scheduled tasks, so a
+    fire-and-forget ``ensure_future`` can be garbage-collected mid-flight
+    and a failure in it vanishes silently. The handle stays in ``tasks``
+    until the task finishes; a non-cancellation exception is logged as
+    ``error_msg``.
+    """
+    task = asyncio.ensure_future(coro)
+    tasks.add(task)
+
+    def _done(t) -> None:
+        tasks.discard(t)
+        if not t.cancelled() and t.exception() is not None:
+            log.error(error_msg, exc_info=t.exception())
+
+    task.add_done_callback(_done)
+    return task

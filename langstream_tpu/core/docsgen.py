@@ -146,6 +146,24 @@ CONFIG_DOCS: dict[str, dict[str, str]] = {
 }
 
 
+# Per-type prose notes rendered after the config table: descope decisions
+# and permanent caveats a key/description table can't carry.
+TYPE_NOTES: dict[str, str] = {
+    "camel-source": (
+        "**Scheme support is permanently descoped to `timer:` and "
+        "`file:`.** The reference embeds the full Apache Camel JVM runtime "
+        "(300+ components); a Python port of that surface would be a "
+        "second project, and every pipeline in this repo's examples and "
+        "tests only ever exercises the timer and file components. Other "
+        "schemes fail at planning time with a clear error naming the "
+        "supported subset. This is a deliberate, permanent decision, not "
+        "a TODO — new event-source integrations should be first-class "
+        "agents (like `webcrawler-source` or `azure-blob-storage-source`), "
+        "not Camel URIs."
+    ),
+}
+
+
 def agent_docs() -> dict[str, Any]:
     """Structured docs for every registered agent type."""
     out: dict[str, Any] = {}
@@ -156,6 +174,8 @@ def agent_docs() -> dict[str, Any]:
             "composable": meta.composable if meta else True,
             "configuration": CONFIG_DOCS.get(agent_type, {}),
         }
+        if agent_type in TYPE_NOTES:
+            out[agent_type]["notes"] = TYPE_NOTES[agent_type]
     return out
 
 
@@ -173,6 +193,9 @@ def render_markdown() -> str:
             lines.append("|---|---|")
             for key, desc in doc["configuration"].items():
                 lines.append(f"| `{key}` | {desc} |")
+        if doc.get("notes"):
+            lines.append("")
+            lines.append(doc["notes"])
         lines.append("")
     return "\n".join(lines)
 

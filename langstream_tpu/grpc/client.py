@@ -218,8 +218,8 @@ class _GrpcAgentBase:
             # writes instead of leaving them suspended on a silent channel
             try:
                 call.cancel()
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("topic-producer stream cancel failed: %s", e)
 
     async def _restart_transport(self) -> bool:
         """Respawn a dead sidecar and reconnect (parity: the reference's
@@ -238,8 +238,8 @@ class _GrpcAgentBase:
             self._tp_task.cancel()
         try:
             await self.channel.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001
+            log.debug("closing dead channel failed: %s", e)
         await loop.run_in_executor(None, self.sidecar.stop)
         try:
             await self._connect()

@@ -2,13 +2,18 @@
 
 ``protoc --python_out`` runs once per proto-file content hash (no
 ``grpcio-tools`` in the image, so the service layer is defined here as a
-method table both the aio server and the client build from).
+method table both the aio server and the client build from). Images with
+no ``protoc`` binary either fall back to :func:`_fallback_messages` — the
+same messages built as a ``FileDescriptorProto`` against the installed
+protobuf runtime, wire-compatible with protoc output because field numbers
+and types are identical.
 """
 
 from __future__ import annotations
 
 import hashlib
 import importlib.util
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -26,11 +31,17 @@ class ProtoBuildError(RuntimeError):
 
 
 def load_messages():
-    """Generate (if needed) and import the ``agent_pb2`` message module."""
+    """Generate (if needed) and import the ``agent_pb2`` message module.
+
+    Resolution order: cached protoc output for this proto hash → a fresh
+    ``protoc`` run → :func:`_fallback_messages` when no protoc binary
+    exists in the image."""
     digest = hashlib.sha256(PROTO_FILE.read_bytes()).hexdigest()[:16]
     gen_dir = _GEN_DIR / digest
     target = gen_dir / "agent_pb2.py"
     if not target.exists():
+        if shutil.which("protoc") is None:
+            return _fallback_messages()
         gen_dir.mkdir(parents=True, exist_ok=True)
         with tempfile.TemporaryDirectory() as tmp:
             proc = subprocess.run(
@@ -55,6 +66,115 @@ def load_messages():
     sys.modules[spec.name] = module
     spec.loader.exec_module(module)
     return module
+
+
+_FALLBACK_CACHE = None
+
+
+def _fallback_messages():
+    """``agent.proto`` compiled in-process, no protoc: the schema rebuilt
+    as a ``FileDescriptorProto`` against the installed protobuf runtime.
+
+    Wire-compatible with protoc output — field numbers, types, and labels
+    below mirror ``agent.proto`` exactly, so a sidecar running the
+    protoc-generated module interoperates with a runtime running this one
+    (and vice versa). Kept in sync by ``tests/test_grpc_agents.py``, which
+    exercises every message over a real channel.
+    """
+    global _FALLBACK_CACHE
+    if _FALLBACK_CACHE is not None:
+        return _FALLBACK_CACHE
+    import types
+
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    T = descriptor_pb2.FieldDescriptorProto
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="langstream_tpu/agent_fallback.proto",
+        package="langstream_tpu",
+        syntax="proto3",
+    )
+
+    # (name, number, type, label, message type name, oneof index)
+    SCHEMA: dict[str, list[tuple]] = {
+        "Datum": [
+            ("null_value", 1, T.TYPE_BOOL, None, None, 0),
+            ("bytes_value", 2, T.TYPE_BYTES, None, None, 0),
+            ("string_value", 3, T.TYPE_STRING, None, None, 0),
+            ("json_value", 4, T.TYPE_STRING, None, None, 0),
+        ],
+        "Header": [
+            ("name", 1, T.TYPE_STRING, None, None, None),
+            ("value", 2, T.TYPE_MESSAGE, None, "Datum", None),
+        ],
+        "WireRecord": [
+            ("record_id", 1, T.TYPE_INT64, None, None, None),
+            ("key", 2, T.TYPE_MESSAGE, None, "Datum", None),
+            ("value", 3, T.TYPE_MESSAGE, None, "Datum", None),
+            ("headers", 4, T.TYPE_MESSAGE, T.LABEL_REPEATED, "Header", None),
+            ("origin", 5, T.TYPE_STRING, None, None, None),
+            ("timestamp", 6, T.TYPE_INT64, None, None, None),
+        ],
+        "InfoRequest": [],
+        "InfoResponse": [("info_json", 1, T.TYPE_STRING, None, None, None)],
+        "SourceRequest": [
+            ("committed_ids", 1, T.TYPE_INT64, T.LABEL_REPEATED, None, None),
+            ("failed_id", 2, T.TYPE_INT64, None, None, None),
+            ("failure_error", 3, T.TYPE_STRING, None, None, None),
+        ],
+        "SourceResponse": [
+            ("records", 1, T.TYPE_MESSAGE, T.LABEL_REPEATED, "WireRecord", None),
+        ],
+        "ProcessRequest": [
+            ("records", 1, T.TYPE_MESSAGE, T.LABEL_REPEATED, "WireRecord", None),
+        ],
+        "ProcessResult": [
+            ("record_id", 1, T.TYPE_INT64, None, None, None),
+            ("records", 2, T.TYPE_MESSAGE, T.LABEL_REPEATED, "WireRecord", None),
+            ("error", 3, T.TYPE_STRING, None, None, None),
+        ],
+        "ProcessResponse": [
+            ("results", 1, T.TYPE_MESSAGE, T.LABEL_REPEATED, "ProcessResult", None),
+        ],
+        "SinkRequest": [
+            ("record", 1, T.TYPE_MESSAGE, None, "WireRecord", None),
+        ],
+        "SinkResponse": [
+            ("record_id", 1, T.TYPE_INT64, None, None, None),
+            ("error", 2, T.TYPE_STRING, None, None, None),
+        ],
+        "TopicProducerRecord": [
+            ("record_id", 1, T.TYPE_INT64, None, None, None),
+            ("topic", 2, T.TYPE_STRING, None, None, None),
+            ("record", 3, T.TYPE_MESSAGE, None, "WireRecord", None),
+        ],
+        "TopicProducerAck": [
+            ("record_id", 1, T.TYPE_INT64, None, None, None),
+            ("error", 2, T.TYPE_STRING, None, None, None),
+        ],
+    }
+    for msg_name, fields in SCHEMA.items():
+        m = fd.message_type.add(name=msg_name)
+        if msg_name == "Datum":
+            m.oneof_decl.add(name="kind")
+        for name, number, ftype, label, type_name, oneof in fields:
+            f = m.field.add(
+                name=name, number=number, type=ftype,
+                label=label if label is not None else T.LABEL_OPTIONAL,
+            )
+            if type_name is not None:
+                f.type_name = f".langstream_tpu.{type_name}"
+            if oneof is not None:
+                f.oneof_index = oneof
+
+    # private pool: never collides with a protoc-generated module loaded
+    # into the default pool by another component in this process
+    pool = descriptor_pool.DescriptorPool()
+    classes = message_factory.GetMessages([fd], pool=pool)
+    _FALLBACK_CACHE = types.SimpleNamespace(
+        **{full.rsplit(".", 1)[1]: cls for full, cls in classes.items()}
+    )
+    return _FALLBACK_CACHE
 
 
 def method_table(pb2) -> dict[str, dict]:

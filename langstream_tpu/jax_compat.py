@@ -1,0 +1,70 @@
+"""Version-compatibility shims for the JAX surface this tree uses.
+
+The codebase targets the current JAX API (``jax.shard_map`` with
+``check_vma``, ``pltpu.CompilerParams``); the image may pin an older 0.4.x
+release where ``shard_map`` still lives in ``jax.experimental.shard_map``
+(with the ``check_rep`` spelling) and the Pallas TPU compiler-params
+dataclass is named ``TPUCompilerParams``. Every shard_map /
+compiler-params consumer imports from here so either version works — one
+resolution point instead of a try/except per call site.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+else:  # jax < 0.6: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters
+)
+
+# Partial-manual shard_map (some axes manual, the rest automatic) only
+# works on jax versions with the ``axis_names`` parameter; the older
+# ``auto=`` spelling miscompiles on CPU (XLA "PartitionId is not supported
+# for SPMD partitioning"). Callers that ONLY need partial-auto as an
+# optimisation (e.g. in-stage sharding constraints inside a pipeline
+# stage) check this and degrade to replicated compute on old jax.
+SHARD_MAP_PARTIAL_AUTO = "axis_names" in _SHARD_MAP_PARAMS
+
+
+def shard_map(f, /, **kwargs):
+    """``jax.shard_map`` under either replication-check spelling.
+
+    Callers write the current ``check_vma=...``; on a jax whose shard_map
+    only knows ``check_rep`` (or vice versa) the kwarg is renamed to the
+    one the installed version accepts.
+    """
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _SHARD_MAP_PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    if "axis_names" in kwargs and "axis_names" not in _SHARD_MAP_PARAMS:
+        # no partial-manual support: run fully manual. Axes the specs never
+        # mention are replicated, so the result is identical — non-manual
+        # axes just lose automatic sharding inside the body (see
+        # SHARD_MAP_PARTIAL_AUTO for how bodies degrade their constraints).
+        kwargs.pop("axis_names")
+        kwargs.pop("check_vma", None); kwargs["check_rep"] = False
+    return _shard_map_impl(f, **kwargs)
+
+
+def pallas_compiler_params():
+    """The Pallas TPU compiler-params class under its current name.
+
+    Resolved lazily (function, not module attribute) so importing this
+    module never pulls in Pallas — kernel modules already import it, but
+    ``parallel/`` shard_map users must stay Pallas-free on backends where
+    Pallas is unavailable.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # the jax < 0.5 name
+        cls = pltpu.TPUCompilerParams
+    return cls

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 from collections import deque
+from pathlib import Path
 from typing import Any
 
 from langstream_tpu.controlplane.stores import StoredApplication
@@ -34,8 +35,12 @@ class KubernetesComputeRuntime:
         api: KubeApi,
         image: str = "langstream-tpu/runtime:latest",
         code_storage_config: dict[str, Any] | None = None,
+        pods_root: Path | str | None = None,
     ):
         self.api = api
+        # the ProcessKubelet root: pod subprocess stdout/stderr lands in
+        # <pods_root>/pods/<namespace>/<pod>/pod.log, which /logs surfaces
+        self.pods_root = Path(pods_root) if pods_root is not None else None
         self.code_storage_config = code_storage_config
         self.code_storage = (
             make_code_storage(code_storage_config) if code_storage_config else None
@@ -99,6 +104,59 @@ class KubernetesComputeRuntime:
                 self.api.delete("Agent", namespace, cr_name)
                 self.api.delete("Secret", namespace, f"{cr_name}-config")
         self.logs.pop(key, None)
+
+    def pod_logs(
+        self, tenant: str, name: str, tail: int = 200
+    ) -> dict[str, list[str]]:
+        """Pod name → last ``tail`` lines of its ``pod.log``.
+
+        Pod names come from the application's live StatefulSets and Jobs,
+        matched by their ``langstream-application`` label — name-prefix
+        matching against kubelet directories would leak logs across
+        applications whose ids prefix each other (``chat`` vs ``chat-2``).
+        STS pods are ``<sts>-<ordinal>``; a Job's pod shares the Job's
+        name (see ``ProcessKubelet``). Synchronous file I/O by design:
+        the /logs handler offloads it to an executor.
+        """
+        from langstream_tpu.k8s.cluster_runtime import tenant_namespace
+
+        if self.pods_root is None:
+            return {}
+        namespace = tenant_namespace(tenant)
+        ns_dir = self.pods_root / "pods" / namespace
+        if not ns_dir.is_dir():
+            return {}
+        selector = {"langstream-application": name}
+        pod_names: set[str] = set()
+        for sts in self.api.list(
+            "StatefulSet", namespace, label_selector=selector
+        ):
+            sts_name = sts["metadata"]["name"]
+            replicas = int(sts["spec"].get("replicas", 1))
+            pod_names.update(f"{sts_name}-{i}" for i in range(replicas))
+        for job in self.api.list("Job", namespace, label_selector=selector):
+            pod_names.add(job["metadata"]["name"])
+        out: dict[str, list[str]] = {}
+        for pod_name in sorted(pod_names):
+            log_path = ns_dir / pod_name / "pod.log"
+            if not log_path.is_file():
+                continue
+            # bounded tail read: pod.log is append-only and never rotated,
+            # so reading the whole file would grow without limit
+            window = max(tail * 512, 65536)
+            try:
+                with log_path.open("rb") as f:
+                    f.seek(0, 2)
+                    size = f.tell()
+                    f.seek(max(0, size - window))
+                    chunk = f.read(window)
+            except OSError:
+                continue
+            lines = chunk.decode(errors="replace").splitlines()
+            if size > window:
+                lines = lines[1:]  # window start lands mid-line; drop it
+            out[pod_name] = lines[-tail:]
+        return out
 
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         """Agent CR specs + operator-written statuses."""

@@ -299,8 +299,8 @@ class ProcessKubelet:
             sts["status"] = {"readyReplicas": ready, "replicas": replicas}
             try:
                 self.api.update_status(sts)
-            except Exception:
-                pass  # conflict: next pass re-reads
+            except Exception as e:
+                log.debug("sts status update conflict (next pass re-reads): %s", e)
         return keys
 
     def _sync_job(self, ns: str, job: dict[str, Any]) -> set[tuple[str, str]]:
@@ -337,8 +337,8 @@ class ProcessKubelet:
             try:
                 self.api.update_status(job)
                 pod.reported = True
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("job status update conflict (next pass retries): %s", e)
         return {key}
 
     # -- lifecycle ---------------------------------------------------------

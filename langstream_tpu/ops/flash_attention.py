@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from langstream_tpu.jax_compat import pallas_compiler_params as _compiler_params
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -164,7 +166,7 @@ def _flash_bhsd(
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params()(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -202,8 +204,9 @@ def flash_attention(
     if mesh is not None:
         from functools import partial as _partial
 
-        from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from langstream_tpu.jax_compat import shard_map
 
         axes = mesh.axis_names
         H_, Kh_, B_ = q.shape[2], k.shape[2], q.shape[0]

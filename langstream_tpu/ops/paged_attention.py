@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from langstream_tpu.jax_compat import pallas_compiler_params as _compiler_params
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -296,7 +298,7 @@ def paged_attention_partial(
             jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
             jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params()(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -362,7 +364,7 @@ def _paged_attention_partial_q8(
             jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
             jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params()(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -545,7 +547,7 @@ def paged_attention_multiquery_partial(
             jax.ShapeDtypeStruct((B, nt * THb, 8), jnp.float32),
             jax.ShapeDtypeStruct((B, nt * THb, 8), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params()(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -580,8 +582,9 @@ def shard_mapped_paged_read(
     divide ``tp``. One copy so the two call sites can't drift."""
     from functools import partial as _partial
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from langstream_tpu.jax_compat import shard_map
 
     axes = mesh.axis_names
     dp = (

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from langstream_tpu.jax_compat import SHARD_MAP_PARTIAL_AUTO, shard_map
+
 from langstream_tpu.models.llama import (
     LlamaConfig,
     _rms_norm,
@@ -80,8 +82,11 @@ def gpipe(
 
     # scan (not fori_loop): the schedule must be reverse-differentiable so a
     # training step can backprop through the pipeline
+    # the aux accumulator is rank-1, never a scalar: jax 0.4.x shard_map
+    # partial-eval mis-names scalar residuals in the backward pass
+    # (_SpecError from _check_names) — a (1,) carry sidesteps it
     (_, out, aux_acc), _ = jax.lax.scan(
-        tick, (buf0, out0, jnp.float32(0.0)), jnp.arange(T)
+        tick, (buf0, out0, jnp.zeros((1,), jnp.float32)), jnp.arange(T)
     )
     # results live on the last stage; psum broadcasts them (other stages
     # contribute zeros) so the head/loss runs identically everywhere.
@@ -149,7 +154,7 @@ def llama_forward_pp(
         out, _ = jax.lax.scan(body, xm, local_layers)
         return out.astype(jnp.float32), jnp.float32(0.0)
 
-    run = jax.shard_map(
+    run = shard_map(
         lambda layers, xm: gpipe(partial(stage, layers), xm)[0],
         mesh=mesh,
         in_specs=(
@@ -187,7 +192,10 @@ def moe_forward_pp(
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     capacity = c.capacity((B // M) * S)
     axes = mesh.axis_names
-    ep = "ep" if "ep" in axes else None
+    # in-stage ep constraints need partial-manual shard_map (pp manual,
+    # ep/tp automatic); old jax runs the stage fully manual instead, where
+    # a mesh-axis constraint is illegal — experts are simply replicated
+    ep = "ep" if "ep" in axes and SHARD_MAP_PARTIAL_AUTO else None
     e_spec = NamedSharding(mesh, P(ep, None, None))
 
     x = jnp.take(params["embed"], tokens, axis=0)
@@ -214,14 +222,17 @@ def moe_forward_pp(
                     else None
                 ),
             )
-            return (x + ffn, aux_acc + aux), None
+            # the aux accumulator is shape (1,), not a scalar: jax 0.4.x
+            # shard_map partial-eval mis-names scalar residuals in the
+            # backward pass (_SpecError) — a rank-1 carry sidesteps it
+            return (x + ffn, aux_acc + aux.reshape(1)), None
 
         (out, aux_total), _ = jax.lax.scan(
-            body, (xm, jnp.float32(0.0)), local_layers
+            body, (xm, jnp.zeros((1,), jnp.float32)), local_layers
         )
         return out.astype(jnp.float32), aux_total
 
-    run = jax.shard_map(
+    run = shard_map(
         lambda layers, xm: gpipe(partial(stage_fn, layers), xm),
         mesh=mesh,
         in_specs=(
@@ -236,4 +247,4 @@ def moe_forward_pp(
     x = x.reshape(B, S, c.hidden)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]).astype(jnp.float32)
-    return logits, aux_total / M
+    return logits, aux_total.reshape(()) / M

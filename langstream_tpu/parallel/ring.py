@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from langstream_tpu.jax_compat import shard_map
+
 
 def _axis_or_none(mesh: Mesh, name: str | None) -> str | None:
     if name is None or mesh is None:
@@ -151,7 +153,7 @@ def ring_attention(
     if sa is None:
         raise ValueError(f"mesh {mesh.axis_names} has no sequence axis {seq_axis!r}")
     spec = P(ba, sa, ha, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ring_attention_local, axis_name=sa, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -242,7 +244,7 @@ def ulysses_attention(
     if sa is None:
         raise ValueError(f"mesh {mesh.axis_names} has no sequence axis {seq_axis!r}")
     spec = P(ba, sa, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ulysses_local, axis_name=sa, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(spec, spec, spec),

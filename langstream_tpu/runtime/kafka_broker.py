@@ -225,8 +225,8 @@ def kafka_message_to_record(msg: Any) -> Record:
         ts_type, ts_value = msg.timestamp()
         if ts_value and ts_value > 0:
             ts = ts_value
-    except Exception:
-        pass
+    except Exception as e:
+        logger.debug("message has no usable timestamp: %s", e)
     return SimpleRecord(
         value=deserialize_datum(msg.value(), kinds.get(VALUE_KIND_HEADER)),
         key=deserialize_datum(msg.key(), kinds.get(KEY_KIND_HEADER)),

@@ -24,8 +24,10 @@ example sets ``security.protocol: SASL_SSL`` + ``sasl.mechanism: PLAIN``,
 connection, SASL PLAIN and SCRAM-SHA-256/-512 (RFC 5802, stdlib hmac/
 hashlib) over SaslHandshake(v1) + SaslAuthenticate(v0). Fetch
 decompression: gzip (stdlib) and zstd (zstandard, present in this image)
-always; snappy/lz4 raise a clear error naming the missing codec library.
-Produce-side compression: optional gzip.
+always; snappy always too — a pure-Python raw-block decoder
+(:func:`_snappy_decompress_raw`) handles xerial-framed and bare blocks
+when python-snappy is absent; lz4 raises a clear error naming the
+missing codec library. Produce-side compression: optional gzip.
 
 APIs: ApiVersions(0) Metadata(1) Produce(3) Fetch(4) ListOffsets(1)
 FindCoordinator(1) OffsetCommit(2) OffsetFetch(1) JoinGroup(2)
@@ -39,6 +41,7 @@ import asyncio
 import base64
 import hashlib
 import hmac
+import logging
 import re
 import secrets
 import ssl as ssl_module
@@ -46,6 +49,8 @@ import struct
 import zlib
 from dataclasses import dataclass, field
 from typing import Any
+
+logger = logging.getLogger(__name__)
 
 # api keys
 API_PRODUCE = 0
@@ -321,13 +326,106 @@ def _gzip_compress(data: bytes) -> bytes:
 
 _CODEC_NAMES = {1: "gzip", 2: "snappy", 3: "lz4", 4: "zstd"}
 
+#: xerial block-stream magic java snappy producers prepend
+XERIAL_MAGIC = b"\x82SNAPPY\x00"
+
+
+def _snappy_decompress_raw(data: bytes) -> bytes:
+    """One raw snappy block, pure Python: a varint32 preamble with the
+    uncompressed length, then tagged literal/copy elements (the format's
+    only two element kinds). Fetch-path only — slow next to the C codec,
+    but a consumer must read whatever an upstream java producer wrote,
+    and this image has no python-snappy to lean on."""
+    total = 0
+    shift = 0
+    i = 0
+    while True:
+        if i >= len(data):
+            raise KafkaProtocolError(-1, "truncated snappy preamble")
+        byte = data[i]
+        i += 1
+        total |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while i < len(data):
+        tag = data[i]
+        i += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = tag >> 2
+            if length >= 60:  # 60..63: length-1 in the next 1..4 LE bytes
+                extra = length - 59
+                length = int.from_bytes(data[i : i + extra], "little")
+                i += extra
+            length += 1
+            if i + length > len(data):
+                raise KafkaProtocolError(-1, "truncated snappy literal")
+            out += data[i : i + length]
+            i += length
+            continue
+        extra = 1 if kind == 1 else 2 if kind == 2 else 4
+        if i + extra > len(data):
+            raise KafkaProtocolError(-1, "truncated snappy copy")
+        if kind == 1:  # copy, 1-byte offset: len 4..11, offset 11 bits
+            length = ((tag >> 2) & 0x07) + 4
+            offset = ((tag >> 5) << 8) | data[i]
+        elif kind == 2:  # copy, 2-byte LE offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[i : i + 2], "little")
+        else:  # copy, 4-byte LE offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[i : i + 4], "little")
+        i += extra
+        if offset == 0 or offset > len(out):
+            raise KafkaProtocolError(-1, "corrupt snappy copy offset")
+        start = len(out) - offset
+        if offset >= length:
+            out += out[start : start + length]
+        else:  # overlapping copy = run-length repeat, byte at a time
+            for j in range(length):
+                out.append(out[start + j])
+    if len(out) != total:
+        raise KafkaProtocolError(
+            -1, f"snappy length mismatch: got {len(out)}, preamble {total}"
+        )
+    return bytes(out)
+
+
+try:
+    # resolved once: a failed import is not cached, and re-attempting it
+    # per fetch batch costs a sys.path scan in the consumer hot path
+    import snappy as _python_snappy
+
+    _snappy_block = _python_snappy.decompress
+except ImportError:
+    _snappy_block = _snappy_decompress_raw
+
+
+def _snappy_decompress(data: bytes) -> bytes:
+    """Snappy as Kafka ships it: java producers wrap raw blocks in xerial
+    stream framing (magic + two version ints, then length-prefixed
+    blocks); plain raw blocks also occur. python-snappy accelerates the
+    per-block decode when present; the pure-Python decoder is the
+    always-available fallback."""
+    block = _snappy_block
+    if data[:8] == XERIAL_MAGIC:
+        r = Reader(data, 16)  # skip magic + version + compat
+        chunks = []
+        while r.remaining() > 0:
+            chunks.append(block(r.raw(r.i32())))
+        return b"".join(chunks)
+    return block(data)
+
 
 def decompress_records(codec: int, data: bytes) -> bytes:
     """Decompress a batch's records section. gzip rides stdlib zlib; zstd
-    the ``zstandard`` package (present in this image); snappy/lz4 need
-    libraries absent here — the error names the codec and the library so
-    the operator knows exactly what the producer must change (or install).
-    """
+    the ``zstandard`` package (present in this image); snappy the
+    pure-Python raw-block decoder (python-snappy accelerates when
+    installed); lz4 needs a library absent here — the error names the
+    codec and the library so the operator knows exactly what the
+    producer must change (or install)."""
     if codec == 1:  # gzip
         return zlib.decompress(data, 16 + zlib.MAX_WBITS)
     if codec == 4:  # zstd
@@ -342,34 +440,18 @@ def decompress_records(codec: int, data: bytes) -> bytes:
         # emit frames WITHOUT the content-size header field, which the
         # one-shot decompress() refuses
         return zstandard.ZstdDecompressor().decompressobj().decompress(data)
-    if codec in (2, 3):  # snappy (xerial framing) / lz4 (frame format)
-        name = _CODEC_NAMES[codec]
-        lib = {"snappy": "python-snappy", "lz4": "lz4"}[name]
+    if codec == 2:  # snappy (xerial framing or bare raw blocks)
+        return _snappy_decompress(data)
+    if codec == 3:  # lz4 (frame format)
         try:
-            if codec == 2:
-                import snappy  # noqa: F401
-            else:
-                import lz4.frame  # noqa: F401
+            import lz4.frame
         except ImportError:
             raise KafkaProtocolError(
                 -1,
-                f"{name}-compressed batch but the '{lib}' package is not "
-                f"installed in this image; reconfigure the producing side "
-                f"to gzip/zstd/none or install {lib}",
+                "lz4-compressed batch but the 'lz4' package is not "
+                "installed in this image; reconfigure the producing side "
+                "to gzip/zstd/snappy/none or install lz4",
             ) from None
-        if codec == 2:
-            import snappy
-
-            # java producers wrap snappy in xerial block framing
-            if data[:8] == b"\x82SNAPPY\x00":
-                r = Reader(data, 16)
-                chunks = []
-                while r.remaining() > 0:
-                    chunks.append(snappy.decompress(r.raw(r.i32())))
-                return b"".join(chunks)
-            return snappy.decompress(data)
-        import lz4.frame
-
         return lz4.frame.decompress(data)
     raise KafkaProtocolError(-1, f"unknown compression codec {codec}")
 
@@ -809,8 +891,8 @@ class _Conn:
                 # so the next call redials (and re-authenticates)
                 try:
                     self._writer.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("closing dead broker socket failed: %s", e)
                 self._writer = self._reader = None
                 raise
 

@@ -22,6 +22,7 @@ identical in both modes and shared via :class:`ContiguousOffsetTracker`.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import time
 from typing import Any
@@ -56,6 +57,8 @@ from langstream_tpu.runtime.kafka_wire import (
     WireRecord,
     range_assign,
 )
+
+logger = logging.getLogger(__name__)
 
 _GROUP_ERRORS = (
     ERR_ILLEGAL_GENERATION,
@@ -211,8 +214,10 @@ class GroupMembership:
             self._hb_task.cancel()
             try:
                 await self._hb_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001
+                logger.debug("heartbeat task errored at leave: %s", e)
             self._hb_task = None
         if self.member_id:
             try:

@@ -153,14 +153,18 @@ class PravegaTopicConsumer(TopicConsumer):
                 # executor thread, never run broker RPCs on the loop
                 try:
                     late = fut.result()
-                except Exception:
+                except Exception as e:
+                    logger.debug("abandoned acquire resolved with error: %s", e)
                     return
                 if late is not None and reader is not None:
                     def _release() -> None:
                         try:
                             reader.release_segment(late)
-                        except Exception:
-                            pass  # reader already offline at shutdown
+                        except Exception as e:
+                            logger.debug(
+                                "late segment release skipped "
+                                "(reader already offline): %s", e,
+                            )
 
                     try:
                         loop.run_in_executor(None, _release)

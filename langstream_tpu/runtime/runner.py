@@ -42,6 +42,7 @@ from langstream_tpu.api.topics import (
     TopicConsumer,
     TopicProducer,
 )
+from langstream_tpu.core.asyncutil import spawn_retained
 from langstream_tpu.runtime.composite import CompositeAgentProcessor
 from langstream_tpu.runtime.errors_handler import (
     FailureAction,
@@ -144,9 +145,16 @@ class _RunnerRecordSink:
 
     def __init__(self, runner: "AgentRunner"):
         self.runner = runner
+        self._tasks: set = set()
 
     def emit(self, result: SourceRecordAndResult) -> None:
-        asyncio.ensure_future(self.runner._handle_result(result))
+        # a failed _handle_result must not vanish with its record un-acked
+        spawn_retained(
+            self.runner._handle_result(result),
+            self._tasks,
+            log,
+            "result handling failed",
+        )
 
     def emit_error(self, source_record: Record, error: Exception) -> None:
         self.emit(SourceRecordAndResult(source_record, [], error))
@@ -448,8 +456,10 @@ class AgentRunner:
             self._service_task.cancel()
             try:
                 await self._service_task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:
+                log.debug("service task errored at stop: %s", e)
         for closer in (self.processor, self.sink, self.source):
             try:
                 await closer.close()

@@ -39,6 +39,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from typing import Any, Awaitable, Callable
@@ -141,6 +142,14 @@ class ServingConfig:
     warmup_on_start: bool = False
     # max requests prefilled in one batched call
     prefill_batch: int = 8
+    # model compute/param dtype override: None keeps the model's default
+    # (bf16), "float32" runs params + activations in f32. f32 makes
+    # greedy streams exactly shape-independent — decode, verify, and
+    # sharded paths reduce to the same argmax regardless of XLA fusion —
+    # which bf16 only approximates (near-tie logits can flip between
+    # differently-shaped programs, backend-dependent). Dev/CPU posture
+    # and exactness tests; 2x the param+cache HBM of bf16 on chips.
+    model_dtype: str | None = None
     # weight-only quantization: None (bf16) or "int8" (scales TP-shard
     # with their weights, so the mesh posture keeps the int8 default)
     quantize: str | None = None
@@ -221,12 +230,14 @@ class ServingConfig:
             "prefix-cache-max-suffix": self.prefix_cache_max_suffix,
             "prefill-chunk": self.prefill_chunk,
             "speculative-drafts": self.speculative_drafts,
+            "model-dtype": self.model_dtype,
         }
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ServingConfig":
         mesh = tuple((k, int(v)) for k, v in (d.get("mesh") or {}).items())
         return cls(
+            model_dtype=d.get("model-dtype", d.get("model_dtype")),
             quantize=d.get("quantize"),
             kv_quantize=d.get("kv-quantize", d.get("kv_quantize")),
             model=d.get("model", "tiny"),
@@ -309,6 +320,9 @@ class _Request:
     logprobs: list[float] = dataclasses.field(default_factory=list)
     loop: asyncio.AbstractEventLoop | None = None
     enqueue_time: float = 0.0
+    # TTFT decomposition: enqueue → admit (queue wait) → first token
+    # (prefill); the remainder to the client's first chunk is transport
+    admit_time: float | None = None
     first_token_time: float | None = None
     # prompt-lookup speculation: bigram -> most recent first-element index,
     # maintained incrementally (amortized O(1)/token; a backward rescan per
@@ -378,6 +392,19 @@ class TpuServingEngine:
         self.model_config = _resolve_model_config(
             config.model, config.max_seq_len
         )
+        if config.model_dtype is not None:
+            dtypes = {
+                "float32": jnp.float32, "f32": jnp.float32,
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+            }
+            if config.model_dtype not in dtypes:
+                raise ValueError(
+                    f"unknown model_dtype {config.model_dtype!r}; "
+                    f"known: {sorted(dtypes)}"
+                )
+            self.model_config = dataclasses.replace(
+                self.model_config, dtype=dtypes[config.model_dtype]
+            )
         self.is_moe = config.model in _MOE_MODELS
         self.tokenizer: Tokenizer = load_tokenizer(config.tokenizer)
         if self.tokenizer.vocab_size > self.model_config.vocab_size:
@@ -441,6 +468,9 @@ class TpuServingEngine:
         self._freq = np.zeros(config.slots, dtype=np.float32)
         self._pending_emits: list = []
         self._finished_requests: list = []
+        # per-request {queue_wait, prefill, ttft} seconds, newest last —
+        # the gateway bench reads this to attribute client-measured TTFT
+        self.request_timings: deque[dict[str, float]] = deque(maxlen=4096)
         self.total_generated = 0
         # Prometheus serving metrics (ride the pod's /metrics endpoint next
         # to the per-agent counters; labeled by model)
@@ -1126,8 +1156,9 @@ class TpuServingEngine:
             if not task.done():
                 try:
                     await asyncio.shield(task)
+                # graftcheck: disable=EXC402 warmup failure is logged by the task done-callback
                 except Exception:
-                    pass  # logged by the task callback; lazy compiles take over
+                    pass  # lazy compiles take over
         tokens = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -1983,6 +2014,7 @@ class TpuServingEngine:
                     slot.request = request
                     slot.prefilling = True
                     slot.prefill_done = reuse
+                    request.admit_time = time.monotonic()
                     if reuse:
                         self._m_prefix_hits(1)
                         self._m_prefix_tokens(reuse)
@@ -2005,8 +2037,10 @@ class TpuServingEngine:
                 batch.append((slot_id, request, reuse))
             if not batch:
                 return
+            admit_now = time.monotonic()
             for slot_id, request, _reuse in batch:
                 self.slots[slot_id].request = request
+                request.admit_time = admit_now
                 if self.block_mgr is not None:
                     self.block_mgr.ensure_capacity(
                         slot_id, len(request.prompt_tokens)
@@ -2279,6 +2313,14 @@ class TpuServingEngine:
                 ]
                 if hits:
                     text = text[: min(hits)]
+            first = request.first_token_time or time.monotonic()
+            admit = request.admit_time or first
+            timing = {
+                "queue_wait": admit - request.enqueue_time,
+                "prefill": first - admit,
+                "ttft": first - request.enqueue_time,
+            }
+            self.request_timings.append(timing)
             if not request.future.done():
                 request.future.set_result(
                     {
@@ -2287,8 +2329,9 @@ class TpuServingEngine:
                         "logprobs": request.logprobs,
                         "num_prompt_tokens": len(request.prompt_tokens),
                         "num_completion_tokens": len(request.generated),
-                        "ttft": (request.first_token_time or time.monotonic())
-                        - request.enqueue_time,
+                        "ttft": timing["ttft"],
+                        "queue_wait": timing["queue_wait"],
+                        "prefill": timing["prefill"],
                         "finish_reason": (
                             "stop"
                             if is_eos or request.stop_matched

@@ -56,6 +56,10 @@ def _rs256_token_and_jwk(claims: dict) -> tuple[str, dict]:
     import base64
     import json
 
+    # RS256 (mint and verify) rides the ``cryptography`` primitives —
+    # skip (not fail) on images without the package; HS256 coverage above
+    # is pure stdlib and always runs
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
 

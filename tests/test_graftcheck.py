@@ -1,0 +1,709 @@
+"""graftcheck: per-rule fixtures plus the tier-1 whole-tree gate.
+
+Every rule family carries a true-positive snippet (the bug fires) and a
+true-negative snippet (the sanctioned spelling stays silent) — the
+fixtures are the contract that keeps rule edits honest. The gate at the
+bottom runs the analyzer over all of ``langstream_tpu/`` against the
+checked-in baseline and fails on any new violation or stale baseline
+entry, which is what makes graftcheck a guarantee instead of a tool.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from langstream_tpu.analysis import (
+    ALL_RULES,
+    BASELINE_PATH,
+    BaselineEntry,
+    RULES_BY_ID,
+    analyze_source,
+    load_baseline,
+    run,
+)
+
+
+def findings(source: str, path: str = "langstream_tpu/serving/engine.py"):
+    return analyze_source(textwrap.dedent(source), path, ALL_RULES)
+
+
+def rule_ids(source: str, path: str = "langstream_tpu/serving/engine.py"):
+    return [f.rule for f in findings(source, path)]
+
+
+# --------------------------------------------------------------------------
+# JAX101 — host sync inside a traced function
+# --------------------------------------------------------------------------
+
+
+def test_jax101_tp_item_inside_jit():
+    ids = rule_ids(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+        """
+    )
+    assert ids == ["JAX101"]
+
+
+def test_jax101_tp_float_of_traced_arg_in_pallas_wrapped():
+    ids = rule_ids(
+        """
+        import jax
+
+        def kernel(x):
+            return float(x)
+
+        traced = jax.jit(kernel)
+        """
+    )
+    assert ids == ["JAX101"]
+
+
+def test_jax101_tn_item_outside_trace():
+    ids = rule_ids(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def host_side(x):
+            return step(x).item()
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# JAX102 — Python branch on a traced value
+# --------------------------------------------------------------------------
+
+
+def test_jax102_tp_if_on_traced_arg():
+    ids = rule_ids(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        """
+    )
+    assert ids == ["JAX102"]
+
+
+def test_jax102_tn_static_arg_and_shape_checks():
+    ids = rule_ids(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def step(x, mode):
+            if mode == "fast":           # static: fine
+                return x
+            if x.shape[0] > 8:           # shape: trace-time constant
+                return x * 2
+            if x is None:                # identity: fine
+                return x
+            return -x
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# JAX103 — mutable default on a traced function
+# --------------------------------------------------------------------------
+
+
+def test_jax103_tp_list_default():
+    ids = rule_ids(
+        """
+        import jax
+
+        @jax.jit
+        def step(x, scales=[1.0, 2.0]):
+            return x
+        """
+    )
+    assert ids == ["JAX103"]
+
+
+def test_jax103_tn_none_default():
+    ids = rule_ids(
+        """
+        import jax
+
+        @jax.jit
+        def step(x, scales=None):
+            return x
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# JAX104 — host sync reachable from the decode hot loop
+# --------------------------------------------------------------------------
+
+
+def test_jax104_tp_item_in_helper_called_from_decode_loop():
+    ids = rule_ids(
+        """
+        class Engine:
+            def _decode_loop(self):
+                self._emit(self.chunk)
+
+            def _emit(self, chunk):
+                return chunk.item()
+        """
+    )
+    assert ids == ["JAX104"]
+
+
+def test_jax104_tn_asarray_chunk_fetch_and_cold_paths():
+    # np.asarray is the sanctioned one-transfer-per-chunk pattern, and the
+    # same .item() outside the reachable set doesn't fire
+    ids = rule_ids(
+        """
+        import numpy as np
+
+        class Engine:
+            def _decode_loop(self):
+                return np.asarray(self.chunk)
+
+            def debug_dump(self, x):
+                return x.item()
+        """
+    )
+    assert ids == []
+
+
+def test_jax104_tn_other_module_not_scanned():
+    ids = rule_ids(
+        """
+        class Engine:
+            def _decode_loop(self):
+                return self.chunk.item()
+        """,
+        path="langstream_tpu/agents/ai.py",
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# ASYNC201 — blocking call inside async def
+# --------------------------------------------------------------------------
+
+
+def test_async201_tp_time_sleep():
+    ids = rule_ids(
+        """
+        import time
+
+        async def handler(request):
+            time.sleep(1)
+        """
+    )
+    assert ids == ["ASYNC201"]
+
+
+def test_async201_nested_async_def_reported_once():
+    # the inner async def is walked on its own; the outer walk must not
+    # rescan it, or the same call double-reports
+    ids = rule_ids(
+        """
+        import time
+
+        async def outer():
+            async def inner():
+                time.sleep(1)
+            return inner
+        """
+    )
+    assert ids == ["ASYNC201"]
+
+
+def test_async201_tn_asyncio_sleep_and_sync_def():
+    ids = rule_ids(
+        """
+        import asyncio
+        import time
+
+        async def handler(request):
+            await asyncio.sleep(1)
+
+        def sync_helper():
+            time.sleep(1)
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# ASYNC202 — sync file I/O inside async def in a serving package
+# --------------------------------------------------------------------------
+
+
+def test_async202_tp_read_text_in_gateway_handler():
+    ids = rule_ids(
+        """
+        async def handler(request, path):
+            return path.read_text()
+        """,
+        path="langstream_tpu/gateway/server.py",
+    )
+    assert ids == ["ASYNC202"]
+
+
+def test_async202_tn_outside_serving_packages():
+    ids = rule_ids(
+        """
+        async def handler(request, path):
+            return path.read_text()
+        """,
+        path="langstream_tpu/agents/pdftext.py",
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# ASYNC203 — coroutine never awaited
+# --------------------------------------------------------------------------
+
+
+def test_async203_tp_bare_self_coroutine_call():
+    ids = rule_ids(
+        """
+        class Gateway:
+            async def flush(self):
+                pass
+
+            async def close(self):
+                self.flush()
+        """
+    )
+    assert ids == ["ASYNC203"]
+
+
+def test_async203_tn_awaited_and_other_class():
+    ids = rule_ids(
+        """
+        class Gateway:
+            async def flush(self):
+                pass
+
+            async def close(self):
+                await self.flush()
+
+        class Buffer:
+            def flush(self):
+                pass
+
+            def close(self):
+                self.flush()  # sync method of a different class
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# ASYNC204 — dropped task handle
+# --------------------------------------------------------------------------
+
+
+def test_async204_tp_bare_create_task():
+    ids = rule_ids(
+        """
+        import asyncio
+
+        async def main(work):
+            asyncio.create_task(work())
+        """
+    )
+    assert ids == ["ASYNC204"]
+
+
+def test_async204_tn_handle_kept():
+    ids = rule_ids(
+        """
+        import asyncio
+
+        async def main(work, tasks):
+            task = asyncio.create_task(work())
+            tasks.add(task)
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# ASYNC205 — unlocked global write in an async handler
+# --------------------------------------------------------------------------
+
+
+def test_async205_tp_unlocked_global_increment():
+    ids = rule_ids(
+        """
+        COUNT = 0
+
+        async def handler(request):
+            global COUNT
+            COUNT += 1
+        """
+    )
+    assert ids == ["ASYNC205"]
+
+
+def test_async205_tn_lock_guarded():
+    ids = rule_ids(
+        """
+        COUNT = 0
+
+        async def handler(request, state_lock):
+            global COUNT
+            async with state_lock:
+                COUNT += 1
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# SEC301 — credential interpolated into a log line
+# --------------------------------------------------------------------------
+
+
+def test_sec301_tp_fstring_password_in_kafka_wire():
+    ids = rule_ids(
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def authenticate(sasl_password):
+            log.info(f"authenticating with {sasl_password}")
+        """,
+        path="langstream_tpu/runtime/kafka_wire.py",
+    )
+    assert ids == ["SEC301"]
+
+
+def test_sec301_tp_percent_style_token_in_auth():
+    ids = rule_ids(
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def verify(token):
+            log.warning("bad token %s", token)
+        """,
+        path="langstream_tpu/auth/jwt.py",
+    )
+    assert ids == ["SEC301"]
+
+
+def test_sec301_tn_benign_names_calls_and_paths():
+    ids = rule_ids(
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def authenticate(sasl_password, token_count):
+            log.info("auth ok, %d tokens", token_count)       # benign name
+            log.info("password digest %s", hash(sasl_password))  # call: fine
+        """,
+        path="langstream_tpu/runtime/kafka_wire.py",
+    )
+    assert ids == []
+    # same leak outside the credential-handling packages: token = LLM token
+    ids = rule_ids(
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def emit(token):
+            log.debug("decoded %s", token)
+        """,
+        path="langstream_tpu/serving/sampler.py",
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# EXC401 / EXC402 — exception swallowing
+# --------------------------------------------------------------------------
+
+
+def test_exc401_tp_bare_except():
+    ids = rule_ids(
+        """
+        def poll(source):
+            try:
+                return source.read()
+            except:
+                return None
+        """
+    )
+    assert ids == ["EXC401"]
+
+
+def test_exc401_tn_bare_except_reraise():
+    ids = rule_ids(
+        """
+        def poll(source, cleanup):
+            try:
+                return source.read()
+            except:
+                cleanup()
+                raise
+        """
+    )
+    assert ids == []
+
+
+def test_exc402_tp_except_exception_pass():
+    ids = rule_ids(
+        """
+        def poll(source):
+            while True:
+                try:
+                    source.read()
+                except Exception:
+                    pass
+        """
+    )
+    assert ids == ["EXC402"]
+
+
+def test_exc402_tn_logged_and_narrow():
+    ids = rule_ids(
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def poll(source):
+            while True:
+                try:
+                    source.read()
+                except Exception as e:
+                    log.debug("poll failed: %s", e)
+                try:
+                    source.commit()
+                except TimeoutError:
+                    pass  # narrow best-effort catch is allowed
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# suppressions + GC000
+# --------------------------------------------------------------------------
+
+
+def test_inline_suppression_with_reason_silences_finding():
+    ids = rule_ids(
+        """
+        def poll(source):
+            try:
+                source.read()
+            except Exception:  # graftcheck: disable=EXC402 probe is best-effort
+                pass
+        """
+    )
+    assert ids == []
+
+
+def test_suppression_on_line_above_applies():
+    ids = rule_ids(
+        """
+        def poll(source):
+            try:
+                source.read()
+            # graftcheck: disable=EXC402 probe is best-effort
+            except Exception:
+                pass
+        """
+    )
+    assert ids == []
+
+
+def test_suppression_without_reason_is_gc000():
+    # a reasonless suppression is itself a finding AND does not suppress:
+    # the original violation stays visible
+    ids = rule_ids(
+        """
+        def poll(source):
+            try:
+                source.read()
+            except Exception:  # graftcheck: disable=EXC402
+                pass
+        """
+    )
+    assert ids == ["EXC402", "GC000"]
+
+
+def test_suppression_for_other_rule_does_not_apply():
+    ids = rule_ids(
+        """
+        def poll(source):
+            try:
+                source.read()
+            except Exception:  # graftcheck: disable=SEC301 wrong rule entirely
+                pass
+        """
+    )
+    assert ids == ["EXC402"]
+
+
+def test_suppression_text_inside_string_is_inert():
+    ids = rule_ids(
+        '''
+        DOC = """quote the syntax: # graftcheck: disable=EXC402 reason"""
+
+        def poll(source):
+            try:
+                source.read()
+            except Exception:
+                pass
+        '''
+    )
+    assert ids == ["EXC402"]
+
+
+# --------------------------------------------------------------------------
+# baseline mechanics
+# --------------------------------------------------------------------------
+
+
+def test_baseline_matches_by_symbol_and_goes_stale(tmp_path):
+    src = textwrap.dedent(
+        """
+        def poll(source):
+            try:
+                source.read()
+            except Exception:
+                pass
+        """
+    )
+    bad = tmp_path / "legacy.py"
+    bad.write_text(src)
+    entry = BaselineEntry(
+        rule="EXC402", path="legacy.py", symbol="poll", reason="test entry"
+    )
+    report = run(ALL_RULES, files=[bad], baseline=[entry], repo_root=tmp_path)
+    assert report.ok
+    assert [f.rule for f in report.baselined] == ["EXC402"]
+
+    # the symbol disappears -> the entry is stale and the gate goes red
+    bad.write_text("def poll(source):\n    return source.read()\n")
+    report = run(ALL_RULES, files=[bad], baseline=[entry], repo_root=tmp_path)
+    assert not report.ok
+    assert report.stale_baseline == [entry]
+
+
+def test_checked_in_baseline_is_small_and_justified():
+    entries = load_baseline()
+    assert len(entries) <= 10, "baseline must stay near-empty (<= 10 entries)"
+    for entry in entries:
+        assert entry.reason.strip(), f"baseline entry {entry.key()} needs a reason"
+    # well-formed JSON list of objects with the exact expected keys
+    raw = json.loads(BASELINE_PATH.read_text())
+    assert isinstance(raw, list)
+
+
+# --------------------------------------------------------------------------
+# the tier-1 gate
+# --------------------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    """The gate: the whole ``langstream_tpu/`` tree has no non-baselined
+    violation, no stale baseline entry, and no unparseable file."""
+    report = run(ALL_RULES)
+    problems = [f.format() for f in report.new]
+    problems += [
+        f"STALE BASELINE {e.rule} {e.path} [{e.symbol}]"
+        for e in report.stale_baseline
+    ]
+    problems += [f"PARSE ERROR {p}" for p in report.parse_errors]
+    assert not problems, (
+        "graftcheck violations (fix them, suppress inline with a reason, "
+        "or baseline with a justification):\n" + "\n".join(problems)
+    )
+
+
+def test_cli_whole_tree_exit_zero(capsys):
+    from langstream_tpu.analysis.__main__ import main
+
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "0 violation(s)" in out
+
+
+def test_cli_list_rules(capsys):
+    from langstream_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule.id in out
+
+
+def test_cli_flags_violations_in_explicit_path(tmp_path, capsys):
+    from langstream_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\nasync def handler():\n    time.sleep(1)\n"
+    )
+    assert main([str(bad)]) == 1
+    assert "ASYNC201" in capsys.readouterr().out
+
+
+def test_cli_subset_scan_ignores_stale_baseline(tmp_path, capsys, monkeypatch):
+    """--changed/explicit-path scans see only a file subset: baseline
+    entries for unscanned files must not read as stale or fail the run."""
+    import langstream_tpu.analysis.__main__ as cli
+    from langstream_tpu.analysis.core import BaselineEntry
+
+    monkeypatch.setattr(cli, "load_baseline", lambda: [
+        BaselineEntry(
+            rule="ASYNC201", path="langstream_tpu/somewhere.py",
+            symbol="handler", reason="legacy",
+        )
+    ])
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert cli.main([str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "STALE" not in out
+    assert "0 stale" in out
+
+
+def test_every_rule_has_unique_id_and_family():
+    ids = [r.id for r in ALL_RULES]
+    assert len(ids) == len(set(ids))
+    assert set(RULES_BY_ID) == set(ids)
+    families = {r.family for r in ALL_RULES}
+    # the five families the analyzer ships
+    assert {
+        "jax", "async-blocking", "concurrency", "secret-leak",
+        "exception-swallowing",
+    } <= families

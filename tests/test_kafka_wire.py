@@ -49,6 +49,101 @@ def test_varint_known_encodings():
     assert Writer().varint(150).done() == b"\xac\x02"
 
 
+# -- snappy (pure-Python raw-block decoder + xerial framing) ---------------
+#
+# fixtures are hand-assembled from the format spec, NOT produced by a
+# compressor: [varint uncompressed-length][literal/copy elements]
+
+
+def _raw_literal(payload: bytes) -> bytes:
+    """One raw snappy block that stores ``payload`` as a single literal."""
+    assert len(payload) < 61
+    preamble = bytes([len(payload)])  # varint, single byte for < 128
+    tag = bytes([(len(payload) - 1) << 2])  # kind 0, length-1 in tag
+    return preamble + tag + payload
+
+
+def test_snappy_raw_literal_block():
+    from langstream_tpu.runtime.kafka_wire import _snappy_decompress_raw
+
+    assert _snappy_decompress_raw(_raw_literal(b"langstream")) == b"langstream"
+
+
+def test_snappy_copy_elements_and_overlap():
+    from langstream_tpu.runtime.kafka_wire import _snappy_decompress_raw
+
+    # "abcd" literal + kind-1 copy (offset 4, len 8): overlapping copy
+    # repeats the 4-byte pattern → "abcd" * 3
+    block = bytes(
+        [12]            # preamble: 12 uncompressed bytes
+        + [(4 - 1) << 2]  # literal, len 4
+    ) + b"abcd" + bytes(
+        [((8 - 4) << 2) | (0 << 5) | 1, 4]  # copy1: len 8, offset 4
+    )
+    assert _snappy_decompress_raw(block) == b"abcd" * 3
+
+    # kind-2 copy with a 2-byte little-endian offset
+    block = bytes([8, (4 - 1) << 2]) + b"wxyz" + bytes(
+        [((4 - 1) << 2) | 2]
+    ) + (4).to_bytes(2, "little")
+    assert _snappy_decompress_raw(block) == b"wxyzwxyz"
+
+
+def test_snappy_long_literal_uses_extra_length_byte():
+    from langstream_tpu.runtime.kafka_wire import _snappy_decompress_raw
+
+    payload = bytes(range(256)) * 2  # 512 bytes: needs the 2-byte form
+    preamble = bytes([0x80 | (512 & 0x7F), 512 >> 7])  # varint 512
+    tag = bytes([61 << 2]) + (len(payload) - 1).to_bytes(2, "little")
+    assert _snappy_decompress_raw(preamble + tag + payload) == payload
+
+
+def test_snappy_corrupt_blocks_raise():
+    from langstream_tpu.runtime.kafka_wire import _snappy_decompress_raw
+
+    with pytest.raises(KafkaProtocolError, match="truncated snappy"):
+        _snappy_decompress_raw(_raw_literal(b"short")[:-1])
+    with pytest.raises(KafkaProtocolError, match="length mismatch"):
+        # preamble claims 10 uncompressed bytes, block only yields 5
+        _snappy_decompress_raw(bytes([10]) + _raw_literal(b"short")[1:])
+    with pytest.raises(KafkaProtocolError, match="copy offset"):
+        # copy back 200 bytes when only 4 exist
+        bad = bytes([8, (4 - 1) << 2]) + b"abcd" + bytes(
+            [((4 - 1) << 2) | 2]
+        ) + (200).to_bytes(2, "little")
+        _snappy_decompress_raw(bad)
+    with pytest.raises(KafkaProtocolError, match="truncated snappy copy"):
+        # block ends right after a kind-1 copy tag, before its offset byte
+        _snappy_decompress_raw(
+            bytes([8, (4 - 1) << 2]) + b"abcd"
+            + bytes([((8 - 4) << 2) | 1])
+        )
+    with pytest.raises(KafkaProtocolError, match="truncated snappy copy"):
+        # kind-2 copy with only one of its two offset bytes present
+        _snappy_decompress_raw(
+            bytes([8, (4 - 1) << 2]) + b"abcd"
+            + bytes([((4 - 1) << 2) | 2, 4])
+        )
+
+
+def test_snappy_xerial_framed_fetch_decompression():
+    """decompress_records(codec=2) on a hand-built xerial stream: magic +
+    version/compat ints, then length-prefixed raw blocks — the shape java
+    producers actually put on the wire."""
+    from langstream_tpu.runtime.kafka_wire import (
+        XERIAL_MAGIC,
+        decompress_records,
+    )
+
+    blocks = [_raw_literal(b"hello "), _raw_literal(b"kafka")]
+    framed = XERIAL_MAGIC + (1).to_bytes(4, "big") + (1).to_bytes(4, "big")
+    for b in blocks:
+        framed += len(b).to_bytes(4, "big") + b
+    assert decompress_records(2, framed) == b"hello kafka"
+    # bare (unframed) raw block also accepted
+    assert decompress_records(2, _raw_literal(b"bare")) == b"bare"
+
+
 def test_record_batch_roundtrip_and_crc():
     records = [
         (b"k1", b"v1", [("h", b"x"), ("n", None)]),

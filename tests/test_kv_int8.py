@@ -187,13 +187,18 @@ def test_engine_rejects_unsupported_kv_quantize_combos():
                 dense_kernel="pallas-interpret",
             )
         )
-    with pytest.raises(ValueError, match="paged_kernel=xla"):
-        TpuServingEngine(
-            ServingConfig(
-                model="tiny", max_seq_len=128, kv_layout="paged",
-                kv_quantize="int8", paged_kernel="pallas-interpret",
-            )
+    # kv-quantize=int8 + a forced Pallas paged kernel is a SUPPORTED combo
+    # since the in-kernel dequant twin (ops/paged_attention.
+    # _paged_kernel_q8) landed: construction honours the forced kernel
+    # instead of rejecting it (auto still defaults int8 pools to the fused
+    # XLA gather, which chip-measures faster at the headline shape)
+    eng = TpuServingEngine(
+        ServingConfig(
+            model="tiny", max_seq_len=128, kv_layout="paged",
+            kv_quantize="int8", paged_kernel="pallas-interpret",
         )
+    )
+    assert eng.paged_read_kernel == "pallas-interpret"
 
 
 def test_paged_write_gather_roundtrip_int8():

@@ -160,6 +160,72 @@ def test_kubelet_statefulset_pods_env_volumes_and_scale(kube, tmp_path):
     kubelet.stop()
 
 
+def test_logs_endpoint_surfaces_pod_log_files(kube, tmp_path, run_async):
+    """k8s-mode /logs appends each pod's pod.log tail (the files the
+    kubelet writes) after the framework lines — and only this app's pods."""
+    import aiohttp
+
+    from langstream_tpu.controlplane.server import ControlPlaneServer
+    from langstream_tpu.controlplane.stores import InMemoryApplicationStore
+    from langstream_tpu.k8s.compute import KubernetesComputeRuntime
+
+    pods_root = tmp_path / "kubelet"
+    pod_dir = pods_root / "pods" / "langstream-t1" / "chat-app-step1-0"
+    pod_dir.mkdir(parents=True)
+    (pod_dir / "pod.log").write_text("agent booted\ndecode step 1 ok\n")
+    # a second app whose pod dir sits in the same namespace — including a
+    # dash-prefix collision ("chat-app" vs "chat-app-2") that defeats
+    # name-prefix matching; pod ownership must come from the
+    # langstream-application label instead
+    other = pods_root / "pods" / "langstream-t1" / "chat-app-2-step1-0"
+    other.mkdir(parents=True)
+    (other / "pod.log").write_text("other app line\n")
+    kube.apply({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "langstream-t1"}})
+    for app, sts_name in (
+        ("chat-app", "chat-app-step1"),
+        ("chat-app-2", "chat-app-2-step1"),
+    ):
+        kube.apply({
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": sts_name,
+                "namespace": "langstream-t1",
+                "labels": {"langstream-application": app},
+            },
+            "spec": {"replicas": 1, "template": {"spec": {"containers": []}}},
+        })
+
+    compute = KubernetesComputeRuntime(kube, pods_root=pods_root)
+    compute.append_log("t1", "chat-app", "wrote 1 agent CRs")
+    store = InMemoryApplicationStore()
+    store.put_tenant("t1")
+
+    async def main():
+        control = ControlPlaneServer(
+            store=store, compute=compute, port=18347
+        )
+        await control.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                url = (
+                    "http://127.0.0.1:18347"
+                    "/api/applications/t1/chat-app/logs"
+                )
+                async with session.get(url) as r:
+                    assert r.status == 200
+                    return await r.text()
+        finally:
+            await control.stop()
+
+    body = run_async(main())
+    assert "wrote 1 agent CRs" in body
+    assert "---- pod chat-app-step1-0 (pod.log) ----" in body
+    assert "decode step 1 ok" in body
+    assert "other app line" not in body  # chat-app-2's pod stays isolated
+
+
 # ---------------------------------------------------------------------------
 # full mini-cluster smoke (slow: real subprocesses + engine compile)
 # ---------------------------------------------------------------------------

@@ -87,6 +87,8 @@ def test_prefill_padding_invariance():
 
 def test_tp_sharded_decode_matches_single_device():
     """The TP-sharded model must produce the same logits as unsharded."""
+    import dataclasses
+
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from langstream_tpu.models.llama import (
@@ -100,7 +102,11 @@ def test_tp_sharded_decode_matches_single_device():
     )
     from langstream_tpu.parallel.mesh import make_mesh
 
-    c = LlamaConfig.tiny(max_seq_len=32)
+    # f32: the sharded/unsharded comparison is about layout, not rounding —
+    # bf16 leaves it hostage to backend-dependent fusion differences
+    c = dataclasses.replace(
+        LlamaConfig.tiny(max_seq_len=32), dtype=jnp.float32
+    )
     params = init_llama_params(c, jax.random.PRNGKey(3))
     tokens = jnp.array([[5, 9, 17, 3]], dtype=jnp.int32)
 

@@ -167,6 +167,8 @@ async def run_gateway_bench(
                             "e2e": time.monotonic() - t0,
                         }
 
+        from langstream_tpu.serving.engine import TpuServingEngine
+
         # warmup compiles prefill + decode variants: sequential requests
         # cover the light-load regime (and the engine's own warmup-on-start
         # wave, when configured), then a concurrent wave drives the active
@@ -180,6 +182,13 @@ async def run_gateway_bench(
             await asyncio.gather(
                 *(one_request(20_000 + i) for i in range(wave))
             )
+
+        # drop warmup requests from the engine-side timing samples so the
+        # TTFT decomposition below covers only the measured window
+        with TpuServingEngine._instances_lock:
+            engines = list(TpuServingEngine._instances.values())
+        for engine in engines:
+            engine.request_timings.clear()
 
         rng = random.Random(seed)
         tasks: list[asyncio.Task] = []
@@ -195,13 +204,38 @@ async def run_gateway_bench(
                 min(len(sorted_values) - 1, int(q * len(sorted_values)))
             ]
 
-        return {
+        out = {
             "gateway_ttft_p50_s": round(pct(ttfts, 0.50), 4),
             "gateway_ttft_p99_s": round(pct(ttfts, 0.99), 4),
             "e2e_p50_s": round(pct(e2es, 0.50), 4),
             "arrival_rate_hz": arrival_rate_hz,
             "requests": requests,
         }
+        # TTFT decomposition from the engine's per-request timestamps:
+        # queue-wait (enqueue → slot admission), prefill (admission → first
+        # token), first-chunk (everything after the engine emitted the
+        # first token: stream adapter, broker hop, gateway push — the
+        # client-measured p50 minus the engine-measured p50). A p50 16x
+        # over target now names its component instead of one opaque number.
+        # Re-snapshot _instances: with warmup=0 the engine is only lazily
+        # created during the measured window, after the snapshot above.
+        with TpuServingEngine._instances_lock:
+            engines = list(TpuServingEngine._instances.values())
+        timings = [t for e in engines for t in list(e.request_timings)]
+        if timings:
+            queue_waits = sorted(t["queue_wait"] for t in timings)
+            prefills = sorted(t["prefill"] for t in timings)
+            engine_ttfts = sorted(t["ttft"] for t in timings)
+            out.update({
+                "queue_wait_p50_s": round(pct(queue_waits, 0.50), 4),
+                "queue_wait_p99_s": round(pct(queue_waits, 0.99), 4),
+                "prefill_p50_s": round(pct(prefills, 0.50), 4),
+                "engine_ttft_p50_s": round(pct(engine_ttfts, 0.50), 4),
+                "first_chunk_p50_s": round(
+                    max(0.0, pct(ttfts, 0.50) - pct(engine_ttfts, 0.50)), 4
+                ),
+            })
+        return out
     finally:
         await session.close()
         await gateway.stop()

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""graftcheck launcher — static analysis for the langstream-tpu tree.
+
+Thin wrapper so the analyzer runs from a checkout without installing the
+package: ``python tools/graftcheck.py [--changed|paths...]``. All logic
+lives in ``langstream_tpu/analysis`` (see ``docs/ANALYSIS.md``).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from langstream_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
