@@ -1,6 +1,6 @@
 """graftcheck: first-party static analysis for the langstream-tpu tree.
 
-Five rule families tuned to this codebase's actual failure modes:
+Six rule families tuned to this codebase's actual failure modes:
 
 ==========  ==============================================================
 JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
@@ -11,6 +11,8 @@ ASYNC203-5  concurrency hygiene: unawaited coroutines, dropped task
             handles, unlocked global writes in handlers
 SEC301      secret-leak: credentials interpolated into log lines
 EXC401/402  exception swallowing: bare/broad excepts that discard errors
+OBS501      observability: wall-clock ``time.time()`` in the
+            latency-measured packages (``serving/``, ``runtime/``)
 ==========  ==============================================================
 
 Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),
@@ -36,6 +38,7 @@ from langstream_tpu.analysis.core import (
 from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
 from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
+from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
 from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
 
 ALL_RULES: list[Rule] = [
@@ -43,6 +46,7 @@ ALL_RULES: list[Rule] = [
     *_ASYNC_RULES,
     *_SEC_RULES,
     *_EXC_RULES,
+    *_OBS_RULES,
 ]
 
 RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,67 @@
+"""Observability rules: clock discipline in the measured packages.
+
+OBS501 flags ``time.time()`` inside ``serving/`` and ``runtime/`` — the
+packages whose timings feed spans, ``request_timings``, and the latency
+histograms. Wall clock is not monotonic (NTP slews and steps it), so a
+duration computed from it can be negative or wildly wrong exactly when an
+operator is debugging a latency incident. Durations and deadlines there
+must use ``time.monotonic()``; code that genuinely needs a wall-clock
+*timestamp* (record ``timestamp`` fields, display anchoring) suppresses
+with a reason, which is the audit trail that the use really is a
+timestamp and never enters a subtraction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule, call_name
+
+#: package prefixes where every timing is latency-bearing
+_MEASURED_PATHS = (
+    "langstream_tpu/serving/",
+    "langstream_tpu/runtime/",
+)
+
+
+def _imports_bare_time_fn(mod: Module) -> bool:
+    """True when the module does ``from time import time`` (so a bare
+    ``time()`` call is the wall clock)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time" and (alias.asname or "time") == "time":
+                    return True
+    return False
+
+
+def check_wall_clock_in_measured_paths(mod: Module) -> Iterator[Finding]:
+    if not any(p in mod.path for p in _MEASURED_PATHS):
+        return
+    bare_time = _imports_bare_time_fn(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name == "time.time" or (bare_time and name == "time"):
+            yield mod.finding(
+                "OBS501",
+                node,
+                "time.time() in a latency-measured package: wall clock is "
+                "not monotonic, so durations built on it break under NTP "
+                "adjustment — use time.monotonic() for spans/timings, or "
+                "suppress with a reason if this really is a wall-clock "
+                "timestamp",
+            )
+
+
+RULES = [
+    Rule(
+        id="OBS501",
+        family="obs",
+        summary="wall-clock time.time() inside serving/ or runtime/ "
+        "(use time.monotonic() for durations)",
+        check=check_wall_clock_in_measured_paths,
+    ),
+]

@@ -53,7 +53,7 @@ class RecordSink(Protocol):
 
 
 class MetricsReporter:
-    """Minimal metrics SPI (counter/gauge), label-scoped per agent.
+    """Minimal metrics SPI (counter/gauge/histogram), label-scoped per agent.
 
     Parity: ``MetricsReporter`` SPI (``api/runner/code/MetricsReporter.java``)
     with the Prometheus implementation provided by the runtime layer.
@@ -73,6 +73,19 @@ class MetricsReporter:
             pass
 
         return _set
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: tuple[float, ...] | None = None,
+    ) -> Callable[[float], None]:
+        """Observe a distribution (latencies). Returns ``observe(value)``."""
+
+        def _observe(v: float) -> None:
+            pass
+
+        return _observe
 
 
 class TopicProducerHandle(Protocol):
@@ -189,8 +202,25 @@ class SingleRecordProcessor(AgentProcessor):
         raise NotImplementedError
 
     def process(self, records: list[Record], sink: RecordSink) -> None:
+        from langstream_tpu.core.tracing import (
+            TRACE_HEADER,
+            TraceContext,
+            reset_current,
+            set_current,
+        )
+
         for record in records:
-            task = asyncio.ensure_future(self._process_one(record))
+            # bind the record's trace context for the per-record task: the
+            # task snapshots contextvars at creation, so deep callees (the
+            # serving engine) parent their spans under this record's hop
+            # without any signature plumbing
+            ctx = TraceContext.parse(record.header(TRACE_HEADER))
+            token = set_current(ctx) if ctx is not None else None
+            try:
+                task = asyncio.ensure_future(self._process_one(record))
+            finally:
+                if token is not None:
+                    reset_current(token)
             task.add_done_callback(lambda t, r=record, s=sink: _deliver(t, r, s))
 
     async def _process_one(self, record: Record) -> list[Record]:

@@ -2,8 +2,14 @@
 
 Parity: ``MetricsReporter`` SPI + ``PrometheusMetricsReporter``
 (``langstream-runtime-impl/.../agent/metrics/PrometheusMetricsReporter.java:23``)
-— counters/gauges labeled by agent, exposed over the runtime's HTTP
-``/metrics`` endpoint.
+— counters/gauges/histograms labeled by agent, exposed over the runtime's
+HTTP ``/metrics`` endpoint.
+
+When ``prometheus_client`` is absent (minimal images), a tiny in-tree
+registry records the same series and :func:`render_metrics` renders them in
+the text exposition format — the endpoint always answers a well-formed
+``text/plain; version=0.0.4`` body, so scraper probes don't read an empty
+response as a dead target.
 """
 
 from __future__ import annotations
@@ -14,7 +20,13 @@ from typing import Callable
 from langstream_tpu.api.agent import MetricsReporter
 
 try:
-    from prometheus_client import Counter, Gauge, REGISTRY, generate_latest
+    from prometheus_client import (
+        Counter,
+        Gauge,
+        Histogram,
+        REGISTRY,
+        generate_latest,
+    )
 
     _HAVE_PROM = True
 except ImportError:  # pragma: no cover - prometheus_client is in the image
@@ -23,6 +35,124 @@ except ImportError:  # pragma: no cover - prometheus_client is in the image
 _metric_lock = threading.Lock()
 _counters: dict[str, "Counter"] = {}
 _gauges: dict[str, "Gauge"] = {}
+_histograms: dict[str, "Histogram"] = {}
+
+#: seconds-scale latency buckets (sub-ms broker hops up to multi-second
+#: saturated-queue waits — the range the serving TTFT decomposition spans)
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+# ---------------------------------------------------------------------------
+# stdlib fallback registry (prometheus_client absent)
+# ---------------------------------------------------------------------------
+
+
+class _FallbackMetric:
+    """One metric family: name → {label value → state}."""
+
+    def __init__(self, kind: str, help: str, buckets: tuple[float, ...] = ()):
+        self.kind = kind
+        self.help = help
+        self.buckets = buckets
+        self.series: dict[str, object] = {}
+
+
+_fallback: dict[str, _FallbackMetric] = {}
+
+
+def _fallback_counter(full: str, help: str, label: str) -> Callable[[int], None]:
+    with _metric_lock:
+        metric = _fallback.setdefault(full, _FallbackMetric("counter", help))
+        metric.series.setdefault(label, 0.0)
+
+    def _inc(n: int = 1) -> None:
+        with _metric_lock:
+            metric.series[label] += n  # type: ignore[operator]
+
+    return _inc
+
+
+def _fallback_gauge(full: str, help: str, label: str) -> Callable[[float], None]:
+    with _metric_lock:
+        metric = _fallback.setdefault(full, _FallbackMetric("gauge", help))
+        metric.series.setdefault(label, 0.0)
+
+    def _set(v: float) -> None:
+        with _metric_lock:
+            metric.series[label] = float(v)
+
+    return _set
+
+
+def _fallback_histogram(
+    full: str, help: str, label: str, buckets: tuple[float, ...]
+) -> Callable[[float], None]:
+    with _metric_lock:
+        metric = _fallback.setdefault(
+            full, _FallbackMetric("histogram", help, buckets)
+        )
+        # the family's buckets win (same as the prometheus_client path,
+        # which keeps the first registration): sizing a series from a
+        # caller's differing tuple would desync observe()'s iteration
+        metric.series.setdefault(
+            label,
+            {"count": 0, "sum": 0.0, "buckets": [0] * len(metric.buckets)},
+        )
+
+    def _observe(v: float) -> None:
+        with _metric_lock:
+            state: dict = metric.series[label]  # type: ignore[assignment]
+            state["count"] += 1
+            state["sum"] += float(v)
+            # per-bucket (non-cumulative) counts; the renderer cumulates
+            for i, le in enumerate(metric.buckets):
+                if v <= le:
+                    state["buckets"][i] += 1
+                    break
+
+    return _observe
+
+
+def _render_fallback() -> bytes:
+    lines: list[str] = []
+    with _metric_lock:
+        families = {name: m for name, m in _fallback.items()}
+        for name in sorted(families):
+            metric = families[name]
+            lines.append(f"# HELP {name} {metric.help or name}")
+            lines.append(f"# TYPE {name} {metric.kind}")
+            for label, state in metric.series.items():
+                sel = f'{{agent_id="{label}"}}' if label else ""
+                if metric.kind in ("counter", "gauge"):
+                    lines.append(f"{name}{sel} {state}")
+                    continue
+                hist: dict = state  # type: ignore[assignment]
+                cumulative = 0
+                for le, n in zip(metric.buckets, hist["buckets"]):
+                    cumulative += n
+                    bsel = (
+                        f'{{agent_id="{label}",le="{le}"}}'
+                        if label
+                        else f'{{le="{le}"}}'
+                    )
+                    lines.append(f"{name}_bucket{bsel} {cumulative}")
+                isel = (
+                    f'{{agent_id="{label}",le="+Inf"}}'
+                    if label
+                    else '{le="+Inf"}'
+                )
+                lines.append(f"{name}_bucket{isel} {hist['count']}")
+                lines.append(f"{name}_count{sel} {hist['count']}")
+                lines.append(f"{name}_sum{sel} {hist['sum']}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+# ---------------------------------------------------------------------------
+# reporter
+# ---------------------------------------------------------------------------
 
 
 class PrometheusMetricsReporter(MetricsReporter):
@@ -37,9 +167,9 @@ class PrometheusMetricsReporter(MetricsReporter):
         return f"{self.prefix}_{name}".replace("-", "_").replace(".", "_")
 
     def counter(self, name: str, help: str = "") -> Callable[[int], None]:
-        if not _HAVE_PROM:
-            return super().counter(name, help)
         full = self._full(name)
+        if not _HAVE_PROM:
+            return _fallback_counter(full, help, self.agent_id)
         with _metric_lock:
             if full not in _counters:
                 _counters[full] = Counter(full, help or full, ["agent_id"])
@@ -47,17 +177,39 @@ class PrometheusMetricsReporter(MetricsReporter):
         return lambda n=1: c.inc(n)
 
     def gauge(self, name: str, help: str = "") -> Callable[[float], None]:
-        if not _HAVE_PROM:
-            return super().gauge(name, help)
         full = self._full(name)
+        if not _HAVE_PROM:
+            return _fallback_gauge(full, help, self.agent_id)
         with _metric_lock:
             if full not in _gauges:
                 _gauges[full] = Gauge(full, help or full, ["agent_id"])
             g = _gauges[full].labels(agent_id=self.agent_id)
         return lambda v: g.set(v)
 
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: tuple[float, ...] | None = None,
+    ) -> Callable[[float], None]:
+        full = self._full(name)
+        buckets = buckets or LATENCY_BUCKETS
+        if not _HAVE_PROM:
+            return _fallback_histogram(full, help, self.agent_id, buckets)
+        with _metric_lock:
+            if full not in _histograms:
+                _histograms[full] = Histogram(
+                    full, help or full, ["agent_id"], buckets=buckets
+                )
+            h = _histograms[full].labels(agent_id=self.agent_id)
+        return lambda v: h.observe(v)
+
 
 def render_metrics() -> bytes:
+    """Text exposition of every registered series. Always non-empty and
+    well-formed — the pod ``/metrics`` endpoint serves this verbatim with
+    ``text/plain; version=0.0.4`` regardless of which registry backed it."""
     if not _HAVE_PROM:
-        return b""
+        body = _render_fallback()
+        return body if body.strip() else b"# no metrics registered yet\n"
     return generate_latest(REGISTRY)

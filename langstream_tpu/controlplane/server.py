@@ -186,6 +186,35 @@ class LocalComputeRuntime:
         per-pod log files; everything lands in the framework buffer."""
         return {}
 
+    def traces(
+        self, tenant: str, name: str, trace_id: str | None = None
+    ) -> list[dict[str, Any]]:
+        """Trace data for the /traces aggregation route. Dev mode runs every
+        agent (and the gateway) in-process, so the process-global span
+        buffer already IS the aggregate; scope to traces that touched this
+        application by its runners' EXACT agent ids — prefix matching would
+        leak traces across dash-prefixed app ids (``app`` vs ``app-b``),
+        the same bug pod_logs fixed with label selectors."""
+        from langstream_tpu.core.tracing import SPANS
+
+        runner = self.runners.get((tenant, name))
+        agent_ids = (
+            {r.agent_id for r in runner.runners} if runner is not None else set()
+        )
+        if trace_id is not None:
+            # the full trace, cross-service (gateway + agent + engine
+            # spans) — but only once the trace verifiably touched this
+            # app, so one tenant's route can't read another's spans
+            spans = SPANS.spans(trace_id)
+            if any(s.get("service") in agent_ids for s in spans):
+                return spans
+            return []
+        return [
+            summary
+            for summary in SPANS.summaries()
+            if any(svc in agent_ids for svc in summary["services"])
+        ]
+
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         runner = self.runners.get((tenant, name))
         return runner.agent_info() if runner else []
@@ -246,6 +275,11 @@ class ControlPlaneServer:
                 web.get("/api/applications/{tenant}/{name}", self._get_app),
                 web.delete("/api/applications/{tenant}/{name}", self._delete_app),
                 web.get("/api/applications/{tenant}/{name}/logs", self._logs),
+                web.get("/api/applications/{tenant}/{name}/traces", self._traces),
+                web.get(
+                    "/api/applications/{tenant}/{name}/traces/{trace_id}",
+                    self._trace,
+                ),
                 web.get("/api/applications/{tenant}/{name}/code", self._download_code),
                 web.get("/api/applications/{tenant}/{name}/agents", self._agents),
                 # archetypes (parity: ArchetypeResource)
@@ -524,6 +558,31 @@ class ControlPlaneServer:
             lines.append(f"---- pod {pod_name} (pod.log) ----")
             lines.extend(pod_lines)
         return web.Response(text="\n".join(lines))
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        """Per-application trace index, aggregated the way /logs aggregates
+        pod.log (in-process buffer in dev mode; per-pod /traces endpoints
+        under the k8s compute runtime)."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        # k8s-mode aggregation does pod HTTP round-trips — off the loop
+        traces = await asyncio.to_thread(self.compute.traces, tenant, name)
+        return web.json_response(traces)
+
+    async def _trace(self, request: web.Request) -> web.Response:
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        trace_id = request.match_info["trace_id"]
+        spans = await asyncio.to_thread(
+            self.compute.traces, tenant, name, trace_id
+        )
+        if not spans:
+            raise web.HTTPNotFound(reason=f"unknown trace {trace_id!r}")
+        return web.json_response(spans)
 
     async def _agents(self, request: web.Request) -> web.Response:
         return web.json_response(

@@ -33,6 +33,7 @@ from langstream_tpu.api.topics import (
     OFFSET_HEADER,
     TopicConnectionsRuntimeRegistry,
 )
+from langstream_tpu.core.tracing import TRACE_HEADER, start_span
 from langstream_tpu.gateway.auth import (
     AuthenticationException,
     get_auth_provider,
@@ -238,6 +239,22 @@ class GatewayServer:
             "offset": offset,
         }
 
+    @staticmethod
+    def _traced_headers(
+        headers: dict[str, Any], span_name: str
+    ) -> tuple[dict[str, Any], Any]:
+        """Open the gateway-side span for one produced record and stamp its
+        context into the record headers (honoring a client-supplied
+        ``langstream-trace`` traceparent as the parent). Returns
+        ``(headers, span)``; the header value is echoed back to the client
+        so it can fetch ``/traces/<trace_id>`` afterwards."""
+        span = start_span(
+            span_name, service="gateway", parent=headers.get(TRACE_HEADER)
+        )
+        headers = dict(headers)
+        headers[TRACE_HEADER] = span.context().to_header()
+        return headers, span
+
     def _filters_match(
         self, gateway: Gateway, params, principal, record: Record
     ) -> bool:
@@ -296,13 +313,20 @@ class GatewayServer:
                     continue
                 try:
                     payload = json.loads(msg.data)
+                    headers, span = self._traced_headers(
+                        {**(payload.get("headers") or {}), **inject},
+                        "gateway.produce",
+                    )
                     record = make_record(
                         value=payload.get("value"),
                         key=payload.get("key"),
-                        headers={**(payload.get("headers") or {}), **inject},
+                        headers=headers,
                     )
-                    await producer.write(record)
-                    await ws.send_json({"status": "OK"})
+                    with span:
+                        await producer.write(record)
+                    await ws.send_json(
+                        {"status": "OK", "trace": headers[TRACE_HEADER]}
+                    )
                 except Exception as e:
                     await ws.send_json({"status": "BAD_REQUEST", "reason": str(e)})
         finally:
@@ -325,21 +349,28 @@ class GatewayServer:
             raise web.HTTPUnauthorized(reason=str(e))
         payload = await self._json_body(request)
         inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        headers, span = self._traced_headers(
+            {**(payload.get("headers") or {}), **inject}, "gateway.produce"
+        )
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
         producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
         await producer.start()
         try:
-            await producer.write(
-                make_record(
-                    value=payload.get("value"),
-                    key=payload.get("key"),
-                    headers={**(payload.get("headers") or {}), **inject},
+            with span:
+                await producer.write(
+                    make_record(
+                        value=payload.get("value"),
+                        key=payload.get("key"),
+                        headers=headers,
+                    )
                 )
-            )
         finally:
             await producer.close()
             await runtime.close()
-        return web.json_response({"status": "OK"})
+        return web.json_response(
+            {"status": "OK", "trace": headers[TRACE_HEADER]},
+            headers={TRACE_HEADER: headers[TRACE_HEADER]},
+        )
 
     # ------------------------------------------------------------------
     # consume
@@ -433,14 +464,21 @@ class GatewayServer:
                     continue
                 try:
                     payload = json.loads(msg.data)
-                    await producer.write(
-                        make_record(
-                            value=payload.get("value"),
-                            key=payload.get("key"),
-                            headers={**(payload.get("headers") or {}), **inject},
-                        )
+                    headers, span = self._traced_headers(
+                        {**(payload.get("headers") or {}), **inject},
+                        "gateway.chat",
                     )
-                    await ws.send_json({"status": "OK"})
+                    with span:
+                        await producer.write(
+                            make_record(
+                                value=payload.get("value"),
+                                key=payload.get("key"),
+                                headers=headers,
+                            )
+                        )
+                    await ws.send_json(
+                        {"status": "OK", "trace": headers[TRACE_HEADER]}
+                    )
                 except Exception as e:
                     await ws.send_json({"status": "BAD_REQUEST", "reason": str(e)})
         finally:
@@ -569,26 +607,44 @@ class GatewayServer:
         producer = runtime.create_producer("gateway-service", {"topic": input_topic})
         await producer.start()
         inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        headers, span = self._traced_headers(
+            {
+                **(payload.get("headers") or {}),
+                **inject,
+                "langstream-service-request-id": correlation,
+            },
+            "gateway.service",
+        )
         try:
-            await producer.write(
-                make_record(
-                    value=payload.get("value", payload),
-                    key=payload.get("key"),
-                    headers={
-                        **(payload.get("headers") or {}),
-                        **inject,
-                        "langstream-service-request-id": correlation,
-                    },
+            # `with span:` so a broker failure mid-write/read still closes
+            # the span with its error (end() is idempotent — the explicit
+            # ends below keep their timings and error labels)
+            with span:
+                await producer.write(
+                    make_record(
+                        value=payload.get("value", payload),
+                        key=payload.get("key"),
+                        headers=headers,
+                    )
                 )
-            )
-            deadline = asyncio.get_event_loop().time() + float(
-                service.get("timeout-seconds", 30)
-            )
-            while asyncio.get_event_loop().time() < deadline:
-                for record in await reader.read(timeout=0.5):
-                    if record.header("langstream-service-request-id") == correlation:
-                        return web.json_response(self._record_json(record))
-            raise web.HTTPGatewayTimeout(reason="no response on output topic")
+                deadline = asyncio.get_event_loop().time() + float(
+                    service.get("timeout-seconds", 30)
+                )
+                while asyncio.get_event_loop().time() < deadline:
+                    for record in await reader.read(timeout=0.5):
+                        if (
+                            record.header("langstream-service-request-id")
+                            == correlation
+                        ):
+                            span.end()
+                            return web.json_response(
+                                self._record_json(record),
+                                headers={TRACE_HEADER: headers[TRACE_HEADER]},
+                            )
+                span.end(error="timeout")
+                raise web.HTTPGatewayTimeout(
+                    reason="no response on output topic"
+                )
         finally:
             await producer.close()
             await reader.close()

@@ -158,6 +158,97 @@ class KubernetesComputeRuntime:
             out[pod_name] = lines[-tail:]
         return out
 
+    def _pod_addresses(self, tenant: str, name: str) -> dict[str, str]:
+        """Pod name → in-cluster base URL for the runtime's :8080 server,
+        via the STS headless service (``<pod>.<service>.<ns>.svc``)."""
+        from langstream_tpu.k8s.cluster_runtime import tenant_namespace
+        from langstream_tpu.k8s.resources import AGENT_PORT
+
+        namespace = tenant_namespace(tenant)
+        selector = {"langstream-application": name}
+        out: dict[str, str] = {}
+        for sts in self.api.list(
+            "StatefulSet", namespace, label_selector=selector
+        ):
+            sts_name = sts["metadata"]["name"]
+            service = sts["spec"].get("serviceName", sts_name)
+            for i in range(int(sts["spec"].get("replicas", 1))):
+                pod = f"{sts_name}-{i}"
+                out[pod] = (
+                    f"http://{pod}.{service}.{namespace}.svc:{AGENT_PORT}"
+                )
+        return out
+
+    def traces(
+        self, tenant: str, name: str, trace_id: str | None = None
+    ) -> list[dict[str, Any]]:
+        """Aggregate the application pods' ``/traces`` ring buffers (the
+        same fan-in /logs does for pod.log, but over the pods' HTTP
+        endpoints). Best-effort: an unreachable pod contributes nothing —
+        trace retrieval must not 502 because one replica is restarting.
+        Synchronous by design; the /traces handler runs it in a thread.
+        Pods are fetched concurrently — serial 2 s timeouts against a
+        rolling restart would make one request cost replicas x 2 s."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+        from concurrent.futures import ThreadPoolExecutor
+
+        path = f"/traces/{trace_id}" if trace_id else "/traces"
+
+        def _fetch(pod_base: tuple[str, str]) -> list[dict[str, Any]]:
+            pod, base = pod_base
+            try:
+                with urllib.request.urlopen(base + path, timeout=2) as resp:
+                    payload = _json.loads(resp.read())
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                log.debug("pod %s traces unreachable: %s", pod, e)
+                return []
+            return payload if isinstance(payload, list) else []
+
+        pods = sorted(self._pod_addresses(tenant, name).items())
+        merged: list[dict[str, Any]] = []
+        if pods:
+            with ThreadPoolExecutor(max_workers=min(8, len(pods))) as pool:
+                for chunk in pool.map(_fetch, pods):
+                    merged.extend(chunk)
+        if trace_id is None:
+            # index entries are per-pod PARTIAL rollups of the same trace
+            # (each agent pod buffered its own hop): merge them per
+            # trace_id or a client keying by id sees duplicate rows with
+            # conflicting span counts/durations
+            merged = self._merge_summaries(merged)
+        merged.sort(key=lambda s: s.get("start_ms", 0.0))
+        return merged
+
+    @staticmethod
+    def _merge_summaries(
+        partials: list[dict[str, Any]]
+    ) -> list[dict[str, Any]]:
+        by_trace: dict[str, dict[str, Any]] = {}
+        for part in partials:
+            trace_id = part.get("trace_id")
+            agg = by_trace.get(trace_id)
+            if agg is None:
+                by_trace[trace_id] = dict(part)
+                continue
+            start = min(agg["start_ms"], part.get("start_ms", 0.0))
+            end = max(
+                agg["start_ms"] + agg.get("duration_ms", 0.0),
+                part.get("start_ms", 0.0) + part.get("duration_ms", 0.0),
+            )
+            if part.get("start_ms", 0.0) < agg["start_ms"]:
+                # root-most span name comes from the earliest partial
+                agg["root"] = part.get("root")
+            agg["start_ms"] = start
+            agg["duration_ms"] = round(end - start, 3)
+            agg["spans"] = agg.get("spans", 0) + part.get("spans", 0)
+            agg["errors"] = agg.get("errors", 0) + part.get("errors", 0)
+            agg["services"] = sorted(
+                {*agg.get("services", []), *part.get("services", [])}
+            )
+        return list(by_trace.values())
+
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         """Agent CR specs + operator-written statuses."""
         return [

@@ -104,15 +104,33 @@ class CompositeAgentProcessor(AgentProcessor):
             task.add_done_callback(_done)
 
     async def _chain_one(self, record: Record) -> list[Record]:
+        from langstream_tpu.core.tracing import TRACE_HEADER, start_span
+
+        parent = record.header(TRACE_HEADER)
+        service = getattr(
+            getattr(self, "context", None), "global_agent_id", ""
+        ) or "composite"
         current: list[Record] = [record]
         for stage in self.processors:
             if not current:
                 return []
             next_records: list[Record] = []
-            results = await process_await(stage, current)
-            for res in results:
-                if res.error is not None:
-                    raise res.error
-                next_records.extend(res.results)
+            span = start_span(
+                f"stage.{stage.agent_id or stage.agent_type}",
+                service=service,
+                parent=parent,
+                attributes={"stage-type": stage.agent_type},
+            )
+            try:
+                results = await process_await(stage, current)
+                for res in results:
+                    if res.error is not None:
+                        raise res.error
+                    next_records.extend(res.results)
+            except Exception as e:
+                span.end(error=e)
+                raise
+            span.set_attribute("records-out", len(next_records))
+            span.end()
             current = next_records
         return current

@@ -43,6 +43,7 @@ from langstream_tpu.api.topics import (
     TopicProducer,
 )
 from langstream_tpu.core.asyncutil import spawn_retained
+from langstream_tpu.core.tracing import TRACE_HEADER, TraceContext, start_span
 from langstream_tpu.runtime.composite import CompositeAgentProcessor
 from langstream_tpu.runtime.errors_handler import (
     FailureAction,
@@ -190,6 +191,10 @@ class AgentRunner:
         self._inflight = 0
         self._loop_task: asyncio.Task | None = None
         self._service_task: asyncio.Task | None = None
+        # per-record trace spans, opened at read and closed when the record
+        # reaches a terminal state (written / committed / dead-lettered);
+        # keyed by id() like the tracker (record values may be dicts)
+        self._record_spans: dict[int, Any] = {}
 
     # ---- wiring ----------------------------------------------------------
 
@@ -292,6 +297,10 @@ class AgentRunner:
         )
         self._m_errors = metrics.counter("record_errors", "record failures")
         self._m_pending = metrics.gauge("records_pending", "in-flight records")
+        self._m_latency = metrics.histogram(
+            "record_process_seconds",
+            "per-record latency from source read to terminal write/commit",
+        )
         context = AgentContext(
             agent_id=self.node.id,
             global_agent_id=self.agent_id,
@@ -390,11 +399,38 @@ class AgentRunner:
                 self._m_records_in(len(records))
                 self._inflight += len(records)
                 self._m_pending(self._inflight)
+                records = [self._begin_record_trace(r) for r in records]
                 self.processor.process(records, self.record_sink)
                 await asyncio.sleep(0)
         except Exception as e:  # loop-level failure is fatal for the replica
             self._fatal = e
             log.exception("agent %s main loop failed", self.agent_id)
+
+    def _begin_record_trace(self, record: Record) -> Record:
+        """Open the per-record hop span and stamp its context into the
+        record's ``langstream-trace`` header (creating a root trace when the
+        record arrived without one), so composite stages, the serving
+        engine, and every downstream hop parent under this one."""
+        ctx = TraceContext.parse(record.header(TRACE_HEADER))
+        span = start_span(
+            "agent.process",
+            service=self.agent_id,
+            parent=ctx,
+            attributes={"agent": self.node.id, "replica": self.replica},
+        )
+        record = record.with_headers({TRACE_HEADER: span.context().to_header()})
+        self._record_spans[id(record)] = span
+        return record
+
+    def _finish_record_trace(
+        self, record: Record, error: Exception | None = None, **attributes: Any
+    ) -> None:
+        span = self._record_spans.pop(id(record), None)
+        if span is None:
+            return
+        for key, value in attributes.items():
+            span.set_attribute(key, value)
+        self._m_latency(span.end(error=error))
 
     async def _handle_result(self, result: SourceRecordAndResult) -> None:
         if result.error is not None:
@@ -406,8 +442,14 @@ class AgentRunner:
         self.tracker.track(result.source_record, len(result.results))
         if not result.results:
             await self.tracker.commit_if_tracked_empty(result.source_record)
+            self._finish_record_trace(result.source_record, results=0)
             return
+        src_trace = result.source_record.header(TRACE_HEADER)
         for record in result.results:
+            if src_trace is not None and record.header(TRACE_HEADER) is None:
+                # processors that rebuild records from scratch must not
+                # break the trace chain mid-pipeline
+                record = record.with_headers({TRACE_HEADER: src_trace})
             try:
                 await self.sink.write(record)
                 self.records_out += 1
@@ -418,6 +460,9 @@ class AgentRunner:
                 self._inflight += 1  # re-enters error handling below
                 await self._handle_error(result.source_record, e)
                 return
+        self._finish_record_trace(
+            result.source_record, results=len(result.results)
+        )
 
     async def _handle_error(self, source_record: Record, error: Exception) -> None:
         self.errors_total += 1
@@ -425,10 +470,19 @@ class AgentRunner:
         action = self.errors_handler.handle(source_record, error)
         if action == FailureAction.RETRY:
             # single-record retry, documented out-of-order; stays in flight
+            # (and its span stays open — retries are one logical attempt)
+            span = self._record_spans.get(id(source_record))
+            if span is not None:
+                span.set_attribute(
+                    "retries", int(span.attributes.get("retries", 0)) + 1
+                )
             self.processor.process([source_record], self.record_sink)
             return
         self._inflight = max(0, self._inflight - 1)
         self._m_pending(self._inflight)
+        self._finish_record_trace(
+            source_record, error=error, outcome=action.value
+        )
         if action == FailureAction.SKIP:
             await self.tracker.commit_now(source_record)
         elif action == FailureAction.DEAD_LETTER:

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from langstream_tpu.api.metrics import PrometheusMetricsReporter
+from langstream_tpu.core.tracing import current_context, record_span
 from langstream_tpu.models.llama import (
     LlamaConfig,
     init_kv_cache,
@@ -334,6 +335,14 @@ class _Request:
     # is truncated at the match (the match itself excluded, OpenAI-style)
     stop: list = dataclasses.field(default_factory=list)
     stop_matched: bool = False
+    # trace context captured at enqueue (the caller's ambient per-record
+    # context): parents the engine.queue/prefill/decode spans
+    trace: Any = None
+    # warmup probes skip the latency histograms: their TTFT is XLA compile
+    # time and Prometheus histograms are cumulative — one warmup wave would
+    # poison the p99 forever (trace=None alone can't tell warmup apart
+    # from an untraced real request)
+    warmup: bool = False
 
 
 def _normalize_stop(value) -> list[str]:
@@ -485,6 +494,14 @@ class TpuServingEngine:
         )
         self._m_ttft = reporter.gauge(
             "last_ttft_seconds", "time to first token of the last request"
+        )
+        # real distributions, not counter-of-sums: p50/p99 TTFT and queue
+        # wait are what the gateway bench and dashboards quantile over
+        self._m_ttft_hist = reporter.histogram(
+            "ttft_seconds", "engine time-to-first-token (enqueue to token 1)"
+        )
+        self._m_queue_wait_hist = reporter.histogram(
+            "queue_wait_seconds", "enqueue to slot admission"
         )
         self._m_active = reporter.gauge(
             "slots_active", "decode slots currently generating"
@@ -1194,6 +1211,10 @@ class TpuServingEngine:
             future=asyncio.get_running_loop().create_future(),
             loop=asyncio.get_running_loop(),
             enqueue_time=time.monotonic(),
+            # warmup probes must not attach synthetic phase spans to
+            # whichever record's task happened to trigger the warmup gate
+            trace=None if _warmup_probe else current_context(),
+            warmup=_warmup_probe,
             stop=stop,
             presence_penalty=float(options.get("presence-penalty", 0.0)),
             frequency_penalty=float(options.get("frequency-penalty", 0.0)),
@@ -2313,14 +2334,41 @@ class TpuServingEngine:
                 ]
                 if hits:
                     text = text[: min(hits)]
-            first = request.first_token_time or time.monotonic()
+            done_t = time.monotonic()
+            first = request.first_token_time or done_t
             admit = request.admit_time or first
             timing = {
                 "queue_wait": admit - request.enqueue_time,
                 "prefill": first - admit,
                 "ttft": first - request.enqueue_time,
+                # decode phase + its step count: the bench derives achieved
+                # step time from these (EOS can end a request well before
+                # max_tokens, so the client can't know the step count)
+                "decode": done_t - first,
+                "tokens": float(len(request.generated)),
             }
-            self.request_timings.append(timing)
+            if not request.warmup:
+                # warmup probes never enter the latency record: their TTFT
+                # is XLA compile time, which would poison both the
+                # cumulative histograms and the bench's request_timings
+                # decomposition (a warmup_on_start engine created lazily
+                # inside the measured window)
+                self.request_timings.append(timing)
+                self._m_ttft_hist(timing["ttft"])
+                self._m_queue_wait_hist(timing["queue_wait"])
+            if request.trace is not None:
+                # materialize the request's phases as child spans from the
+                # timestamps above — no extra clocks in the decode loop,
+                # and record_span never raises into the serving path
+                svc = f"engine:{self.config.model}"
+                record_span("engine.queue", svc, request.trace,
+                            request.enqueue_time, admit)
+                record_span("engine.prefill", svc, request.trace, admit, first,
+                            attributes={
+                                "prompt-tokens": len(request.prompt_tokens)
+                            })
+                record_span("engine.decode", svc, request.trace, first, done_t,
+                            attributes={"tokens": len(request.generated)})
             if not request.future.done():
                 request.future.set_result(
                     {

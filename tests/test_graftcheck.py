@@ -513,6 +513,82 @@ def test_exc402_tn_logged_and_narrow():
 
 
 # --------------------------------------------------------------------------
+# OBS501 — wall clock in latency-measured packages
+# --------------------------------------------------------------------------
+
+
+def test_obs501_tp_wall_clock_duration_in_serving():
+    ids = rule_ids(
+        """
+        import time
+
+        def measure(step):
+            t0 = time.time()
+            step()
+            return time.time() - t0
+        """
+    )
+    assert ids == ["OBS501", "OBS501"]
+
+
+def test_obs501_tp_bare_time_import_in_runtime():
+    ids = rule_ids(
+        """
+        from time import time
+
+        async def poll(consumer):
+            start = time()
+            return await consumer.read(), start
+        """,
+        path="langstream_tpu/runtime/runner.py",
+    )
+    assert ids == ["OBS501"]
+
+
+def test_obs501_tn_monotonic_in_serving_and_wall_clock_elsewhere():
+    # monotonic in a measured package: clean
+    assert (
+        rule_ids(
+            """
+            import time
+
+            def measure(step):
+                t0 = time.monotonic()
+                step()
+                return time.monotonic() - t0
+            """
+        )
+        == []
+    )
+    # time.time() outside serving/ and runtime/ (record timestamps): clean
+    assert (
+        rule_ids(
+            """
+            import time
+
+            def now_millis():
+                return int(time.time() * 1000)
+            """,
+            path="langstream_tpu/api/record.py",
+        )
+        == []
+    )
+
+
+def test_obs501_suppressed_wall_clock_timestamp():
+    ids = rule_ids(
+        """
+        import time
+
+        def stamp():
+            # graftcheck: disable=OBS501 display anchor, never subtracted
+            return time.time() * 1000
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
 # suppressions + GC000
 # --------------------------------------------------------------------------
 
@@ -702,8 +778,8 @@ def test_every_rule_has_unique_id_and_family():
     assert len(ids) == len(set(ids))
     assert set(RULES_BY_ID) == set(ids)
     families = {r.family for r in ALL_RULES}
-    # the five families the analyzer ships
+    # the six families the analyzer ships
     assert {
         "jax", "async-blocking", "concurrency", "secret-leak",
-        "exception-swallowing",
+        "exception-swallowing", "obs",
     } <= families

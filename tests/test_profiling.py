@@ -1,0 +1,100 @@
+"""ProfilerHooks unit tests: the env-gated auto-capture path, exercised
+with a monkeypatched ``jax.profiler`` so the logic is covered off-TPU
+(on real TPUs it only runs when ``LS_TPU_PROFILE_DIR`` is set)."""
+
+import jax
+import pytest
+
+from langstream_tpu.serving.profiling import ProfilerHooks
+
+
+class _FakeProfiler:
+    def __init__(self, fail_start: bool = False):
+        self.fail_start = fail_start
+        self.starts: list[str] = []
+        self.stops = 0
+
+    def start_trace(self, target: str) -> None:
+        if self.fail_start:
+            raise RuntimeError("profiler session already active")
+        self.starts.append(target)
+
+    def stop_trace(self) -> None:
+        self.stops += 1
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    return fake
+
+
+def make_hooks(monkeypatch, tmp_path, chunks: int) -> ProfilerHooks:
+    monkeypatch.setenv("LS_TPU_PROFILE_DIR", str(tmp_path / "trace"))
+    monkeypatch.setenv("LS_TPU_PROFILE_CHUNKS", str(chunks))
+    return ProfilerHooks()
+
+
+def test_auto_capture_starts_once_counts_down_stops_at_zero(
+    monkeypatch, tmp_path, fake_profiler
+):
+    hooks = make_hooks(monkeypatch, tmp_path, chunks=3)
+    assert hooks._auto_remaining == 3
+
+    hooks.on_decode_chunk()  # starts the capture, consumes chunk 1
+    assert fake_profiler.starts == [str(tmp_path / "trace")]
+    assert hooks._tracing is True
+    assert hooks._auto_remaining == 2
+
+    hooks.on_decode_chunk()  # chunk 2: no second start
+    assert len(fake_profiler.starts) == 1
+    assert fake_profiler.stops == 0
+
+    hooks.on_decode_chunk()  # chunk 3: count reaches zero -> stop
+    assert hooks._auto_remaining == 0
+    assert fake_profiler.stops == 1
+    assert hooks._tracing is False
+
+    hooks.on_decode_chunk()  # fully drained: inert forever after
+    assert len(fake_profiler.starts) == 1
+    assert fake_profiler.stops == 1
+
+
+def test_auto_capture_disabled_without_profile_dir(
+    monkeypatch, fake_profiler
+):
+    monkeypatch.delenv("LS_TPU_PROFILE_DIR", raising=False)
+    hooks = ProfilerHooks()
+    assert hooks._auto_remaining == 0
+    hooks.on_decode_chunk()
+    assert fake_profiler.starts == []
+
+
+def test_start_failure_zeroes_auto_remaining(monkeypatch, tmp_path):
+    """A failed start (another capture already owns the process-global
+    profiler) must not retry on every subsequent chunk."""
+    fake = _FakeProfiler(fail_start=True)
+    monkeypatch.setattr(jax, "profiler", fake)
+    hooks = make_hooks(monkeypatch, tmp_path, chunks=4)
+
+    hooks.on_decode_chunk()
+    assert hooks._tracing is False
+    assert hooks._auto_remaining == 0  # start failure zeroes the budget
+    # and the stop side never fires for a capture that never began
+    hooks.on_decode_chunk()
+    assert fake.stops == 0
+
+
+def test_explicit_start_stop_roundtrip(monkeypatch, tmp_path, fake_profiler):
+    monkeypatch.delenv("LS_TPU_PROFILE_DIR", raising=False)
+    hooks = ProfilerHooks()
+    # no target configured and none passed: nothing starts
+    assert hooks.start_trace() is False
+    target = str(tmp_path / "explicit")
+    assert hooks.start_trace(target) is True
+    assert fake_profiler.starts == [target]
+    assert hooks.start_trace(target) is False  # idempotent while tracing
+    assert hooks.stop_trace() is True
+    assert fake_profiler.stops == 1
+    assert hooks.stop_trace() is False  # idempotent once stopped

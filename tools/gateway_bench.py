@@ -235,6 +235,49 @@ async def run_gateway_bench(
                     max(0.0, pct(ttfts, 0.50) - pct(engine_ttfts, 0.50)), 4
                 ),
             })
+        # decode roofline: the HBM-bandwidth floor for one decode step at
+        # this engine shape (profiling.decode_step_bytes), so a recorded
+        # tok/s number carries its achieved-vs-possible context. Achieved
+        # step time comes from the ENGINE-side decode phase over the
+        # actual per-request step count — EOS can end generation well
+        # before max_tokens, so dividing a client-side window by the token
+        # budget would overstate utilization (even past 1.0).
+        if engines and max_tokens > 1:
+            from langstream_tpu.serving.profiling import decode_step_bytes
+
+            engine = engines[0]
+            cfg = engine.config
+            try:
+                window = (
+                    engine._window_for(cfg.max_seq_len) or cfg.max_seq_len
+                )
+                roofline = decode_step_bytes(
+                    engine.model_config,
+                    slots=cfg.slots,
+                    window=window,
+                    quantize=cfg.quantize,
+                    kv_dtype_bytes=4 if cfg.model_dtype == "float32" else 2,
+                    kv_quantize=cfg.kv_quantize,
+                )
+            except Exception as e:
+                # shapes the roofline model doesn't cover (MoE trees):
+                # the bench result simply omits the roofline keys
+                print(f"roofline unavailable for this model: {e}")
+                roofline = None
+            step_ms = sorted(
+                t["decode"] / (t["tokens"] - 1) * 1000.0
+                for t in timings
+                if t.get("tokens", 0) > 1
+            )
+            if roofline is not None and step_ms:
+                achieved_ms = pct(step_ms, 0.50)
+                out.update({
+                    "roofline_min_step_ms": round(roofline.min_step_ms(), 4),
+                    "achieved_step_ms_p50": round(achieved_ms, 4),
+                    "hbm_utilization": round(
+                        roofline.utilization(achieved_ms), 4
+                    ),
+                })
         return out
     finally:
         await session.close()
@@ -245,6 +288,12 @@ async def run_gateway_bench(
 
 if __name__ == "__main__":
     import os
+    import sys
+    from pathlib import Path
+
+    # runnable from a checkout: `python tools/gateway_bench.py` (the same
+    # bootstrap graftcheck/render_deploy use; bench.py imports us directly)
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
     if os.environ.get("JAX_PLATFORMS"):
         # the environment's TPU plugin overrides JAX_PLATFORMS at interpreter
