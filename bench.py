@@ -337,10 +337,13 @@ def _run_degraded_cpu_pass(budget_s: float) -> dict:
 def _record(headline: dict, detail: dict) -> dict:
     wdtype = "int8-weights" if QUANTIZE == "int8" else "bf16"
     kv_desc = f"{KV_LAYOUT or 'dense'}{' int8' if KV_QUANT == 'int8' else ''} KV"
+    # detected generation from the probe child; v5e only as the unknowable
+    # fallback (the fleet baseline the targets were set against)
+    gen = _PROBE_INFO.get("generation") or "v5e"
     if MODEL in ("llama3-8b", "llama-3-8b"):
-        shape = f"real Llama-3-8B shape single chip, {kv_desc}, v5e"
+        shape = f"real Llama-3-8B shape single chip, {kv_desc}, {gen}"
     else:
-        shape = f"per-chip shard proxy of Llama-3-8B TP8, {kv_desc}, v5e"
+        shape = f"per-chip shard proxy of Llama-3-8B TP8, {kv_desc}, {gen}"
     tok_s = headline.get("tok_s", 0.0)
     return {
         "metric": f"tok/s/chip {MODEL or 'unselected'} {wdtype} decode ({shape})",
@@ -387,6 +390,9 @@ def run_bench() -> dict:
             "backend": _PROBE_INFO.get("backend"),
             # None on platforms that don't expose allocator stats (axon)
             "hbm": _PROBE_INFO.get("hbm"),
+            # detected TPU generation (device_kind fallback when the
+            # TPU_ACCELERATOR_TYPE env var is unset); None off-TPU
+            "generation": _PROBE_INFO.get("generation"),
         }
 
     # ---- headline decode: fallback chain, each attempt a FRESH child ----
@@ -524,6 +530,9 @@ def _child_probe() -> dict:
             result["ok"] = True
             result["backend"] = jax.default_backend()
             result["hbm"] = _mem_snapshot()
+            from langstream_tpu.serving.profiling import detect_generation
+
+            result["generation"] = detect_generation()
         except Exception as e:  # pragma: no cover - device-dependent
             result["error"] = f"{type(e).__name__}: {e}"
 
@@ -647,6 +656,13 @@ async def run_decode_bench(
         kv_quantize=kv_quantize,
     )
     achieved_step_ms = SLOTS / tok_s * 1e3  # all slots advance one token/step
+    # flight-recorder rollup: decomposes the achieved-vs-roofline gap into
+    # device/host/stall instead of leaving it "unattributed host overhead"
+    # (the r05 16 ms/step mystery), and records recompiles/queue depth so
+    # the record can tell a compile convoy from a genuinely slow step
+    from langstream_tpu.serving.flight import bench_rollup
+
+    flight = bench_rollup(engine.flight.summary())
     out = {
         "model": model or MODEL,
         "kv_layout": kv_layout,
@@ -657,11 +673,16 @@ async def run_decode_bench(
         "elapsed_s": round(elapsed, 2),
         "roofline": {
             "hbm_gbps_assumed": roof.hbm_gbps,
+            # detected device identity (null off-TPU / when the plugin
+            # hides memory stats): the roof this run was judged against
+            "generation": roof.generation,
+            "hbm_bytes": roof.hbm_bytes,
             "bytes_per_step": roof.total_bytes_per_step,
             "min_step_ms": round(roof.min_step_ms(), 3),
             "achieved_step_ms": round(achieved_step_ms, 3),
             "hbm_utilization": round(roof.utilization(achieved_step_ms), 3),
         },
+        "flight": flight,
     }
     await engine.close()
     return out

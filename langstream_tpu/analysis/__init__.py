@@ -11,8 +11,10 @@ ASYNC203-5  concurrency hygiene: unawaited coroutines, dropped task
             handles, unlocked global writes in handlers
 SEC301      secret-leak: credentials interpolated into log lines
 EXC401/402  exception swallowing: bare/broad excepts that discard errors
-OBS501      observability: wall-clock ``time.time()`` in the
-            latency-measured packages (``serving/``, ``runtime/``)
+OBS501-503  observability: wall-clock ``time.time()`` in the
+            latency-measured packages (``serving/``, ``runtime/``);
+            threading locks held across ``await`` in ``serving/``;
+            blocking I/O in the engine hot loops / flight recorder
 ==========  ==============================================================
 
 Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),

@@ -1,4 +1,4 @@
-"""Observability rules: clock discipline in the measured packages.
+"""Observability rules: clock and hot-loop discipline in ``serving/``.
 
 OBS501 flags ``time.time()`` inside ``serving/`` and ``runtime/`` — the
 packages whose timings feed spans, ``request_timings``, and the latency
@@ -9,6 +9,19 @@ must use ``time.monotonic()``; code that genuinely needs a wall-clock
 *timestamp* (record ``timestamp`` fields, display anchoring) suppresses
 with a reason, which is the audit trail that the use really is a
 timestamp and never enters a subtraction.
+
+OBS502/OBS503 keep the flight-recorder/metrics paths inside the engine
+hot loops non-blocking — the observability-must-not-perturb contract:
+
+- **OBS502**: a synchronous (``threading``) lock held across an ``await``
+  in ``serving/``. The lock blocks the whole event-loop thread while the
+  awaited dispatch runs, serializing every in-flight request behind it —
+  exactly the host-overhead class the flight recorder exists to expose.
+  ``async with`` on an ``asyncio.Lock`` is loop-native and stays silent.
+- **OBS503**: file/socket/subprocess I/O (or ``print``) inside the engine
+  hot-loop methods or anywhere in ``serving/flight.py``. Telemetry there
+  must be an in-memory append; export belongs off-loop (the pod HTTP
+  endpoint, the JSONL export thread in core/tracing.py).
 """
 
 from __future__ import annotations
@@ -16,7 +29,14 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from langstream_tpu.analysis.core import Finding, Module, Rule, call_name
+from langstream_tpu.analysis.core import (
+    Finding,
+    Module,
+    Rule,
+    call_name,
+    dotted_name,
+)
+from langstream_tpu.analysis.rules_async import _BLOCKING_CALLS
 
 #: package prefixes where every timing is latency-bearing
 _MEASURED_PATHS = (
@@ -56,6 +76,119 @@ def check_wall_clock_in_measured_paths(mod: Module) -> Iterator[Finding]:
             )
 
 
+#: engine methods on the per-burst dispatch path: everything here runs on
+#: the single engine event-loop thread between device dispatches, so one
+#: blocking call stalls every active stream
+_HOT_LOOP_FUNCS = {
+    "_run_loop",
+    "_decode_burst",
+    "_speculative_burst",
+    "_advance_prefills",
+    "_admit",
+    "_process_chunk",
+    "_emit_token",
+    "_flush_emits",
+    "_flight_record",
+    "_flight_stall",
+    "_note_compile",
+    "_admission_stall",
+}
+
+#: the flight-recorder module is hot-path by contract: EVERY function in it
+#: may be called from the engine loop or the dispatch thread
+_RECORDER_MODULE = "langstream_tpu/serving/flight.py"
+
+#: extra blocking calls beyond the async-rule table: stdout can block on a
+#: full pipe, and open() is disk I/O wherever it runs
+_EXTRA_BLOCKING = {"open", "print"}
+
+_FILE_IO_ATTRS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+
+
+def _lockish(expr: ast.AST) -> bool:
+    """True when a with-item context looks like a lock (name or call chain
+    containing 'lock' — the same heuristic ASYNC205's guard check uses)."""
+    if isinstance(expr, ast.Call):
+        text = call_name(expr) or ""
+    else:
+        text = dotted_name(expr) or ""
+    return "lock" in text.lower()
+
+
+def check_lock_across_await(mod: Module) -> Iterator[Finding]:
+    if "langstream_tpu/serving/" not in mod.path:
+        return
+    for node in ast.walk(mod.tree):
+        # sync `with` only: `async with` on an asyncio.Lock yields the loop
+        # while waiting and never blocks the thread
+        if not isinstance(node, ast.With):
+            continue
+        if not any(_lockish(item.context_expr) for item in node.items):
+            continue
+        # awaits inside nested function defs aren't held under THIS with
+        nested: set[int] = set()
+        for inner in ast.walk(node):
+            if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.update(id(n) for n in ast.walk(inner))
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Await) and id(inner) not in nested:
+                yield mod.finding(
+                    "OBS502",
+                    inner,
+                    "threading lock held across await in serving/: the "
+                    "event-loop thread blocks inside the lock while the "
+                    "awaited work runs, serializing every in-flight "
+                    "request — release before awaiting, or use an "
+                    "asyncio.Lock with `async with`",
+                )
+                break
+
+
+def _hot_functions(mod: Module) -> Iterator[ast.AST]:
+    whole_module_hot = mod.path.endswith(_RECORDER_MODULE)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if whole_module_hot or node.name in _HOT_LOOP_FUNCS:
+            yield node
+
+
+def check_blocking_in_hot_loop(mod: Module) -> Iterator[Finding]:
+    if "langstream_tpu/serving/" not in mod.path:
+        return
+    for fn in _hot_functions(mod):
+        # nested defs run elsewhere (the dispatch-thread `_run`/`_dispatch`
+        # closures) — the engine loop never blocks on their bodies directly
+        nested: set[int] = set()
+        for inner in ast.walk(fn):
+            if (
+                isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and inner is not fn
+            ):
+                nested.update(id(n) for n in ast.walk(inner))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in nested:
+                continue
+            name = call_name(node)
+            offender = None
+            if name in _BLOCKING_CALLS or name in _EXTRA_BLOCKING:
+                offender = f"{name}()"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FILE_IO_ATTRS
+            ):
+                offender = f".{node.func.attr}()"
+            if offender is not None:
+                yield mod.finding(
+                    "OBS503",
+                    node,
+                    f"blocking call {offender} on the engine hot path "
+                    f"(`{fn.name}`): flight-recorder/metrics work there "
+                    f"must be an in-memory append — no file/socket/"
+                    f"subprocess I/O, no stdout; export off-loop instead",
+                )
+
+
 RULES = [
     Rule(
         id="OBS501",
@@ -63,5 +196,19 @@ RULES = [
         summary="wall-clock time.time() inside serving/ or runtime/ "
         "(use time.monotonic() for durations)",
         check=check_wall_clock_in_measured_paths,
+    ),
+    Rule(
+        id="OBS502",
+        family="obs",
+        summary="threading lock held across await in serving/ "
+        "(blocks the event loop; use asyncio.Lock or release first)",
+        check=check_lock_across_await,
+    ),
+    Rule(
+        id="OBS503",
+        family="obs",
+        summary="blocking I/O in an engine hot-loop method or the flight "
+        "recorder (telemetry must be non-blocking)",
+        check=check_blocking_in_hot_loop,
     ),
 ]

@@ -215,6 +215,28 @@ class LocalComputeRuntime:
             if any(svc in agent_ids for svc in summary["services"])
         ]
 
+    def flight(self, tenant: str, name: str) -> list[dict[str, Any]]:
+        """Engine flight-recorder data for the /flight aggregation route,
+        scoped to the models the application's serving resources declare —
+        engines are process-global in dev mode, and without the scope one
+        tenant's route would read every other in-process tenant's engine
+        telemetry (the same leak shape the traces route closes with exact
+        agent ids). Two apps declaring the same model genuinely share one
+        engine and both see it. Empty when the app isn't deployed here or
+        declares no TPU serving resource (the mock provider has no
+        engine)."""
+        from langstream_tpu.serving.engine import flight_report
+
+        runner = self.runners.get((tenant, name))
+        if runner is None:
+            return []
+        models = {
+            (res.configuration or {}).get("model", "tiny")
+            for res in runner.application.resources.values()
+            if res.type == "tpu-serving-configuration"
+        }
+        return [e for e in flight_report() if e["model"] in models]
+
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         runner = self.runners.get((tenant, name))
         return runner.agent_info() if runner else []
@@ -279,6 +301,9 @@ class ControlPlaneServer:
                 web.get(
                     "/api/applications/{tenant}/{name}/traces/{trace_id}",
                     self._trace,
+                ),
+                web.get(
+                    "/api/applications/{tenant}/{name}/flight", self._flight
                 ),
                 web.get("/api/applications/{tenant}/{name}/code", self._download_code),
                 web.get("/api/applications/{tenant}/{name}/agents", self._agents),
@@ -570,6 +595,17 @@ class ControlPlaneServer:
         # k8s-mode aggregation does pod HTTP round-trips — off the loop
         traces = await asyncio.to_thread(self.compute.traces, tenant, name)
         return web.json_response(traces)
+
+    async def _flight(self, request: web.Request) -> web.Response:
+        """Per-application engine flight-recorder aggregation (the same
+        fan-in shape /traces uses: in-process engines in dev mode, per-pod
+        /flight endpoints under the k8s compute runtime)."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        report = await asyncio.to_thread(self.compute.flight, tenant, name)
+        return web.json_response(report)
 
     async def _trace(self, request: web.Request) -> web.Response:
         import asyncio

@@ -179,39 +179,46 @@ class KubernetesComputeRuntime:
                 )
         return out
 
-    def traces(
-        self, tenant: str, name: str, trace_id: str | None = None
-    ) -> list[dict[str, Any]]:
-        """Aggregate the application pods' ``/traces`` ring buffers (the
-        same fan-in /logs does for pod.log, but over the pods' HTTP
-        endpoints). Best-effort: an unreachable pod contributes nothing —
-        trace retrieval must not 502 because one replica is restarting.
-        Synchronous by design; the /traces handler runs it in a thread.
-        Pods are fetched concurrently — serial 2 s timeouts against a
-        rolling restart would make one request cost replicas x 2 s."""
+    def _pod_json_fanin(
+        self, tenant: str, name: str, path: str
+    ) -> list[tuple[str, list]]:
+        """(pod, parsed JSON list) for every application pod serving
+        ``path`` on its runtime HTTP port. Best-effort: an unreachable pod
+        contributes an empty list — aggregation must not 502 because one
+        replica is restarting. Synchronous by design (handlers run it in a
+        thread); pods are fetched concurrently — serial 2 s timeouts
+        against a rolling restart would cost replicas x 2 s per request."""
         import json as _json
         import urllib.error
         import urllib.request
         from concurrent.futures import ThreadPoolExecutor
 
-        path = f"/traces/{trace_id}" if trace_id else "/traces"
-
-        def _fetch(pod_base: tuple[str, str]) -> list[dict[str, Any]]:
+        def _fetch(pod_base: tuple[str, str]) -> tuple[str, list]:
             pod, base = pod_base
             try:
                 with urllib.request.urlopen(base + path, timeout=2) as resp:
                     payload = _json.loads(resp.read())
             except (urllib.error.URLError, OSError, ValueError) as e:
-                log.debug("pod %s traces unreachable: %s", pod, e)
-                return []
-            return payload if isinstance(payload, list) else []
+                log.debug("pod %s %s unreachable: %s", pod, path, e)
+                return pod, []
+            return pod, payload if isinstance(payload, list) else []
 
         pods = sorted(self._pod_addresses(tenant, name).items())
+        if not pods:
+            return []
+        with ThreadPoolExecutor(max_workers=min(8, len(pods))) as pool:
+            return list(pool.map(_fetch, pods))
+
+    def traces(
+        self, tenant: str, name: str, trace_id: str | None = None
+    ) -> list[dict[str, Any]]:
+        """Aggregate the application pods' ``/traces`` ring buffers (the
+        same fan-in /logs does for pod.log, but over the pods' HTTP
+        endpoints)."""
+        path = f"/traces/{trace_id}" if trace_id else "/traces"
         merged: list[dict[str, Any]] = []
-        if pods:
-            with ThreadPoolExecutor(max_workers=min(8, len(pods))) as pool:
-                for chunk in pool.map(_fetch, pods):
-                    merged.extend(chunk)
+        for _pod, chunk in self._pod_json_fanin(tenant, name, path):
+            merged.extend(chunk)
         if trace_id is None:
             # index entries are per-pod PARTIAL rollups of the same trace
             # (each agent pod buffered its own hop): merge them per
@@ -248,6 +255,19 @@ class KubernetesComputeRuntime:
                 {*agg.get("services", []), *part.get("services", [])}
             )
         return list(by_trace.values())
+
+    def flight(self, tenant: str, name: str) -> list[dict[str, Any]]:
+        """Fan in the application pods' ``/flight`` reports. Unlike traces
+        (one logical trace spans pods, so partial rollups merge), a flight
+        entry is one engine on one pod — entries concatenate, each tagged
+        with its pod so ``engine_top`` and operators can tell replicas
+        apart."""
+        merged: list[dict[str, Any]] = []
+        for pod, chunk in self._pod_json_fanin(tenant, name, "/flight"):
+            for entry in chunk:
+                if isinstance(entry, dict):
+                    merged.append({"pod": pod, **entry})
+        return merged
 
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         """Agent CR specs + operator-written statuses."""

@@ -397,6 +397,17 @@ class BlockManager:
 
     # -- stats ---------------------------------------------------------
 
+    def used_ratio(self) -> float:
+        """Admission-relevant pool pressure: the RESERVED fraction of the
+        usable pool (block 0 is scratch). Admission gates on worst-case
+        reservations, so a pool can refuse admissions while mostly
+        unallocated — an allocated-fullness gauge would read near empty
+        exactly when ``no-kv-blocks`` stalls fire. Physical allocation
+        (free/live/cached) lives in :meth:`stats`. Cheap enough for the
+        flight recorder to sample per burst."""
+        usable = self.layout.num_blocks - 1
+        return self._reserved / usable if usable > 0 else 0.0
+
     def stats(self) -> dict:
         return {
             "num_blocks": self.layout.num_blocks,

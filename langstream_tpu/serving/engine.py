@@ -66,6 +66,7 @@ from langstream_tpu.models.encoder import (
     init_encoder_params,
 )
 from langstream_tpu.models.tokenizer import Tokenizer, load_tokenizer
+from langstream_tpu.serving.flight import FlightRecorder
 from langstream_tpu.serving.profiling import ProfilerHooks
 from langstream_tpu.serving.sampler import sample_tokens
 
@@ -526,9 +527,68 @@ class TpuServingEngine:
         )
         self.spec_steps = 0
         self.spec_accepted = 0
+        self.spec_rejected = 0
+        # host mirrors of the prefix-cache counters (flight samples carry
+        # them; the metric closures above are write-only)
+        self.prefix_hits = 0
+        self.prefix_tokens = 0
         # adaptive-chunk observability: dispatches per regime
         self._light_chunks = 0
         self._heavy_chunks = 0
+        # flight recorder: one sample per dispatched burst + stall gaps +
+        # discrete events; served by the pod /flight endpoints and the
+        # engine_top console (serving/flight.py)
+        self.flight = FlightRecorder(slots=config.slots)
+        # shapes already compiled (jit-variant keys AND prefill bucket/row
+        # shapes): a miss here is a fresh XLA compile — tens of seconds on
+        # TPU, the event every recompile-storm diagnosis starts from
+        self._compiled_shapes: set = set()
+        self._m_step_hist = {
+            "decode": reporter.histogram(
+                "decode_step_seconds", "wall time per dispatched decode chunk"
+            ),
+            "prefill": reporter.histogram(
+                "prefill_step_seconds", "wall time per dispatched prefill batch"
+            ),
+            "verify": reporter.histogram(
+                "verify_step_seconds", "wall time per speculative verify step"
+            ),
+        }
+        self._m_host_overhead = reporter.histogram(
+            "host_overhead_seconds",
+            "host-side share of each dispatched burst (wall - device wait)",
+        )
+        self._m_kv_used = reporter.gauge(
+            "kv_pool_used_ratio",
+            "paged KV block-pool RESERVED fraction (0-1): the admission "
+            "pressure that produces no-kv-blocks, not physical fullness",
+        )
+        self._m_stall = {
+            reason: reporter.counter(
+                f"admission_stall_{reason.replace('-', '_')}_seconds_total",
+                f"seconds admission could not proceed: {reason} (accrues "
+                f"while the engine is busy decoding too — queue pressure, "
+                f"not engine idleness; the flight rollup's stall_ms is "
+                f"the idle component)",
+            )
+            for reason in (
+                "no-free-slot", "no-kv-blocks", "prefill-in-flight",
+                "queue-empty",
+            )
+        }
+        self._m_spec_rejected = reporter.counter(
+            "speculative_drafts_rejected_total",
+            "draft tokens rejected by verify steps",
+        )
+        self._m_spec_ratio = reporter.gauge(
+            "speculative_accept_ratio",
+            "accepted / drafted ratio over the engine's life",
+        )
+        self._m_recompiles = reporter.counter(
+            "recompiles_total",
+            "jit program variants/shapes compiled (bucket or sampler-mode "
+            "misses; each is a potential mid-traffic convoy)",
+        )
         self._warmup_task: asyncio.Task | None = None
         # device-side upload caches (content-keyed): block tables and the
         # sampler/active-mask tuple change rarely between chunks, and each
@@ -1058,6 +1118,7 @@ class TpuServingEngine:
         k_steps = k_steps or self.config.decode_chunk
         key = (sampler_mode, window, k_steps, use_pen)
         if key not in self._decode_chunk_fns:
+            self._note_compile("decode", key)
             self._decode_chunk_fns[key] = self._make_decode(
                 sampler_mode, window, k_steps, use_pen
             )
@@ -1099,8 +1160,94 @@ class TpuServingEngine:
     def _verify_fn(self, nrb: int, sampler_mode: tuple):
         key = (nrb, sampler_mode)
         if key not in self._verify_fns:
+            self._note_compile("verify", key)
             self._verify_fns[key] = self._make_verify(nrb, sampler_mode)
         return self._verify_fns[key]
+
+    # ------------------------------------------------------------------
+    # flight recorder plumbing
+    # ------------------------------------------------------------------
+
+    def _note_compile(self, kind: str, key) -> None:
+        """Record a recompile event the first time a (kind, shape) pair is
+        dispatched: jit-variant cache misses AND new prefill bucket/row
+        shapes (the same Python variant re-traces per padded shape). Runs
+        on the engine loop or the dispatch thread; append-only."""
+        shape_key = (kind, repr(key))
+        if shape_key in self._compiled_shapes:
+            return
+        self._compiled_shapes.add(shape_key)
+        self.flight.event("recompile", what=kind, variant=repr(key))
+        self._m_recompiles(1)
+
+    def _admission_stall(self) -> str | None:
+        """Why queued work is not being admitted right now (None when the
+        queue is empty or admission would succeed on the next pass)."""
+        if self._queue.empty():
+            return None
+        if not any(s.free for s in self.slots):
+            return "no-free-slot"
+        if self.block_mgr is not None:
+            try:
+                head = self._queue._queue[0]  # peek, engine-loop only
+            except IndexError:
+                return None
+            if not self.block_mgr.can_admit(
+                len(head.prompt_tokens) + head.max_tokens + 1
+            ):
+                return "no-kv-blocks"
+        if self._has_prefilling():
+            return "prefill-in-flight"
+        return None
+
+    def _flight_record(
+        self,
+        phase: str,
+        device_s: float,
+        tokens: int = 0,
+        spec_accepted: int = 0,
+        spec_rejected: int = 0,
+    ) -> None:
+        """One flight sample per dispatched burst, plus its Prometheus
+        mirrors. Hot-path discipline (graftcheck OBS503): deque appends and
+        counter bumps only — no I/O, no locks."""
+        stall = self._admission_stall()
+        kv_used = (
+            self.block_mgr.used_ratio() if self.block_mgr is not None else None
+        )
+        sample = self.flight.sample(
+            phase,
+            device_s=device_s,
+            tokens=tokens,
+            occupancy=sum(1 for s in self.slots if not s.free),
+            queue_depth=self._queue.qsize(),
+            stall=stall,
+            kv_used=kv_used,
+            prefix_hits=self.prefix_hits,
+            spec_accepted=spec_accepted,
+            spec_rejected=spec_rejected,
+        )
+        hist = self._m_step_hist.get(phase)
+        if hist is not None:
+            hist(sample["wall_ms"] / 1000.0)
+        self._m_host_overhead(sample["host_ms"] / 1000.0)
+        if kv_used is not None:
+            self._m_kv_used(kv_used)
+        if stall is not None:
+            self._m_stall[stall](sample["wall_ms"] / 1000.0)
+
+    def _flight_stall(self, reason: str) -> None:
+        """Record an idle/blocked engine-loop gap as stall time."""
+        kv_used = (
+            self.block_mgr.used_ratio() if self.block_mgr is not None else None
+        )
+        sample = self.flight.stall(
+            reason,
+            occupancy=sum(1 for s in self.slots if not s.free),
+            queue_depth=self._queue.qsize(),
+            kv_used=kv_used,
+        )
+        self._m_stall[reason](sample["wall_ms"] / 1000.0)
 
     @staticmethod
     def _sampler_mode(temps, topks, topps) -> tuple:
@@ -1265,6 +1412,7 @@ class TpuServingEngine:
         text = "engine warmup probe text. " * 4
         k = max(self.config.decode_chunk, self.config.decode_chunk_light) + 1
         opts = {"max-tokens": k, "temperature": 0}
+        self.flight.event("warmup", stage="begin")
         await self.generate(text, dict(opts), _warmup_probe=True)
         wave = min(
             self.config.slots,
@@ -1276,10 +1424,12 @@ class TpuServingEngine:
                 for _ in range(wave)
             )
         )
-        return {
+        result = {
             "decode_variants": len(self._decode_chunk_fns),
             "prefill_variants": len(self._prefill_fns),
         }
+        self.flight.event("warmup", stage="end", **result)
+        return result
 
     def stats(self) -> dict[str, Any]:
         out = {
@@ -1292,6 +1442,10 @@ class TpuServingEngine:
                 "light": self._light_chunks,
                 "heavy": self._heavy_chunks,
             },
+            # per-phase dispatched-step counts (flight recorder): lets a
+            # running engine decompose where its dispatches go without a
+            # bench run
+            "steps": dict(self.flight.steps_by_phase),
         }
         if self.block_mgr is not None:
             out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
@@ -1299,6 +1453,10 @@ class TpuServingEngine:
             out["speculative"] = {
                 "steps": self.spec_steps,
                 "drafts_accepted": self.spec_accepted,
+                # rejected drafts make the 4.3x spec slowdown decomposable
+                # from a live engine: high reject ratio = wasted verify
+                # FLOPs, not host overhead
+                "rejected": self.spec_rejected,
             }
         return out
 
@@ -1345,6 +1503,11 @@ class TpuServingEngine:
 
     async def _run_loop(self) -> None:
         loop = asyncio.get_running_loop()
+        # reset the flight timeline: the loop starts lazily on the first
+        # generate(), and the construction→first-request gap (an hour for
+        # an idle deploy) must not be billed to the first sample as host
+        # time — from here on the loop itself records every gap
+        self.flight.mark()
         while not self._stop:
             try:
                 if not self._queue.empty():
@@ -1367,6 +1530,10 @@ class TpuServingEngine:
                             await asyncio.wait_for(self._wake.wait(), timeout=1.0)
                         except asyncio.TimeoutError:
                             pass
+                        # the whole gap was engine idle time: record it so
+                        # the flight timeline stays contiguous and the
+                        # rollup's stall component is exact
+                        self._flight_stall("queue-empty")
                     continue
                 if (
                     self.config.speculative_drafts > 0
@@ -1404,9 +1571,17 @@ class TpuServingEngine:
                     # a lost follower is unrecoverable for this process
                     # group — stop serving so the slice restarts as a unit
                     log.error("lockstep group broken; engine stops serving")
+                    self.flight.event(
+                        "lockstep-divergence", error=str(e)[:200]
+                    )
                     self._stop = True
 
     def _fail_inflight(self, error: Exception) -> None:
+        self.flight.event(
+            "preempt",
+            error=f"{type(error).__name__}: {error}"[:200],
+            inflight=sum(1 for s in self.slots if not s.free),
+        )
         for slot_id, slot in enumerate(self.slots):
             request = slot.request
             if request is not None and not request.future.done():
@@ -1424,11 +1599,16 @@ class TpuServingEngine:
         self._pending_emits.clear()
         self._finished_requests.clear()
 
-    def _draft_tokens(self, slot_id: int, num_drafts: int) -> list[int]:
+    def _draft_tokens(
+        self, slot_id: int, num_drafts: int
+    ) -> tuple[list[int], int]:
         """Prompt-lookup draft: continue the context's most recent bigram
         match. Unmatched slots get zero drafts — greedy verify accepts a
         draft only when the model would have emitted it anyway, so a bad
-        draft costs nothing but the verified position."""
+        draft costs nothing but the verified position. Returns the padded
+        draft row and the number of REAL drafts in it (padding zeros are
+        not drafts — counting them as rejected would deflate the accept
+        ratio on workloads where lookup rarely matches)."""
         request = self.slots[slot_id].request
         ctx = request.prompt_tokens + request.generated
         n = len(ctx)
@@ -1442,8 +1622,9 @@ class TpuServingEngine:
             pos = idx.get((ctx[-2], ctx[-1]))
             if pos is not None:
                 cont = ctx[pos + 2 : pos + 2 + num_drafts]
-                return list(cont) + [0] * (num_drafts - len(cont))
-        return [0] * num_drafts
+                padded = list(cont) + [0] * (num_drafts - len(cont))
+                return padded, len(cont)
+        return [0] * num_drafts, 0
 
     async def _speculative_burst(self, loop, active: list[int]) -> None:
         """Greedy prompt-lookup speculative decoding: per step, each active
@@ -1466,12 +1647,18 @@ class TpuServingEngine:
             if not live:
                 return
             tokens = np.zeros((self.config.slots, D1), dtype=np.int32)
+            grown = 0
+            drafted_real: dict[int, int] = {}
             for slot_id in live:
-                self.block_mgr.ensure_capacity(
+                grown += self.block_mgr.ensure_capacity(
                     slot_id, min(int(self._lengths[slot_id]) + D1, S)
                 )
                 tokens[slot_id, 0] = self._current[slot_id]
-                tokens[slot_id, 1:] = self._draft_tokens(slot_id, D)
+                drafts, n_real = self._draft_tokens(slot_id, D)
+                drafted_real[slot_id] = n_real
+                tokens[slot_id, 1:] = drafts
+            if grown:
+                self.flight.event("pool-grow", slots=grown, phase="verify")
             tables = self.block_mgr.tables.copy()
             active_mask = np.zeros(self.config.slots, dtype=bool)
             active_mask[live] = True
@@ -1513,23 +1700,30 @@ class TpuServingEngine:
                     jnp.asarray(self._topps),
                 )
                 self.cache_k, self.cache_v = out[4], out[5]
-                return (
+                # dispatch returned async; the fetches below block until
+                # the device finishes — that wait is the step's device time
+                t_dev = time.monotonic()
+                fetched = (
                     np.asarray(out[0]), np.asarray(out[1]),
                     np.asarray(out[2]), np.asarray(out[3]),
                     np.asarray(out[6]),
                 )
+                return fetched + (time.monotonic() - t_dev,)
 
-            emitted, adv, nxt, new_lengths, logprobs = (
+            emitted, adv, nxt, new_lengths, logprobs, device_s = (
                 await loop.run_in_executor(self._executor, _run)
             )
             self._m_spec_steps(1)
             self.spec_steps += 1
             finished = False
             emitted_before = self.total_generated  # _emit_token counts each
+            accepted_before = self.spec_accepted
+            rejected_step = 0
             for slot_id in live:
                 a = int(adv[slot_id])
                 base = int(self._lengths[slot_id])
                 done = False
+                acc_slot = 0
                 for j in range(a):
                     # advance the length BEFORE each emit so the emit-side
                     # max_seq_len stop guard sees the true context size
@@ -1545,12 +1739,30 @@ class TpuServingEngine:
                     if j > 0:
                         self._m_spec_accepted(1)
                         self.spec_accepted += 1
+                        acc_slot += 1
                     if done:
                         finished = True
                         break
                 if not done:
                     self._current[slot_id] = int(nxt[slot_id])
+                # only REAL drafts count as rejected (padding zeros never
+                # were drafts); drafts left unconsumed by a mid-burst
+                # stop/EOS were still wasted verify positions
+                rejected_step += max(0, drafted_real[slot_id] - acc_slot)
             self._m_tokens(self.total_generated - emitted_before)
+            accepted_step = self.spec_accepted - accepted_before
+            self.spec_rejected += rejected_step
+            self._m_spec_rejected(rejected_step)
+            drafted = self.spec_accepted + self.spec_rejected
+            if drafted:
+                self._m_spec_ratio(self.spec_accepted / drafted)
+            self._flight_record(
+                "verify",
+                device_s=device_s,
+                tokens=self.total_generated - emitted_before,
+                spec_accepted=accepted_step,
+                spec_rejected=rejected_step,
+            )
             await self._flush_emits(live)
             if (
                 finished
@@ -1589,16 +1801,22 @@ class TpuServingEngine:
         ])
     ))
 
-    def _fetch_chunk(self, out) -> tuple[np.ndarray, np.ndarray]:
+    def _fetch_chunk(self, out) -> tuple[np.ndarray, np.ndarray, float]:
         """ONE device→host transfer per chunk: tokens and bitcast logprobs
         ride the same array (each np.asarray is a synchronous RPC over a
-        tunneled chip — two fetches is two round trips)."""
+        tunneled chip — two fetches is two round trips). The third element
+        is the seconds this call spent blocked on the device — the chunk's
+        un-overlapped device wait, which the flight recorder subtracts
+        from wall time to expose the host share."""
         tokens, lps = out[0], out[1]
         K, B = tokens.shape
+        t_dev = time.monotonic()
         packed = np.asarray(self._pack_chunk(tokens, lps))
+        fetch_s = time.monotonic() - t_dev
         return (
             packed[: K * B].reshape(K, B),
             packed[K * B:].view(np.float32).reshape(K, B),
+            fetch_s,
         )
 
     def _tables_device(self, tables: np.ndarray | None):
@@ -1717,6 +1935,7 @@ class TpuServingEngine:
             if not paged:
                 return None
             S = self.model_config.max_seq_len
+            grown = 0
             for slot_id in active:
                 request = self.slots[slot_id].request
                 if request is not None:
@@ -1730,7 +1949,9 @@ class TpuServingEngine:
                         int(self._lengths[slot_id]) + (pending_chunks + 1) * K,
                         cap, S,
                     )
-                    self.block_mgr.ensure_capacity(slot_id, need)
+                    grown += self.block_mgr.ensure_capacity(slot_id, need)
+            if grown:
+                self.flight.event("pool-grow", slots=grown, phase="decode")
             return self.block_mgr.tables.copy()
 
         def _dispatch(tokens, lengths, key, window, tables, first=False):
@@ -1812,10 +2033,15 @@ class TpuServingEngine:
         chunk_index = 0
         if light or pen:
             while True:
-                chunk_t, chunk_lp = await loop.run_in_executor(
+                chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
                     self._executor, partial(self._fetch_chunk, out)
                 )
+                gen_before = self.total_generated
                 finished = self._process_chunk(chunk_t, chunk_lp, active)
+                self._flight_record(
+                    "decode", device_s=fetch_s,
+                    tokens=self.total_generated - gen_before,
+                )
                 await self._flush_emits(active)
                 if self._burst_should_yield(finished):
                     return
@@ -1840,18 +2066,28 @@ class TpuServingEngine:
                 partial(_dispatch, out[2], out[3], key_next,
                         _bucket_for(base_max), _grow_blocks(1)),
             )
-            chunk_t, chunk_lp = await loop.run_in_executor(
+            chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
                 self._executor, partial(self._fetch_chunk, out)
             )
+            gen_before = self.total_generated
             finished = self._process_chunk(chunk_t, chunk_lp, active)
+            self._flight_record(
+                "decode", device_s=fetch_s,
+                tokens=self.total_generated - gen_before,
+            )
             await self._flush_emits(active)
             out = await next_out_task
             if self._burst_should_yield(finished):
                 # drain the speculative chunk, then hand back to the loop
-                chunk_t, chunk_lp = await loop.run_in_executor(
+                chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
                     self._executor, partial(self._fetch_chunk, out)
                 )
+                gen_before = self.total_generated
                 self._process_chunk(chunk_t, chunk_lp, active)
+                self._flight_record(
+                    "decode", device_s=fetch_s,
+                    tokens=self.total_generated - gen_before,
+                )
                 await self._flush_emits(active)
                 return
 
@@ -1900,6 +2136,8 @@ class TpuServingEngine:
         mode = self._sampler_mode(temps, topks, topps)
         nrb = self._read_blocks_for(max(int(starts.max()), 1))
         fn = self._prefill_continue_fn(mode, nrb)
+        # the continuation variant re-traces per (rows, chunk, window) shape
+        self._note_compile("prefill-continue", (mode, nrb, Bp, C))
         sel_np = self.block_mgr.tables[slot_ids]
         key = self._split_key()
 
@@ -1920,14 +2158,21 @@ class TpuServingEngine:
                         "topps": topps,
                     }
                 )
-            return fn(
+            out = fn(
                 self.params, self.cache_k, self.cache_v,
                 jnp.asarray(tokens), jnp.asarray(starts),
                 jnp.asarray(suffix_lens), jnp.asarray(sel_np), key,
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
             )
+            t_dev = time.monotonic()
+            # the caller fetched these synchronously anyway (np.asarray on
+            # the loop thread); fencing HERE keeps that single sync but on
+            # the dispatch thread, timed — the sample's device_ms
+            # graftcheck: disable=JAX104 the one per-dispatch sync, moved off-loop and timed
+            jax.block_until_ready(out)
+            return out, time.monotonic() - t_dev
 
-        next_tokens, logprobs, self.cache_k, self.cache_v = (
+        (next_tokens, logprobs, self.cache_k, self.cache_v), device_s = (
             await loop.run_in_executor(self._executor, _run)
         )
         next_np = np.asarray(next_tokens)
@@ -1960,6 +2205,9 @@ class TpuServingEngine:
                 )
                 done_slots.append(slot_id)
                 self._m_tokens(1)
+        self._flight_record(
+            "prefill", device_s=device_s, tokens=len(done_slots)
+        )
         if done_slots:
             await self._flush_emits(done_slots)
 
@@ -2037,6 +2285,8 @@ class TpuServingEngine:
                     slot.prefill_done = reuse
                     request.admit_time = time.monotonic()
                     if reuse:
+                        self.prefix_hits += 1
+                        self.prefix_tokens += reuse
                         self._m_prefix_hits(1)
                         self._m_prefix_tokens(reuse)
                     continue
@@ -2098,8 +2348,13 @@ class TpuServingEngine:
             if use_continue:
                 nrb = self._read_blocks_for(int(starts.max()))
                 prefill_fn = self._prefill_continue_fn(prefill_mode, nrb)
+                self._note_compile(
+                    "prefill-continue", (prefill_mode, nrb, Bp, bucket)
+                )
             else:
                 prefill_fn = self._prefill_fn(prefill_mode)
+                # same Python variant, fresh XLA program per (bucket, rows)
+                self._note_compile("prefill", (prefill_mode, bucket, Bp))
 
             def _run():
                 if self._lockstep is not None:
@@ -2141,9 +2396,15 @@ class TpuServingEngine:
                 self.profiler.dump_hlo(
                     f"prefill_p{bucket}_b{Bp}{variant}", prefill_fn, *args
                 )
-                return prefill_fn(*args)
+                out = prefill_fn(*args)
+                t_dev = time.monotonic()
+                # same single sync the loop-thread np.asarray used to pay,
+                # moved onto the dispatch thread so it can be timed
+                # graftcheck: disable=JAX104 the one per-dispatch sync, moved off-loop and timed
+                jax.block_until_ready(out)
+                return out, time.monotonic() - t_dev
 
-            next_tokens, logprobs, self.cache_k, self.cache_v = (
+            (next_tokens, logprobs, self.cache_k, self.cache_v), device_s = (
                 await loop.run_in_executor(self._executor, _run)
             )
             if use_prefix:
@@ -2152,6 +2413,8 @@ class TpuServingEngine:
                         slot_id, request.prompt_tokens
                     )
                     if reuse:
+                        self.prefix_hits += 1
+                        self.prefix_tokens += reuse
                         self._m_prefix_hits(1)
                         self._m_prefix_tokens(reuse)
             next_np = np.asarray(next_tokens)
@@ -2170,6 +2433,9 @@ class TpuServingEngine:
                 self._emit_token(slot_id, int(next_np[i]), float(logprob_np[i]))
                 admitted_slots.append(slot_id)
             self._m_tokens(len(batch))
+            self._flight_record(
+                "prefill", device_s=device_s, tokens=len(batch)
+            )
             await self._flush_emits(admitted_slots)
 
     def _process_chunk(
@@ -2387,6 +2653,29 @@ class TpuServingEngine:
                         ),
                     }
                 )
+
+
+def flight_report(
+    summary_only: bool = False, samples: int = 240
+) -> list[dict[str, Any]]:
+    """Flight-recorder payload for every live engine (the pod's ``/flight``
+    and ``/flight/summary`` endpoints serve this; the control plane fans it
+    in per application). One entry per engine: model, rollup summary, and —
+    unless ``summary_only`` — the recent sample window and event tail."""
+    with TpuServingEngine._instances_lock:
+        engines = list(TpuServingEngine._instances.values())
+    report: list[dict[str, Any]] = []
+    for engine in engines:
+        entry: dict[str, Any] = {
+            "model": engine.config.model,
+            "slots": engine.config.slots,
+            "summary": engine.flight.summary(),
+        }
+        if not summary_only:
+            entry["samples"] = engine.flight.recent(samples)
+            entry["events"] = engine.flight.recent_events()
+        report.append(entry)
+    return report
 
 
 def profile_engines(action: str, trace_dir: str | None = None) -> dict[str, bool]:

@@ -1,0 +1,358 @@
+"""Engine flight recorder: per-dispatch telemetry ring + stall attribution.
+
+The vLLM-style engine stats loop, grown into a bounded time series: PR-2's
+traces explain *one request's* journey; this module records *every
+dispatched burst* the engine runs — the aggregate signal that localizes
+systemic stalls (Dapper's lesson: per-request traces don't find the 16 ms
+of host overhead that every step pays).
+
+One :class:`FlightRecorder` per engine. The engine loop records a **sample**
+per dispatched decode/prefill/verify burst and a **stall** sample for every
+idle gap, so the samples tile the engine-loop timeline contiguously:
+
+- ``wall_ms`` — time since the previous recorded boundary (the full slice
+  of engine-loop wall clock this burst accounts for);
+- ``device_ms`` — time the host spent *blocked on device results* for the
+  burst (measured at the dispatch's block boundary — the fetch/
+  ``block_until_ready`` call). Under the pipelined decode path this is the
+  un-overlapped device wait, which is exactly the number that matters:
+  device time hidden behind host work costs nothing;
+- ``host_ms`` — ``wall − device`` (clamped ≥ 0): Python dispatch, numpy
+  packing, emit callbacks, block accounting — the "unattributed host
+  overhead" bucket BENCH r05 could not see;
+- ``stall`` — why queued work is not being admitted at this boundary
+  (``no-free-slot`` / ``no-kv-blocks`` / ``prefill-in-flight`` /
+  ``queue-empty``), plus batch occupancy, queue depth, tokens emitted,
+  KV-pool reserved ratio (the admission pressure), prefix-cache hits,
+  and speculative accept/reject.
+
+Because the samples tile the timeline, the rollup decomposes total wall
+time **exactly** into ``device + host + stall`` — the property the bench
+acceptance checks against its own measured wall clock. Stall attribution
+is kept in two disjoint dictionaries so a saturated engine never reads
+as "stalled": ``stall_s_by_reason`` (engine-loop idle time; sums to
+``stall_ms``) vs ``blocked_s_by_reason`` (busy-dispatch wall during
+which queued work could not be admitted — queue pressure).
+
+Discrete **events** ride a second small ring: ``recompile`` (a jit variant
+or prefill bucket compiled for the first time — the 30 s mid-traffic
+convoy-maker on TPU), ``pool-grow`` (decode-time KV block allocation),
+``warmup``, ``preempt`` (in-flight work failed), ``lockstep-divergence``.
+
+Hot-path discipline (graftcheck rule OBS503 gates this): the record path
+is append-only on GIL-atomic deques — **no locks, no I/O, nothing that can
+block the engine loop**. Rollups snapshot with ``list(deque)``.
+
+Sizing: ``LS_TPU_FLIGHT_BUFFER`` samples (default 4096, min 64). Cumulative
+totals (wall/device/host/stall, per-phase step counts, stall seconds by
+reason, token counts) are plain counters maintained alongside the ring, so
+the rollup stays exact even after the ring starts evicting; percentiles
+and rates come from the retained window.
+
+Exposure: the pod serves ``/flight`` (recent samples + events + rollup)
+and ``/flight/summary`` next to ``/metrics`` and ``/traces``; the control
+plane fans pods in under ``/api/applications/{t}/{n}/flight``; and
+``tools/engine_top.py`` renders the same payload as a live console or a
+post-mortem breakdown. See ``docs/OBSERVABILITY.md``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any
+
+#: admission-stall reasons a sample may carry (the attribution vocabulary)
+STALL_REASONS = (
+    "no-free-slot",
+    "no-kv-blocks",
+    "prefill-in-flight",
+    "queue-empty",
+)
+
+#: dispatch phases (a "stall" sample is the fifth, non-dispatch kind)
+PHASES = ("prefill", "decode", "verify")
+
+
+def _buffer_size() -> int:
+    try:
+        return max(64, int(os.environ.get("LS_TPU_FLIGHT_BUFFER", "4096")))
+    except ValueError:
+        return 4096
+
+
+def _pct(sorted_values: list, q: float):
+    """Nearest-rank percentile of an already-sorted list (None when empty)."""
+    if not sorted_values:
+        return None
+    return sorted_values[min(len(sorted_values) - 1, int(q * len(sorted_values)))]
+
+
+class FlightRecorder:
+    """Bounded per-engine telemetry ring. Single writer (the engine loop;
+    events may also arrive from the dispatch thread), many readers."""
+
+    def __init__(self, slots: int = 0, maxlen: int | None = None):
+        self.slots = slots
+        self.capacity = maxlen if maxlen is not None else _buffer_size()
+        self._samples: deque[dict[str, Any]] = deque(maxlen=self.capacity)
+        self._events: deque[dict[str, Any]] = deque(maxlen=512)
+        self._seq = 0
+        self._last_mark = time.monotonic()
+        # cumulative counters: exact over the engine's whole life, immune
+        # to ring eviction (plain attributes — engine loop is the only
+        # sample writer, and CPython attribute updates don't interleave)
+        self.recorded = 0
+        self.wall_ms = 0.0
+        self.device_ms = 0.0
+        self.host_ms = 0.0
+        self.stall_ms = 0.0
+        self.tokens = 0
+        self.recompiles = 0
+        self.steps_by_phase: dict[str, int] = {}
+        # two distinct attributions (they must not be conflated, or a
+        # saturated engine reads as 100% stalled):
+        # - stall_s_by_reason: engine-loop STALL time (stall samples only)
+        #   — decomposes totals.stall_ms exactly;
+        # - blocked_s_by_reason: wall time of dispatch samples annotated
+        #   with an admission-stall reason — the engine was BUSY, but
+        #   queued work waited that long for that reason (queue pressure)
+        self.stall_s_by_reason: dict[str, float] = {}
+        self.blocked_s_by_reason: dict[str, float] = {}
+        self.events_by_type: dict[str, int] = {}
+        self.spec_accepted = 0
+        self.spec_rejected = 0
+
+    # -- recording (engine hot path: appends + counter bumps only) -------
+
+    def mark(self) -> None:
+        """Reset the timeline boundary (e.g. when the engine loop starts
+        after a long construction gap, so the gap isn't billed as host)."""
+        self._last_mark = time.monotonic()
+
+    def sample(
+        self,
+        phase: str,
+        *,
+        device_s: float = 0.0,
+        tokens: int = 0,
+        occupancy: int = 0,
+        queue_depth: int = 0,
+        stall: str | None = None,
+        kv_used: float | None = None,
+        prefix_hits: int = 0,
+        spec_accepted: int = 0,
+        spec_rejected: int = 0,
+    ) -> dict[str, Any]:
+        """Record one dispatched burst. ``wall`` is the time since the
+        previous boundary; ``host = wall − device``."""
+        now = time.monotonic()
+        wall_ms = (now - self._last_mark) * 1000.0
+        self._last_mark = now
+        device_ms = max(0.0, min(device_s * 1000.0, wall_ms))
+        host_ms = wall_ms - device_ms
+        self._seq += 1
+        entry: dict[str, Any] = {
+            "seq": self._seq,
+            # wall-clock anchor for display alignment across pods only;
+            # every duration above is monotonic
+            # graftcheck: disable=OBS501 display anchor, never subtracted
+            "t_ms": round(time.time() * 1000.0, 3),
+            "phase": phase,
+            "wall_ms": round(wall_ms, 3),
+            "device_ms": round(device_ms, 3),
+            "host_ms": round(host_ms, 3),
+            "occupancy": occupancy,
+            "slots": self.slots,
+            "tokens": tokens,
+            "queue_depth": queue_depth,
+            "stall": stall,
+            "kv_used": round(kv_used, 4) if kv_used is not None else None,
+            "prefix_hits": prefix_hits,
+        }
+        if spec_accepted or spec_rejected:
+            entry["spec_accepted"] = spec_accepted
+            entry["spec_rejected"] = spec_rejected
+        self._samples.append(entry)
+        self.recorded += 1
+        self.wall_ms += wall_ms
+        self.device_ms += device_ms
+        self.host_ms += host_ms
+        self.tokens += tokens
+        self.steps_by_phase[phase] = self.steps_by_phase.get(phase, 0) + 1
+        if stall:
+            # the engine dispatched work this slice, so this is BLOCKED
+            # (queued work waiting while busy), not engine stall
+            self.blocked_s_by_reason[stall] = (
+                self.blocked_s_by_reason.get(stall, 0.0) + wall_ms / 1000.0
+            )
+        self.spec_accepted += spec_accepted
+        self.spec_rejected += spec_rejected
+        return entry
+
+    def stall(
+        self,
+        reason: str,
+        *,
+        occupancy: int = 0,
+        queue_depth: int = 0,
+        kv_used: float | None = None,
+    ) -> dict[str, Any]:
+        """Record an idle/blocked gap (no dispatch): its whole wall slice
+        is stall time attributed to ``reason``."""
+        now = time.monotonic()
+        wall_ms = (now - self._last_mark) * 1000.0
+        self._last_mark = now
+        self._seq += 1
+        entry: dict[str, Any] = {
+            "seq": self._seq,
+            # graftcheck: disable=OBS501 display anchor, never subtracted
+            "t_ms": round(time.time() * 1000.0, 3),
+            "phase": "stall",
+            "wall_ms": round(wall_ms, 3),
+            "device_ms": 0.0,
+            "host_ms": 0.0,
+            "occupancy": occupancy,
+            "slots": self.slots,
+            "tokens": 0,
+            "queue_depth": queue_depth,
+            "stall": reason,
+            "kv_used": round(kv_used, 4) if kv_used is not None else None,
+            "prefix_hits": 0,
+        }
+        self._samples.append(entry)
+        self.recorded += 1
+        self.wall_ms += wall_ms
+        self.stall_ms += wall_ms
+        self.stall_s_by_reason[reason] = (
+            self.stall_s_by_reason.get(reason, 0.0) + wall_ms / 1000.0
+        )
+        return entry
+
+    def event(self, kind: str, **detail: Any) -> None:
+        """Record a discrete event (recompile / pool-grow / warmup /
+        preempt / lockstep-divergence). Safe from any thread."""
+        self.events_by_type[kind] = self.events_by_type.get(kind, 0) + 1
+        if kind == "recompile":
+            self.recompiles += 1
+        self._events.append(
+            {
+                "seq": self._seq,
+                # graftcheck: disable=OBS501 display anchor, never subtracted
+                "t_ms": round(time.time() * 1000.0, 3),
+                "kind": kind,
+                **detail,
+            }
+        )
+
+    # -- reading (snapshots; never block the writer) ---------------------
+    #
+    # Cross-thread safety: readers snapshot with list(deque) / dict(d) —
+    # single C-level copies of containers holding plain dicts, which never
+    # release the GIL or call back into Python, so a concurrent append
+    # from the engine loop or dispatch thread cannot interleave mid-copy.
+    # All derived math then runs on the snapshot.
+
+    def recent(self, n: int = 240) -> list[dict[str, Any]]:
+        samples = list(self._samples)
+        return samples[-n:] if n else samples
+
+    def recent_events(self, n: int = 64) -> list[dict[str, Any]]:
+        events = list(self._events)
+        return events[-n:] if n else events
+
+    @property
+    def dropped(self) -> int:
+        """Samples evicted from the ring (0 until ``recorded`` exceeds
+        ``LS_TPU_FLIGHT_BUFFER``)."""
+        return self.recorded - len(self._samples)
+
+    def summary(self) -> dict[str, Any]:
+        """Rollup: exact cumulative totals + window percentiles/rates.
+
+        ``totals.device_ms + totals.host_ms + totals.stall_ms ==
+        totals.wall_ms`` by construction — the decomposition the bench
+        acceptance compares against its measured wall clock.
+        """
+        window = list(self._samples)
+        dispatch = [s for s in window if s["phase"] != "stall"]
+        walls = sorted(s["wall_ms"] for s in dispatch)
+        hosts = sorted(s["host_ms"] for s in dispatch)
+        devices = sorted(s["device_ms"] for s in dispatch)
+        queue_depths = sorted(s["queue_depth"] for s in window)
+        # the samples tile the timeline, so the retained window's span is
+        # the (monotonic) sum of its wall slices — no wall-clock arithmetic
+        span_s = sum(s["wall_ms"] for s in window) / 1000.0
+        window_tokens = sum(s["tokens"] for s in dispatch)
+        kv_last = next(
+            (s["kv_used"] for s in reversed(window) if s["kv_used"] is not None),
+            None,
+        )
+        out: dict[str, Any] = {
+            "capacity": self.capacity,
+            "recorded": self.recorded,
+            "dropped": self.dropped,
+            "totals": {
+                "wall_ms": round(self.wall_ms, 3),
+                "device_ms": round(self.device_ms, 3),
+                "host_ms": round(self.host_ms, 3),
+                "stall_ms": round(self.stall_ms, 3),
+                "tokens": self.tokens,
+                "steps_by_phase": dict(self.steps_by_phase),
+                "stall_s_by_reason": {
+                    k: round(v, 4) for k, v in self.stall_s_by_reason.items()
+                },
+                "blocked_s_by_reason": {
+                    k: round(v, 4)
+                    for k, v in self.blocked_s_by_reason.items()
+                },
+                "recompiles": self.recompiles,
+                "events_by_type": dict(self.events_by_type),
+                "spec_accepted": self.spec_accepted,
+                "spec_rejected": self.spec_rejected,
+            },
+            "window": {
+                "samples": len(window),
+                "span_s": round(span_s, 3),
+                "tokens": window_tokens,
+                "tok_s": round(window_tokens / span_s, 1) if span_s else None,
+                "step_ms_p50": _pct(walls, 0.50),
+                "step_ms_p95": _pct(walls, 0.95),
+                "host_overhead_ms_p50": _pct(hosts, 0.50),
+                "device_ms_p50": _pct(devices, 0.50),
+                "queue_depth_p95": _pct(queue_depths, 0.95),
+                "occupancy_mean": (
+                    round(sum(s["occupancy"] for s in dispatch) / len(dispatch), 2)
+                    if dispatch
+                    else None
+                ),
+                "kv_used_ratio_last": kv_last,
+            },
+        }
+        return out
+
+
+def bench_rollup(summary: dict[str, Any]) -> dict[str, Any]:
+    """The subset of a flight summary a bench record snapshots (BENCH_r06
+    keys — enough for ``engine_top --analyze`` to decompose a run)."""
+    totals = summary.get("totals", {})
+    window = summary.get("window", {})
+    return {
+        "host_overhead_ms_p50": window.get("host_overhead_ms_p50"),
+        "stall_s_by_reason": totals.get("stall_s_by_reason"),
+        "blocked_s_by_reason": totals.get("blocked_s_by_reason"),
+        "queue_depth_p95": window.get("queue_depth_p95"),
+        "recompile_count": totals.get("recompiles"),
+        "totals": {
+            k: totals.get(k)
+            for k in (
+                "wall_ms",
+                "device_ms",
+                "host_ms",
+                "stall_ms",
+                "tokens",
+                "steps_by_phase",
+            )
+        },
+    }

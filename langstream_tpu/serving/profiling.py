@@ -136,6 +136,10 @@ class DecodeRoofline:
     cache_bytes_per_step: int  # KV window read across all slots
     total_bytes_per_step: int
     hbm_gbps: float            # assumed device bandwidth
+    # detected device identity, recorded so a bench JSON says WHICH roof it
+    # was measured against instead of implying v5e everywhere
+    generation: str | None = None   # "v5e"/"v5p"/"v4"/"v6e"; None off-TPU
+    hbm_bytes: int | None = None    # allocator bytes_limit when exposed
 
     def min_step_ms(self) -> float:
         return self.total_bytes_per_step / (self.hbm_gbps * 1e9) * 1e3
@@ -147,13 +151,64 @@ class DecodeRoofline:
 # published HBM bandwidth by TPU generation (GB/s); used for reporting only
 _HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0}
 
+# jax device_kind substrings → generation key (plugins spell these several
+# ways: "TPU v5 lite", "TPU v5e", "TPU v6 lite", ...). Checked in order so
+# the lite variants match before the bare version numbers.
+_DEVICE_KIND_GEN = (
+    ("v5 lite", "v5e"),
+    ("v5lite", "v5e"),
+    ("v5e", "v5e"),
+    ("v5p", "v5p"),
+    ("v6 lite", "v6e"),
+    ("v6lite", "v6e"),
+    ("v6e", "v6e"),
+    ("v4", "v4"),
+)
+
+
+def detect_generation() -> str | None:
+    """TPU generation key from ``TPU_ACCELERATOR_TYPE``, falling back to
+    the live backend's ``device_kind`` (the env var is unset under some
+    plugins — the reason ``device.hbm``/generation used to come out null).
+    None on CPU/GPU or when nothing matches."""
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    for key in _HBM_GBPS:
+        if accel.startswith(key):
+            return key
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        if not devices or devices[0].platform != "tpu":
+            return None
+        kind = getattr(devices[0], "device_kind", "").lower()
+        for pattern, key in _DEVICE_KIND_GEN:
+            if pattern in kind:
+                return key
+    except Exception:  # backend not initialized / no devices: just unknown
+        return None
+    return None
+
+
+def detect_hbm_bytes() -> int | None:
+    """Physical HBM per chip from the allocator's ``bytes_limit`` when the
+    platform exposes memory stats (several TPU plugins return None)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception as e:
+        log.debug("memory_stats unavailable: %s", e)
+    return None
+
 
 def detect_hbm_gbps(default: float = 819.0) -> float:
-    gen = os.environ.get("TPU_ACCELERATOR_TYPE", "")
-    for key, bw in _HBM_GBPS.items():
-        if gen.startswith(key):
-            return bw
-    return default
+    """Bandwidth of the detected generation; ``default`` (v5e, the fleet
+    baseline) only when no generation can be detected at all."""
+    generation = detect_generation()
+    return _HBM_GBPS.get(generation, default)
 
 
 def decode_step_bytes(
@@ -187,4 +242,6 @@ def decode_step_bytes(
         cache_bytes_per_step=cache,
         total_bytes_per_step=wbytes + cache,
         hbm_gbps=detect_hbm_gbps(),
+        generation=detect_generation(),
+        hbm_bytes=detect_hbm_bytes(),
     )

@@ -1,0 +1,708 @@
+"""Engine flight recorder tests.
+
+Layers covered: the recorder ring (bounded size, drop accounting, rollup
+math), the engine integration on the CPU backend under concurrent load
+(the acceptance decomposition: device + host + stall sums to the measured
+wall clock), recompile-event detection via a fake compile-cache miss, the
+pod ``/flight`` endpoints, the control-plane fan-in over the memory broker
+(mirroring ``test_tracing.py``'s e2e shape), the k8s fan-in pod tagging,
+and the ``engine_top --analyze`` post-mortem on a canned dump."""
+
+import asyncio
+import importlib.util
+import json
+import socket
+import time
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+from langstream_tpu.serving.flight import FlightRecorder, bench_rollup
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _close_engines():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    with TpuServingEngine._instances_lock:
+        engines = list(TpuServingEngine._instances.values())
+    for engine in engines:
+        await engine.close()
+
+
+def _load_engine_top():
+    path = Path(__file__).resolve().parents[1] / "tools" / "engine_top.py"
+    spec = importlib.util.spec_from_file_location("engine_top", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# --------------------------------------------------------------------------
+# recorder units: bounded ring, drop accounting, rollup math
+# --------------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_counts_drops():
+    recorder = FlightRecorder(slots=4, maxlen=8)
+    for _ in range(20):
+        recorder.sample("decode", device_s=0.001, tokens=4)
+    assert len(recorder.recent(0)) == 8
+    assert recorder.recorded == 20
+    assert recorder.dropped == 12
+    # cumulative totals survive eviction
+    assert recorder.tokens == 80
+    assert recorder.steps_by_phase == {"decode": 20}
+
+
+def test_no_drops_below_capacity():
+    recorder = FlightRecorder(slots=4, maxlen=64)
+    for _ in range(63):
+        recorder.sample("decode")
+    assert recorder.dropped == 0
+    summary = recorder.summary()
+    assert summary["dropped"] == 0
+    assert summary["recorded"] == 63
+
+
+def test_buffer_size_env(monkeypatch):
+    monkeypatch.setenv("LS_TPU_FLIGHT_BUFFER", "100")
+    assert FlightRecorder().capacity == 100
+    monkeypatch.setenv("LS_TPU_FLIGHT_BUFFER", "3")  # clamped to the floor
+    assert FlightRecorder().capacity == 64
+    monkeypatch.setenv("LS_TPU_FLIGHT_BUFFER", "junk")
+    assert FlightRecorder().capacity == 4096
+
+
+def test_rollup_decomposition_is_exact():
+    """wall == device + host per dispatch sample, and the totals tile the
+    timeline: dispatch walls + stall walls == total wall."""
+    recorder = FlightRecorder(slots=2, maxlen=32)
+    time.sleep(0.02)
+    recorder.sample("prefill", device_s=0.005, tokens=2)
+    time.sleep(0.03)
+    recorder.sample("decode", device_s=0.01, tokens=16, stall="no-free-slot")
+    time.sleep(0.01)
+    recorder.stall("queue-empty")
+    totals = recorder.summary()["totals"]
+    # each total is independently rounded to 3 decimals for JSON, so the
+    # identity holds to rounding precision
+    assert totals["wall_ms"] == pytest.approx(
+        totals["device_ms"] + totals["host_ms"] + totals["stall_ms"], abs=0.01
+    )
+    assert totals["tokens"] == 18
+    assert totals["steps_by_phase"] == {"prefill": 1, "decode": 1}
+    # two disjoint attributions: idle gaps are STALL (decompose stall_ms),
+    # annotated busy dispatches are BLOCKED (queue pressure while decoding)
+    assert set(totals["stall_s_by_reason"]) == {"queue-empty"}
+    assert set(totals["blocked_s_by_reason"]) == {"no-free-slot"}
+    assert totals["blocked_s_by_reason"]["no-free-slot"] >= 0.03
+    # the dict rounds to 4 decimals of seconds (0.1 ms steps), stall_ms to
+    # 3 decimals of ms — equal up to half a rounding step
+    assert sum(totals["stall_s_by_reason"].values()) * 1000 == pytest.approx(
+        totals["stall_ms"], abs=0.06
+    )
+
+
+def test_device_time_clamped_to_wall():
+    """A device_s overestimate (overlapped pipelined fetch) must not drive
+    host_ms negative."""
+    recorder = FlightRecorder(slots=1, maxlen=8)
+    sample = recorder.sample("decode", device_s=999.0)
+    assert sample["device_ms"] <= sample["wall_ms"]
+    assert sample["host_ms"] >= 0.0
+
+
+def test_events_ring_and_counters():
+    recorder = FlightRecorder(slots=1, maxlen=8)
+    recorder.event("recompile", what="decode", variant="w128")
+    recorder.event("pool-grow", slots=3)
+    recorder.event("warmup", stage="begin")
+    assert recorder.recompiles == 1
+    assert recorder.events_by_type == {
+        "recompile": 1, "pool-grow": 1, "warmup": 1,
+    }
+    kinds = [e["kind"] for e in recorder.recent_events()]
+    assert kinds == ["recompile", "pool-grow", "warmup"]
+
+
+def test_bench_rollup_carries_the_record_keys():
+    recorder = FlightRecorder(slots=2, maxlen=32)
+    recorder.sample("decode", device_s=0.001, tokens=8, stall="no-kv-blocks")
+    recorder.event("recompile", what="decode")
+    rollup = bench_rollup(recorder.summary())
+    assert set(rollup) == {
+        "host_overhead_ms_p50", "stall_s_by_reason", "blocked_s_by_reason",
+        "queue_depth_p95", "recompile_count", "totals",
+    }
+    assert rollup["recompile_count"] == 1
+    # the annotated dispatch sample is queue pressure, not engine stall
+    assert "no-kv-blocks" in rollup["blocked_s_by_reason"]
+    assert rollup["stall_s_by_reason"] == {}
+    assert set(rollup["totals"]) == {
+        "wall_ms", "device_ms", "host_ms", "stall_ms", "tokens",
+        "steps_by_phase",
+    }
+    # rollups must be JSON-clean for the bench record line
+    json.dumps(rollup)
+
+
+# --------------------------------------------------------------------------
+# engine integration (CPU backend): the acceptance decomposition
+# --------------------------------------------------------------------------
+
+
+def test_paged_engine_under_load_decomposes_wall_time(run_async):
+    """A paged engine under concurrent generate(): the flight rollup's
+    device + host + stall components sum to within 10% of the measured
+    wall time, at least one recompile event lands during the (implicit)
+    warmup wave, and nothing is dropped below buffer capacity."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=4, max_seq_len=128, decode_chunk=8,
+                kv_layout="paged", prefix_cache=True,
+            )
+        )
+        t0 = time.monotonic()
+        try:
+            results = await asyncio.gather(
+                *(
+                    engine.generate(
+                        f"flight recorder load prompt {i}", {"max-tokens": 16}
+                    )
+                    for i in range(12)
+                )
+            )
+            elapsed = time.monotonic() - t0
+            assert all(r["tokens"] for r in results)
+            summary = engine.flight.summary()
+            totals = summary["totals"]
+            covered_s = (
+                totals["device_ms"] + totals["host_ms"] + totals["stall_ms"]
+            ) / 1000.0
+            # the samples tile the engine-loop timeline, so the decomposed
+            # components must reproduce the measured wall clock
+            assert covered_s == pytest.approx(elapsed, rel=0.10)
+            # ... and the decomposition itself is internally exact (up to
+            # the per-total JSON rounding)
+            assert totals["wall_ms"] / 1000.0 == pytest.approx(
+                covered_s, abs=1e-4
+            )
+            # first-sight compiles (the warmup wave) are recorded as events
+            recompiles = [
+                e for e in engine.flight.recent_events()
+                if e["kind"] == "recompile"
+            ]
+            assert recompiles, "warmup compiles must surface as events"
+            assert totals["recompiles"] == len(recompiles)
+            assert summary["dropped"] == 0
+            assert totals["tokens"] == sum(len(r["tokens"]) for r in results)
+            # every dispatch phase the run used shows up in the step counts
+            assert totals["steps_by_phase"].get("prefill", 0) >= 1
+            assert totals["steps_by_phase"].get("decode", 0) >= 1
+            # stats() mirrors the per-phase counts for live introspection
+            assert engine.stats()["steps"] == totals["steps_by_phase"]
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_timeline_mark_recompile_events_and_idle_stall(run_async):
+    """One engine, three recorder behaviors (shared to keep tier-1 wall
+    time down): the loop re-marks the timeline at start so an idle
+    deploy's construction→first-request gap isn't billed as host time; a
+    fake compile-cache miss surfaces as exactly one recompile event; and
+    idle gaps are recorded as queue-empty stall."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(
+            ServingConfig(model="tiny", slots=2, max_seq_len=64, decode_chunk=4)
+        )
+        try:
+            await asyncio.sleep(0.6)  # idle deploy: no loop, no samples
+            t0 = time.monotonic()
+            await engine.generate("late first request", {"max-tokens": 4})
+            elapsed = time.monotonic() - t0
+            totals = engine.flight.summary()["totals"]
+            # without the loop-start mark the first sample would absorb
+            # the 0.6 s pre-request gap
+            assert totals["wall_ms"] / 1000.0 <= elapsed + 0.2
+
+            # fake a compile-cache miss: forget a variant and re-request it
+            before = engine.flight.recompiles
+            engine._decode_chunk_fns.clear()
+            engine._compiled_shapes.clear()
+            engine._decode_fn((False, False, True), None)
+            assert engine.flight.recompiles == before + 1
+            newest = engine.flight.recent_events()[-1]
+            assert newest["kind"] == "recompile"
+            assert newest["what"] == "decode"
+            # the same variant again is NOT a new compile
+            engine._decode_fn((False, False, True), None)
+            assert engine.flight.recompiles == before + 1
+
+            # let the loop hit its idle wait once (1s wake timeout)
+            await asyncio.sleep(1.2)
+            assert engine.flight.stall_s_by_reason.get("queue-empty", 0.0) > 0
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_draft_tokens_report_real_draft_count(run_async):
+    """Padding zeros are not drafts: the rejected-drafts accounting counts
+    only genuine prompt-lookup continuations."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=64, decode_chunk=4,
+                kv_layout="paged", speculative_drafts=4,
+            )
+        )
+        try:
+            from langstream_tpu.serving.engine import _Request
+
+            def fake_request(prompt):
+                return _Request(
+                    prompt_tokens=prompt, max_tokens=8, temperature=0.0,
+                    top_k=0, top_p=1.0, on_token=None, future=None,
+                )
+
+            # repeated bigram (1,2): the continuation [3,1,2] drafts 3 real
+            # tokens, padded to 4
+            engine.slots[0].request = fake_request([1, 2, 3, 1, 2])
+            drafts, n_real = engine._draft_tokens(0, 4)
+            assert drafts == [3, 1, 2, 0]
+            assert n_real == 3
+            # no bigram repeats: zero real drafts, all padding
+            engine.slots[1].request = fake_request([5, 6, 7, 8])
+            drafts, n_real = engine._draft_tokens(1, 4)
+            assert drafts == [0, 0, 0, 0]
+            assert n_real == 0
+            engine.slots[0].request = None
+            engine.slots[1].request = None
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# pod /flight endpoints
+# --------------------------------------------------------------------------
+
+
+def test_pod_serves_flight_and_summary(run_async, monkeypatch):
+    from langstream_tpu.runtime.pod import _serve_info
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        # get_or_create registers the engine in the instance map the
+        # /flight endpoint reports (direct construction stays private)
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(model="tiny", slots=2, max_seq_len=64, decode_chunk=4)
+        )
+        port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(port))
+        server = await _serve_info(None)
+        try:
+            await engine.generate("pod flight probe", {"max-tokens": 4})
+            async with aiohttp.ClientSession() as session:
+                base = f"http://127.0.0.1:{port}"
+                async with session.get(f"{base}/flight") as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"] == "application/json"
+                    report = await resp.json()
+                entry = next(e for e in report if e["model"] == "tiny")
+                assert entry["samples"], "full report carries samples"
+                assert entry["events"], "…and the event tail"
+                assert entry["summary"]["totals"]["steps_by_phase"]
+                async with session.get(f"{base}/flight/summary") as resp:
+                    assert resp.status == 200
+                    summaries = await resp.json()
+                entry = next(e for e in summaries if e["model"] == "tiny")
+                assert "samples" not in entry  # rollups only
+                assert entry["summary"]["totals"]["wall_ms"] > 0
+        finally:
+            server.close()
+            await engine.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# control-plane fan-in e2e over the memory broker
+# --------------------------------------------------------------------------
+
+PIPELINE = """
+topics:
+  - name: "input-topic"
+    creation-mode: create-if-not-exists
+  - name: "output-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "chat"
+    id: "chat"
+    type: "ai-chat-completions"
+    input: "input-topic"
+    output: "output-topic"
+    configuration:
+      completion-field: "value.answer"
+      max-tokens: 8
+      messages:
+        - role: user
+          content: "{{ value.q }}"
+"""
+
+# a real (tiny) TPU engine behind the agent — without the resource the
+# agent resolves the mock provider and no flight recorder exists
+CONFIGURATION = """
+configuration:
+  resources:
+    - type: "tpu-serving-configuration"
+      name: "tpu"
+      configuration:
+        model: "tiny"
+        slots: 2
+        max-seq-len: 128
+        decode-chunk: 4
+"""
+
+GATEWAYS = """
+gateways:
+  - id: "produce-input"
+    type: produce
+    topic: "input-topic"
+    parameters: [sessionId]
+    produce-options:
+      headers:
+        - key: "langstream-client-session-id"
+          value-from-parameters: sessionId
+  - id: "consume-output"
+    type: consume
+    topic: "output-topic"
+    parameters: [sessionId]
+    consume-options:
+      filters:
+        headers:
+          - key: "langstream-client-session-id"
+            value-from-parameters: sessionId
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+"""
+
+
+def test_e2e_flight_via_pod_and_controlplane(run_async, monkeypatch):
+    """Gateway → ai-chat-completions over the memory broker, then the same
+    flight data from the pod endpoint and the control-plane fan-in route
+    (the ``test_tracing.py`` e2e shape, pointed at /flight)."""
+    from langstream_tpu.controlplane.server import (
+        ControlPlaneServer,
+        LocalComputeRuntime,
+    )
+    from langstream_tpu.controlplane.stores import InMemoryApplicationStore
+    from langstream_tpu.gateway.server import GatewayRegistry, GatewayServer
+    from langstream_tpu.runtime.pod import _serve_info
+
+    async def main():
+        registry = GatewayRegistry()
+        compute = LocalComputeRuntime(gateway_registry=registry)
+        control = ControlPlaneServer(
+            store=InMemoryApplicationStore(), compute=compute, port=free_port()
+        )
+        gateway = GatewayServer(registry=registry, port=free_port())
+        pod_port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(pod_port))
+        await control.start()
+        await gateway.start()
+        pod_server = await _serve_info(None)
+        session = aiohttp.ClientSession()
+        try:
+            api = f"http://127.0.0.1:{control.port}"
+            async with session.put(f"{api}/api/tenants/t1") as resp:
+                assert resp.status == 200
+            payload = {
+                "files": {
+                    "pipeline.yaml": PIPELINE,
+                    "configuration.yaml": CONFIGURATION,
+                    "gateways.yaml": GATEWAYS,
+                },
+                "instance": INSTANCE,
+            }
+            async with session.post(
+                f"{api}/api/applications/t1/flightapp", json=payload
+            ) as resp:
+                body = await resp.json()
+                assert resp.status == 200, body
+
+            ws_base = f"ws://127.0.0.1:{gateway.port}"
+            consume_url = (
+                f"{ws_base}/v1/consume/t1/flightapp/consume-output"
+                "?param:sessionId=s1&option:position=earliest"
+            )
+            produce_url = (
+                f"{ws_base}/v1/produce/t1/flightapp/produce-input"
+                "?param:sessionId=s1"
+            )
+            async with session.ws_connect(consume_url) as consumer:
+                async with session.ws_connect(produce_url) as producer:
+                    await producer.send_json({"value": {"q": "hello flight"}})
+                    ack = await producer.receive_json()
+                    assert ack["status"] == "OK"
+                push = await asyncio.wait_for(
+                    consumer.receive_json(), timeout=30
+                )
+            assert push["record"]["value"]["answer"]
+
+            # the pod endpoint serves the engine that just ran
+            pod_base = f"http://127.0.0.1:{pod_port}"
+            async with session.get(f"{pod_base}/flight") as resp:
+                assert resp.status == 200
+                pod_report = await resp.json()
+            assert pod_report, "a live engine must be reported"
+            assert any(
+                e["summary"]["totals"]["tokens"] > 0 for e in pod_report
+            )
+
+            # ... and the control-plane route fans in the same engines
+            async with session.get(
+                f"{api}/api/applications/t1/flightapp/flight"
+            ) as resp:
+                assert resp.status == 200
+                cp_report = await resp.json()
+            assert {e["model"] for e in cp_report} == {
+                e["model"] for e in pod_report
+            }
+            entry = cp_report[0]
+            assert entry["summary"]["totals"]["steps_by_phase"]
+            assert "samples" in entry  # dev-mode fan-in carries the window
+
+            # an app this control plane never deployed reports nothing
+            async with session.get(
+                f"{api}/api/applications/t1/ghost/flight"
+            ) as resp:
+                assert resp.status == 200
+                assert await resp.json() == []
+        finally:
+            await session.close()
+            pod_server.close()
+            await gateway.stop()
+            await control.stop()
+            await _close_engines()
+
+    run_async(main())
+
+
+def test_dev_flight_scoped_to_declared_models(monkeypatch):
+    """Dev-mode engines are process-global: an app's flight route must
+    only show the models its own serving resources declare (a sibling
+    tenant's engine telemetry must not leak), and an app with no TPU
+    resource (mock provider) sees nothing."""
+    import langstream_tpu.serving.engine as engine_mod
+    from langstream_tpu.controlplane.server import LocalComputeRuntime
+
+    monkeypatch.setattr(
+        engine_mod,
+        "flight_report",
+        lambda **kw: [
+            {"model": "tiny", "summary": {}},
+            {"model": "llama-1b", "summary": {}},
+        ],
+    )
+
+    class _Resource:
+        def __init__(self, rtype, configuration):
+            self.type = rtype
+            self.configuration = configuration
+
+    def runner_with(resources):
+        class _App:
+            pass
+
+        class _Runner:
+            pass
+
+        _Runner.application = _App()
+        _Runner.application.resources = resources
+        return _Runner()
+
+    compute = LocalComputeRuntime()
+    compute.runners[("t", "app")] = runner_with(
+        {"tpu": _Resource("tpu-serving-configuration", {"model": "tiny"})}
+    )
+    compute.runners[("t", "plain")] = runner_with({})
+    assert [e["model"] for e in compute.flight("t", "app")] == ["tiny"]
+    assert compute.flight("t", "plain") == []
+    assert compute.flight("t", "ghost") == []
+
+
+def test_k8s_flight_fanin_tags_pods():
+    """The k8s compute runtime concatenates per-pod /flight entries and
+    tags each with its pod (engines don't merge across pods the way trace
+    rollups do)."""
+    from langstream_tpu.k8s.compute import KubernetesComputeRuntime
+
+    class _Stub:
+        def _pod_json_fanin(self, tenant, name, path):
+            assert path == "/flight"
+            return [
+                ("app-chat-0", [{"model": "tiny", "summary": {}}]),
+                ("app-chat-1", [{"model": "tiny", "summary": {}}, "junk"]),
+                ("app-chat-2", []),
+            ]
+
+    report = KubernetesComputeRuntime.flight(_Stub(), "t", "app")
+    assert [e["pod"] for e in report] == ["app-chat-0", "app-chat-1"]
+    assert all(e["model"] == "tiny" for e in report)
+
+
+# --------------------------------------------------------------------------
+# engine_top: render + --analyze golden on a canned dump
+# --------------------------------------------------------------------------
+
+
+def _canned_entry() -> dict:
+    return {
+        "model": "llama3-8b",
+        "slots": 64,
+        "summary": {
+            "capacity": 4096,
+            "recorded": 120,
+            "dropped": 0,
+            "totals": {
+                "wall_ms": 4800.0,
+                "device_ms": 2952.0,
+                "host_ms": 1608.0,
+                "stall_ms": 240.0,
+                "tokens": 7680,
+                "steps_by_phase": {"decode": 110, "prefill": 10},
+                "stall_s_by_reason": {
+                    "no-kv-blocks": 0.18,
+                    "queue-empty": 0.06,
+                },
+                "recompiles": 4,
+                "events_by_type": {"recompile": 4, "pool-grow": 7},
+                "spec_accepted": 0,
+                "spec_rejected": 0,
+            },
+            "window": {
+                "samples": 120,
+                "span_s": 4.8,
+                "tokens": 7680,
+                "tok_s": 1600.0,
+                "step_ms_p50": 40.0,
+                "step_ms_p95": 66.0,
+                "host_overhead_ms_p50": 13.4,
+                "device_ms_p50": 24.6,
+                "queue_depth_p95": 9,
+                "occupancy_mean": 61.5,
+                "kv_used_ratio_last": 0.97,
+            },
+        },
+        "samples": [
+            {
+                "seq": i, "t_ms": 1000.0 + 40.0 * i, "phase": "decode",
+                "wall_ms": 40.0, "device_ms": 24.6, "host_ms": 15.4,
+                "occupancy": 60, "slots": 64, "tokens": 64,
+                "queue_depth": 1 + i // 10, "stall": None, "kv_used": 0.97,
+                "prefix_hits": 0,
+            }
+            for i in range(120)
+        ],
+        "events": [
+            {"seq": 3, "t_ms": 1100.0, "kind": "recompile", "what": "decode"},
+            {"seq": 4, "t_ms": 1600.0, "kind": "recompile", "what": "decode"},
+            {"seq": 5, "t_ms": 2100.0, "kind": "recompile", "what": "prefill"},
+            {"seq": 9, "t_ms": 3000.0, "kind": "pool-grow", "slots": 4},
+        ],
+    }
+
+
+def test_engine_top_analyze_golden(capsys, tmp_path):
+    engine_top = _load_engine_top()
+    text = engine_top.analyze([_canned_entry()])
+    # decomposition: the three components with their shares
+    assert "device  61.5%" in text
+    assert "host    33.5%" in text
+    assert "stall    5.0%" in text
+    # mean step = busy wall (wall − stall) / steps: (4800−240)/120
+    assert "mean step 38.0ms" in text
+    assert "stall[no-kv-blocks] 0.18s" in text
+    # anomaly windows: compiles clustered within 2 s + pool pressure
+    assert "recompile storm" in text
+    assert "KV pool" in text
+    # queue depth grows 1 → 12 across the canned window
+    assert "queue growth" in text
+
+    # the CLI path: same analysis from a file, exit 0
+    dump = tmp_path / "dump.json"
+    dump.write_text(json.dumps([_canned_entry()]))
+    assert engine_top.main(["--analyze", str(dump)]) == 0
+    assert "device  61.5%" in capsys.readouterr().out
+
+
+def test_engine_top_analyze_accepts_bench_record():
+    """A bench JSON whose detail carries the flight rollup (no raw
+    samples) still decomposes without error."""
+    engine_top = _load_engine_top()
+    record = {
+        "metric": "tok/s/chip",
+        "value": 1600.0,
+        "detail": {
+            "paged": {
+                "tok_s": 1600.0,
+                "flight": {
+                    "host_overhead_ms_p50": 13.4,
+                    "stall_s_by_reason": {"no-free-slot": 2.0},
+                    "queue_depth_p95": 30,
+                    "recompile_count": 2,
+                    "totals": {
+                        "wall_ms": 10000.0,
+                        "device_ms": 6000.0,
+                        "host_ms": 3000.0,
+                        "stall_ms": 1000.0,
+                        "tokens": 30000,
+                        "steps_by_phase": {"decode": 200},
+                    },
+                },
+            }
+        },
+    }
+    text = engine_top.analyze(record)
+    assert "device  60.0%" in text
+    assert "host    30.0%" in text
+    assert "stall   10.0%" in text
+    assert "stall[no-free-slot] 2.00s" in text
+
+    with pytest.raises(ValueError):
+        engine_top.analyze({"no": "flight here"})
+
+
+def test_engine_top_render_smoke():
+    engine_top = _load_engine_top()
+    frame = engine_top.render([_canned_entry()])
+    assert "engine llama3-8b" in frame
+    assert "60/64" in frame          # occupancy
+    assert "tok/s 1600.0" in frame
+    assert "recompiles 4" in frame
+    assert "kv pool" in frame
+    # empty report renders a hint, not a crash
+    assert "no live engines" in engine_top.render([])

@@ -589,6 +589,133 @@ def test_obs501_suppressed_wall_clock_timestamp():
 
 
 # --------------------------------------------------------------------------
+# OBS502 — threading lock held across await in serving/
+# --------------------------------------------------------------------------
+
+
+def test_obs502_tp_sync_lock_held_across_await():
+    ids = rule_ids(
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+
+        async def record(buffer, item):
+            with _LOCK:
+                await buffer.put(item)
+        """
+    )
+    assert ids == ["OBS502"]
+
+
+def test_obs502_tn_asyncio_lock_and_lock_released_before_await():
+    # async with on an asyncio.Lock is loop-native; a sync lock released
+    # before the await never blocks the loop inside it
+    ids = rule_ids(
+        """
+        import asyncio
+
+        _ALOCK = asyncio.Lock()
+
+        async def record(buffer, item, sync_lock):
+            async with _ALOCK:
+                await buffer.put(item)
+            with sync_lock:
+                buffer.count += 1
+            await buffer.flush()
+        """
+    )
+    assert ids == []
+
+
+def test_obs502_tn_await_in_nested_def_not_held():
+    # the nested coroutine's await runs when IT is awaited, not under the
+    # enclosing with
+    ids = rule_ids(
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+
+        async def record(buffer, item):
+            with _LOCK:
+                async def later():
+                    await buffer.put(item)
+                buffer.pending = later
+        """
+    )
+    assert ids == []
+
+
+def test_obs502_tn_outside_serving():
+    ids = rule_ids(
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+
+        async def record(buffer, item):
+            with _LOCK:
+                await buffer.put(item)
+        """,
+        path="langstream_tpu/controlplane/server.py",
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
+# OBS503 — blocking I/O in engine hot loops / the flight recorder
+# --------------------------------------------------------------------------
+
+
+def test_obs503_tp_file_io_in_hot_loop_method():
+    ids = rule_ids(
+        """
+        class Engine:
+            def _flight_record(self, sample):
+                with open("/tmp/flight.log", "a") as f:
+                    f.write(str(sample))
+        """
+    )
+    assert ids == ["OBS503"]
+
+
+def test_obs503_tp_any_function_in_flight_module_is_hot():
+    ids = rule_ids(
+        """
+        def sample(ring, entry):
+            print(entry)
+            ring.append(entry)
+        """,
+        path="langstream_tpu/serving/flight.py",
+    )
+    assert ids == ["OBS503"]
+
+
+def test_obs503_tn_append_only_recording_and_cold_paths():
+    # in-memory appends in hot methods are the sanctioned pattern, the
+    # same I/O in a non-hot method doesn't fire, and nested dispatch
+    # closures (executor-thread bodies) are exempt
+    ids = rule_ids(
+        """
+        class Engine:
+            def _flight_record(self, sample):
+                self.ring.append(sample)
+
+            def dump_debug(self, sample):
+                with open("/tmp/debug.json", "w") as f:
+                    f.write(str(sample))
+
+            async def _decode_burst(self, loop):
+                def _run():
+                    print("dispatch-thread logging is the executor's business")
+                await loop.run_in_executor(None, _run)
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
 # suppressions + GC000
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,381 @@
+#!/usr/bin/env python3
+"""engine top: live flight-recorder console + post-mortem analyzer.
+
+Live mode polls a pod's ``/flight`` endpoint (or the control plane's
+``/api/applications/{tenant}/{name}/flight`` fan-in — any URL returning the
+flight report shape works) and renders a one-screen view per engine:
+occupancy bar, tok/s, a step-time sparkline, the device/host/stall
+decomposition, admission-stall breakdown by reason, KV-pool utilization,
+and the discrete-event tail (recompiles, pool growth, warmup, preemptions).
+
+    python tools/engine_top.py                          # localhost:8080
+    python tools/engine_top.py --url http://pod:8080/flight --interval 2
+    python tools/engine_top.py --once                   # one frame, no clear
+
+Post-mortem mode decomposes a saved dump — either a raw ``/flight``
+payload (``curl pod:8080/flight > dump.json``) or a bench record whose
+``flight`` rollup rode along (BENCH_r06+) — into mean-step device/host/
+stall shares and flags anomaly windows: recompile storms, KV-pool
+exhaustion, and unbounded queue growth.
+
+    python tools/engine_top.py --analyze dump.json
+    python tools/engine_top.py --analyze BENCH_r06.json
+
+Zero dependencies (stdlib only), plain-refresh rendering (ANSI clear) so
+it works over any terminal a pod exec gives you.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _bar(frac: float | None, width: int = 24) -> str:
+    frac = min(max(frac or 0.0, 0.0), 1.0)
+    n = int(round(frac * width))
+    return "█" * n + "·" * (width - n)
+
+
+def _spark(values, width: int = 48) -> str:
+    vals = [v for v in list(values)[-width:] if v is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    top = len(SPARK) - 1
+    return "".join(SPARK[min(top, int((v - lo) / span * top))] for v in vals)
+
+
+def _fmt_ms(ms) -> str:
+    if ms is None:
+        return "-"
+    if ms >= 10_000:
+        return f"{ms / 1000:.1f}s"
+    return f"{ms:.1f}ms"
+
+
+def _shares(totals: dict) -> tuple[float, float, float, float]:
+    """(wall_ms, device%, host%, stall%) from a totals dict."""
+    device = totals.get("device_ms") or 0.0
+    host = totals.get("host_ms") or 0.0
+    stall = totals.get("stall_ms") or 0.0
+    wall = totals.get("wall_ms") or (device + host + stall)
+    denom = wall or 1.0
+    return wall, 100 * device / denom, 100 * host / denom, 100 * stall / denom
+
+
+# ---------------------------------------------------------------------------
+# live rendering
+# ---------------------------------------------------------------------------
+
+
+def render(report: list[dict]) -> str:
+    lines: list[str] = []
+    if not report:
+        return "no live engines (has the first request arrived yet?)"
+    for entry in report:
+        summary = entry.get("summary", {})
+        totals = summary.get("totals", {})
+        window = summary.get("window", {})
+        samples = entry.get("samples") or []
+        dispatch = [s for s in samples if s.get("phase") != "stall"]
+        slots = entry.get("slots") or (samples[-1]["slots"] if samples else 0)
+        occupancy = samples[-1]["occupancy"] if samples else 0
+        queue_depth = samples[-1]["queue_depth"] if samples else 0
+        pod = f" @ {entry['pod']}" if entry.get("pod") else ""
+        lines.append(f"== engine {entry.get('model', '?')}{pod} ==")
+        lines.append(
+            f"slots    [{_bar(occupancy / slots if slots else 0)}] "
+            f"{occupancy}/{slots}   queue {queue_depth}   "
+            f"tok/s {window.get('tok_s') if window.get('tok_s') is not None else '-'}"
+        )
+        lines.append(
+            f"step     p50 {_fmt_ms(window.get('step_ms_p50'))}  "
+            f"p95 {_fmt_ms(window.get('step_ms_p95'))}  "
+            f"host-overhead p50 {_fmt_ms(window.get('host_overhead_ms_p50'))}  "
+            f"device p50 {_fmt_ms(window.get('device_ms_p50'))}"
+        )
+        wall, device_pct, host_pct, stall_pct = _shares(totals)
+        lines.append(
+            f"decomp   device {device_pct:.1f}%  host {host_pct:.1f}%  "
+            f"stall {stall_pct:.1f}%  (of {_fmt_ms(wall)} recorded wall)"
+        )
+        for label, by_reason in (
+            ("stalls", totals.get("stall_s_by_reason")),
+            ("blocked", totals.get("blocked_s_by_reason")),
+        ):
+            if by_reason:
+                breakdown = "  ".join(
+                    f"{reason} {seconds:.2f}s"
+                    for reason, seconds in sorted(
+                        by_reason.items(), key=lambda kv: -kv[1]
+                    )
+                )
+                lines.append(f"{label:8s} {breakdown}")
+        kv_used = window.get("kv_used_ratio_last")
+        if kv_used is not None:
+            lines.append(f"kv pool  [{_bar(kv_used)}] {100 * kv_used:.1f}% used")
+        spec_acc = totals.get("spec_accepted") or 0
+        spec_rej = totals.get("spec_rejected") or 0
+        if spec_acc or spec_rej:
+            drafted = spec_acc + spec_rej
+            lines.append(
+                f"spec     accepted {spec_acc}/{drafted} "
+                f"({100 * spec_acc / drafted:.1f}%)"
+            )
+        if dispatch:
+            lines.append(
+                f"step ms  {_spark([s['wall_ms'] for s in dispatch])}"
+            )
+        lines.append(
+            f"steps    {totals.get('steps_by_phase')}   "
+            f"recompiles {totals.get('recompiles', 0)}   "
+            f"samples {summary.get('recorded', 0)} "
+            f"(dropped {summary.get('dropped', 0)})"
+        )
+        events = entry.get("events") or []
+        for event in events[-6:]:
+            detail = {
+                k: v
+                for k, v in event.items()
+                if k not in ("kind", "t_ms", "seq")
+            }
+            lines.append(f"event    {event.get('kind')} {detail}")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+# ---------------------------------------------------------------------------
+# post-mortem analysis
+# ---------------------------------------------------------------------------
+
+
+def _collect_flight_dicts(obj, found: list[dict], label: str = "") -> None:
+    """Recursively find anything flight-shaped: full report entries (have
+    ``summary.totals``) or bare bench rollups (have ``totals`` with a
+    device/host split)."""
+    if isinstance(obj, dict):
+        totals = (obj.get("summary") or {}).get("totals") or obj.get("totals")
+        if isinstance(totals, dict) and "device_ms" in totals:
+            found.append({"label": label or obj.get("model", ""), "src": obj})
+            return
+        for key, value in obj.items():
+            _collect_flight_dicts(
+                value, found, f"{label}.{key}" if label else str(key)
+            )
+    elif isinstance(obj, list):
+        for i, value in enumerate(obj):
+            _collect_flight_dicts(value, found, f"{label}[{i}]")
+
+
+def _anomalies(entry: dict) -> list[str]:
+    flags: list[str] = []
+    summary = entry.get("summary") or entry
+    totals = summary.get("totals") or {}
+    samples = entry.get("samples") or []
+    events = entry.get("events") or []
+    # bench rollups carry these at the top level, full reports inside
+    # totals — accept both
+    for key in ("stall_s_by_reason", "blocked_s_by_reason"):
+        fallback = entry.get(key)
+        if key not in totals and isinstance(fallback, dict):
+            totals = {**totals, key: fallback}
+    if "recompiles" not in totals and entry.get("recompile_count") is not None:
+        totals = {**totals, "recompiles": entry["recompile_count"]}
+    # recompile storm: compiles clustered in time (each is a potential
+    # multi-second convoy on TPU) — needs the event tail; fall back to a
+    # count heuristic when only rollups survived
+    recompile_ts = sorted(
+        e["t_ms"] for e in events if e.get("kind") == "recompile"
+    )
+    for i in range(len(recompile_ts) - 2):
+        if recompile_ts[i + 2] - recompile_ts[i] <= 2000.0:
+            flags.append(
+                "recompile storm: >=3 compiles within 2s — check for "
+                "unbounded shape variety (prompt buckets, sampler modes)"
+            )
+            break
+    else:
+        steps = sum((totals.get("steps_by_phase") or {}).values())
+        recompiles = totals.get("recompiles", 0)
+        if steps and recompiles > max(8, steps // 4):
+            flags.append(
+                f"recompile-heavy run: {recompiles} compiles over {steps} "
+                f"steps"
+            )
+    # pool pressure shows up as engine stall OR as blocked admission
+    # while decode keeps running — either way it's the same fix. Floored
+    # so a single transient blip doesn't tell the operator to resize a
+    # healthy pool: flag only when a material share of the recorded wall
+    # was pool-blocked
+    pool_s = (totals.get("stall_s_by_reason") or {}).get(
+        "no-kv-blocks", 0.0
+    ) + (totals.get("blocked_s_by_reason") or {}).get("no-kv-blocks", 0.0)
+    wall_s = (totals.get("wall_ms") or 0.0) / 1000.0
+    if pool_s > max(0.5, 0.02 * wall_s):
+        flags.append(
+            f"KV pool exhaustion: {pool_s:.2f}s of admission blocked on "
+            f"no-kv-blocks — grow kv-pool-blocks/kv-pool-fraction or "
+            f"lower max-tokens"
+        )
+    if samples:
+        kv_hot = sum(
+            1 for s in samples if (s.get("kv_used") or 0.0) > 0.95
+        )
+        if kv_hot > len(samples) // 4:
+            flags.append(
+                f"KV pool near capacity in {kv_hot}/{len(samples)} samples"
+            )
+        quarter = max(1, len(samples) // 4)
+        head = samples[:quarter]
+        tail = samples[-quarter:]
+        head_q = sum(s.get("queue_depth", 0) for s in head) / len(head)
+        tail_q = sum(s.get("queue_depth", 0) for s in tail) / len(tail)
+        if tail_q > max(2.0, 2.0 * head_q):
+            flags.append(
+                f"queue growth: depth {head_q:.1f} -> {tail_q:.1f} across "
+                f"the window — arrival rate exceeds service rate"
+            )
+    return flags
+
+
+def analyze(dump) -> str:
+    """Decompose a flight dump (raw /flight payload, control-plane fan-in,
+    or a bench record carrying the ``flight`` rollup) into per-engine mean-
+    step device/host/stall shares plus anomaly flags."""
+    found: list[dict] = []
+    _collect_flight_dicts(dump, found)
+    if not found:
+        raise ValueError(
+            "no flight data found in the dump (expected a /flight payload "
+            "or a bench record with a 'flight' rollup)"
+        )
+    lines: list[str] = []
+    for item in found:
+        entry = item["src"]
+        summary = entry.get("summary") or entry
+        totals = summary.get("totals") or {}
+        label = entry.get("model") or item["label"] or "engine"
+        pod = f" @ {entry['pod']}" if entry.get("pod") else ""
+        wall, device_pct, host_pct, stall_pct = _shares(totals)
+        steps = sum((totals.get("steps_by_phase") or {}).values())
+        # mean step excludes idle/stall gaps: a mostly-idle deploy's hour
+        # of queue-empty waits must not inflate its 40 ms decode steps
+        busy_ms = wall - (totals.get("stall_ms") or 0.0)
+        mean_step = busy_ms / steps if steps else 0.0
+        lines.append(f"== {label}{pod} ==")
+        lines.append(
+            f"recorded wall {_fmt_ms(wall)} over {steps} dispatched steps "
+            f"(mean step {_fmt_ms(mean_step)})"
+        )
+        lines.append(
+            f"  device {device_pct:5.1f}%  "
+            f"({_fmt_ms(totals.get('device_ms'))})"
+        )
+        lines.append(
+            f"  host   {host_pct:5.1f}%  ({_fmt_ms(totals.get('host_ms'))})"
+        )
+        lines.append(
+            f"  stall  {stall_pct:5.1f}%  ({_fmt_ms(totals.get('stall_ms'))})"
+        )
+        for label, by_reason in (
+            ("stall", totals.get("stall_s_by_reason")
+                or entry.get("stall_s_by_reason")),
+            ("blocked", totals.get("blocked_s_by_reason")
+                or entry.get("blocked_s_by_reason")),
+        ):
+            for reason, seconds in sorted(
+                (by_reason or {}).items(), key=lambda kv: -kv[1]
+            ):
+                lines.append(f"    {label}[{reason}] {seconds:.2f}s")
+        if totals.get("tokens"):
+            lines.append(f"  tokens {totals['tokens']}")
+        rollup_keys = {
+            k: entry.get(k)
+            for k in (
+                "host_overhead_ms_p50",
+                "queue_depth_p95",
+                "recompile_count",
+            )
+            if entry.get(k) is not None
+        }
+        if rollup_keys:
+            lines.append(f"  rollup {rollup_keys}")
+        flags = _anomalies(entry)
+        for flag in flags:
+            lines.append(f"  !! {flag}")
+        if not flags:
+            lines.append("  no anomaly windows flagged")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def _fetch(url: str, timeout: float = 5.0) -> list[dict]:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        payload = json.loads(resp.read())
+    return payload if isinstance(payload, list) else []
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live engine flight-recorder console / dump analyzer"
+    )
+    parser.add_argument(
+        "--url",
+        default="http://127.0.0.1:8080/flight",
+        help="pod /flight endpoint (or control-plane flight fan-in URL)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0, help="poll interval seconds"
+    )
+    parser.add_argument(
+        "--once", action="store_true", help="print one frame and exit"
+    )
+    parser.add_argument(
+        "--analyze",
+        metavar="DUMP_JSON",
+        help="post-mortem: decompose a saved /flight payload or bench record",
+    )
+    args = parser.parse_args(argv)
+
+    if args.analyze:
+        try:
+            with open(args.analyze) as f:
+                dump = json.load(f)
+            print(analyze(dump))
+        except (OSError, ValueError) as e:
+            print(f"analyze failed: {e}", file=sys.stderr)
+            return 2
+        return 0
+
+    try:
+        while True:
+            try:
+                frame = render(_fetch(args.url))
+            except (OSError, ValueError) as e:
+                frame = f"fetch {args.url} failed: {e}"
+            if args.once:
+                print(frame)
+                return 0
+            # plain-refresh: clear + home, then the frame (works over any
+            # pod-exec terminal; no curses dependency)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -277,7 +277,28 @@ async def run_gateway_bench(
                     "hbm_utilization": round(
                         roofline.utilization(achieved_ms), 4
                     ),
+                    # which roof: detected generation + physical HBM (null
+                    # off-TPU or when the plugin hides memory stats)
+                    "hbm_generation": roofline.generation,
+                    "hbm_bytes": roofline.hbm_bytes,
                 })
+        # flight-recorder rollup: attributes the TTFT gap — was the engine
+        # stalled (and why), paying host overhead, or convoyed behind a
+        # recompile — so BENCH can name the component instead of re-guessing
+        if engines:
+            from langstream_tpu.serving.flight import bench_rollup
+
+            # the engine this bench configured; fall back to the first
+            # live one, and record when other engines were present so a
+            # single-engine rollup is never mistaken for the whole process
+            chat_engine = next(
+                (e for e in engines if e.config.model == serving.get("model")),
+                engines[0],
+            )
+            out["flight"] = bench_rollup(chat_engine.flight.summary())
+            if len(engines) > 1:
+                out["flight"]["engines_observed"] = len(engines)
+                out["flight"]["model"] = chat_engine.config.model
         return out
     finally:
         await session.close()
