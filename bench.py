@@ -46,11 +46,18 @@ Phases (BASELINE.md targets: >= 2000 tok/s/chip, p50 gateway TTFT < 200ms):
 4. **Speculative decode** on a context-copying workload: uplift vs off.
 5. **Prefix-cache TTFT**: cold vs warm TTFT for requests sharing a long
    preamble (paged layout; warm requests adopt cached prefix blocks).
+6. **QoS mix** (`--qos-mix` scenario, BENCH_QOS=0 skips): one batch
+   tenant flooding the engine at saturating load while an interactive
+   tenant trickles requests through the WDRR scheduler — records
+   per-class TTFT/throughput plus shed/preempt counts next to the
+   flight rollup keys, the number that shows whether priority admission
+   actually bounds interactive latency under contention.
 
 Env knobs: BENCH_MODEL (tiny|llama-1b|llama3-8b|...), BENCH_SLOTS,
 BENCH_DECODE_CHUNK, BENCH_QUANTIZE (int8|none), BENCH_KV (dense|paged),
 BENCH_KV_QUANT (int8|none), BENCH_GATEWAY=0 / BENCH_PAGED=0 /
-BENCH_PREFIX=0 / BENCH_KV_INT8=0 / BENCH_SPEC=0 to skip phases.
+BENCH_PREFIX=0 / BENCH_KV_INT8=0 / BENCH_SPEC=0 / BENCH_QOS=0 to skip
+phases.
 
 Offline note: weights are random-init (no checkpoint files in this
 environment) — identical FLOPs/bytes to trained weights, so throughput is
@@ -122,6 +129,7 @@ RUN_PAGED = os.environ.get("BENCH_PAGED", "1") != "0"
 RUN_PREFIX = os.environ.get("BENCH_PREFIX", "1") != "0"
 RUN_KV_INT8 = os.environ.get("BENCH_KV_INT8", "1") != "0"
 RUN_SPEC = os.environ.get("BENCH_SPEC", "1") != "0"
+RUN_QOS = os.environ.get("BENCH_QOS", "1") != "0"
 DEGRADED = os.environ.get("BENCH_DEGRADED") == "1"
 
 PROMPT = "Benchmarking the TPU serving engine end to end. " * 4
@@ -300,6 +308,7 @@ def _run_degraded_cpu_pass(budget_s: float) -> dict:
         BENCH_PREFIX="0",
         BENCH_KV_INT8="0",
         BENCH_SPEC="0",
+        BENCH_QOS="0",
         BENCH_GATEWAY="1",
         BENCH_TOTAL_TIMEOUT_S=str(max(int(budget_s) - 30, 60)),
         BENCH_PHASE_TIMEOUT_S="180",
@@ -481,6 +490,9 @@ def run_bench() -> dict:
     # context-copying workload: the regime where prompt-lookup speculation
     # must EARN its number (uplift > 1x), not just exist
     optional("speculative", RUN_SPEC)
+    # --qos-mix: batch tenant floods, interactive tenant trickles; records
+    # per-class TTFT + shed/preempt counts under the WDRR scheduler
+    optional("qos_mix", RUN_QOS)
     # detail key kept from rounds 1-4 ("prefix_cache") for record tooling
     optional("prefix", RUN_PREFIX, detail_key="prefix_cache",
              budget_cap=min(PHASE_BUDGET_S, 300))
@@ -765,6 +777,109 @@ async def run_speculative_phase() -> dict:
     }
 
 
+async def run_qos_mix_phase() -> dict:
+    """The ``--qos-mix`` scenario: one batch tenant flooding at saturating
+    load while an interactive tenant trickles closed-loop requests through
+    the WDRR scheduler. Records per-class TTFT/throughput and the
+    scheduler's shed/preempt counters next to the flight rollup — the
+    number that shows whether priority admission bounds interactive
+    latency while batch still receives its guaranteed share."""
+    import dataclasses as _dc
+
+    from langstream_tpu.serving.engine import TpuServingEngine
+    from langstream_tpu.serving.flight import bench_rollup
+    from langstream_tpu.serving.qos import QosSpec
+
+    qos = QosSpec.from_dict(
+        {
+            "classes": {
+                "interactive": {"weight": 8},
+                "batch": {
+                    "weight": 1,
+                    "queue-limit": max(64, BENCH_REQUESTS * 2),
+                },
+            },
+        }
+    )
+    cfg = _dc.replace(_serving_config(KV_LAYOUT or "dense", KV_QUANT), qos=qos)
+    engine = TpuServingEngine.get_or_create(cfg)
+    await asyncio.gather(
+        *(
+            engine.generate(PROMPT, {"max-tokens": MAX_TOKENS})
+            for _ in range(WARMUP_REQUESTS)
+        )
+    )
+
+    batch_n = BENCH_REQUESTS
+    inter_n = max(8, BENCH_REQUESTS // 8)
+    inter_tokens = min(16, MAX_TOKENS)
+    start = time.monotonic()
+    batch_done = asyncio.gather(
+        *(
+            engine.generate(
+                PROMPT,
+                {"max-tokens": MAX_TOKENS, "priority": "batch",
+                 "qos-tenant": "bulk"},
+            )
+            for _ in range(batch_n)
+        )
+    )
+    # closed-loop trickle: one interactive request in flight at a time —
+    # the "low rate" side of the mix, measured while the flood saturates
+    inter_results = []
+    for _ in range(inter_n):
+        inter_results.append(
+            await engine.generate(
+                PROMPT,
+                {"max-tokens": inter_tokens, "priority": "interactive",
+                 "qos-tenant": "live"},
+            )
+        )
+    batch_results = await batch_done
+    elapsed = time.monotonic() - start
+
+    def _pct(results, q: float) -> float:
+        ttfts = sorted(r["ttft"] for r in results)
+        return round(ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))], 4)
+
+    scheduler = engine.stats()["scheduler"]
+    flight = bench_rollup(engine.flight.summary())
+    out = {
+        "elapsed_s": round(elapsed, 2),
+        "interactive": {
+            "requests": inter_n,
+            "ttft_p50_s": _pct(inter_results, 0.50),
+            "ttft_p95_s": _pct(inter_results, 0.95),
+            "tok_s": round(
+                sum(r["num_completion_tokens"] for r in inter_results)
+                / elapsed, 1,
+            ),
+        },
+        "batch": {
+            "requests": batch_n,
+            "ttft_p50_s": _pct(batch_results, 0.50),
+            "ttft_p95_s": _pct(batch_results, 0.95),
+            "tok_s": round(
+                sum(r["num_completion_tokens"] for r in batch_results)
+                / elapsed, 1,
+            ),
+        },
+        "shed": scheduler.get("shed", 0),
+        "preempted": scheduler.get("preempted", 0),
+        "resumed": scheduler.get("resumed", 0),
+        "queue_wait_by_class": {
+            cls: {
+                "p50_s": info.get("queue_wait_p50_s"),
+                "p95_s": info.get("queue_wait_p95_s"),
+            }
+            for cls, info in (scheduler.get("classes") or {}).items()
+        },
+        "flight": flight,
+    }
+    await engine.close()
+    return out
+
+
 async def run_prefix_cache_phase() -> dict:
     """Cold vs warm TTFT with a shared preamble (paged layout).
 
@@ -879,6 +994,8 @@ async def _child_phase(phase: str) -> dict:
         return await _phase(run_gateway_phase())
     if phase == "speculative":
         return await _phase(run_speculative_phase())
+    if phase == "qos_mix":
+        return await _phase(run_qos_mix_phase())
     if phase == "prefix":
         return await _phase(
             run_prefix_cache_phase(), budget_s=min(PHASE_BUDGET_S, 300)

@@ -46,7 +46,7 @@ class _AIAgentBase(SingleRecordProcessor):
             configuration.get("__resources__", {})
         )
 
-    def _options(self) -> dict[str, Any]:
+    def _options(self, record: Record | None = None) -> dict[str, Any]:
         keys = (
             "model",
             "max-tokens",
@@ -57,8 +57,26 @@ class _AIAgentBase(SingleRecordProcessor):
             "presence-penalty",
             "frequency-penalty",
             "logprobs",
+            # pipeline-wide QoS defaults (the record headers below
+            # override per request)
+            "priority",
+            "qos-tenant",
         )
-        return {k: self.configuration[k] for k in keys if k in self.configuration}
+        options = {
+            k: self.configuration[k] for k in keys if k in self.configuration
+        }
+        if record is not None:
+            # the gateway stamped the client's QoS identity onto the
+            # record; forward it so the engine's scheduler sees the same
+            # tenant/priority the gateway throttled on
+            headers = record.header_map()
+            qos_tenant = headers.get("langstream-qos-tenant")
+            if qos_tenant:
+                options["qos-tenant"] = qos_tenant
+            priority = headers.get("langstream-qos-priority")
+            if priority:
+                options["priority"] = priority
+        return options
 
 
 class _StreamWriter:
@@ -149,7 +167,7 @@ class ChatCompletionsAgent(_AIAgentBase):
             consumer = writer.on_chunk
         result = await self.provider.get_completions_service(
             self.configuration
-        ).chat_completions(messages, self._options(), consumer)
+        ).chat_completions(messages, self._options(record), consumer)
 
         completion_field = self.configuration.get("completion-field")
         if completion_field:
@@ -208,7 +226,7 @@ class TextCompletionsAgent(_AIAgentBase):
             consumer = writer.on_chunk
         result = await self.provider.get_completions_service(
             self.configuration
-        ).text_completions(prompt, self._options(), consumer)
+        ).text_completions(prompt, self._options(record), consumer)
         completion_field = self.configuration.get("completion-field", "value")
         if completion_field == "value":
             mutable.value = result.text

@@ -39,6 +39,11 @@ Resource shape (``configuration.yaml``):
                                        # + padded prefill shapes on the first
                                        # request (serving pods want this)
           embeddings-model: "minilm-l6"
+          qos: null                    # multi-tenant QoS scheduler: priority
+                                       # classes (WDRR admission), per-tenant
+                                       # token buckets, preemptive load
+                                       # shedding — docs/SCHEDULING.md; null
+                                       # keeps the FIFO admission queue
 """
 
 from __future__ import annotations

@@ -1,6 +1,6 @@
 """graftcheck: first-party static analysis for the langstream-tpu tree.
 
-Six rule families tuned to this codebase's actual failure modes:
+Seven rule families tuned to this codebase's actual failure modes:
 
 ==========  ==============================================================
 JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
@@ -15,6 +15,8 @@ OBS501-503  observability: wall-clock ``time.time()`` in the
             latency-measured packages (``serving/``, ``runtime/``);
             threading locks held across ``await`` in ``serving/``;
             blocking I/O in the engine hot loops / flight recorder
+QOS601      backpressure: unbounded ``asyncio.Queue()`` in ``serving/``
+            or ``gateway/`` (defeats QoS load shedding)
 ==========  ==============================================================
 
 Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),
@@ -41,6 +43,7 @@ from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
 from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
+from langstream_tpu.analysis.rules_qos import RULES as _QOS_RULES
 from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
 
 ALL_RULES: list[Rule] = [
@@ -49,6 +52,7 @@ ALL_RULES: list[Rule] = [
     *_SEC_RULES,
     *_EXC_RULES,
     *_OBS_RULES,
+    *_QOS_RULES,
 ]
 
 RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,78 @@
+"""QoS/backpressure rules: bounded queues in the serving stack.
+
+QOS601 flags ``asyncio.Queue()`` constructed without a ``maxsize`` in
+``serving/`` and ``gateway/``. An unbounded queue between the gateway and
+the engine defeats the QoS subsystem's whole point: load shedding and
+per-class backpressure only work when every buffer on the admission path
+is bounded — an unbounded queue silently absorbs the overload the
+scheduler was supposed to refuse, converts it into unbounded memory
+growth and unbounded tail latency, and reports a healthy "accepted"
+status to every client. The engine's own admission queue is a bounded
+per-class structure (``serving/scheduler.py``); anything else on these
+paths must either pass an explicit ``maxsize`` or carry a suppression
+explaining why unbounded is safe there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule, call_name
+
+#: packages on the gateway→engine admission path where every queue must
+#: be bounded
+_BACKPRESSURE_PATHS = (
+    "langstream_tpu/serving/",
+    "langstream_tpu/gateway/",
+)
+
+
+def _imports_bare_queue(mod: Module) -> bool:
+    """True when the module does ``from asyncio import Queue`` (so a bare
+    ``Queue()`` call is the asyncio queue)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "asyncio":
+            for alias in node.names:
+                if alias.name == "Queue" and (alias.asname or "Queue") == "Queue":
+                    return True
+    return False
+
+
+def check_unbounded_queue(mod: Module) -> Iterator[Finding]:
+    if not any(p in mod.path for p in _BACKPRESSURE_PATHS):
+        return
+    bare_queue = _imports_bare_queue(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name != "asyncio.Queue" and not (bare_queue and name == "Queue"):
+            continue
+        # maxsize is the first positional or the keyword; either counts
+        # as "the author thought about the bound" (asyncio treats <= 0 as
+        # unbounded, but an explicit 0 is a visible, reviewable choice)
+        has_bound = bool(node.args) or any(
+            kw.arg == "maxsize" for kw in node.keywords
+        )
+        if not has_bound:
+            yield mod.finding(
+                "QOS601",
+                node,
+                "asyncio.Queue() without maxsize on the gateway/engine "
+                "path: an unbounded queue absorbs overload instead of "
+                "shedding it, defeating QoS backpressure — pass an "
+                "explicit maxsize (or suppress with a reason why "
+                "unbounded is safe here)",
+            )
+
+
+RULES = [
+    Rule(
+        id="QOS601",
+        family="qos",
+        summary="unbounded asyncio.Queue() in serving/ or gateway/ "
+        "(defeats QoS backpressure; pass maxsize)",
+        check=check_unbounded_queue,
+    ),
+]

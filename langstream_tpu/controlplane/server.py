@@ -37,6 +37,7 @@ from langstream_tpu.controlplane.stores import (
 from langstream_tpu.core.parser import ModelBuilder
 from langstream_tpu.gateway.auth import validate_gateway_authentication
 from langstream_tpu.gateway.server import GatewayRegistry
+from langstream_tpu.serving.qos import validate_application_qos
 from langstream_tpu.runtime.local_runner import LocalApplicationRunner
 
 log = logging.getLogger(__name__)
@@ -215,6 +216,33 @@ class LocalComputeRuntime:
             if any(svc in agent_ids for svc in summary["services"])
         ]
 
+    def qos(self, tenant: str, name: str) -> dict[str, Any]:
+        """QoS status for the /qos route: the app's declared qos sections
+        plus each live engine's scheduler counters (per-class queued/
+        admitted/shed/preempted, tenant throttles). Reads the same
+        ``stats()["scheduler"]`` section the pod's ``/flight/summary``
+        carries, scoped to the app's declared models like :meth:`flight`
+        — no extra engine surface."""
+        from langstream_tpu.serving.engine import flight_report
+
+        runner = self.runners.get((tenant, name))
+        if runner is None:
+            return {"configured": {}, "engines": []}
+        configured: dict[str, Any] = {}
+        models: set[str] = set()
+        for res_name, res in runner.application.resources.items():
+            if res.type != "tpu-serving-configuration":
+                continue
+            config = res.configuration or {}
+            models.add(config.get("model", "tiny"))
+            configured[res_name] = config.get("qos")
+        engines = [
+            {"model": e["model"], "scheduler": e.get("scheduler")}
+            for e in flight_report(summary_only=True)
+            if e["model"] in models
+        ]
+        return {"configured": configured, "engines": engines}
+
     def flight(self, tenant: str, name: str) -> list[dict[str, Any]]:
         """Engine flight-recorder data for the /flight aggregation route,
         scoped to the models the application's serving resources declare —
@@ -305,6 +333,7 @@ class ControlPlaneServer:
                 web.get(
                     "/api/applications/{tenant}/{name}/flight", self._flight
                 ),
+                web.get("/api/applications/{tenant}/{name}/qos", self._qos),
                 web.get("/api/applications/{tenant}/{name}/code", self._download_code),
                 web.get("/api/applications/{tenant}/{name}/agents", self._agents),
                 # archetypes (parity: ArchetypeResource)
@@ -442,6 +471,7 @@ class ControlPlaneServer:
                 f"{stored.tenant}-{stored.name}", application
             )
             validate_gateway_authentication(application.gateways)
+            validate_application_qos(application)
         except web.HTTPException:
             raise
         except Exception as e:
@@ -463,6 +493,7 @@ class ControlPlaneServer:
                     f"{stored.tenant}-{stored.name}", application
                 )
                 validate_gateway_authentication(application.gateways)
+                validate_application_qos(application)
             except Exception as e:
                 raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         else:
@@ -605,6 +636,17 @@ class ControlPlaneServer:
         tenant = request.match_info["tenant"]
         name = request.match_info["name"]
         report = await asyncio.to_thread(self.compute.flight, tenant, name)
+        return web.json_response(report)
+
+    async def _qos(self, request: web.Request) -> web.Response:
+        """Per-application QoS status: declared policy + live per-class
+        scheduler counters (dev mode reads in-process engines; the k8s
+        runtime fans in the pods' /flight/summary scheduler sections)."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        report = await asyncio.to_thread(self.compute.qos, tenant, name)
         return web.json_response(report)
 
     async def _trace(self, request: web.Request) -> web.Response:

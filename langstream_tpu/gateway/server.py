@@ -38,8 +38,20 @@ from langstream_tpu.gateway.auth import (
     AuthenticationException,
     get_auth_provider,
 )
+from langstream_tpu.serving.qos import (
+    QosSpec,
+    TenantLimiter,
+    normalize_priority,
+)
 
 log = logging.getLogger(__name__)
+
+#: record headers the gateway stamps so downstream AI agents hand the
+#: engine the same QoS identity the gateway throttled on
+QOS_TENANT_HEADER = "langstream-qos-tenant"
+QOS_PRIORITY_HEADER = "langstream-qos-priority"
+#: response header naming the throttled tenant on a 429
+THROTTLED_HEADER = "langstream-throttled"
 
 
 class GatewayRegistry:
@@ -54,14 +66,48 @@ class GatewayRegistry:
     def __init__(self) -> None:
         self._apps: dict[tuple[str, str], Application] = {}
         self._service_uris: dict[tuple[str, str, str], str] = {}
+        # per-app QoS limiter (built lazily from the app's
+        # tpu-serving-configuration resource's qos section; invalidated on
+        # register/unregister so a redeploy picks up new limits)
+        self._qos_limiters: dict[tuple[str, str], TenantLimiter | None] = {}
 
     def register(self, tenant: str, app_id: str, application: Application) -> None:
         self._apps[(tenant, app_id)] = application
+        self._qos_limiters.pop((tenant, app_id), None)
 
     def unregister(self, tenant: str, app_id: str) -> None:
         self._apps.pop((tenant, app_id), None)
+        self._qos_limiters.pop((tenant, app_id), None)
         for key in [k for k in self._service_uris if k[:2] == (tenant, app_id)]:
             del self._service_uris[key]
+
+    def qos_limiter(self, tenant: str, app_id: str) -> TenantLimiter | None:
+        """The app's gateway-side QoS limiter (None when the app declares
+        no enabled qos section). The same :class:`QosSpec` the engine
+        enforces — buckets are enforced at BOTH ends: the gateway sheds
+        before a record ever enters the broker, the engine backstops
+        produce paths that bypass the gateway."""
+        key = (tenant, app_id)
+        if key not in self._qos_limiters:
+            limiter = None
+            app = self._apps.get(key)
+            for res in (getattr(app, "resources", None) or {}).values():
+                if getattr(res, "type", None) != "tpu-serving-configuration":
+                    continue
+                try:
+                    spec = QosSpec.from_dict(
+                        (res.configuration or {}).get("qos")
+                    )
+                except ValueError as e:
+                    # deploy validation rejects malformed specs; a stale
+                    # app that slipped through must not break produce
+                    log.warning("ignoring invalid qos section: %s", e)
+                    continue
+                if spec is not None and spec.enabled:
+                    limiter = TenantLimiter(spec)
+                    break
+            self._qos_limiters[key] = limiter
+        return self._qos_limiters[key]
 
     def register_service_uri(
         self, tenant: str, app_id: str, agent_id: str, uri: str
@@ -138,6 +184,9 @@ class GatewayServer:
             ]
         )
         self._runner: web.AppRunner | None = None
+        # per-QoS-tenant throttle counters (lazily created: tenants are
+        # client identities, unknown until the first 429)
+        self._m_throttled: dict[str, Any] = {}
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)
@@ -255,6 +304,115 @@ class GatewayServer:
         headers[TRACE_HEADER] = span.context().to_header()
         return headers, span
 
+    # ------------------------------------------------------------------
+    # QoS: tenant identity + gateway-side throttling
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _qos_identity(
+        params: dict[str, str], principal: dict[str, Any]
+    ) -> tuple[str, str]:
+        """(qos tenant, priority class) for one client: the authenticated
+        subject is the tenant when auth is on (clients cannot spoof it);
+        an explicit ``param:tenant`` covers unauthenticated dev setups.
+        Priority comes from ``param:priority``, clamped to a known class."""
+        tenant = str(
+            principal.get("subject") or params.get("tenant") or "anonymous"
+        )
+        return tenant, normalize_priority(params.get("priority"))
+
+    def _qos_headers(
+        self,
+        limiter: TenantLimiter | None,
+        params: dict[str, str],
+        principal: dict[str, Any],
+    ) -> dict[str, str]:
+        """Record headers carrying the QoS identity downstream (the AI
+        agents forward them into engine options, so the engine's own
+        buckets and priority classes see the same tenant the gateway
+        throttled). Stamped only when the app has QoS configured or the
+        client asked for special treatment — otherwise record headers
+        stay byte-identical to the pre-QoS gateway."""
+        if (
+            limiter is None
+            and "tenant" not in params
+            and "priority" not in params
+        ):
+            return {}
+        tenant, priority = self._qos_identity(params, principal)
+        return {QOS_TENANT_HEADER: tenant, QOS_PRIORITY_HEADER: priority}
+
+    #: max distinct tenant labels on the throttle counter — tenant names
+    #: can be client-chosen on unauthenticated gateways, and Prometheus
+    #: label cardinality (and this dict) must not grow with them
+    _MAX_THROTTLE_LABELS = 256
+
+    def _count_throttle(self, tenant: str) -> None:
+        if (
+            tenant not in self._m_throttled
+            and len(self._m_throttled) >= self._MAX_THROTTLE_LABELS
+        ):
+            tenant = "<other>"
+        counter = self._m_throttled.get(tenant)
+        if counter is None:
+            from langstream_tpu.api.metrics import PrometheusMetricsReporter
+
+            counter = PrometheusMetricsReporter(
+                prefix="langstream_gateway", agent_id=tenant
+            ).counter(
+                "throttled_total",
+                "produce requests refused with 429 for this QoS tenant",
+            )
+            self._m_throttled[tenant] = counter
+        counter(1)
+
+    @staticmethod
+    def _retry_after_header(retry: float) -> str:
+        # Retry-After is integral seconds; round UP so a client honoring
+        # it never retries into a still-empty bucket
+        return str(max(1, -(-int(retry * 1000) // 1000)))
+
+    def _throttle_http(
+        self, tenant: str, retry: float, trace: str | None = None
+    ) -> web.Response:
+        """Structured 429: machine-readable body + ``Retry-After`` +
+        ``langstream-throttled`` naming the tenant (so a shared proxy can
+        tell whose budget was hit) + the trace header when a span was
+        already opened for the rejected produce."""
+        self._count_throttle(tenant)
+        headers = {
+            "Retry-After": self._retry_after_header(retry),
+            THROTTLED_HEADER: tenant,
+        }
+        body: dict[str, Any] = {
+            "status": "THROTTLED",
+            "reason": f"tenant {tenant!r} over its rate limit",
+            "retry-after": round(retry, 3),
+        }
+        if trace:
+            headers[TRACE_HEADER] = trace
+            body["trace"] = trace
+        return web.json_response(body, status=429, headers=headers)
+
+    def _ws_throttle_gate(
+        self, limiter: TenantLimiter | None, tenant: str
+    ) -> None:
+        """WS upgrade gate: a tenant whose bucket is already empty gets
+        the 429 at the handshake (read-only peek — the upgrade itself
+        costs no budget; per-message debits happen on each produce)."""
+        if limiter is None:
+            return
+        retry = limiter.retry_after(tenant)
+        if retry is not None:
+            self._count_throttle(tenant)
+            raise web.HTTPTooManyRequests(
+                reason=f"tenant {tenant!r} over its rate limit",
+                headers={
+                    "Retry-After": self._retry_after_header(retry),
+                    THROTTLED_HEADER: tenant,
+                },
+            )
+
     def _filters_match(
         self, gateway: Gateway, params, principal, record: Record
     ) -> bool:
@@ -300,13 +458,21 @@ class GatewayServer:
             principal = await self._authenticate(gateway, credentials)
         except AuthenticationException as e:
             raise web.HTTPUnauthorized(reason=str(e))
+        limiter = self.registry.qos_limiter(tenant, app_id)
+        qos_tenant, _ = self._qos_identity(params, principal)
+        # an already-empty bucket refuses the upgrade itself with a real
+        # 429 (per-message throttling below covers mid-stream exhaustion)
+        self._ws_throttle_gate(limiter, qos_tenant)
         ws = web.WebSocketResponse()
         await ws.prepare(request)
         await self._emit_event(gateway, streaming, "ClientConnected", tenant, app_id)
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
         producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
         await producer.start()
-        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        inject = {
+            **self._mapped_headers(gateway.produce_headers, params, principal),
+            **self._qos_headers(limiter, params, principal),
+        }
         try:
             async for msg in ws:
                 if msg.type != WSMsgType.TEXT:
@@ -317,6 +483,26 @@ class GatewayServer:
                         {**(payload.get("headers") or {}), **inject},
                         "gateway.produce",
                     )
+                    retry = (
+                        limiter.admit_request(qos_tenant)
+                        if limiter is not None
+                        else None
+                    )
+                    if retry is not None:
+                        # the span records the rejection (error label),
+                        # and the structured ack mirrors the HTTP 429
+                        span.end(error="throttled")
+                        self._count_throttle(qos_tenant)
+                        await ws.send_json(
+                            {
+                                "status": "THROTTLED",
+                                "reason": f"tenant {qos_tenant!r} over its "
+                                          f"rate limit",
+                                "retry-after": round(retry, 3),
+                                "trace": headers[TRACE_HEADER],
+                            }
+                        )
+                        continue
                     record = make_record(
                         value=payload.get("value"),
                         key=payload.get("key"),
@@ -348,10 +534,22 @@ class GatewayServer:
         except AuthenticationException as e:
             raise web.HTTPUnauthorized(reason=str(e))
         payload = await self._json_body(request)
-        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        limiter = self.registry.qos_limiter(tenant, app_id)
+        qos_tenant, _ = self._qos_identity(params, principal)
+        inject = {
+            **self._mapped_headers(gateway.produce_headers, params, principal),
+            **self._qos_headers(limiter, params, principal),
+        }
         headers, span = self._traced_headers(
             {**(payload.get("headers") or {}), **inject}, "gateway.produce"
         )
+        if limiter is not None:
+            retry = limiter.admit_request(qos_tenant)
+            if retry is not None:
+                span.end(error="throttled")
+                return self._throttle_http(
+                    qos_tenant, retry, headers[TRACE_HEADER]
+                )
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
         producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
         await producer.start()
@@ -442,6 +640,9 @@ class GatewayServer:
         answers_topic = chat.get("answers-topic")
         if not questions_topic or not answers_topic:
             raise web.HTTPBadRequest(reason="chat gateway needs questions/answers topics")
+        limiter = self.registry.qos_limiter(tenant, app_id)
+        qos_tenant, _ = self._qos_identity(params, principal)
+        self._ws_throttle_gate(limiter, qos_tenant)
         ws = web.WebSocketResponse()
         await ws.prepare(request)
         await self._emit_event(gateway, streaming, "ClientConnected", tenant, app_id)
@@ -452,7 +653,10 @@ class GatewayServer:
             {"topic": answers_topic}, initial_position="latest"
         )
         await reader.start()
-        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        inject = {
+            **self._mapped_headers(gateway.produce_headers, params, principal),
+            **self._qos_headers(limiter, params, principal),
+        }
         # the same headers injected on produce are the consume-side filters
         # (that's how chat correlates answers to this session)
         pusher = asyncio.ensure_future(
@@ -468,6 +672,24 @@ class GatewayServer:
                         {**(payload.get("headers") or {}), **inject},
                         "gateway.chat",
                     )
+                    retry = (
+                        limiter.admit_request(qos_tenant)
+                        if limiter is not None
+                        else None
+                    )
+                    if retry is not None:
+                        span.end(error="throttled")
+                        self._count_throttle(qos_tenant)
+                        await ws.send_json(
+                            {
+                                "status": "THROTTLED",
+                                "reason": f"tenant {qos_tenant!r} over its "
+                                          f"rate limit",
+                                "retry-after": round(retry, 3),
+                                "trace": headers[TRACE_HEADER],
+                            }
+                        )
+                        continue
                     with span:
                         await producer.write(
                             make_record(
@@ -606,7 +828,15 @@ class GatewayServer:
         await reader.start()
         producer = runtime.create_producer("gateway-service", {"topic": input_topic})
         await producer.start()
-        inject = self._mapped_headers(gateway.produce_headers, params, principal)
+        # service round-trips stamp the QoS identity too (the engine's own
+        # buckets backstop them); gateway-side shedding stays on the
+        # produce/chat paths where a retry hint is actionable
+        inject = {
+            **self._mapped_headers(gateway.produce_headers, params, principal),
+            **self._qos_headers(
+                self.registry.qos_limiter(tenant, app_id), params, principal
+            ),
+        }
         headers, span = self._traced_headers(
             {
                 **(payload.get("headers") or {}),

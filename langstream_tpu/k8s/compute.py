@@ -269,6 +269,27 @@ class KubernetesComputeRuntime:
                     merged.append({"pod": pod, **entry})
         return merged
 
+    def qos(self, tenant: str, name: str) -> dict[str, Any]:
+        """QoS status: fan in the pods' ``/flight/summary`` entries and
+        keep only the scheduler sections (per-class queued/admitted/shed/
+        preempted counters + tenant throttles), tagged per pod like
+        :meth:`flight` — the engine exposes no dedicated QoS endpoint by
+        design. The declared policy lives in the stored application (the
+        control plane serves it from the app files), so ``configured``
+        stays empty here."""
+        engines: list[dict[str, Any]] = []
+        for pod, chunk in self._pod_json_fanin(tenant, name, "/flight/summary"):
+            for entry in chunk:
+                if isinstance(entry, dict):
+                    engines.append(
+                        {
+                            "pod": pod,
+                            "model": entry.get("model"),
+                            "scheduler": entry.get("scheduler"),
+                        }
+                    )
+        return {"configured": {}, "engines": engines}
+
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         """Agent CR specs + operator-written statuses."""
         return [

@@ -68,7 +68,14 @@ from langstream_tpu.models.encoder import (
 from langstream_tpu.models.tokenizer import Tokenizer, load_tokenizer
 from langstream_tpu.serving.flight import FlightRecorder
 from langstream_tpu.serving.profiling import ProfilerHooks
+from langstream_tpu.serving.qos import (
+    PRIORITY_CLASSES,
+    QosSpec,
+    RateLimited,
+    normalize_priority,
+)
 from langstream_tpu.serving.sampler import sample_tokens
+from langstream_tpu.serving.scheduler import make_scheduler
 
 log = logging.getLogger(__name__)
 
@@ -195,6 +202,12 @@ class ServingConfig:
     # decode bursts — a long prompt no longer stalls every active stream
     # for its whole prefill (head-of-line blocking). 0 disables.
     prefill_chunk: int = 0
+    # multi-tenant QoS (serving/qos.py, serving/scheduler.py): None keeps
+    # the FIFO admission queue (the pre-QoS engine, bit for bit); a
+    # QosSpec switches admission to priority classes with WDRR dequeue,
+    # bounded per-class queues, per-tenant token buckets, and preemptive
+    # load shedding under KV pressure (docs/SCHEDULING.md)
+    qos: QosSpec | None = None
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -233,6 +246,7 @@ class ServingConfig:
             "prefill-chunk": self.prefill_chunk,
             "speculative-drafts": self.speculative_drafts,
             "model-dtype": self.model_dtype,
+            "qos": self.qos.to_dict() if self.qos is not None else None,
         }
 
     @classmethod
@@ -291,6 +305,7 @@ class ServingConfig:
             speculative_drafts=int(
                 d.get("speculative-drafts", d.get("speculative_drafts", 0))
             ),
+            qos=QosSpec.from_dict(d.get("qos")),
         )
 
 
@@ -344,6 +359,29 @@ class _Request:
     # poison the p99 forever (trace=None alone can't tell warmup apart
     # from an untraced real request)
     warmup: bool = False
+    # QoS identity (serving/qos.py): the priority class drives WDRR
+    # dequeue and preemption eligibility; the tenant keys the token
+    # buckets. Both default to the unprivileged middle ground so a
+    # QoS-off engine behaves exactly as before.
+    tenant: str = ""
+    priority: str = "default"
+    # preemptive load shedding: times preempted so far (capped by
+    # qos.max-preemptions) and, while requeued, when the preemption
+    # happened (feeds the resume-latency histogram)
+    preemptions: int = 0
+    preempt_time: float | None = None
+
+    @property
+    def context_tokens(self) -> list[int]:
+        """Full model context: prompt plus everything generated so far.
+        Equals ``prompt_tokens`` until a preemption; a resumed request
+        re-prefills this to rebuild its KV state, so with greedy
+        sampling the continuation is bit-identical to an unpreempted
+        run (the generated tokens + per-request sampling params ARE the
+        snapshot — greedy decode carries no other state)."""
+        if not self.generated:
+            return self.prompt_tokens
+        return self.prompt_tokens + self.generated
 
 
 def _normalize_stop(value) -> list[str]:
@@ -461,7 +499,10 @@ class TpuServingEngine:
         self._init_model()
 
         self.slots = [_Slot() for _ in range(config.slots)]
-        self._queue: asyncio.Queue[_Request] = asyncio.Queue()
+        # admission policy: FIFO by default; a qos spec swaps in the
+        # priority/WDRR/token-bucket scheduler (serving/scheduler.py)
+        self.scheduler = make_scheduler(config.qos)
+        self._qos_enabled = config.qos is not None and config.qos.enabled
         self._wake = asyncio.Event()
         self._stop = False
         self._loop_task: asyncio.Task | None = None
@@ -589,6 +630,42 @@ class TpuServingEngine:
             "jit program variants/shapes compiled (bucket or sampler-mode "
             "misses; each is a potential mid-traffic convoy)",
         )
+        # QoS observability (created only with a qos spec so a FIFO
+        # engine's /metrics surface is unchanged): per-class queue-depth
+        # gauges, shed/preempt counters, preemption/resume histograms
+        self._m_class_depth: dict[str, Any] = {}
+        self._m_shed = None
+        self._m_preempted = None
+        self._m_resume_hist = None
+        self._m_preempt_hist = None
+        if self._qos_enabled:
+            self._m_class_depth = {
+                cls: reporter.gauge(
+                    f"qos_queue_depth_{cls}",
+                    f"requests queued in the {cls} priority class",
+                )
+                for cls in PRIORITY_CLASSES
+            }
+            self._m_shed = reporter.counter(
+                "qos_shed_total",
+                "requests refused by QoS policy (tenant throttle or a "
+                "full class queue)",
+            )
+            self._m_preempted = reporter.counter(
+                "qos_preempted_total",
+                "running requests preempted under KV pressure (snapshot + "
+                "requeue for transparent resume)",
+            )
+            self._m_resume_hist = reporter.histogram(
+                "qos_resume_seconds",
+                "preemption → re-admission wall time (how long preempted "
+                "work waited to resume)",
+            )
+            self._m_preempt_hist = reporter.histogram(
+                "qos_preempted_run_seconds",
+                "how long a victim had been running when preempted (the "
+                "decode progress the preemption put at risk)",
+            )
         self._warmup_task: asyncio.Task | None = None
         # device-side upload caches (content-keyed): block tables and the
         # sampler/active-mask tuple change rarely between chunks, and each
@@ -1183,14 +1260,13 @@ class TpuServingEngine:
     def _admission_stall(self) -> str | None:
         """Why queued work is not being admitted right now (None when the
         queue is empty or admission would succeed on the next pass)."""
-        if self._queue.empty():
+        if self.scheduler.empty():
             return None
         if not any(s.free for s in self.slots):
             return "no-free-slot"
         if self.block_mgr is not None:
-            try:
-                head = self._queue._queue[0]  # peek, engine-loop only
-            except IndexError:
+            head = self.scheduler.peek()  # engine-loop only
+            if head is None:
                 return None
             if not self.block_mgr.can_admit(
                 len(head.prompt_tokens) + head.max_tokens + 1
@@ -1215,18 +1291,23 @@ class TpuServingEngine:
         kv_used = (
             self.block_mgr.used_ratio() if self.block_mgr is not None else None
         )
+        depths = self.scheduler.depths()
         sample = self.flight.sample(
             phase,
             device_s=device_s,
             tokens=tokens,
             occupancy=sum(1 for s in self.slots if not s.free),
-            queue_depth=self._queue.qsize(),
+            queue_depth=self.scheduler.qsize(),
             stall=stall,
             kv_used=kv_used,
             prefix_hits=self.prefix_hits,
             spec_accepted=spec_accepted,
             spec_rejected=spec_rejected,
+            queue_by_class=depths,
         )
+        if depths:
+            for cls, gauge in self._m_class_depth.items():
+                gauge(depths.get(cls, 0))
         hist = self._m_step_hist.get(phase)
         if hist is not None:
             hist(sample["wall_ms"] / 1000.0)
@@ -1244,8 +1325,9 @@ class TpuServingEngine:
         sample = self.flight.stall(
             reason,
             occupancy=sum(1 for s in self.slots if not s.free),
-            queue_depth=self._queue.qsize(),
+            queue_depth=self.scheduler.qsize(),
             kv_used=kv_used,
+            queue_by_class=self.scheduler.depths(),
         )
         self._m_stall[reason](sample["wall_ms"] / 1000.0)
 
@@ -1365,8 +1447,23 @@ class TpuServingEngine:
             stop=stop,
             presence_penalty=float(options.get("presence-penalty", 0.0)),
             frequency_penalty=float(options.get("frequency-penalty", 0.0)),
+            tenant=str(options.get("qos-tenant", "") or ""),
+            priority=normalize_priority(options.get("priority")),
         )
-        await self._queue.put(request)
+        try:
+            self.scheduler.submit(request)
+        except RateLimited as e:
+            # load shed / tenant throttle: refused before any slot or
+            # block was touched — callers (gateway, agents) map this to
+            # 429 + Retry-After
+            self.flight.event(
+                "shed", reason=e.reason, tenant=request.tenant,
+                priority=request.priority,
+                retry_after_s=e.retry_after,
+            )
+            if self._m_shed is not None:
+                self._m_shed(1)
+            raise
         self._ensure_loop()
         self._wake.set()
         return await request.future
@@ -1436,8 +1533,12 @@ class TpuServingEngine:
             "model": self.config.model,
             "slots": self.config.slots,
             "active": sum(1 for s in self.slots if not s.free),
-            "queued": self._queue.qsize(),
+            "queued": self.scheduler.qsize(),
             "total-generated": self.total_generated,
+            # admission-policy counters (per-class queued/admitted/shed/
+            # preempted under QoS; plain FIFO totals otherwise) — the
+            # control-plane /qos route reads these off /flight/summary
+            "scheduler": self.scheduler.stats(),
             "decode-chunks": {
                 "light": self._light_chunks,
                 "heavy": self._heavy_chunks,
@@ -1510,8 +1611,14 @@ class TpuServingEngine:
         self.flight.mark()
         while not self._stop:
             try:
-                if not self._queue.empty():
+                if not self.scheduler.empty():
                     await self._admit(loop)
+                    # QoS preemption: admission stalled on KV pressure
+                    # with a higher-priority request waiting → preempt
+                    # the policy-chosen victim (its blocks free NOW) and
+                    # re-run admission so the waiter lands this pass
+                    if self._maybe_preempt():
+                        await self._admit(loop)
                 if self._has_prefilling():
                     # one bounded chunk per loop pass: long prefills make
                     # progress without stalling the decode bursts below
@@ -1522,9 +1629,9 @@ class TpuServingEngine:
                     if not s.free and not s.prefilling
                 ]
                 self._m_active(len(active))
-                self._m_queued(self._queue.qsize())
+                self._m_queued(self.scheduler.qsize())
                 if not active:
-                    if self._queue.empty() and not self._has_prefilling():
+                    if self.scheduler.empty() and not self._has_prefilling():
                         self._wake.clear()
                         try:
                             await asyncio.wait_for(self._wake.wait(), timeout=1.0)
@@ -1592,12 +1699,99 @@ class TpuServingEngine:
             if self.block_mgr is not None:
                 self.block_mgr.release(slot_id)
         self._lengths[:] = 0
-        while not self._queue.empty():
-            request = self._queue.get_nowait()
+        for request in self.scheduler.drain():
             if not request.future.done():
                 request.future.set_exception(error)
         self._pending_emits.clear()
         self._finished_requests.clear()
+
+    def _maybe_preempt(self) -> bool:
+        """Preemptive load shedding under KV pressure: when admission is
+        stalled on ``no-kv-blocks`` and the scheduler's cost model names
+        a running victim (strictly lower class than the stalled head,
+        preemptions left, more deadline slack than the waiter), preempt
+        it so the waiter's blocks free immediately. Returns True when a
+        slot was preempted (the caller re-runs admission). Runs at the
+        loop's safe point — no dispatch is in flight."""
+        if not self._qos_enabled or self.block_mgr is None:
+            return False
+        if self._admission_stall() != "no-kv-blocks":
+            return False
+        head = self.scheduler.peek()
+        if head is None:
+            return False
+        running = [
+            (i, s.request)
+            for i, s in enumerate(self.slots)
+            if s.request is not None and not s.prefilling
+        ]
+        victim = self.scheduler.preempt_candidate(head, running)
+        if victim is None:
+            return False
+        self._preempt_slot(victim)
+        return True
+
+    def _preempt_slot(self, slot_id: int) -> None:
+        """Preempt one running request: its generated tokens + sampling
+        params ARE the snapshot (greedy resume re-prefills
+        ``context_tokens`` and continues bit-identically — see
+        ``_Request.context_tokens``). Free the slot and its worst-case
+        block reservation, then requeue at the front of its class so
+        resume latency is bounded by the pressure, not the backlog."""
+        slot = self.slots[slot_id]
+        request = slot.request
+        now = time.monotonic()
+        slot.request = None
+        slot.prefilling = False
+        slot.prefill_done = 0
+        self._lengths[slot_id] = 0
+        if self.block_mgr is not None:
+            self.block_mgr.release(slot_id)
+        request.preemptions += 1
+        request.preempt_time = now
+        self.scheduler.note_preempted(request)
+        self.scheduler.requeue_front(request)
+        if self._m_preempted is not None:
+            self._m_preempted(1)
+        if self._m_preempt_hist is not None and request.admit_time is not None:
+            self._m_preempt_hist(now - request.admit_time)
+        self.flight.event(
+            "preempt",
+            reason="no-kv-blocks",
+            priority=request.priority,
+            tenant=request.tenant,
+            generated=len(request.generated),
+        )
+        if request.trace is not None:
+            record_span(
+                "engine.preempt", f"engine:{self.config.model}",
+                request.trace, now, now,
+                attributes={"generated": len(request.generated)},
+            )
+
+    def _note_resume(self, request: "_Request") -> None:
+        """A preempted request was just re-admitted: close the resume
+        accounting (histogram + flight/trace events)."""
+        if request.preempt_time is None:
+            return
+        now = time.monotonic()
+        waited = now - request.preempt_time
+        if self._m_resume_hist is not None:
+            self._m_resume_hist(waited)
+        self.flight.event(
+            "resume",
+            priority=request.priority,
+            tenant=request.tenant,
+            generated=len(request.generated),
+            waited_ms=round(waited * 1000.0, 3),
+        )
+        if request.trace is not None:
+            record_span(
+                "engine.resume", f"engine:{self.config.model}",
+                request.trace, request.preempt_time, now,
+                attributes={"generated": len(request.generated)},
+            )
+        request.preempt_time = None
 
     def _draft_tokens(
         self, slot_id: int, num_drafts: int
@@ -1766,7 +1960,7 @@ class TpuServingEngine:
             await self._flush_emits(live)
             if (
                 finished
-                or not self._queue.empty()
+                or not self.scheduler.empty()
                 or self._stop
                 or self._has_prefilling()
             ):
@@ -1786,7 +1980,7 @@ class TpuServingEngine:
         compute)."""
         if finished or self._stop or self._has_prefilling():
             return True
-        if self._queue.empty():
+        if self.scheduler.empty():
             return False
         if os.environ.get("LS_TPU_STICKY_BURSTS", "1") == "0":
             return True  # pre-r5 behavior (A/B knob): yield on any queue
@@ -2123,7 +2317,7 @@ class TpuServingEngine:
             slot_id = pre[min(i, len(pre) - 1)]
             slot = self.slots[slot_id]
             request = slot.request
-            chunk = request.prompt_tokens[
+            chunk = request.context_tokens[
                 slot.prefill_done : slot.prefill_done + C
             ]
             tokens[i, : len(chunk)] = chunk
@@ -2183,20 +2377,26 @@ class TpuServingEngine:
             slot = self.slots[slot_id]
             request = slot.request
             slot.prefill_done += int(suffix_lens[i])
-            if slot.prefill_done >= len(request.prompt_tokens):
-                self._lengths[slot_id] = len(request.prompt_tokens)
+            if slot.prefill_done >= len(request.context_tokens):
+                self._lengths[slot_id] = len(request.context_tokens)
                 self._current[slot_id] = int(next_np[i])
                 self._temps[slot_id] = request.temperature
                 self._topks[slot_id] = request.top_k
                 self._topps[slot_id] = request.top_p
                 self._pres[slot_id] = request.presence_penalty
                 self._freq[slot_id] = request.frequency_penalty
-                request.first_token_time = now
+                if request.first_token_time is None:
+                    # a resumed request keeps its ORIGINAL first-token
+                    # time: TTFT measures the client-visible first token
+                    request.first_token_time = now
                 slot.prefilling = False
                 # register BEFORE emitting: a max-tokens=1 / instant-EOS
                 # request is released inside _emit_token, and registering
-                # against a released slot's empty table publishes nothing
-                if self.config.prefix_cache:
+                # against a released slot's empty table publishes nothing.
+                # Resumed contexts stay out of the prefix cache — their
+                # block chains mix generated content into what looks like
+                # a prompt prefix.
+                if self.config.prefix_cache and not request.preemptions:
                     self.block_mgr.register_prefix(
                         slot_id, request.prompt_tokens
                     )
@@ -2223,19 +2423,23 @@ class TpuServingEngine:
         use_prefix = (
             self.block_mgr is not None and self.config.prefix_cache
         )
-        while not self._queue.empty():
+        while not self.scheduler.empty():
             free = [i for i, s in enumerate(self.slots) if s.free]
             if not free:
                 return
             batch: list[tuple[int, _Request, int]] = []  # (slot, req, reuse)
             bucket = None
             while (
-                not self._queue.empty()
+                not self.scheduler.empty()
                 and len(batch) < min(len(free), self.config.prefill_batch)
             ):
-                request = self._queue._queue[0]  # peek
+                # the scheduler names the next admission candidate (FIFO
+                # head by default; the WDRR-selected class head under QoS)
+                request = self.scheduler.peek()
+                if request is None:
+                    break
                 if request.future.cancelled():
-                    self._queue.get_nowait()  # caller gave up while queued
+                    self.scheduler.pop()  # caller gave up while queued
                     continue
                 if self.block_mgr is not None and not self.block_mgr.can_admit(
                     len(request.prompt_tokens) + request.max_tokens + 1
@@ -2243,15 +2447,19 @@ class TpuServingEngine:
                     # paged backpressure: the worst case doesn't fit the
                     # pool right now; finished slots will free reservations.
                     # (Requests that could NEVER fit are rejected up front in
-                    # generate(), so this always unblocks eventually.)
+                    # generate(), so this always unblocks eventually. The
+                    # QoS loop may also preempt a lower-class victim to
+                    # unblock this head — see _maybe_preempt.)
                     break
-                if use_prefix:
-                    blocks, reuse = self.block_mgr.match_prefix(
-                        request.prompt_tokens
-                    )
+                # a resumed request's prefill content is its full context
+                # (prompt + generated so far), rebuilding the KV state the
+                # preemption dropped; untouched requests see ctx == prompt
+                ctx = request.context_tokens
+                if use_prefix and not request.preemptions:
+                    blocks, reuse = self.block_mgr.match_prefix(ctx)
                     if (
                         reuse
-                        and len(request.prompt_tokens) - reuse
+                        and len(ctx) - reuse
                         > self.config.prefix_cache_max_suffix
                     ):
                         # long suffix, small saving: the flash/ring full
@@ -2259,7 +2467,7 @@ class TpuServingEngine:
                         blocks, reuse = [], 0
                 else:
                     blocks, reuse = [], 0
-                to_prefill = len(request.prompt_tokens) - reuse
+                to_prefill = len(ctx) - reuse
                 if (
                     self.block_mgr is not None
                     and self.config.prefill_chunk > 0
@@ -2269,21 +2477,20 @@ class TpuServingEngine:
                     # feed the prompt through _advance_prefills one bounded
                     # chunk per loop pass instead of one monolithic prefill
                     slot_id = free.pop(len(batch))
-                    self._queue.get_nowait()
+                    self.scheduler.pop()
                     self.block_mgr.admit(
                         slot_id,
                         len(request.prompt_tokens) + request.max_tokens + 1,
                     )
                     if blocks:
                         self.block_mgr.adopt_prefix(slot_id, blocks)
-                    self.block_mgr.ensure_capacity(
-                        slot_id, len(request.prompt_tokens)
-                    )
+                    self.block_mgr.ensure_capacity(slot_id, len(ctx))
                     slot = self.slots[slot_id]
                     slot.request = request
                     slot.prefilling = True
                     slot.prefill_done = reuse
                     request.admit_time = time.monotonic()
+                    self._note_resume(request)
                     if reuse:
                         self.prefix_hits += 1
                         self.prefix_tokens += reuse
@@ -2296,7 +2503,7 @@ class TpuServingEngine:
                 elif b != bucket:
                     break
                 slot_id = free[len(batch)]
-                self._queue.get_nowait()
+                self.scheduler.pop()
                 if self.block_mgr is not None:
                     # reserve at pop time so the NEXT peek's can_admit sees
                     # this batch member's reservation
@@ -2312,9 +2519,10 @@ class TpuServingEngine:
             for slot_id, request, _reuse in batch:
                 self.slots[slot_id].request = request
                 request.admit_time = admit_now
+                self._note_resume(request)
                 if self.block_mgr is not None:
                     self.block_mgr.ensure_capacity(
-                        slot_id, len(request.prompt_tokens)
+                        slot_id, len(request.context_tokens)
                     )
             Bp = _pow2(len(batch))
             use_continue = any(r > 0 for _, _, r in batch)
@@ -2327,7 +2535,7 @@ class TpuServingEngine:
             topps = np.ones(Bp, dtype=np.float32)
             for i in range(Bp):
                 slot_id, request, reuse = batch[min(i, len(batch) - 1)]
-                suffix = request.prompt_tokens[reuse:]
+                suffix = request.context_tokens[reuse:]
                 padded[i, : len(suffix)] = suffix
                 lengths[i] = len(suffix)
                 starts[i] = reuse
@@ -2409,6 +2617,10 @@ class TpuServingEngine:
             )
             if use_prefix:
                 for slot_id, request, reuse in batch:
+                    if request.preemptions:
+                        # resumed contexts stay out of the prefix cache
+                        # (generated content is not a shareable prompt)
+                        continue
                     self.block_mgr.register_prefix(
                         slot_id, request.prompt_tokens
                     )
@@ -2422,14 +2634,15 @@ class TpuServingEngine:
             now = time.monotonic()
             admitted_slots = []
             for i, (slot_id, request, _reuse) in enumerate(batch):
-                self._lengths[slot_id] = len(request.prompt_tokens)
+                self._lengths[slot_id] = len(request.context_tokens)
                 self._current[slot_id] = int(next_np[i])
                 self._temps[slot_id] = request.temperature
                 self._topks[slot_id] = request.top_k
                 self._topps[slot_id] = request.top_p
                 self._pres[slot_id] = request.presence_penalty
                 self._freq[slot_id] = request.frequency_penalty
-                request.first_token_time = now
+                if request.first_token_time is None:
+                    request.first_token_time = now
                 self._emit_token(slot_id, int(next_np[i]), float(logprob_np[i]))
                 admitted_slots.append(slot_id)
             self._m_tokens(len(batch))
@@ -2580,6 +2793,9 @@ class TpuServingEngine:
                 await result
         finished, self._finished_requests = self._finished_requests, []
         for request, is_eos in finished:
+            # tenant tokens/s accounting (QoS post-debit): cancelled
+            # requests debit too — their tokens burned engine capacity
+            self.scheduler.on_finished(request)
             if request.future.cancelled():
                 # aborted by the caller: not a served request — keep it out
                 # of the request-rate/TTFT metrics (a disconnect storm must
@@ -2670,6 +2886,10 @@ def flight_report(
             "model": engine.config.model,
             "slots": engine.config.slots,
             "summary": engine.flight.summary(),
+            # admission-policy state (per-class counters + tenant throttle
+            # counts under QoS): included in /flight/summary too, so the
+            # control-plane /qos route needs no extra engine surface
+            "scheduler": engine.scheduler.stats(),
         }
         if not summary_only:
             entry["samples"] = engine.flight.recent(samples)

@@ -37,7 +37,13 @@ which queued work could not be admitted — queue pressure).
 Discrete **events** ride a second small ring: ``recompile`` (a jit variant
 or prefill bucket compiled for the first time — the 30 s mid-traffic
 convoy-maker on TPU), ``pool-grow`` (decode-time KV block allocation),
-``warmup``, ``preempt`` (in-flight work failed), ``lockstep-divergence``.
+``warmup``, ``preempt`` (a QoS preemption under KV pressure, or in-flight
+work failed — the ``reason`` field tells them apart), ``resume`` (a
+preempted request re-admitted), ``shed`` (a request refused by QoS
+policy: tenant throttle or full class queue), ``lockstep-divergence``.
+Under a QoS scheduler each sample additionally carries ``queue_by_class``
+(per-priority-class queue depths — what ``engine_top --analyze`` watches
+for sustained interactive-class growth).
 
 Hot-path discipline (graftcheck rule OBS503 gates this): the record path
 is append-only on GIL-atomic deques — **no locks, no I/O, nothing that can
@@ -144,9 +150,12 @@ class FlightRecorder:
         prefix_hits: int = 0,
         spec_accepted: int = 0,
         spec_rejected: int = 0,
+        queue_by_class: dict[str, int] | None = None,
     ) -> dict[str, Any]:
         """Record one dispatched burst. ``wall`` is the time since the
-        previous boundary; ``host = wall − device``."""
+        previous boundary; ``host = wall − device``. ``queue_by_class``
+        (QoS engines only) keeps the sample schema unchanged for FIFO
+        engines by being omitted when None."""
         now = time.monotonic()
         wall_ms = (now - self._last_mark) * 1000.0
         self._last_mark = now
@@ -174,6 +183,8 @@ class FlightRecorder:
         if spec_accepted or spec_rejected:
             entry["spec_accepted"] = spec_accepted
             entry["spec_rejected"] = spec_rejected
+        if queue_by_class is not None:
+            entry["queue_by_class"] = dict(queue_by_class)
         self._samples.append(entry)
         self.recorded += 1
         self.wall_ms += wall_ms
@@ -198,6 +209,7 @@ class FlightRecorder:
         occupancy: int = 0,
         queue_depth: int = 0,
         kv_used: float | None = None,
+        queue_by_class: dict[str, int] | None = None,
     ) -> dict[str, Any]:
         """Record an idle/blocked gap (no dispatch): its whole wall slice
         is stall time attributed to ``reason``."""
@@ -221,6 +233,8 @@ class FlightRecorder:
             "kv_used": round(kv_used, 4) if kv_used is not None else None,
             "prefix_hits": 0,
         }
+        if queue_by_class is not None:
+            entry["queue_by_class"] = dict(queue_by_class)
         self._samples.append(entry)
         self.recorded += 1
         self.wall_ms += wall_ms

@@ -34,6 +34,7 @@ def _bench_env(tmp_path, **overrides) -> dict:
         BENCH_PREFIX="0",
         BENCH_KV_INT8="0",
         BENCH_SPEC="0",
+        BENCH_QOS="0",
         JAX_COMPILATION_CACHE_DIR=str(tmp_path / "jax_cache"),
     )
     env.update(overrides)

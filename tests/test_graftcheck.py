@@ -716,6 +716,77 @@ def test_obs503_tn_append_only_recording_and_cold_paths():
 
 
 # --------------------------------------------------------------------------
+# QOS601 — unbounded asyncio.Queue in serving/ or gateway/
+# --------------------------------------------------------------------------
+
+
+def test_qos601_tp_unbounded_queue_in_serving_and_gateway():
+    snippet = """
+        import asyncio
+
+        class Engine:
+            def __init__(self):
+                self._queue = asyncio.Queue()
+        """
+    assert rule_ids(snippet) == ["QOS601"]
+    assert rule_ids(
+        snippet, path="langstream_tpu/gateway/server.py"
+    ) == ["QOS601"]
+
+
+def test_qos601_tp_bare_queue_import():
+    ids = rule_ids(
+        """
+        from asyncio import Queue
+
+        pending = Queue()
+        """
+    )
+    assert ids == ["QOS601"]
+
+
+def test_qos601_tn_bounded_other_package_and_deque():
+    # an explicit maxsize (positional or keyword) is the sanctioned
+    # spelling; other packages and non-asyncio containers stay silent
+    assert (
+        rule_ids(
+            """
+            import asyncio
+            from collections import deque
+
+            bounded_kw = asyncio.Queue(maxsize=64)
+            bounded_pos = asyncio.Queue(16)
+            ring = deque(maxlen=64)
+            """
+        )
+        == []
+    )
+    assert (
+        rule_ids(
+            """
+            import asyncio
+
+            results = asyncio.Queue()
+            """,
+            path="langstream_tpu/grpc/server.py",
+        )
+        == []
+    )
+
+
+def test_qos601_suppressed_with_reason():
+    ids = rule_ids(
+        """
+        import asyncio
+
+        # graftcheck: disable=QOS601 drained synchronously before return
+        lines = asyncio.Queue()
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
 # suppressions + GC000
 # --------------------------------------------------------------------------
 

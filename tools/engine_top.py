@@ -6,7 +6,9 @@ Live mode polls a pod's ``/flight`` endpoint (or the control plane's
 flight report shape works) and renders a one-screen view per engine:
 occupancy bar, tok/s, a step-time sparkline, the device/host/stall
 decomposition, admission-stall breakdown by reason, KV-pool utilization,
-and the discrete-event tail (recompiles, pool growth, warmup, preemptions).
+the QoS scheduler state (per-class queue depths, per-tenant throttle
+counts, shed/preempt tallies plus their event tail), and the
+discrete-event tail (recompiles, pool growth, warmup, preemptions).
 
     python tools/engine_top.py                          # localhost:8080
     python tools/engine_top.py --url http://pod:8080/flight --interval 2
@@ -84,6 +86,7 @@ def render(report: list[dict]) -> str:
         totals = summary.get("totals", {})
         window = summary.get("window", {})
         samples = entry.get("samples") or []
+        events = entry.get("events") or []
         dispatch = [s for s in samples if s.get("phase") != "stall"]
         slots = entry.get("slots") or (samples[-1]["slots"] if samples else 0)
         occupancy = samples[-1]["occupancy"] if samples else 0
@@ -121,6 +124,7 @@ def render(report: list[dict]) -> str:
         kv_used = window.get("kv_used_ratio_last")
         if kv_used is not None:
             lines.append(f"kv pool  [{_bar(kv_used)}] {100 * kv_used:.1f}% used")
+        lines.extend(_render_scheduler(entry.get("scheduler"), events))
         spec_acc = totals.get("spec_accepted") or 0
         spec_rej = totals.get("spec_rejected") or 0
         if spec_acc or spec_rej:
@@ -139,7 +143,6 @@ def render(report: list[dict]) -> str:
             f"samples {summary.get('recorded', 0)} "
             f"(dropped {summary.get('dropped', 0)})"
         )
-        events = entry.get("events") or []
         for event in events[-6:]:
             detail = {
                 k: v
@@ -149,6 +152,54 @@ def render(report: list[dict]) -> str:
             lines.append(f"event    {event.get('kind')} {detail}")
         lines.append("")
     return "\n".join(lines).rstrip()
+
+
+def _render_scheduler(scheduler: dict | None, events: list[dict]) -> list[str]:
+    """QoS lines for one engine: per-class queue depths + admitted/shed/
+    preempted tallies, per-tenant throttle counts, and a dedicated tail
+    of the shed/preempt/resume events (the generic event tail can be
+    drowned out by recompiles/pool-grows during an incident)."""
+    if not scheduler or scheduler.get("policy") != "qos":
+        return []
+    lines: list[str] = []
+    classes = scheduler.get("classes") or {}
+    parts = []
+    for cls in ("interactive", "default", "batch"):
+        info = classes.get(cls)
+        if info is None:
+            continue
+        parts.append(
+            f"{cls[:3]} q={info.get('depth', 0)}"
+            f"/{info.get('queue_limit', '?')} adm={info.get('admitted', 0)}"
+        )
+    lines.append(
+        f"qos      {'  '.join(parts)}  | shed {scheduler.get('shed', 0)}"
+        f"  preempted {scheduler.get('preempted', 0)}"
+        f"  resumed {scheduler.get('resumed', 0)}"
+    )
+    tenants = scheduler.get("tenants") or {}
+    throttled = {
+        t: c.get("throttled", 0)
+        for t, c in tenants.items()
+        if c.get("throttled", 0)
+    }
+    if throttled:
+        lines.append(
+            "tenants  "
+            + "  ".join(
+                f"{t or '<anonymous>'} throttled={n}"
+                for t, n in sorted(throttled.items(), key=lambda kv: -kv[1])
+            )
+        )
+    qos_events = [
+        e for e in events if e.get("kind") in ("shed", "preempt", "resume")
+    ]
+    for event in qos_events[-4:]:
+        detail = {
+            k: v for k, v in event.items() if k not in ("kind", "t_ms", "seq")
+        }
+        lines.append(f"qos ev   {event.get('kind')} {detail}")
+    return lines
 
 
 # ---------------------------------------------------------------------------
@@ -172,6 +223,20 @@ def _collect_flight_dicts(obj, found: list[dict], label: str = "") -> None:
     elif isinstance(obj, list):
         for i, value in enumerate(obj):
             _collect_flight_dicts(value, found, f"{label}[{i}]")
+
+
+def _growth(series: list) -> tuple[float, float] | None:
+    """(head mean, tail mean) of the first/last quarter when the tail
+    exceeds max(2, 2*head) — the shared sustained-growth detector for
+    total queue depth and the per-class series."""
+    if len(series) < 8:
+        return None
+    q4 = max(1, len(series) // 4)
+    head = sum(series[:q4]) / q4
+    tail = sum(series[-q4:]) / q4
+    if tail > max(2.0, 2.0 * head):
+        return head, tail
+    return None
 
 
 def _anomalies(entry: dict) -> list[str]:
@@ -232,15 +297,30 @@ def _anomalies(entry: dict) -> list[str]:
             flags.append(
                 f"KV pool near capacity in {kv_hot}/{len(samples)} samples"
             )
-        quarter = max(1, len(samples) // 4)
-        head = samples[:quarter]
-        tail = samples[-quarter:]
-        head_q = sum(s.get("queue_depth", 0) for s in head) / len(head)
-        tail_q = sum(s.get("queue_depth", 0) for s in tail) / len(tail)
-        if tail_q > max(2.0, 2.0 * head_q):
+        total_growth = _growth([s.get("queue_depth", 0) for s in samples])
+        if total_growth is not None:
+            head_q, tail_q = total_growth
             flags.append(
                 f"queue growth: depth {head_q:.1f} -> {tail_q:.1f} across "
                 f"the window — arrival rate exceeds service rate"
+            )
+        # QoS engines: sustained interactive-class growth is the signal
+        # that matters even when total depth looks flat (a batch flood
+        # draining can mask the latency-sensitive class backing up)
+        inter_growth = _growth(
+            [
+                s["queue_by_class"].get("interactive", 0)
+                for s in samples
+                if isinstance(s.get("queue_by_class"), dict)
+            ]
+        )
+        if inter_growth is not None:
+            head_i, tail_i = inter_growth
+            flags.append(
+                f"interactive-class queue growth: depth {head_i:.1f} -> "
+                f"{tail_i:.1f} across the window — the latency class is "
+                f"backing up; raise its weight, add slots/replicas, or "
+                f"shed batch harder"
             )
     return flags
 
@@ -307,6 +387,13 @@ def analyze(dump) -> str:
         }
         if rollup_keys:
             lines.append(f"  rollup {rollup_keys}")
+        scheduler = entry.get("scheduler")
+        if scheduler and scheduler.get("policy") == "qos":
+            lines.append(
+                f"  qos    shed {scheduler.get('shed', 0)}  preempted "
+                f"{scheduler.get('preempted', 0)}  resumed "
+                f"{scheduler.get('resumed', 0)}"
+            )
         flags = _anomalies(entry)
         for flag in flags:
             lines.append(f"  !! {flag}")
