@@ -43,6 +43,11 @@ Phases (BASELINE.md targets: >= 2000 tok/s/chip, p50 gateway TTFT < 200ms):
 3. **Paged-KV / int8-KV decode** (1b proxy path only — the 8B headline
    already runs paged+int8): the same workload on the block-pool cache and
    on the int8 KV cache, so both layouts have driver-recorded numbers.
+   The paged phase additionally runs the **pipeline ablation**: the same
+   workload through the sequential reference loop (``pipeline=False``,
+   the ``LS_TPU_PIPELINE=0`` escape hatch), recording both legs'
+   ``overlap_ratio``/``host_exposed_ms_p50`` flight rollups and the
+   step-time speedup the depth-2 pipelined dispatch buys.
 4. **Speculative decode** on a context-copying workload: uplift vs off.
 5. **Prefix-cache TTFT**: cold vs warm TTFT for requests sharing a long
    preamble (paged layout; warm requests adopt cached prefix blocks).
@@ -604,7 +609,7 @@ async def _cleanup_engines() -> None:
 
 
 def _serving_config(kv_layout: str, kv_quantize: str | None = None,
-                    model: str | None = None):
+                    model: str | None = None, pipeline: bool = True):
     from langstream_tpu.serving.engine import ServingConfig
 
     return ServingConfig(
@@ -619,6 +624,9 @@ def _serving_config(kv_layout: str, kv_quantize: str | None = None,
         quantize=QUANTIZE,
         kv_layout=kv_layout,
         kv_quantize=kv_quantize,
+        # pipeline=False is the paged phase's ablation leg: the sequential
+        # reference loop on the same workload (docs/PIPELINE.md)
+        pipeline=pipeline,
         dense_kernel="xla" if _FORCE_XLA else "auto",
         paged_kernel="xla" if _FORCE_XLA else "auto",
     )
@@ -626,13 +634,14 @@ def _serving_config(kv_layout: str, kv_quantize: str | None = None,
 
 async def run_decode_bench(
     kv_layout: str, requests: int, kv_quantize: str | None = None,
-    model: str | None = None,
+    model: str | None = None, pipeline: bool = True,
 ) -> dict:
     """Saturated decode throughput for one KV layout."""
     from langstream_tpu.serving.engine import TpuServingEngine
 
     engine = TpuServingEngine.get_or_create(
-        _serving_config(kv_layout, kv_quantize, model=model)
+        _serving_config(kv_layout, kv_quantize, model=model,
+                        pipeline=pipeline)
     )
 
     # warmup at FULL length: the decode window bucket grows with sequence
@@ -644,6 +653,13 @@ async def run_decode_bench(
             for _ in range(WARMUP_REQUESTS)
         )
     )
+    # fresh flight ring for the measured window: warmup's compile storms
+    # and first-touch costs must not pollute the recorded rollup (the
+    # pipeline ablation compares rollups across legs, and the first leg
+    # in a child otherwise absorbs every process-global one-time cost)
+    from langstream_tpu.serving.flight import FlightRecorder
+
+    engine.flight = FlightRecorder(slots=SLOTS)
 
     start = time.monotonic()
     results = await asyncio.gather(
@@ -675,10 +691,21 @@ async def run_decode_bench(
     from langstream_tpu.serving.flight import bench_rollup
 
     flight = bench_rollup(engine.flight.summary())
+    # mean dispatched-step wall excluding idle gaps (the engine_top
+    # convention): the number the pipeline ablation compares across legs
+    totals = flight.get("totals") or {}
+    steps = sum((totals.get("steps_by_phase") or {}).values())
+    busy_ms = (totals.get("wall_ms") or 0.0) - (totals.get("stall_ms") or 0.0)
     out = {
         "model": model or MODEL,
         "kv_layout": kv_layout,
         **({"kv_quantize": kv_quantize} if kv_quantize else {}),
+        "pipeline": pipeline,
+        "mean_step_ms": round(busy_ms / steps, 3) if steps else None,
+        # the pipelined loop's headline observability: how much host work
+        # was hidden under device compute, and what stayed exposed
+        "overlap_ratio": flight.get("overlap_ratio"),
+        "host_exposed_ms_p50": flight.get("host_exposed_ms_p50"),
         "tok_s": round(tok_s, 1),
         "requests": requests,
         "total_tokens": total_tokens,
@@ -774,6 +801,40 @@ async def run_speculative_phase() -> dict:
         "accepted_per_step": round(accepted / steps, 2) if steps else 0.0,
         "requests": reqs,
         "max_tokens": toks,
+    }
+
+
+async def run_paged_pipeline_phase(requests: int | None = None) -> dict:
+    """The paged phase with its ``pipeline`` ablation: the same saturated
+    workload once through the depth-2 pipelined loop and once through the
+    ``LS_TPU_PIPELINE=0``-equivalent sequential reference
+    (``pipeline=False``), fresh engine each. Records both legs' rollups
+    plus the step-time ratio — the measured answer to "what did
+    overlapping host work under device compute buy", with
+    ``overlap_ratio``/``host_exposed_ms_p50`` from the flight rollup
+    showing how much host time the pipeline actually hid."""
+    n = requests if requests is not None else max(8, BENCH_REQUESTS // 2)
+    pipelined = await run_decode_bench("paged", n, pipeline=True)
+    await _cleanup_engines()
+    sequential = await run_decode_bench("paged", n, pipeline=False)
+    # median step over the measured window (post-warmup flight reset):
+    # robust to the stray mid-measurement compile that makes means lie
+    pipe_step = (pipelined.get("flight") or {}).get("step_ms_p50")
+    seq_step = (sequential.get("flight") or {}).get("step_ms_p50")
+    return {
+        # headline keys mirror the pipelined leg so record tooling that
+        # reads detail.paged.tok_s keeps working
+        **pipelined,
+        "pipelined": pipelined,
+        "sequential": sequential,
+        "step_speedup": (
+            round(seq_step / pipe_step, 3)
+            if pipe_step and seq_step else None
+        ),
+        "tok_s_uplift": (
+            round(pipelined["tok_s"] / sequential["tok_s"], 3)
+            if sequential.get("tok_s") else None
+        ),
     }
 
 
@@ -985,7 +1046,7 @@ async def _child_phase(phase: str) -> dict:
             )
         )
     if phase == "paged":
-        return await _phase(run_decode_bench("paged", BENCH_REQUESTS // 2))
+        return await _phase(run_paged_pipeline_phase())
     if phase == "kv_int8":
         return await _phase(
             run_decode_bench("dense", BENCH_REQUESTS // 2, kv_quantize="int8")

@@ -1,6 +1,6 @@
 """graftcheck: first-party static analysis for the langstream-tpu tree.
 
-Seven rule families tuned to this codebase's actual failure modes:
+Eight rule families tuned to this codebase's actual failure modes:
 
 ==========  ==============================================================
 JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
@@ -17,6 +17,8 @@ OBS501-503  observability: wall-clock ``time.time()`` in the
             blocking I/O in the engine hot loops / flight recorder
 QOS601      backpressure: unbounded ``asyncio.Queue()`` in ``serving/``
             or ``gateway/`` (defeats QoS load shedding)
+PERF701     pipeline fetch discipline: synchronous device fetches on the
+            engine dispatch path outside the designated fetch stage
 ==========  ==============================================================
 
 Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),
@@ -43,6 +45,7 @@ from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
 from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
+from langstream_tpu.analysis.rules_perf import RULES as _PERF_RULES
 from langstream_tpu.analysis.rules_qos import RULES as _QOS_RULES
 from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
 
@@ -53,6 +56,7 @@ ALL_RULES: list[Rule] = [
     *_EXC_RULES,
     *_OBS_RULES,
     *_QOS_RULES,
+    *_PERF_RULES,
 ]
 
 RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -208,6 +208,20 @@ class ServingConfig:
     # bounded per-class queues, per-tenant token buckets, and preemptive
     # load shedding under KV pressure (docs/SCHEDULING.md)
     qos: QosSpec | None = None
+    # depth-2 pipelined decode dispatch (docs/PIPELINE.md): heavy bursts
+    # overlap the host's fetch/detokenize/stop-check of chunk N with the
+    # device's execution of chunk N+1, freeze finished slots device-side
+    # instead of tearing the burst down, carry the in-flight chunk across
+    # the burst boundary so prefill dispatches interleave under it, and
+    # report the overlapped-vs-exposed host split in the flight rollup.
+    # False (or LS_TPU_PIPELINE=0 in the environment) falls back to the
+    # sequential loop — the reference the equivalence tests compare
+    # against. Greedy output is byte-identical across the two loops with
+    # model_dtype=float32 (exactly shape-independent argmax); under the
+    # bf16 default the loops legitimately run differently-shaped
+    # programs (frozen-slot bursts vs teardown/re-bucket), so near-tie
+    # logits can flip — the same caveat model_dtype documents above.
+    pipeline: bool = True
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -247,6 +261,7 @@ class ServingConfig:
             "speculative-drafts": self.speculative_drafts,
             "model-dtype": self.model_dtype,
             "qos": self.qos.to_dict() if self.qos is not None else None,
+            "pipeline": self.pipeline,
         }
 
     @classmethod
@@ -306,6 +321,7 @@ class ServingConfig:
                 d.get("speculative-drafts", d.get("speculative_drafts", 0))
             ),
             qos=QosSpec.from_dict(d.get("qos")),
+            pipeline=_parse_bool(d.get("pipeline", True)),
         )
 
 
@@ -411,6 +427,60 @@ def _bucket(n: int, lo: int = 32, hi: int = 32768) -> int:
     while b < n and b < hi:
         b *= 2
     return min(b, hi)  # hi may not be a power of two (user max_seq_len)
+
+
+def _dev_cache_cap() -> int:
+    try:
+        return max(1, int(os.environ.get("LS_TPU_DEV_CACHE_CAP", "32")))
+    except ValueError:
+        return 32
+
+
+class _DeviceLru:
+    """Content-keyed device-upload cache with an LRU bound.
+
+    The r5 single-entry caches saved the ~70 ms upload RPC only when two
+    consecutive bursts shared the exact same content; multi-tenant traffic
+    alternating between a few slot populations re-uploaded on every flip.
+    Keeping the last N contents fixes the flip-flop — and the bound plus
+    eviction counter (``engine.stats()["device-cache"]``) keeps a
+    long-lived engine from pinning one device buffer per distinct block
+    table it ever saw. Engine-loop/dispatch-thread only; plain dict ops,
+    no locks (OBS503 discipline)."""
+
+    def __init__(self, cap: int | None = None):
+        from collections import OrderedDict
+
+        self.cap = cap if cap is not None else _dev_cache_cap()
+        self._entries: Any = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_put(self, key: bytes, factory: Callable[[], Any]) -> Any:
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry
+        self.misses += 1
+        entry = self._entries[key] = factory()
+        while len(self._entries) > self.cap:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        return entry
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "size": len(self._entries),
+            "cap": self.cap,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }
 
 
 class TpuServingEngine:
@@ -667,11 +737,29 @@ class TpuServingEngine:
                 "decode progress the preemption put at risk)",
             )
         self._warmup_task: asyncio.Task | None = None
-        # device-side upload caches (content-keyed): block tables and the
-        # sampler/active-mask tuple change rarely between chunks, and each
-        # re-upload is a synchronous ~70ms RPC over a tunneled chip
-        self._tables_dev_cache: tuple[bytes, Any] | None = None
-        self._sampler_dev_cache: tuple[bytes, Any] | None = None
+        # device-side upload caches (content-keyed, LRU-bounded): block
+        # tables and the sampler/active-mask tuple change rarely between
+        # chunks, and each re-upload is a synchronous ~70ms RPC over a
+        # tunneled chip
+        self._tables_dev_cache = _DeviceLru()
+        self._sampler_dev_cache = _DeviceLru()
+        # pipelined engine loop (docs/PIPELINE.md): config + env escape
+        # hatch; LS_TPU_PIPELINE=0 forces the sequential reference loop
+        self._pipeline_on = config.pipeline and (
+            os.environ.get("LS_TPU_PIPELINE", "1") != "0"
+        )
+        # a dispatched-but-unprocessed decode chunk carried across the
+        # burst boundary so admission prefills dispatch under its device
+        # shadow: (out, active slot ids, request identities at capture, K)
+        self._pending_chunk: tuple | None = None
+        # inside a pipelined burst, finished slots' block releases are
+        # DEFERRED to burst exit: an in-flight chunk still commits via the
+        # tables captured at its dispatch, and a mid-burst re-allocation
+        # of those blocks to a live slot would let the stale commit land
+        # on top of live K/V (the post-burst prefill overwrite that makes
+        # immediate release safe between bursts does not exist mid-burst)
+        self._defer_release = False
+        self._deferred_releases: list[int] = []
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
 
@@ -1281,11 +1369,14 @@ class TpuServingEngine:
         phase: str,
         device_s: float,
         tokens: int = 0,
+        overlapped_s: float = 0.0,
         spec_accepted: int = 0,
         spec_rejected: int = 0,
     ) -> None:
         """One flight sample per dispatched burst, plus its Prometheus
-        mirrors. Hot-path discipline (graftcheck OBS503): deque appends and
+        mirrors. ``overlapped_s`` is host work the pipelined loop ran
+        under an in-flight dispatch's device shadow (see flight.py).
+        Hot-path discipline (graftcheck OBS503): deque appends and
         counter bumps only — no I/O, no locks."""
         stall = self._admission_stall()
         kv_used = (
@@ -1295,6 +1386,7 @@ class TpuServingEngine:
         sample = self.flight.sample(
             phase,
             device_s=device_s,
+            overlapped_s=overlapped_s,
             tokens=tokens,
             occupancy=sum(1 for s in self.slots if not s.free),
             queue_depth=self.scheduler.qsize(),
@@ -1543,6 +1635,14 @@ class TpuServingEngine:
                 "light": self._light_chunks,
                 "heavy": self._heavy_chunks,
             },
+            # pipelined loop posture + the bounded device-upload caches
+            # (size/hits/misses/evictions — the eviction counter is the
+            # long-lived-engine leak canary the LRU bound exists for)
+            "pipeline": self._pipeline_on,
+            "device-cache": {
+                "tables": self._tables_dev_cache.stats(),
+                "sampler": self._sampler_dev_cache.stats(),
+            },
             # per-phase dispatched-step counts (flight recorder): lets a
             # running engine decompose where its dispatches go without a
             # bench run
@@ -1584,8 +1684,9 @@ class TpuServingEngine:
         self.params = None
         self.cache_k = self.cache_v = None
         self._decode_chunk_fns.clear()
-        self._tables_dev_cache = None
-        self._sampler_dev_cache = None
+        self._pending_chunk = None
+        self._tables_dev_cache.clear()
+        self._sampler_dev_cache.clear()
 
     # ------------------------------------------------------------------
     # engine loop
@@ -1612,6 +1713,16 @@ class TpuServingEngine:
         while not self._stop:
             try:
                 if not self.scheduler.empty():
+                    await self._admit(loop)
+                # a pipelined burst may have left a decode chunk in
+                # flight: drained only AFTER admission so the prefill
+                # above dispatched under its device shadow, and BEFORE
+                # preemption so a victim's slot state is settled when the
+                # snapshot is taken
+                await self._drain_pending(loop)
+                if not self.scheduler.empty():
+                    # slots the drained chunk just freed are admission
+                    # opportunities NOW, not one burst later
                     await self._admit(loop)
                     # QoS preemption: admission stalled on KV pressure
                     # with a higher-priority request waiting → preempt
@@ -1689,6 +1800,11 @@ class TpuServingEngine:
             error=f"{type(error).__name__}: {error}"[:200],
             inflight=sum(1 for s in self.slots if not s.free),
         )
+        # a pending pipelined chunk belongs to the failed dispatch stream:
+        # drop it (every slot below is failed + released uniformly anyway)
+        self._pending_chunk = None
+        self._defer_release = False
+        self._deferred_releases.clear()
         for slot_id, slot in enumerate(self.slots):
             request = slot.request
             if request is not None and not request.future.done():
@@ -1966,7 +2082,7 @@ class TpuServingEngine:
             ):
                 return
 
-    def _burst_should_yield(self, finished: bool) -> bool:
+    def _burst_should_yield(self, finished: bool, pipelined: bool = False) -> bool:
         """End the decode burst only when the engine loop can actually make
         progress elsewhere: a slot just freed (admission now possible),
         queued work can land in an already-free slot, the engine is
@@ -1977,9 +2093,20 @@ class TpuServingEngine:
         ~70ms over a tunneled chip, and the saturated bench held a full
         admission queue for its whole duration — every chunk became its own
         burst, serializing ~500ms of host RPCs against 787ms of device
-        compute)."""
-        if finished or self._stop or self._has_prefilling():
+        compute).
+
+        Pipelined bursts additionally survive a finish when nobody is
+        queued: the finished slot is frozen in the device-side active mask
+        from the next dispatch on (its over-run tokens discarded host-side,
+        never billed), so mixed-length workloads don't tear the pipeline
+        down — and re-pay its teardown/rebuild — once per completion. The
+        sequential reference loop keeps the yield-on-finish behavior."""
+        if self._stop or self._has_prefilling():
             return True
+        if finished:
+            # a freed slot is an admission opportunity the moment anyone
+            # is queued; otherwise the pipelined loop freezes it in place
+            return not (pipelined and self.scheduler.empty())
         if self.scheduler.empty():
             return False
         if os.environ.get("LS_TPU_STICKY_BURSTS", "1") == "0":
@@ -1995,64 +2122,93 @@ class TpuServingEngine:
         ])
     ))
 
-    def _fetch_chunk(self, out) -> tuple[np.ndarray, np.ndarray, float]:
-        """ONE device→host transfer per chunk: tokens and bitcast logprobs
-        ride the same array (each np.asarray is a synchronous RPC over a
-        tunneled chip — two fetches is two round trips). The third element
-        is the seconds this call spent blocked on the device — the chunk's
-        un-overlapped device wait, which the flight recorder subtracts
-        from wall time to expose the host share."""
-        tokens, lps = out[0], out[1]
-        K, B = tokens.shape
+    def _fetch_chunk(
+        self, packed, k_steps: int
+    ) -> tuple[np.ndarray, np.ndarray, float]:
+        """The designated fetch stage (graftcheck PERF701 polices syncs
+        anywhere else on the dispatch path): ONE device→host transfer per
+        chunk — tokens and bitcast logprobs ride the same packed array,
+        whose D2H copy the dispatch already started asynchronously. The
+        third element is the seconds this call spent blocked on the
+        device — the chunk's un-overlapped device wait, which the flight
+        recorder subtracts from wall time to expose the host share."""
+        B = self.config.slots
+        n = k_steps * B
         t_dev = time.monotonic()
-        packed = np.asarray(self._pack_chunk(tokens, lps))
+        flat = np.asarray(packed)
         fetch_s = time.monotonic() - t_dev
         return (
-            packed[: K * B].reshape(K, B),
-            packed[K * B:].view(np.float32).reshape(K, B),
+            flat[:n].reshape(k_steps, B),
+            flat[n:].view(np.float32).reshape(k_steps, B),
             fetch_s,
         )
 
+    @staticmethod
+    def _chunk_ready(packed) -> bool:
+        """Non-blocking completion probe for an in-flight packed chunk
+        (overlap accounting only — never a sync): True once the device
+        has finished producing it. Backends without the probe report
+        not-ready, i.e. the pre-readiness-bounded accounting."""
+        try:
+            return bool(packed.is_ready())
+        except AttributeError:
+            return False
+
+    @staticmethod
+    def _start_fetch(packed) -> None:
+        """Begin the packed chunk's device→host copy without blocking, so
+        the transfer rides under the next dispatch's device shadow and the
+        deferred wait in :meth:`_fetch_chunk` finds the bytes already in
+        flight (or landed)."""
+        try:
+            packed.copy_to_host_async()
+        except AttributeError:  # backends without async D2H: fetch blocks
+            pass
+
     def _tables_device(self, tables: np.ndarray | None):
-        """Device copy of the block tables, re-uploaded only when they
-        changed (most chunks allocate no new blocks; the upload RPC is the
-        cost that matters, not the 4KB payload)."""
+        """Device copy of the block tables, re-uploaded only on a content
+        miss (most chunks allocate no new blocks; the upload RPC is the
+        cost that matters, not the 4KB payload). LRU-bounded: see
+        :class:`_DeviceLru`."""
         if tables is None:
             return None
-        raw = tables.tobytes()
-        cached = self._tables_dev_cache
-        if cached is None or cached[0] != raw:
-            self._tables_dev_cache = (raw, jnp.asarray(tables))
-        return self._tables_dev_cache[1]
+        return self._tables_dev_cache.get_or_put(
+            tables.tobytes(), lambda: jnp.asarray(tables)
+        )
 
     def _sampler_device(self, active_mask: np.ndarray):
         """Device copies of (active mask, temps, topks, topps), re-uploaded
-        only when the slot population changed (4 upload RPCs per burst
-        otherwise)."""
+        only on a content miss (4 upload RPCs per burst otherwise) —
+        LRU-bounded, so the pipelined loop's finished-slot mask refreshes
+        flip between populations without re-uploading each time."""
         raw = (
             active_mask.tobytes() + self._temps.tobytes()
             + self._topks.tobytes() + self._topps.tobytes()
         )
-        cached = self._sampler_dev_cache
-        if cached is None or cached[0] != raw:
-            self._sampler_dev_cache = (
-                raw,
-                (
-                    jnp.asarray(active_mask),
-                    jnp.asarray(self._temps),
-                    jnp.asarray(self._topks),
-                    jnp.asarray(self._topps),
-                ),
-            )
-        return self._sampler_dev_cache[1]
+        return self._sampler_dev_cache.get_or_put(
+            raw,
+            lambda: (
+                jnp.asarray(active_mask),
+                jnp.asarray(self._temps),
+                jnp.asarray(self._topks),
+                jnp.asarray(self._topps),
+            ),
+        )
 
     async def _decode_burst(self, loop, active: list[int]) -> None:
-        """Pipelined chunk decoding: chunk k+1 is dispatched from chunk k's
-        *device-resident* outputs before k's tokens reach the host, so the
-        host round-trip (the dominant per-chunk cost on tunneled chips, and
-        a real cost on local ones) overlaps device compute. Slots that
-        finish inside a speculative chunk burn a few wasted steps; the host
-        discards their tail. The burst ends when admission work appears.
+        """Depth-2 pipelined chunk decoding (docs/PIPELINE.md): chunk k+1
+        is dispatched from chunk k's *device-resident* outputs before k's
+        tokens reach the host (the sampler feedback never round-trips),
+        the packed fetch is started asynchronously at dispatch, and the
+        host's fetch/detokenize/stop-check/emit work for chunk k runs
+        under chunk k+1's device shadow — recorded as the sample's
+        ``host_overlapped_ms``. Slots that finish inside an in-flight
+        chunk are frozen in the device-side active mask from the next
+        dispatch on; their over-run tokens are discarded host-side and
+        never billed. The burst ends when admission work appears, leaving
+        its in-flight chunk pending so the admission prefill dispatches
+        under that chunk's shadow (drained identity-filtered afterwards —
+        see :meth:`_drain_pending`).
 
         Light-load regime (active slots <= ``_light_threshold``): the burst
         fuses only ``decode_chunk_light`` steps per dispatch and runs them
@@ -2060,7 +2216,11 @@ class TpuServingEngine:
         request reaches prefill after at most one short chunk instead of
         two long ones. The device idles for one host round-trip between
         chunks, which is free precisely when the engine is under-loaded;
-        past the threshold the pipelined big-chunk path takes over."""
+        past the threshold the pipelined big-chunk path takes over. The
+        same sequential loop serves penalty bursts and the
+        ``pipeline=False`` / ``LS_TPU_PIPELINE=0`` escape hatch — it is
+        the reference the pipelined loop's greedy byte-identity is tested
+        against."""
         key1 = self._split_key()
         active_mask = np.zeros(self.config.slots, dtype=bool)
         active_mask[active] = True
@@ -2155,14 +2315,17 @@ class TpuServingEngine:
             if self._lockstep is not None:
                 # runs on the single dispatch thread → broadcast order is
                 # dispatch order. Speculative chunks ("decode_cont") carry
-                # only control: followers chain their own device-resident
-                # tokens/lengths outputs, so nothing syncs to host here.
+                # only control (plus the active mask, so a mid-burst
+                # finished-slot freeze reaches followers): followers chain
+                # their own device-resident tokens/lengths outputs, so
+                # nothing syncs to host here.
                 desc: dict[str, Any] = {
                     "op": "decode" if first else "decode_cont",
                     "sampler_mode": list(sampler_mode),
                     "window": window,
                     "k": K,
                     "key": np.asarray(key),
+                    "active": active_mask,
                 }
                 if tables is not None:
                     desc["tables"] = tables  # host snapshot from _grow_blocks
@@ -2180,7 +2343,6 @@ class TpuServingEngine:
                     desc.update(
                         tokens=np.asarray(self._current),
                         lengths=np.asarray(self._lengths),
-                        active=active_mask,
                         temps=np.asarray(self._temps),
                         topks=np.asarray(self._topks),
                         topps=np.asarray(self._topps),
@@ -2209,7 +2371,12 @@ class TpuServingEngine:
             )
             chunk_t, chunk_lp, t, l, ck, cv = decode_fn(*args)
             self.cache_k, self.cache_v = ck, cv
-            return chunk_t, chunk_lp, t, l
+            # pack tokens+logprobs NOW and start their D2H copy: by the
+            # time the deferred _fetch_chunk wait runs, the transfer has
+            # been riding under this dispatch's own device shadow
+            packed = self._pack_chunk(chunk_t, chunk_lp)
+            self._start_fetch(packed)
+            return packed, t, l
 
         def _bucket_for(max_len: int):
             return (
@@ -2225,10 +2392,14 @@ class TpuServingEngine:
             ),
         )
         chunk_index = 0
-        if light or pen:
+        if light or pen or not self._pipeline_on:
+            # the SEQUENTIAL reference loop (also the light-load / penalty
+            # posture): one chunk in flight at a time, burst torn down on
+            # any finish — byte-identical greedy output is defined here,
+            # and the pipelined loop below is equivalence-tested against it
             while True:
                 chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
-                    self._executor, partial(self._fetch_chunk, out)
+                    self._executor, partial(self._fetch_chunk, out[0], K)
                 )
                 gen_before = self.total_generated
                 finished = self._process_chunk(chunk_t, chunk_lp, active)
@@ -2245,45 +2416,155 @@ class TpuServingEngine:
                 # blocks grow with a fixed one-chunk lookahead
                 out = await loop.run_in_executor(
                     self._executor,
-                    partial(_dispatch, out[2], out[3], self._split_key(),
+                    partial(_dispatch, out[1], out[2], self._split_key(),
                             _bucket_for(base_max), _grow_blocks(0)),
                 )
-        while True:
-            # speculate the next chunk from device state
-            base_max += K
-            chunk_index += 1
-            key_next = self._split_key()
-            # pipelined: exactly one dispatched chunk is still unprocessed
-            # when the speculative chunk is dispatched
-            next_out_task = loop.run_in_executor(
-                self._executor,
-                partial(_dispatch, out[2], out[3], key_next,
-                        _bucket_for(base_max), _grow_blocks(1)),
-            )
+
+        async def _drain(out, expected, overlapped_s: float = 0.0) -> None:
+            """Fetch + apply one dispatched chunk (the burst's tail or an
+            all-finished over-run): identity-filtered so tokens never land
+            on a request the slot no longer runs."""
             chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
-                self._executor, partial(self._fetch_chunk, out)
+                self._executor, partial(self._fetch_chunk, out[0], K)
             )
             gen_before = self.total_generated
-            finished = self._process_chunk(chunk_t, chunk_lp, active)
+            self._process_chunk(chunk_t, chunk_lp, active, expected=expected)
             self._flight_record(
-                "decode", device_s=fetch_s,
+                "decode", device_s=fetch_s, overlapped_s=overlapped_s,
                 tokens=self.total_generated - gen_before,
             )
             await self._flush_emits(active)
-            out = await next_out_task
-            if self._burst_should_yield(finished):
-                # drain the speculative chunk, then hand back to the loop
-                chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
-                    self._executor, partial(self._fetch_chunk, out)
+
+        # the PIPELINED depth-2 loop: chunk N+1 executes on device while
+        # the host fetches/processes chunk N under its shadow. Finished
+        # slots' block releases are deferred to burst exit — an in-flight
+        # chunk commits via the tables captured at its dispatch, and no
+        # mid-burst allocation may reuse those blocks under it.
+        self._defer_release = self.block_mgr is not None
+        finished = False
+        try:
+            while True:
+                if finished:
+                    # device-side finished-slot mask: slots that completed
+                    # inside chunk N freeze in place from the next dispatch
+                    # on (the decode jit holds their token/length wherever
+                    # ``active`` is False); their in-flight over-run tokens
+                    # are discarded host-side and never billed
+                    live = [
+                        i for i in active
+                        if self.slots[i].request is not None
+                    ]
+                    if not live:
+                        await _drain(out, [None] * len(active))
+                        return
+                    if len(live) != len(active):
+                        active = live
+                        active_mask = np.zeros(self.config.slots, dtype=bool)
+                        active_mask[active] = True
+                        amask, temps, topks, topps = self._sampler_device(
+                            active_mask
+                        )
+                # speculate the next chunk from device state
+                base_max += K
+                chunk_index += 1
+                key_next = self._split_key()
+                # pipelined: exactly one dispatched chunk is still
+                # unprocessed when the speculative chunk is dispatched
+                next_out_task = loop.run_in_executor(
+                    self._executor,
+                    partial(_dispatch, out[1], out[2], key_next,
+                            _bucket_for(base_max), _grow_blocks(1)),
                 )
+                chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
+                    self._executor, partial(self._fetch_chunk, out[0], K)
+                )
+                # the dispatch ran before the fetch on the single executor
+                # thread, so this await resolves instantly — we just need
+                # the in-flight chunk's handle for the readiness probes
+                out = await next_out_task
                 gen_before = self.total_generated
-                self._process_chunk(chunk_t, chunk_lp, active)
+                # host work from here to the sample runs under chunk N+1's
+                # device shadow — but credit it as overlapped only while
+                # the device was ACTUALLY still executing (the readiness
+                # probes below), or host-heavy workloads would overstate
+                # the device share and overlap_ratio could never collapse
+                t_overlap = time.monotonic()
+                in_flight = not self._chunk_ready(out[0])
+                finished = self._process_chunk(chunk_t, chunk_lp, active)
+                await self._flush_emits(active)
+                elapsed = time.monotonic() - t_overlap
+                if not in_flight:
+                    overlapped_s = 0.0  # device finished before we started
+                elif not self._chunk_ready(out[0]):
+                    overlapped_s = elapsed  # device outlived all our work
+                else:
+                    overlapped_s = elapsed / 2.0  # finished mid-span
                 self._flight_record(
                     "decode", device_s=fetch_s,
+                    overlapped_s=overlapped_s,
                     tokens=self.total_generated - gen_before,
                 )
-                await self._flush_emits(active)
-                return
+                if self._burst_should_yield(finished, pipelined=True):
+                    if not self._stop:
+                        # carry the in-flight chunk across the burst
+                        # boundary: the loop runs admission FIRST, so
+                        # prefill dispatches interleave under this chunk's
+                        # device execution, and _drain_pending applies it
+                        # afterwards (identity-filtered per slot)
+                        self._pending_chunk = (
+                            out, list(active),
+                            [self.slots[i].request for i in active], K,
+                        )
+                        return
+                    # stopping: nothing will drain a pending chunk — do it
+                    # inline so the flight timeline stays contiguous
+                    await _drain(
+                        out, [self.slots[i].request for i in active]
+                    )
+                    return
+        finally:
+            self._defer_release = False
+            if self._deferred_releases:
+                for slot_id in self._deferred_releases:
+                    self.block_mgr.release(slot_id)
+                self._deferred_releases.clear()
+
+    async def _drain_pending(self, loop) -> None:
+        """Apply the decode chunk the previous pipelined burst left in
+        flight. Runs AFTER admission in the engine loop, so the admission
+        batch's prefill was dispatched under this chunk's device shadow
+        (the "prefill interleave" overlap). Identity-filtered: a slot that
+        finished and was re-admitted between the chunk's dispatch and now
+        must not receive the old request's tokens."""
+        pending = self._pending_chunk
+        if pending is None:
+            return
+        self._pending_chunk = None
+        out, active, expected, k_steps = pending
+        chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
+            self._executor, partial(self._fetch_chunk, out[0], k_steps)
+        )
+        gen_before = self.total_generated
+        self._process_chunk(chunk_t, chunk_lp, active, expected=expected)
+        self._flight_record(
+            "decode", device_s=fetch_s,
+            tokens=self.total_generated - gen_before,
+        )
+        await self._flush_emits(active)
+
+    def _release_blocks(self, slot_id: int) -> None:
+        """Free a finished slot's block reservation — immediately between
+        bursts, DEFERRED to burst exit inside a pipelined burst (the
+        in-flight chunk still commits via tables captured at dispatch;
+        reusing its blocks mid-burst would land stale K/V on a live
+        slot — between bursts the adopting prefill's overwrite makes the
+        immediate release safe)."""
+        if self.block_mgr is None:
+            return
+        if self._defer_release:
+            self._deferred_releases.append(slot_id)
+        else:
+            self.block_mgr.release(slot_id)
 
     async def _advance_prefills(self, loop) -> None:
         """One bounded chunk of progress for every mid-prefill slot, batched
@@ -2359,18 +2640,20 @@ class TpuServingEngine:
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
             )
             t_dev = time.monotonic()
-            # the caller fetched these synchronously anyway (np.asarray on
-            # the loop thread); fencing HERE keeps that single sync but on
-            # the dispatch thread, timed — the sample's device_ms
+            # the ONE per-dispatch sync, on the dispatch thread and timed
+            # (the sample's device_ms); the token/logprob fetch rides the
+            # same stop so the loop thread never blocks on the device
             # graftcheck: disable=JAX104 the one per-dispatch sync, moved off-loop and timed
             jax.block_until_ready(out)
-            return out, time.monotonic() - t_dev
+            device_s = time.monotonic() - t_dev
+            return (
+                np.asarray(out[0]), np.asarray(out[1]), out[2], out[3],
+                device_s,
+            )
 
-        (next_tokens, logprobs, self.cache_k, self.cache_v), device_s = (
+        (next_np, logprob_np, self.cache_k, self.cache_v, device_s) = (
             await loop.run_in_executor(self._executor, _run)
         )
-        next_np = np.asarray(next_tokens)
-        logprob_np = np.asarray(logprobs)
         now = time.monotonic()
         done_slots = []
         for i, slot_id in enumerate(pre):
@@ -2607,12 +2890,17 @@ class TpuServingEngine:
                 out = prefill_fn(*args)
                 t_dev = time.monotonic()
                 # same single sync the loop-thread np.asarray used to pay,
-                # moved onto the dispatch thread so it can be timed
+                # moved onto the dispatch thread so it can be timed; the
+                # token/logprob fetch rides the same stop
                 # graftcheck: disable=JAX104 the one per-dispatch sync, moved off-loop and timed
                 jax.block_until_ready(out)
-                return out, time.monotonic() - t_dev
+                device_s = time.monotonic() - t_dev
+                return (
+                    np.asarray(out[0]), np.asarray(out[1]), out[2], out[3],
+                    device_s,
+                )
 
-            (next_tokens, logprobs, self.cache_k, self.cache_v), device_s = (
+            (next_np, logprob_np, self.cache_k, self.cache_v, device_s) = (
                 await loop.run_in_executor(self._executor, _run)
             )
             if use_prefix:
@@ -2629,8 +2917,6 @@ class TpuServingEngine:
                         self.prefix_tokens += reuse
                         self._m_prefix_hits(1)
                         self._m_prefix_tokens(reuse)
-            next_np = np.asarray(next_tokens)
-            logprob_np = np.asarray(logprobs)
             now = time.monotonic()
             admitted_slots = []
             for i, (slot_id, request, _reuse) in enumerate(batch):
@@ -2652,18 +2938,29 @@ class TpuServingEngine:
             await self._flush_emits(admitted_slots)
 
     def _process_chunk(
-        self, chunk_tokens: np.ndarray, chunk_lps: np.ndarray, active: list[int]
+        self,
+        chunk_tokens: np.ndarray,
+        chunk_lps: np.ndarray,
+        active: list[int],
+        expected: list | None = None,
     ) -> bool:
         """Apply a chunk's tokens to host state; queue emissions. Returns
-        True if any slot finished (→ admission opportunity)."""
+        True if any slot finished (→ admission opportunity).
+
+        ``expected`` (the pipelined drain path) pins each slot to the
+        request it ran when the chunk was dispatched: a slot re-admitted
+        in between (the prefill-interleave window) silently drops the old
+        request's over-run tokens instead of corrupting the new one."""
         K = chunk_tokens.shape[0]
         finished_any = False
         emitted_before = self.total_generated
         eos = self.tokenizer.eos_id
-        for slot_id in active:
+        for pos, slot_id in enumerate(active):
             slot = self.slots[slot_id]
             request = slot.request
             if request is None:
+                continue
+            if expected is not None and request is not expected[pos]:
                 continue
             if (
                 request.stop
@@ -2721,8 +3018,7 @@ class TpuServingEngine:
                 slot.prefilling = False
                 slot.prefill_done = 0
                 self._lengths[slot_id] = 0
-                if self.block_mgr is not None:
-                    self.block_mgr.release(slot_id)
+                self._release_blocks(slot_id)
                 self._finished_requests.append(
                     (request, bool(eos_hits.size))
                 )
@@ -2777,11 +3073,12 @@ class TpuServingEngine:
             slot.prefilling = False
             slot.prefill_done = 0
             self._lengths[slot_id] = 0
-            if self.block_mgr is not None:
-                # safe while a speculative chunk is in flight: it writes via
-                # the tables captured at its dispatch, and those writes land
-                # before any re-allocation's prefill (single executor thread)
-                self.block_mgr.release(slot_id)
+            # release is safe while a speculative chunk is in flight (it
+            # writes via the tables captured at its dispatch, and those
+            # writes land before any re-allocation's prefill — single
+            # executor thread); INSIDE a pipelined burst the release is
+            # deferred to burst exit instead (see _release_blocks)
+            self._release_blocks(slot_id)
             self._finished_requests.append((request, is_eos))
         return done
 

@@ -12,14 +12,25 @@ idle gap, so the samples tile the engine-loop timeline contiguously:
 
 - ``wall_ms`` — time since the previous recorded boundary (the full slice
   of engine-loop wall clock this burst accounts for);
-- ``device_ms`` — time the host spent *blocked on device results* for the
-  burst (measured at the dispatch's block boundary — the fetch/
-  ``block_until_ready`` call). Under the pipelined decode path this is the
-  un-overlapped device wait, which is exactly the number that matters:
-  device time hidden behind host work costs nothing;
-- ``host_ms`` — ``wall − device`` (clamped ≥ 0): Python dispatch, numpy
-  packing, emit callbacks, block accounting — the "unattributed host
-  overhead" bucket BENCH r05 could not see;
+- ``device_ms`` — the slice of wall spent *under device execution*: the
+  blocked device wait (measured at the dispatch's block boundary — the
+  fetch/``block_until_ready`` call) PLUS any host work the pipelined loop
+  ran in the shadow of an in-flight dispatch (``host_overlapped_ms``,
+  also carried per sample). Host time hidden behind device compute costs
+  nothing, so it is credited to the device-busy share rather than to
+  host overhead — and reported separately so the overlap win is visible;
+- ``host_overlapped_ms`` — the host share of ``device_ms``: detokenize/
+  stop-check/emit work the pipelined loop ran while the next chunk
+  executed on device (0 for the sequential loop). The engine bounds the
+  credit with non-blocking device-readiness probes (``is_ready``), so
+  host work that outlives the shadowing dispatch stays EXPOSED — a
+  host-bound engine cannot masquerade as device-bound. Never
+  double-counted: it lives inside ``device_ms``, never inside
+  ``host_ms``;
+- ``host_ms`` — ``wall − device`` (clamped ≥ 0): the *exposed* host time
+  — Python dispatch, numpy packing, emit callbacks, block accounting
+  that ran with the device idle — the "unattributed host overhead"
+  bucket BENCH r05 could not see;
 - ``stall`` — why queued work is not being admitted at this boundary
   (``no-free-slot`` / ``no-kv-blocks`` / ``prefill-in-flight`` /
   ``queue-empty``), plus batch occupancy, queue depth, tokens emitted,
@@ -113,6 +124,7 @@ class FlightRecorder:
         self.wall_ms = 0.0
         self.device_ms = 0.0
         self.host_ms = 0.0
+        self.host_overlapped_ms = 0.0
         self.stall_ms = 0.0
         self.tokens = 0
         self.recompiles = 0
@@ -142,6 +154,7 @@ class FlightRecorder:
         phase: str,
         *,
         device_s: float = 0.0,
+        overlapped_s: float = 0.0,
         tokens: int = 0,
         occupancy: int = 0,
         queue_depth: int = 0,
@@ -153,13 +166,20 @@ class FlightRecorder:
         queue_by_class: dict[str, int] | None = None,
     ) -> dict[str, Any]:
         """Record one dispatched burst. ``wall`` is the time since the
-        previous boundary; ``host = wall − device``. ``queue_by_class``
-        (QoS engines only) keeps the sample schema unchanged for FIFO
-        engines by being omitted when None."""
+        previous boundary. ``overlapped_s`` is host work the pipelined
+        loop ran under an in-flight dispatch's device shadow: it is
+        credited to the device-busy share (``device = wait + overlapped``,
+        clamped to wall) and reported per sample, so
+        ``host = wall − device`` stays the *exposed* host time and the
+        wall decomposition remains exact. ``queue_by_class`` (QoS engines
+        only) keeps the sample schema unchanged for FIFO engines by being
+        omitted when None."""
         now = time.monotonic()
         wall_ms = (now - self._last_mark) * 1000.0
         self._last_mark = now
-        device_ms = max(0.0, min(device_s * 1000.0, wall_ms))
+        wait_ms = max(0.0, min(device_s * 1000.0, wall_ms))
+        overlapped_ms = max(0.0, min(overlapped_s * 1000.0, wall_ms - wait_ms))
+        device_ms = wait_ms + overlapped_ms
         host_ms = wall_ms - device_ms
         self._seq += 1
         entry: dict[str, Any] = {
@@ -172,6 +192,7 @@ class FlightRecorder:
             "wall_ms": round(wall_ms, 3),
             "device_ms": round(device_ms, 3),
             "host_ms": round(host_ms, 3),
+            "host_overlapped_ms": round(overlapped_ms, 3),
             "occupancy": occupancy,
             "slots": self.slots,
             "tokens": tokens,
@@ -190,6 +211,7 @@ class FlightRecorder:
         self.wall_ms += wall_ms
         self.device_ms += device_ms
         self.host_ms += host_ms
+        self.host_overlapped_ms += overlapped_ms
         self.tokens += tokens
         self.steps_by_phase[phase] = self.steps_by_phase.get(phase, 0) + 1
         if stall:
@@ -225,6 +247,7 @@ class FlightRecorder:
             "wall_ms": round(wall_ms, 3),
             "device_ms": 0.0,
             "host_ms": 0.0,
+            "host_overlapped_ms": 0.0,
             "occupancy": occupancy,
             "slots": self.slots,
             "tokens": 0,
@@ -294,6 +317,16 @@ class FlightRecorder:
         walls = sorted(s["wall_ms"] for s in dispatch)
         hosts = sorted(s["host_ms"] for s in dispatch)
         devices = sorted(s["device_ms"] for s in dispatch)
+        overlaps = sorted(
+            s.get("host_overlapped_ms", 0.0) for s in dispatch
+        )
+        # window overlap ratio: the share of host work the pipelined loop
+        # hid behind device compute (None when the window did no host work)
+        overlapped_sum = sum(overlaps)
+        host_sum = overlapped_sum + sum(hosts)
+        overlap_ratio = (
+            round(overlapped_sum / host_sum, 4) if host_sum > 0 else None
+        )
         queue_depths = sorted(s["queue_depth"] for s in window)
         # the samples tile the timeline, so the retained window's span is
         # the (monotonic) sum of its wall slices — no wall-clock arithmetic
@@ -311,6 +344,7 @@ class FlightRecorder:
                 "wall_ms": round(self.wall_ms, 3),
                 "device_ms": round(self.device_ms, 3),
                 "host_ms": round(self.host_ms, 3),
+                "host_overlapped_ms": round(self.host_overlapped_ms, 3),
                 "stall_ms": round(self.stall_ms, 3),
                 "tokens": self.tokens,
                 "steps_by_phase": dict(self.steps_by_phase),
@@ -334,6 +368,12 @@ class FlightRecorder:
                 "step_ms_p50": _pct(walls, 0.50),
                 "step_ms_p95": _pct(walls, 0.95),
                 "host_overhead_ms_p50": _pct(hosts, 0.50),
+                # the pipelined-loop naming of the same split: exposed =
+                # host_ms (kept under its legacy key above for old
+                # consumers), overlapped = host work under device shadow
+                "host_exposed_ms_p50": _pct(hosts, 0.50),
+                "host_overlapped_ms_p50": _pct(overlaps, 0.50),
+                "overlap_ratio": overlap_ratio,
                 "device_ms_p50": _pct(devices, 0.50),
                 "queue_depth_p95": _pct(queue_depths, 0.95),
                 "occupancy_mean": (
@@ -354,6 +394,9 @@ def bench_rollup(summary: dict[str, Any]) -> dict[str, Any]:
     window = summary.get("window", {})
     return {
         "host_overhead_ms_p50": window.get("host_overhead_ms_p50"),
+        "host_exposed_ms_p50": window.get("host_exposed_ms_p50"),
+        "overlap_ratio": window.get("overlap_ratio"),
+        "step_ms_p50": window.get("step_ms_p50"),
         "stall_s_by_reason": totals.get("stall_s_by_reason"),
         "blocked_s_by_reason": totals.get("blocked_s_by_reason"),
         "queue_depth_p95": window.get("queue_depth_p95"),
@@ -364,6 +407,7 @@ def bench_rollup(summary: dict[str, Any]) -> dict[str, Any]:
                 "wall_ms",
                 "device_ms",
                 "host_ms",
+                "host_overlapped_ms",
                 "stall_ms",
                 "tokens",
                 "steps_by_phase",

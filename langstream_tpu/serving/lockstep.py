@@ -355,6 +355,12 @@ class LockstepFollower:
                     lengths = jnp.asarray(desc["lengths"])
                 else:
                     tokens, lengths = carry_tokens, carry_lengths
+                    if "active" in desc:
+                        # pipelined finished-slot freeze: the leader
+                        # refreshes the active mask mid-burst; followers
+                        # must apply the same mask or their frozen slots'
+                        # device state diverges from the leader's
+                        burst["active"] = jnp.asarray(desc["active"])
                 window = desc.get("window")
                 pen = bool(desc.get("pen"))
                 fn = engine._decode_fn(

@@ -138,7 +138,8 @@ def test_bench_rollup_carries_the_record_keys():
     recorder.event("recompile", what="decode")
     rollup = bench_rollup(recorder.summary())
     assert set(rollup) == {
-        "host_overhead_ms_p50", "stall_s_by_reason", "blocked_s_by_reason",
+        "host_overhead_ms_p50", "host_exposed_ms_p50", "overlap_ratio",
+        "step_ms_p50", "stall_s_by_reason", "blocked_s_by_reason",
         "queue_depth_p95", "recompile_count", "totals",
     }
     assert rollup["recompile_count"] == 1
@@ -146,8 +147,8 @@ def test_bench_rollup_carries_the_record_keys():
     assert "no-kv-blocks" in rollup["blocked_s_by_reason"]
     assert rollup["stall_s_by_reason"] == {}
     assert set(rollup["totals"]) == {
-        "wall_ms", "device_ms", "host_ms", "stall_ms", "tokens",
-        "steps_by_phase",
+        "wall_ms", "device_ms", "host_ms", "host_overlapped_ms", "stall_ms",
+        "tokens", "steps_by_phase",
     }
     # rollups must be JSON-clean for the bench record line
     json.dumps(rollup)

@@ -787,6 +787,115 @@ def test_qos601_suppressed_with_reason():
 
 
 # --------------------------------------------------------------------------
+# PERF701 — synchronous device fetch on the dispatch path outside the
+# designated fetch stage
+# --------------------------------------------------------------------------
+
+
+def test_perf701_tp_sync_fetch_in_decode_burst():
+    """np.asarray on the dispatch path (outside _fetch_chunk/_run) is the
+    host-serializing fetch the pipelined loop exists to avoid."""
+    ids = rule_ids(
+        """
+        import numpy as np
+
+        class Engine:
+            async def _decode_burst(self, loop, active):
+                out = self._decode_fn()
+                tokens = np.asarray(out[0])  # eager fetch, not deferred
+                return tokens
+        """
+    )
+    assert ids == ["PERF701"]
+
+
+def test_perf701_tp_item_and_block_until_ready_in_dispatch_closure():
+    """Nested dispatch closures (not named _run/_fetch*) inherit the
+    dispatch-path scope: per-element fetches there still serialize."""
+    ids = rule_ids(
+        """
+        class Engine:
+            async def _decode_burst(self, loop, active):
+                def _dispatch(tokens):
+                    out = self._decode_fn(tokens)
+                    return out[0].block_until_ready()
+
+                first = self._lengths[0].item()
+                return _dispatch(first)
+        """
+    )
+    assert ids == ["PERF701", "PERF701"]
+
+
+def test_perf701_tn_fetch_stage_and_lockstep_and_other_files():
+    # the designated fetch stages stay silent
+    assert (
+        rule_ids(
+            """
+            import numpy as np
+
+            class Engine:
+                def _fetch_chunk(self, packed, k_steps):
+                    return np.asarray(packed)
+
+                async def _admit(self, loop):
+                    def _run():
+                        out = self._prefill_fn()
+                        return np.asarray(out[0])
+
+                    return await loop.run_in_executor(None, _run)
+            """
+        )
+        == []
+    )
+    # the lockstep broadcast branch ships host bytes by protocol
+    assert (
+        rule_ids(
+            """
+            import numpy as np
+
+            class Engine:
+                async def _decode_burst(self, loop, active):
+                    def _dispatch(key):
+                        if self._lockstep is not None:
+                            self._lockstep.broadcast({"key": np.asarray(key)})
+                        return self._decode_fn(key)
+
+                    return _dispatch(self._split_key())
+            """
+        )
+        == []
+    )
+    # outside serving/engine.py the rule does not apply
+    assert (
+        rule_ids(
+            """
+            import numpy as np
+
+            class Engine:
+                async def _decode_burst(self, loop, active):
+                    return np.asarray(active)
+            """,
+            path="langstream_tpu/serving/lockstep.py",
+        )
+        == []
+    )
+
+
+def test_perf701_tn_host_math_outside_dispatch_methods():
+    ids = rule_ids(
+        """
+        import numpy as np
+
+        class Engine:
+            def stats(self):
+                return np.asarray([1, 2, 3]).tolist()
+        """
+    )
+    assert ids == []
+
+
+# --------------------------------------------------------------------------
 # suppressions + GC000
 # --------------------------------------------------------------------------
 

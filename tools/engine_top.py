@@ -5,7 +5,9 @@ Live mode polls a pod's ``/flight`` endpoint (or the control plane's
 ``/api/applications/{tenant}/{name}/flight`` fan-in — any URL returning the
 flight report shape works) and renders a one-screen view per engine:
 occupancy bar, tok/s, a step-time sparkline, the device/host/stall
-decomposition, admission-stall breakdown by reason, KV-pool utilization,
+decomposition with the pipelined loop's overlapped-vs-exposed host split
+(``overlap_ratio``), admission-stall breakdown by reason, KV-pool
+utilization,
 the QoS scheduler state (per-class queue depths, per-tenant throttle
 counts, shed/preempt tallies plus their event tail), and the
 discrete-event tail (recompiles, pool growth, warmup, preemptions).
@@ -18,7 +20,8 @@ Post-mortem mode decomposes a saved dump — either a raw ``/flight``
 payload (``curl pod:8080/flight > dump.json``) or a bench record whose
 ``flight`` rollup rode along (BENCH_r06+) — into mean-step device/host/
 stall shares and flags anomaly windows: recompile storms, KV-pool
-exhaustion, and unbounded queue growth.
+exhaustion, unbounded queue growth, and pipeline overlap collapse
+(sustained ``overlap_ratio`` near 0 while occupancy is high).
 
     python tools/engine_top.py --analyze dump.json
     python tools/engine_top.py --analyze BENCH_r06.json
@@ -104,6 +107,20 @@ def render(report: list[dict]) -> str:
             f"host-overhead p50 {_fmt_ms(window.get('host_overhead_ms_p50'))}  "
             f"device p50 {_fmt_ms(window.get('device_ms_p50'))}"
         )
+        # pipelined-loop host split: exposed (device idle) vs overlapped
+        # (hidden under an in-flight dispatch) — absent on old payloads
+        if window.get("overlap_ratio") is not None or window.get(
+            "host_overlapped_ms_p50"
+        ) is not None:
+            ratio = window.get("overlap_ratio")
+            lines.append(
+                f"host     exposed p50 "
+                f"{_fmt_ms(window.get('host_exposed_ms_p50'))}  "
+                f"overlapped p50 "
+                f"{_fmt_ms(window.get('host_overlapped_ms_p50'))}  "
+                f"overlap "
+                + (f"{100 * ratio:.1f}%" if ratio is not None else "-")
+            )
         wall, device_pct, host_pct, stall_pct = _shares(totals)
         lines.append(
             f"decomp   device {device_pct:.1f}%  host {host_pct:.1f}%  "
@@ -322,7 +339,69 @@ def _anomalies(entry: dict) -> list[str]:
                 f"backing up; raise its weight, add slots/replicas, or "
                 f"shed batch harder"
             )
+    collapse = _overlap_collapse(entry, summary, totals, samples)
+    if collapse:
+        flags.append(collapse)
     return flags
+
+
+def _overlap_collapse(
+    entry: dict, summary: dict, totals: dict, samples: list
+) -> str | None:
+    """Pipeline overlap collapse: a loaded engine whose host work is all
+    EXPOSED (sustained ``overlap_ratio`` near 0 while occupancy is high)
+    has lost the depth-2 pipeline — a penalty-sampling workload pinning
+    the sequential path, ``LS_TPU_PIPELINE=0`` left on after a debug
+    session, or a regression serializing fetches. Light load is exempt:
+    the engine runs the sequential light-chunk regime there by design."""
+    window = summary.get("window") or {}
+    decode_samples = [s for s in samples if s.get("phase") == "decode"]
+    if decode_samples and not any(
+        "host_overlapped_ms" in s for s in decode_samples
+    ):
+        # pre-pipeline dump: the split was never recorded — absence is
+        # not collapse (the render path guards old payloads the same way)
+        return None
+    if len(decode_samples) >= 8:
+        # sustained, from the raw window: decode host time overwhelmingly
+        # exposed while the batch is more than half full
+        overlapped = sum(
+            s.get("host_overlapped_ms") or 0.0 for s in decode_samples
+        )
+        host = sum(s.get("host_ms") or 0.0 for s in decode_samples)
+        slots = max((s.get("slots") or 0) for s in decode_samples)
+        occ = sum(s.get("occupancy") or 0 for s in decode_samples) / len(
+            decode_samples
+        )
+        if (
+            host + overlapped > 0
+            and overlapped / (host + overlapped) < 0.05
+            and slots
+            and occ > slots / 2
+        ):
+            return (
+                f"pipeline overlap collapse: {overlapped:.1f}ms of "
+                f"{host + overlapped:.1f}ms decode host time overlapped "
+                f"(<5%) at occupancy {occ:.1f}/{slots} — check "
+                f"LS_TPU_PIPELINE/pipeline config, or whether the "
+                f"workload pins the sequential (penalty/light) path"
+            )
+        return None
+    # rollup-only dumps (bench records): overlap_ratio survives at the
+    # top level, occupancy doesn't — require a material decode run
+    ratio = window.get("overlap_ratio", entry.get("overlap_ratio"))
+    steps = (totals.get("steps_by_phase") or {}).get("decode", 0)
+    host_ms = (totals.get("host_ms") or 0.0) + (
+        totals.get("host_overlapped_ms") or 0.0
+    )
+    if ratio is not None and ratio < 0.05 and steps >= 8 and host_ms > 50.0:
+        return (
+            f"pipeline overlap collapse: overlap_ratio {ratio} over "
+            f"{steps} decode steps ({host_ms:.0f}ms host) — check "
+            f"LS_TPU_PIPELINE/pipeline config, or whether the workload "
+            f"pins the sequential (penalty/light) path"
+        )
+    return None
 
 
 def analyze(dump) -> str:
@@ -361,6 +440,14 @@ def analyze(dump) -> str:
         lines.append(
             f"  host   {host_pct:5.1f}%  ({_fmt_ms(totals.get('host_ms'))})"
         )
+        if totals.get("host_overlapped_ms"):
+            # inside the device share, reported separately (never
+            # double-counted): host work hidden under device compute
+            lines.append(
+                f"  ^ overlapped host "
+                f"{_fmt_ms(totals.get('host_overlapped_ms'))} rode inside "
+                f"the device share"
+            )
         lines.append(
             f"  stall  {stall_pct:5.1f}%  ({_fmt_ms(totals.get('stall_ms'))})"
         )
