@@ -1,6 +1,6 @@
 """graftcheck: first-party static analysis for the langstream-tpu tree.
 
-Eight rule families tuned to this codebase's actual failure modes:
+Ten rule families tuned to this codebase's actual failure modes:
 
 ==========  ==============================================================
 JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
@@ -19,12 +19,27 @@ QOS601      backpressure: unbounded ``asyncio.Queue()`` in ``serving/``
             or ``gateway/`` (defeats QoS load shedding)
 PERF701     pipeline fetch discipline: synchronous device fetches on the
             engine dispatch path outside the designated fetch stage
+RACE801/2   whole-program thread-role races: instance state written on
+            one thread role (async loop / dispatch thread / worker) and
+            touched on another without a lock or handoff; collections
+            mutated in one role while iterated in another
+INV901/902  engine invariants across the call graph: block releases on
+            the burst-dispatch path outside the sanctioned deferral, and
+            device syncs reachable from the dispatch path beyond the
+            method bodies PERF701 sees
 ==========  ==============================================================
 
+RACE/INV are **project rules**: they run over a whole-program index
+(``analysis/project.py`` — symbol table, call graph, thread roles,
+per-class attribute access sets) instead of one file at a time. GC001
+flags suppressions that no longer silence anything, so escapes can't rot.
+
 Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),
-``--changed`` for files differing from HEAD only. Gate: the whole tree is
-linted in tier-1 by ``tests/test_graftcheck.py``. Policy, suppression
-syntax, and the baseline rules live in ``docs/ANALYSIS.md``.
+``--changed`` for files differing from HEAD (plus their call-graph
+dependents, which project rules need), ``--format json|sarif`` for CI.
+Gate: the whole tree is linted in tier-1 by ``tests/test_graftcheck.py``
+inside a wall-time budget. Policy, suppression syntax, the thread-role
+model, and the baseline rules live in ``docs/ANALYSIS.md``.
 """
 
 from __future__ import annotations
@@ -41,12 +56,15 @@ from langstream_tpu.analysis.core import (
     load_baseline,
     run,
 )
+from langstream_tpu.analysis.project import ProjectIndex, ProjectRule
 from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
 from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
+from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
 from langstream_tpu.analysis.rules_perf import RULES as _PERF_RULES
 from langstream_tpu.analysis.rules_qos import RULES as _QOS_RULES
+from langstream_tpu.analysis.rules_race import RULES as _RACE_RULES
 from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
 
 ALL_RULES: list[Rule] = [
@@ -59,15 +77,26 @@ ALL_RULES: list[Rule] = [
     *_PERF_RULES,
 ]
 
+#: whole-program rules (run over the ProjectIndex, not per file)
+PROJECT_RULES: list[ProjectRule] = [
+    *_RACE_RULES,
+    *_INV_RULES,
+]
+
 RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}
+PROJECT_RULES_BY_ID: dict[str, ProjectRule] = {r.id: r for r in PROJECT_RULES}
 
 __all__ = [
     "ALL_RULES",
+    "PROJECT_RULES",
     "RULES_BY_ID",
+    "PROJECT_RULES_BY_ID",
     "BASELINE_PATH",
     "BaselineEntry",
     "Finding",
     "Module",
+    "ProjectIndex",
+    "ProjectRule",
     "Report",
     "Rule",
     "analyze_source",

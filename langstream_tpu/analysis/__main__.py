@@ -3,12 +3,20 @@
 Modes:
 
 - no args — lint the whole ``langstream_tpu/`` tree against the baseline
-  (exactly what the tier-1 gate runs);
-- ``--changed`` — lint only files that differ from ``HEAD`` (inner-loop
-  mode: fast enough to run on every save);
-- explicit paths — lint those files/dirs;
+  (exactly what the tier-1 gate runs): per-file rules AND the
+  whole-program project rules (RACE/INV);
+- ``--changed`` — lint only files that differ from ``HEAD`` *plus their
+  call-graph dependents*: project rules see cross-file effects, so a
+  change to a helper must re-report the modules whose call graphs reach
+  it (the index build is content-hash cached, so this stays inner-loop
+  fast);
+- explicit paths — lint those files/dirs (project rules still index the
+  whole package for call-graph context; findings are filtered to the
+  requested files);
 - ``--list-rules`` — print every rule id and summary;
-- ``--no-baseline`` — report baselined findings too (audit mode).
+- ``--no-baseline`` — report baselined findings too (audit mode);
+- ``--format text|json|sarif`` — machine-readable output for CI
+  annotation (SARIF 2.1.0).
 
 Exit code 0 = clean, 1 = violations (or stale baseline entries), 2 = usage
 or parse errors.
@@ -17,6 +25,7 @@ or parse errors.
 from __future__ import annotations
 
 import argparse
+import json
 import subprocess
 import sys
 from pathlib import Path
@@ -24,11 +33,19 @@ from pathlib import Path
 from langstream_tpu.analysis import (
     ALL_RULES,
     BASELINE_PATH,
+    PROJECT_RULES,
     iter_py_files,
     load_baseline,
     run,
 )
-from langstream_tpu.analysis.core import PACKAGE_ROOT, REPO_ROOT
+from langstream_tpu.analysis.core import PACKAGE_ROOT, REPO_ROOT, Report
+from langstream_tpu.analysis.project import ProjectIndex
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def _changed_files() -> list[Path]:
@@ -53,6 +70,137 @@ def _changed_files() -> list[Path]:
     return sorted(set(files))
 
 
+def expand_with_dependents(
+    changed: list[Path],
+) -> tuple[list[Path], int, ProjectIndex | None]:
+    """``--changed`` soundness for project rules: a changed file can alter
+    findings in any module whose import/call graph reaches it, so the
+    scan set is the closure over the package index. Returns the expanded
+    file list, how many dependents were added, and the whole-package
+    index (handed to ``run()`` so it isn't resolved twice)."""
+    if not changed:
+        return changed, 0, None
+    index = ProjectIndex.build_from_paths(
+        iter_py_files(PACKAGE_ROOT), repo_root=REPO_ROOT
+    )
+    changed_rel = set()
+    for path in changed:
+        try:
+            changed_rel.add(
+                path.resolve().relative_to(REPO_ROOT.resolve()).as_posix()
+            )
+        except ValueError:
+            continue
+    closure = index.dependents(changed_rel)
+    extra = sorted(closure - changed_rel)
+    expanded = list(changed) + [REPO_ROOT / rel for rel in extra]
+    return expanded, len(extra), index
+
+
+def _all_rule_meta() -> list[tuple[str, str]]:
+    return [(r.id, r.summary) for r in ALL_RULES] + [
+        (r.id, r.summary) for r in PROJECT_RULES
+    ]
+
+
+def _as_json(report: Report, stale: list) -> dict:
+    def enc(f):
+        return {
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "symbol": f.symbol, "message": f.message,
+        }
+
+    return {
+        "violations": [enc(f) for f in report.new],
+        "baselined": [enc(f) for f in report.baselined],
+        "stale_baseline": [
+            {"rule": e.rule, "path": e.path, "symbol": e.symbol}
+            for e in stale
+        ],
+        "parse_errors": list(report.parse_errors),
+        "analysis_seconds": round(report.analysis_seconds, 4),
+    }
+
+
+def _as_sarif(report: Report, stale: list) -> dict:
+    """Minimal structurally-valid SARIF 2.1.0 for CI annotation. Every
+    gate-failing condition appears: findings and stale-baseline entries
+    as results, parse errors as tool execution notifications — a red
+    exit code never pairs with an empty SARIF document."""
+    rules_meta = [
+        {
+            "id": rule_id,
+            "shortDescription": {"text": summary},
+        }
+        for rule_id, summary in _all_rule_meta()
+    ] + [
+        {"id": "GC000",
+         "shortDescription": {"text": "suppression without a reason"}},
+        {"id": "GC001",
+         "shortDescription": {"text": "stale suppression"}},
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f"[{f.symbol}] {f.message}"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        for f in report.new
+    ]
+    results += [
+        {
+            "ruleId": entry.rule,
+            "level": "error",
+            "message": {
+                "text": f"[{entry.symbol}] stale baseline entry: no "
+                f"matching finding — remove it from {BASELINE_PATH.name}"
+            },
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": entry.path},
+                        "region": {"startLine": 1},
+                    }
+                }
+            ],
+        }
+        for entry in stale
+    ]
+    invocation = {
+        "executionSuccessful": not report.parse_errors,
+        "toolExecutionNotifications": [
+            {"level": "error", "message": {"text": f"PARSE ERROR {err}"}}
+            for err in report.parse_errors
+        ],
+    }
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "informationUri":
+                            "docs/ANALYSIS.md",
+                        "rules": rules_meta,
+                    }
+                },
+                "invocations": [invocation],
+                "results": results,
+            }
+        ],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="graftcheck", description=__doc__,
@@ -61,7 +209,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*", help="files or directories")
     parser.add_argument(
         "--changed", action="store_true",
-        help="lint only files changed vs HEAD",
+        help="lint files changed vs HEAD plus their call-graph dependents",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print rules and exit"
@@ -70,22 +218,31 @@ def main(argv: list[str] | None = None) -> int:
         "--no-baseline", action="store_true",
         help="ignore the baseline: report every finding",
     )
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format (json/sarif are CI-annotation friendly)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in ALL_RULES:
             print(f"{rule.id}  [{rule.family}]  {rule.summary}")
+        for rule in PROJECT_RULES:
+            print(f"{rule.id}  [{rule.family}]  (project) {rule.summary}")
         return 0
 
     if args.changed and args.paths:
         parser.error("--changed and explicit paths are mutually exclusive")
 
     files: list[Path] | None
+    dependents_added = 0
+    project_index = None
     if args.changed:
         files = _changed_files()
         if not files:
             print("graftcheck: no changed python files under langstream_tpu/")
             return 0
+        files, dependents_added, project_index = expand_with_dependents(files)
     elif args.paths:
         files = []
         for raw in args.paths:
@@ -101,31 +258,45 @@ def main(argv: list[str] | None = None) -> int:
         files = None  # whole tree
 
     baseline = [] if args.no_baseline else load_baseline()
-    report = run(ALL_RULES, files=files, baseline=baseline)
+    report = run(
+        ALL_RULES, files=files, baseline=baseline,
+        project_rules=PROJECT_RULES, project_index=project_index,
+    )
 
-    for err in report.parse_errors:
-        print(f"PARSE ERROR {err}")
-    for finding in report.new:
-        print(finding.format())
     # a subset scan (--changed / explicit paths) can't see findings in the
     # unscanned files, so unmatched baseline entries are expected there —
     # staleness is only meaningful (and only fails) on the full-tree run
     subset_scan = files is not None
     stale = [] if (args.no_baseline or subset_scan) else report.stale_baseline
-    for entry in stale:
-        print(
-            f"STALE BASELINE {entry.rule} {entry.path} [{entry.symbol}]: "
-            f"no matching finding — remove it from {BASELINE_PATH.name}"
-        )
 
-    n_new, n_base = len(report.new), len(report.baselined)
-    scanned = "changed files" if args.changed else (
-        f"{len(files)} file(s)" if files is not None else "langstream_tpu/"
-    )
-    print(
-        f"graftcheck: {n_new} violation(s), {n_base} baselined, "
-        f"{len(stale)} stale baseline entr(ies) in {scanned}"
-    )
+    if args.format == "json":
+        print(json.dumps(_as_json(report, stale), indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(_as_sarif(report, stale), indent=2))
+    else:
+        for err in report.parse_errors:
+            print(f"PARSE ERROR {err}")
+        for finding in report.new:
+            print(finding.format())
+        for entry in stale:
+            print(
+                f"STALE BASELINE {entry.rule} {entry.path} [{entry.symbol}]: "
+                f"no matching finding — remove it from {BASELINE_PATH.name}"
+            )
+        n_new, n_base = len(report.new), len(report.baselined)
+        scanned = (
+            f"changed files (+{dependents_added} dependent(s))"
+            if args.changed
+            else (
+                f"{len(files)} file(s)" if files is not None
+                else "langstream_tpu/"
+            )
+        )
+        print(
+            f"graftcheck: {n_new} violation(s), {n_base} baselined, "
+            f"{len(stale)} stale baseline entr(ies) in {scanned} "
+            f"[{report.analysis_seconds:.2f}s]"
+        )
     if report.parse_errors:
         return 2
     return 0 if not report.new and not stale else 1

@@ -19,9 +19,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import json
 import re
+import time
 import tokenize
 from pathlib import Path
 from typing import Callable, Iterable, Iterator
@@ -163,6 +165,75 @@ def is_suppressed(finding: Finding, by_line: dict[int, set[str]]) -> bool:
     return False
 
 
+def _suppression_line_for(
+    finding: Finding, by_line: dict[int, set[str]]
+) -> int | None:
+    """Which suppression line (if any) silences this finding — the usage
+    mark the stale-suppression pass (GC001) keys on."""
+    for line in (finding.line, finding.line - 1):
+        rules = by_line.get(line)
+        if rules and (finding.rule in rules or "all" in rules):
+            return line
+    return None
+
+
+def _apply_suppressions(
+    findings: list[Finding],
+    by_line: dict[int, set[str]],
+    used: set[tuple[int, str]],
+) -> list[Finding]:
+    """Drop suppressed findings, recording each suppression USE as
+    ``(suppression line, rule id)`` so unused suppressions can be flagged
+    as stale."""
+    kept: list[Finding] = []
+    for finding in findings:
+        line = _suppression_line_for(finding, by_line)
+        if line is None:
+            kept.append(finding)
+        else:
+            used.add((line, finding.rule))
+            if "all" in by_line.get(line, ()):
+                used.add((line, "all"))
+    return kept
+
+
+def stale_suppression_findings(
+    path: str,
+    by_line: dict[int, set[str]],
+    used: set[tuple[int, str]],
+    known_rules: set[str],
+) -> list[Finding]:
+    """GC001: a suppression that silences nothing is rot — the code was
+    fixed (or the comment drifted) and the dead suppression would mask a
+    future regression on that line. Rule ids outside ``known_rules`` are
+    skipped rather than flagged: a per-file scan cannot evaluate a
+    project-rule suppression (and a typo'd id is self-correcting — it
+    suppresses nothing, so the real finding still fails the gate)."""
+    problems: list[Finding] = []
+    for line, rules in sorted(by_line.items()):
+        for rule in sorted(rules):
+            if rule == "all":
+                if not any(u_line == line for u_line, _ in used):
+                    problems.append(Finding(
+                        rule="GC001", path=path, line=line,
+                        symbol="<suppression>",
+                        message="stale suppression: disable=all silences "
+                        "nothing on this line — remove it",
+                    ))
+                continue
+            if rule not in known_rules:
+                continue
+            if (line, rule) not in used:
+                problems.append(Finding(
+                    rule="GC001", path=path, line=line,
+                    symbol="<suppression>",
+                    message=f"stale suppression: {rule} no longer fires "
+                    f"here — remove the disable comment (it would mask a "
+                    f"future regression)",
+                ))
+    return problems
+
+
 # --------------------------------------------------------------------------
 # baseline
 # --------------------------------------------------------------------------
@@ -209,6 +280,7 @@ class Report:
     baselined: list[Finding]      # matched a baseline entry
     stale_baseline: list[BaselineEntry]  # entries matching nothing (fail)
     parse_errors: list[str]
+    analysis_seconds: float = 0.0  # wall time of the whole analysis pass
 
     @property
     def ok(self) -> bool:
@@ -221,14 +293,19 @@ def analyze_source(
     rules: Iterable[Rule],
 ) -> list[Finding]:
     """Findings for one source blob after inline suppressions (the fixture
-    entry point; the CLI goes through :func:`run`)."""
-    mod = Module(path, source)
-    suppressions, problems = parse_suppressions(mod)
-    findings = list(problems)
-    for rule in rules:
-        for finding in rule.check(mod):
-            if not is_suppressed(finding, suppressions):
-                findings.append(finding)
+    entry point; the CLI goes through :func:`run`). Suppressions that
+    silence nothing are reported as GC001 — per-file rules only here, so
+    a suppression naming a project rule is left unevaluated. Shares the
+    cached per-file pipeline (:func:`_check_file`) with :func:`run` so
+    the two entry points cannot drift."""
+    rules = list(rules)
+    rules_key = ",".join(r.id for r in rules)
+    raw, suppressions, problems = _check_file(path, source, rules, rules_key)
+    used: set[tuple[int, str]] = set()
+    findings = list(problems) + _apply_suppressions(raw, suppressions, used)
+    findings += stale_suppression_findings(
+        path, suppressions, used, {r.id for r in rules}
+    )
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
@@ -239,14 +316,78 @@ def iter_py_files(root: Path) -> Iterator[Path]:
         yield path
 
 
+def _rel_path(file_path: Path, repo_root: Path) -> str:
+    try:
+        return file_path.resolve().relative_to(repo_root.resolve()).as_posix()
+    except ValueError:
+        return file_path.as_posix()
+
+
+#: per-file rule results memoized by content hash, mirroring the project
+#: index cache (analysis/project.py): rule checks are pure in
+#: ``(path, source, rule set)``, so the second whole-tree pass in one
+#: process (the tier-1 gate runs the driver AND the CLI) re-walks nothing.
+#: Values are never mutated after insertion — Finding is frozen and the
+#: suppression map is shared read-only.
+_FILE_RESULT_CACHE: dict[
+    tuple[str, str],
+    tuple[str, list[Finding], dict[int, set[str]], list[Finding]],
+] = {}
+_FILE_RESULT_CACHE_CAP = 4096
+
+
+def _check_file(
+    rel: str, source: str, rules: list[Rule], rules_key: str
+) -> tuple[list[Finding], dict[int, set[str]], list[Finding]]:
+    """Raw (pre-suppression) findings + suppression map + GC000 problems
+    for one file, content-hash cached. Raises SyntaxError on bad source
+    (never cached)."""
+    digest = hashlib.sha256(source.encode()).hexdigest()
+    cached = _FILE_RESULT_CACHE.get((rel, rules_key))
+    if cached is not None and cached[0] == digest:
+        return cached[1], cached[2], cached[3]
+    mod = Module(rel, source)
+    suppressions, problems = parse_suppressions(mod)
+    raw: list[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check(mod))
+    if len(_FILE_RESULT_CACHE) >= _FILE_RESULT_CACHE_CAP:
+        _FILE_RESULT_CACHE.clear()
+    _FILE_RESULT_CACHE[(rel, rules_key)] = (
+        digest, raw, suppressions, problems
+    )
+    return raw, suppressions, problems
+
+
 def run(
     rules: Iterable[Rule],
     files: Iterable[Path] | None = None,
     baseline: list[BaselineEntry] | None = None,
     repo_root: Path | None = None,
+    project_rules: Iterable | None = None,
+    project_files: Iterable[Path] | None = None,
+    project_index=None,
 ) -> Report:
+    """The driver: per-file rules over ``files``, then project rules over
+    the whole-program index, then stale-suppression (GC001) and baseline
+    bookkeeping.
+
+    ``project_files`` is the index scope for project rules. Default: the
+    scanned files when they define their own world (whole-package run, or
+    a fixture tree under an explicit ``repo_root``); the whole package
+    for a subset scan of the real tree — a project rule needs the full
+    call graph even when only a few files are being reported on. Project
+    findings are always filtered to the scanned file set. A caller that
+    already built the whole-package :class:`ProjectIndex` (the
+    ``--changed`` dependents expansion) passes it as ``project_index`` to
+    skip the rebuild.
+    """
+    t0 = time.perf_counter()
     rules = list(rules)
+    project_rules = list(project_rules or ())
+    explicit_root = repo_root is not None
     repo_root = repo_root or REPO_ROOT
+    whole_tree = files is None
     if files is None:
         files = iter_py_files(PACKAGE_ROOT)
     if baseline is None:
@@ -254,22 +395,94 @@ def run(
 
     findings: list[Finding] = []
     parse_errors: list[str] = []
+    scanned: dict[str, str] = {}  # rel path -> source
+    # per scanned module: suppression map + which suppressions got used
+    suppression_maps: dict[str, dict[int, set[str]]] = {}
+    used_suppressions: dict[str, set[tuple[int, str]]] = {}
+    rules_key = ",".join(r.id for r in rules)
     for file_path in files:
         file_path = Path(file_path)
-        try:
-            rel = file_path.resolve().relative_to(repo_root).as_posix()
-        except ValueError:
-            rel = file_path.as_posix()
+        rel = _rel_path(file_path, repo_root)
         try:
             source = file_path.read_text()
         except (OSError, UnicodeDecodeError) as e:
             parse_errors.append(f"{rel}: unreadable: {e}")
             continue
         try:
-            findings.extend(analyze_source(source, rel, rules))
+            raw, suppressions, problems = _check_file(
+                rel, source, rules, rules_key
+            )
         except SyntaxError as e:
             parse_errors.append(f"{rel}: syntax error: {e}")
+            continue
+        scanned[rel] = source
+        suppression_maps[rel] = suppressions
+        used = used_suppressions.setdefault(rel, set())
+        findings.extend(problems)
+        findings.extend(_apply_suppressions(raw, suppressions, used))
 
+    if project_rules and project_index is not None:
+        index = project_index
+    elif project_rules:
+        from langstream_tpu.analysis.project import ProjectIndex
+
+        if project_files is not None:
+            index_sources: dict[str, str] = {}
+            for file_path in project_files:
+                file_path = Path(file_path)
+                rel = _rel_path(file_path, repo_root)
+                if rel in scanned:
+                    index_sources[rel] = scanned[rel]
+                    continue
+                try:
+                    index_sources[rel] = file_path.read_text()
+                except (OSError, UnicodeDecodeError):
+                    continue
+        elif whole_tree or explicit_root:
+            index_sources = dict(scanned)
+        else:
+            # subset scan of the real tree: the call graph needs the
+            # whole package even though findings are filtered below
+            index_sources = dict(scanned)
+            for file_path in iter_py_files(PACKAGE_ROOT):
+                rel = _rel_path(file_path, repo_root)
+                if rel in index_sources:
+                    continue
+                try:
+                    index_sources[rel] = file_path.read_text()
+                except (OSError, UnicodeDecodeError):
+                    continue
+        # ProjectIndex.build skips unparseable sources itself (scanned
+        # files' syntax errors were already reported above)
+        index = ProjectIndex.build(sorted(index_sources.items()))
+
+    if project_rules:
+        for rule in project_rules:
+            for finding in rule.check(index):
+                suppressions = suppression_maps.get(finding.path)
+                if suppressions is not None:
+                    line = _suppression_line_for(finding, suppressions)
+                    if line is not None:
+                        used_suppressions[finding.path].add(
+                            (line, finding.rule)
+                        )
+                        if "all" in suppressions.get(line, ()):
+                            used_suppressions[finding.path].add(
+                                (line, "all")
+                            )
+                        continue
+                if finding.path in scanned:
+                    findings.append(finding)
+
+    known_ids = {r.id for r in rules} | {r.id for r in project_rules}
+    for rel in scanned:
+        findings.extend(
+            stale_suppression_findings(
+                rel, suppression_maps[rel], used_suppressions[rel], known_ids
+            )
+        )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
     by_key: dict[tuple[str, str, str], BaselineEntry] = {
         e.key(): e for e in baseline
     }
@@ -289,6 +502,7 @@ def run(
         baselined=baselined,
         stale_baseline=stale,
         parse_errors=parse_errors,
+        analysis_seconds=time.perf_counter() - t0,
     )
 
 

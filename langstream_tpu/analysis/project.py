@@ -1,0 +1,999 @@
+"""Whole-program index for graftcheck project rules.
+
+The per-file :class:`~langstream_tpu.analysis.core.Rule` API sees one
+module at a time, which is exactly wrong for the bug class the pipelined
+engine introduced: a field written on the ``tpu-engine`` dispatch thread
+and read from an async handler two modules away. This module parses the
+whole package once and derives the cross-cutting facts a
+:class:`ProjectRule` needs:
+
+- a **symbol table** — every function, method, nested closure, and lambda
+  gets a stable qualified name (``langstream_tpu.serving.engine.
+  TpuServingEngine._decode_burst._dispatch``); classes carry their
+  methods, bases, and best-effort attribute types (``self.flight =
+  FlightRecorder(...)`` makes ``self.flight.sample`` resolvable);
+- a best-effort **intra-package call graph** — bare names through lexical
+  scoping, ``self.``/``cls.`` methods through the class table (bases
+  included), imported names through the per-module import map, and
+  ``self.<attr>.<method>`` through the inferred attribute types;
+- **thread roles** per function: ``async`` (runs on the event loop —
+  seeded by ``async def`` and ``call_soon_threadsafe`` targets),
+  ``dispatch`` (runs on an executor thread — seeded by
+  ``run_in_executor``/``executor.submit`` submissions, unwrapping
+  ``functools.partial`` and lambdas), and ``worker`` (a dedicated
+  ``threading.Thread`` target). Roles propagate along *direct* call
+  edges to a fixpoint — a helper called from both an async handler and a
+  dispatch closure is **both**, which is precisely the shape of a race.
+  Propagation is cut at ``__init__``: constructors run before the object
+  is published, so construction-only helpers carry no role;
+- per-class **attribute access sets** — every ``self.X``/``cls.X`` read,
+  write, collection mutation (``.append``/``[...] =``/…), and iteration,
+  each annotated with its function, line, whether it sits under a
+  ``with <…lock…>:`` guard, and whether it sits in an
+  ``if self._lockstep…`` branch (the broadcast protocol ships host state
+  by design — the same exemption PERF701 grants);
+- **designated handoff attributes** — fields initialized to thread-safe
+  primitives (``asyncio.Event``, ``threading.Lock``, ``queue.Queue``,
+  ``deque``, futures, …) are cross-thread *by design* and exempt from
+  the race rules.
+
+Per-file indexing is pure in ``(path, source)`` and memoized by content
+hash (:func:`cache_stats` exposes hit counters), so the tier-1 whole-tree
+gate re-runs pay only the cross-file resolution, and ``--changed`` can
+rebuild the index cheaply to compute call-graph **dependents** of the
+edited files (:meth:`ProjectIndex.dependents`).
+
+Known limits (precision over recall, like the per-file rules): accesses
+through local aliases (``slot = self.slots[i]; slot.request = None``)
+and containers of objects are invisible; two distinct worker threads
+share the ``worker`` role; happens-before via an *awaited*
+``run_in_executor`` future is not modeled — the sanctioned escapes are
+locks, handoff attributes, and inline suppressions with reasons.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+from langstream_tpu.analysis.core import (
+    Finding,
+    REPO_ROOT,
+    dotted_name,
+)
+
+#: thread roles a function can carry
+ROLE_ASYNC = "async"        # the asyncio event-loop thread
+ROLE_DISPATCH = "dispatch"  # an executor thread (run_in_executor/submit)
+ROLE_WORKER = "worker"      # a dedicated threading.Thread target
+
+#: constructors whose instances are designated cross-thread handoffs
+HANDOFF_TYPES = {
+    "Event", "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "deque", "Future",
+}
+
+#: method names that mutate the receiver collection in place
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "move_to_end", "rotate", "sort", "reverse",
+}
+
+#: wrappers whose call still iterates the argument (``list(self.x)`` …)
+_ITER_WRAPPERS = {
+    "list", "tuple", "set", "frozenset", "sorted", "reversed", "enumerate",
+    "sum", "min", "max", "any", "all", "dict", "iter", "map", "filter",
+}
+
+#: synchronous device fetches (the INV902 vocabulary; PERF701 shares the
+#: np spellings but is engine-file-scoped — outside the engine file only
+#: the unambiguous device syncs count, because ``np.asarray`` on helper
+#: modules is usually host-numpy math, not a device transfer)
+SYNC_FETCH_CALLS = {
+    "jax.block_until_ready", "jax.device_get",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+}
+SYNC_FETCH_ATTRS = {"block_until_ready", "item"}
+UNAMBIGUOUS_SYNC_CALLS = {"jax.block_until_ready", "jax.device_get"}
+UNAMBIGUOUS_SYNC_ATTRS = {"block_until_ready"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectRule:
+    """A whole-program rule: receives the :class:`ProjectIndex` instead of
+    one module. Registered in ``PROJECT_RULES`` next to ``ALL_RULES``;
+    the driver applies the same suppression/baseline machinery."""
+
+    id: str
+    family: str
+    summary: str
+    check: Callable[["ProjectIndex"], Iterator[Finding]]
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrAccess:
+    """One ``self.X`` / ``cls.X`` touch inside a method or closure."""
+
+    attr: str
+    kind: str        # "read" | "write" | "mutate" | "iterate"
+    func: str        # qname of the enclosing function
+    path: str
+    line: int
+    locked: bool     # under `with <...lock...>:`
+    lockstep: bool   # under `if ...(_)lockstep...:` (broadcast protocol)
+
+
+@dataclasses.dataclass(frozen=True)
+class RawCall:
+    """An unresolved call site, recorded at index time, resolved when the
+    whole-project tables exist. ``kind``: "name" (bare), "self" (self.m /
+    cls.m), "selfattr" (self.X.m), "dotted" (alias.m / a.b.m)."""
+
+    kind: str
+    name: str            # bare name / method name
+    extra: str = ""      # attr X for selfattr; dotted prefix for dotted
+    line: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FetchSite:
+    line: int
+    spelling: str
+    lockstep: bool
+    unambiguous: bool    # device-only spelling (block_until_ready/device_get)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReleaseSite:
+    line: int
+    receiver: str
+    in_finally: bool
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str
+    path: str
+    name: str
+    module: str                   # dotted module name
+    cls: str | None               # enclosing class qname (lexical)
+    parent: str | None            # enclosing function qname (lexical)
+    scope_names: tuple[str, ...]  # lexical def-name chain, outermost first
+    is_async: bool
+    lineno: int
+    raw_calls: list[RawCall] = dataclasses.field(default_factory=list)
+    raw_submits: list[RawCall] = dataclasses.field(default_factory=list)
+    raw_threads: list[RawCall] = dataclasses.field(default_factory=list)
+    raw_loop_cbs: list[RawCall] = dataclasses.field(default_factory=list)
+    fetch_sites: list[FetchSite] = dataclasses.field(default_factory=list)
+    release_sites: list[ReleaseSite] = dataclasses.field(default_factory=list)
+    # resolved by ProjectIndex:
+    calls: set[str] = dataclasses.field(default_factory=set)
+    submits: set[str] = dataclasses.field(default_factory=set)
+    threads: set[str] = dataclasses.field(default_factory=set)
+    loop_cbs: set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qname: str
+    path: str
+    name: str
+    module: str
+    lineno: int
+    bases: list[str] = dataclasses.field(default_factory=list)  # raw dotted
+    methods: dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_accesses: list[AttrAccess] = dataclasses.field(default_factory=list)
+    handoff_attrs: set[str] = dataclasses.field(default_factory=set)
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    # attrs assigned a raw in-package-class constructor (pre-resolution)
+    raw_attr_ctors: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class FileIndex:
+    """Everything derivable from one file alone — pure in (path, source),
+    memoized by content hash."""
+
+    path: str
+    module: str
+    imports: dict[str, str]              # local alias -> dotted target
+    functions: dict[str, FunctionInfo]   # qname -> info
+    classes: dict[str, ClassInfo]        # qname -> info
+    toplevel_funcs: dict[str, str]       # bare name -> qname
+    toplevel_classes: dict[str, str]     # bare name -> qname
+
+
+# --------------------------------------------------------------------------
+# per-file indexing (cached)
+# --------------------------------------------------------------------------
+
+_FILE_CACHE: dict[tuple[str, str], FileIndex] = {}
+_CACHE_HITS = 0
+_CACHE_MISSES = 0
+_CACHE_CAP = 4096
+
+
+def cache_stats() -> dict[str, int]:
+    return {
+        "entries": len(_FILE_CACHE),
+        "hits": _CACHE_HITS,
+        "misses": _CACHE_MISSES,
+    }
+
+
+def clear_cache() -> None:
+    global _CACHE_HITS, _CACHE_MISSES
+    _FILE_CACHE.clear()
+    _CACHE_HITS = 0
+    _CACHE_MISSES = 0
+
+
+def module_name_for(rel_path: str) -> str:
+    """Dotted module name for a repo-relative path (fixture trees outside
+    the package dot their own relative paths the same way)."""
+    p = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    parts = [x for x in p.split("/") if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "<root>"
+
+
+def index_file(rel_path: str, source: str) -> FileIndex:
+    """Memoized per-file index: pure in ``(rel_path, source)``."""
+    global _CACHE_HITS, _CACHE_MISSES
+    key = (rel_path, hashlib.sha256(source.encode()).hexdigest())
+    hit = _FILE_CACHE.get(key)
+    if hit is not None:
+        _CACHE_HITS += 1
+        return hit
+    _CACHE_MISSES += 1
+    built = _build_file_index(rel_path, source)
+    if len(_FILE_CACHE) >= _CACHE_CAP:
+        _FILE_CACHE.clear()
+    _FILE_CACHE[key] = built
+    return built
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+    return name is not None and "lock" in name.lower()
+
+
+class _FileVisitor:
+    """Single-pass structural walk building the FileIndex."""
+
+    def __init__(self, rel_path: str, source: str):
+        self.path = rel_path
+        self.module = module_name_for(rel_path)
+        self.tree = ast.parse(source)
+        self.imports: dict[str, str] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.toplevel_funcs: dict[str, str] = {}
+        self.toplevel_classes: dict[str, str] = {}
+        self._collect_imports()
+        self._walk_body(
+            self.tree.body, scope=(), cls=None, parent_fn=None,
+            ctx={"locked": False, "lockstep": False, "in_finally": False},
+        )
+
+    # -- imports ---------------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:  # relative import: resolve against this module
+                    base = self.module.split(".")
+                    base = base[: max(len(base) - node.level, 0)]
+                    mod = ".".join(base + [node.module])
+                else:
+                    mod = node.module
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    self.imports[alias.asname or alias.name] = (
+                        f"{mod}.{alias.name}"
+                    )
+
+    # -- structural walk -------------------------------------------------
+
+    def _qname(self, scope: tuple[str, ...]) -> str:
+        return ".".join((self.module,) + scope)
+
+    def _walk_body(self, body, scope, cls, parent_fn, ctx) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, scope, cls, parent_fn, ctx)
+
+    def _walk_stmt(self, node, scope, cls, parent_fn, ctx) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._def_function(node, scope, cls, parent_fn)
+            return
+        if isinstance(node, ast.ClassDef):
+            self._def_class(node, scope, parent_fn)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked = ctx["locked"] or any(
+                _is_lockish(item.context_expr) for item in node.items
+            )
+            inner = {**ctx, "locked": locked}
+            for item in node.items:
+                self._walk_expr(item.context_expr, scope, cls, parent_fn, ctx)
+            self._walk_body(node.body, scope, cls, parent_fn, inner)
+            return
+        if isinstance(node, ast.If):
+            test_names = [
+                dotted_name(sub) or ""
+                for sub in ast.walk(node.test)
+            ]
+            lockstep = ctx["lockstep"] or any(
+                n.endswith("_lockstep") or n.endswith(".lockstep")
+                for n in test_names
+            )
+            self._walk_expr(node.test, scope, cls, parent_fn, ctx)
+            inner = {**ctx, "lockstep": lockstep}
+            self._walk_body(node.body, scope, cls, parent_fn, inner)
+            self._walk_body(node.orelse, scope, cls, parent_fn, ctx)
+            return
+        if isinstance(node, ast.Try):
+            self._walk_body(node.body, scope, cls, parent_fn, ctx)
+            for handler in node.handlers:
+                self._walk_body(handler.body, scope, cls, parent_fn, ctx)
+            self._walk_body(node.orelse, scope, cls, parent_fn, ctx)
+            fin = {**ctx, "in_finally": True}
+            self._walk_body(node.finalbody, scope, cls, parent_fn, fin)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._walk_expr(
+                node.iter, scope, cls, parent_fn, ctx, iterating=True
+            )
+            self._walk_expr(node.target, scope, cls, parent_fn, ctx)
+            self._walk_body(node.body, scope, cls, parent_fn, ctx)
+            self._walk_body(node.orelse, scope, cls, parent_fn, ctx)
+            return
+        # generic statement: walk child statements/expressions
+        for field, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                for item in value:
+                    if isinstance(item, ast.stmt):
+                        self._walk_stmt(item, scope, cls, parent_fn, ctx)
+                    elif isinstance(item, ast.expr):
+                        self._walk_expr(item, scope, cls, parent_fn, ctx)
+            elif isinstance(value, ast.stmt):
+                self._walk_stmt(value, scope, cls, parent_fn, ctx)
+            elif isinstance(value, ast.expr):
+                self._walk_expr(value, scope, cls, parent_fn, ctx)
+        # attribute stores need the statement-level view
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._record_stores(node, scope, cls, parent_fn, ctx)
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                self._maybe_attr(
+                    target, "write", scope, cls, parent_fn, ctx
+                )
+
+    def _def_class(self, node: ast.ClassDef, scope, parent_fn) -> None:
+        cscope = scope + (node.name,)
+        qname = self._qname(cscope)
+        info = ClassInfo(
+            qname=qname, path=self.path, name=node.name, module=self.module,
+            lineno=node.lineno,
+            bases=[dotted_name(b) or "" for b in node.bases],
+        )
+        self.classes[qname] = info
+        if not scope:
+            self.toplevel_classes[node.name] = qname
+        self._walk_body(
+            node.body, cscope, info, parent_fn,
+            {"locked": False, "lockstep": False, "in_finally": False},
+        )
+
+    def _def_function(self, node, scope, cls, parent_fn) -> None:
+        fscope = scope + (node.name,)
+        qname = self._qname(fscope)
+        info = FunctionInfo(
+            qname=qname, path=self.path, name=node.name, module=self.module,
+            cls=cls.qname if cls is not None else None,
+            parent=parent_fn.qname if parent_fn is not None else None,
+            scope_names=fscope,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            lineno=node.lineno,
+        )
+        self.functions[qname] = info
+        if not scope:
+            self.toplevel_funcs[node.name] = qname
+        if cls is not None and info.parent is None:
+            cls.methods.setdefault(node.name, qname)
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            self._walk_expr(default, scope, cls, parent_fn,
+                            {"locked": False, "lockstep": False,
+                             "in_finally": False})
+        self._walk_body(
+            node.body, fscope, cls, info,
+            {"locked": False, "lockstep": False, "in_finally": False},
+        )
+
+    def _def_lambda(self, node: ast.Lambda, scope, cls, parent_fn) -> str:
+        fscope = scope + (f"<lambda:{node.lineno}>",)
+        qname = self._qname(fscope)
+        if qname not in self.functions:
+            info = FunctionInfo(
+                qname=qname, path=self.path, name="<lambda>",
+                module=self.module,
+                cls=cls.qname if cls is not None else None,
+                parent=parent_fn.qname if parent_fn is not None else None,
+                scope_names=fscope, is_async=False, lineno=node.lineno,
+            )
+            self.functions[qname] = info
+            self._walk_expr(
+                node.body, fscope, cls, info,
+                {"locked": False, "lockstep": False, "in_finally": False},
+            )
+        return qname
+
+    # -- expressions -----------------------------------------------------
+
+    def _walk_expr(self, node, scope, cls, parent_fn, ctx,
+                   iterating: bool = False) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Lambda):
+            self._def_lambda(node, scope, cls, parent_fn)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._def_function(node, scope, cls, parent_fn)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, scope, cls, parent_fn, ctx)
+            wrapped_iter = (
+                iterating
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _ITER_WRAPPERS
+            )
+            # don't re-walk func below; args walked here
+            if isinstance(node.func, ast.Attribute):
+                self._walk_expr(node.func.value, scope, cls, parent_fn, ctx)
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    arg = arg.value
+                self._walk_expr(
+                    arg, scope, cls, parent_fn, ctx, iterating=wrapped_iter
+                )
+            for kw in node.keywords:
+                self._walk_expr(kw.value, scope, cls, parent_fn, ctx)
+            return
+        if isinstance(node, ast.Attribute):
+            self._maybe_attr(
+                node, "iterate" if iterating else "read",
+                scope, cls, parent_fn, ctx,
+            )
+            self._walk_expr(node.value, scope, cls, parent_fn, ctx)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                self._walk_expr(
+                    gen.iter, scope, cls, parent_fn, ctx, iterating=True
+                )
+                for cond in gen.ifs:
+                    self._walk_expr(cond, scope, cls, parent_fn, ctx)
+            if isinstance(node, ast.DictComp):
+                self._walk_expr(node.key, scope, cls, parent_fn, ctx)
+                self._walk_expr(node.value, scope, cls, parent_fn, ctx)
+            else:
+                self._walk_expr(node.elt, scope, cls, parent_fn, ctx)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child, scope, cls, parent_fn, ctx)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(child, scope, cls, parent_fn, ctx)
+
+    # -- attribute accesses ----------------------------------------------
+
+    def _receiver_attr(self, node) -> str | None:
+        """``self.X`` / ``cls.X`` -> X, else None."""
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+        ):
+            return node.attr
+        return None
+
+    def _record(self, attr, kind, scope, cls, parent_fn, ctx, line) -> None:
+        if cls is None:
+            return
+        cls.attr_accesses.append(
+            AttrAccess(
+                attr=attr, kind=kind,
+                func=(
+                    parent_fn.qname if parent_fn is not None
+                    else self._qname(scope) if scope else "<module>"
+                ),
+                path=self.path, line=line,
+                locked=ctx["locked"], lockstep=ctx["lockstep"],
+            )
+        )
+
+    def _maybe_attr(self, node, kind, scope, cls, parent_fn, ctx) -> None:
+        attr = self._receiver_attr(node)
+        if attr is not None:
+            self._record(attr, kind, scope, cls, parent_fn, ctx, node.lineno)
+            return
+        # self.X[...] as store target handled via _record_stores; a Load
+        # subscript of self.X is a read (recorded when the Attribute under
+        # the Subscript is walked)
+        if isinstance(node, ast.Subscript):
+            self._walk_expr(node.value, scope, cls, parent_fn, ctx)
+            self._walk_expr(node.slice, scope, cls, parent_fn, ctx)
+
+    def _record_stores(self, node, scope, cls, parent_fn, ctx) -> None:
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for target in targets:
+            for el in self._flatten_targets(target):
+                attr = self._receiver_attr(el)
+                if attr is not None:
+                    self._record(
+                        attr, "write", scope, cls, parent_fn, ctx, el.lineno
+                    )
+                    if (
+                        isinstance(node, (ast.Assign, ast.AnnAssign))
+                        and cls is not None
+                        and node.value is not None
+                    ):
+                        self._note_ctor(attr, node.value, cls)
+                elif isinstance(el, ast.Subscript):
+                    inner = self._receiver_attr(el.value)
+                    if inner is not None:
+                        # self.X[i] = v mutates the collection X holds
+                        self._record(
+                            inner, "mutate", scope, cls, parent_fn, ctx,
+                            el.lineno,
+                        )
+
+    @staticmethod
+    def _flatten_targets(target) -> Iterator[ast.expr]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                yield from _FileVisitor._flatten_targets(el)
+        else:
+            yield target
+
+    def _note_ctor(self, attr: str, value, cls: ClassInfo) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        ctor = dotted_name(value.func)
+        if ctor is None:
+            return
+        base = ctor.split(".")[-1]
+        if base in HANDOFF_TYPES:
+            cls.handoff_attrs.add(attr)
+        else:
+            cls.raw_attr_ctors.setdefault(attr, ctor)
+
+    # -- calls -----------------------------------------------------------
+
+    def _call_target(self, node, scope, cls, parent_fn) -> RawCall | None:
+        """Describe a callable expression (a call's func, or a function
+        handed to an executor/thread)."""
+        if isinstance(node, ast.Call):
+            # functools.partial(X, ...) -> X
+            fname = dotted_name(node.func) or ""
+            if fname.split(".")[-1] == "partial" and node.args:
+                return self._call_target(node.args[0], scope, cls, parent_fn)
+            return None
+        if isinstance(node, ast.Lambda):
+            qname = self._def_lambda(node, scope, cls, parent_fn)
+            return RawCall(kind="resolved", name=qname, line=node.lineno)
+        if isinstance(node, ast.Name):
+            return RawCall(kind="name", name=node.id, line=node.lineno)
+        if isinstance(node, ast.Attribute):
+            attr = self._receiver_attr(node)
+            if attr is not None:
+                return RawCall(kind="self", name=attr, line=node.lineno)
+            if (
+                isinstance(node.value, ast.Attribute)
+                and (inner := self._receiver_attr(node.value)) is not None
+            ):
+                return RawCall(
+                    kind="selfattr", name=node.attr, extra=inner,
+                    line=node.lineno,
+                )
+            d = dotted_name(node)
+            if d is not None:
+                return RawCall(kind="dotted", name=d, line=node.lineno)
+        return None
+
+    def _record_call(self, node: ast.Call, scope, cls, parent_fn, ctx) -> None:
+        if parent_fn is None:
+            owner = None
+        else:
+            owner = parent_fn
+        func_d = dotted_name(node.func) or ""
+        func_base = func_d.split(".")[-1]
+
+        # -- submission edges ------------------------------------------
+        target_expr = None
+        bucket = None
+        if func_base == "run_in_executor" and len(node.args) >= 2:
+            target_expr, bucket = node.args[1], "submit"
+        elif func_base == "submit" and node.args and (
+            "executor" in func_d.lower() or "pool" in func_d.lower()
+        ):
+            target_expr, bucket = node.args[0], "submit"
+        elif func_base == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target_expr, bucket = kw.value, "thread"
+        elif func_base in ("call_soon_threadsafe", "call_soon") and node.args:
+            target_expr, bucket = node.args[0], "loop_cb"
+        if target_expr is not None and owner is not None:
+            raw = self._call_target(target_expr, scope, cls, parent_fn)
+            if raw is not None:
+                {
+                    "submit": owner.raw_submits,
+                    "thread": owner.raw_threads,
+                    "loop_cb": owner.raw_loop_cbs,
+                }[bucket].append(raw)
+
+        # -- plain call edge -------------------------------------------
+        if owner is not None:
+            raw = self._call_target(node.func, scope, cls, parent_fn)
+            if raw is not None and not isinstance(node.func, ast.Lambda):
+                owner.raw_calls.append(raw)
+
+        # -- receiver-method mutation (self.X.append(...)) --------------
+        if (
+            isinstance(node.func, ast.Attribute)
+            and (attr := self._receiver_attr(node.func.value)) is not None
+        ):
+            kind = "mutate" if node.func.attr in MUTATOR_METHODS else "read"
+            self._record(attr, kind, scope, cls, parent_fn, ctx,
+                         node.func.lineno)
+
+        # -- sync-fetch sites (INV902 vocabulary) -----------------------
+        if owner is not None:
+            spelling = None
+            unambiguous = False
+            if func_d in SYNC_FETCH_CALLS:
+                spelling = f"{func_d}()"
+                unambiguous = func_d in UNAMBIGUOUS_SYNC_CALLS
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_FETCH_ATTRS
+            ):
+                spelling = f".{node.func.attr}()"
+                unambiguous = node.func.attr in UNAMBIGUOUS_SYNC_ATTRS
+            if spelling is not None:
+                owner.fetch_sites.append(
+                    FetchSite(
+                        line=node.lineno, spelling=spelling,
+                        lockstep=ctx["lockstep"], unambiguous=unambiguous,
+                    )
+                )
+
+        # -- block-release sites (INV901) -------------------------------
+        if (
+            owner is not None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "release"
+        ):
+            recv = dotted_name(node.func.value) or ""
+            if "block" in recv.lower():
+                owner.release_sites.append(
+                    ReleaseSite(
+                        line=node.lineno, receiver=recv,
+                        in_finally=ctx["in_finally"],
+                    )
+                )
+
+
+def _build_file_index(rel_path: str, source: str) -> FileIndex:
+    v = _FileVisitor(rel_path, source)
+    return FileIndex(
+        path=rel_path, module=v.module, imports=v.imports,
+        functions=v.functions, classes=v.classes,
+        toplevel_funcs=v.toplevel_funcs, toplevel_classes=v.toplevel_classes,
+    )
+
+
+# --------------------------------------------------------------------------
+# the project index
+# --------------------------------------------------------------------------
+
+
+class ProjectIndex:
+    """Cross-file resolution: symbol tables, the call graph, thread roles.
+
+    Build with :meth:`build` from ``(rel_path, source)`` pairs (the driver
+    hands it the same sources the per-file pass read).
+    """
+
+    def __init__(self, files: dict[str, FileIndex]):
+        self.files = files
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_to_path: dict[str, str] = {}
+        self.func_by_module_name: dict[str, str] = {}
+        self.class_by_module_name: dict[str, str] = {}
+        for fi in files.values():
+            self.functions.update(fi.functions)
+            self.classes.update(fi.classes)
+            self.module_to_path[fi.module] = fi.path
+            for name, q in fi.toplevel_funcs.items():
+                self.func_by_module_name[f"{fi.module}.{name}"] = q
+            for name, q in fi.toplevel_classes.items():
+                self.class_by_module_name[f"{fi.module}.{name}"] = q
+        self._resolve_attr_types()
+        self._resolve_calls()
+        self.roles: dict[str, frozenset[str]] = self._infer_roles()
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def build(cls, sources: Iterable[tuple[str, str]]) -> "ProjectIndex":
+        """Index ``(rel_path, source)`` pairs; unparseable sources are
+        skipped (the per-file scan owns reporting those)."""
+        files: dict[str, FileIndex] = {}
+        for path, src in sources:
+            try:
+                files[path] = index_file(path, src)
+            except SyntaxError:
+                continue
+        return cls(files)
+
+    @classmethod
+    def build_from_paths(
+        cls, paths: Iterable[Path], repo_root: Path | None = None
+    ) -> "ProjectIndex":
+        """Index files from disk, skipping unreadable/unparseable ones
+        (their own per-file scan reports those)."""
+        repo_root = repo_root or REPO_ROOT
+        files: dict[str, FileIndex] = {}
+        for p in paths:
+            p = Path(p)
+            try:
+                rel = p.resolve().relative_to(repo_root.resolve()).as_posix()
+            except ValueError:
+                rel = p.as_posix()
+            try:
+                files[rel] = index_file(rel, p.read_text())
+            except (OSError, UnicodeDecodeError, SyntaxError):
+                continue
+        return cls(files)
+
+    # -- resolution ------------------------------------------------------
+
+    def _class_for(self, dotted: str, fi: FileIndex) -> str | None:
+        """Resolve a raw dotted class reference from ``fi``'s namespace."""
+        if dotted in fi.toplevel_classes:
+            return fi.toplevel_classes[dotted]
+        head = dotted.split(".")[0]
+        if head in fi.imports:
+            full = fi.imports[head] + dotted[len(head):]
+            if full in self.class_by_module_name:
+                return self.class_by_module_name[full]
+        if dotted in self.class_by_module_name:
+            return self.class_by_module_name[dotted]
+        return None
+
+    def _resolve_attr_types(self) -> None:
+        for fi in self.files.values():
+            for cls in fi.classes.values():
+                for attr, ctor in cls.raw_attr_ctors.items():
+                    resolved = self._class_for(ctor, fi)
+                    if resolved is not None:
+                        cls.attr_types.setdefault(attr, resolved)
+
+    def _method_on(self, class_qname: str, method: str,
+                   depth: int = 0) -> str | None:
+        info = self.classes.get(class_qname)
+        if info is None or depth > 8:
+            return None
+        if method in info.methods:
+            return info.methods[method]
+        fi = self.files.get(info.path)
+        for base in info.bases:
+            if not base:
+                continue
+            base_q = self._class_for(base, fi) if fi else None
+            if base_q is not None:
+                found = self._method_on(base_q, method, depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _resolve_raw(self, raw: RawCall, fn: FunctionInfo) -> str | None:
+        fi = self.files[fn.path]
+        if raw.kind == "resolved":
+            return raw.name if raw.name in self.functions else None
+        if raw.kind == "name":
+            # lexical scoping: nested defs of enclosing functions first
+            cur = fn
+            while cur is not None:
+                cand = f"{cur.qname}.{raw.name}"
+                if cand in self.functions:
+                    return cand
+                cur = (
+                    self.functions.get(cur.parent)
+                    if cur.parent is not None else None
+                )
+            if raw.name in fi.toplevel_funcs:
+                return fi.toplevel_funcs[raw.name]
+            if raw.name in fi.toplevel_classes:
+                # constructing a class calls __init__ (role-cut there)
+                return self._method_on(fi.toplevel_classes[raw.name],
+                                       "__init__")
+            if raw.name in fi.imports:
+                full = fi.imports[raw.name]
+                if full in self.func_by_module_name:
+                    return self.func_by_module_name[full]
+                if full in self.class_by_module_name:
+                    return self._method_on(
+                        self.class_by_module_name[full], "__init__"
+                    )
+            return None
+        if raw.kind == "self":
+            if fn.cls is not None:
+                return self._method_on(fn.cls, raw.name)
+            return None
+        if raw.kind == "selfattr":
+            if fn.cls is None:
+                return None
+            cls = self.classes.get(fn.cls)
+            if cls is None:
+                return None
+            target_cls = cls.attr_types.get(raw.extra)
+            if target_cls is not None:
+                return self._method_on(target_cls, raw.name)
+            return None
+        if raw.kind == "dotted":
+            head, _, rest = raw.name.partition(".")
+            if head in fi.imports and rest:
+                full = f"{fi.imports[head]}.{rest}"
+                if full in self.func_by_module_name:
+                    return self.func_by_module_name[full]
+                # module.Class(...) -> __init__
+                mod_cls, _, meth = full.rpartition(".")
+                if mod_cls in self.class_by_module_name:
+                    return self._method_on(
+                        self.class_by_module_name[mod_cls], meth
+                    )
+            return None
+        return None
+
+    def _resolve_calls(self) -> None:
+        for fn in self.functions.values():
+            for raw, dest in (
+                [(r, fn.calls) for r in fn.raw_calls]
+                + [(r, fn.submits) for r in fn.raw_submits]
+                + [(r, fn.threads) for r in fn.raw_threads]
+                + [(r, fn.loop_cbs) for r in fn.raw_loop_cbs]
+            ):
+                resolved = self._resolve_raw(raw, fn)
+                if resolved is not None and resolved != fn.qname:
+                    dest.add(resolved)
+
+    # -- thread roles ----------------------------------------------------
+
+    def _infer_roles(self) -> dict[str, frozenset[str]]:
+        roles: dict[str, set[str]] = {q: set() for q in self.functions}
+        for fn in self.functions.values():
+            if fn.is_async:
+                roles[fn.qname].add(ROLE_ASYNC)
+            for target in fn.submits:
+                roles[target].add(ROLE_DISPATCH)
+            for target in fn.threads:
+                roles[target].add(ROLE_WORKER)
+            for target in fn.loop_cbs:
+                roles[target].add(ROLE_ASYNC)
+        # fixpoint over direct call edges; constructors are a propagation
+        # cut (they run before the object is published)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions.values():
+                src = roles[fn.qname]
+                if not src:
+                    continue
+                for callee in fn.calls:
+                    if callee not in roles:
+                        continue
+                    if self.functions[callee].name == "__init__":
+                        continue
+                    before = len(roles[callee])
+                    roles[callee] |= src
+                    if len(roles[callee]) != before:
+                        changed = True
+        return {q: frozenset(r) for q, r in roles.items()}
+
+    # -- queries ---------------------------------------------------------
+
+    def reachable(self, roots: Iterable[str]) -> set[str]:
+        """Transitive closure over direct call edges from ``roots``."""
+        seen: set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            for callee in self.functions[q].calls:
+                if callee not in seen and callee in self.functions:
+                    stack.append(callee)
+        return seen
+
+    def dependents(self, rel_paths: Iterable[str]) -> set[str]:
+        """Files whose project-level findings can change when ``rel_paths``
+        change — the transitive closure over import/call edges in BOTH
+        directions, because influence flows both ways: a changed *caller*
+        alters roles and reachability in its callees (an INV902 site in a
+        helper appears when the engine starts calling it), and a changed
+        *callee* alters resolution and role propagation in its importers.
+        Findings are always computed over the full index; this set only
+        decides which files a ``--changed`` scan reports on, so the
+        symmetric over-approximation costs nothing but report width.
+        Always includes the inputs themselves."""
+        targets = set(rel_paths)
+        adjacent: dict[str, set[str]] = {}
+
+        def _edge(a: str, b: str) -> None:
+            if a != b:
+                adjacent.setdefault(a, set()).add(b)
+                adjacent.setdefault(b, set()).add(a)
+
+        for fi in self.files.values():
+            for dotted in fi.imports.values():
+                # an import of pkg.mod.name may reference the module or a
+                # symbol in it — check both spellings
+                for cand in (dotted, dotted.rpartition(".")[0]):
+                    path = self.module_to_path.get(cand)
+                    if path is not None:
+                        _edge(fi.path, path)
+        for fn in self.functions.values():
+            for callee in fn.calls | fn.submits | fn.threads | fn.loop_cbs:
+                cfn = self.functions.get(callee)
+                if cfn is not None:
+                    _edge(fn.path, cfn.path)
+        out: set[str] = set()
+        stack = [p for p in targets if p in self.files]
+        while stack:
+            p = stack.pop()
+            if p in out:
+                continue
+            out.add(p)
+            for neighbor in adjacent.get(p, ()):
+                if neighbor not in out:
+                    stack.append(neighbor)
+        return out
+
+    def role_of(self, qname: str) -> frozenset[str]:
+        return self.roles.get(qname, frozenset())
+
+
+def conflicting_roles(a: frozenset[str], b: frozenset[str]) -> bool:
+    """True when two role sets imply two *different* threads can touch the
+    same state concurrently: distinct roles across the sets, or one
+    function carrying two roles (it races with itself)."""
+    if not a or not b:
+        return False
+    if a == b and len(a) == 1:
+        return False
+    return len(a | b) > 1

@@ -1,0 +1,159 @@
+"""Engine-invariant checkers (project-level): INV901 / INV902.
+
+The pipelined engine loop (docs/PIPELINE.md) rests on two whole-program
+invariants that per-file rules cannot see past a method boundary:
+
+- **INV901 — deferred block release.** Inside a pipelined burst, a
+  finished slot's KV blocks must NOT be released directly: the in-flight
+  chunk still commits through the tables captured at its dispatch, and a
+  mid-burst re-allocation would land stale K/V on a live slot. Every
+  release reachable from the burst-dispatch entry points
+  (``_decode_burst`` / ``_speculative_burst`` / ``_drain_pending``) must
+  go through the sanctioned ``_release_blocks`` wrapper (which defers
+  while ``_defer_release`` is set) or sit in the burst's own ``finally``
+  (burst exit — the deferral target). This rule walks the *call graph*:
+  a helper three frames deep that calls ``self.block_mgr.release(...)``
+  directly is convicted too.
+
+- **INV902 — whole-graph fetch confinement.** PERF701 polices
+  synchronous device fetches in the engine file's dispatch-path method
+  bodies; INV902 extends the same contract across the call graph: any
+  function *reachable* from the dispatch path — including helpers in
+  other modules — must not synchronize device→host outside the
+  designated fetch stages (functions named ``_fetch*``/``_run*``) or a
+  lockstep branch. Outside ``serving/engine.py`` only the unambiguous
+  device syncs (``jax.block_until_ready``, ``jax.device_get``,
+  ``.block_until_ready()``) are counted — ``np.asarray`` in a helper
+  module is usually host-numpy math, and a false positive in the tier-1
+  gate is a broken build (docs/ANALYSIS.md, "precision beats recall").
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding
+from langstream_tpu.analysis.project import ProjectIndex, ProjectRule
+
+#: the engine file whose invariants these rules guard (suffix match so
+#: fixture trees can provide their own engine module)
+_ENGINE_FILE = "serving/engine.py"
+
+#: burst-dispatch entry points for the deferred-release invariant
+_BURST_ENTRIES = ("_decode_burst", "_speculative_burst", "_drain_pending")
+
+#: dispatch-path entry points for fetch confinement (superset: everything
+#: PERF701 scopes, so the graph walk starts where the per-file rule ends)
+_DISPATCH_ENTRIES = (
+    "_decode_burst", "_drain_pending", "_speculative_burst",
+    "_advance_prefills", "_admit", "_process_chunk", "_emit_token",
+    "_flush_emits", "_tables_device", "_sampler_device",
+)
+
+#: designated fetch-stage name prefixes (mirrors PERF701)
+_FETCH_STAGES = ("_fetch", "_run")
+
+
+def _engine_entry_qnames(index: ProjectIndex, names) -> list[str]:
+    return [
+        fn.qname
+        for fn in index.functions.values()
+        if fn.path.endswith(_ENGINE_FILE) and fn.name in names
+    ]
+
+
+def _is_fetch_stage(fn) -> bool:
+    return any(
+        scope.startswith(prefix)
+        for scope in fn.scope_names
+        for prefix in _FETCH_STAGES
+    )
+
+
+def check_deferred_release(index: ProjectIndex) -> Iterator[Finding]:
+    entries = _engine_entry_qnames(index, _BURST_ENTRIES)
+    if not entries:
+        return
+    for qname in sorted(index.reachable(entries)):
+        fn = index.functions[qname]
+        if fn.name == "_release_blocks":
+            continue  # the sanctioned deferral wrapper
+        for site in fn.release_sites:
+            if site.in_finally and fn.name in _BURST_ENTRIES:
+                # burst exit: the deferral target itself. ONLY the burst
+                # entry's own finally qualifies — a helper's try/finally
+                # still releases mid-burst, which is exactly the stale-KV
+                # reuse the invariant forbids
+                continue
+            yield Finding(
+                rule="INV901",
+                path=fn.path,
+                line=site.line,
+                symbol=".".join(fn.scope_names),
+                message=(
+                    f"direct `{site.receiver}.release(...)` reachable from "
+                    f"the burst-dispatch path ({', '.join(_BURST_ENTRIES)}) "
+                    f"— an in-flight pipelined chunk still commits through "
+                    f"tables captured at dispatch, so a mid-burst release "
+                    f"can hand its blocks to a live slot and land stale K/V "
+                    f"on it; route through _release_blocks (deferred while "
+                    f"_defer_release) or the burst's finally block "
+                    f"(docs/PIPELINE.md, deferred-release invariant)"
+                ),
+            )
+
+
+def check_fetch_confinement(index: ProjectIndex) -> Iterator[Finding]:
+    entries = _engine_entry_qnames(index, _DISPATCH_ENTRIES)
+    if not entries:
+        return
+    for qname in sorted(index.reachable(entries)):
+        fn = index.functions[qname]
+        if _is_fetch_stage(fn):
+            continue  # the designated fetch stages themselves
+        in_engine_dispatch = fn.path.endswith(_ENGINE_FILE) and any(
+            scope in _DISPATCH_ENTRIES for scope in fn.scope_names
+        )
+        if in_engine_dispatch:
+            continue  # PERF701's turf: the per-file rule reports these
+        in_engine_file = fn.path.endswith(_ENGINE_FILE)
+        for site in fn.fetch_sites:
+            if site.lockstep:
+                continue  # broadcast protocol ships host bytes by design
+            if not in_engine_file and not site.unambiguous:
+                continue  # np.asarray/.item() off-engine: host numpy math
+            yield Finding(
+                rule="INV902",
+                path=fn.path,
+                line=site.line,
+                symbol=".".join(fn.scope_names),
+                message=(
+                    f"synchronous device fetch {site.spelling} in "
+                    f"`{fn.name}`, which is reachable from the engine "
+                    f"dispatch path — it serializes the host against the "
+                    f"device from a helper PERF701 cannot see; keep the "
+                    f"sync inside _fetch_chunk / the off-loop _run closure, "
+                    f"or keep the data device-resident "
+                    f"(docs/PIPELINE.md, one-transfer-per-chunk)"
+                ),
+            )
+
+
+RULES = [
+    ProjectRule(
+        id="INV901",
+        family="inv",
+        summary="block release reachable from the burst-dispatch path "
+        "outside _release_blocks / the burst's finally — violates the "
+        "pipelined loop's deferred-release invariant",
+        check=check_deferred_release,
+    ),
+    ProjectRule(
+        id="INV902",
+        family="inv",
+        summary="synchronous device fetch anywhere in the call graph "
+        "reachable from the engine dispatch path, outside the designated "
+        "fetch stages (whole-program PERF701)",
+        check=check_fetch_confinement,
+    ),
+]

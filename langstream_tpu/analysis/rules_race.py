@@ -1,0 +1,243 @@
+"""Thread-role race rules (project-level): RACE801 / RACE802.
+
+The PR 5 pipelined loop made the serving stack genuinely concurrent —
+asyncio handlers on the event loop, the single ``tpu-engine`` dispatch
+thread running the decode closures, dedicated worker threads (lockstep
+accept/replay) — and its safety invariants lived in prose. These rules
+police them mechanically from the :class:`ProjectIndex` thread roles:
+
+- **RACE801** — an instance field *written* in one thread role and
+  *accessed* in another (or touched by a function that carries two roles,
+  which races with itself) without a lock, a designated handoff
+  structure, or a suppression. Lost updates and torn read-modify-writes
+  are exactly the bug PR 5's "block releases defer to burst exit" prose
+  exists to prevent.
+- **RACE802** — a collection field *mutated* in one role while *iterated*
+  in another: ``RuntimeError: dict changed size during iteration`` on the
+  reader, silent skips on a list. Reported instead of (not on top of)
+  RACE801 for the same attribute.
+
+Sanctioned patterns (true negatives by design):
+
+- conflicting pairs where BOTH sides sit under ``with <…lock…>:`` /
+  ``async with <…lock…>:`` — one-sided locking is still reported (the
+  unlocked side reads stale/torn state);
+- attributes initialized to thread-safe handoff primitives
+  (``asyncio.Event``, ``threading.Lock``, ``queue.Queue``, ``deque``,
+  futures — GIL-atomic appends are the flight recorder's documented
+  discipline);
+- accesses inside ``if …_lockstep…:`` branches — the broadcast protocol
+  ships host state from the dispatch thread by design (the same
+  exemption PERF701 grants);
+- writes in ``__init__``/construction-only helpers (role propagation is
+  cut at constructors: the object is not yet published);
+- inline ``# graftcheck: disable=RACE801 reason`` suppressions — e.g.
+  ``TpuServingEngine.close`` drops device references after the loop task
+  is awaited and the executor shut down, an ordering the static model
+  cannot see.
+
+Scope: ``serving/``, ``gateway/``, ``runtime/`` — the packages where the
+event loop meets real threads. One finding per (class, attribute),
+anchored at the event-loop-side access when one exists (that is where
+the handoff belongs), so a single suppression retires the finding.
+"""
+
+from __future__ import annotations
+
+import re
+import weakref
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding
+from langstream_tpu.analysis.project import (
+    AttrAccess,
+    ProjectIndex,
+    ProjectRule,
+    ROLE_ASYNC,
+    conflicting_roles,
+)
+
+#: packages where the event loop meets dedicated threads
+_SCOPE_RE = re.compile(r"(^|/)(serving|gateway|runtime)/")
+
+
+def _scoped(path: str) -> bool:
+    return bool(_SCOPE_RE.search(path))
+
+
+def _role_label(roles: frozenset[str]) -> str:
+    return "+".join(sorted(roles)) or "?"
+
+
+def _conflicts(
+    index: ProjectIndex,
+    writes: list[AttrAccess],
+    accesses: list[AttrAccess],
+) -> tuple[AttrAccess, AttrAccess] | None:
+    """First (write, counterpart) pair whose functions can run on two
+    different threads. A both-roles function conflicts with itself. A
+    lock exempts a PAIR only when BOTH sides hold it — a writer locking
+    against other writers while a reader peeks unguarded is still a race
+    (stale/torn reads on the unlocked side)."""
+    for w in writes:
+        wr = index.role_of(w.func)
+        if len(wr) > 1 and not w.locked:
+            return (w, w)
+        for a in accesses:
+            if a is w:
+                continue
+            if w.locked and a.locked:
+                continue
+            if conflicting_roles(wr, index.role_of(a.func)):
+                return (w, a)
+    return None
+
+
+def _anchor(
+    index: ProjectIndex, pair: tuple[AttrAccess, AttrAccess],
+    accesses: list[AttrAccess],
+) -> AttrAccess:
+    """Prefer the event-loop-side access as the finding anchor — the loop
+    side is where the handoff (snapshot, lock, queue) belongs, and a
+    suppression there retires the whole (class, attr) finding."""
+    implicated = [a for a in accesses if ROLE_ASYNC in index.role_of(a.func)]
+    loop_writes = [a for a in implicated if a.kind in ("write", "mutate")]
+    pool = loop_writes or implicated or list(pair)
+    return min(pool, key=lambda a: (a.path, a.line))
+
+
+def _eligible(index: ProjectIndex, accesses: list[AttrAccess]):
+    """Drop accesses the model sanctions outright: lockstep-branch
+    protocol state and role-less functions (construction/main-thread-only
+    code). Locked accesses stay in — the lock exemption is pairwise
+    (both sides must hold it), decided in :func:`_conflicts`."""
+    return [
+        a for a in accesses
+        if not a.lockstep and index.role_of(a.func)
+    ]
+
+
+def check_cross_thread_state(index: ProjectIndex) -> Iterator[Finding]:
+    for cls in index.classes.values():
+        if not _scoped(cls.path):
+            continue
+        by_attr: dict[str, list[AttrAccess]] = {}
+        for access in cls.attr_accesses:
+            if access.attr in cls.handoff_attrs:
+                continue
+            by_attr.setdefault(access.attr, []).append(access)
+        for attr, accesses in sorted(by_attr.items()):
+            live = _eligible(index, accesses)
+            if not live:
+                continue
+            writes = [a for a in live if a.kind in ("write", "mutate")]
+            if not writes:
+                continue
+
+            # RACE802 first (more specific): mutation racing iteration
+            mutates = [a for a in live if a.kind == "mutate"]
+            iterates = [a for a in live if a.kind == "iterate"]
+            pair = _conflicts(index, mutates, iterates) if iterates else None
+            if pair is not None and (
+                pair[0].kind == "mutate" or pair[0] is pair[1]
+            ):
+                w, other = pair
+                anchor = _anchor(index, pair, live)
+                yield Finding(
+                    rule="RACE802",
+                    path=anchor.path,
+                    line=anchor.line,
+                    symbol=f"{cls.name}.{attr}",
+                    message=(
+                        f"collection `{attr}` is mutated in "
+                        f"{w.func.split('.')[-1]} "
+                        f"[{_role_label(index.role_of(w.func))}] while "
+                        f"iterated in {other.func.split('.')[-1]} "
+                        f"[{_role_label(index.role_of(other.func))}] with no "
+                        f"lock or handoff structure — a concurrent resize "
+                        f"raises RuntimeError (dict/set) or silently skips "
+                        f"elements (list); snapshot with list(...) under a "
+                        f"lock, or hand off through a queue/deque"
+                    ),
+                )
+                continue  # don't double-report as RACE801
+
+            pair = _conflicts(index, writes, live)
+            if pair is None:
+                continue
+            w, other = pair
+            if w is other:
+                detail = (
+                    f"`{attr}` is written in {w.func.split('.')[-1]}, which "
+                    f"runs on more than one thread "
+                    f"[{_role_label(index.role_of(w.func))}] — it races "
+                    f"with itself"
+                )
+            else:
+                detail = (
+                    f"`{attr}` is written in {w.func.split('.')[-1]} "
+                    f"[{_role_label(index.role_of(w.func))}] and accessed "
+                    f"in {other.func.split('.')[-1]} "
+                    f"[{_role_label(index.role_of(other.func))}]"
+                )
+            anchor = _anchor(index, pair, live)
+            yield Finding(
+                rule="RACE801",
+                path=anchor.path,
+                line=anchor.line,
+                symbol=f"{cls.name}.{attr}",
+                message=(
+                    f"{detail} with no lock, handoff structure, or "
+                    f"suppression — cross-thread read-modify-write loses "
+                    f"updates; snapshot host state on the event loop before "
+                    f"dispatch, guard with a lock, or initialize `{attr}` "
+                    f"to a thread-safe handoff type"
+                ),
+            )
+
+
+_WALK_CACHE: "weakref.WeakKeyDictionary[ProjectIndex, list[Finding]]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def _all_findings(index: ProjectIndex) -> list[Finding]:
+    """The shared per-class/per-attribute walk, memoized per index so
+    registering two rule ids doesn't run it twice."""
+    cached = _WALK_CACHE.get(index)
+    if cached is None:
+        cached = list(check_cross_thread_state(index))
+        _WALK_CACHE[index] = cached
+    return cached
+
+
+def _only(rule_id: str):
+    """The two rules share one walk (RACE802 takes precedence per attr);
+    each registration keeps only its own findings so the driver can run
+    both without double-reporting."""
+
+    def check(index: ProjectIndex) -> Iterator[Finding]:
+        for finding in _all_findings(index):
+            if finding.rule == rule_id:
+                yield finding
+
+    return check
+
+
+RULES = [
+    ProjectRule(
+        id="RACE801",
+        family="race",
+        summary="instance field written in one thread role (async loop / "
+        "dispatch thread / worker) and accessed in another without a lock, "
+        "handoff structure, or suppression",
+        check=_only("RACE801"),
+    ),
+    ProjectRule(
+        id="RACE802",
+        family="race",
+        summary="collection mutated in one thread role while iterated in "
+        "another — RuntimeError or silent element skips on the reader",
+        check=_only("RACE802"),
+    ),
+]

@@ -366,8 +366,11 @@ class KafkaTopicConsumer(TopicConsumer):
             for (topic, partition), position in to_commit.items()
         ]
         loop = asyncio.get_running_loop()
+        # captured on the loop thread: close() nulls the field, and the
+        # executor closure must not re-read it mid-flight (RACE801)
+        consumer = self._consumer
         await loop.run_in_executor(
-            None, lambda: self._consumer.commit(offsets=tps, asynchronous=False)
+            None, lambda: consumer.commit(offsets=tps, asynchronous=False)
         )
 
     def total_out(self) -> int:
@@ -461,19 +464,22 @@ class KafkaTopicReader(TopicReader):
             self._factory(self._conf) if self._factory else kafka.Consumer(self._conf)
         )
         loop = asyncio.get_running_loop()
+        # captured on the loop thread: close() nulls the field, and the
+        # executor closure must not re-read it mid-flight (RACE801)
+        consumer = self._consumer
 
         def _assign() -> None:
-            md = self._consumer.list_topics(self.topic, timeout=10)
+            md = consumer.list_topics(self.topic, timeout=10)
             topic_md = md.topics.get(self.topic)
             partitions = sorted(topic_md.partitions) if topic_md else [0]
             tps = []
             for p in partitions:
-                lo, hi = self._consumer.get_watermark_offsets(
+                lo, hi = consumer.get_watermark_offsets(
                     kafka.TopicPartition(self.topic, p), timeout=10
                 )
                 start = lo if self.initial_position == "earliest" else hi
                 tps.append(kafka.TopicPartition(self.topic, p, start))
-            self._consumer.assign(tps)
+            consumer.assign(tps)
 
         await loop.run_in_executor(None, _assign)
 

@@ -195,8 +195,12 @@ class PravegaTopicConsumer(TopicConsumer):
             # would double-consume), so the in-flight future is kept and
             # re-awaited on the next read
             if self._slice_future is None:
+                # the bound method is captured on the loop thread: close()
+                # nulls _reader, and the (possibly abandoned) blocking call
+                # must not re-read the field mid-flight (RACE801)
+                reader = self._reader
                 self._slice_future = loop.run_in_executor(
-                    None, lambda: self._reader.get_segment_slice()
+                    None, reader.get_segment_slice
                 )
             if timeout is not None:
                 done, _ = await asyncio.wait(
@@ -216,8 +220,10 @@ class PravegaTopicConsumer(TopicConsumer):
                 self._slice_future = None
             if self._slice is None:
                 return []
+        # captured on the loop thread (same RACE801 discipline as _reader)
+        current_slice = self._slice
         event = await loop.run_in_executor(
-            None, lambda: next(iter(self._slice), None)
+            None, lambda: next(iter(current_slice), None)
         )
         if event is None:
             # slice drained; release once everything it held is committed
@@ -280,14 +286,17 @@ class PravegaTopicProducer(TopicProducer):
     async def write(self, record: Record) -> None:
         payload, routing_key = record_to_event(record)
         loop = asyncio.get_running_loop()
+        # captured on the loop thread: close() nulls the field, and the
+        # executor closure must not re-read it mid-flight (RACE801)
+        writer = self._writer
 
         def _write():
             if routing_key is not None:
-                result = self._writer.write_event_bytes(
+                result = writer.write_event_bytes(
                     payload, routing_key=routing_key
                 )
             else:
-                result = self._writer.write_event_bytes(payload)
+                result = writer.write_event_bytes(payload)
             # the binding queues writes and returns a future; durability =
             # the broker acked, and the tracker upstream commits the source
             # offset when this returns — so block on the ack here

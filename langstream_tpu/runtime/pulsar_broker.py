@@ -189,10 +189,13 @@ class PulsarTopicConsumer(TopicConsumer):
     async def read(self) -> list[Record]:
         pulsar = _pulsar()
         loop = asyncio.get_running_loop()
+        # captured on the loop thread: close() nulls the field, and the
+        # executor closure must not re-read it mid-flight (RACE801)
+        consumer = self._consumer
 
         def _receive():
             try:
-                return self._consumer.receive(timeout_millis=500)
+                return consumer.receive(timeout_millis=500)
             except pulsar.Timeout:
                 return None
             except Exception as e:  # pulsar maps timeouts to generic errors
@@ -248,12 +251,14 @@ class PulsarTopicProducer(TopicProducer):
     async def write(self, record: Record) -> None:
         payload, properties, partition_key = record_to_payload(record)
         loop = asyncio.get_running_loop()
+        # captured on the loop thread — see PulsarTopicConsumer.read
+        producer = self._producer
 
         def _send():
             kwargs: dict[str, Any] = {"properties": properties}
             if partition_key is not None:
                 kwargs["partition_key"] = partition_key
-            self._producer.send(payload, **kwargs)
+            producer.send(payload, **kwargs)
 
         await loop.run_in_executor(None, _send)
         self._total_in += 1
@@ -294,10 +299,12 @@ class PulsarTopicReader(TopicReader):
         pulsar = _pulsar()
         loop = asyncio.get_running_loop()
         millis = int((timeout if timeout is not None else 0.5) * 1000)
+        # captured on the loop thread — see PulsarTopicConsumer.read
+        reader = self._reader
 
         def _read():
             try:
-                return self._reader.read_next(timeout_millis=millis)
+                return reader.read_next(timeout_millis=millis)
             except pulsar.Timeout:
                 return None
             except Exception as e:

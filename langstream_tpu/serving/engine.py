@@ -445,42 +445,55 @@ class _DeviceLru:
     Keeping the last N contents fixes the flip-flop — and the bound plus
     eviction counter (``engine.stats()["device-cache"]``) keeps a
     long-lived engine from pinning one device buffer per distinct block
-    table it ever saw. Engine-loop/dispatch-thread only; plain dict ops,
-    no locks (OBS503 discipline)."""
+    table it ever saw. One instance is touched from the engine loop, the
+    other from the dispatch thread, and ``stats()``/``clear()`` run on
+    whichever thread asks — so the OrderedDict bookkeeping (a multi-step
+    read-modify-write, not a single GIL-atomic op) sits behind a plain
+    ``threading.Lock``. The lock is uncontended in steady state and never
+    held across I/O or device calls, so the OBS503 hot-path discipline
+    holds; graftcheck RACE801 polices exactly this shape."""
 
     def __init__(self, cap: int | None = None):
         from collections import OrderedDict
 
         self.cap = cap if cap is not None else _dev_cache_cap()
+        self._lock = threading.Lock()
         self._entries: Any = OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
     def get_or_put(self, key: bytes, factory: Callable[[], Any]) -> Any:
-        entry = self._entries.get(key)
-        if entry is not None:
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return entry
-        self.misses += 1
-        entry = self._entries[key] = factory()
-        while len(self._entries) > self.cap:
-            self._entries.popitem(last=False)
-            self.evictions += 1
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry
+            self.misses += 1
+        # the factory (a device upload RPC) runs OUTSIDE the lock; a lost
+        # race uploads twice, which is the pre-LRU behavior, not a bug
+        entry = factory()
+        with self._lock:
+            self._entries[key] = entry
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+                self.evictions += 1
         return entry
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
 
     def stats(self) -> dict[str, int]:
-        return {
-            "size": len(self._entries),
-            "cap": self.cap,
-            "hits": self.hits,
-            "misses": self.misses,
-            "evictions": self.evictions,
-        }
+        with self._lock:
+            return {
+                "size": len(self._entries),
+                "cap": self.cap,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
 
 
 class TpuServingEngine:
@@ -1668,7 +1681,11 @@ class TpuServingEngine:
             await self._loop_task
         if self._lockstep is not None:
             self._lockstep.close()
-        self._executor.shutdown(wait=False)
+        # wait=True: the loop task above is done, so the executor queue is
+        # empty or finishing its last closure — joining it here is what
+        # makes the reference drops below race-free (the dispatch thread
+        # no longer exists when they run)
+        self._executor.shutdown(wait=True)
         # evict from the singleton cache: a closed engine must not be handed
         # out again (its loop would exit immediately, stranding requests)
         with self._instances_lock:
@@ -1681,10 +1698,13 @@ class TpuServingEngine:
         # (speculation on/off comparison, model reload) must not OOM
         # against a ghost (r5: the speculative bench child died exactly
         # this way)
+        # graftcheck: disable=RACE801 loop task awaited + executor joined (wait=True): no dispatch closure can still run
         self.params = None
+        # graftcheck: disable=RACE801 loop task awaited + executor joined (wait=True): no dispatch closure can still run
         self.cache_k = self.cache_v = None
         self._decode_chunk_fns.clear()
         self._pending_chunk = None
+        # graftcheck: disable=RACE801 loop task awaited + executor joined (wait=True): no dispatch closure can still run
         self._tables_dev_cache.clear()
         self._sampler_dev_cache.clear()
 
@@ -1980,7 +2000,14 @@ class TpuServingEngine:
                 self._topps[active_mask],
             )
             fn = self._verify_fn(nrb, sampler_mode)
+            # host state snapshotted on the LOOP thread: the verify step
+            # yields to admission between iterations, which rewrites the
+            # sampler arrays — the dispatch closure must not re-read
+            # mutable engine fields mid-flight (RACE801)
             lengths_np = self._lengths.copy()
+            temps_np = self._temps.copy()
+            topks_np = self._topks.copy()
+            topps_np = self._topps.copy()
             key = self._split_key()
 
             def _run():
@@ -1997,17 +2024,17 @@ class TpuServingEngine:
                             "active": active_mask,
                             "tables": tables,
                             "key": np.asarray(key),
-                            "temps": np.asarray(self._temps),
-                            "topks": np.asarray(self._topks),
-                            "topps": np.asarray(self._topps),
+                            "temps": temps_np,
+                            "topks": topks_np,
+                            "topps": topps_np,
                         }
                     )
                 out = fn(
                     self.params, self.cache_k, self.cache_v,
                     jnp.asarray(tokens), jnp.asarray(lengths_np),
                     jnp.asarray(active_mask), jnp.asarray(tables),
-                    key, jnp.asarray(self._temps), jnp.asarray(self._topks),
-                    jnp.asarray(self._topps),
+                    key, jnp.asarray(temps_np), jnp.asarray(topks_np),
+                    jnp.asarray(topps_np),
                 )
                 self.cache_k, self.cache_v = out[4], out[5]
                 # dispatch returned async; the fetches below block until
@@ -2257,6 +2284,11 @@ class TpuServingEngine:
             (self._pres[active_mask] != 0).any()
             or (self._freq[active_mask] != 0).any()
         )
+        # penalty state snapshotted on the LOOP thread: _admit/_advance_
+        # prefills rewrite these arrays between bursts, and the dispatch
+        # thread must never re-read engine fields mid-flight (RACE801)
+        pres_np = self._pres.copy() if pen else None
+        freq_np = self._freq.copy() if pen else None
         # host-tracked longest active sequence: each dispatched chunk grows
         # it by K; the attention window bucket follows
         base_max = int(self._lengths[active].max())
@@ -2308,10 +2340,13 @@ class TpuServingEngine:
                 self.flight.event("pool-grow", slots=grown, phase="decode")
             return self.block_mgr.tables.copy()
 
-        def _dispatch(tokens, lengths, key, window, tables, first=False):
-            # async JAX dispatch: returns device arrays without blocking
-            decode_fn = self._decode_fn(sampler_mode, window, K, pen)
-            counts_np = _build_counts() if pen else None
+        def _dispatch(tokens, lengths, key, window, tables, decode_fn,
+                      counts_np=None, first=False):
+            # async JAX dispatch: returns device arrays without blocking.
+            # Everything the closure needs (the resolved jit variant, the
+            # penalty snapshot, the block tables) was prepared on the loop
+            # thread by _submit — the dispatch thread reads no mutable
+            # engine fields outside the lockstep protocol branch (RACE801)
             if self._lockstep is not None:
                 # runs on the single dispatch thread → broadcast order is
                 # dispatch order. Speculative chunks ("decode_cont") carry
@@ -2335,8 +2370,8 @@ class TpuServingEngine:
                     # but penalties are a per-request opt-in)
                     desc.update(
                         pen=True,
-                        pres=np.asarray(self._pres),
-                        freq=np.asarray(self._freq),
+                        pres=pres_np,
+                        freq=freq_np,
                         counts=counts_np,
                     )
                 if first:
@@ -2348,10 +2383,6 @@ class TpuServingEngine:
                         topps=np.asarray(self._topps),
                     )
                 self._lockstep.broadcast(desc)
-            if light:
-                self._light_chunks += 1
-            else:
-                self._heavy_chunks += 1
             self.profiler.on_decode_chunk()
             tables_dev = self._tables_device(tables)
             args = (
@@ -2363,7 +2394,7 @@ class TpuServingEngine:
             )
             if pen:
                 args = args + (
-                    jnp.asarray(self._pres), jnp.asarray(self._freq),
+                    jnp.asarray(pres_np), jnp.asarray(freq_np),
                     jnp.asarray(counts_np),
                 )
             self.profiler.dump_hlo(
@@ -2384,12 +2415,29 @@ class TpuServingEngine:
                 else self._window_for(max_len)
             )
 
-        out = await loop.run_in_executor(
-            self._executor,
-            partial(
-                _dispatch, jnp.asarray(self._current), jnp.asarray(self._lengths),
-                key1, _bucket_for(base_max), _grow_blocks(0), first=True,
-            ),
+        def _submit(tokens, lengths, key, window, tables, first=False):
+            """Loop-thread half of a chunk dispatch: resolve the jit
+            variant (so the ``_decode_chunk_fns``/``_compiled_shapes``
+            bookkeeping never runs on the dispatch thread), rebuild the
+            penalty counts from host truth, bump the regime counters,
+            then hand the fully-prepared closure to the dispatch thread.
+            Returns the executor future — awaited immediately by the
+            sequential path, left in flight by the pipelined one."""
+            decode_fn = self._decode_fn(sampler_mode, window, K, pen)
+            counts_np = _build_counts() if pen else None
+            if light:
+                self._light_chunks += 1
+            else:
+                self._heavy_chunks += 1
+            return loop.run_in_executor(
+                self._executor,
+                partial(_dispatch, tokens, lengths, key, window, tables,
+                        decode_fn, counts_np, first=first),
+            )
+
+        out = await _submit(
+            jnp.asarray(self._current), jnp.asarray(self._lengths),
+            key1, _bucket_for(base_max), _grow_blocks(0), first=True,
         )
         chunk_index = 0
         if light or pen or not self._pipeline_on:
@@ -2414,10 +2462,9 @@ class TpuServingEngine:
                 chunk_index += 1
                 # sequential: the chunk just processed is in _lengths, so
                 # blocks grow with a fixed one-chunk lookahead
-                out = await loop.run_in_executor(
-                    self._executor,
-                    partial(_dispatch, out[1], out[2], self._split_key(),
-                            _bucket_for(base_max), _grow_blocks(0)),
+                out = await _submit(
+                    out[1], out[2], self._split_key(),
+                    _bucket_for(base_max), _grow_blocks(0),
                 )
 
         async def _drain(out, expected, overlapped_s: float = 0.0) -> None:
@@ -2470,10 +2517,9 @@ class TpuServingEngine:
                 key_next = self._split_key()
                 # pipelined: exactly one dispatched chunk is still
                 # unprocessed when the speculative chunk is dispatched
-                next_out_task = loop.run_in_executor(
-                    self._executor,
-                    partial(_dispatch, out[1], out[2], key_next,
-                            _bucket_for(base_max), _grow_blocks(1)),
+                next_out_task = _submit(
+                    out[1], out[2], key_next,
+                    _bucket_for(base_max), _grow_blocks(1),
                 )
                 chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
                     self._executor, partial(self._fetch_chunk, out[0], K)
@@ -2639,6 +2685,10 @@ class TpuServingEngine:
                 jnp.asarray(suffix_lens), jnp.asarray(sel_np), key,
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
             )
+            # the donated caches are re-bound HERE, on the dispatch thread
+            # — the same side that reads them in every dispatch closure, so
+            # cache_k/cache_v stay single-thread-role (RACE801)
+            self.cache_k, self.cache_v = out[2], out[3]
             t_dev = time.monotonic()
             # the ONE per-dispatch sync, on the dispatch thread and timed
             # (the sample's device_ms); the token/logprob fetch rides the
@@ -2646,13 +2696,10 @@ class TpuServingEngine:
             # graftcheck: disable=JAX104 the one per-dispatch sync, moved off-loop and timed
             jax.block_until_ready(out)
             device_s = time.monotonic() - t_dev
-            return (
-                np.asarray(out[0]), np.asarray(out[1]), out[2], out[3],
-                device_s,
-            )
+            return np.asarray(out[0]), np.asarray(out[1]), device_s
 
-        (next_np, logprob_np, self.cache_k, self.cache_v, device_s) = (
-            await loop.run_in_executor(self._executor, _run)
+        next_np, logprob_np, device_s = await loop.run_in_executor(
+            self._executor, _run
         )
         now = time.monotonic()
         done_slots = []
@@ -2888,6 +2935,9 @@ class TpuServingEngine:
                     f"prefill_p{bucket}_b{Bp}{variant}", prefill_fn, *args
                 )
                 out = prefill_fn(*args)
+                # donated caches re-bound on the dispatch thread — see
+                # _advance_prefills._run (RACE801: single thread role)
+                self.cache_k, self.cache_v = out[2], out[3]
                 t_dev = time.monotonic()
                 # same single sync the loop-thread np.asarray used to pay,
                 # moved onto the dispatch thread so it can be timed; the
@@ -2895,13 +2945,10 @@ class TpuServingEngine:
                 # graftcheck: disable=JAX104 the one per-dispatch sync, moved off-loop and timed
                 jax.block_until_ready(out)
                 device_s = time.monotonic() - t_dev
-                return (
-                    np.asarray(out[0]), np.asarray(out[1]), out[2], out[3],
-                    device_s,
-                )
+                return np.asarray(out[0]), np.asarray(out[1]), device_s
 
-            (next_np, logprob_np, self.cache_k, self.cache_v, device_s) = (
-                await loop.run_in_executor(self._executor, _run)
+            next_np, logprob_np, device_s = await loop.run_in_executor(
+                self._executor, _run
             )
             if use_prefix:
                 for slot_id, request, reuse in batch:

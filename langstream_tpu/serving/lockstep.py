@@ -273,22 +273,36 @@ class LockstepFollower:
         self.connect_timeout = connect_timeout
         self.token = token
         self.engine = None
+        # stop() is a cross-thread signal: the pod's event loop calls it
+        # while run() blocks in recv on the replay thread — the flag is a
+        # threading.Event (a designated handoff, RACE801) and the socket
+        # handle is guarded so stop() never races the assignment in run()
+        self._sock_lock = threading.Lock()
         self._sock: socket.socket | None = None
-        self._stopping = False
+        self._stopping = threading.Event()
 
     def stop(self) -> None:
         """Unblock a blocked ``run`` (SIGTERM path): closing the socket
-        makes the pending recv raise, and ``run`` returns cleanly."""
-        self._stopping = True
-        if self._sock is not None:
+        makes the pending recv raise, and ``run`` returns cleanly. Safe to
+        call from any thread (the pod's loop calls it on SIGTERM while
+        the replay thread owns the socket)."""
+        self._stopping.set()
+        with self._sock_lock:
+            sock = self._sock
+        if sock is not None:
             try:
-                self._sock.close()
+                sock.close()
             except OSError:
                 pass
 
     def _connect(self) -> socket.socket:
         deadline = time.monotonic() + self.connect_timeout
         while True:
+            if self._stopping.is_set():
+                # stop() landed while we were still retrying the connect:
+                # there is no socket to close yet, so the flag is the only
+                # way out of the retry loop
+                raise ConnectionAbortedError("lockstep follower stopping")
             try:
                 sock = socket.create_connection(self.addr, timeout=10.0)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -311,7 +325,22 @@ class LockstepFollower:
 
         from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
 
-        sock = self._sock = self._connect()
+        try:
+            sock = self._connect()
+        except ConnectionAbortedError:
+            return 0  # stop() before any connection: nothing replayed
+        with self._sock_lock:
+            self._sock = sock
+            stopping = self._stopping.is_set()
+        if stopping:
+            # stop() ran between _connect and the assignment above: it saw
+            # _sock as None and closed nothing — close here or the recv
+            # loop below would block forever with the flag already set
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return 0
         sock.sendall(encode_descriptor({"op": "join", "token": self.token}))
         handshake = read_frame(sock)
         if handshake.get("op") == "reject":
@@ -336,7 +365,7 @@ class LockstepFollower:
             try:
                 desc = read_frame(sock)
             except (ConnectionError, OSError):
-                if self._stopping:
+                if self._stopping.is_set():
                     break  # stop() closed the socket: clean local shutdown
                 raise
             op = desc.get("op")

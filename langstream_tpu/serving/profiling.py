@@ -28,18 +28,33 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
 from typing import Any
 
 log = logging.getLogger(__name__)
 
 
 class ProfilerHooks:
-    """Owns trace capture state for one engine instance."""
+    """Owns trace capture state for one engine instance.
+
+    Capture state is touched from two threads: the pod's ``/profile``
+    debug endpoint drives :meth:`start_trace`/:meth:`stop_trace` from the
+    event loop while :meth:`on_decode_chunk` runs on the engine dispatch
+    thread — so the start/stop/auto-countdown read-modify-writes sit
+    behind a lock (graftcheck RACE801 polices the shape). The lock guards
+    only the state transitions: the ``_tracing`` flag is flipped as a
+    *reservation* and the filesystem / ``jax.profiler`` calls run outside
+    it, so the event-loop thread can never stall on a lock held across
+    I/O (the OBS502/OBS503 failure mode). A concurrent start+stop can
+    therefore observe the reservation before the profiler actually
+    started — the losing call's jax error is caught and logged, never
+    raised into serving, which is this class's contract anyway."""
 
     def __init__(self) -> None:
         self.profile_dir = os.environ.get("LS_TPU_PROFILE_DIR")
         self.auto_chunks = int(os.environ.get("LS_TPU_PROFILE_CHUNKS", "4"))
         self.hlo_dir = os.environ.get("LS_TPU_HLO_DUMP_DIR")
+        self._state_lock = threading.Lock()
         self._tracing = False
         self._auto_remaining = self.auto_chunks if self.profile_dir else 0
         self._dumped: set[str] = set()
@@ -51,30 +66,36 @@ class ProfilerHooks:
         capture started. The profiler is process-global while hooks are
         per-engine, so a capture already running elsewhere (another engine)
         is tolerated, never raised into the serving path."""
-        if self._tracing:
-            return False
         target = trace_dir or self.profile_dir
         if not target:
             return False
+        with self._state_lock:
+            if self._tracing:
+                return False
+            self._tracing = True  # reserve: concurrent callers back off
         import jax
 
         try:
             os.makedirs(target, exist_ok=True)
             jax.profiler.start_trace(target)
         except Exception as e:  # profiling must never break serving
-            log.warning("profiler trace start failed (already active?): %s", e)
-            self._auto_remaining = 0
+            log.warning(
+                "profiler trace start failed (already active?): %s", e
+            )
+            with self._state_lock:
+                self._tracing = False
+                self._auto_remaining = 0
             return False
-        self._tracing = True
         log.info("jax profiler trace started -> %s", target)
         return True
 
     def stop_trace(self) -> bool:
-        if not self._tracing:
-            return False
+        with self._state_lock:
+            if not self._tracing:
+                return False
+            self._tracing = False
         import jax
 
-        self._tracing = False
         try:
             jax.profiler.stop_trace()
         except Exception as e:
@@ -86,12 +107,18 @@ class ProfilerHooks:
     def on_decode_chunk(self) -> None:
         """Called once per dispatched decode chunk: drives the env-var
         auto-capture of the first N chunks."""
-        if self._auto_remaining <= 0:
-            return
-        if not self._tracing and not self.start_trace():
+        with self._state_lock:
+            if self._auto_remaining <= 0:
+                return
+            need_start = not self._tracing
+        if need_start and not self.start_trace():
             return  # start failed/disabled; _auto_remaining already zeroed
-        self._auto_remaining -= 1
-        if self._auto_remaining == 0:
+        with self._state_lock:
+            if self._auto_remaining <= 0:
+                return
+            self._auto_remaining -= 1
+            should_stop = self._auto_remaining == 0
+        if should_stop:
             self.stop_trace()
 
     # -- HLO dumps ------------------------------------------------------
