@@ -1,6 +1,6 @@
 """graftcheck: first-party static analysis for the langstream-tpu tree.
 
-Ten rule families tuned to this codebase's actual failure modes:
+Eleven rule families tuned to this codebase's actual failure modes:
 
 ==========  ==============================================================
 JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
@@ -27,16 +27,24 @@ INV901/902  engine invariants across the call graph: block releases on
             the burst-dispatch path outside the sanctioned deferral, and
             device syncs reachable from the dispatch path beyond the
             method bodies PERF701 sees
+FLOW1001-4  dataflow: donated jit buffers read before rebinding,
+            request-derived values reaching jit shapes un-bucketed,
+            task handles that never outlive their frame, lock-order
+            cycles across the call graph
 ==========  ==============================================================
 
-RACE/INV are **project rules**: they run over a whole-program index
+RACE/INV/FLOW are **project rules**: they run over a whole-program index
 (``analysis/project.py`` — symbol table, call graph, thread roles,
-per-class attribute access sets) instead of one file at a time. GC001
-flags suppressions that no longer silence anything, so escapes can't rot.
+per-class attribute access sets) instead of one file at a time; FLOW
+additionally builds per-function CFGs, reaching definitions, and taint
+(``analysis/dataflow.py``). GC001 flags suppressions that no longer
+silence anything, so escapes can't rot.
 
 Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),
 ``--changed`` for files differing from HEAD (plus their call-graph
-dependents, which project rules need), ``--format json|sarif`` for CI.
+dependents, which project rules need), ``--explain RULEID`` for a rule's
+doc plus a live TP/TN fixture and the fix pattern, ``--jobs N`` for a
+threaded per-file pass, ``--format json|sarif`` for CI.
 Gate: the whole tree is linted in tier-1 by ``tests/test_graftcheck.py``
 inside a wall-time budget. Policy, suppression syntax, the thread-role
 model, and the baseline rules live in ``docs/ANALYSIS.md``.
@@ -59,6 +67,7 @@ from langstream_tpu.analysis.core import (
 from langstream_tpu.analysis.project import ProjectIndex, ProjectRule
 from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
 from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
+from langstream_tpu.analysis.rules_flow import RULES as _FLOW_RULES
 from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
@@ -81,6 +90,7 @@ ALL_RULES: list[Rule] = [
 PROJECT_RULES: list[ProjectRule] = [
     *_RACE_RULES,
     *_INV_RULES,
+    *_FLOW_RULES,
 ]
 
 RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -14,7 +14,12 @@ Modes:
   whole package for call-graph context; findings are filtered to the
   requested files);
 - ``--list-rules`` — print every rule id and summary;
+- ``--explain RULEID`` — the rule's doc, a live true-positive and
+  true-negative example from the fixture registry, and the sanctioned
+  fix pattern (so a red gate tells the next builder HOW to fix);
 - ``--no-baseline`` — report baselined findings too (audit mode);
+- ``--jobs N`` — per-file scanning on N threads (default
+  ``min(4, cpus)``; the project index stays a single build);
 - ``--format text|json|sarif`` — machine-readable output for CI
   annotation (SARIF 2.1.0).
 
@@ -26,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -101,6 +107,57 @@ def _all_rule_meta() -> list[tuple[str, str]]:
     return [(r.id, r.summary) for r in ALL_RULES] + [
         (r.id, r.summary) for r in PROJECT_RULES
     ]
+
+
+def _default_jobs() -> int:
+    return min(4, os.cpu_count() or 1)
+
+
+def explain_rule(rule_id: str) -> int:
+    """``--explain RULEID``: doc + live TP/TN example + fix pattern."""
+    from langstream_tpu.analysis import PROJECT_RULES_BY_ID, RULES_BY_ID
+    from langstream_tpu.analysis.fixtures import EXAMPLES
+
+    rule = RULES_BY_ID.get(rule_id) or PROJECT_RULES_BY_ID.get(rule_id)
+    framework = {
+        "GC000": "suppression without a reason",
+        "GC001": "stale suppression: a disable= comment that no longer "
+        "silences anything",
+    }
+    if rule is None and rule_id not in framework:
+        known = sorted(
+            list(RULES_BY_ID) + list(PROJECT_RULES_BY_ID) + list(framework)
+        )
+        print(f"graftcheck: unknown rule {rule_id!r} (known: "
+              f"{', '.join(known)})", file=sys.stderr)
+        return 2
+    summary = rule.summary if rule is not None else framework[rule_id]
+    kind = (
+        "project rule" if rule_id in {r.id for r in PROJECT_RULES}
+        else "framework" if rule is None else "per-file rule"
+    )
+    print(f"{rule_id} [{rule.family if rule else 'framework'}] ({kind})")
+    print(f"  {summary}")
+    doc = (rule.check.__doc__ or "").strip() if rule is not None else ""
+    if doc:
+        print()
+        for line in doc.splitlines():
+            print(f"  {line.strip()}")
+    example = EXAMPLES.get(rule_id)
+    if example is None:
+        print("\n  (no registered fixture example; see docs/ANALYSIS.md "
+              "and tests/test_graftcheck.py for this rule's fixtures)")
+        return 0
+    for title, tree in (("fires (true positive)", example.tp),
+                        ("stays clean (true negative)", example.tn)):
+        print(f"\n--- {title} " + "-" * max(0, 58 - len(title)))
+        for rel, src in tree.items():
+            print(f"# {rel}")
+            for line in src.rstrip("\n").splitlines():
+                print(f"    {line}")
+    print("\n--- fix " + "-" * 51)
+    print(f"  {example.fix}")
+    return 0
 
 
 def _as_json(report: Report, stale: list) -> dict:
@@ -215,8 +272,18 @@ def main(argv: list[str] | None = None) -> int:
         "--list-rules", action="store_true", help="print rules and exit"
     )
     parser.add_argument(
+        "--explain", metavar="RULEID",
+        help="print a rule's doc, a live TP/TN example, and the "
+        "sanctioned fix pattern, then exit",
+    )
+    parser.add_argument(
         "--no-baseline", action="store_true",
         help="ignore the baseline: report every finding",
+    )
+    parser.add_argument(
+        "--jobs", type=int, default=None, metavar="N",
+        help="per-file scan threads (default min(4, cpus); the project "
+        "index stays a single build)",
     )
     parser.add_argument(
         "--format", choices=("text", "json", "sarif"), default="text",
@@ -230,6 +297,9 @@ def main(argv: list[str] | None = None) -> int:
         for rule in PROJECT_RULES:
             print(f"{rule.id}  [{rule.family}]  (project) {rule.summary}")
         return 0
+
+    if args.explain:
+        return explain_rule(args.explain)
 
     if args.changed and args.paths:
         parser.error("--changed and explicit paths are mutually exclusive")
@@ -261,6 +331,7 @@ def main(argv: list[str] | None = None) -> int:
     report = run(
         ALL_RULES, files=files, baseline=baseline,
         project_rules=PROJECT_RULES, project_index=project_index,
+        jobs=args.jobs if args.jobs is not None else _default_jobs(),
     )
 
     # a subset scan (--changed / explicit paths) can't see findings in the

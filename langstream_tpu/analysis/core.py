@@ -367,6 +367,7 @@ def run(
     project_rules: Iterable | None = None,
     project_files: Iterable[Path] | None = None,
     project_index=None,
+    jobs: int | None = None,
 ) -> Report:
     """The driver: per-file rules over ``files``, then project rules over
     the whole-program index, then stale-suppression (GC001) and baseline
@@ -381,6 +382,13 @@ def run(
     already built the whole-package :class:`ProjectIndex` (the
     ``--changed`` dependents expansion) passes it as ``project_index`` to
     skip the rebuild.
+
+    ``jobs`` > 1 runs the per-file pass on a thread pool (rule checks
+    are pure in ``(path, source, rule set)`` and the content-hash cache
+    tolerates concurrent same-key inserts; the tokenizer and ``ast``
+    release work to C). The project index stays a single build and the
+    report stays byte-identical to a sequential run — results are folded
+    back in input order.
     """
     t0 = time.perf_counter()
     rules = list(rules)
@@ -400,21 +408,37 @@ def run(
     suppression_maps: dict[str, dict[int, set[str]]] = {}
     used_suppressions: dict[str, set[tuple[int, str]]] = {}
     rules_key = ",".join(r.id for r in rules)
+
+    # read sources sequentially (cheap, keeps error attribution simple)
+    sources: list[tuple[str, str]] = []
     for file_path in files:
         file_path = Path(file_path)
         rel = _rel_path(file_path, repo_root)
         try:
-            source = file_path.read_text()
+            sources.append((rel, file_path.read_text()))
         except (OSError, UnicodeDecodeError) as e:
             parse_errors.append(f"{rel}: unreadable: {e}")
-            continue
+
+    def _checked(item: tuple[str, str]):
+        rel, source = item
         try:
-            raw, suppressions, problems = _check_file(
-                rel, source, rules, rules_key
-            )
+            return rel, source, _check_file(rel, source, rules, rules_key)
         except SyntaxError as e:
-            parse_errors.append(f"{rel}: syntax error: {e}")
+            return rel, source, e
+
+    if jobs and jobs > 1 and len(sources) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(_checked, sources))
+    else:
+        results = map(_checked, sources)
+
+    for rel, source, outcome in results:
+        if isinstance(outcome, SyntaxError):
+            parse_errors.append(f"{rel}: syntax error: {outcome}")
             continue
+        raw, suppressions, problems = outcome
         scanned[rel] = source
         suppression_maps[rel] = suppressions
         used = used_suppressions.setdefault(rel, set())

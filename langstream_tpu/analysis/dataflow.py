@@ -1,0 +1,784 @@
+"""Intraprocedural dataflow for graftcheck: CFG, reaching definitions,
+def-use chains, and a small taint engine.
+
+graftcheck v1/v2 answered "does this syntax appear" (per-file rules) and
+"who calls whom on which thread" (the :class:`ProjectIndex`). The FLOW
+rule family needs a third question those layers cannot ask: *what happens
+to a value along each path* — is a donated buffer read again before it is
+rebound, does a request-derived length reach a jit shape without passing
+a bucketing function, is a task handle ever used after it is created.
+This module supplies the machinery:
+
+- :func:`build_cfg` — a statement-granularity control-flow graph per
+  function: branches, ``while``/``for`` loops (back edges, ``break`` /
+  ``continue``), ``try``/``except``/``else``/``finally`` (every try-body
+  statement may jump to every handler), ``with`` spans, and early exits
+  (``return``/``raise`` edge to the synthetic exit node);
+- :func:`reaching_definitions` — the classic forward may-analysis over
+  **tracked refs**: local names (``x``) and instance attributes spelled
+  ``self.X``/``cls.X`` (normalized to ``self.X``). Parameters define at
+  the entry node;
+- :func:`def_use_chains` — uses resolved against the reaching-def sets,
+  the substrate for "is this handle ever touched again";
+- :func:`reads_before_rebind` — the FLOW1001 path query: starting *after*
+  a given node, every read of a ref reachable along some path with no
+  intervening write to it;
+- :class:`TaintState` / :func:`run_taint` — a small forward taint
+  lattice (ref → set of labels, union at joins) driven by a
+  caller-supplied :class:`TaintSpec`: sources label expressions,
+  sanctioners launder a call's value, sinks are checked by the rule
+  after the fixpoint.
+
+Everything here is **intraprocedural**; cross-function effects (a
+tainted argument reaching a callee's sink, a donated callable flowing
+through a ``functools.partial``) are composed by the FLOW rules on top
+of the :class:`ProjectIndex` call graph. Per-function summaries are pure
+in ``(path, source)`` and memoized by content hash exactly like the
+project index (:func:`flow_index`), so the tier-1 gate pays the CFG
+construction once per file revision.
+
+Known limits (precision over recall, as everywhere in graftcheck):
+nested function bodies are opaque to the enclosing CFG (a closure's
+reads/writes do not appear in the outer function's chains — each
+function is analyzed on its own); aliases (``k = self.cache_k``) are a
+fresh ref, not the same storage; exception edges are conservative
+(any try-body statement may reach any handler).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+from typing import Callable, Iterator
+
+#: refs this module tracks: a bare local name ("x") or an instance
+#: attribute ("self.X" — cls.X normalizes to the same spelling)
+Ref = str
+
+#: method names that put their arguments INTO the receiver collection —
+#: taint flowing in must stick to the collection (weak update: nothing
+#: is removed, so labels only accumulate)
+_COLLECTION_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "put", "put_nowait",
+}
+
+
+def ref_of(node: ast.AST) -> Ref | None:
+    """The tracked ref a Name / self-attribute expression denotes."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    ):
+        return f"self.{node.attr}"
+    return None
+
+
+# --------------------------------------------------------------------------
+# CFG
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CFGNode:
+    """One statement (or branch/loop header expression) in the CFG.
+
+    ``reads``/``writes`` are precomputed per node: reads are every
+    tracked ref loaded by the node's own expressions (nested function
+    bodies excluded), writes every ref the node rebinds. A subscript or
+    attribute store *through* a tracked ref (``self.X[i] = v``) is a
+    READ of that ref (the binding survives; the object is touched) —
+    exactly the semantics use-after-donate needs."""
+
+    idx: int
+    ast_node: ast.AST | None     # None for entry/exit
+    kind: str                    # "entry" | "exit" | "stmt" | "head"
+    line: int
+    reads: dict[Ref, int] = dataclasses.field(default_factory=dict)
+    writes: set[Ref] = dataclasses.field(default_factory=set)
+    succs: list[int] = dataclasses.field(default_factory=list)
+    preds: list[int] = dataclasses.field(default_factory=list)
+
+
+class CFG:
+    def __init__(self) -> None:
+        self.nodes: list[CFGNode] = []
+        self.entry = self._new(None, "entry", 0)
+        self.exit = self._new(None, "exit", 0)
+        #: ast node id -> cfg node idx, for anchoring queries on a stmt
+        self.by_ast: dict[int, int] = {}
+
+    def _new(self, ast_node: ast.AST | None, kind: str, line: int) -> int:
+        node = CFGNode(idx=len(self.nodes), ast_node=ast_node, kind=kind,
+                       line=line)
+        self.nodes.append(node)
+        if ast_node is not None:
+            self.by_ast[id(ast_node)] = node.idx
+        return node.idx
+
+    def _edge(self, a: int, b: int) -> None:
+        if b not in self.nodes[a].succs:
+            self.nodes[a].succs.append(b)
+            self.nodes[b].preds.append(a)
+
+    def node_for(self, ast_node: ast.AST) -> CFGNode | None:
+        idx = self.by_ast.get(id(ast_node))
+        return self.nodes[idx] if idx is not None else None
+
+
+def _collect_reads(node: ast.AST, into: dict[Ref, int]) -> None:
+    """Tracked refs loaded anywhere under ``node``, skipping nested
+    function/class bodies (they are separate analysis units) and skipping
+    the ``self`` name itself when it only serves as an attribute base."""
+    if node is None:
+        return
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda, ast.ClassDef)):
+        return
+    r = ref_of(node)
+    if r is not None and isinstance(getattr(node, "ctx", ast.Load()), ast.Load):
+        # no children worth walking: a Name has none, and a self.X
+        # attribute's only child is the bare Name `self`
+        into.setdefault(r, getattr(node, "lineno", 0))
+        return
+    for child in ast.iter_child_nodes(node):
+        _collect_reads(child, into)
+
+
+def _targets_of(target: ast.AST, writes: set[Ref],
+                reads: dict[Ref, int]) -> None:
+    """Classify one assignment target: rebinding a tracked ref is a
+    write; storing through it (subscript/attribute of the ref) is a read
+    of the ref plus reads of the index expression."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            _targets_of(el, writes, reads)
+        return
+    if isinstance(target, ast.Starred):
+        _targets_of(target.value, writes, reads)
+        return
+    r = ref_of(target)
+    if r is not None:
+        writes.add(r)
+        return
+    if isinstance(target, ast.Subscript):
+        _collect_reads(target.value, reads)
+        _collect_reads(target.slice, reads)
+        return
+    if isinstance(target, ast.Attribute):
+        # obj.attr = v where obj is not self: the base is read
+        _collect_reads(target.value, reads)
+        return
+    _collect_reads(target, reads)
+
+
+class _Builder:
+    """Recursive-descent CFG construction. ``_body`` threads the current
+    fall-through frontier (the set of node indices whose control reaches
+    the next statement)."""
+
+    def __init__(self, fn: ast.AST):
+        self.cfg = CFG()
+        #: (head idx for continue, list collecting break sources)
+        self.loops: list[tuple[int, list[int]]] = []
+        frontier = self._body(fn.body, {self.cfg.entry})
+        for n in frontier:
+            self.cfg._edge(n, self.cfg.exit)
+
+    # -- node constructors ----------------------------------------------
+
+    def _stmt_node(self, stmt: ast.stmt) -> int:
+        idx = self.cfg._new(stmt, "stmt", getattr(stmt, "lineno", 0))
+        node = self.cfg.nodes[idx]
+        if isinstance(stmt, ast.Assign):
+            _collect_reads(stmt.value, node.reads)
+            for t in stmt.targets:
+                _targets_of(t, node.writes, node.reads)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                _collect_reads(stmt.value, node.reads)
+                _targets_of(stmt.target, node.writes, node.reads)
+        elif isinstance(stmt, ast.AugAssign):
+            _collect_reads(stmt.value, node.reads)
+            _collect_reads(stmt.target, node.reads)  # x += 1 reads x
+            _targets_of(stmt.target, node.writes, node.reads)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            node.writes.add(stmt.name)  # the def binds its name; body opaque
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                node.writes.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                r = ref_of(t)
+                if r is not None:
+                    node.writes.add(r)
+                else:
+                    _collect_reads(t, node.reads)
+        else:
+            _collect_reads(stmt, node.reads)
+        return idx
+
+    def _head_node(self, stmt: ast.AST, expr: ast.AST | None) -> int:
+        idx = self.cfg._new(stmt, "head", getattr(stmt, "lineno", 0))
+        if expr is not None:
+            _collect_reads(expr, self.cfg.nodes[idx].reads)
+        return idx
+
+    # -- statement walk --------------------------------------------------
+
+    def _body(self, stmts: list[ast.stmt], preds: set[int]) -> set[int]:
+        for stmt in stmts:
+            preds = self._stmt(stmt, preds)
+        return preds
+
+    def _link(self, preds: set[int], idx: int) -> None:
+        for p in preds:
+            self.cfg._edge(p, idx)
+
+    def _stmt(self, stmt: ast.stmt, preds: set[int]) -> set[int]:
+        if not preds:
+            return preds  # unreachable code keeps no edges
+        if isinstance(stmt, ast.If):
+            head = self._head_node(stmt, stmt.test)
+            self._link(preds, head)
+            out = self._body(stmt.body, {head})
+            out |= self._body(stmt.orelse, {head}) if stmt.orelse else {head}
+            return out
+        if isinstance(stmt, ast.While):
+            head = self._head_node(stmt, stmt.test)
+            self._link(preds, head)
+            self.loops.append((head, breaks := []))
+            tail = self._body(stmt.body, {head})
+            self.loops.pop()
+            for n in tail:
+                self.cfg._edge(n, head)  # back edge
+            out = self._body(stmt.orelse, {head}) if stmt.orelse else {head}
+            return out | set(breaks)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            head = self._head_node(stmt, stmt.iter)
+            _targets_of(stmt.target, self.cfg.nodes[head].writes,
+                        self.cfg.nodes[head].reads)
+            self._link(preds, head)
+            self.loops.append((head, breaks := []))
+            tail = self._body(stmt.body, {head})
+            self.loops.pop()
+            for n in tail:
+                self.cfg._edge(n, head)
+            out = self._body(stmt.orelse, {head}) if stmt.orelse else {head}
+            return out | set(breaks)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            cur = preds
+            for item in stmt.items:
+                head = self._head_node(stmt, item.context_expr)
+                if item.optional_vars is not None:
+                    _targets_of(item.optional_vars,
+                                self.cfg.nodes[head].writes,
+                                self.cfg.nodes[head].reads)
+                self._link(cur, head)
+                cur = {head}
+            return self._body(stmt.body, cur)
+        if isinstance(stmt, ast.Try) or stmt.__class__.__name__ == "TryStar":
+            return self._try(stmt, preds)
+        if isinstance(stmt, ast.Break):
+            idx = self._stmt_node(stmt)
+            self._link(preds, idx)
+            if self.loops:
+                self.loops[-1][1].append(idx)
+            return set()
+        if isinstance(stmt, ast.Continue):
+            idx = self._stmt_node(stmt)
+            self._link(preds, idx)
+            if self.loops:
+                self.cfg._edge(idx, self.loops[-1][0])
+            return set()
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            idx = self._stmt_node(stmt)
+            self._link(preds, idx)
+            self.cfg._edge(idx, self.cfg.exit)
+            return set()
+        idx = self._stmt_node(stmt)
+        self._link(preds, idx)
+        return {idx}
+
+    def _try(self, stmt: ast.Try, preds: set[int]) -> set[int]:
+        body_nodes_before = len(self.cfg.nodes)
+        body_out = self._body(stmt.body, preds)
+        body_nodes = range(body_nodes_before, len(self.cfg.nodes))
+        out: set[int] = set()
+        handler_entries: list[int] = []
+        for handler in stmt.handlers:
+            entry = self.cfg._new(handler, "head",
+                                  getattr(handler, "lineno", 0))
+            if handler.name:
+                self.cfg.nodes[entry].writes.add(handler.name)
+            handler_entries.append(entry)
+            out |= self._body(handler.body, {entry})
+        # an exception can surface after any try-body statement — edge
+        # from each body node (and the incoming preds, for a first-stmt
+        # raise) to every handler entry
+        for entry in handler_entries:
+            for n in body_nodes:
+                self.cfg._edge(n, entry)
+            self._link(preds, entry)
+        if stmt.orelse:
+            body_out = self._body(stmt.orelse, body_out)
+        out |= body_out
+        if stmt.finalbody:
+            out = self._body(stmt.finalbody, out or preds)
+        return out
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one function/lambda body. Nested defs are single opaque
+    nodes (build their own CFGs to analyze them)."""
+    if isinstance(fn, ast.Lambda):
+        wrapper = ast.Return(value=fn.body)
+        ast.copy_location(wrapper, fn.body)
+        fn = ast.Module(body=[wrapper], type_ignores=[])
+        fn.body = [wrapper]
+    return _Builder(fn).cfg
+
+
+def param_refs(fn: ast.AST) -> list[Ref]:
+    args = fn.args
+    out = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        out.append(args.vararg.arg)
+    if args.kwarg:
+        out.append(args.kwarg.arg)
+    return out
+
+
+# --------------------------------------------------------------------------
+# reaching definitions / def-use
+# --------------------------------------------------------------------------
+
+#: a definition: (ref, cfg node idx that wrote it); parameters and the
+#: function's free refs define at the entry node
+Definition = tuple[Ref, int]
+
+
+def reaching_definitions(
+    cfg: CFG, entry_refs: Iterator[Ref] | list[Ref] = ()
+) -> list[set[Definition]]:
+    """IN set per CFG node (classic forward may-analysis, worklist).
+    ``entry_refs`` (parameters, closure refs) define at ``cfg.entry``;
+    any ref read somewhere but never written also defines at entry so
+    chains never dangle."""
+    written = {r for n in cfg.nodes for r in n.writes}
+    free = {
+        r for n in cfg.nodes for r in n.reads
+        if r not in written
+    }
+    entry_defs = {(r, cfg.entry) for r in set(entry_refs) | free}
+
+    n_nodes = len(cfg.nodes)
+    in_sets: list[set[Definition]] = [set() for _ in range(n_nodes)]
+    out_sets: list[set[Definition]] = [set() for _ in range(n_nodes)]
+    out_sets[cfg.entry] = set(entry_defs)
+
+    work = [n.idx for n in cfg.nodes if n.idx != cfg.entry]
+    in_work = set(work)
+    while work:
+        idx = work.pop(0)
+        in_work.discard(idx)
+        node = cfg.nodes[idx]
+        new_in: set[Definition] = set()
+        for p in node.preds:
+            new_in |= out_sets[p]
+        new_out = {d for d in new_in if d[0] not in node.writes}
+        new_out |= {(r, idx) for r in node.writes}
+        if new_in == in_sets[idx] and new_out == out_sets[idx]:
+            continue
+        in_sets[idx] = new_in
+        out_sets[idx] = new_out
+        for s in node.succs:
+            if s not in in_work:
+                in_work.add(s)
+                work.append(s)
+    return in_sets
+
+
+def def_use_chains(
+    cfg: CFG, entry_refs: list[Ref] = ()
+) -> dict[Definition, set[int]]:
+    """definition -> set of CFG node indices that may read it."""
+    in_sets = reaching_definitions(cfg, entry_refs)
+    chains: dict[Definition, set[int]] = {}
+    for node in cfg.nodes:
+        if not node.reads:
+            continue
+        for d in in_sets[node.idx]:
+            if d[0] in node.reads:
+                chains.setdefault(d, set()).add(node.idx)
+    return chains
+
+
+def reads_before_rebind(
+    cfg: CFG, start: int, ref: Ref
+) -> list[tuple[int, int]]:
+    """Every read of ``ref`` reachable from (strictly after) node
+    ``start`` along some path with no intervening write to ``ref`` —
+    the FLOW1001 query. Returns ``(cfg node idx, line)`` pairs.
+
+    A node that both reads and writes the ref (``x = f(x)``) counts as a
+    read (the old binding is consumed first)."""
+    hits: list[tuple[int, int]] = []
+    seen: set[int] = set()
+    stack = list(cfg.nodes[start].succs)
+    while stack:
+        idx = stack.pop()
+        if idx in seen:
+            continue
+        seen.add(idx)
+        node = cfg.nodes[idx]
+        if ref in node.reads:
+            hits.append((idx, node.reads[ref] or node.line))
+            continue  # report the first read on this path, stop walking it
+        if ref in node.writes:
+            continue  # rebound: this path is safe
+        stack.extend(node.succs)
+    return hits
+
+
+def exits_without_rebind(cfg: CFG, start: int, ref: Ref) -> bool:
+    """True when some path from (strictly after) node ``start`` reaches
+    the function exit with no write to ``ref``. For a donated *instance
+    attribute* — which outlives the frame — this is the quiet half of
+    use-after-donate: nothing in this function reads the dead buffer,
+    but the stale binding survives the return and the next reader
+    anywhere in the program gets garbage (the PR-6 bug class: a dropped
+    rebind on the dispatch thread)."""
+    seen: set[int] = set()
+    stack = list(cfg.nodes[start].succs)
+    while stack:
+        idx = stack.pop()
+        if idx == cfg.exit:
+            return True
+        if idx in seen:
+            continue
+        seen.add(idx)
+        node = cfg.nodes[idx]
+        if ref in node.writes:
+            continue
+        stack.extend(node.succs)
+    return False
+
+
+# --------------------------------------------------------------------------
+# taint
+# --------------------------------------------------------------------------
+
+
+class TaintSpec:
+    """Policy hooks for :func:`run_taint`; subclass per rule.
+
+    - :meth:`source_label` — a label when the expression is a taint
+      source *by itself* (independent of operand taint);
+    - :meth:`is_sanctioner` — True when a call's *value* is clean no
+      matter what its arguments carry (the bucketing functions);
+    """
+
+    def source_label(self, expr: ast.AST) -> str | None:
+        return None
+
+    def is_sanctioner(self, call: ast.Call) -> bool:
+        return False
+
+
+@dataclasses.dataclass
+class TaintState:
+    """Fixpoint result: per CFG node, the ref→labels map *entering* the
+    node, plus an evaluator for arbitrary expressions at that node."""
+
+    cfg: CFG
+    spec: TaintSpec
+    in_maps: list[dict[Ref, frozenset[str]]]
+
+    def expr_labels(self, expr: ast.AST, at_node: int) -> frozenset[str]:
+        return _expr_taint(expr, self.in_maps[at_node], self.spec)
+
+
+def _expr_taint(
+    expr: ast.AST,
+    env: dict[Ref, frozenset[str]],
+    spec: TaintSpec,
+) -> frozenset[str]:
+    """Labels carried by ``expr`` under ``env``. Sanctioned calls launder
+    their arguments; sources contribute their own label; every other
+    construct unions its children (nested defs opaque)."""
+    if expr is None or isinstance(
+        expr, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+               ast.ClassDef)
+    ):
+        return frozenset()
+    if isinstance(expr, ast.Call) and spec.is_sanctioner(expr):
+        return frozenset()
+    out: set[str] = set()
+    label = spec.source_label(expr)
+    if label is not None:
+        out.add(label)
+    r = ref_of(expr)
+    if r is not None:
+        out |= env.get(r, frozenset())
+        if isinstance(expr, ast.Name):
+            return frozenset(out)
+    for child in ast.iter_child_nodes(expr):
+        out |= _expr_taint(child, env, spec)
+    return frozenset(out)
+
+
+def run_taint(
+    cfg: CFG,
+    spec: TaintSpec,
+    seed: dict[Ref, frozenset[str]] | None = None,
+) -> TaintState:
+    """Forward taint to a fixpoint. ``seed`` taints refs at entry
+    (parameter labels for the cross-function summaries). Transfer:
+    an assignment taints its name/self-attr targets with the RHS labels
+    (tuple targets share the whole RHS — precision loss, safe direction);
+    every other write clears the ref."""
+    n = len(cfg.nodes)
+    seed = dict(seed or {})
+    in_maps: list[dict[Ref, frozenset[str]]] = [{} for _ in range(n)]
+    out_maps: list[dict[Ref, frozenset[str]]] = [{} for _ in range(n)]
+    out_maps[cfg.entry] = dict(seed)
+
+    def _weak_updates(stmt, env, new) -> None:
+        """Taint flowing INTO a collection sticks to the collection:
+        ``xs.append(tainted)`` and ``xs[i] = tainted`` label ``xs``
+        without clearing it (nothing is removed), so a later
+        ``len(xs)`` carries the taint."""
+        stack = [stmt]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue  # nested defs are their own analysis units
+            stack.extend(ast.iter_child_nodes(sub))
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _COLLECTION_MUTATORS
+            ):
+                recv = ref_of(sub.func.value)
+                if recv is None:
+                    continue
+                labels: frozenset[str] = frozenset()
+                for arg in sub.args:
+                    labels |= _expr_taint(arg, env, spec)
+                for kw in sub.keywords:
+                    labels |= _expr_taint(kw.value, env, spec)
+                if labels:
+                    new[recv] = new.get(recv, frozenset()) | labels
+            elif isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if sub.value is None:
+                    continue
+                tgts = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                for t in tgts:
+                    if not isinstance(t, ast.Subscript):
+                        continue
+                    recv = ref_of(t.value)
+                    if recv is None:
+                        continue
+                    labels = _expr_taint(sub.value, env, spec)
+                    if labels:
+                        new[recv] = new.get(recv, frozenset()) | labels
+
+    def transfer(node: CFGNode, env: dict[Ref, frozenset[str]]):
+        new = dict(env)
+        stmt = node.ast_node
+        value = None
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            value, targets = stmt.value, list(stmt.targets)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value, targets = stmt.value, [stmt.target]
+        elif isinstance(stmt, ast.AugAssign):
+            value, targets = stmt, [stmt.target]
+        elif (
+            node.kind == "head"
+            and isinstance(stmt, (ast.For, ast.AsyncFor))
+        ):
+            value, targets = stmt.iter, [stmt.target]
+        elif (
+            node.kind == "head"
+            and isinstance(stmt, (ast.With, ast.AsyncWith))
+        ):
+            # the node for `with E as v`: v carries E's labels. A
+            # multi-item `with` builds one head node PER item, so match
+            # each item to the node that wrote its targets — labeling
+            # every write from every item would hand item 1's target
+            # the LAST item's labels
+            for item in stmt.items:
+                if item.optional_vars is None:
+                    continue
+                writes: set[Ref] = set()
+                _targets_of(item.optional_vars, writes, {})
+                mine = writes & node.writes
+                if not mine:
+                    continue
+                labels = _expr_taint(item.context_expr, env, spec)
+                for w in mine:
+                    new[w] = labels
+            return new
+        if value is not None:
+            labels = _expr_taint(value, env, spec)
+            for t in targets:
+                _assign_taint(t, labels, new)
+        else:
+            for w in node.writes:
+                new[w] = frozenset()
+        if stmt is not None and node.kind == "stmt":
+            # head nodes cover compound statements whose bodies have
+            # their own CFG nodes — weak updates apply per simple stmt
+            _weak_updates(stmt, env, new)
+        return new
+
+    work = list(cfg.nodes[cfg.entry].succs)
+    in_work = set(work)
+    visited: set[int] = set()
+    while work:
+        idx = work.pop(0)
+        in_work.discard(idx)
+        node = cfg.nodes[idx]
+        merged: dict[Ref, frozenset[str]] = {}
+        for p in node.preds:
+            for r, labels in out_maps[p].items():
+                merged[r] = merged.get(r, frozenset()) | labels
+        in_maps[idx] = merged
+        new_out = transfer(node, merged)
+        first_visit = idx not in visited
+        visited.add(idx)
+        if new_out != out_maps[idx] or first_visit:
+            out_maps[idx] = new_out
+            for s in node.succs:
+                if s not in in_work and s != cfg.entry:
+                    in_work.add(s)
+                    work.append(s)
+    return TaintState(cfg=cfg, spec=spec, in_maps=in_maps)
+
+
+def _assign_taint(
+    target: ast.AST, labels: frozenset[str],
+    env: dict[Ref, frozenset[str]],
+) -> None:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            _assign_taint(el, labels, env)
+        return
+    if isinstance(target, ast.Starred):
+        _assign_taint(target.value, labels, env)
+        return
+    r = ref_of(target)
+    if r is not None:
+        env[r] = labels
+
+
+# --------------------------------------------------------------------------
+# the per-file flow index (content-hash cached)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FlowFunction:
+    """One function body ready for flow queries. ``qname`` matches the
+    :class:`~langstream_tpu.analysis.project.FunctionInfo` naming scheme
+    so FLOW rules can join the two indexes."""
+
+    qname: str
+    name: str
+    path: str
+    lineno: int
+    is_async: bool
+    node: ast.AST                    # the FunctionDef/AsyncFunctionDef
+    scope_names: tuple[str, ...]
+    _cfg: CFG | None = None
+    #: rule-layer memo for derived facts that are pure in this function's
+    #: source (taint fixpoints, statement lists, call descriptors) — the
+    #: FlowFunction itself is content-hash cached, so anything file-pure
+    #: parked here amortizes across repeated scans in one process
+    memo: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def cfg(self) -> CFG:
+        if self._cfg is None:
+            self._cfg = build_cfg(self.node)
+        return self._cfg
+
+    def symbol(self) -> str:
+        return ".".join(self.scope_names)
+
+
+@dataclasses.dataclass
+class FileFlow:
+    path: str
+    module: str
+    functions: dict[str, FlowFunction]   # qname -> flow function
+    #: True when the AST actually spells a donate_argnums keyword (string
+    #: mentions in docs/rule vocabularies don't count)
+    has_donation: bool = False
+
+
+_FLOW_CACHE: dict[tuple[str, str], FileFlow] = {}
+_FLOW_CACHE_CAP = 4096
+
+
+def flow_index(rel_path: str, source: str) -> FileFlow:
+    """Memoized per-file flow index: pure in ``(rel_path, source)``.
+    Mirrors the project-index cache so warm tier-1 re-runs re-parse
+    nothing."""
+    key = (rel_path, hashlib.sha256(source.encode()).hexdigest())
+    hit = _FLOW_CACHE.get(key)
+    if hit is not None:
+        return hit
+    built = _build_file_flow(rel_path, source)
+    if len(_FLOW_CACHE) >= _FLOW_CACHE_CAP:
+        _FLOW_CACHE.clear()
+    _FLOW_CACHE[key] = built
+    return built
+
+
+def _build_file_flow(rel_path: str, source: str) -> FileFlow:
+    from langstream_tpu.analysis.project import module_name_for
+
+    module = module_name_for(rel_path)
+    functions: dict[str, FlowFunction] = {}
+
+    def walk(body: list[ast.stmt], scope: tuple[str, ...]) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fscope = scope + (node.name,)
+                qname = ".".join((module,) + fscope)
+                functions[qname] = FlowFunction(
+                    qname=qname, name=node.name, path=rel_path,
+                    lineno=node.lineno,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                    node=node, scope_names=fscope,
+                )
+                walk(node.body, fscope)
+            elif isinstance(node, ast.ClassDef):
+                walk(node.body, scope + (node.name,))
+            else:
+                # defs nested in compound statements (if TYPE_CHECKING:,
+                # try/except fallbacks) still define functions
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.stmt, ast.excepthandler)):
+                        walk([child], scope)
+
+    tree = ast.parse(source)
+    walk(tree.body, ())
+    has_donation = any(
+        isinstance(node, ast.keyword) and node.arg == "donate_argnums"
+        for node in ast.walk(tree)
+    )
+    return FileFlow(path=rel_path, module=module, functions=functions,
+                    has_donation=has_donation)

@@ -1,0 +1,239 @@
+"""Teaching fixtures for ``--explain RULEID``: per rule, a minimal
+true-positive tree (the bug fires), a true-negative tree (the sanctioned
+spelling stays silent), and the fix pattern a red gate should point at.
+
+These are *live* fixtures, not prose: ``tests/test_graftcheck.py``
+re-runs every entry through the real analyzer and asserts the TP fires
+and the TN stays clean, so ``--explain`` can never teach a pattern the
+rules stopped recognizing. Keep each example as small as honesty allows
+— the point is that a builder staring at a red gate can read the whole
+thing in one screen.
+
+Trees are ``{rel path: source}`` dicts (project rules need real paths:
+scope filters key off ``serving/``/``gateway/``/``runtime/``). Entries
+are optional for per-file rules (``--explain`` falls back to the rule
+summary and check docstring) but required for every FLOW rule — the
+flow findings are the ones whose fix is least obvious from the message
+alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleExample:
+    rule: str
+    tp: dict[str, str]        # fixture tree where the rule fires
+    tn: dict[str, str]        # fixture tree pinning the sanctioned shape
+    fix: str                  # the sanctioned fix pattern, as prose
+
+
+EXAMPLES: dict[str, RuleExample] = {}
+
+
+def _register(example: RuleExample) -> None:
+    EXAMPLES[example.rule] = example
+
+
+_register(RuleExample(
+    rule="FLOW1001",
+    tp={
+        "langstream_tpu/serving/engine.py": '''\
+from functools import partial
+import jax
+
+class Engine:
+    def step(self, tokens, debug):
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def _decode(params, cache_k, cache_v, tokens):
+            return tokens, cache_k, cache_v
+
+        out = _decode(self.params, self.cache_k, self.cache_v, tokens)
+        if debug:
+            stale = self.cache_k.sum()   # donated buffer read on a branch
+        self.cache_k, self.cache_v = out[1], out[2]
+        return out[0]
+''',
+    },
+    tn={
+        "langstream_tpu/serving/engine.py": '''\
+from functools import partial
+import jax
+
+class Engine:
+    def step(self, tokens):
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def _decode(params, cache_k, cache_v, tokens):
+            return tokens, cache_k, cache_v
+
+        out = _decode(self.params, self.cache_k, self.cache_v, tokens)
+        # the engine pattern: rebind from the outputs BEFORE any read
+        self.cache_k, self.cache_v = out[1], out[2]
+        return self.cache_k
+''',
+    },
+    fix=(
+        "Rebind the donated refs from the call's outputs immediately "
+        "after the jitted call, on every path that can read them again "
+        "(`self.cache_k, self.cache_v = out[...]` — see the engine's "
+        "_run/_dispatch closures). If the old value is genuinely needed "
+        "afterwards, copy it before the call or stop donating that "
+        "argument."
+    ),
+))
+
+_register(RuleExample(
+    rule="FLOW1002",
+    tp={
+        "langstream_tpu/serving/engine.py": '''\
+import numpy as np
+
+class Engine:
+    def admit(self, request):
+        rows = len(request.context_tokens)     # per-request value...
+        return np.zeros((rows, 4), dtype=np.int32)   # ...shapes a buffer
+''',
+    },
+    tn={
+        "langstream_tpu/serving/engine.py": '''\
+import numpy as np
+
+def _pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+class Engine:
+    def admit(self, request):
+        rows = _pow2(len(request.context_tokens))    # bucketed first
+        return np.zeros((rows, 4), dtype=np.int32)
+''',
+    },
+    fix=(
+        "Pass the request-derived value through a sanctioned bucketing "
+        "function (SANCTIONED_BUCKETING in analysis/rules_flow.py: "
+        "_pow2 / _bucket / _window_for / _read_blocks_for / "
+        "_sampler_mode, or any `*bucket*` helper) before it reaches a "
+        "shape, a specialization-getter argument, or a `self._*_fns[...]` "
+        "key. To sanction a new helper, add it to the registry AND a TN "
+        "fixture pinning it (docs/ANALYSIS.md)."
+    ),
+))
+
+_register(RuleExample(
+    rule="FLOW1003",
+    tp={
+        "langstream_tpu/runtime/agent.py": '''\
+import asyncio
+
+class Processor:
+    def process(self, records, sink):
+        for record in records:
+            task = asyncio.ensure_future(self._one(record))
+            task.add_done_callback(lambda t: sink.emit(t.result()))
+            # the frame returns here: only the loop's weak ref is left
+''',
+    },
+    tn={
+        "langstream_tpu/runtime/agent.py": '''\
+import asyncio
+import logging
+
+from langstream_tpu.core.asyncutil import spawn_retained
+
+log = logging.getLogger(__name__)
+
+class Processor:
+    def __init__(self):
+        self._tasks = set()
+
+    def process(self, records, sink):
+        for record in records:
+            task = spawn_retained(
+                self._one(record), self._tasks, log, "chain failed",
+            )
+            task.add_done_callback(lambda t: sink.emit(t.result()))
+''',
+    },
+    fix=(
+        "Route the coroutine through core/asyncutil.spawn_retained with "
+        "an instance-owned task set: the set holds a strong reference "
+        "until the task finishes and a failure is logged instead of "
+        "vanishing. Storing the handle on `self`, in a collection, or "
+        "awaiting it also retains it."
+    ),
+))
+
+_register(RuleExample(
+    rule="FLOW1004",
+    tp={
+        "langstream_tpu/serving/state.py": '''\
+class State:
+    def snapshot(self):
+        with self._table_lock:
+            with self._stats_lock:      # order: table -> stats
+                return dict(self._stats)
+
+    def record(self):
+        with self._stats_lock:
+            self._refresh()
+
+    def _refresh(self):
+        with self._table_lock:          # order: stats -> table (cycle!)
+            self._tables += 1
+''',
+    },
+    tn={
+        "langstream_tpu/serving/state.py": '''\
+class State:
+    def snapshot(self):
+        with self._table_lock:
+            with self._stats_lock:      # one global order everywhere:
+                return dict(self._stats)
+
+    def record(self):
+        with self._table_lock:
+            with self._stats_lock:      # table -> stats again
+                self._stats["n"] += 1
+''',
+    },
+    fix=(
+        "Pick one global acquisition order for the locks in the cycle "
+        "and make every path (including helpers reached through the "
+        "call graph while a lock is held) follow it — or collapse the "
+        "two locks into one. The finding's message lists the cycle; the "
+        "anchor line is one of its edges."
+    ),
+))
+
+_register(RuleExample(
+    rule="GC001",
+    tp={
+        "langstream_tpu/serving/util.py": '''\
+import time
+
+def measure(step):
+    # graftcheck: disable=OBS501 legacy timing path
+    t0 = time.monotonic()      # the code was fixed; the escape lingers
+    step()
+    return time.monotonic() - t0
+''',
+    },
+    tn={
+        "langstream_tpu/serving/util.py": '''\
+import time
+
+def stamp():
+    # graftcheck: disable=OBS501 wall-clock timestamp for the audit log
+    return time.time()         # the suppression still silences a finding
+''',
+    },
+    fix=(
+        "Delete the stale `# graftcheck: disable=...` comment (or the "
+        "regression it was hiding). A suppression that silences nothing "
+        "would mask whatever fires on that line next."
+    ),
+))

@@ -132,12 +132,26 @@ class AttrAccess:
 class RawCall:
     """An unresolved call site, recorded at index time, resolved when the
     whole-project tables exist. ``kind``: "name" (bare), "self" (self.m /
-    cls.m), "selfattr" (self.X.m), "dotted" (alias.m / a.b.m)."""
+    cls.m), "selfattr" (self.X.m), "dotted" (alias.m / a.b.m).
+    ``held``: raw spellings of the locks lexically held at the call site
+    (the FLOW1004 lock-order vocabulary; empty for the common case)."""
 
     kind: str
     name: str            # bare name / method name
     extra: str = ""      # attr X for selfattr; dotted prefix for dotted
     line: int = 0
+    held: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class LockAcquire:
+    """One ``with <…lock…>:`` entry. ``held`` is the raw spelling of the
+    locks already held lexically when this one is taken — each pair
+    (held → lock) is a lock-order edge."""
+
+    lock: str            # raw dotted spelling ("self._state_lock")
+    line: int
+    held: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,11 +186,17 @@ class FunctionInfo:
     raw_loop_cbs: list[RawCall] = dataclasses.field(default_factory=list)
     fetch_sites: list[FetchSite] = dataclasses.field(default_factory=list)
     release_sites: list[ReleaseSite] = dataclasses.field(default_factory=list)
+    lock_acquires: list[LockAcquire] = dataclasses.field(default_factory=list)
     # resolved by ProjectIndex:
     calls: set[str] = dataclasses.field(default_factory=set)
     submits: set[str] = dataclasses.field(default_factory=set)
     threads: set[str] = dataclasses.field(default_factory=set)
     loop_cbs: set[str] = dataclasses.field(default_factory=set)
+    # (callee qname, raw held-lock spellings, line) for calls made while
+    # at least one lock is held — the FLOW1004 composition edges
+    calls_under_lock: list[tuple[str, tuple[str, ...], int]] = (
+        dataclasses.field(default_factory=list)
+    )
 
 
 @dataclasses.dataclass
@@ -282,7 +302,7 @@ class _FileVisitor:
         self._collect_imports()
         self._walk_body(
             self.tree.body, scope=(), cls=None, parent_fn=None,
-            ctx={"locked": False, "lockstep": False, "in_finally": False},
+            ctx={"locked": False, "lockstep": False, "in_finally": False, "held": ()},
         )
 
     # -- imports ---------------------------------------------------------
@@ -325,12 +345,25 @@ class _FileVisitor:
             self._def_class(node, scope, parent_fn)
             return
         if isinstance(node, (ast.With, ast.AsyncWith)):
-            locked = ctx["locked"] or any(
-                _is_lockish(item.context_expr) for item in node.items
-            )
-            inner = {**ctx, "locked": locked}
+            held = ctx["held"]
             for item in node.items:
                 self._walk_expr(item.context_expr, scope, cls, parent_fn, ctx)
+                if _is_lockish(item.context_expr):
+                    lock = dotted_name(item.context_expr)
+                    if lock is None and isinstance(
+                        item.context_expr, ast.Call
+                    ):
+                        lock = dotted_name(item.context_expr.func)
+                    if lock is not None and parent_fn is not None:
+                        parent_fn.lock_acquires.append(
+                            LockAcquire(
+                                lock=lock, line=node.lineno, held=held
+                            )
+                        )
+                    if lock is not None:
+                        # `with a, b:` acquires b while a is held
+                        held = held + (lock,)
+            inner = {**ctx, "locked": bool(held), "held": held}
             self._walk_body(node.body, scope, cls, parent_fn, inner)
             return
         if isinstance(node, ast.If):
@@ -397,7 +430,7 @@ class _FileVisitor:
             self.toplevel_classes[node.name] = qname
         self._walk_body(
             node.body, cscope, info, parent_fn,
-            {"locked": False, "lockstep": False, "in_finally": False},
+            {"locked": False, "lockstep": False, "in_finally": False, "held": ()},
         )
 
     def _def_function(self, node, scope, cls, parent_fn) -> None:
@@ -424,7 +457,7 @@ class _FileVisitor:
                              "in_finally": False})
         self._walk_body(
             node.body, fscope, cls, info,
-            {"locked": False, "lockstep": False, "in_finally": False},
+            {"locked": False, "lockstep": False, "in_finally": False, "held": ()},
         )
 
     def _def_lambda(self, node: ast.Lambda, scope, cls, parent_fn) -> str:
@@ -441,7 +474,7 @@ class _FileVisitor:
             self.functions[qname] = info
             self._walk_expr(
                 node.body, fscope, cls, info,
-                {"locked": False, "lockstep": False, "in_finally": False},
+                {"locked": False, "lockstep": False, "in_finally": False, "held": ()},
             )
         return qname
 
@@ -657,6 +690,10 @@ class _FileVisitor:
         if owner is not None:
             raw = self._call_target(node.func, scope, cls, parent_fn)
             if raw is not None and not isinstance(node.func, ast.Lambda):
+                if ctx["held"]:
+                    raw = dataclasses.replace(
+                        raw, held=tuple(ctx["held"]), line=node.lineno
+                    )
                 owner.raw_calls.append(raw)
 
         # -- receiver-method mutation (self.X.append(...)) --------------
@@ -726,8 +763,12 @@ class ProjectIndex:
     hands it the same sources the per-file pass read).
     """
 
-    def __init__(self, files: dict[str, FileIndex]):
+    def __init__(self, files: dict[str, FileIndex],
+                 sources: dict[str, str] | None = None):
         self.files = files
+        #: rel path -> source text, for the dataflow layer (FLOW rules
+        #: re-parse lazily through the content-hash flow cache)
+        self.sources: dict[str, str] = sources or {}
         self.functions: dict[str, FunctionInfo] = {}
         self.classes: dict[str, ClassInfo] = {}
         self.module_to_path: dict[str, str] = {}
@@ -752,12 +793,14 @@ class ProjectIndex:
         """Index ``(rel_path, source)`` pairs; unparseable sources are
         skipped (the per-file scan owns reporting those)."""
         files: dict[str, FileIndex] = {}
+        texts: dict[str, str] = {}
         for path, src in sources:
             try:
                 files[path] = index_file(path, src)
             except SyntaxError:
                 continue
-        return cls(files)
+            texts[path] = src
+        return cls(files, sources=texts)
 
     @classmethod
     def build_from_paths(
@@ -767,6 +810,7 @@ class ProjectIndex:
         (their own per-file scan reports those)."""
         repo_root = repo_root or REPO_ROOT
         files: dict[str, FileIndex] = {}
+        texts: dict[str, str] = {}
         for p in paths:
             p = Path(p)
             try:
@@ -774,10 +818,12 @@ class ProjectIndex:
             except ValueError:
                 rel = p.as_posix()
             try:
-                files[rel] = index_file(rel, p.read_text())
+                src = p.read_text()
+                files[rel] = index_file(rel, src)
             except (OSError, UnicodeDecodeError, SyntaxError):
                 continue
-        return cls(files)
+            texts[rel] = src
+        return cls(files, sources=texts)
 
     # -- resolution ------------------------------------------------------
 
@@ -890,6 +936,10 @@ class ProjectIndex:
                 resolved = self._resolve_raw(raw, fn)
                 if resolved is not None and resolved != fn.qname:
                     dest.add(resolved)
+                    if raw.held and dest is fn.calls:
+                        fn.calls_under_lock.append(
+                            (resolved, raw.held, raw.line)
+                        )
 
     # -- thread roles ----------------------------------------------------
 
@@ -925,6 +975,13 @@ class ProjectIndex:
         return {q: frozenset(r) for q, r in roles.items()}
 
     # -- queries ---------------------------------------------------------
+
+    def resolve_call(self, raw: RawCall, fn: FunctionInfo) -> str | None:
+        """Public wrapper over the raw-call resolver, for layers (the
+        FLOW rules) that extract their own call descriptors from ASTs
+        and need them resolved against the same tables the call graph
+        used."""
+        return self._resolve_raw(raw, fn)
 
     def reachable(self, roots: Iterable[str]) -> set[str]:
         """Transitive closure over direct call edges from ``roots``."""
@@ -972,6 +1029,15 @@ class ProjectIndex:
                 cfn = self.functions.get(callee)
                 if cfn is not None:
                     _edge(fn.path, cfn.path)
+        # inferred attribute types couple files without an explicit call
+        # edge (``self.flight = FlightRecorder(...)`` resolved methods,
+        # FLOW taint flowing through a held object): a change to the
+        # attribute's class can alter findings in every holder
+        for cls_info in self.classes.values():
+            for target_cls in cls_info.attr_types.values():
+                tinfo = self.classes.get(target_cls)
+                if tinfo is not None:
+                    _edge(cls_info.path, tinfo.path)
         out: set[str] = set()
         stack = [p for p in targets if p in self.files]
         while stack:

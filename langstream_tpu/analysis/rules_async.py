@@ -47,7 +47,9 @@ _FILE_IO_PACKAGES = (
     "langstream_tpu/runtime/",
 )
 
-_TASK_SPAWNERS = {"create_task", "ensure_future"}
+#: shared with FLOW1003 (rules_flow) — the flow-sensitive complement
+#: keys off the same spawner spellings so the two rules cannot drift
+TASK_SPAWNERS = {"create_task", "ensure_future"}
 
 
 def _async_functions(mod: Module) -> Iterator[ast.AsyncFunctionDef]:
@@ -184,7 +186,7 @@ def check_dropped_task(mod: Module) -> Iterator[Finding]:
         if name is None:
             continue
         leaf = name.split(".")[-1]
-        if leaf in _TASK_SPAWNERS:
+        if leaf in TASK_SPAWNERS:
             yield mod.finding(
                 "ASYNC204",
                 node,

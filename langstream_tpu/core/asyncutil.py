@@ -7,7 +7,8 @@ import logging
 
 
 def spawn_retained(
-    coro, tasks: set, log: logging.Logger, error_msg: str
+    coro, tasks: set, log: logging.Logger, error_msg: str,
+    level: int = logging.ERROR,
 ) -> asyncio.Task:
     """Schedule ``coro`` and retain its task handle in ``tasks``.
 
@@ -15,7 +16,9 @@ def spawn_retained(
     fire-and-forget ``ensure_future`` can be garbage-collected mid-flight
     and a failure in it vanishes silently. The handle stays in ``tasks``
     until the task finishes; a non-cancellation exception is logged as
-    ``error_msg``.
+    ``error_msg`` at ``level`` — pass ``logging.DEBUG`` when another
+    done-callback already reports the failure somewhere structured (a
+    sink, a future) and the log line is just an audit trail.
     """
     task = asyncio.ensure_future(coro)
     tasks.add(task)
@@ -23,7 +26,7 @@ def spawn_retained(
     def _done(t) -> None:
         tasks.discard(t)
         if not t.cancelled() and t.exception() is not None:
-            log.error(error_msg, exc_info=t.exception())
+            log.log(level, error_msg, exc_info=t.exception())
 
     task.add_done_callback(_done)
     return task

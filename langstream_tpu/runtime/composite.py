@@ -10,6 +10,7 @@ included, with per-source-record error attribution preserved.
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any
 
 from langstream_tpu.api.agent import (
@@ -20,6 +21,9 @@ from langstream_tpu.api.agent import (
     SourceRecordAndResult,
 )
 from langstream_tpu.api.record import Record
+from langstream_tpu.core.asyncutil import spawn_retained
+
+log = logging.getLogger(__name__)
 
 
 class _CollectorSink:
@@ -59,6 +63,10 @@ class CompositeAgentProcessor(AgentProcessor):
 
     def __init__(self, processors: list[AgentProcessor]):
         self.processors = processors
+        # strong refs to in-flight per-record chains: the event loop keeps
+        # only a weak reference, so an unretained task can be collected
+        # mid-chain and its error never reaches the sink (FLOW1003)
+        self._chains: set[asyncio.Task] = set()
 
     async def init(self, configuration: dict[str, Any]) -> None:
         self.configuration = configuration
@@ -88,9 +96,17 @@ class CompositeAgentProcessor(AgentProcessor):
 
     def process(self, records: list[Record], sink: RecordSink) -> None:
         for record in records:
-            task = asyncio.ensure_future(self._chain_one(record))
+            # the sink emit below is the real error report — the
+            # spawn_retained log line is a DEBUG audit trail, not a
+            # second ERROR for a failure the framework already handles
+            task = spawn_retained(
+                self._chain_one(record), self._chains, log,
+                "composite chain task failed", level=logging.DEBUG,
+            )
 
             def _done(t: "asyncio.Task", r: Record = record) -> None:
+                if t.cancelled():
+                    return  # loop shutdown: no result to attribute
                 err = t.exception()
                 if err is not None:
                     sink.emit(

@@ -1,0 +1,487 @@
+"""Unit tests for the graftcheck dataflow layer (analysis/dataflow.py):
+CFG construction (branch joins, loop back-edges, try/except/finally,
+``with`` spans), reaching definitions, def-use chains, the
+use-after-donate path query, and the taint engine. The FLOW rules built
+on top are covered by fixtures in test_graftcheck.py — these tests pin
+the substrate they all share."""
+
+from __future__ import annotations
+
+import ast
+import textwrap
+
+from langstream_tpu.analysis.dataflow import (
+    TaintSpec,
+    build_cfg,
+    def_use_chains,
+    flow_index,
+    param_refs,
+    reaching_definitions,
+    reads_before_rebind,
+    ref_of,
+    run_taint,
+)
+
+
+def _fn(source: str) -> ast.AST:
+    # strip the leading blank line so `def` sits on line 1 and the test
+    # sources' line numbers match what they assert
+    return ast.parse(textwrap.dedent(source).lstrip("\n")).body[0]
+
+
+def _node_at(cfg, line: int, kind: str = "stmt"):
+    for node in cfg.nodes:
+        if node.line == line and node.kind == kind:
+            return node
+    raise AssertionError(f"no {kind} node at line {line}")
+
+
+def _lines(cfg, idxs) -> set[int]:
+    return {cfg.nodes[i].line for i in idxs}
+
+
+# --------------------------------------------------------------------------
+# CFG construction
+# --------------------------------------------------------------------------
+
+
+def test_cfg_if_branches_and_join():
+    cfg = build_cfg(_fn("""
+        def f(c):
+            a = 1
+            if c:
+                b = 2
+            else:
+                b = 3
+            return b
+    """))
+    head = _node_at(cfg, 3, "head")
+    assert _lines(cfg, head.succs) == {4, 6}  # both branches
+    ret = _node_at(cfg, 7)
+    assert _lines(cfg, ret.preds) == {4, 6}   # join at the return
+    assert ret.succs == [cfg.exit]
+
+
+def test_cfg_if_without_else_falls_through():
+    cfg = build_cfg(_fn("""
+        def f(c):
+            if c:
+                a = 1
+            return 0
+    """))
+    head = _node_at(cfg, 2, "head")
+    ret = _node_at(cfg, 4)
+    # the test reaches the return both through the body and directly
+    assert head.idx in ret.preds
+    assert _node_at(cfg, 3).idx in ret.preds
+
+
+def test_cfg_while_back_edge_break_continue():
+    cfg = build_cfg(_fn("""
+        def f(n):
+            while n:
+                if n == 1:
+                    break
+                if n == 2:
+                    continue
+                n = step(n)
+            return n
+    """))
+    head = _node_at(cfg, 2, "head")
+    body_tail = _node_at(cfg, 7)
+    assert head.idx in body_tail.succs          # loop back edge
+    brk = _node_at(cfg, 4)
+    ret = _node_at(cfg, 8)
+    assert ret.idx in brk.succs                 # break -> after loop
+    cont = _node_at(cfg, 6)
+    assert head.idx in cont.succs               # continue -> head
+    assert ret.idx in head.succs                # loop exit
+
+
+def test_cfg_for_head_writes_target():
+    cfg = build_cfg(_fn("""
+        def f(items):
+            for x in items:
+                use(x)
+    """))
+    head = _node_at(cfg, 2, "head")
+    assert "x" in head.writes
+    assert "items" in head.reads
+    body = _node_at(cfg, 3)
+    assert head.idx in body.succs or body.idx in head.succs
+
+
+def test_cfg_try_except_finally_paths():
+    cfg = build_cfg(_fn("""
+        def f():
+            try:
+                a = risky()
+                b = 2
+            except ValueError:
+                c = 3
+            finally:
+                d = 4
+            return d
+    """))
+    handler = _node_at(cfg, 5, "head")
+    # every try-body statement may raise into the handler
+    assert {_node_at(cfg, 3).idx, _node_at(cfg, 4).idx} <= set(handler.preds)
+    fin = _node_at(cfg, 8)
+    # both the normal exit and the handler route through finally
+    assert _node_at(cfg, 4).idx in fin.preds
+    assert _node_at(cfg, 6).idx in fin.preds
+    ret = _node_at(cfg, 9)
+    assert fin.idx in ret.preds
+
+
+def test_cfg_return_edges_to_exit_kills_fallthrough():
+    cfg = build_cfg(_fn("""
+        def f(c):
+            if c:
+                return 1
+            return 2
+    """))
+    ret1 = _node_at(cfg, 3)
+    assert ret1.succs == [cfg.exit]
+    ret2 = _node_at(cfg, 4)
+    assert ret1.idx not in ret2.preds
+
+
+def test_cfg_with_span_binds_optional_vars():
+    cfg = build_cfg(_fn("""
+        def f(path):
+            with open(path) as fh:
+                data = fh.read()
+            return data
+    """))
+    head = _node_at(cfg, 2, "head")
+    assert "fh" in head.writes
+    assert "path" in head.reads
+
+
+def test_cfg_subscript_store_reads_not_writes_the_ref():
+    # self.X[i] = v touches the object X holds; the binding survives —
+    # exactly the semantics use-after-donate needs
+    cfg = build_cfg(_fn("""
+        def f(self, i, v):
+            self.table[i] = v
+            self.table = {}
+    """))
+    store = _node_at(cfg, 2)
+    assert "self.table" in store.reads
+    assert "self.table" not in store.writes
+    rebind = _node_at(cfg, 3)
+    assert "self.table" in rebind.writes
+
+
+def test_cfg_nested_defs_are_opaque():
+    cfg = build_cfg(_fn("""
+        def f(self):
+            def helper():
+                return self.cache_k
+            return helper
+    """))
+    defstmt = _node_at(cfg, 2)
+    assert defstmt.writes == {"helper"}
+    assert "self.cache_k" not in defstmt.reads
+
+
+# --------------------------------------------------------------------------
+# reaching definitions / def-use
+# --------------------------------------------------------------------------
+
+
+def test_reaching_defs_branch_join_merges_both():
+    cfg = build_cfg(_fn("""
+        def f(c):
+            if c:
+                x = 1
+            else:
+                x = 2
+            return x
+    """))
+    in_sets = reaching_definitions(cfg, param_refs(_fn("""
+        def f(c):
+            pass
+    """)))
+    ret = _node_at(cfg, 6)
+    defs = {d for d in in_sets[ret.idx] if d[0] == "x"}
+    assert _lines(cfg, {idx for _, idx in defs}) == {3, 5}
+
+
+def test_reaching_defs_loop_back_edge_reaches_head():
+    cfg = build_cfg(_fn("""
+        def f(n):
+            x = 0
+            while n:
+                x = x + 1
+            return x
+    """))
+    in_sets = reaching_definitions(cfg)
+    head = _node_at(cfg, 3, "head")
+    defs = {idx for ref, idx in in_sets[head.idx] if ref == "x"}
+    assert _lines(cfg, defs) == {2, 4}  # initial def AND the loop body's
+
+
+def test_def_use_chains_straight_line_and_kill():
+    cfg = build_cfg(_fn("""
+        def f():
+            x = 1
+            use(x)
+            x = 2
+            use(x)
+    """))
+    chains = def_use_chains(cfg)
+    d1 = ("x", _node_at(cfg, 2).idx)
+    d2 = ("x", _node_at(cfg, 4).idx)
+    assert _lines(cfg, chains[d1]) == {3}   # first def killed by line 4
+    assert _lines(cfg, chains[d2]) == {5}
+
+
+def test_def_use_chains_param_defined_at_entry():
+    fn = _fn("""
+        def f(x):
+            return use(x)
+    """)
+    cfg = build_cfg(fn)
+    chains = def_use_chains(cfg, param_refs(fn))
+    assert _lines(cfg, chains[("x", cfg.entry)]) == {2}
+
+
+def test_def_use_chains_dead_def_has_no_uses():
+    cfg = build_cfg(_fn("""
+        def f():
+            t = spawn()
+            other = 1
+            return other
+    """))
+    chains = def_use_chains(cfg)
+    assert ("t", _node_at(cfg, 2).idx) not in chains
+
+
+# --------------------------------------------------------------------------
+# the use-after-donate path query
+# --------------------------------------------------------------------------
+
+
+def test_reads_before_rebind_branch_read_fires():
+    cfg = build_cfg(_fn("""
+        def f(self, c):
+            out = fn(self.cache_k)
+            if c:
+                bad = self.cache_k.sum()
+            self.cache_k = out
+    """))
+    call = _node_at(cfg, 2)
+    hits = reads_before_rebind(cfg, call.idx, "self.cache_k")
+    assert [line for _, line in hits] == [4]
+
+
+def test_reads_before_rebind_immediate_rebind_is_clean():
+    cfg = build_cfg(_fn("""
+        def f(self):
+            out = fn(self.cache_k)
+            self.cache_k = out
+            return self.cache_k
+    """))
+    call = _node_at(cfg, 2)
+    assert reads_before_rebind(cfg, call.idx, "self.cache_k") == []
+
+
+def test_reads_before_rebind_loop_carries_the_read_back():
+    # second loop iteration reads the ref donated by the first: the back
+    # edge must carry the read even though it is textually BEFORE the call
+    cfg = build_cfg(_fn("""
+        def f(self, n):
+            for _ in range(n):
+                out = fn(self.cache_k)
+            return 0
+    """))
+    call = _node_at(cfg, 3)
+    hits = reads_before_rebind(cfg, call.idx, "self.cache_k")
+    assert [line for _, line in hits] == [3]
+
+
+def test_exits_without_rebind_detects_the_quiet_path():
+    from langstream_tpu.analysis.dataflow import exits_without_rebind
+
+    cfg = build_cfg(_fn("""
+        def f(self, c):
+            out = fn(self.cache_k)
+            if c:
+                self.cache_k = out
+            return 0
+    """))
+    call = _node_at(cfg, 2)
+    # the else path reaches the return with the donated attr unbound
+    assert exits_without_rebind(cfg, call.idx, "self.cache_k")
+
+
+def test_exits_without_rebind_clean_when_all_paths_rebind():
+    from langstream_tpu.analysis.dataflow import exits_without_rebind
+
+    cfg = build_cfg(_fn("""
+        def f(self):
+            out = fn(self.cache_k)
+            self.cache_k = out
+            return 0
+    """))
+    call = _node_at(cfg, 2)
+    assert not exits_without_rebind(cfg, call.idx, "self.cache_k")
+
+
+def test_reads_before_rebind_read_and_write_same_stmt_counts_as_read():
+    cfg = build_cfg(_fn("""
+        def f(self):
+            out = fn(self.cache_k)
+            self.cache_k = self.cache_k.copy()
+    """))
+    call = _node_at(cfg, 2)
+    hits = reads_before_rebind(cfg, call.idx, "self.cache_k")
+    assert [line for _, line in hits] == [3]
+
+
+# --------------------------------------------------------------------------
+# taint
+# --------------------------------------------------------------------------
+
+
+class _Spec(TaintSpec):
+    def source_label(self, expr):
+        if isinstance(expr, ast.Attribute) and expr.attr == "request":
+            return "request"
+        return None
+
+    def is_sanctioner(self, call):
+        return isinstance(call.func, ast.Name) and call.func.id == "_bucket"
+
+
+def _taint_of(source: str, line: int, seed=None):
+    fn = _fn(source)
+    cfg = build_cfg(fn)
+    state = run_taint(cfg, _Spec(), seed=seed)
+    node = _node_at(cfg, line)
+    assert isinstance(node.ast_node, (ast.Assign, ast.Return, ast.Expr))
+    expr = getattr(node.ast_node, "value", node.ast_node)
+    return set(state.expr_labels(expr, node.idx))
+
+
+def test_taint_propagates_through_assignments_and_len():
+    assert _taint_of("""
+        def f(self):
+            n = len(self.slot.request.tokens)
+            m = n + 1
+            return m
+    """, 4) == {"request"}
+
+
+def test_taint_sanctioner_launders():
+    assert _taint_of("""
+        def f(self):
+            n = _bucket(len(self.slot.request.tokens))
+            return n
+    """, 3) == set()
+
+
+def test_taint_merges_at_branch_join():
+    assert _taint_of("""
+        def f(self, c):
+            if c:
+                n = 4
+            else:
+                n = self.slot.request.size
+            return n
+    """, 6) == {"request"}
+
+
+def test_taint_rebinding_clears():
+    assert _taint_of("""
+        def f(self):
+            n = self.slot.request.size
+            n = 8
+            return n
+    """, 4) == set()
+
+
+def test_taint_seed_labels_params():
+    assert _taint_of("""
+        def f(rows):
+            padded = rows * 2
+            return padded
+    """, 3, seed={"rows": frozenset({"param:rows"})}) == {"param:rows"}
+
+
+def test_taint_weak_update_through_append_and_subscript_store():
+    assert _taint_of("""
+        def f(self, items):
+            batch = []
+            for it in items:
+                batch.append(self.slot.request)
+            return len(batch)
+    """, 5) == {"request"}
+    assert _taint_of("""
+        def f(self, table):
+            table["k"] = self.slot.request.size
+            return table
+    """, 3) == {"request"}
+
+
+def test_taint_with_as_carries_context_labels():
+    assert _taint_of("""
+        def f(self):
+            with self.queue.request as item:
+                got = item
+            return got
+    """, 4) == {"request"}
+
+
+def test_taint_multi_item_with_labels_each_target_from_its_own_item():
+    # a multi-item `with` builds one head node per item: the tainted
+    # first item must not be overwritten by the clean second (and the
+    # clean second must not inherit the first's taint)
+    src = """
+        def f(self, p):
+            with self.ctx.request as rows, open(p) as fh:
+                a = rows
+                b = fh
+            return a, b
+    """
+    assert _taint_of(src, 3) == {"request"}   # a = rows
+    assert _taint_of(src, 4) == set()          # b = fh
+
+
+# --------------------------------------------------------------------------
+# the flow index
+# --------------------------------------------------------------------------
+
+
+def test_flow_index_qnames_and_cache():
+    src = textwrap.dedent("""
+        class Engine:
+            def step(self):
+                def inner():
+                    return 1
+                return inner
+
+        def helper():
+            try:
+                pass
+            except Exception:
+                def fallback():
+                    return 0
+    """)
+    ff = flow_index("serving/engine.py", src)
+    assert set(ff.functions) == {
+        "serving.engine.Engine.step",
+        "serving.engine.Engine.step.inner",
+        "serving.engine.helper",
+        "serving.engine.helper.fallback",
+    }
+    assert flow_index("serving/engine.py", src) is ff  # content-hash hit
+
+
+def test_ref_of_spellings():
+    assert ref_of(ast.parse("x", mode="eval").body) == "x"
+    assert ref_of(ast.parse("self.cache_k", mode="eval").body) == "self.cache_k"
+    assert ref_of(ast.parse("cls.table", mode="eval").body) == "self.table"
+    assert ref_of(ast.parse("obj.attr", mode="eval").body) is None

@@ -1852,5 +1852,626 @@ def test_every_rule_has_unique_id_and_family():
     }
     assert {
         "jax", "async-blocking", "concurrency", "secret-leak",
-        "exception-swallowing", "obs", "race", "inv",
+        "exception-swallowing", "obs", "race", "inv", "flow",
     } <= families
+
+
+# --------------------------------------------------------------------------
+# FLOW1001 — use-after-donate
+# --------------------------------------------------------------------------
+
+
+def test_flow1001_tp_branch_read_after_donating_call(tmp_path):
+    findings = project_findings({
+        "serving/engine.py": """
+            from functools import partial
+            import jax
+
+            class Engine:
+                def step(self, tokens, debug):
+                    @partial(jax.jit, donate_argnums=(1, 2))
+                    def _decode(params, cache_k, cache_v, tokens):
+                        return tokens, cache_k, cache_v
+
+                    out = _decode(
+                        self.params, self.cache_k, self.cache_v, tokens
+                    )
+                    if debug:
+                        stale = self.cache_k.sum()
+                    self.cache_k, self.cache_v = out[1], out[2]
+                    return out[0]
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1001"]
+    assert "self.cache_k" in findings[0].message
+
+
+def test_flow1001_tp_through_factory_attr_and_variant_cache(tmp_path):
+    # the engine's full indirection chain: nested factory -> instance
+    # attr -> variant-cache dict -> getter method -> local binding
+    findings = project_findings({
+        "serving/engine.py": """
+            from functools import partial
+            import jax
+
+            class Engine:
+                def _init_model(self):
+                    def _make_decode(mode):
+                        @partial(jax.jit, donate_argnums=(1, 2))
+                        def _decode(params, cache_k, cache_v, tokens):
+                            return tokens, cache_k, cache_v
+                        return _decode
+                    self._make_decode = _make_decode
+                    self._decode_chunk_fns = {}
+
+                def _decode_fn(self, mode):
+                    if mode not in self._decode_chunk_fns:
+                        self._decode_chunk_fns[mode] = self._make_decode(mode)
+                    return self._decode_chunk_fns[mode]
+
+                def step(self, tokens, mode):
+                    fn = self._decode_fn(mode)
+                    out = fn(
+                        self.params, self.cache_k, self.cache_v, tokens
+                    )
+                    emitted = self.cache_v[0]     # donated, not yet rebound
+                    self.cache_k, self.cache_v = out[1], out[2]
+                    return emitted
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1001"]
+    assert "self.cache_v" in findings[0].message
+
+
+def test_flow1001_tn_rebind_in_closure_with_starred_args(tmp_path):
+    # the engine pattern pinned by the acceptance criteria: the dispatch
+    # closure builds args (branching on paged), calls fn(*args), and
+    # rebinds the donated caches immediately — stays clean
+    assert project_ids({
+        "serving/engine.py": """
+            from functools import partial
+            import jax
+
+            class Engine:
+                def _init_model(self):
+                    def _make_decode(mode):
+                        @partial(jax.jit, donate_argnums=(1, 2))
+                        def _decode(params, cache_k, cache_v, tokens):
+                            return tokens, cache_k, cache_v
+                        return _decode
+                    self._make_decode = _make_decode
+                    self._decode_chunk_fns = {}
+
+                def _decode_fn(self, mode):
+                    if mode not in self._decode_chunk_fns:
+                        self._decode_chunk_fns[mode] = self._make_decode(mode)
+                    return self._decode_chunk_fns[mode]
+
+                async def _burst(self, loop, tokens, mode, paged):
+                    fn = self._decode_fn(mode)
+
+                    def _run():
+                        args = (
+                            (self.params, self.cache_k, self.cache_v, tokens)
+                            if paged
+                            else (self.params, self.cache_k,
+                                  self.cache_v, tokens)
+                        )
+                        out = fn(*args)
+                        # donated caches re-bound on the dispatch thread
+                        self.cache_k, self.cache_v = out[1], out[2]
+                        return out[0]
+
+                    return await loop.run_in_executor(None, _run)
+        """,
+    }, tmp_path) == []
+
+
+def test_flow1001_tp_missing_rebind_on_donated_attr(tmp_path):
+    # the quiet half (the PR-6 bug class): nothing in the closure reads
+    # the donated cache, but the instance attr outlives the frame still
+    # bound to donated memory — the next reader anywhere gets garbage
+    findings = project_findings({
+        "serving/engine.py": """
+            from functools import partial
+            import jax
+
+            class Engine:
+                def step(self, tokens):
+                    @partial(jax.jit, donate_argnums=(1, 2))
+                    def _decode(params, cache_k, cache_v, tokens):
+                        return tokens, cache_k, cache_v
+
+                    out = _decode(
+                        self.params, self.cache_k, self.cache_v, tokens
+                    )
+                    return out[0]    # caches never rebound
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1001", "FLOW1001"]
+    assert "not rebound on every path" in findings[0].message
+
+
+def test_flow1001_tp_closure_call_binding_from_enclosing_scope(tmp_path):
+    # the binding `fn = ...` lives in the method; the donating call and
+    # the (missing) rebind live in the dispatch closure — the lexical
+    # chain must connect them
+    findings = project_findings({
+        "serving/engine.py": """
+            from functools import partial
+            import jax
+
+            class Engine:
+                def _init_model(self):
+                    def _make_decode(mode):
+                        @partial(jax.jit, donate_argnums=(1, 2))
+                        def _decode(params, cache_k, cache_v, tokens):
+                            return tokens, cache_k, cache_v
+                        return _decode
+                    self._make_decode = _make_decode
+
+                async def _burst(self, loop, tokens, mode):
+                    fn = self._make_decode(mode)
+
+                    def _run():
+                        out = fn(
+                            self.params, self.cache_k, self.cache_v, tokens
+                        )
+                        return out[0]    # donated caches never rebound
+
+                    return await loop.run_in_executor(None, _run)
+        """,
+    }, tmp_path)
+    assert {f.rule for f in findings} == {"FLOW1001"}
+    assert all("not rebound" in f.message for f in findings)
+
+
+def test_flow1001_tn_undonated_jit_call_reads_freely(tmp_path):
+    assert project_ids({
+        "serving/engine.py": """
+            import jax
+
+            class Engine:
+                def step(self, tokens):
+                    @jax.jit
+                    def _decode(params, cache_k, tokens):
+                        return tokens
+
+                    out = _decode(self.params, self.cache_k, tokens)
+                    return self.cache_k.sum()    # no donation: fine
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# FLOW1002 — recompile taint
+# --------------------------------------------------------------------------
+
+
+def test_flow1002_tp_request_len_shapes_array(tmp_path):
+    findings = project_findings({
+        "serving/engine.py": """
+            import numpy as np
+
+            class Engine:
+                def admit(self, request):
+                    rows = len(request.context_tokens)
+                    return np.zeros((rows, 4), dtype=np.int32)
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1002"]
+    assert "np.zeros" in findings[0].message
+
+
+def test_flow1002_tp_cross_function_through_callee_param(tmp_path):
+    findings = project_findings({
+        "serving/engine.py": """
+            import numpy as np
+
+            def _alloc(rows):
+                return np.zeros((rows, 4), dtype=np.int32)
+
+            class Engine:
+                def admit(self, request):
+                    return _alloc(len(request.context_tokens))
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1002"]
+    assert "_alloc" in findings[0].message
+
+
+def test_flow1002_tp_variant_cache_key_and_queue_item(tmp_path):
+    findings = project_findings({
+        "serving/engine.py": """
+            class Engine:
+                def resolve(self):
+                    request = self._queue.get_nowait()
+                    key = len(request.prompt)
+                    return self._decode_chunk_fns[key]
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1002"]
+    assert "variant key" in findings[0].message
+
+
+def test_flow1002_tp_taint_through_collection_append(tmp_path):
+    # the admit-batch shape: request-derived tuples accumulate in a
+    # list and len(list) shapes the padded batch — taint must survive
+    # the .append()
+    findings = project_findings({
+        "serving/engine.py": """
+            import numpy as np
+
+            class Engine:
+                def admit(self, pending):
+                    batch = []
+                    for request in pending:
+                        batch.append((request, request.top_k))
+                    rows = len(batch)
+                    return np.zeros((rows, 8), dtype=np.int32)
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1002"]
+
+
+def test_flow1002_tn_bucketed_and_config_derived(tmp_path):
+    assert project_ids({
+        "serving/engine.py": """
+            import numpy as np
+
+            def _pow2(n):
+                p = 1
+                while p < n:
+                    p *= 2
+                return p
+
+            def chunk_bucket(n):
+                return max(16, n // 16 * 16)
+
+            class Engine:
+                def admit(self, request):
+                    rows = _pow2(len(request.context_tokens))
+                    cols = chunk_bucket(len(request.prompt))
+                    fixed = self.config.slots
+                    a = np.zeros((rows, cols), dtype=np.int32)
+                    b = np.zeros((fixed, 4), dtype=np.int32)
+                    key = (rows, cols)
+                    self._decode_chunk_fns[key] = a
+                    return a, b
+        """,
+    }, tmp_path) == []
+
+
+def test_flow1002_tn_outside_serving_not_scoped(tmp_path):
+    assert project_ids({
+        "runtime/agent.py": """
+            import numpy as np
+
+            class Agent:
+                def pack(self, request):
+                    return np.zeros(len(request.items))
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# FLOW1003 — unretained task
+# --------------------------------------------------------------------------
+
+
+def test_flow1003_tp_dead_handle_in_async_fn(tmp_path):
+    findings = project_findings({
+        "gateway/server.py": """
+            import asyncio
+
+            class Server:
+                async def handle(self, request):
+                    task = asyncio.ensure_future(self._push(request))
+                    return 202
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1003"]
+    assert "spawn_retained" in findings[0].message
+
+
+def test_flow1003_tp_sync_frame_receiver_only_uses(tmp_path):
+    # the composite.py bug this PR fixed: the handle is "used" (a done
+    # callback is attached) but nothing retains it past frame exit
+    findings = project_findings({
+        "runtime/composite.py": """
+            import asyncio
+
+            class Processor:
+                def process(self, records, sink):
+                    for record in records:
+                        task = asyncio.ensure_future(self._one(record))
+                        task.add_done_callback(
+                            lambda t: sink.emit(t.result())
+                        )
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1003"]
+    assert "never escapes" in findings[0].message
+
+
+def test_flow1003_tn_sanctioned_retention_patterns(tmp_path):
+    assert project_ids({
+        "runtime/agent.py": """
+            import asyncio
+
+            from langstream_tpu.core.asyncutil import spawn_retained
+
+            class Agent:
+                def start(self):
+                    # attribute stores retain by design
+                    self._loop_task = asyncio.ensure_future(self._main())
+
+                def chain(self, records, sink, log):
+                    for record in records:
+                        task = spawn_retained(
+                            self._one(record), self._tasks, log, "boom",
+                        )
+                        task.add_done_callback(
+                            lambda t: sink.emit(t.result())
+                        )
+
+                async def serve(self, ws, reader):
+                    # a live coroutine frame retains its locals: the
+                    # gateway pusher pattern stays clean
+                    pusher = asyncio.ensure_future(self._push(ws, reader))
+                    try:
+                        async for _ in ws:
+                            pass
+                    finally:
+                        pusher.cancel()
+
+                def fan_out(self, items):
+                    # escaping into a collection/call retains
+                    tasks = [asyncio.ensure_future(self._one(i))
+                             for i in items]
+                    return asyncio.gather(*tasks)
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# FLOW1004 — lock-order cycles
+# --------------------------------------------------------------------------
+
+
+def test_flow1004_tp_cycle_through_call_graph(tmp_path):
+    findings = project_findings({
+        "serving/state.py": """
+            class State:
+                def snapshot(self):
+                    with self._table_lock:
+                        with self._stats_lock:
+                            return dict(self._stats)
+
+                def record(self):
+                    with self._stats_lock:
+                        self._refresh()
+
+                def _refresh(self):
+                    with self._table_lock:
+                        self._tables += 1
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["FLOW1004"]
+    assert "_table_lock" in findings[0].message
+    assert "_stats_lock" in findings[0].message
+
+
+def test_flow1004_tn_same_order_and_sequential(tmp_path):
+    assert project_ids({
+        "serving/state.py": """
+            class State:
+                def snapshot(self):
+                    with self._table_lock:
+                        with self._stats_lock:
+                            return dict(self._stats)
+
+                def record(self):
+                    with self._table_lock:
+                        with self._stats_lock:
+                            self._stats["n"] = 1
+
+                def sequential(self):
+                    # taken one AFTER the other, never nested: no edge
+                    with self._stats_lock:
+                        n = self._stats["n"]
+                    with self._table_lock:
+                        self._tables = n
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# FLOW x GC001 — suppression hygiene covers the flow family
+# --------------------------------------------------------------------------
+
+
+def test_gc001_flags_stale_flow_suppression(tmp_path):
+    findings = project_findings({
+        "runtime/agent.py": """
+            import asyncio
+
+            class Agent:
+                def start(self):
+                    # graftcheck: disable=FLOW1003 handle parked on self below
+                    self._task = asyncio.ensure_future(self._main())
+        """,
+    }, tmp_path)
+    # the attribute store never fires FLOW1003, so the suppression is rot
+    assert [f.rule for f in findings] == ["GC001"]
+    assert "FLOW1003" in findings[0].message
+
+
+def test_flow_suppression_with_reason_is_honored(tmp_path):
+    findings = project_findings({
+        "runtime/agent.py": """
+            import asyncio
+
+            class Agent:
+                async def fire(self):
+                    # graftcheck: disable=FLOW1003 best-effort probe, loss is acceptable
+                    probe = asyncio.ensure_future(self._probe())
+        """,
+    }, tmp_path)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------
+# the --explain fixture registry is live, not prose
+# --------------------------------------------------------------------------
+
+
+def test_every_flow_rule_has_a_registered_example():
+    from langstream_tpu.analysis.fixtures import EXAMPLES
+
+    flow_ids = {r.id for r in PROJECT_RULES if r.family == "flow"}
+    assert flow_ids <= set(EXAMPLES)
+
+
+@pytest.mark.parametrize(
+    "rule_id",
+    sorted(__import__(
+        "langstream_tpu.analysis.fixtures", fromlist=["EXAMPLES"]
+    ).EXAMPLES),
+)
+def test_explain_examples_validate_against_the_analyzer(rule_id, tmp_path):
+    from langstream_tpu.analysis.fixtures import EXAMPLES
+
+    example = EXAMPLES[rule_id]
+    tp_report = run(
+        ALL_RULES, files=write_tree(example.tp, tmp_path / "tp"),
+        baseline=[], repo_root=tmp_path / "tp",
+        project_rules=PROJECT_RULES,
+    )
+    assert rule_id in {f.rule for f in tp_report.new}, (
+        f"--explain {rule_id} TP example no longer fires"
+    )
+    tn_report = run(
+        ALL_RULES, files=write_tree(example.tn, tmp_path / "tn"),
+        baseline=[], repo_root=tmp_path / "tn",
+        project_rules=PROJECT_RULES,
+    )
+    assert rule_id not in {f.rule for f in tn_report.new}, (
+        f"--explain {rule_id} TN example fires"
+    )
+
+
+def test_cli_explain_known_and_unknown_rule(capsys):
+    from langstream_tpu.analysis.__main__ import main
+
+    assert main(["--explain", "FLOW1002"]) == 0
+    out = capsys.readouterr().out
+    assert "true positive" in out
+    assert "true negative" in out
+    assert "fix" in out
+    assert "_pow2" in out
+
+    assert main(["--explain", "FLOW9999"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# --jobs: parallel per-file scanning is report-identical
+# --------------------------------------------------------------------------
+
+
+def test_jobs_parallel_scan_matches_sequential(tmp_path):
+    tree = {
+        "serving/a.py": """
+            import time
+
+            async def handler():
+                time.sleep(1)
+        """,
+        "serving/b.py": """
+            def measure(step):
+                import time
+                t0 = time.time()
+                step()
+                return time.time() - t0
+        """,
+        "runtime/c.py": """
+            import asyncio
+
+            async def go(work):
+                asyncio.create_task(work())
+        """,
+        "gateway/d.py": "x = 1\n",
+    }
+    files = write_tree(tree, tmp_path)
+    seq = run(ALL_RULES, files=files, baseline=[], repo_root=tmp_path,
+              project_rules=PROJECT_RULES)
+    par = run(ALL_RULES, files=files, baseline=[], repo_root=tmp_path,
+              project_rules=PROJECT_RULES, jobs=4)
+    assert [f.format() for f in par.new] == [f.format() for f in seq.new]
+    assert par.new  # the fixture actually exercises findings
+    assert par.parse_errors == seq.parse_errors
+
+
+def test_cli_jobs_flag(tmp_path, capsys):
+    from langstream_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\nasync def handler():\n    time.sleep(1)\n"
+    )
+    assert main([str(bad), "--jobs", "2"]) == 1
+    assert "ASYNC201" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------
+# --changed closure carries FLOW coupling
+# --------------------------------------------------------------------------
+
+
+def test_dependents_closure_covers_flow_taint_coupling(tmp_path):
+    """A change to a bucketing helper must re-report the engine module
+    whose FLOW1002 verdict depends on it — the call-graph edge carries
+    the coupling, in both directions."""
+    index = build_index({
+        "serving/buckets.py": """
+            def _pow2(n):
+                p = 1
+                while p < n:
+                    p *= 2
+                return p
+        """,
+        "serving/engine.py": """
+            import numpy as np
+
+            from serving.buckets import _pow2
+
+            class Engine:
+                def admit(self, request):
+                    rows = _pow2(len(request.context_tokens))
+                    return np.zeros((rows, 4), dtype=np.int32)
+        """,
+    }, tmp_path)
+    closure = index.dependents(["serving/buckets.py"])
+    assert "serving/engine.py" in closure
+
+
+def test_dependents_closure_covers_attr_type_coupling(tmp_path):
+    """Inferred attribute types couple a holder class to the held class
+    even when resolution happened without a same-file call edge."""
+    index = build_index({
+        "serving/flight.py": """
+            class FlightRecorder:
+                def sample(self):
+                    return 1
+        """,
+        "serving/engine.py": """
+            from serving.flight import FlightRecorder
+
+            class Engine:
+                def __init__(self):
+                    self.flight = FlightRecorder()
+        """,
+    }, tmp_path)
+    closure = index.dependents(["serving/flight.py"])
+    assert "serving/engine.py" in closure
