@@ -44,6 +44,16 @@ Resource shape (``configuration.yaml``):
                                        # token buckets, preemptive load
                                        # shedding — docs/SCHEDULING.md; null
                                        # keeps the FIFO admission queue
+          wedge-window-s: 60           # engine watchdog: WEDGED (liveness
+                                       # probe fails, pod rescheduled) after
+                                       # this long with queued work and no
+                                       # step progress (serving/health.py)
+          slo: null                    # SLO objectives (ttft / queue-wait /
+                                       # shed-rate / availability targets)
+                                       # tracked with multi-window burn
+                                       # rates; `alert` flight events +
+                                       # slo_burn_rate gauges on fast burn —
+                                       # docs/OBSERVABILITY.md Health & SLO
 """
 
 from __future__ import annotations

@@ -237,3 +237,36 @@ def stamp():
         "would mask whatever fires on that line next."
     ),
 ))
+
+_register(RuleExample(
+    rule="OBS504",
+    tp={
+        "langstream_tpu/serving/health.py": '''\
+import jax
+
+def check_engine(engine):
+    # a liveness probe that syncs the device hangs exactly when the
+    # device does — the one moment it must answer
+    jax.block_until_ready(engine.last_logits)
+    with engine.dispatch_lock:
+        return engine.state
+''',
+    },
+    tn={
+        "langstream_tpu/serving/health.py": '''\
+def check_engine(engine, clock):
+    # the sanctioned shape: snapshot reads + arithmetic, nothing that
+    # can wait on the device, a lock, or I/O
+    samples = list(engine.ring)
+    age = clock() - engine.last_step
+    return "wedged" if age > 60.0 and engine.queued > 0 else "ok"
+''',
+    },
+    fix=(
+        "Make the checker judge host-side evidence the engine loop "
+        "already recorded (heartbeat stamps, flight-ring snapshots) "
+        "instead of touching the device or its locks: list(deque) "
+        "copies, attribute loads, and arithmetic are the whole "
+        "sanctioned vocabulary (see serving/health.py)."
+    ),
+))

@@ -22,6 +22,23 @@ hot loops non-blocking — the observability-must-not-perturb contract:
   hot-loop methods or anywhere in ``serving/flight.py``. Telemetry there
   must be an in-memory append; export belongs off-loop (the pod HTTP
   endpoint, the JSONL export thread in core/tracing.py).
+
+OBS504 keeps the *health plane* wait-free — the dual of OBS503: where
+telemetry must not perturb the engine, the health checker must not
+DEPEND on it. A liveness probe that syncs the device
+(``block_until_ready`` / ``device_get`` / ``.item()``) hangs exactly
+when the device does — the one moment it must answer; a probe that
+acquires a lock can queue behind the wedged dispatch holding it; and
+blocking I/O stalls the probe on a resource unrelated to the verdict.
+Scope: everything in ``serving/health.py`` (predicates and trackers),
+the pod probe handlers (``_probe_healthz``/``_probe_ready`` in
+``runtime/pod.py``), and the engine's health-surface methods
+(``health``/``slo_status``/``_slo_record``/``_slo_record_latency``/
+``_slo_emit``/``health_report``/``kick_warmups`` in ``serving/`` —
+``_HEALTH_FUNCS_BY_FILE`` below is the authoritative list). Nested defs
+are exempt everywhere: they are deferred work (warmup tasks, factories)
+the probe only creates, never runs inline. The sanctioned pattern is
+snapshot reads (``list(deque)``, attribute loads) plus arithmetic.
 """
 
 from __future__ import annotations
@@ -189,6 +206,121 @@ def check_blocking_in_hot_loop(mod: Module) -> Iterator[Finding]:
                 )
 
 
+#: the health-plane module: EVERY function in it is a health predicate or
+#: tracker that probe handlers may run inline
+_HEALTH_MODULE = "langstream_tpu/serving/health.py"
+
+#: named health-plane functions outside that module: the pod probe
+#: handlers and the engine's health-surface methods
+_HEALTH_FUNCS_BY_FILE = {
+    "langstream_tpu/runtime/pod.py": {"_probe_healthz", "_probe_ready"},
+    "langstream_tpu/serving/": {
+        "health",
+        "slo_status",
+        "_slo_record",
+        "_slo_record_latency",
+        "_slo_emit",
+        "health_report",
+        "kick_warmups",
+    },
+}
+
+#: unambiguous device syncs (PERF701's table minus np.asarray/np.array —
+#: health math runs numpy on host snapshots, and a probe has no device
+#: arrays to convert; the sync spellings below have no host-only reading)
+_DEVICE_SYNC_CALLS = {
+    "jax.block_until_ready",
+    "jax.device_get",
+    "block_until_ready",
+    "device_get",
+}
+
+_DEVICE_SYNC_ATTRS = {"block_until_ready", "item", "copy_to_host"}
+
+
+def _health_functions(mod: Module) -> Iterator[ast.AST]:
+    whole_module = mod.path.endswith(_HEALTH_MODULE)
+    named: set[str] = set()
+    for prefix, names in _HEALTH_FUNCS_BY_FILE.items():
+        if prefix in mod.path or mod.path.endswith(prefix):
+            named = names
+            break
+    if not whole_module and not named:
+        return
+    # nested defs are deferred work (warmup tasks, factories) and get
+    # their own exemption in the checker — never yield them as policed
+    # functions in their own right, or whole-module mode would re-scan
+    # exactly the bodies the exemption excludes
+    nested_fns: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if inner is not node and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested_fns.add(id(inner))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(node) in nested_fns:
+            continue
+        if whole_module or node.name in named:
+            yield node
+
+
+def check_blocking_in_health_plane(mod: Module) -> Iterator[Finding]:
+    for fn in _health_functions(mod):
+        # nested defs are deferred work (warmup tasks, factories) — the
+        # probe never runs their bodies inline (same exemption OBS503
+        # grants dispatch closures)
+        nested: set[int] = set()
+        for inner in ast.walk(fn):
+            if (
+                isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and inner is not fn
+            ):
+                nested.update(id(n) for n in ast.walk(inner))
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue
+            offender = kind = None
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _DEVICE_SYNC_CALLS:
+                    offender, kind = f"{name}()", "device sync"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DEVICE_SYNC_ATTRS
+                ):
+                    offender, kind = f".{node.func.attr}()", "device sync"
+                elif name in _BLOCKING_CALLS or name in _EXTRA_BLOCKING:
+                    offender, kind = f"{name}()", "blocking call"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _FILE_IO_ATTRS
+                ):
+                    offender, kind = f".{node.func.attr}()", "blocking call"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    offender, kind = f"{name or '.acquire'}()", "lock"
+            elif isinstance(node, ast.With):
+                if any(_lockish(item.context_expr) for item in node.items):
+                    offender, kind = "with <lock>", "lock"
+            if offender is not None:
+                yield mod.finding(
+                    "OBS504",
+                    node,
+                    f"{kind} {offender} in a health-check/watchdog path "
+                    f"(`{fn.name}`): probes must stay wait-free — a "
+                    f"device sync hangs with the device, a lock queues "
+                    f"behind the wedged dispatch holding it, blocking "
+                    f"I/O stalls the verdict; use snapshot reads "
+                    f"(list(deque), attribute loads) and arithmetic only",
+                )
+
+
 RULES = [
     Rule(
         id="OBS501",
@@ -210,5 +342,12 @@ RULES = [
         summary="blocking I/O in an engine hot-loop method or the flight "
         "recorder (telemetry must be non-blocking)",
         check=check_blocking_in_hot_loop,
+    ),
+    Rule(
+        id="OBS504",
+        family="obs",
+        summary="device sync, blocking I/O, or lock acquisition in a "
+        "health-check/watchdog path (probes must be wait-free)",
+        check=check_blocking_in_health_plane,
     ),
 ]

@@ -37,6 +37,7 @@ from langstream_tpu.controlplane.stores import (
 from langstream_tpu.core.parser import ModelBuilder
 from langstream_tpu.gateway.auth import validate_gateway_authentication
 from langstream_tpu.gateway.server import GatewayRegistry
+from langstream_tpu.serving.health import validate_application_slo
 from langstream_tpu.serving.qos import validate_application_qos
 from langstream_tpu.runtime.local_runner import LocalApplicationRunner
 
@@ -228,16 +229,71 @@ class LocalComputeRuntime:
         runner = self.runners.get((tenant, name))
         if runner is None:
             return {"configured": {}, "engines": []}
-        configured: dict[str, Any] = {}
-        models: set[str] = set()
-        for res_name, res in runner.application.resources.items():
-            if res.type != "tpu-serving-configuration":
-                continue
-            config = res.configuration or {}
-            models.add(config.get("model", "tiny"))
-            configured[res_name] = config.get("qos")
+        models = self._declared_models(tenant, name) or set()
+        configured = {
+            res_name: (res.configuration or {}).get("qos")
+            for res_name, res in runner.application.resources.items()
+            if res.type == "tpu-serving-configuration"
+        }
         engines = [
             {"model": e["model"], "scheduler": e.get("scheduler")}
+            for e in flight_report(summary_only=True)
+            if e["model"] in models
+        ]
+        return {"configured": configured, "engines": engines}
+
+    def _declared_models(self, tenant: str, name: str) -> set[str] | None:
+        """Models the app's serving resources declare (None when the app
+        isn't deployed here) — the scope every engine-reading route
+        applies, since dev-mode engines are process-global and one
+        tenant's route must not read another's telemetry."""
+        runner = self.runners.get((tenant, name))
+        if runner is None:
+            return None
+        return {
+            (res.configuration or {}).get("model", "tiny")
+            for res in runner.application.resources.values()
+            if res.type == "tpu-serving-configuration"
+        }
+
+    def health(self, tenant: str, name: str) -> dict[str, Any]:
+        """Fleet health for the /health route: the watchdog verdicts of
+        this app's in-process engines (serving/health.py), worst-state
+        aggregated. Dev mode has no pods, so ``pods`` carries one
+        synthetic in-process member per engine."""
+        from langstream_tpu.serving.engine import health_report
+        from langstream_tpu.serving.health import worst_state
+
+        models = self._declared_models(tenant, name)
+        if models is None:
+            return {"status": "ok", "pods": []}
+        engines = [e for e in health_report() if e.get("model") in models]
+        return {
+            "status": worst_state(e.get("state", "wedged") for e in engines),
+            "pods": [
+                {"pod": "in-process", "status": e.get("state"), "engines": [e]}
+                for e in engines
+            ],
+        }
+
+    def slo(self, tenant: str, name: str) -> dict[str, Any]:
+        """SLO status for the /slo route: declared objectives (from the
+        app's serving resources) plus each live engine's burn-rate
+        evaluation — the same ``slo`` section the pod's /flight/summary
+        carries, scoped to the app's declared models like :meth:`qos`."""
+        from langstream_tpu.serving.engine import flight_report
+
+        runner = self.runners.get((tenant, name))
+        if runner is None:
+            return {"configured": {}, "engines": []}
+        models = self._declared_models(tenant, name) or set()
+        configured = {
+            res_name: (res.configuration or {}).get("slo")
+            for res_name, res in runner.application.resources.items()
+            if res.type == "tpu-serving-configuration"
+        }
+        engines = [
+            {"model": e["model"], "slo": e.get("slo")}
             for e in flight_report(summary_only=True)
             if e["model"] in models
         ]
@@ -255,14 +311,9 @@ class LocalComputeRuntime:
         engine)."""
         from langstream_tpu.serving.engine import flight_report
 
-        runner = self.runners.get((tenant, name))
-        if runner is None:
+        models = self._declared_models(tenant, name)
+        if models is None:
             return []
-        models = {
-            (res.configuration or {}).get("model", "tiny")
-            for res in runner.application.resources.values()
-            if res.type == "tpu-serving-configuration"
-        }
         return [e for e in flight_report() if e["model"] in models]
 
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
@@ -334,6 +385,10 @@ class ControlPlaneServer:
                     "/api/applications/{tenant}/{name}/flight", self._flight
                 ),
                 web.get("/api/applications/{tenant}/{name}/qos", self._qos),
+                web.get(
+                    "/api/applications/{tenant}/{name}/health", self._health
+                ),
+                web.get("/api/applications/{tenant}/{name}/slo", self._slo),
                 web.get("/api/applications/{tenant}/{name}/code", self._download_code),
                 web.get("/api/applications/{tenant}/{name}/agents", self._agents),
                 # archetypes (parity: ArchetypeResource)
@@ -472,6 +527,7 @@ class ControlPlaneServer:
             )
             validate_gateway_authentication(application.gateways)
             validate_application_qos(application)
+            validate_application_slo(application)
         except web.HTTPException:
             raise
         except Exception as e:
@@ -494,6 +550,7 @@ class ControlPlaneServer:
                 )
                 validate_gateway_authentication(application.gateways)
                 validate_application_qos(application)
+                validate_application_slo(application)
             except Exception as e:
                 raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         else:
@@ -647,6 +704,29 @@ class ControlPlaneServer:
         tenant = request.match_info["tenant"]
         name = request.match_info["name"]
         report = await asyncio.to_thread(self.compute.qos, tenant, name)
+        return web.json_response(report)
+
+    async def _health(self, request: web.Request) -> web.Response:
+        """Per-application fleet health: dev mode judges the in-process
+        engines' watchdogs; the k8s runtime fans in the pods' /healthz —
+        with timed-out pods reported as unreachable members, never
+        dropped."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        report = await asyncio.to_thread(self.compute.health, tenant, name)
+        return web.json_response(report)
+
+    async def _slo(self, request: web.Request) -> web.Response:
+        """Per-application SLO status: declared objectives + live burn
+        rates (dev mode in-process; k8s via the pods' /flight/summary
+        slo sections)."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        report = await asyncio.to_thread(self.compute.slo, tenant, name)
         return web.json_response(report)
 
     async def _trace(self, request: web.Request) -> web.Response:

@@ -181,27 +181,46 @@ class KubernetesComputeRuntime:
 
     def _pod_json_fanin(
         self, tenant: str, name: str, path: str
-    ) -> list[tuple[str, list]]:
-        """(pod, parsed JSON list) for every application pod serving
+    ) -> list[tuple[str, Any]]:
+        """(pod, parsed JSON payload) for every application pod serving
         ``path`` on its runtime HTTP port. Best-effort: an unreachable pod
-        contributes an empty list — aggregation must not 502 because one
-        replica is restarting. Synchronous by design (handlers run it in a
-        thread); pods are fetched concurrently — serial 2 s timeouts
-        against a rolling restart would cost replicas x 2 s per request."""
+        contributes ``None`` — aggregation must not 502 because one
+        replica is restarting. Member-shaped aggregates (flight, qos,
+        health, slo) MUST surface the ``None`` as an ``unreachable``
+        member rather than dropping it (an operator reading an aggregate
+        that silently omits the one pod that timed out would conclude
+        the fleet is fine precisely when it is not); :meth:`traces` is
+        the one exception — its payload is a span/rollup list keyed by
+        trace_id with no per-pod member shape to hang the marker on.
+        Non-2xx answers parse like any other body (probe
+        endpoints speak JSON at 503 too). Synchronous by design (handlers
+        run it in a thread); pods are fetched concurrently — serial 2 s
+        timeouts against a rolling restart would cost replicas x 2 s per
+        request."""
         import json as _json
         import urllib.error
         import urllib.request
         from concurrent.futures import ThreadPoolExecutor
 
-        def _fetch(pod_base: tuple[str, str]) -> tuple[str, list]:
+        def _fetch(pod_base: tuple[str, str]) -> tuple[str, Any]:
             pod, base = pod_base
             try:
                 with urllib.request.urlopen(base + path, timeout=2) as resp:
-                    payload = _json.loads(resp.read())
+                    return pod, _json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                # the pod answered: a 503 probe body is a report, not an
+                # outage — read it. The read itself can still stall/fail
+                # (status line sent, body never arrives — the wedged-pod
+                # shape), so OSError here means unreachable too, never a
+                # 500 out of the aggregate route
+                try:
+                    return pod, _json.loads(e.read())
+                except (OSError, ValueError):
+                    log.debug("pod %s %s: unreadable %s body", pod, path, e.code)
+                    return pod, None
             except (urllib.error.URLError, OSError, ValueError) as e:
                 log.debug("pod %s %s unreachable: %s", pod, path, e)
-                return pod, []
-            return pod, payload if isinstance(payload, list) else []
+                return pod, None
 
         pods = sorted(self._pod_addresses(tenant, name).items())
         if not pods:
@@ -218,7 +237,8 @@ class KubernetesComputeRuntime:
         path = f"/traces/{trace_id}" if trace_id else "/traces"
         merged: list[dict[str, Any]] = []
         for _pod, chunk in self._pod_json_fanin(tenant, name, path):
-            merged.extend(chunk)
+            if isinstance(chunk, list):
+                merged.extend(chunk)
         if trace_id is None:
             # index entries are per-pod PARTIAL rollups of the same trace
             # (each agent pod buffered its own hop): merge them per
@@ -261,34 +281,79 @@ class KubernetesComputeRuntime:
         (one logical trace spans pods, so partial rollups merge), a flight
         entry is one engine on one pod — entries concatenate, each tagged
         with its pod so ``engine_top`` and operators can tell replicas
-        apart."""
+        apart. A pod whose fetch timed out appears as an ``unreachable``
+        member: during an incident the missing replica IS the signal, and
+        silently dropping it made the aggregate read healthy exactly when
+        a pod hung."""
         merged: list[dict[str, Any]] = []
         for pod, chunk in self._pod_json_fanin(tenant, name, "/flight"):
-            for entry in chunk:
+            if chunk is None:
+                merged.append({"pod": pod, "unreachable": True})
+                continue
+            for entry in chunk if isinstance(chunk, list) else []:
                 if isinstance(entry, dict):
                     merged.append({"pod": pod, **entry})
         return merged
 
-    def qos(self, tenant: str, name: str) -> dict[str, Any]:
-        """QoS status: fan in the pods' ``/flight/summary`` entries and
-        keep only the scheduler sections (per-class queued/admitted/shed/
-        preempted counters + tenant throttles), tagged per pod like
-        :meth:`flight` — the engine exposes no dedicated QoS endpoint by
-        design. The declared policy lives in the stored application (the
-        control plane serves it from the app files), so ``configured``
-        stays empty here."""
+    def _summary_section_fanin(
+        self, tenant: str, name: str, section: str
+    ) -> dict[str, Any]:
+        """Shared shape of the qos/slo aggregates: fan in the pods'
+        ``/flight/summary`` entries and keep one ``section`` per engine,
+        tagged per pod like :meth:`flight`; timed-out pods surface as
+        ``unreachable`` members. The declared policy lives in the stored
+        application (the control plane serves it from the app files), so
+        ``configured`` stays empty here — the dev-mode runtime fills
+        it."""
         engines: list[dict[str, Any]] = []
         for pod, chunk in self._pod_json_fanin(tenant, name, "/flight/summary"):
-            for entry in chunk:
+            if chunk is None:
+                engines.append({"pod": pod, "unreachable": True})
+                continue
+            for entry in chunk if isinstance(chunk, list) else []:
                 if isinstance(entry, dict):
                     engines.append(
                         {
                             "pod": pod,
                             "model": entry.get("model"),
-                            "scheduler": entry.get("scheduler"),
+                            section: entry.get(section),
                         }
                     )
         return {"configured": {}, "engines": engines}
+
+    def qos(self, tenant: str, name: str) -> dict[str, Any]:
+        """QoS status: the per-engine ``scheduler`` sections (per-class
+        queued/admitted/shed/preempted counters + tenant throttles) off
+        ``/flight/summary`` — the engine exposes no dedicated QoS
+        endpoint by design."""
+        return self._summary_section_fanin(tenant, name, "scheduler")
+
+    def health(self, tenant: str, name: str) -> dict[str, Any]:
+        """Fleet health: fan in the pods' ``/healthz`` verdicts (each a
+        dict — status + per-engine watchdog sections, runtime/pod.py) and
+        aggregate worst-state. Unreachable pods are first-class members,
+        ranked ``degraded`` for the aggregate: a pod that cannot answer
+        its own health probe may be restarting (routine) or hung (the
+        r03 shape) — the member entry carries the evidence either way,
+        and its own liveness probe is what escalates a hang to a
+        reschedule."""
+        from langstream_tpu.serving.health import worst_state
+
+        pods: list[dict[str, Any]] = []
+        states: list[str] = []
+        for pod, payload in self._pod_json_fanin(tenant, name, "/healthz"):
+            if not isinstance(payload, dict):
+                pods.append({"pod": pod, "unreachable": True})
+                states.append("degraded")
+                continue
+            pods.append({"pod": pod, **payload})
+            states.append(payload.get("status", "wedged"))
+        return {"status": worst_state(states), "pods": pods}
+
+    def slo(self, tenant: str, name: str) -> dict[str, Any]:
+        """SLO status: the per-engine ``slo`` sections (burn rates,
+        budget remaining, alerting objectives) off ``/flight/summary``."""
+        return self._summary_section_fanin(tenant, name, "slo")
 
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         """Agent CR specs + operator-written statuses."""

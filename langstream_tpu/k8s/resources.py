@@ -28,7 +28,7 @@ from typing import Any
 
 from langstream_tpu.k8s.crds import AgentCustomResource
 
-AGENT_PORT = 8080  # /metrics + /info (parity: AgentRunner.java:96-110)
+AGENT_PORT = 8080  # /metrics + /info + /healthz + /ready (runtime/pod.py)
 AGENT_SERVICE_PORT = 8790  # custom service agents (gateway agent-proxy target)
 COORDINATOR_PORT = 8476  # jax.distributed coordinator
 LOCKSTEP_PORT = 7077  # leader->follower step-descriptor channel (serving/lockstep.py)
@@ -291,10 +291,29 @@ class AgentResourcesFactory:
                     ],
                     "resources": resources,
                     "volumeMounts": volume_mounts,
+                    # readiness gates on the REAL serving surface
+                    # (runtime/pod.py /ready: agent init done, engines
+                    # warmed, nothing wedged) — /info answers 200 the
+                    # instant the HTTP server binds, before agents
+                    # initialize and forever after the device wedges, so
+                    # probing it routed traffic to pods that could not
+                    # serve (/info itself stays for the CLI)
                     "readinessProbe": {
-                        "httpGet": {"path": "/info", "port": AGENT_PORT},
+                        "httpGet": {"path": "/ready", "port": AGENT_PORT},
                         "initialDelaySeconds": 5,
                         "periodSeconds": 10,
+                    },
+                    # liveness fails only on a WEDGED engine (no step
+                    # progress while work is queued, serving/health.py):
+                    # ~3 failures x 10 s after the watchdog window a
+                    # wedged device finally gets the pod rescheduled.
+                    # initialDelay + the 60 s default wedge window keep
+                    # first-compile convoys from reading as death
+                    "livenessProbe": {
+                        "httpGet": {"path": "/healthz", "port": AGENT_PORT},
+                        "initialDelaySeconds": 30,
+                        "periodSeconds": 10,
+                        "failureThreshold": 3,
                     },
                 }
             ],

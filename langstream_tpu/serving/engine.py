@@ -67,6 +67,7 @@ from langstream_tpu.models.encoder import (
 )
 from langstream_tpu.models.tokenizer import Tokenizer, load_tokenizer
 from langstream_tpu.serving.flight import FlightRecorder
+from langstream_tpu.serving.health import EngineWatchdog, SloSpec, SloTracker
 from langstream_tpu.serving.profiling import ProfilerHooks
 from langstream_tpu.serving.qos import (
     PRIORITY_CLASSES,
@@ -222,6 +223,17 @@ class ServingConfig:
     # programs (frozen-slot bursts vs teardown/re-bucket), so near-tie
     # logits can flip — the same caveat model_dtype documents above.
     pipeline: bool = True
+    # engine watchdog (serving/health.py): the engine is declared WEDGED
+    # (liveness probe fails, k8s reschedules the pod) when no loop-boundary
+    # progress occurs for this many seconds while work is queued or in
+    # flight. Must exceed the worst single loop gap — on TPU the first XLA
+    # compile of a variant (tens of seconds); warmup-on-start pods, whose
+    # compiles land inside the readiness window, can run it much tighter.
+    wedge_window_s: float = 60.0
+    # SLO objectives (serving/health.py SloSpec): targets for TTFT /
+    # queue-wait quantiles, shed rate, and availability, evaluated
+    # engine-side with multi-window burn rates; None disables tracking
+    slo: SloSpec | None = None
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -262,6 +274,8 @@ class ServingConfig:
             "model-dtype": self.model_dtype,
             "qos": self.qos.to_dict() if self.qos is not None else None,
             "pipeline": self.pipeline,
+            "wedge-window-s": self.wedge_window_s,
+            "slo": self.slo.to_dict() if self.slo is not None else None,
         }
 
     @classmethod
@@ -322,6 +336,10 @@ class ServingConfig:
             ),
             qos=QosSpec.from_dict(d.get("qos")),
             pipeline=_parse_bool(d.get("pipeline", True)),
+            wedge_window_s=float(
+                d.get("wedge-window-s", d.get("wedge_window_s", 60.0))
+            ),
+            slo=SloSpec.from_dict(d.get("slo")),
         )
 
 
@@ -663,6 +681,31 @@ class TpuServingEngine:
         # discrete events; served by the pod /flight endpoints and the
         # engine_top console (serving/flight.py)
         self.flight = FlightRecorder(slots=config.slots)
+        # engine watchdog: heartbeat stamped at every flight boundary,
+        # judged (wait-free) by probes/stats via health() — the layer that
+        # turns a wedged device into a failed k8s liveness probe
+        self.watchdog = EngineWatchdog(wedge_window_s=config.wedge_window_s)
+        # SLO burn-rate tracker (None without a declared slo section):
+        # completions/sheds/failures recorded on the engine loop, burn
+        # rates surfaced via stats()/flight and the gauges below, `alert`
+        # flight events on fast-burn transitions
+        self.slo = SloTracker(config.slo) if config.slo is not None else None
+        self._m_slo_burn: dict[str, Any] = {}
+        self._m_slo_budget: dict[str, Any] = {}
+        if self.slo is not None:
+            for objective in config.slo.objectives:
+                self._m_slo_burn[objective.name] = reporter.gauge(
+                    f"slo_burn_rate_{objective.name}",
+                    f"fast-window error-budget burn rate for the "
+                    f"{objective.name} objective (1.0 = budget exhausts "
+                    f"exactly at the window's end)",
+                )
+                self._m_slo_budget[objective.name] = reporter.gauge(
+                    f"slo_budget_remaining_{objective.name}",
+                    f"slow-window error budget remaining for the "
+                    f"{objective.name} objective (1 - slow burn; negative "
+                    f"= overspent)",
+                )
         # shapes already compiled (jit-variant keys AND prefill bucket/row
         # shapes): a miss here is a fresh XLA compile — tens of seconds on
         # TPU, the event every recompile-storm diagnosis starts from
@@ -1410,6 +1453,8 @@ class TpuServingEngine:
             spec_rejected=spec_rejected,
             queue_by_class=depths,
         )
+        # watchdog heartbeat: a recorded dispatch IS step progress
+        self.watchdog.beat(sample["queue_depth"])
         if depths:
             for cls, gauge in self._m_class_depth.items():
                 gauge(depths.get(cls, 0))
@@ -1434,7 +1479,114 @@ class TpuServingEngine:
             kv_used=kv_used,
             queue_by_class=self.scheduler.depths(),
         )
+        # heartbeat on idle gaps too: an idle engine beats ~once a second,
+        # so queue-empty idleness can never read as a wedge
+        self.watchdog.beat(sample["queue_depth"])
         self._m_stall[reason](sample["wall_ms"] / 1000.0)
+
+    def _slo_record(self, objective: str, good: bool) -> None:
+        """Record one event against an SLO objective (engine loop only;
+        no-op without a declared spec or for undeclared objectives)."""
+        if self.slo is not None:
+            self._slo_emit(objective, self.slo.record(objective, good))
+
+    def _slo_record_latency(self, objective: str, seconds: float) -> None:
+        """Record a measured latency; the tracker judges it against the
+        objective's declared threshold (no-op when undeclared)."""
+        if self.slo is not None:
+            self._slo_emit(
+                objective, self.slo.record_latency(objective, seconds * 1000.0)
+            )
+
+    def _slo_emit(self, objective: str, verdict: dict | None) -> None:
+        """Mirror one SLO evaluation onto the burn/budget gauges and
+        emit an ``alert`` flight event when the multi-window fast-burn
+        condition transitions — alerts fire at record time, not scrape
+        time, so an unwatched engine still leaves the evidence in its
+        event ring."""
+        if verdict is None:
+            return
+        gauge = self._m_slo_burn.get(objective)
+        if gauge is not None:
+            gauge(verdict["burn_rate_fast"] or 0.0)
+        gauge = self._m_slo_budget.get(objective)
+        if gauge is not None:
+            gauge(verdict["budget_remaining"])
+        if verdict["transition"]:
+            self.flight.event(
+                "alert",
+                objective=objective,
+                state="firing" if verdict["alerting"] else "resolved",
+                burn_rate_fast=verdict["burn_rate_fast"],
+                burn_rate_slow=verdict["burn_rate_slow"],
+                budget_remaining=verdict["budget_remaining"],
+                target=verdict["target"],
+            )
+
+    def health(self) -> dict[str, Any]:
+        """Wait-free health snapshot (OBS504: callable from probe
+        handlers while the engine is wedged — snapshot reads and
+        arithmetic only, no device work, no locks). Judges the watchdog
+        heartbeat against the live queue/occupancy and runs the
+        degradation predicates over the flight window; a state
+        transition is recorded as a ``health`` flight event with the
+        stall evidence."""
+        queued = self.scheduler.qsize()
+        occupancy = sum(1 for s in self.slots if not s.free)
+        verdict = self.watchdog.evaluate(
+            queued=queued,
+            occupancy=occupancy,
+            samples=self.flight.recent(240),
+            events=self.flight.recent_events(64),
+            # a lockstep-broken engine stays registered but refuses all
+            # requests: only a pod restart recovers the slice, so it
+            # reports wedged and the liveness probe does the recycling
+            stopped=self._stop,
+        )
+        if verdict.pop("transition"):
+            self.flight.event(
+                "health",
+                state=verdict["state"],
+                previous=verdict["previous"],
+                reasons=list(verdict["reasons"]),
+                last_step_age_s=verdict["last_step_age_s"],
+                queued=queued,
+                occupancy=occupancy,
+            )
+        warmup = self._warmup_state()
+        ready = warmup not in ("pending", "running") and (
+            verdict["state"] != "wedged"
+        )
+        return {
+            "model": self.config.model,
+            "slots": self.config.slots,
+            **verdict,
+            "warmup": warmup,
+            "ready": ready,
+        }
+
+    def _warmup_state(self) -> str:
+        """``not-required`` (no warmup_on_start), ``pending`` (gate armed
+        but nothing triggered it yet), ``running``, ``done``, or
+        ``failed`` (done with an exception — serving continues on lazy
+        compiles, so failed still counts as warmed for readiness)."""
+        if not self.config.warmup_on_start:
+            return "not-required"
+        task = self._warmup_task
+        if task is None:
+            return "pending"
+        if not task.done():
+            return "running"
+        if task.cancelled() or task.exception() is not None:
+            return "failed"
+        return "done"
+
+    def slo_status(self) -> dict[str, Any] | None:
+        """The SLO section for ``stats()`` / ``/flight/summary`` (None
+        without a declared spec). Wait-free like :meth:`health`."""
+        if self.slo is None:
+            return None
+        return self.slo.status()
 
     @staticmethod
     def _sampler_mode(temps, topks, topps) -> tuple:
@@ -1568,7 +1720,13 @@ class TpuServingEngine:
             )
             if self._m_shed is not None:
                 self._m_shed(1)
+            if not _warmup_probe:
+                self._slo_record("shed-rate", False)
             raise
+        if not _warmup_probe:
+            # the shed-rate objective counts every submission: admitted =
+            # good, refused = bad (recorded in the except arm above)
+            self._slo_record("shed-rate", True)
         self._ensure_loop()
         self._wake.set()
         return await request.future
@@ -1660,7 +1818,12 @@ class TpuServingEngine:
             # running engine decompose where its dispatches go without a
             # bench run
             "steps": dict(self.flight.steps_by_phase),
+            # watchdog verdict + warmup/readiness posture (serving/health.py)
+            "health": self.health(),
         }
+        slo = self.slo_status()
+        if slo is not None:
+            out["slo"] = slo
         if self.block_mgr is not None:
             out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
         if self.config.speculative_drafts > 0:
@@ -1730,6 +1893,9 @@ class TpuServingEngine:
         # an idle deploy) must not be billed to the first sample as host
         # time — from here on the loop itself records every gap
         self.flight.mark()
+        # fresh heartbeat at loop start: the wedge window measures from
+        # here, not from engine construction
+        self.watchdog.beat(self.scheduler.qsize())
         while not self._stop:
             try:
                 if not self.scheduler.empty():
@@ -1829,6 +1995,8 @@ class TpuServingEngine:
             request = slot.request
             if request is not None and not request.future.done():
                 request.future.set_exception(error)
+                if not request.warmup:
+                    self._slo_record("availability", False)
             slot.request = None
             slot.prefilling = False
             slot.prefill_done = 0
@@ -1838,6 +2006,8 @@ class TpuServingEngine:
         for request in self.scheduler.drain():
             if not request.future.done():
                 request.future.set_exception(error)
+                if not request.warmup:
+                    self._slo_record("availability", False)
         self._pending_emits.clear()
         self._finished_requests.clear()
 
@@ -3182,6 +3352,13 @@ class TpuServingEngine:
                 self.request_timings.append(timing)
                 self._m_ttft_hist(timing["ttft"])
                 self._m_queue_wait_hist(timing["queue_wait"])
+                # SLO evidence (no-ops without a declared objective): a
+                # served request is availability-good, and the tracker
+                # judges the measured latencies against the declared
+                # thresholds
+                self._slo_record("availability", True)
+                self._slo_record_latency("ttft", timing["ttft"])
+                self._slo_record_latency("queue-wait", timing["queue_wait"])
             if request.trace is not None:
                 # materialize the request's phases as child spans from the
                 # timestamps above — no extra clocks in the decode loop,
@@ -3234,12 +3411,50 @@ def flight_report(
             # counts under QoS): included in /flight/summary too, so the
             # control-plane /qos route needs no extra engine surface
             "scheduler": engine.scheduler.stats(),
+            # watchdog verdict (serving/health.py): rides /flight/summary
+            # so the control-plane /health route and engine_top need no
+            # extra engine surface — and a saved dump self-diagnoses a
+            # wedge post mortem (engine_top --analyze)
+            "health": engine.health(),
         }
+        slo = engine.slo_status()
+        if slo is not None:
+            entry["slo"] = slo
         if not summary_only:
             entry["samples"] = engine.flight.recent(samples)
             entry["events"] = engine.flight.recent_events()
         report.append(entry)
     return report
+
+
+def health_report() -> list[dict[str, Any]]:
+    """Per-engine health verdicts for the pod's ``/healthz``/``/ready``
+    probes. Wait-free by contract (graftcheck OBS504): the instance map
+    is snapshotted WITHOUT ``_instances_lock`` — a liveness probe must
+    never queue behind an engine constructor/close holding it (the probe
+    runs exactly when the process is suspect), and a torn read of the
+    dict copy at worst reports an engine twice or a brand-new one not at
+    all, both harmless for a health poll."""
+    return [
+        engine.health() for engine in list(TpuServingEngine._instances.values())
+    ]
+
+
+def kick_warmups() -> None:
+    """Begin warmup for every ``warmup_on_start`` engine that hasn't
+    started it yet. The readiness probe calls this: a freshly scheduled
+    serving pod compiles its variants inside the not-ready window
+    instead of on the first real request, and ``/ready`` flips 200 only
+    once the warmup task completes. Task creation only — non-blocking
+    (OBS504); must run on the engines' event loop (in-pod there is one
+    loop)."""
+    for engine in list(TpuServingEngine._instances.values()):
+        if (
+            engine.config.warmup_on_start
+            and engine._warmup_task is None
+            and not engine._stop
+        ):
+            engine._warmup_begun()
 
 
 def profile_engines(action: str, trace_dir: str | None = None) -> dict[str, bool]:

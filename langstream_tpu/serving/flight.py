@@ -51,7 +51,10 @@ convoy-maker on TPU), ``pool-grow`` (decode-time KV block allocation),
 ``warmup``, ``preempt`` (a QoS preemption under KV pressure, or in-flight
 work failed — the ``reason`` field tells them apart), ``resume`` (a
 preempted request re-admitted), ``shed`` (a request refused by QoS
-policy: tenant throttle or full class queue), ``lockstep-divergence``.
+policy: tenant throttle or full class queue), ``lockstep-divergence``,
+``health`` (a watchdog state transition — ok/degraded/wedged, with the
+stall evidence; serving/health.py), and ``alert`` (an SLO objective's
+multi-window burn rate crossed the page threshold, or recovered).
 Under a QoS scheduler each sample additionally carries ``queue_by_class``
 (per-priority-class queue depths — what ``engine_top --analyze`` watches
 for sustained interactive-class growth).
@@ -278,6 +281,10 @@ class FlightRecorder:
                 "seq": self._seq,
                 # graftcheck: disable=OBS501 display anchor, never subtracted
                 "t_ms": round(time.time() * 1000.0, 3),
+                # monotonic stamp for the live health predicates
+                # (serving/health.py recompile_storm): recency judgments
+                # must survive NTP steps, which t_ms cannot
+                "m_s": round(time.monotonic(), 3),
                 "kind": kind,
                 **detail,
             }

@@ -370,7 +370,8 @@ pipeline:
 """
 
 # a real (tiny) TPU engine behind the agent — without the resource the
-# agent resolves the mock provider and no flight recorder exists
+# agent resolves the mock provider and no flight recorder exists; the
+# slo section exercises the declared-objective path end to end
 CONFIGURATION = """
 configuration:
   resources:
@@ -381,6 +382,13 @@ configuration:
         slots: 2
         max-seq-len: 128
         decode-chunk: 4
+        slo:
+          objectives:
+            availability:
+              target: 0.999
+            ttft:
+              target: 0.99
+              threshold-ms: 60000
 """
 
 GATEWAYS = """
@@ -495,6 +503,47 @@ def test_e2e_flight_via_pod_and_controlplane(run_async, monkeypatch):
             entry = cp_report[0]
             assert entry["summary"]["totals"]["steps_by_phase"]
             assert "samples" in entry  # dev-mode fan-in carries the window
+
+            # ... and the health/slo routes judge the same engines: the
+            # served request left a healthy watchdog verdict and SLO
+            # evidence (availability good, TTFT under its 60s threshold)
+            async with session.get(
+                f"{api}/api/applications/t1/flightapp/health"
+            ) as resp:
+                assert resp.status == 200
+                health = await resp.json()
+            assert health["status"] == "ok"
+            assert health["pods"], "dev mode reports in-process members"
+            engine_health = health["pods"][0]["engines"][0]
+            assert engine_health["state"] == "ok"
+            assert engine_health["ready"] is True
+            async with session.get(
+                f"{api}/api/applications/t1/flightapp/slo"
+            ) as resp:
+                assert resp.status == 200
+                slo = await resp.json()
+            assert "availability" in slo["configured"]["tpu"]["objectives"]
+            engine_slo = next(
+                e["slo"] for e in slo["engines"] if e["model"] == "tiny"
+            )
+            assert engine_slo["objectives"]["availability"]["window_good"] >= 1
+            assert engine_slo["alerting"] == []
+
+            # a malformed slo section fails the deploy with 400
+            bad = {
+                **payload,
+                "files": {
+                    **payload["files"],
+                    "configuration.yaml": CONFIGURATION.replace(
+                        "availability:", "uptime:"
+                    ),
+                },
+            }
+            async with session.post(
+                f"{api}/api/applications/t1/badslo", json=bad
+            ) as resp:
+                assert resp.status == 400
+                assert "slo" in (await resp.text())
 
             # an app this control plane never deployed reports nothing
             async with session.get(

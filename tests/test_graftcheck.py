@@ -750,6 +750,98 @@ def test_obs503_tn_append_only_recording_and_cold_paths():
 
 
 # --------------------------------------------------------------------------
+# OBS504 — health-check/watchdog paths must be wait-free
+# --------------------------------------------------------------------------
+
+
+def test_obs504_tp_device_sync_and_lock_in_health_module():
+    # every function in serving/health.py is policed: a device sync, a
+    # lock acquisition, and blocking I/O each fire
+    ids = rule_ids(
+        """
+        import jax
+
+        def judge(engine):
+            jax.block_until_ready(engine.last_logits)
+            with engine.dispatch_lock:
+                state = engine.state
+            with open("/var/run/health", "w") as f:
+                f.write(state)
+            return state
+        """,
+        path="langstream_tpu/serving/health.py",
+    )
+    assert ids == ["OBS504", "OBS504", "OBS504"]
+
+
+def test_obs504_tp_probe_handler_in_pod_and_engine_health_method():
+    # the pod probe handlers and the engine's health surface are policed
+    # by name; .item() is a device sync, .acquire() a lock
+    ids = rule_ids(
+        """
+        def _probe_healthz():
+            depth = queue_gauge.value.item()
+            return 200 if depth < 10 else 503
+        """,
+        path="langstream_tpu/runtime/pod.py",
+    )
+    assert ids == ["OBS504"]
+    ids = rule_ids(
+        """
+        class Engine:
+            def health(self):
+                self._instances_lock.acquire()
+                try:
+                    return {"state": self._state}
+                finally:
+                    self._instances_lock.release()
+        """,
+        path="langstream_tpu/serving/engine.py",
+    )
+    assert ids == ["OBS504"]
+
+
+def test_obs504_tn_snapshot_reads_and_out_of_scope_functions():
+    # the sanctioned shape — snapshot copies + arithmetic — stays silent,
+    # nested defs (deferred warmup tasks) are exempt, and the same sync
+    # outside a policed function/module doesn't fire
+    assert (
+        rule_ids(
+            """
+            def evaluate(engine, clock):
+                samples = list(engine.ring)
+                age = clock() - engine.last_step
+                hot = sum(1 for s in samples if (s.get("kv_used") or 0) > 0.95)
+                return "wedged" if age > 60 and engine.queued else "ok"
+
+            def kickoff(engine):
+                async def _warm():
+                    # deferred-task bodies may block/lock: the probe
+                    # only CREATES the task, it never runs this inline
+                    with engine.warmup_lock:
+                        engine.compile_variants()
+                    await engine.warmup()
+                return _warm
+            """,
+            path="langstream_tpu/serving/health.py",
+        )
+        == []
+    )
+    assert (
+        rule_ids(
+            """
+            import jax
+
+            def _fetch_chunk(self, packed):
+                return jax.block_until_ready(packed)
+            """,
+            path="langstream_tpu/serving/engine.py",
+        )
+        == []
+    )
+
+
+# --------------------------------------------------------------------------
 # QOS601 — unbounded asyncio.Queue in serving/ or gateway/
 # --------------------------------------------------------------------------
 

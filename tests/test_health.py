@@ -1,0 +1,882 @@
+"""Fleet health & SLO plane tests.
+
+Layers covered: the engine watchdog state machine on a fake clock
+(progress → WEDGED → recovery; no false WEDGED on queue-empty idle), the
+live degradation predicates (recompile storm / KV saturation / overlap
+collapse), SLO spec validation and multi-window burn-rate math, the pod
+``/healthz``/``/ready`` probes end to end — including the chaos
+acceptance: a wedge injected into the engine loop (steps stop, queue
+non-empty) flips ``/healthz`` unhealthy within the watchdog window,
+leaves a ``health`` flight event with the stall evidence, and ``/ready``
+recovers once the wedge clears — the k8s StatefulSet probe wiring, the
+control-plane fan-ins with ``unreachable`` pod tagging, and the
+``engine_top`` health/SLO rendering + wedged-device analyze flag.
+"""
+
+import asyncio
+import importlib.util
+import json
+import socket
+import time
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+from langstream_tpu.serving.health import (
+    EngineWatchdog,
+    SloSpec,
+    SloTracker,
+    kv_saturation,
+    overlap_collapse,
+    recompile_storm,
+    validate_application_slo,
+    worst_state,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _close_engines():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    with TpuServingEngine._instances_lock:
+        engines = list(TpuServingEngine._instances.values())
+    for engine in engines:
+        await engine.close()
+
+
+def _load_engine_top():
+    path = Path(__file__).resolve().parents[1] / "tools" / "engine_top.py"
+    spec = importlib.util.spec_from_file_location("engine_top", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# --------------------------------------------------------------------------
+# watchdog state machine (fake clock)
+# --------------------------------------------------------------------------
+
+
+def test_watchdog_progress_wedge_recovery_transitions():
+    clock = [0.0]
+    wd = EngineWatchdog(wedge_window_s=5.0, clock=lambda: clock[0])
+    assert wd.evaluate(queued=0, occupancy=0)["state"] == "ok"
+    # steps flowing: beats keep the age under the window
+    for t in (1.0, 2.0, 3.0):
+        clock[0] = t
+        wd.beat(queue_depth=2)
+    clock[0] = 7.0  # 4s since the last beat: inside the window
+    verdict = wd.evaluate(queued=2, occupancy=1)
+    assert verdict["state"] == "ok" and not verdict["transition"]
+    # steps stop while work is queued: WEDGED once the window passes
+    clock[0] = 9.5
+    verdict = wd.evaluate(queued=2, occupancy=1)
+    assert verdict["state"] == "wedged"
+    assert verdict["transition"] and verdict["previous"] == "ok"
+    assert "no step progress for 6.5s" in verdict["reasons"][0]
+    assert verdict["last_step_age_s"] == pytest.approx(6.5)
+    # still wedged on the next check — no duplicate transition
+    clock[0] = 10.0
+    verdict = wd.evaluate(queued=2, occupancy=1)
+    assert verdict["state"] == "wedged" and not verdict["transition"]
+    # the device comes back: a beat recovers the state machine
+    wd.beat(queue_depth=0)
+    verdict = wd.evaluate(queued=0, occupancy=0)
+    assert verdict["state"] == "ok"
+    assert verdict["transition"] and verdict["previous"] == "wedged"
+    assert wd.transitions == 2
+
+
+def test_watchdog_stopped_engine_reports_wedged():
+    """A stopped engine (lockstep group broken) refuses every request
+    until the pod restarts — the watchdog reports it wedged so the
+    liveness probe does the recycling."""
+    clock = [0.0]
+    wd = EngineWatchdog(wedge_window_s=5.0, clock=lambda: clock[0])
+    wd.beat(queue_depth=0)
+    verdict = wd.evaluate(queued=0, occupancy=0, stopped=True)
+    assert verdict["state"] == "wedged"
+    assert "stopped serving" in verdict["reasons"][0]
+
+
+def test_watchdog_no_false_wedge_on_idle():
+    """An idle engine (queue empty, nothing in flight, stale stamp) is
+    NOT wedged — there is no work to make progress on."""
+    clock = [0.0]
+    wd = EngineWatchdog(wedge_window_s=5.0, clock=lambda: clock[0])
+    wd.beat(queue_depth=0)
+    clock[0] = 3600.0  # an hour idle
+    assert wd.evaluate(queued=0, occupancy=0)["state"] == "ok"
+    # work queued at the LAST stamp counts as pending even if the live
+    # queue read races to zero (the stamp is the loop's own testimony)
+    wd.queue_at_stamp = 3
+    assert wd.evaluate(queued=0, occupancy=0)["state"] == "wedged"
+
+
+# --------------------------------------------------------------------------
+# degradation predicates: the --analyze heuristics, live
+# --------------------------------------------------------------------------
+
+
+def test_recompile_storm_predicate():
+    events = [
+        {"kind": "recompile", "m_s": t} for t in (100.0, 100.5, 101.0)
+    ]
+    assert recompile_storm(events, now_s=110.0) is not None
+    # spread out: no storm
+    spread = [{"kind": "recompile", "m_s": t} for t in (10.0, 50.0, 100.0)]
+    assert recompile_storm(spread, now_s=110.0) is None
+    # a storm that happened long ago is history, not degradation
+    assert recompile_storm(events, now_s=1000.0) is None
+    # old payloads without monotonic stamps never flag
+    assert recompile_storm([{"kind": "recompile"}] * 5, now_s=0.0) is None
+
+
+def test_kv_saturation_and_overlap_collapse_predicates():
+    hot = [{"kv_used": 0.99} for _ in range(10)]
+    assert kv_saturation(hot) is not None
+    cool = [{"kv_used": 0.5} for _ in range(10)]
+    assert kv_saturation(cool) is None
+    assert kv_saturation(hot[:4]) is None  # too few samples to judge
+
+    collapsed = [
+        {
+            "phase": "decode", "host_overlapped_ms": 0.0, "host_ms": 10.0,
+            "occupancy": 7, "slots": 8,
+        }
+        for _ in range(12)
+    ]
+    assert overlap_collapse(collapsed) is not None
+    # light load is exempt (sequential light-chunk regime by design)
+    light = [dict(s, occupancy=1) for s in collapsed]
+    assert overlap_collapse(light) is None
+    # healthy pipeline: most host time rides the device shadow
+    healthy = [dict(s, host_overlapped_ms=9.0, host_ms=1.0) for s in collapsed]
+    assert overlap_collapse(healthy) is None
+    # pre-pipeline samples never carried the split: absence != collapse
+    legacy = [
+        {"phase": "decode", "host_ms": 10.0, "occupancy": 7, "slots": 8}
+        for _ in range(12)
+    ]
+    assert overlap_collapse(legacy) is None
+
+
+def test_worst_state():
+    assert worst_state([]) == "ok"
+    assert worst_state(["ok", "degraded", "ok"]) == "degraded"
+    assert worst_state(["ok", "wedged", "degraded"]) == "wedged"
+    assert worst_state(["ok", "garbage"]) == "wedged"
+
+
+# --------------------------------------------------------------------------
+# SLO spec validation + burn-rate math
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "bad, msg",
+    [
+        ({"objectives": {}}, "non-empty"),
+        ({"objectives": {"latency": {"target": 0.99}}}, "unknown objective"),
+        (
+            {"objectives": {"ttft": {"target": 1.5, "threshold-ms": 100}}},
+            "target must be in",
+        ),
+        ({"objectives": {"ttft": {"target": 0.99}}}, "threshold-ms is required"),
+        (
+            {"objectives": {"availability": {"target": 0.99, "threshold-ms": 5}}},
+            "no threshold-ms",
+        ),
+        (
+            {
+                "objectives": {"availability": {"target": 0.99}},
+                "fast-window-s": 600,
+                "slow-window-s": 60,
+            },
+            "smaller than",
+        ),
+        (
+            {"objectives": {"availability": {"target": 0.99}}, "fast-burn": 0.5},
+            "must be > 1",
+        ),
+        ("fast", "must be a mapping"),
+    ],
+)
+def test_slo_spec_validation_rejects(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        SloSpec.from_dict(bad)
+
+
+def test_slo_spec_roundtrip_and_config_hashability():
+    from langstream_tpu.serving.engine import ServingConfig
+
+    spec = SloSpec.from_dict(
+        {
+            "objectives": {
+                "ttft": {"target": 0.99, "threshold-ms": 2000},
+                "shed-rate": {"target": 0.95},
+            },
+            "fast-window-s": 60,
+            "slow-window-s": 600,
+        }
+    )
+    assert SloSpec.from_dict(spec.to_dict()) == spec
+    config = ServingConfig.from_dict(
+        {"model": "tiny", "slo": spec.to_dict(), "wedge-window-s": 12}
+    )
+    assert config.slo == spec and config.wedge_window_s == 12.0
+    hash(config)  # engines are singleton-cached by config
+    assert ServingConfig.from_dict(config.to_dict()) == config
+
+
+def test_validate_application_slo():
+    class _Res:
+        type = "tpu-serving-configuration"
+        configuration = {"slo": {"objectives": {"bogus": {"target": 0.9}}}}
+
+    class _App:
+        resources = {"tpu": _Res()}
+
+    with pytest.raises(ValueError, match="tpu.*invalid slo"):
+        validate_application_slo(_App())
+    _Res.configuration = {"slo": None}
+    validate_application_slo(_App())  # missing section is fine
+
+
+def test_slo_burn_rate_multi_window_math():
+    """Burn = (bad fraction) / (1 − target), per window; the fast window
+    forgets what scrolled out of it while the slow window remembers."""
+    spec = SloSpec.from_dict(
+        {
+            "objectives": {"availability": {"target": 0.99}},
+            "fast-window-s": 60,
+            "slow-window-s": 600,
+        }
+    )
+    clock = [1000.0]
+    tracker = SloTracker(spec, clock=lambda: clock[0])
+    # 90 good + 10 bad → bad fraction 0.1 → burn 10x against a 1% budget
+    for i in range(100):
+        verdict = tracker.record("availability", good=(i % 10 != 0))
+    assert verdict["burn_rate_fast"] == pytest.approx(10.0)
+    assert verdict["burn_rate_slow"] == pytest.approx(10.0)
+    assert verdict["budget_remaining"] == pytest.approx(1.0 - 10.0)
+    # two minutes later the fast window is clean, the slow one is not
+    clock[0] = 1130.0
+    for _ in range(100):
+        verdict = tracker.record("availability", good=True)
+    assert verdict["burn_rate_fast"] == pytest.approx(0.0)
+    assert verdict["burn_rate_slow"] == pytest.approx(5.0)  # 10/200 / 0.01
+    # undeclared objectives are a no-op, never an error
+    assert tracker.record("ttft", good=False) is None
+    assert tracker.record_latency("ttft", 9999.0) is None
+    status = tracker.status()
+    assert set(status["objectives"]) == {"availability"}
+    assert status["objectives"]["availability"]["total_bad"] == 10
+    json.dumps(status)  # the /slo route serves this verbatim
+
+
+def test_slo_alert_fires_on_both_windows_and_resolves():
+    spec = SloSpec.from_dict(
+        {
+            "objectives": {"availability": {"target": 0.5}},
+            "fast-window-s": 60,
+            "slow-window-s": 600,
+            "fast-burn": 1.5,
+        }
+    )
+    clock = [0.0]
+    tracker = SloTracker(spec, clock=lambda: clock[0])
+    verdict = tracker.record("availability", good=True)
+    assert not verdict["alerting"]
+    # all-bad: burn 2.0 on both windows ≥ fast_burn 1.5 → page
+    for _ in range(10):
+        verdict = tracker.record("availability", good=False)
+    assert verdict["alerting"]
+    # exactly one transition on the crossing record
+    assert tracker.alerting["availability"]
+    # a clean fast window resolves the alert even while the slow window
+    # still remembers the incident (multi-window: page only while it is
+    # STILL happening)
+    clock[0] = 120.0
+    for _ in range(50):
+        verdict = tracker.record("availability", good=True)
+    assert not verdict["alerting"]
+    assert verdict["burn_rate_slow"] > 0
+
+
+def test_slo_record_latency_judges_against_the_declared_threshold():
+    """Callers report what they measured; the tracker owns the good/bad
+    line (the threshold lives with the spec, nowhere else)."""
+    spec = SloSpec.from_dict(
+        {"objectives": {"ttft": {"target": 0.9, "threshold-ms": 100}}}
+    )
+    tracker = SloTracker(spec, clock=lambda: 0.0)
+    tracker.record_latency("ttft", 80.0)    # within threshold → good
+    tracker.record_latency("ttft", 250.0)   # over → bad
+    totals = tracker.totals["ttft"]
+    assert totals == {"good": 1, "bad": 1}
+    # rate objectives take no latency — no-op, never a crash
+    rate_spec = SloSpec.from_dict(
+        {"objectives": {"availability": {"target": 0.9}}}
+    )
+    assert SloTracker(rate_spec).record_latency("availability", 5.0) is None
+
+
+def test_slo_status_read_path_never_swallows_transitions():
+    """status() is a read: a scrape landing between the condition
+    changing and the next record must not consume the transition edge —
+    the next record still emits it (the alert-evidence contract)."""
+    spec = SloSpec.from_dict(
+        {
+            "objectives": {"availability": {"target": 0.5}},
+            "fast-window-s": 60,
+            "slow-window-s": 600,
+            "fast-burn": 1.5,
+        }
+    )
+    clock = [0.0]
+    tracker = SloTracker(spec, clock=lambda: clock[0])
+    for _ in range(10):
+        verdict = tracker.record("availability", good=False)
+    assert verdict["alerting"] and tracker.alerting["availability"]
+    # the fast window drains; a status() poll sees the live resolution...
+    clock[0] = 120.0
+    status = tracker.status()
+    assert status["alerting"] == []
+    assert not status["objectives"]["availability"]["alerting"]
+    # ...but does NOT commit it: the next record still reports the edge,
+    # so the 'resolved' alert event lands in the ring
+    verdict = tracker.record("availability", good=True)
+    assert verdict["transition"] and not verdict["alerting"]
+
+
+# --------------------------------------------------------------------------
+# engine integration: health/slo sections + alert flight events
+# --------------------------------------------------------------------------
+
+
+def test_engine_stats_health_and_slo_sections(run_async):
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=64, decode_chunk=4,
+                slo=SloSpec.from_dict(
+                    {
+                        "objectives": {
+                            "ttft": {"target": 0.5, "threshold-ms": 60000},
+                            "availability": {"target": 0.5},
+                            "shed-rate": {"target": 0.5},
+                        },
+                        # 1 good + 10 bad → burn 1.82 against the 0.5
+                        # budget: above 1.5, so the forced burst pages
+                        "fast-burn": 1.5,
+                    }
+                ),
+            )
+        )
+        try:
+            await engine.generate("slo probe", {"max-tokens": 4})
+            stats = engine.stats()
+            health = stats["health"]
+            assert health["state"] == "ok" and health["ready"]
+            assert health["warmup"] == "not-required"
+            slo = stats["slo"]
+            # the served request recorded: shed-rate good (admitted),
+            # availability good, ttft judged against its 60s threshold
+            assert slo["objectives"]["availability"]["window_good"] >= 1
+            assert slo["objectives"]["shed-rate"]["window_good"] >= 1
+            assert slo["objectives"]["ttft"]["total_good"] >= 1
+            assert slo["alerting"] == []
+            # force a fast burn: availability all-bad → alert flight event
+            for _ in range(10):
+                engine._slo_record("availability", False)
+            assert engine.stats()["slo"]["alerting"] == ["availability"]
+            alerts = [
+                e for e in engine.flight.recent_events(0)
+                if e["kind"] == "alert"
+            ]
+            assert alerts and alerts[-1]["state"] == "firing"
+            assert alerts[-1]["objective"] == "availability"
+            # flight_report carries the same sections for the fan-ins
+            from langstream_tpu.serving.engine import flight_report
+
+            entry = next(
+                e
+                for e in flight_report(summary_only=True)
+                if e["model"] == "tiny"
+            )
+            assert entry["health"]["state"] == "ok"
+            assert entry["slo"]["alerting"] == ["availability"]
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# pod probes: the chaos acceptance e2e
+# --------------------------------------------------------------------------
+
+
+def test_chaos_wedge_flips_probes_and_records_health_event(
+    run_async, monkeypatch
+):
+    """The acceptance chaos test: inject a wedge into the engine loop
+    (dispatches stop while the queue holds work) and assert /healthz
+    flips unhealthy within the watchdog window, the health flight event
+    records the transition with the stall evidence, and /ready recovers
+    after the wedge clears. The checker itself performs zero device work
+    — enforced statically by graftcheck OBS504 over serving/health.py
+    and the probe handlers, and dynamically here: the probes answer
+    while the engine loop is provably stuck."""
+    from langstream_tpu.runtime.pod import PodHealth, _serve_info
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        await _close_engines()  # foreign engines must not gate readiness
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=64, decode_chunk=4,
+                wedge_window_s=0.3,
+            )
+        )
+        port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(port))
+        health = PodHealth()
+        health.agent_ready = True
+        server = await _serve_info(None, health=health)
+        session = aiohttp.ClientSession()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # healthy baseline: a request completes, both probes 200
+            await engine.generate("healthy probe", {"max-tokens": 2})
+            async with session.get(f"{base}/healthz") as resp:
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "ok"
+            async with session.get(f"{base}/ready") as resp:
+                assert resp.status == 200
+                assert (await resp.json())["ready"] is True
+
+            # inject the wedge: admission blocks on a gate, so the loop
+            # makes no progress while the new request sits queued
+            gate = asyncio.Event()
+            real_admit = engine._admit
+
+            async def wedged_admit(loop):
+                await gate.wait()
+                await real_admit(loop)
+
+            monkeypatch.setattr(engine, "_admit", wedged_admit)
+            stuck = asyncio.ensure_future(
+                engine.generate("stuck request", {"max-tokens": 2})
+            )
+            # /healthz must flip within the watchdog window (0.3s) plus
+            # polling slack
+            deadline = time.monotonic() + 10.0
+            status, body = 200, {}
+            while time.monotonic() < deadline:
+                async with session.get(f"{base}/healthz") as resp:
+                    status = resp.status
+                    body = await resp.json()
+                if status == 503:
+                    break
+                await asyncio.sleep(0.05)
+            assert status == 503, body
+            assert body["status"] == "wedged"
+            assert body["wedged"] == ["tiny"]
+            # the transition event carries the stall evidence
+            events = [
+                e for e in engine.flight.recent_events(0)
+                if e["kind"] == "health" and e["state"] == "wedged"
+            ]
+            assert events, "wedge transition must land in the event ring"
+            evidence = events[-1]
+            assert evidence["queued"] + evidence["occupancy"] >= 1
+            assert evidence["last_step_age_s"] > 0.3
+            assert "no step progress" in evidence["reasons"][0]
+            async with session.get(f"{base}/ready") as resp:
+                assert resp.status == 503
+                blockers = (await resp.json())["blockers"]
+                assert any(b == "engine:tiny:wedged" for b in blockers)
+
+            # clear the wedge: the stuck request completes and both
+            # probes recover
+            gate.set()
+            result = await asyncio.wait_for(stuck, timeout=60)
+            assert result["tokens"]
+            async with session.get(f"{base}/healthz") as resp:
+                assert resp.status == 200
+            async with session.get(f"{base}/ready") as resp:
+                assert resp.status == 200
+            recoveries = [
+                e for e in engine.flight.recent_events(0)
+                if e["kind"] == "health" and e["state"] == "ok"
+            ]
+            assert recoveries and recoveries[-1]["previous"] == "wedged"
+        finally:
+            await session.close()
+            server.close()
+            await engine.close()
+
+    run_async(main())
+
+
+def test_ready_gates_on_warmup_and_kicks_it(run_async, monkeypatch):
+    """A warmup-on-start engine is not ready until its variants exist;
+    the readiness probe itself kicks the warmup so a freshly scheduled
+    pod compiles inside the not-ready window and flips 200 when done."""
+    from langstream_tpu.runtime.pod import PodHealth, _serve_info
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        await _close_engines()
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=64, decode_chunk=4,
+                warmup_on_start=True,
+            )
+        )
+        port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(port))
+        health = PodHealth()
+        health.agent_ready = True
+        server = await _serve_info(None, health=health)
+        session = aiohttp.ClientSession()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with session.get(f"{base}/ready") as resp:
+                assert resp.status == 503
+                body = await resp.json()
+            assert any(
+                b.startswith("engine:tiny:warmup") for b in body["blockers"]
+            )
+            # ... but liveness is fine: warming up is not wedged
+            async with session.get(f"{base}/healthz") as resp:
+                assert resp.status == 200
+            # the probe kicked warmup; polling alone reaches readiness
+            deadline = time.monotonic() + 120.0
+            status = 503
+            while time.monotonic() < deadline:
+                async with session.get(f"{base}/ready") as resp:
+                    status = resp.status
+                if status == 200:
+                    break
+                await asyncio.sleep(0.25)
+            assert status == 200
+            assert engine._warmup_task is not None
+            assert engine._warmup_task.done()
+            assert engine.health()["warmup"] == "done"
+        finally:
+            await session.close()
+            server.close()
+            await engine.close()
+
+    run_async(main())
+
+
+def test_probe_ready_gates_on_agent_init(run_async):
+    from langstream_tpu.runtime.pod import PodHealth, _probe_ready
+
+    async def main():
+        await _close_engines()
+        health = PodHealth()
+        status, body = _probe_ready(health)
+        assert status == 503 and body["blockers"] == ["agent-init"]
+        health.agent_ready = True
+        status, body = _probe_ready(health)
+        assert status == 200 and body["ready"] is True
+        # no gate object (follower pods, bare test servers): ready
+        status, _body = _probe_ready(None)
+        assert status == 200
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# k8s wiring: StatefulSet probes + fan-in unreachable tagging
+# --------------------------------------------------------------------------
+
+
+def test_statefulset_probes_target_health_endpoints():
+    from langstream_tpu.k8s.crds import (
+        AgentCustomResource,
+        AgentResourcesCR,
+        AgentSpec,
+    )
+    from langstream_tpu.k8s.resources import AgentResourcesFactory
+
+    cr = AgentCustomResource(
+        name="myapp-step1",
+        namespace="langstream-t1",
+        spec=AgentSpec(
+            tenant="t1",
+            application_id="myapp",
+            agent_id="step1",
+            image="img",
+            agent_config_secret_ref="cfg",
+            agent_config_secret_ref_checksum="abc",
+            resources=AgentResourcesCR(parallelism=1),
+        ),
+    )
+    sts = AgentResourcesFactory.generate_statefulsets(cr)[0]
+    container = sts["spec"]["template"]["spec"]["containers"][0]
+    # readiness gates on the real serving surface, not HTTP-bind
+    assert container["readinessProbe"]["httpGet"]["path"] == "/ready"
+    # liveness reschedules a wedged device
+    liveness = container["livenessProbe"]
+    assert liveness["httpGet"]["path"] == "/healthz"
+    assert liveness["failureThreshold"] == 3
+
+
+def test_k8s_fanin_marks_unreachable_pods():
+    """The satellite fix: a pod whose fetch times out is an
+    ``unreachable`` member of every aggregate — flight, qos, health,
+    slo — never a silent omission."""
+    from langstream_tpu.k8s.compute import KubernetesComputeRuntime
+
+    def fanin(tenant, name, path):
+        if path == "/healthz":
+            return [
+                ("app-0", {"status": "ok", "wedged": [], "engines": []}),
+                ("app-1", None),
+            ]
+        return [
+            ("app-0", [
+                {"model": "tiny", "summary": {}, "scheduler": {},
+                 "slo": {"alerting": []}},
+            ]),
+            ("app-1", None),
+        ]
+
+    runtime = KubernetesComputeRuntime.__new__(KubernetesComputeRuntime)
+    runtime._pod_json_fanin = fanin
+
+    flight = runtime.flight("t", "a")
+    assert {"pod": "app-1", "unreachable": True} in flight
+    assert any(e.get("model") == "tiny" for e in flight)
+
+    qos = runtime.qos("t", "a")
+    assert {"pod": "app-1", "unreachable": True} in qos["engines"]
+
+    slo = runtime.slo("t", "a")
+    assert {"pod": "app-1", "unreachable": True} in slo["engines"]
+    reachable = next(e for e in slo["engines"] if e.get("model") == "tiny")
+    assert reachable["slo"] == {"alerting": []}
+
+    health = runtime.health("t", "a")
+    assert {"pod": "app-1", "unreachable": True} in health["pods"]
+    # one unreachable pod degrades the aggregate without crying wolf
+    assert health["status"] == "degraded"
+
+    wedged = KubernetesComputeRuntime.__new__(KubernetesComputeRuntime)
+    wedged._pod_json_fanin = lambda t, n, p: [
+        ("app-0", {"status": "wedged", "wedged": ["tiny"]})
+    ]
+    assert wedged.health("t", "a")["status"] == "wedged"
+
+
+def test_pod_json_fanin_returns_none_for_unreachable_and_parses_503(
+    run_async, monkeypatch
+):
+    """The transport layer itself: a dead address yields ``None`` (not
+    an empty list), and a pod answering 503 with a JSON body — the probe
+    endpoints' not-ready shape — still parses as a report."""
+    from langstream_tpu.k8s.compute import KubernetesComputeRuntime
+    from langstream_tpu.runtime.pod import PodHealth, _serve_info
+
+    async def main():
+        await _close_engines()
+        port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(port))
+        health = PodHealth()  # agent_ready False → /ready answers 503
+        server = await _serve_info(None, health=health)
+        rt = KubernetesComputeRuntime.__new__(KubernetesComputeRuntime)
+        dead = free_port()
+        rt._pod_addresses = lambda t, n: {
+            "up-0": f"http://127.0.0.1:{port}",
+            "down-0": f"http://127.0.0.1:{dead}",
+        }
+        try:
+            result = dict(
+                await asyncio.to_thread(rt._pod_json_fanin, "t", "a", "/ready")
+            )
+            assert result["down-0"] is None
+            assert result["up-0"]["ready"] is False  # 503 body, parsed
+            assert result["up-0"]["blockers"] == ["agent-init"]
+        finally:
+            server.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# control-plane dev-mode scoping
+# --------------------------------------------------------------------------
+
+
+def _runner_with(resources):
+    class _Resource:
+        def __init__(self, rtype, configuration):
+            self.type = rtype
+            self.configuration = configuration
+
+    class _App:
+        pass
+
+    class _Runner:
+        pass
+
+    _Runner.application = _App()
+    _Runner.application.resources = {
+        name: _Resource(*spec) for name, spec in resources.items()
+    }
+    return _Runner()
+
+
+def test_dev_health_and_slo_scoped_to_declared_models(monkeypatch):
+    import langstream_tpu.serving.engine as engine_mod
+    from langstream_tpu.controlplane.server import LocalComputeRuntime
+
+    monkeypatch.setattr(
+        engine_mod,
+        "health_report",
+        lambda: [
+            {"model": "tiny", "state": "wedged", "ready": False},
+            {"model": "llama-1b", "state": "ok", "ready": True},
+        ],
+    )
+    monkeypatch.setattr(
+        engine_mod,
+        "flight_report",
+        lambda **kw: [
+            {"model": "tiny", "summary": {}, "slo": {"alerting": ["ttft"]}},
+            {"model": "llama-1b", "summary": {}},
+        ],
+    )
+    compute = LocalComputeRuntime()
+    compute.runners[("t", "app")] = _runner_with(
+        {
+            "tpu": (
+                "tpu-serving-configuration",
+                {"model": "tiny", "slo": {"objectives": {}}},
+            )
+        }
+    )
+    health = compute.health("t", "app")
+    assert health["status"] == "wedged"
+    assert [p["engines"][0]["model"] for p in health["pods"]] == ["tiny"]
+    # the sibling model's engine never leaks into this app's view
+    assert all(
+        e["model"] == "tiny" for p in health["pods"] for e in p["engines"]
+    )
+    slo = compute.slo("t", "app")
+    assert list(slo["configured"]) == ["tpu"]
+    assert [e["model"] for e in slo["engines"]] == ["tiny"]
+    assert slo["engines"][0]["slo"]["alerting"] == ["ttft"]
+    # undeployed app: empty, never an error
+    assert compute.health("t", "ghost") == {"status": "ok", "pods": []}
+    assert compute.slo("t", "ghost") == {"configured": {}, "engines": []}
+
+
+# --------------------------------------------------------------------------
+# engine_top: health/SLO panels + the wedged-device analyze flag
+# --------------------------------------------------------------------------
+
+
+def _wedged_entry() -> dict:
+    return {
+        "model": "llama3-8b",
+        "slots": 64,
+        "health": {
+            "model": "llama3-8b",
+            "state": "wedged",
+            "reasons": [
+                "no step progress for 151.2s (window 60.0s) with 9 queued "
+                "and 12 in flight"
+            ],
+            "last_step_age_s": 151.2,
+            "queued": 9,
+            "occupancy": 12,
+            "wedge_window_s": 60.0,
+            "warmup": "done",
+            "ready": False,
+        },
+        "slo": {
+            "fast_window_s": 300.0,
+            "slow_window_s": 3600.0,
+            "fast_burn": 14.4,
+            "alerting": ["availability"],
+            "objectives": {
+                "availability": {
+                    "target": 0.999,
+                    "burn_rate_fast": 80.0,
+                    "burn_rate_slow": 22.5,
+                    "budget_remaining": -21.5,
+                    "alerting": True,
+                },
+                "ttft": {
+                    "target": 0.99,
+                    "threshold_ms": 2000,
+                    "burn_rate_fast": 0.4,
+                    "burn_rate_slow": 0.2,
+                    "budget_remaining": 0.8,
+                    "alerting": False,
+                },
+            },
+        },
+        "summary": {
+            "totals": {
+                "wall_ms": 4800.0, "device_ms": 2952.0, "host_ms": 1608.0,
+                "stall_ms": 240.0, "tokens": 7680,
+                "steps_by_phase": {"decode": 110},
+            },
+            "window": {"tok_s": 1600.0, "step_ms_p50": 40.0},
+        },
+        "samples": [],
+        "events": [],
+    }
+
+
+def test_engine_top_renders_health_and_slo_panels():
+    engine_top = _load_engine_top()
+    frame = engine_top.render([_wedged_entry()])
+    assert "health   WEDGED" in frame
+    assert "no step progress for 151.2s" in frame
+    assert "slo      availability" in frame
+    assert "ALERT" in frame
+    assert "budget -2150.0%" in frame
+    # unreachable fan-in members render as the loudest line on screen
+    frame = engine_top.render([{"pod": "app-3", "unreachable": True}])
+    assert "UNREACHABLE" in frame
+    # payloads without health/slo sections render unchanged
+    assert "health" not in engine_top.render(
+        [{"model": "m", "summary": {}, "samples": [], "events": []}]
+    )
+
+
+def test_engine_top_analyze_flags_wedged_device_and_slo_burn(tmp_path):
+    engine_top = _load_engine_top()
+    text = engine_top.analyze([_wedged_entry()])
+    assert "wedged device" in text
+    assert "no step progress for 151.2s" in text
+    assert "liveness probe" in text
+    assert "SLO fast burn on 'availability'" in text
+    # a healthy dump stays unflagged on the health axis
+    healthy = _wedged_entry()
+    healthy["health"].update(
+        {"state": "ok", "reasons": [], "last_step_age_s": 0.4, "queued": 0,
+         "occupancy": 12}
+    )
+    healthy["slo"]["alerting"] = []
+    text = engine_top.analyze([healthy])
+    assert "wedged device" not in text
+    assert "SLO fast burn" not in text

@@ -791,19 +791,20 @@ def test_k8s_qos_fanin_tags_pods():
     /flight/summary — no dedicated engine endpoint needed."""
     from langstream_tpu.k8s.compute import KubernetesComputeRuntime
 
-    class _Stub:
-        def _pod_json_fanin(self, tenant, name, path):
-            assert path == "/flight/summary"
-            return [
-                (
-                    "app-chat-0",
-                    [{"model": "tiny", "summary": {},
-                      "scheduler": {"policy": "qos", "shed": 3}}],
-                ),
-                ("app-chat-1", ["junk"]),
-            ]
+    def fanin(tenant, name, path):
+        assert path == "/flight/summary"
+        return [
+            (
+                "app-chat-0",
+                [{"model": "tiny", "summary": {},
+                  "scheduler": {"policy": "qos", "shed": 3}}],
+            ),
+            ("app-chat-1", ["junk"]),
+        ]
 
-    report = KubernetesComputeRuntime.qos(_Stub(), "t", "app")
+    runtime = KubernetesComputeRuntime.__new__(KubernetesComputeRuntime)
+    runtime._pod_json_fanin = fanin
+    report = runtime.qos("t", "app")
     assert report["engines"] == [
         {"pod": "app-chat-0", "model": "tiny",
          "scheduler": {"policy": "qos", "shed": 3}},

@@ -4,13 +4,18 @@
 Live mode polls a pod's ``/flight`` endpoint (or the control plane's
 ``/api/applications/{tenant}/{name}/flight`` fan-in — any URL returning the
 flight report shape works) and renders a one-screen view per engine:
-occupancy bar, tok/s, a step-time sparkline, the device/host/stall
+occupancy bar, tok/s, a step-time sparkline, the engine watchdog's
+health verdict (ok/DEGRADED/WEDGED with its stall evidence,
+serving/health.py) and the SLO burn panel (per-objective fast/slow burn
+rates + budget remaining, ALERT on fast burn), the device/host/stall
 decomposition with the pipelined loop's overlapped-vs-exposed host split
 (``overlap_ratio``), admission-stall breakdown by reason, KV-pool
 utilization,
 the QoS scheduler state (per-class queue depths, per-tenant throttle
 counts, shed/preempt tallies plus their event tail), and the
 discrete-event tail (recompiles, pool growth, warmup, preemptions).
+Control-plane fan-ins mark timed-out pods ``UNREACHABLE`` instead of
+omitting them.
 
     python tools/engine_top.py                          # localhost:8080
     python tools/engine_top.py --url http://pod:8080/flight --interval 2
@@ -20,8 +25,11 @@ Post-mortem mode decomposes a saved dump — either a raw ``/flight``
 payload (``curl pod:8080/flight > dump.json``) or a bench record whose
 ``flight`` rollup rode along (BENCH_r06+) — into mean-step device/host/
 stall shares and flags anomaly windows: recompile storms, KV-pool
-exhaustion, unbounded queue growth, and pipeline overlap collapse
-(sustained ``overlap_ratio`` near 0 while occupancy is high).
+exhaustion, unbounded queue growth, pipeline overlap collapse
+(sustained ``overlap_ratio`` near 0 while occupancy is high), the
+wedged-device flag (no step progress while work is queued — the r03
+hang shape, read from the dump's ``health`` section), and SLO
+objectives in fast burn.
 
     python tools/engine_top.py --analyze dump.json
     python tools/engine_top.py --analyze BENCH_r06.json
@@ -85,6 +93,12 @@ def render(report: list[dict]) -> str:
     if not report:
         return "no live engines (has the first request arrived yet?)"
     for entry in report:
+        if entry.get("unreachable"):
+            # control-plane fan-in marker: the pod timed out — the most
+            # important line on the screen during an incident
+            lines.append(f"== pod {entry.get('pod', '?')} UNREACHABLE ==")
+            lines.append("")
+            continue
         summary = entry.get("summary", {})
         totals = summary.get("totals", {})
         window = summary.get("window", {})
@@ -121,6 +135,8 @@ def render(report: list[dict]) -> str:
                 f"overlap "
                 + (f"{100 * ratio:.1f}%" if ratio is not None else "-")
             )
+        lines.extend(_render_health(entry.get("health")))
+        lines.extend(_render_slo(entry.get("slo")))
         wall, device_pct, host_pct, stall_pct = _shares(totals)
         lines.append(
             f"decomp   device {device_pct:.1f}%  host {host_pct:.1f}%  "
@@ -169,6 +185,52 @@ def render(report: list[dict]) -> str:
             lines.append(f"event    {event.get('kind')} {detail}")
         lines.append("")
     return "\n".join(lines).rstrip()
+
+
+def _render_health(health: dict | None) -> list[str]:
+    """Watchdog panel: state (upper-cased when not ok so a wedge jumps
+    off the screen), last-step age vs the wedge window, queued/in-flight
+    work, warmup posture, and the degradation reasons. Absent on
+    pre-health payloads."""
+    if not health:
+        return []
+    state = health.get("state", "?")
+    shown = state if state == "ok" else state.upper()
+    line = (
+        f"health   {shown}  last step "
+        f"{health.get('last_step_age_s', 0):.1f}s ago "
+        f"(window {health.get('wedge_window_s', 0):g}s)  "
+        f"queued {health.get('queued', 0)}  "
+        f"in-flight {health.get('occupancy', 0)}"
+    )
+    warmup = health.get("warmup")
+    if warmup and warmup != "not-required":
+        line += f"  warmup {warmup}"
+    lines = [line]
+    for reason in health.get("reasons") or []:
+        lines.append(f"         ! {reason}")
+    return lines
+
+
+def _render_slo(slo: dict | None) -> list[str]:
+    """SLO burn panel: per objective, the fast/slow-window burn rates
+    and the remaining slow-window budget; alerting objectives are
+    flagged. Absent when the app declared no slo section."""
+    if not slo or not slo.get("objectives"):
+        return []
+    lines = []
+    for name, obj in slo["objectives"].items():
+        fast = obj.get("burn_rate_fast")
+        slow = obj.get("burn_rate_slow")
+        budget = obj.get("budget_remaining")
+        lines.append(
+            f"slo      {name:13s} burn "
+            f"{fast if fast is not None else '-'}/"
+            f"{slow if slow is not None else '-'} (fast/slow)  budget "
+            + (f"{100 * budget:.1f}%" if budget is not None else "-")
+            + ("  ALERT" if obj.get("alerting") else "")
+        )
+    return lines
 
 
 def _render_scheduler(scheduler: dict | None, events: list[dict]) -> list[str]:
@@ -342,6 +404,37 @@ def _anomalies(entry: dict) -> list[str]:
     collapse = _overlap_collapse(entry, summary, totals, samples)
     if collapse:
         flags.append(collapse)
+    # wedged device (the r03 hang shape): the health section a /flight
+    # dump carries self-diagnoses — no step progress while work was
+    # queued/in flight. Flag on the recorded verdict, and re-derive from
+    # the evidence too (a dump captured with a generous window still
+    # shows the stalled heartbeat)
+    health = entry.get("health")
+    if isinstance(health, dict):
+        age = health.get("last_step_age_s") or 0.0
+        window = health.get("wedge_window_s") or 60.0
+        pending = (health.get("queued") or 0) + (health.get("occupancy") or 0)
+        if health.get("state") == "wedged" or (age > window and pending > 0):
+            flags.append(
+                f"wedged device: no step progress for {age:.1f}s with "
+                f"{health.get('queued', 0)} queued and "
+                f"{health.get('occupancy', 0)} in flight — the engine loop "
+                f"is stuck in a dispatch that never returned; expect the "
+                f"liveness probe to fail and k8s to reschedule the pod"
+            )
+        for reason in health.get("reasons") or []:
+            if health.get("state") == "degraded":
+                flags.append(f"degraded: {reason}")
+    slo = entry.get("slo")
+    if isinstance(slo, dict):
+        for name in slo.get("alerting") or []:
+            obj = (slo.get("objectives") or {}).get(name, {})
+            flags.append(
+                f"SLO fast burn on {name!r}: burn "
+                f"{obj.get('burn_rate_fast')}/{obj.get('burn_rate_slow')} "
+                f"(fast/slow) against target {obj.get('target')} — error "
+                f"budget {obj.get('budget_remaining')} remaining"
+            )
     return flags
 
 
