@@ -31,6 +31,9 @@ FLOW1001-4  dataflow: donated jit buffers read before rebinding,
             request-derived values reaching jit shapes un-bucketed,
             task handles that never outlive their frame, lock-order
             cycles across the call graph
+FLEET601/2  fleet autoscaler discipline: replica-count writes not gated
+            by a cooldown check, and blocking I/O or lock acquisition
+            inside the reconcile loop's decision section
 ==========  ==============================================================
 
 RACE/INV/FLOW are **project rules**: they run over a whole-program index
@@ -67,6 +70,7 @@ from langstream_tpu.analysis.core import (
 from langstream_tpu.analysis.project import ProjectIndex, ProjectRule
 from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
 from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
+from langstream_tpu.analysis.rules_fleet import RULES as _FLEET_RULES
 from langstream_tpu.analysis.rules_flow import RULES as _FLOW_RULES
 from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
@@ -84,6 +88,7 @@ ALL_RULES: list[Rule] = [
     *_OBS_RULES,
     *_QOS_RULES,
     *_PERF_RULES,
+    *_FLEET_RULES,
 ]
 
 #: whole-program rules (run over the ProjectIndex, not per file)

@@ -270,3 +270,76 @@ def check_engine(engine, clock):
         "sanctioned vocabulary (see serving/health.py)."
     ),
 ))
+
+_register(RuleExample(
+    rule="FLEET601",
+    tp={
+        "langstream_tpu/controlplane/autoscaler.py": '''\
+class FleetAutoscaler:
+    def step(self, backend, decision, now):
+        if decision.action == "up":
+            # replica write with no cooldown gate: one noisy signal
+            # flip-flops the fleet
+            backend.set_replicas(decision.target)
+''',
+    },
+    tn={
+        "langstream_tpu/controlplane/autoscaler.py": '''\
+class FleetAutoscaler:
+    def _cooldown_ok(self, now):
+        return (
+            self._last_scale_t is None
+            or now - self._last_scale_t >= self.spec.cooldown_s
+        )
+
+    def step(self, backend, decision, now):
+        if decision.action == "up":
+            if self._cooldown_ok(now):
+                backend.set_replicas(decision.target)
+                self._last_scale_t = now
+''',
+    },
+    fix=(
+        "Gate every replica-count write under an `if` whose condition "
+        "names the cooldown (`if self._cooldown_ok(now): "
+        "backend.set_replicas(...)`), and stamp the scale time inside "
+        "the gate. The gate must be visible AT the write site — a "
+        "rate limit enforced three callers up is invisible to the "
+        "reader auditing the scale path."
+    ),
+))
+
+_register(RuleExample(
+    rule="FLEET602",
+    tp={
+        "langstream_tpu/controlplane/autoscaler.py": '''\
+import urllib.request
+
+class FleetAutoscaler:
+    def decide(self, observations, now):
+        # I/O inside the decision: one wedged pod freezes the judgment
+        extra = urllib.request.urlopen("http://pod:8080/flight/summary")
+        with self._lock:
+            return "up" if len(observations) < 2 else "none"
+''',
+    },
+    tn={
+        "langstream_tpu/controlplane/autoscaler.py": '''\
+class FleetAutoscaler:
+    def decide(self, observations, now):
+        # the sanctioned shape: pure arithmetic over snapshots the
+        # backend's observe() already fetched
+        queued = sum(o["queued"] for o in observations)
+        if queued > 8 * max(1, len(observations)):
+            return "up"
+        return "none"
+''',
+    },
+    fix=(
+        "Keep decide() and its pressure/idle/cooldown helpers pure over "
+        "the observation list: the backend's observe() does the pod "
+        "fan-in BEFORE judgment, apply does the writes AFTER it. If "
+        "the decision needs more evidence, extend the observation "
+        "shape, never fetch mid-decide."
+    ),
+))

@@ -1,0 +1,169 @@
+"""Fleet rules: autoscaler decision-path discipline.
+
+The autoscaler (``controlplane/autoscaler.py``) is a control loop whose
+failure modes are *systemic*: a replica-count write that skips the
+cooldown gate turns one noisy signal into fleet thrash (each flip pays a
+pod schedule + XLA warmup up and a drain down), and a decision path that
+can block turns one wedged pod into a frozen autoscaler — precisely when
+the fleet most needs scaling. Two rules make both invariants mechanical:
+
+- **FLEET601** — every replica-count write (``set_replicas`` /
+  ``scale_statefulset`` spellings) in the autoscaler module must sit
+  lexically under an ``if`` whose condition names the cooldown (the
+  sanctioned shape is ``if self._cooldown_ok(now): ...``). The gate
+  being *visible at the write site* is the point: a reader auditing a
+  scale path must not have to trace callers to know it is rate-limited.
+- **FLEET602** — the decision section (``decide`` and its pressure/
+  idle/cooldown helpers) must be wait-free: no blocking I/O, no sleeps,
+  no lock acquisition. The same posture OBS504 enforces for the health
+  plane, for the same reason — judgment must never wait on the thing
+  being judged. I/O belongs in observe/apply, at the loop's edges.
+
+Scope: ``langstream_tpu/controlplane/autoscaler.py`` only. Fixtures in
+``analysis/fixtures.py`` (``--explain FLEET601``/``FLEET602``); policy
+in ``docs/ANALYSIS.md``, the subsystem in ``docs/FLEET.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule, call_name
+from langstream_tpu.analysis.rules_async import _BLOCKING_CALLS
+from langstream_tpu.analysis.rules_obs import (
+    _EXTRA_BLOCKING,
+    _FILE_IO_ATTRS,
+    _lockish,
+)
+
+#: the module whose control loop these rules police
+_AUTOSCALER_MODULE = "langstream_tpu/controlplane/autoscaler.py"
+
+#: callee spellings that write a replica count (method or function, any
+#: receiver: ``backend.set_replicas``, ``self.scale_statefulset``, …)
+_REPLICA_WRITE_ATTRS = {"set_replicas", "scale_statefulset"}
+
+#: substrings marking a function as part of the decision section — the
+#: pure judgment between observe (I/O in) and apply (I/O out)
+_DECISION_NAME_MARKS = ("decide", "pressure", "idle", "cooldown")
+
+
+def _is_replica_write(node: ast.Call) -> str | None:
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr in _REPLICA_WRITE_ATTRS:
+            return node.func.attr
+    elif isinstance(node.func, ast.Name):
+        if node.func.id in _REPLICA_WRITE_ATTRS:
+            return node.func.id
+    return None
+
+
+def _cooldown_gated(ancestors: list[ast.AST]) -> bool:
+    """True when some enclosing ``if``'s condition mentions the cooldown
+    — the visible-at-the-write-site gate FLEET601 demands."""
+    for node in ancestors:
+        if isinstance(node, ast.If) and "cooldown" in ast.unparse(
+            node.test
+        ).lower():
+            return True
+    return False
+
+
+def check_ungated_replica_write(mod: Module) -> Iterator[Finding]:
+    if not mod.path.endswith(_AUTOSCALER_MODULE):
+        return
+
+    def walk(node: ast.AST, ancestors: list[ast.AST]) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                spelling = _is_replica_write(child)
+                if spelling is not None and not _cooldown_gated(ancestors):
+                    yield mod.finding(
+                        "FLEET601",
+                        child,
+                        f"replica-count write {spelling}() is not gated by "
+                        f"a cooldown check: wrap it in "
+                        f"`if self._cooldown_ok(now): ...` (or an if whose "
+                        f"condition names the cooldown) — an ungated write "
+                        f"lets one noisy signal thrash the fleet, paying a "
+                        f"pod schedule + warmup per flip up and a drain "
+                        f"per flip down",
+                    )
+            yield from walk(child, ancestors + [child])
+
+    yield from walk(mod.tree, [])
+
+
+def _decision_functions(mod: Module) -> Iterator[ast.AST]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name = node.name.lower()
+        if any(mark in name for mark in _DECISION_NAME_MARKS):
+            yield node
+
+
+def check_blocking_in_decision_section(mod: Module) -> Iterator[Finding]:
+    if not mod.path.endswith(_AUTOSCALER_MODULE):
+        return
+    for fn in _decision_functions(mod):
+        # nested defs are deferred work the decision only constructs —
+        # the same exemption OBS503/OBS504 grant dispatch closures
+        nested: set[int] = set()
+        for inner in ast.walk(fn):
+            if (
+                isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and inner is not fn
+            ):
+                nested.update(id(n) for n in ast.walk(inner))
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue
+            offender = kind = None
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _BLOCKING_CALLS or name in _EXTRA_BLOCKING:
+                    offender, kind = f"{name}()", "blocking call"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _FILE_IO_ATTRS
+                ):
+                    offender, kind = f".{node.func.attr}()", "blocking call"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    offender, kind = f"{name or '.acquire'}()", "lock"
+            elif isinstance(node, ast.With):
+                if any(_lockish(item.context_expr) for item in node.items):
+                    offender, kind = "with <lock>", "lock"
+            if offender is not None:
+                yield mod.finding(
+                    "FLEET602",
+                    node,
+                    f"{kind} {offender} in the autoscaler decision "
+                    f"section (`{fn.name}`): decide() and its pressure/"
+                    f"idle/cooldown helpers must be wait-free — a "
+                    f"decision that can block freezes scaling exactly "
+                    f"when a wedged pod makes it urgent; move I/O into "
+                    f"the backend's observe/apply edges",
+                )
+
+
+RULES = [
+    Rule(
+        id="FLEET601",
+        family="fleet",
+        summary="autoscaler replica-count write not gated by a cooldown "
+        "check (hysteresis must be visible at the write site)",
+        check=check_ungated_replica_write,
+    ),
+    Rule(
+        id="FLEET602",
+        family="fleet",
+        summary="blocking I/O or lock acquisition in the autoscaler "
+        "decision section (decide paths must be wait-free)",
+        check=check_blocking_in_decision_section,
+    ),
+]

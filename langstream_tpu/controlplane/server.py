@@ -34,6 +34,11 @@ from langstream_tpu.controlplane.stores import (
     InMemoryApplicationStore,
     StoredApplication,
 )
+from langstream_tpu.controlplane.autoscaler import (
+    FleetAutoscaler,
+    application_autoscale_spec,
+    validate_application_autoscale,
+)
 from langstream_tpu.core.parser import ModelBuilder
 from langstream_tpu.gateway.auth import validate_gateway_authentication
 from langstream_tpu.gateway.server import GatewayRegistry
@@ -389,6 +394,10 @@ class ControlPlaneServer:
                     "/api/applications/{tenant}/{name}/health", self._health
                 ),
                 web.get("/api/applications/{tenant}/{name}/slo", self._slo),
+                web.get(
+                    "/api/applications/{tenant}/{name}/autoscaler",
+                    self._autoscaler,
+                ),
                 web.get("/api/applications/{tenant}/{name}/code", self._download_code),
                 web.get("/api/applications/{tenant}/{name}/agents", self._agents),
                 # archetypes (parity: ArchetypeResource)
@@ -403,6 +412,11 @@ class ControlPlaneServer:
             ]
         )
         self._runner: web.AppRunner | None = None
+        # per-application fleet autoscalers (controlplane/autoscaler.py):
+        # created at deploy for apps whose serving resource declares an
+        # enabled autoscale section AND whose compute runtime can scale
+        # (the k8s runtime; dev mode has no replicas to scale)
+        self.autoscalers: dict[tuple[str, str], FleetAutoscaler] = {}
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)
@@ -412,9 +426,65 @@ class ControlPlaneServer:
         log.info("control plane listening on :%d", self.port)
 
     async def stop(self) -> None:
+        for key in list(self.autoscalers):
+            await self._stop_autoscaler(key)
         await self.compute.close()
         if self._runner is not None:
             await self._runner.cleanup()
+
+    # ---- fleet autoscaler lifecycle --------------------------------------
+
+    async def _stop_autoscaler(self, key: tuple[str, str]) -> None:
+        scaler = self.autoscalers.pop(key, None)
+        if scaler is not None:
+            await scaler.stop()
+
+    async def _sync_autoscaler(self, stored: StoredApplication, application) -> None:
+        """(Re)start the app's fleet autoscaler after a deploy: one
+        reconcile loop per app with an enabled ``autoscale:`` section,
+        driving the compute runtime's scaling backend. Dev-mode compute
+        has no replicas, so apps there simply never get one."""
+        key = (stored.tenant, stored.name)
+        await self._stop_autoscaler(key)
+        spec = application_autoscale_spec(application)
+        if spec is None:
+            return
+        backend_factory = getattr(self.compute, "autoscaler_backend", None)
+        if backend_factory is None:
+            log.info(
+                "application %s/%s declares autoscale but the %s cannot "
+                "scale replicas; skipping",
+                stored.tenant, stored.name, type(self.compute).__name__,
+            )
+            return
+        backend = backend_factory(stored.tenant, stored.name, spec)
+        if backend is None:
+            return
+        registry = getattr(self.compute, "gateway_registry", None)
+        on_observation = None
+        if registry is not None:
+            tenant, name = stored.tenant, stored.name
+
+            def on_observation(obs, _t=tenant, _n=name, _r=registry):
+                # the router consumes the same fleet snapshot the scaler
+                # judges — one fan-in, two consumers
+                _r.update_fleet(_t, _n, obs)
+
+        scaler = FleetAutoscaler(spec, backend, on_observation=on_observation)
+        scaler.start()
+        self.autoscalers[key] = scaler
+
+    async def _autoscaler(self, request: web.Request) -> web.Response:
+        """Per-application autoscaler status: declared policy, latest
+        per-replica observations, and the decision ring (scale events
+        with their evidence). Apps without an active autoscaler answer
+        ``{"enabled": false}`` — an operator polling the route learns
+        the distinction between "no policy" and "no decisions yet"."""
+        key = (request.match_info["tenant"], request.match_info["name"])
+        scaler = self.autoscalers.get(key)
+        if scaler is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(scaler.status())
 
     # ---- tenants ---------------------------------------------------------
 
@@ -441,6 +511,7 @@ class ControlPlaneServer:
     async def _delete_tenant(self, request: web.Request) -> web.Response:
         tenant = request.match_info["tenant"]
         for name in self.store.list_applications(tenant):
+            await self._stop_autoscaler((tenant, name))
             await self.compute.undeploy(tenant, name)
         self.store.delete_tenant(tenant)
         return web.json_response({"status": "OK"})
@@ -528,10 +599,12 @@ class ControlPlaneServer:
             validate_gateway_authentication(application.gateways)
             validate_application_qos(application)
             validate_application_slo(application)
+            validate_application_autoscale(application)
         except web.HTTPException:
             raise
         except Exception as e:
             raise web.HTTPBadRequest(reason=f"invalid application: {e}")
+        await self._stop_autoscaler((tenant, name))
         await self.compute.undeploy(tenant, name)
         return await self._do_deploy(stored, application)
 
@@ -551,6 +624,7 @@ class ControlPlaneServer:
                 validate_gateway_authentication(application.gateways)
                 validate_application_qos(application)
                 validate_application_slo(application)
+                validate_application_autoscale(application)
             except Exception as e:
                 raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         else:
@@ -590,6 +664,9 @@ class ControlPlaneServer:
             stored.error = str(e)
             log.exception("deploy failed")
         self.store.put_application(stored)
+        if stored.status == "DEPLOYED":
+            # fleet autoscaler rides the deployed app's lifecycle
+            await self._sync_autoscaler(stored, application)
         return web.json_response(stored.public_view())
 
     async def _get_app(self, request: web.Request) -> web.Response:
@@ -652,6 +729,7 @@ class ControlPlaneServer:
     async def _delete_app(self, request: web.Request) -> web.Response:
         tenant = request.match_info["tenant"]
         name = request.match_info["name"]
+        await self._stop_autoscaler((tenant, name))
         await self.compute.undeploy(tenant, name)
         self.store.delete_application(tenant, name)
         return web.json_response({"status": "OK"})

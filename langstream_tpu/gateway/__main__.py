@@ -80,6 +80,30 @@ async def _sync_registry(registry, control_plane_url: str) -> None:
         registry.register(tenant, app_name, application)
         known[(tenant, app_name)] = fingerprint
 
+    async def sync_fleet(session, tenant: str, app_name: str) -> None:
+        """Replica-router feed (docs/FLEET.md): the control plane's
+        autoscaler already fans in per-replica observations — the
+        gateway consumes the same snapshot for least-loaded routing and
+        session affinity. Polled only for apps whose own resources
+        declare an enabled ``autoscale:`` section — everything else
+        would answer ``{"enabled": false}`` forever, and N apps x one
+        extra round-trip per 5 s tick is pure waste. The 5 s cadence
+        keeps snapshots inside the router's 15 s freshness window."""
+        from langstream_tpu.controlplane.autoscaler import (
+            application_autoscale_spec,
+        )
+
+        app = registry.application(tenant, app_name)
+        if app is None or application_autoscale_spec(app) is None:
+            return
+        async with session.get(
+            f"{control_plane_url}/api/applications/{tenant}/"
+            f"{app_name}/autoscaler"
+        ) as resp:
+            body = await resp.json()
+        if body.get("enabled") and body.get("replicas"):
+            registry.update_fleet(tenant, app_name, body["replicas"])
+
     async with aiohttp.ClientSession(headers=headers) as session:
         while True:
             try:
@@ -102,6 +126,18 @@ async def _sync_registry(registry, control_plane_url: str) -> None:
                         except Exception as e:
                             log.warning(
                                 "sync of %s/%s failed: %s", tenant, app_name, e
+                            )
+                            continue
+                        try:
+                            await sync_fleet(session, tenant, app_name)
+                        except Exception as e:
+                            # the registration above stands — a failed
+                            # fleet poll only leaves the router feed
+                            # stale, and the 15 s freshness window
+                            # degrades that to stamping nothing
+                            log.debug(
+                                "fleet sync of %s/%s failed: %s",
+                                tenant, app_name, e,
                             )
                 # deleted apps must stop resolving (their gateways would
                 # otherwise keep serving stale topic access forever)

@@ -1,0 +1,201 @@
+"""Replica-aware routing for serving traffic (docs/FLEET.md).
+
+The gateway produces into a topic; with one serving replica that is the
+whole story, but a fleet needs the record to land on the replica best
+placed to serve it. This module is the gateway's half of that loop:
+
+- :class:`ReplicaRouter` tracks per-replica flight snapshots (the same
+  observation dicts the autoscaler consumes — queue depth, occupancy,
+  health/drain posture) and picks the **least-loaded eligible** replica:
+  load = ``(1 + queue depth) × (1 + occupancy/slots)``, monotone in both
+  axes so a deep queue and a full batch each push traffic away.
+  Draining, wedged, and unreachable replicas are never eligible — a
+  record routed into a dying pod's queue is a record the drain has to
+  requeue right back.
+- **Session affinity** on the QoS tenant (``langstream-qos-tenant``): a
+  conversation keeps hitting the replica that already holds its
+  prefix-cache blocks (ROADMAP item 3's warm-TTFT lever), for as long as
+  the replica stays eligible and the affinity entry is fresh. Affinity
+  is advisory: an ineligible replica breaks it immediately and the
+  session re-pins to the new least-loaded pick.
+- The choice is stamped as the ``langstream-replica`` record header; the
+  serving agent's consumer honors it (``runtime/runner.py``): a replica
+  that reads a record stamped for a sibling re-produces it back to the
+  input topic (bounce-capped) so partition assignment and routing intent
+  converge instead of fighting.
+
+Snapshots arrive via :meth:`observe` — pushed by whoever already has
+them (the control plane's autoscaler loop, a gateway-side poller, tests)
+— and go stale after ``fresh_s``: routing on stale evidence is worse
+than no routing, so a router with no fresh snapshot stamps nothing and
+the topic's normal partition spread applies.
+
+Stdlib-only, no locks: the router lives on the gateway's event loop;
+every method is dict arithmetic (the same wait-free posture the health
+plane keeps, and for the same reason — routing runs on the produce hot
+path).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any, Callable
+
+#: record header carrying the routing choice; the serving agent's
+#: consumer honors it (see runtime/runner.py)
+REPLICA_HEADER = "langstream-replica"
+#: reroute loop guard: bounces a stamped record may take before the
+#: consumer serves it locally anyway (better the wrong replica than a
+#: record orbiting the topic after its target vanished)
+BOUNCE_HEADER = "langstream-replica-bounces"
+MAX_BOUNCES = 2
+
+
+class ReplicaRouter:
+    """Least-loaded replica choice with tenant session affinity."""
+
+    #: max tenants pinned before LRU eviction — tenant names can be
+    #: client-chosen on unauthenticated gateways (same bound the QoS
+    #: limiter keeps)
+    MAX_AFFINITY = 4096
+
+    def __init__(
+        self,
+        fresh_s: float = 15.0,
+        affinity_ttl_s: float = 600.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.fresh_s = fresh_s
+        self.affinity_ttl_s = affinity_ttl_s
+        self._clock = clock
+        self._replicas: dict[str, dict[str, Any]] = {}
+        self._observed_at: float | None = None
+        # tenant -> [replica, pinned_at]
+        self._affinity: "OrderedDict[str, list]" = OrderedDict()
+        self.picks = 0
+        self.affinity_hits = 0
+        self.affinity_rerouted = 0
+
+    # -- snapshot ingestion ---------------------------------------------
+
+    def observe(self, snapshots: list[dict[str, Any]]) -> None:
+        """Replace the fleet view with fresh per-replica observation
+        dicts (the :class:`~langstream_tpu.controlplane.autoscaler.
+        ReplicaObservation` shape: ``replica``/``queued``/``occupancy``/
+        ``slots``/``state``/``draining``/``unreachable``)."""
+        self._replicas = {
+            s["replica"]: dict(s) for s in snapshots if s.get("replica")
+        }
+        self._observed_at = self._clock()
+
+    def fresh(self) -> bool:
+        return (
+            self._observed_at is not None
+            and self._clock() - self._observed_at <= self.fresh_s
+        )
+
+    # -- choice ----------------------------------------------------------
+
+    @staticmethod
+    def _eligible(snapshot: dict[str, Any]) -> bool:
+        return not (
+            snapshot.get("unreachable")
+            or snapshot.get("draining")
+            or snapshot.get("state") == "wedged"
+        )
+
+    @staticmethod
+    def _load(snapshot: dict[str, Any]) -> float:
+        """(1 + queue depth) × (1 + occupancy/slots): a replica with an
+        empty queue and an empty batch scores 1.0; queue growth scales
+        the score linearly, batch fullness up to 2×."""
+        slots = snapshot.get("slots") or 0
+        occ_frac = (snapshot.get("occupancy") or 0) / slots if slots else 0.0
+        return (1.0 + (snapshot.get("queued") or 0)) * (1.0 + occ_frac)
+
+    def eligible(self) -> list[str]:
+        return sorted(
+            name
+            for name, snap in self._replicas.items()
+            if self._eligible(snap)
+        )
+
+    def pick(self, tenant: str | None = None) -> str | None:
+        """The replica for one record: the tenant's pinned replica while
+        it stays eligible and fresh, else the least-loaded eligible
+        replica (ties break on name for determinism). ``None`` when the
+        fleet view is stale or empty — stamp nothing, let the topic's
+        partition spread route."""
+        if not self.fresh():
+            return None
+        candidates = [
+            (self._load(snap), name)
+            for name, snap in self._replicas.items()
+            if self._eligible(snap)
+        ]
+        if not candidates:
+            return None
+        now = self._clock()
+        if tenant:
+            pinned = self._affinity.get(tenant)
+            if pinned is not None:
+                replica, pinned_at = pinned
+                snap = self._replicas.get(replica)
+                if (
+                    snap is not None
+                    and self._eligible(snap)
+                    and now - pinned_at <= self.affinity_ttl_s
+                ):
+                    # refresh the pin: an active conversation keeps its
+                    # prefix-cache locality for as long as it stays warm
+                    pinned[1] = now
+                    self._affinity.move_to_end(tenant)
+                    self.picks += 1
+                    self.affinity_hits += 1
+                    return replica
+                self.affinity_rerouted += 1
+        choice = min(candidates)[1]
+        self.picks += 1
+        if tenant:
+            self._affinity[tenant] = [choice, now]
+            self._affinity.move_to_end(tenant)
+            while len(self._affinity) > self.MAX_AFFINITY:
+                self._affinity.popitem(last=False)
+        return choice
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "replicas": {
+                name: {
+                    "load": round(self._load(snap), 3),
+                    "eligible": self._eligible(snap),
+                    "queued": snap.get("queued", 0),
+                    "occupancy": snap.get("occupancy", 0),
+                    "draining": bool(snap.get("draining")),
+                    "state": snap.get("state", "ok"),
+                    "unreachable": bool(snap.get("unreachable")),
+                }
+                for name, snap in sorted(self._replicas.items())
+            },
+            "fresh": self.fresh(),
+            "picks": self.picks,
+            "affinity_hits": self.affinity_hits,
+            "affinity_rerouted": self.affinity_rerouted,
+            "pinned_tenants": len(self._affinity),
+        }
+
+
+def split_replica_target(value: str) -> tuple[str, int | None]:
+    """``(base, ordinal)`` of a routing stamp: ``'app-ai-2'`` →
+    ``('app-ai', 2)``, a bare ordinal ``'2'`` → ``('', 2)``, no trailing
+    ordinal → ``(value, None)``. The consumer honors a stamp only when
+    the base names *its own* StatefulSet (or is empty): a stamp
+    targeting a sibling agent's pods must pass through untouched, or a
+    two-stage pipeline would bounce every record at its second hop."""
+    head, _sep, tail = value.rpartition("-")
+    if tail.isdigit():
+        return head, int(tail)
+    return value, None

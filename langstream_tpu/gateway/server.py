@@ -38,6 +38,7 @@ from langstream_tpu.gateway.auth import (
     AuthenticationException,
     get_auth_provider,
 )
+from langstream_tpu.gateway.router import REPLICA_HEADER, ReplicaRouter
 from langstream_tpu.serving.qos import (
     QosSpec,
     TenantLimiter,
@@ -70,16 +71,50 @@ class GatewayRegistry:
         # tpu-serving-configuration resource's qos section; invalidated on
         # register/unregister so a redeploy picks up new limits)
         self._qos_limiters: dict[tuple[str, str], TenantLimiter | None] = {}
+        # per-app replica router (gateway/router.py): exists only once
+        # someone — the control plane's autoscaler loop, a poller, tests
+        # — pushes fleet snapshots via update_fleet; without fresh
+        # snapshots produce paths stamp nothing and the topic's normal
+        # partition spread routes
+        self._routers: dict[tuple[str, str], ReplicaRouter] = {}
 
     def register(self, tenant: str, app_id: str, application: Application) -> None:
         self._apps[(tenant, app_id)] = application
         self._qos_limiters.pop((tenant, app_id), None)
 
+    def application(self, tenant: str, app_id: str) -> Application | None:
+        return self._apps.get((tenant, app_id))
+
     def unregister(self, tenant: str, app_id: str) -> None:
         self._apps.pop((tenant, app_id), None)
         self._qos_limiters.pop((tenant, app_id), None)
+        self._routers.pop((tenant, app_id), None)
         for key in [k for k in self._service_uris if k[:2] == (tenant, app_id)]:
             del self._service_uris[key]
+
+    def update_fleet(
+        self, tenant: str, app_id: str, snapshots: list[dict[str, Any]]
+    ) -> None:
+        """Feed the app's router fresh per-replica observations (the
+        autoscaler's observe() output — it already fans in exactly the
+        evidence routing needs, so the two consume one snapshot)."""
+        self._routers.setdefault(
+            (tenant, app_id), ReplicaRouter()
+        ).observe(snapshots)
+
+    def router(self, tenant: str, app_id: str) -> ReplicaRouter | None:
+        return self._routers.get((tenant, app_id))
+
+    def route_replica(
+        self, tenant: str, app_id: str, qos_tenant: str | None
+    ) -> str | None:
+        """The replica one produced record should land on (None = don't
+        stamp): least-loaded eligible member, with session affinity on
+        the QoS tenant so a conversation keeps its prefix-cache blocks."""
+        router = self._routers.get((tenant, app_id))
+        if router is None:
+            return None
+        return router.pick(qos_tenant)
 
     def qos_limiter(self, tenant: str, app_id: str) -> TenantLimiter | None:
         """The app's gateway-side QoS limiter (None when the app declares
@@ -342,6 +377,34 @@ class GatewayServer:
         tenant, priority = self._qos_identity(params, principal)
         return {QOS_TENANT_HEADER: tenant, QOS_PRIORITY_HEADER: priority}
 
+    def _stamp_replica(
+        self,
+        headers: dict[str, Any],
+        tenant: str,
+        app_id: str,
+        params: dict[str, Any],
+        principal: dict[str, Any],
+    ) -> dict[str, Any]:
+        """Stamp the routing choice onto one produced record (in place).
+        Per-message, not per-connection: load shifts and affinity pins
+        between messages on one WebSocket. The affinity key is the SAME
+        QoS identity the limiter throttled on (resolved here from the
+        same params/principal so the two can never disagree) — except
+        that the shared ``anonymous`` fallback gets no affinity pin:
+        every unauthenticated client shares that name, and pinning it
+        would funnel all anonymous traffic onto one replica, defeating
+        least-loaded routing exactly in the common dev/bench setup. A
+        client-supplied stamp is honored — explicit targeting (debug,
+        pinned benchmarks) beats the router's heuristic."""
+        if REPLICA_HEADER in headers:
+            return headers
+        qos_tenant, _ = self._qos_identity(params, principal)
+        affinity = qos_tenant if qos_tenant != "anonymous" else None
+        replica = self.registry.route_replica(tenant, app_id, affinity)
+        if replica is not None:
+            headers[REPLICA_HEADER] = replica
+        return headers
+
     #: max distinct tenant labels on the throttle counter — tenant names
     #: can be client-chosen on unauthenticated gateways, and Prometheus
     #: label cardinality (and this dict) must not grow with them
@@ -483,6 +546,7 @@ class GatewayServer:
                         {**(payload.get("headers") or {}), **inject},
                         "gateway.produce",
                     )
+                    self._stamp_replica(headers, tenant, app_id, params, principal)
                     retry = (
                         limiter.admit_request(qos_tenant)
                         if limiter is not None
@@ -543,6 +607,7 @@ class GatewayServer:
         headers, span = self._traced_headers(
             {**(payload.get("headers") or {}), **inject}, "gateway.produce"
         )
+        self._stamp_replica(headers, tenant, app_id, params, principal)
         if limiter is not None:
             retry = limiter.admit_request(qos_tenant)
             if retry is not None:
@@ -672,6 +737,7 @@ class GatewayServer:
                         {**(payload.get("headers") or {}), **inject},
                         "gateway.chat",
                     )
+                    self._stamp_replica(headers, tenant, app_id, params, principal)
                     retry = (
                         limiter.admit_request(qos_tenant)
                         if limiter is not None
@@ -845,6 +911,7 @@ class GatewayServer:
             },
             "gateway.service",
         )
+        self._stamp_replica(headers, tenant, app_id, params, principal)
         try:
             # `with span:` so a broker failure mid-write/read still closes
             # the span with its error (end() is idempotent — the explicit

@@ -367,5 +367,195 @@ class KubernetesComputeRuntime:
             for cr in self.runtime.current_agents(tenant, name)
         ]
 
+    # ------------------------------------------------------------------
+    # fleet plane: observe / scale / drain (docs/FLEET.md)
+    # ------------------------------------------------------------------
+
+    def serving_statefulsets(
+        self, tenant: str, name: str
+    ) -> list[dict[str, Any]]:
+        """The application's *scalable* StatefulSets: single-host agents
+        whose replicas are data-parallel pods. Multi-host ICI slices are
+        excluded — their STS replica count is the slice's HOST count
+        (one JAX process group), and "scaling" it would tear the
+        collective topology, not add serving capacity; slice fan-out is
+        the factory's per-logical-replica STS split instead."""
+        from langstream_tpu.k8s.cluster_runtime import tenant_namespace
+
+        namespace = tenant_namespace(tenant)
+        out = []
+        for sts in self.api.list(
+            "StatefulSet", namespace,
+            label_selector={"langstream-application": name},
+        ):
+            template = (
+                (sts["spec"].get("template") or {}).get("spec") or {}
+            )
+            env = {
+                e.get("name"): e.get("value")
+                for c in template.get("containers", [])
+                for e in c.get("env", [])
+            }
+            if int(env.get("LS_SLICE_HOSTS") or 1) > 1:
+                continue
+            out.append(sts)
+        return out
+
+    def fleet_observe(
+        self, tenant: str, name: str, sts_name: str
+    ) -> list[dict[str, Any]]:
+        """One :class:`ReplicaObservation` dict per pod of ``sts_name``,
+        folded from the pods' ``/flight/summary`` fan-in (queue depths,
+        occupancy, KV pressure, health/drain posture, SLO alerts).
+        Timed-out pods surface as ``unreachable`` members — the
+        autoscaler treats a missing replica as a reason NOT to scale
+        down, never as absent capacity."""
+        from langstream_tpu.controlplane.autoscaler import (
+            observation_from_summary,
+        )
+
+        prefix = f"{sts_name}-"
+        observations = []
+        for pod, chunk in self._pod_json_fanin(tenant, name, "/flight/summary"):
+            # exact-STS match: the tail must be the pod ORDINAL, or a
+            # sibling STS whose name extends this one's ("chat-ai" vs
+            # "chat-ai-extra") would leak its pods into this fleet —
+            # the same dash-prefix leak shape pod_logs fixed with label
+            # selectors
+            if not pod.startswith(prefix) or not pod[len(prefix):].isdigit():
+                continue
+            observations.append(observation_from_summary(pod, chunk).to_dict())
+        return observations
+
+    def scale_statefulset(
+        self, tenant: str, name: str, sts_name: str, replicas: int
+    ) -> None:
+        """Patch the StatefulSet's replica count, stamping the autoscale
+        annotation so the operator's level-triggered reconcile preserves
+        the live value instead of resetting it to the CR's parallelism
+        (``AgentController._preserve_autoscaled_replicas``)."""
+        from langstream_tpu.controlplane.autoscaler import AUTOSCALE_ANNOTATION
+        from langstream_tpu.k8s.cluster_runtime import tenant_namespace
+
+        namespace = tenant_namespace(tenant)
+        sts = self.api.get("StatefulSet", namespace, sts_name)
+        if sts is None:
+            raise KeyError(f"StatefulSet {sts_name!r} not found in {namespace}")
+        sts["spec"]["replicas"] = int(replicas)
+        sts.setdefault("metadata", {}).setdefault("annotations", {})[
+            AUTOSCALE_ANNOTATION
+        ] = "true"
+        self.api.apply(sts)
+        self.append_log(
+            tenant, name, f"autoscaler: {sts_name} replicas -> {replicas}"
+        )
+
+    def drain_pod(
+        self, tenant: str, name: str, pod: str, grace_s: float = 30.0
+    ) -> dict[str, Any] | None:
+        """Hit one pod's ``/drain`` endpoint and block until it settles
+        (the endpoint answers only after the engines requeued their work
+        or the grace budget expired). ``None`` when the pod is already
+        unreachable — for the scale-down path that is equivalent to a
+        drained pod: there is nothing left to lose on it. Synchronous by
+        design (the autoscaler runs backend calls in a worker thread)."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        base = self._pod_addresses(tenant, name).get(pod)
+        if base is None:
+            return None
+        url = f"{base}/drain?grace-s={float(grace_s):g}"
+        try:
+            with urllib.request.urlopen(url, timeout=grace_s + 10) as resp:
+                return _json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            log.warning("drain of pod %s failed (%s); treating as gone", pod, e)
+            return None
+
+    def autoscaler_backend(self, tenant: str, name: str, spec) -> Any:
+        """A :class:`FleetAutoscaler` backend for the app's serving
+        StatefulSet (``spec.agent`` disambiguates when the app has
+        several scalable agents). STS resolution is LAZY — at deploy
+        time the operator has not reconciled the Agent CRs into
+        StatefulSets yet, so the backend re-resolves per observation
+        until one exists (an unresolved fleet observes as empty, which
+        the autoscaler treats as "nothing to decide")."""
+        return StatefulSetFleetBackend(self, tenant, name, spec)
+
     async def close(self) -> None:
         pass
+
+
+class StatefulSetFleetBackend:
+    """The duck-typed backend a :class:`FleetAutoscaler` drives against a
+    live cluster: observe = pod ``/flight/summary`` fan-in, scale =
+    StatefulSet replica patch, drain = pod ``/drain``. All methods are
+    synchronous (pod HTTP + API-server round-trips); the autoscaler runs
+    them in a worker thread so the control plane's event loop — and the
+    wait-free decide() — never block on a slow pod."""
+
+    def __init__(
+        self,
+        runtime: KubernetesComputeRuntime,
+        tenant: str,
+        name: str,
+        spec: Any = None,
+    ):
+        self.runtime = runtime
+        self.tenant = tenant
+        self.name = name
+        self.spec = spec
+        self._sts_name: str | None = None
+
+    def resolve(self) -> str | None:
+        """The target StatefulSet's name, re-resolved until the operator
+        has materialized it (cached afterwards — STS names are stable
+        for an app's lifetime)."""
+        if self._sts_name is not None:
+            return self._sts_name
+        from langstream_tpu.k8s.resources import AgentResourcesFactory
+
+        candidates = self.runtime.serving_statefulsets(self.tenant, self.name)
+        if self.spec is not None and getattr(self.spec, "agent", None):
+            wanted = AgentResourcesFactory.agent_resource_name(
+                self.name, self.spec.agent
+            )
+            candidates = [
+                s for s in candidates if s["metadata"]["name"] == wanted
+            ]
+        if not candidates:
+            return None
+        if len(candidates) > 1:
+            log.warning(
+                "application %s/%s has %d scalable StatefulSets and no "
+                "autoscale.agent — scaling %s",
+                self.tenant, self.name, len(candidates),
+                sorted(s["metadata"]["name"] for s in candidates)[0],
+            )
+        self._sts_name = sorted(
+            s["metadata"]["name"] for s in candidates
+        )[0]
+        return self._sts_name
+
+    def observe(self) -> list[dict[str, Any]]:
+        sts_name = self.resolve()
+        if sts_name is None:
+            return []
+        return self.runtime.fleet_observe(self.tenant, self.name, sts_name)
+
+    def set_replicas(self, replicas: int) -> None:
+        sts_name = self.resolve()
+        if sts_name is None:
+            raise KeyError(
+                f"no scalable StatefulSet for {self.tenant}/{self.name}"
+            )
+        self.runtime.scale_statefulset(
+            self.tenant, self.name, sts_name, replicas
+        )
+
+    def drain(self, replica: str, grace_s: float) -> dict[str, Any] | None:
+        return self.runtime.drain_pod(
+            self.tenant, self.name, replica, grace_s
+        )

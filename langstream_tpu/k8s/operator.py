@@ -85,6 +85,27 @@ class AgentController:
             }]
         return obj
 
+    def _preserve_autoscaled_replicas(self, sts: dict[str, Any]) -> None:
+        """The fleet autoscaler owns the replica count of StatefulSets it
+        has stamped (``langstream.tpu/autoscale``): the level-triggered
+        reconcile must carry the LIVE count (and the stamp) into the
+        desired spec, or every tick would fight the autoscaler back to
+        the CR's parallelism — exactly the churn HPA-managed Deployments
+        avoid by omitting ``replicas``."""
+        from langstream_tpu.controlplane.autoscaler import AUTOSCALE_ANNOTATION
+
+        meta = sts["metadata"]
+        existing = self.api.get("StatefulSet", meta["namespace"], meta["name"])
+        if existing is None:
+            return
+        annotations = (existing.get("metadata") or {}).get("annotations") or {}
+        if annotations.get(AUTOSCALE_ANNOTATION) != "true":
+            return
+        live = (existing.get("spec") or {}).get("replicas")
+        if live is not None:
+            sts["spec"]["replicas"] = int(live)
+        meta.setdefault("annotations", {})[AUTOSCALE_ANNOTATION] = "true"
+
     def reconcile(self, cr_dict: dict[str, Any]) -> str:
         cr = AgentCustomResource.from_dict(cr_dict)
         service = self._own(
@@ -97,20 +118,31 @@ class AgentController:
                 cr, accelerator=self.accelerator
             )
         ]
-        # prune StatefulSets from a previous shape (e.g. parallelism shrank
-        # or the agent moved between single- and multi-host)
+        for sts in statefulsets:
+            self._preserve_autoscaled_replicas(sts)
+        # voluntary-eviction protection: one PDB per STS (maxUnavailable 1)
+        # so node drains take serving pods one at a time through the same
+        # preStop /drain path the autoscaler's scale-down uses
+        pdbs = [
+            self._own(pdb, cr_dict)
+            for pdb in AgentResourcesFactory.generate_pod_disruption_budgets(
+                cr, statefulsets
+            )
+        ]
+        # prune StatefulSets (and their PDBs) from a previous shape (e.g.
+        # parallelism shrank or the agent moved between single- and
+        # multi-host)
         wanted = {sts["metadata"]["name"] for sts in statefulsets}
-        existing = self.api.list(
-            "StatefulSet",
-            cr.namespace,
-            label_selector={
-                "langstream-application": cr.spec.application_id,
-                "langstream-agent": cr.spec.agent_id,
-            },
-        )
-        for sts in existing:
-            if sts["metadata"]["name"] not in wanted:
-                self.api.delete("StatefulSet", cr.namespace, sts["metadata"]["name"])
+        selector = {
+            "langstream-application": cr.spec.application_id,
+            "langstream-agent": cr.spec.agent_id,
+        }
+        for kind in ("StatefulSet", "PodDisruptionBudget"):
+            for obj in self.api.list(kind, cr.namespace, label_selector=selector):
+                if obj["metadata"]["name"] not in wanted:
+                    self.api.delete(kind, cr.namespace, obj["metadata"]["name"])
+        for pdb in pdbs:
+            apply_if_changed(self.api, pdb)
         ready = True
         for sts in statefulsets:
             applied = apply_if_changed(self.api, sts)
@@ -126,15 +158,13 @@ class AgentController:
 
     def cleanup(self, cr_dict: dict[str, Any]) -> None:
         cr = AgentCustomResource.from_dict(cr_dict)
-        for sts in self.api.list(
-            "StatefulSet",
-            cr.namespace,
-            label_selector={
-                "langstream-application": cr.spec.application_id,
-                "langstream-agent": cr.spec.agent_id,
-            },
-        ):
-            self.api.delete("StatefulSet", cr.namespace, sts["metadata"]["name"])
+        selector = {
+            "langstream-application": cr.spec.application_id,
+            "langstream-agent": cr.spec.agent_id,
+        }
+        for kind in ("StatefulSet", "PodDisruptionBudget"):
+            for obj in self.api.list(kind, cr.namespace, label_selector=selector):
+                self.api.delete(kind, cr.namespace, obj["metadata"]["name"])
         name = AgentResourcesFactory.agent_resource_name(
             cr.spec.application_id, cr.spec.agent_id
         )

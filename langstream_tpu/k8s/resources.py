@@ -99,7 +99,14 @@ def tpu_placement(accelerator: str, chips: int) -> dict[str, Any]:
 
 
 class AgentResourcesFactory:
-    """Turns one Agent CR into StatefulSet(s) + headless Service manifests."""
+    """Turns one Agent CR into StatefulSet(s) + headless Service + PDB
+    manifests."""
+
+    #: grace budget the preStop /drain hands the serving engines; the pod
+    #: terminationGracePeriod is sized above it so the kubelet never
+    #: SIGKILLs a pod mid-requeue
+    DRAIN_GRACE_S = 45
+    TERMINATION_GRACE_S = 90
 
     @staticmethod
     def agent_resource_name(application_id: str, agent_id: str) -> str:
@@ -175,6 +182,43 @@ class AgentResourcesFactory:
                 image_pull_policy=image_pull_policy, logical_replica=i,
             )
             for i in range(parallelism)
+        ]
+
+    @classmethod
+    def generate_pod_disruption_budgets(
+        cls,
+        cr: AgentCustomResource,
+        statefulsets: list[dict[str, Any]] | None = None,
+        accelerator: str = "v5e",
+    ) -> list[dict[str, Any]]:
+        """One PDB per StatefulSet, ``maxUnavailable: 1``: voluntary
+        evictions (node drains, cluster upgrades) take pods one at a
+        time, and each eviction runs the same preStop ``/drain`` path
+        the autoscaler's scale-down uses — so a node rotation requeues
+        in-flight generations instead of dropping a whole fleet at
+        once. Involuntary disruptions (node death) bypass PDBs by
+        definition; crash-requeue (ROADMAP item 5) is that lane. Pass
+        the already-generated ``statefulsets`` to avoid regenerating
+        them (the operator does)."""
+        if statefulsets is None:
+            statefulsets = cls.generate_statefulsets(
+                cr, accelerator=accelerator
+            )
+        return [
+            {
+                "apiVersion": "policy/v1",
+                "kind": "PodDisruptionBudget",
+                "metadata": {
+                    "name": sts["metadata"]["name"],
+                    "namespace": cr.namespace,
+                    "labels": _agent_labels(cr),
+                },
+                "spec": {
+                    "maxUnavailable": 1,
+                    "selector": sts["spec"]["selector"],
+                },
+            }
+            for sts in statefulsets
         ]
 
     @classmethod
@@ -264,7 +308,10 @@ class AgentResourcesFactory:
 
         entrypoint = ["python", "-m", "langstream_tpu.runtime.pod"]
         pod_spec: dict[str, Any] = {
-            "terminationGracePeriodSeconds": 60,
+            # must exceed the preStop /drain grace (DRAIN_GRACE_S) plus
+            # the runner's own broker-drain budget: the kubelet SIGKILLs
+            # at this deadline no matter what preStop is still doing
+            "terminationGracePeriodSeconds": cls.TERMINATION_GRACE_S,
             "initContainers": [
                 {
                     "name": "code-download",
@@ -314,6 +361,24 @@ class AgentResourcesFactory:
                         "initialDelaySeconds": 30,
                         "periodSeconds": 10,
                         "failureThreshold": 3,
+                    },
+                    # drain-before-terminate (docs/FLEET.md): every
+                    # voluntary termination — autoscaler scale-down,
+                    # rolling update, node drain honoring the PDB —
+                    # first stops admission and requeues in-flight
+                    # generations through /drain; the endpoint blocks
+                    # until the engines settle, and the kubelet holds
+                    # SIGTERM until preStop returns (within the
+                    # terminationGracePeriod above)
+                    "lifecycle": {
+                        "preStop": {
+                            "httpGet": {
+                                "path": (
+                                    f"/drain?grace-s={cls.DRAIN_GRACE_S}"
+                                ),
+                                "port": AGENT_PORT,
+                            }
+                        }
                     },
                 }
             ],

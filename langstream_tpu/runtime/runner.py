@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from pathlib import Path
 from typing import Any
 
@@ -44,6 +45,12 @@ from langstream_tpu.api.topics import (
 )
 from langstream_tpu.core.asyncutil import spawn_retained
 from langstream_tpu.core.tracing import TRACE_HEADER, TraceContext, start_span
+from langstream_tpu.gateway.router import (
+    BOUNCE_HEADER,
+    MAX_BOUNCES,
+    REPLICA_HEADER,
+    split_replica_target,
+)
 from langstream_tpu.runtime.composite import CompositeAgentProcessor
 from langstream_tpu.runtime.errors_handler import (
     FailureAction,
@@ -191,6 +198,23 @@ class AgentRunner:
         self._inflight = 0
         self._loop_task: asyncio.Task | None = None
         self._service_task: asyncio.Task | None = None
+        # replica routing (gateway/router.py): the gateway stamps a
+        # `langstream-replica` target; this consumer honors stamps whose
+        # base names ITS StatefulSet (in-cluster the pod name carries
+        # both base and ordinal; dev/test mode falls back to the
+        # replica index) and bounces mismatches back to the input topic
+        pod_name = os.environ.get("LS_POD_NAME")
+        if pod_name:
+            base, ordinal = split_replica_target(pod_name)
+            self._routing_base = base
+            self._routing_ordinal = (
+                ordinal if ordinal is not None else replica
+            )
+        else:
+            self._routing_base = ""
+            self._routing_ordinal = replica
+        self._reroute_producer: TopicProducer | None = None
+        self.records_rerouted = 0
         # per-record trace spans, opened at read and closed when the record
         # reaches a terminal state (written / committed / dead-lettered);
         # keyed by id() like the tracker (record values may be dicts)
@@ -392,6 +416,8 @@ class AgentRunner:
                 records = await self.source.read()
                 if self._stop_requested.is_set():
                     break
+                if records and self.node.input is not None:
+                    records = await self._honor_replica_routing(records)
                 if not records:
                     await asyncio.sleep(0)
                     continue
@@ -405,6 +431,90 @@ class AgentRunner:
         except Exception as e:  # loop-level failure is fatal for the replica
             self._fatal = e
             log.exception("agent %s main loop failed", self.agent_id)
+
+    async def _honor_replica_routing(self, records: list[Record]) -> list[Record]:
+        """Filter one read batch against `langstream-replica` stamps
+        (docs/FLEET.md): records addressed to THIS replica (or to no one,
+        or to a different agent's pods) pass through; records addressed
+        to a sibling replica of this StatefulSet re-produce back onto
+        the input topic and commit here, so consumer-group partition
+        spread and the gateway's routing intent converge. Bounces are
+        capped: once a record has hopped ``MAX_BOUNCES`` times its
+        target is evidently gone (scaled away mid-flight) and serving it
+        on the wrong replica — a cold prefix cache, nothing worse —
+        beats letting it orbit the topic."""
+        kept: list[Record] = []
+        for record in records:
+            target = record.header(REPLICA_HEADER)
+            if not target:
+                kept.append(record)
+                continue
+            base, ordinal = split_replica_target(str(target))
+            addressed_here = ordinal is not None and (
+                base == "" or base == self._routing_base
+            )
+            if not addressed_here or ordinal == self._routing_ordinal:
+                kept.append(record)
+                continue
+            if record.key is not None:
+                # keyed records hash back to the SAME partition — this
+                # consumer — so a bounce is two broker writes that land
+                # the record right back here; serving it locally is the
+                # only move that terminates
+                kept.append(record)
+                continue
+            try:
+                # the bounce header rides client-suppliable gateway
+                # payloads: garbage reads as over the cap, never as a
+                # loop-killing ValueError
+                bounces = int(record.header(BOUNCE_HEADER) or 0)
+            except (TypeError, ValueError):
+                bounces = MAX_BOUNCES
+            if bounces >= MAX_BOUNCES:
+                kept.append(record)
+                continue
+            if not await self._reroute(record, bounces + 1):
+                kept.append(record)
+        return kept
+
+    async def _reroute(self, record: Record, bounces: int) -> bool:
+        try:
+            producer = self._reroute_producer
+            if producer is None:
+                producer = self.topics_runtime.create_producer(
+                    f"{self.agent_id}-reroute",
+                    {"topic": self.node.input.topic},
+                )
+                await producer.start()
+                self._reroute_producer = producer
+            await producer.write(
+                record.with_headers({BOUNCE_HEADER: str(bounces)})
+            )
+        except Exception:
+            # a transient broker failure must not kill the main loop the
+            # way a processing error never would: serve the record here
+            # (cold prefix cache, nothing worse) and rebuild the producer
+            # on the next bounce
+            log.exception(
+                "agent %s reroute produce failed; serving locally",
+                self.agent_id,
+            )
+            dead, self._reroute_producer = self._reroute_producer, None
+            if dead is not None:
+                try:
+                    await dead.close()
+                except Exception as close_err:
+                    log.debug(
+                        "closing broken reroute producer failed: %s",
+                        close_err,
+                    )
+            return False
+        self.records_rerouted += 1
+        # the re-produced copy is this record's continuation: commit the
+        # original (zero local results) so the source offset advances
+        self.tracker.track(record, 0)
+        await self.tracker.commit_if_tracked_empty(record)
+        return True
 
     def _begin_record_trace(self, record: Record) -> Record:
         """Open the per-record hop span and stamp its context into the
@@ -521,6 +631,8 @@ class AgentRunner:
                 log.exception("error closing %s", closer)
         if self.deadletter_producer:
             await self.deadletter_producer.close()
+        if self._reroute_producer is not None:
+            await self._reroute_producer.close()
         await self.topics_runtime.close()
         self._running = False
         if self._fatal is not None:
@@ -534,6 +646,7 @@ class AgentRunner:
             "replica": self.replica,
             "records-in": self.records_in,
             "records-out": self.records_out,
+            "records-rerouted": self.records_rerouted,
             "errors": self.errors_total,
             "pending": self.tracker.pending_count() if hasattr(self, "tracker") else 0,
             "agent-info": self.processor.agent_info() if hasattr(self, "processor") else {},

@@ -620,6 +620,18 @@ class TpuServingEngine:
         self._freq = np.zeros(config.slots, dtype=np.float32)
         self._pending_emits: list = []
         self._finished_requests: list = []
+        # drain-before-terminate (docs/FLEET.md): once draining, new
+        # submissions shed with a Retry-After while already-accepted work
+        # is preempted-and-requeued at the loop's safe point and served
+        # to completion — the pod /drain endpoint and the autoscaler's
+        # scale-down path both land here
+        self._draining = False
+        self._drain_pass_done = False
+        self._drain_requeued = 0
+        self._drain_shed = 0
+        self._drain_base_completed = 0
+        self._drain_report: dict[str, Any] | None = None
+        self.completed_requests = 0
         # per-request {queue_wait, prefill, ttft} seconds, newest last —
         # the gateway bench reads this to attribute client-measured TTFT
         self.request_timings: deque[dict[str, float]] = deque(maxlen=4096)
@@ -1554,14 +1566,19 @@ class TpuServingEngine:
                 occupancy=occupancy,
             )
         warmup = self._warmup_state()
-        ready = warmup not in ("pending", "running") and (
-            verdict["state"] != "wedged"
+        # a draining engine is alive but must take no new traffic: ready
+        # drops (the router and the readiness probe both key off it)
+        ready = (
+            warmup not in ("pending", "running")
+            and verdict["state"] != "wedged"
+            and not self._draining
         )
         return {
             "model": self.config.model,
             "slots": self.config.slots,
             **verdict,
             "warmup": warmup,
+            "draining": self._draining,
             "ready": ready,
         }
 
@@ -1708,6 +1725,16 @@ class TpuServingEngine:
             priority=normalize_priority(options.get("priority")),
         )
         try:
+            if self._draining and not _warmup_probe:
+                # drain-before-terminate: admission is closed. The shed
+                # is EXPLICIT (Retry-After) so the gateway/router resends
+                # to a live replica instead of losing the request into a
+                # dying pod's queue.
+                raise RateLimited(
+                    "draining", 1.0,
+                    "engine is draining (scale-down or pod termination in "
+                    "progress); retry against another replica",
+                )
             self.scheduler.submit(request)
         except RateLimited as e:
             # load shed / tenant throttle: refused before any slot or
@@ -1718,6 +1745,8 @@ class TpuServingEngine:
                 priority=request.priority,
                 retry_after_s=e.retry_after,
             )
+            if e.reason == "draining":
+                self._drain_shed += 1
             if self._m_shed is not None:
                 self._m_shed(1)
             if not _warmup_probe:
@@ -1820,6 +1849,9 @@ class TpuServingEngine:
             "steps": dict(self.flight.steps_by_phase),
             # watchdog verdict + warmup/readiness posture (serving/health.py)
             "health": self.health(),
+            # drain-before-terminate posture + last drain's counts
+            # (docs/FLEET.md): the autoscaler's evidence trail
+            "drain": self._drain_section(),
         }
         slo = self.slo_status()
         if slo is not None:
@@ -1872,6 +1904,118 @@ class TpuServingEngine:
         self._sampler_dev_cache.clear()
 
     # ------------------------------------------------------------------
+    # drain-before-terminate (docs/FLEET.md)
+    # ------------------------------------------------------------------
+
+    async def drain(self, grace_s: float = 30.0) -> dict[str, Any]:
+        """Drain this engine for termination: stop admitting new work
+        (submissions shed with ``Retry-After``), preempt-and-requeue
+        every running generation at the loop's safe point (the PR 4 QoS
+        machinery: generated tokens + sampling params ARE the snapshot,
+        resume is byte-identical), then serve the backlog — queued plus
+        requeued — to completion. When the grace budget expires with
+        work still in flight, the leftovers are failed *explicitly* with
+        :class:`RateLimited` (never silently dropped): the caller knows
+        to retry elsewhere.
+
+        Returns ``{"requeued", "completed", "shed", "duration_s"}`` —
+        also emitted as a ``drain`` flight event and surfaced in
+        ``stats()["drain"]``. Idempotent: a second call joins the wait
+        with its own grace budget. Draining is terminal for admission
+        (the pod is going away); the engine still answers stats/health.
+        """
+        if self._stop:
+            return {
+                "requeued": 0, "completed": 0, "shed": 0,
+                "duration_s": 0.0, "stopped": True,
+            }
+        start = time.monotonic()
+        if not self._draining:
+            self._draining = True
+            self._drain_pass_done = False
+            self._drain_requeued = 0
+            self._drain_shed = 0
+            self._drain_base_completed = self.completed_requests
+            self._drain_report = None
+            self.flight.event(
+                "drain", stage="begin",
+                queued=self.scheduler.qsize(),
+                inflight=sum(1 for s in self.slots if not s.free),
+            )
+        self._ensure_loop()
+        self._wake.set()
+        deadline = start + grace_s
+        while time.monotonic() < deadline:
+            if (
+                self.scheduler.empty()
+                and all(s.free for s in self.slots)
+                and self._pending_chunk is None
+            ):
+                break
+            await asyncio.sleep(0.02)
+        leftovers = self.scheduler.qsize() + sum(
+            1
+            for s in self.slots
+            if s.request is not None and not s.request.future.done()
+        )
+        if leftovers:
+            # grace exhausted: shed the remainder loudly. _fail_inflight
+            # releases every slot/block and fails queued + running
+            # futures, so nothing is ever silently lost — the error
+            # carries retry_after for the 429 mapping.
+            self._fail_inflight(
+                RateLimited(
+                    "draining", 1.0,
+                    f"engine drained with {leftovers} requests unfinished "
+                    f"after {grace_s:.1f}s grace; retry another replica",
+                )
+            )
+            self._drain_shed += leftovers
+        report = {
+            "requeued": self._drain_requeued,
+            "completed": self.completed_requests - self._drain_base_completed,
+            "shed": self._drain_shed,
+            "duration_s": round(time.monotonic() - start, 3),
+        }
+        self._drain_report = report
+        self.flight.event("drain", stage="end", **report)
+        return report
+
+    def _drain_preempt_pass(self) -> int:
+        """One-shot preempt-and-requeue of every occupied slot, run by
+        the loop at its safe point (no dispatch in flight, pending chunk
+        drained — the same invariant :meth:`_maybe_preempt` relies on).
+        Requeued work resumes front-of-class and completes during the
+        drain wait; the preempt/resume round-trip is what makes a
+        drained generation byte-identical to an undisturbed one."""
+        requeued = 0
+        for slot_id, slot in enumerate(self.slots):
+            request = slot.request
+            if request is None or request.future.done():
+                continue
+            self._preempt_slot(slot_id, reason="drain")
+            requeued += 1
+        return requeued
+
+    def _drain_section(self) -> dict[str, Any]:
+        """The ``stats()["drain"]`` section: final report once the drain
+        finished, live counters while it runs."""
+        out: dict[str, Any] = {"draining": self._draining}
+        if self._drain_report is not None:
+            out.update(self._drain_report)
+        elif self._draining:
+            out.update(
+                {
+                    "requeued": self._drain_requeued,
+                    "completed": (
+                        self.completed_requests - self._drain_base_completed
+                    ),
+                    "shed": self._drain_shed,
+                }
+            )
+        return out
+
+    # ------------------------------------------------------------------
     # engine loop
     # ------------------------------------------------------------------
 
@@ -1906,6 +2050,13 @@ class TpuServingEngine:
                 # preemption so a victim's slot state is settled when the
                 # snapshot is taken
                 await self._drain_pending(loop)
+                if self._draining and not self._drain_pass_done:
+                    # drain-before-terminate: one preempt-and-requeue
+                    # sweep at the safe point (pending chunk settled);
+                    # the requeued work re-admits below and finishes
+                    # under drain()'s grace budget
+                    self._drain_pass_done = True
+                    self._drain_requeued += self._drain_preempt_pass()
                 if not self.scheduler.empty():
                     # slots the drained chunk just freed are admission
                     # opportunities NOW, not one burst later
@@ -2037,13 +2188,15 @@ class TpuServingEngine:
         self._preempt_slot(victim)
         return True
 
-    def _preempt_slot(self, slot_id: int) -> None:
+    def _preempt_slot(self, slot_id: int, reason: str = "no-kv-blocks") -> None:
         """Preempt one running request: its generated tokens + sampling
         params ARE the snapshot (greedy resume re-prefills
         ``context_tokens`` and continues bit-identically — see
         ``_Request.context_tokens``). Free the slot and its worst-case
         block reservation, then requeue at the front of its class so
-        resume latency is bounded by the pressure, not the backlog."""
+        resume latency is bounded by the pressure, not the backlog.
+        ``reason`` labels the flight event: ``no-kv-blocks`` (the QoS
+        pressure path) or ``drain`` (drain-before-terminate)."""
         slot = self.slots[slot_id]
         request = slot.request
         now = time.monotonic()
@@ -2063,7 +2216,7 @@ class TpuServingEngine:
             self._m_preempt_hist(now - request.admit_time)
         self.flight.event(
             "preempt",
-            reason="no-kv-blocks",
+            reason=reason,
             priority=request.priority,
             tenant=request.tenant,
             generated=len(request.generated),
@@ -2276,6 +2429,8 @@ class TpuServingEngine:
                 or not self.scheduler.empty()
                 or self._stop
                 or self._has_prefilling()
+                # a pending drain preempts at the loop's safe point
+                or (self._draining and not self._drain_pass_done)
             ):
                 return
 
@@ -2299,6 +2454,11 @@ class TpuServingEngine:
         down — and re-pay its teardown/rebuild — once per completion. The
         sequential reference loop keeps the yield-on-finish behavior."""
         if self._stop or self._has_prefilling():
+            return True
+        if self._draining and not self._drain_pass_done:
+            # a pending drain must reach the loop's safe point NOW: the
+            # preempt-and-requeue sweep snapshots every running request
+            # after the current chunk, not after the whole burst
             return True
         if finished:
             # a freed slot is an admission opportunity the moment anyone
@@ -3315,6 +3475,7 @@ class TpuServingEngine:
                 # of the request-rate/TTFT metrics (a disconnect storm must
                 # not read as healthy throughput) and skip the decode
                 continue
+            self.completed_requests += 1
             self._m_requests()
             if request.first_token_time is not None:
                 self._m_ttft(request.first_token_time - request.enqueue_time)
@@ -3416,6 +3577,9 @@ def flight_report(
             # extra engine surface — and a saved dump self-diagnoses a
             # wedge post mortem (engine_top --analyze)
             "health": engine.health(),
+            # drain posture: the autoscaler's fan-in reads draining/shed
+            # counts off the same summary (no extra engine surface)
+            "drain": engine._drain_section(),
         }
         slo = engine.slo_status()
         if slo is not None:
@@ -3455,6 +3619,27 @@ def kick_warmups() -> None:
             and not engine._stop
         ):
             engine._warmup_begun()
+
+
+async def drain_engines(grace_s: float = 30.0) -> dict[str, Any]:
+    """Drain every live serving engine (the pod ``/drain`` endpoint and
+    the k8s preStop hook land here): per-model drain reports, each with
+    requeued/completed/shed counts. ``grace_s`` budgets the WHOLE pod,
+    not each engine: every preStop/terminationGracePeriod/drain-HTTP
+    timeout upstream is sized to one grace, so a multi-model pod must
+    fit the same envelope — each engine drains under the time remaining
+    to the shared deadline (a small floor keeps the last engines' sweep:
+    their leftovers still fail explicitly, never silently). Engines
+    drain sequentially — they share one event loop and one device, so a
+    concurrent drain buys nothing and interleaves the flight evidence."""
+    with TpuServingEngine._instances_lock:
+        engines = list(TpuServingEngine._instances.values())
+    deadline = time.monotonic() + grace_s
+    reports: dict[str, Any] = {}
+    for engine in engines:
+        remaining = max(0.5, deadline - time.monotonic())
+        reports[engine.config.model] = await engine.drain(remaining)
+    return reports
 
 
 def profile_engines(action: str, trace_dir: str | None = None) -> dict[str, bool]:

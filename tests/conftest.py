@@ -22,6 +22,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache (repo-local, gitignored): the suite is
+# dominated by jit compiles of the same tiny-model programs, and a warm
+# cache cuts a full run by minutes on a 2-vCPU box. Keyed by HLO hash +
+# compile options + jax version, so correctness is jax's guarantee; set
+# LS_TPU_TEST_JAX_CACHE=0 to measure cold-compile behavior.
+if os.environ.get("LS_TPU_TEST_JAX_CACHE", "1") != "0":
+    _cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".cache", "jax",
+    )
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 import asyncio  # noqa: E402
 
 import pytest  # noqa: E402

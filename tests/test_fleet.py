@@ -1,0 +1,1597 @@
+"""Fleet plane tests: autoscaler, drain-before-terminate, replica router.
+
+Layers covered: AutoscaleSpec parsing/validation (deploy → 400 on a
+malformed section), the decide() hysteresis/cooldown state machine on a
+fake clock, the step() apply path (drain-before-terminate ordering,
+cooldown refusals in the decision ring), the engine drain() round trip —
+including the acceptance byte-identity: a generation preempted by drain
+completes identically to an undisturbed run — the pod ``/drain``
+endpoint + readiness gating, the k8s manifests (preStop hook, PDB) and
+the operator's autoscaled-replica preservation, the compute runtime's
+scale/observe/drain surface over the in-memory kube API, the gateway
+replica router (least-loaded, affinity, never a draining/wedged/
+unreachable member) with the runner-side header honoring, the
+``engine_top`` fleet panel + scale-thrash flag, and the chaos e2e:
+flood until scale-up fires over a fake kube, then starve until
+scale-down drains the victim, with zero lost requests.
+"""
+
+import asyncio
+import importlib.util
+import json
+import socket
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+from langstream_tpu.controlplane.autoscaler import (
+    AUTOSCALE_ANNOTATION,
+    AutoscaleSpec,
+    Decision,
+    FleetAutoscaler,
+    ReplicaObservation,
+    application_autoscale_spec,
+    observation_from_summary,
+    validate_application_autoscale,
+)
+from langstream_tpu.gateway.router import (
+    BOUNCE_HEADER,
+    REPLICA_HEADER,
+    ReplicaRouter,
+    split_replica_target,
+)
+from langstream_tpu.k8s.client import InMemoryKubeApi
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _close_engines():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    with TpuServingEngine._instances_lock:
+        engines = list(TpuServingEngine._instances.values())
+    for engine in engines:
+        await engine.close()
+
+
+def _load_engine_top():
+    path = Path(__file__).resolve().parents[1] / "tools" / "engine_top.py"
+    spec = importlib.util.spec_from_file_location("engine_top", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _fleet_config():
+    from langstream_tpu.serving.engine import ServingConfig
+
+    # f32 + paged: greedy streams are exactly shape-independent, so a
+    # drain-preempted request's resume is bit-identical (the same
+    # posture test_qos pins for KV-pressure preemption)
+    return ServingConfig(
+        model="tiny", slots=2, max_seq_len=128, decode_chunk=4,
+        model_dtype="float32", kv_layout="paged", kv_block_size=16,
+        kv_pool_blocks=16, prefix_cache=False,
+    )
+
+
+# --------------------------------------------------------------------------
+# AutoscaleSpec parsing + deploy validation
+# --------------------------------------------------------------------------
+
+
+def test_autoscale_spec_roundtrip():
+    spec = AutoscaleSpec.from_dict(
+        {
+            "min-replicas": 2,
+            "max-replicas": 6,
+            "scale-up-window-s": 10,
+            "scale-down-window-s": 60,
+            "cooldown-s": 30,
+            "queue-depth-per-replica": 4,
+            "agent": "ai",
+        }
+    )
+    assert spec.min_replicas == 2 and spec.max_replicas == 6
+    assert spec.agent == "ai"
+    assert AutoscaleSpec.from_dict(spec.to_dict()) == spec
+    assert AutoscaleSpec.from_dict(None) is None
+    assert AutoscaleSpec.from_dict(spec) is spec
+
+
+@pytest.mark.parametrize(
+    "bad, msg",
+    [
+        ({"min-replicas": 0}, "min-replicas must be >= 1"),
+        ({"min-replicas": 3, "max-replicas": 2}, "must be >= "),
+        ({"cooldown-s": -1}, "cooldown-s must be >= 0"),
+        ({"drain-grace-s": 0}, "drain-grace-s must be > 0"),
+        ({"kv-reserved": 1.5}, "kv-reserved must be in"),
+        ({"idle-occupancy": 1.0}, "idle-occupancy must be in"),
+        ({"queue-depth-per-replica": 0}, "must be > 0"),
+        ({"shed-delta": 0}, "shed-delta must be >= 1"),
+        ({"replicas": 4}, "unknown key"),
+        ("everything", "must be a mapping"),
+    ],
+)
+def test_autoscale_spec_validation_rejects(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        AutoscaleSpec.from_dict(bad)
+
+
+def test_validate_application_autoscale():
+    class _Res:
+        type = "tpu-serving-configuration"
+        configuration = {"autoscale": {"min-replicas": 0}}
+
+    class _App:
+        resources = {"tpu": _Res()}
+
+    with pytest.raises(ValueError, match="tpu.*invalid autoscale"):
+        validate_application_autoscale(_App())
+    _Res.configuration = {"autoscale": None}
+    validate_application_autoscale(_App())  # missing section is fine
+    assert application_autoscale_spec(_App()) is None
+    _Res.configuration = {"autoscale": {"max-replicas": 3}}
+    spec = application_autoscale_spec(_App())
+    assert spec is not None and spec.max_replicas == 3
+    _Res.configuration = {"autoscale": {"enabled": False}}
+    assert application_autoscale_spec(_App()) is None
+
+
+# --------------------------------------------------------------------------
+# decide(): hysteresis + signals (fake clock, pure)
+# --------------------------------------------------------------------------
+
+
+def _scaler(spec_dict, clock, backend=None):
+    return FleetAutoscaler(
+        AutoscaleSpec.from_dict(spec_dict), backend, clock=lambda: clock[0]
+    )
+
+
+def _obs(replica="app-0", **kw):
+    return {"replica": replica, "slots": 8, **kw}
+
+
+def test_decide_pressure_needs_a_full_window_and_blips_reset():
+    clock = [0.0]
+    scaler = _scaler(
+        {"max-replicas": 3, "scale-up-window-s": 10,
+         "queue-depth-per-replica": 4},
+        clock,
+    )
+    busy = [_obs(queued=40)]
+    calm = [_obs(queued=0)]
+    assert scaler.decide(busy).action == "none"  # streak just began
+    clock[0] = 5.0
+    assert scaler.decide(busy).action == "none"  # half a window
+    clock[0] = 7.0
+    assert scaler.decide(calm).action == "none"  # blip: streak resets
+    clock[0] = 12.0
+    assert scaler.decide(busy).action == "none"  # fresh streak at t=12
+    clock[0] = 23.0
+    decision = scaler.decide(busy)
+    assert decision.action == "up" and decision.target == 2
+    assert any("queue depth" in r for r in decision.reasons)
+    assert decision.evidence["pressure_for_s"] >= 10.0
+
+
+def test_decide_clamps_at_max_and_reports_why():
+    clock = [0.0]
+    scaler = _scaler(
+        {"max-replicas": 2, "scale-up-window-s": 0,
+         "queue-depth-per-replica": 1},
+        clock,
+    )
+    fleet = [_obs("a-0", queued=9), _obs("a-1", queued=9)]
+    decision = scaler.decide(fleet)
+    assert decision.action == "none"
+    assert any("max-replicas" in r for r in decision.reasons)
+
+
+def test_decide_signals_kv_shed_slo_degraded():
+    clock = [0.0]
+    scaler = _scaler({"scale-up-window-s": 0}, clock)
+    # KV saturation on one replica
+    d = scaler.decide([_obs(kv_used=0.99), _obs("app-1")])
+    assert d.action == "up" and any("KV reservation" in r for r in d.reasons)
+    # shed delta between observations
+    scaler2 = _scaler({"scale-up-window-s": 0}, clock)
+    assert scaler2.decide([_obs(shed_total=5)]).action == "none"  # baseline
+    d = scaler2.decide([_obs(shed_total=9)])
+    assert d.action == "up" and any("shed" in r for r in d.reasons)
+    # SLO fast burn
+    scaler3 = _scaler({"scale-up-window-s": 0}, clock)
+    d = scaler3.decide([_obs(slo_alerting=("ttft",))])
+    assert d.action == "up" and any("SLO fast burn" in r for r in d.reasons)
+    # degraded health (recompile storm / overlap collapse predicates)
+    scaler4 = _scaler({"scale-up-window-s": 0}, clock)
+    d = scaler4.decide([_obs(state="degraded")])
+    assert d.action == "up" and any("degraded" in r for r in d.reasons)
+
+
+def test_decide_wedged_replicas_do_not_count_as_capacity():
+    """A wedged pod's queue is meaningless and its 'capacity' serves
+    nothing: per-replica thresholds divide by HEALTHY replicas only."""
+    clock = [0.0]
+    scaler = _scaler(
+        {"scale-up-window-s": 0, "queue-depth-per-replica": 4,
+         "max-replicas": 4},
+        clock,
+    )
+    fleet = [_obs("a-0", queued=5), _obs("a-1", state="wedged", queued=0)]
+    decision = scaler.decide(fleet)
+    assert decision.action == "up"  # 5 queued / 1 healthy > 4
+
+
+def test_decide_scale_down_needs_idle_window_and_full_visibility():
+    clock = [0.0]
+    scaler = _scaler(
+        {"min-replicas": 1, "max-replicas": 3, "scale-down-window-s": 20,
+         "idle-occupancy": 0.2},
+        clock,
+    )
+    idle = [_obs("a-0", queued=0, occupancy=0), _obs("a-1", queued=0)]
+    assert scaler.decide(idle).action == "none"
+    clock[0] = 25.0
+    decision = scaler.decide(idle)
+    assert decision.action == "down" and decision.target == 1
+    # an unreachable replica blocks scale-down: the missing pod may hold
+    # work the observation cannot see
+    scaler2 = _scaler(
+        {"scale-down-window-s": 0, "idle-occupancy": 0.2}, clock
+    )
+    blocked = [_obs("a-0"), {"replica": "a-1", "unreachable": True}]
+    assert scaler2.decide(blocked).action == "none"
+    # at min-replicas nothing fires
+    scaler3 = _scaler({"scale-down-window-s": 0}, clock)
+    assert scaler3.decide([_obs("a-0")]).action == "none"
+
+
+# --------------------------------------------------------------------------
+# step(): cooldown gate + drain-before-terminate ordering
+# --------------------------------------------------------------------------
+
+
+class _ScriptedBackend:
+    """Fake backend with a scripted observation list and a call log."""
+
+    def __init__(self, observations):
+        self.observations = observations
+        self.calls = []
+
+    def observe(self):
+        return self.observations
+
+    def set_replicas(self, n):
+        self.calls.append(("set_replicas", n))
+
+    def drain(self, replica, grace_s):
+        self.calls.append(("drain", replica))
+        return {"requeued": 1, "completed": 1, "shed": 0}
+
+
+def test_step_scales_up_once_then_cooldown_refuses(run_async):
+    clock = [100.0]
+    backend = _ScriptedBackend([_obs(queued=50)])
+    scaler = FleetAutoscaler(
+        AutoscaleSpec.from_dict(
+            {"max-replicas": 3, "scale-up-window-s": 0, "cooldown-s": 60,
+             "queue-depth-per-replica": 4}
+        ),
+        backend,
+        clock=lambda: clock[0],
+    )
+
+    async def main():
+        entry = await scaler.step()
+        assert entry["outcome"] == "scaled" and entry["action"] == "up"
+        assert backend.calls == [("set_replicas", 2)]
+        # pressure persists; the cooldown refuses the second write and
+        # the refusal lands in the decision ring with the remaining time
+        clock[0] = 110.0
+        entry = await scaler.step()
+        assert entry["outcome"] == "cooldown"
+        assert entry["cooldown_remaining_s"] == pytest.approx(50.0)
+        assert backend.calls == [("set_replicas", 2)]
+        status = scaler.status()
+        assert status["scale_ups"] == 1
+        assert [d["outcome"] for d in status["decisions"]] == [
+            "scaled", "cooldown",
+        ]
+        json.dumps(status)  # the /autoscaler route serves this verbatim
+
+    run_async(main())
+
+
+def test_step_drains_highest_ordinal_before_decrementing(run_async):
+    clock = [0.0]
+    backend = _ScriptedBackend(
+        [_obs("app-0"), _obs("app-1"), _obs("app-2")]
+    )
+    scaler = FleetAutoscaler(
+        AutoscaleSpec.from_dict(
+            {"min-replicas": 1, "max-replicas": 3,
+             "scale-down-window-s": 0, "cooldown-s": 0}
+        ),
+        backend,
+        clock=lambda: clock[0],
+    )
+
+    async def main():
+        entry = await scaler.step()
+        assert entry["action"] == "down" and entry["outcome"] == "scaled"
+        # the victim is the highest ordinal (the pod the STS controller
+        # deletes first) and it drains BEFORE the replica write
+        assert backend.calls == [("drain", "app-2"), ("set_replicas", 2)]
+        assert entry["victim"] == "app-2"
+        assert entry["drain"]["requeued"] == 1
+
+    run_async(main())
+
+
+def test_step_scale_down_write_failure_retries_without_redrain(run_async):
+    """A scale-down whose drain succeeded but whose replica write failed
+    must not strand the drained pod as a zombie: the failure lands in
+    the decision ring WITH the drain evidence, and the next tick retries
+    the write alone — no second drain, no waiting out a fresh idle
+    streak around a pod that now sheds everything it's assigned."""
+    clock = [0.0]
+
+    class _FlakyBackend(_ScriptedBackend):
+        fail_next_set = True
+
+        def set_replicas(self, n):
+            if self.fail_next_set:
+                self.fail_next_set = False
+                raise RuntimeError("k8s api momentarily away")
+            super().set_replicas(n)
+
+    backend = _FlakyBackend([_obs("app-0"), _obs("app-1"), _obs("app-2")])
+    scaler = FleetAutoscaler(
+        AutoscaleSpec.from_dict(
+            {"min-replicas": 1, "max-replicas": 3,
+             "scale-down-window-s": 0, "cooldown-s": 30}
+        ),
+        backend,
+        clock=lambda: clock[0],
+    )
+
+    async def main():
+        with pytest.raises(RuntimeError):
+            await scaler.step()
+        assert backend.calls == [("drain", "app-2")]
+        failed = scaler.decisions[-1]
+        assert failed["outcome"] == "apply-failed"
+        assert failed["drain"]["requeued"] == 1
+        # next tick: the write lands exactly once, with NO second drain
+        clock[0] = 5.0
+        entry = await scaler.step()
+        assert entry["outcome"] == "scaled" and entry.get("retried") is True
+        assert backend.calls == [("drain", "app-2"), ("set_replicas", 2)]
+        assert scaler.scale_downs == 1
+        # the cooldown clock starts when the scale LANDED, not when the
+        # (possibly grace-budget-long) drain began
+        assert scaler._last_scale_t == 5.0
+
+    run_async(main())
+
+
+def test_pending_apply_tick_still_feeds_the_observation_hook(run_async):
+    """A k8s-API flake mid scale-down must not starve the gateway
+    router's fleet feed: the retry tick runs the observation hook and
+    refreshes the /autoscaler snapshot before finishing the apply."""
+    clock = [0.0]
+
+    class _FlakyBackend(_ScriptedBackend):
+        fail_next_set = True
+
+        def set_replicas(self, n):
+            if self.fail_next_set:
+                self.fail_next_set = False
+                raise RuntimeError("k8s api momentarily away")
+            super().set_replicas(n)
+
+    backend = _FlakyBackend([_obs("app-0"), _obs("app-1")])
+    fed = []
+    scaler = FleetAutoscaler(
+        AutoscaleSpec.from_dict(
+            {"min-replicas": 1, "max-replicas": 2,
+             "scale-down-window-s": 0, "cooldown-s": 0}
+        ),
+        backend,
+        clock=lambda: clock[0],
+        on_observation=lambda snap: fed.append(len(snap)),
+    )
+
+    async def main():
+        with pytest.raises(RuntimeError):
+            await scaler.step()
+        clock[0] = 5.0
+        entry = await scaler.step()
+        assert entry["outcome"] == "scaled"
+        assert fed == [2, 2]  # both ticks fed the router
+        assert scaler.status()["replicas"]  # snapshot stayed fresh
+
+    run_async(main())
+
+
+def test_refusal_decisions_collapse_instead_of_flooding_the_ring(run_async):
+    """A fleet pinned at max-replicas under sustained pressure records
+    one refusal per 5 s tick: steady-state clamps collapse into their
+    transition entry (repeats + last_m_s) so the bounded ring keeps the
+    scale/drain history an operator needs post-incident."""
+    clock = [0.0]
+    backend = _ScriptedBackend([_obs(queued=50)])
+    scaler = FleetAutoscaler(
+        AutoscaleSpec.from_dict(
+            {"min-replicas": 1, "max-replicas": 1, "scale-up-window-s": 0,
+             "queue-depth-per-replica": 4}
+        ),
+        backend,
+        clock=lambda: clock[0],
+    )
+
+    async def main():
+        for tick in range(5):
+            clock[0] = tick * 5.0
+            entry = await scaler.step()
+            assert entry["outcome"] == "clamped"
+        assert len(scaler.decisions) == 1
+        only = scaler.decisions[0]
+        assert only["repeats"] == 4
+        assert only["last_m_s"] == 20.0
+        assert only["m_s"] == 0.0  # the transition stamp survives
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# engine drain: byte-identity, shed semantics, grace expiry
+# --------------------------------------------------------------------------
+
+
+def test_drain_grace_expiry_sheds_leftovers_explicitly(run_async, monkeypatch):
+    """A wedged loop (admission gated shut) cannot finish its backlog:
+    the grace budget expires and every leftover fails with RateLimited
+    (retry_after > 0) — explicitly shed, never silently lost."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+    from langstream_tpu.serving.qos import RateLimited
+
+    async def main():
+        engine = TpuServingEngine(
+            ServingConfig(model="tiny", slots=2, max_seq_len=64, decode_chunk=4)
+        )
+        try:
+            gate = asyncio.Event()
+            real_admit = engine._admit
+
+            async def wedged_admit(loop):
+                await gate.wait()
+                await real_admit(loop)
+
+            monkeypatch.setattr(engine, "_admit", wedged_admit)
+            stuck = asyncio.ensure_future(
+                engine.generate("stuck request", {"max-tokens": 4})
+            )
+            await asyncio.sleep(0.05)
+            report = await engine.drain(grace_s=0.3)
+            assert report["shed"] >= 1
+            with pytest.raises(RateLimited) as exc:
+                await stuck
+            assert exc.value.retry_after > 0
+            gate.set()
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_drain_engines_budget_is_shared_across_engines(run_async, monkeypatch):
+    """grace_s budgets the WHOLE pod: every preStop/termination-grace/
+    drain-HTTP timeout upstream is sized to one grace, so a multi-model
+    pod's engines split the deadline instead of each taking the full
+    budget (2 engines x 45 s would blow the 90 s termination grace with
+    nothing left for the broker drain)."""
+    from langstream_tpu.serving import engine as engine_mod
+
+    class _FakeEngine:
+        def __init__(self, name, cost_s):
+            self.config = type("C", (), {"model": name})()
+            self.cost_s = cost_s
+            self.granted = None
+
+        async def drain(self, grace_s):
+            self.granted = grace_s
+            await asyncio.sleep(self.cost_s)
+            return {"requeued": 0, "completed": 0, "shed": 0}
+
+    slow, fast = _FakeEngine("slow", 0.2), _FakeEngine("fast", 0.0)
+    monkeypatch.setattr(
+        engine_mod.TpuServingEngine, "_instances",
+        {"a": slow, "b": fast},
+    )
+
+    async def main():
+        reports = await engine_mod.drain_engines(grace_s=1.0)
+        assert set(reports) == {"slow", "fast"}
+        assert slow.granted == pytest.approx(1.0, abs=0.05)
+        # the first engine's spend came out of the second's budget
+        assert 0.5 <= fast.granted <= 0.9
+
+    run_async(main())
+
+
+def test_healthz_fails_an_orphaned_drain(run_async):
+    """A drain is supposed to end in termination. When it never comes
+    (control plane died mid scale-down, stray /drain call), the pod must
+    not be a permanent zero-capacity zombie — liveness flips 503 once
+    the drain has outlived any budget that could still be waiting on it,
+    and the kubelet recycles the pod back into capacity."""
+    from langstream_tpu.runtime.pod import PodHealth, _probe_healthz
+
+    async def main():
+        await _close_engines()
+        health = PodHealth()
+        health.agent_ready = True
+        status, _ = _probe_healthz(health)
+        assert status == 200
+        health.mark_draining(grace_s=30)
+        status, body = _probe_healthz(health)
+        assert status == 200  # a fresh drain is not an orphan
+        assert body["drain_expired"] is False
+        health.draining_since -= 1000  # far past 3x grace
+        status, body = _probe_healthz(health)
+        assert status == 503
+        assert body["drain_expired"] is True
+        assert body["status"] == "drain-expired"
+
+    run_async(main())
+
+
+def test_pod_drain_endpoint_flips_readiness(run_async, monkeypatch):
+    """The /drain endpoint (the preStop hook's target): answers the
+    per-model drain reports, flips /ready to 503 with a draining
+    blocker, and leaves /healthz alone (draining is not wedged)."""
+    from langstream_tpu.runtime.pod import PodHealth, _serve_info
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        await _close_engines()
+        engine = TpuServingEngine.get_or_create(
+            ServingConfig(model="tiny", slots=2, max_seq_len=64, decode_chunk=4)
+        )
+        port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(port))
+        health = PodHealth()
+        health.agent_ready = True
+        server = await _serve_info(None, health=health)
+        session = aiohttp.ClientSession()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # an idle engine drains instantly — the endpoint semantics
+            # (reports + readiness flip) are what this test pins; the
+            # loaded-drain path is the chaos e2e's job
+            async with session.get(f"{base}/ready") as resp:
+                assert resp.status == 200
+            async with session.get(f"{base}/drain?grace-s=30") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+            assert body["draining"] is True
+            assert body["engines"]["tiny"]["shed"] == 0
+            async with session.get(f"{base}/ready") as resp:
+                assert resp.status == 503
+                blockers = (await resp.json())["blockers"]
+            assert "draining" in blockers
+            assert any("engine:tiny:draining" == b for b in blockers)
+            async with session.get(f"{base}/healthz") as resp:
+                assert resp.status == 200  # draining is not wedged
+        finally:
+            await session.close()
+            server.close()
+            await engine.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# k8s manifests: preStop + PDB; operator preservation; compute surface
+# --------------------------------------------------------------------------
+
+
+def _agent_cr(parallelism=1):
+    from langstream_tpu.k8s.crds import (
+        AgentCustomResource,
+        AgentResourcesCR,
+        AgentSpec,
+    )
+
+    return AgentCustomResource(
+        name="chat-ai",
+        namespace="langstream-t1",
+        spec=AgentSpec(
+            tenant="t1",
+            application_id="chat",
+            agent_id="ai",
+            image="img",
+            agent_config_secret_ref="cfg",
+            agent_config_secret_ref_checksum="abc",
+            resources=AgentResourcesCR(parallelism=parallelism),
+        ),
+    )
+
+
+def test_statefulset_prestop_drain_and_pdb():
+    from langstream_tpu.k8s.resources import AgentResourcesFactory
+
+    sts = AgentResourcesFactory.generate_statefulsets(_agent_cr())[0]
+    pod_spec = sts["spec"]["template"]["spec"]
+    container = pod_spec["containers"][0]
+    pre_stop = container["lifecycle"]["preStop"]["httpGet"]
+    assert pre_stop["path"].startswith("/drain?grace-s=")
+    assert pre_stop["port"] == 8080
+    # the kubelet must not SIGKILL a pod mid-requeue: termination grace
+    # strictly exceeds the drain budget the hook hands the engines
+    grace = float(pre_stop["path"].split("=")[1])
+    assert pod_spec["terminationGracePeriodSeconds"] > grace
+
+    pdbs = AgentResourcesFactory.generate_pod_disruption_budgets(_agent_cr())
+    assert len(pdbs) == 1
+    pdb = pdbs[0]
+    assert pdb["kind"] == "PodDisruptionBudget"
+    assert pdb["spec"]["maxUnavailable"] == 1
+    assert pdb["spec"]["selector"] == sts["spec"]["selector"]
+    assert pdb["metadata"]["name"] == sts["metadata"]["name"]
+
+
+def test_operator_preserves_autoscaled_replicas_and_applies_pdb():
+    from langstream_tpu.k8s.operator import AgentController
+
+    api = InMemoryKubeApi()
+    controller = AgentController(api)
+    cr = _agent_cr(parallelism=1)
+    cr_dict = {
+        "apiVersion": "langstream.tpu/v1alpha1",
+        "kind": "Agent",
+        "metadata": {"name": cr.name, "namespace": cr.namespace},
+        "spec": {
+            "tenant": "t1",
+            "applicationId": "chat",
+            "agentId": "ai",
+            "image": "img",
+            "agentConfigSecretRef": "cfg",
+            "agentConfigSecretRefChecksum": "abc",
+            "resources": {"parallelism": 1, "size": 1},
+        },
+    }
+    api.apply(cr_dict)
+    controller.reconcile(api.get("Agent", cr.namespace, cr.name))
+    sts = api.get("StatefulSet", cr.namespace, "chat-ai")
+    assert sts["spec"]["replicas"] == 1
+    assert api.get("PodDisruptionBudget", cr.namespace, "chat-ai") is not None
+
+    # the autoscaler scales to 3 and stamps its annotation ...
+    sts["spec"]["replicas"] = 3
+    sts["metadata"].setdefault("annotations", {})[AUTOSCALE_ANNOTATION] = "true"
+    api.apply(sts)
+    # ... and the next reconcile preserves the live count instead of
+    # resetting it to the CR's parallelism
+    controller.reconcile(api.get("Agent", cr.namespace, cr.name))
+    sts = api.get("StatefulSet", cr.namespace, "chat-ai")
+    assert sts["spec"]["replicas"] == 3
+    assert sts["metadata"]["annotations"][AUTOSCALE_ANNOTATION] == "true"
+
+    # without the stamp, the CR's parallelism wins again (a manual
+    # kubectl scale on a non-autoscaled app is reverted by design)
+    del sts["metadata"]["annotations"][AUTOSCALE_ANNOTATION]
+    sts["spec"]["replicas"] = 5
+    api.apply(sts)
+    controller.reconcile(api.get("Agent", cr.namespace, cr.name))
+    assert api.get("StatefulSet", cr.namespace, "chat-ai")["spec"][
+        "replicas"
+    ] == 1
+
+
+def test_compute_scale_observe_drain_surface():
+    from langstream_tpu.k8s.compute import (
+        KubernetesComputeRuntime,
+        StatefulSetFleetBackend,
+    )
+
+    api = InMemoryKubeApi()
+    api.apply(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": "chat-ai",
+                "namespace": "langstream-t1",
+                "labels": {"langstream-application": "chat"},
+            },
+            "spec": {
+                "serviceName": "chat-ai",
+                "replicas": 2,
+                "template": {"spec": {"containers": [{"name": "runtime"}]}},
+            },
+        }
+    )
+    # a multi-host slice STS must never be offered for scaling: its
+    # replica count is the slice's HOST count, not serving capacity
+    api.apply(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": "chat-big-r0",
+                "namespace": "langstream-t1",
+                "labels": {"langstream-application": "chat"},
+            },
+            "spec": {
+                "serviceName": "chat-big",
+                "replicas": 2,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "runtime",
+                                "env": [
+                                    {"name": "LS_SLICE_HOSTS", "value": "2"}
+                                ],
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    )
+    rt = KubernetesComputeRuntime.__new__(KubernetesComputeRuntime)
+    rt.api = api
+    rt.logs = {}
+    scalable = rt.serving_statefulsets("t1", "chat")
+    assert [s["metadata"]["name"] for s in scalable] == ["chat-ai"]
+
+    rt.scale_statefulset("t1", "chat", "chat-ai", 3)
+    sts = api.get("StatefulSet", "langstream-t1", "chat-ai")
+    assert sts["spec"]["replicas"] == 3
+    assert sts["metadata"]["annotations"][AUTOSCALE_ANNOTATION] == "true"
+
+    # fleet_observe folds the /flight/summary fan-in; unreachable pods
+    # surface as unreachable members of the right STS only
+    rt._pod_json_fanin = lambda t, n, p: [
+        (
+            "chat-ai-0",
+            [
+                {
+                    "model": "tiny",
+                    "slots": 8,
+                    "scheduler": {
+                        "policy": "qos", "depth": 5, "shed": 2,
+                        "classes": {"interactive": {"depth": 3}},
+                    },
+                    "health": {
+                        "state": "ok", "occupancy": 4, "draining": False,
+                    },
+                    "slo": {"alerting": ["ttft"]},
+                    "summary": {"window": {"kv_used_ratio_last": 0.97}},
+                }
+            ],
+        ),
+        ("chat-ai-1", None),
+        ("chat-big-r0-0", [{"model": "big"}]),
+    ]
+    obs = rt.fleet_observe("t1", "chat", "chat-ai")
+    assert len(obs) == 2
+    first = next(o for o in obs if o["replica"] == "chat-ai-0")
+    assert first["queued"] == 5 and first["queue_interactive"] == 3
+    assert first["occupancy"] == 4 and first["slots"] == 8
+    assert first["kv_used"] == 0.97 and first["shed_total"] == 2
+    assert first["slo_alerting"] == ["ttft"]
+    assert next(o for o in obs if o["replica"] == "chat-ai-1")["unreachable"]
+
+    # the lazy backend resolves once the operator materialized the STS
+    backend = StatefulSetFleetBackend(rt, "t1", "chat", None)
+    assert backend.resolve() == "chat-ai"
+    assert len(backend.observe()) == 2
+
+
+def test_observation_from_summary_unreachable_and_worst_state():
+    assert observation_from_summary("p-0", None).unreachable is True
+    obs = observation_from_summary(
+        "p-0",
+        [
+            {"model": "a", "health": {"state": "ok", "occupancy": 1}},
+            {
+                "model": "b",
+                "health": {"state": "degraded", "draining": True},
+                "drain": {"shed": 3},
+            },
+        ],
+    )
+    assert obs.state == "degraded" and obs.draining is True
+    assert obs.shed_total == 3 and obs.occupancy == 1
+    assert observation_from_summary(
+        "p-0", [], healthz={"status": "wedged"}
+    ).state == "wedged"
+
+
+# --------------------------------------------------------------------------
+# replica router + header honoring
+# --------------------------------------------------------------------------
+
+
+def test_router_picks_least_loaded_and_skips_ineligible():
+    clock = [0.0]
+    router = ReplicaRouter(fresh_s=10.0, clock=lambda: clock[0])
+    assert router.pick() is None  # no snapshot yet
+    router.observe(
+        [
+            {"replica": "a-0", "queued": 4, "occupancy": 8, "slots": 8},
+            {"replica": "a-1", "queued": 0, "occupancy": 2, "slots": 8},
+            {"replica": "a-2", "queued": 0, "occupancy": 0, "slots": 8,
+             "draining": True},
+            {"replica": "a-3", "state": "wedged"},
+            {"replica": "a-4", "unreachable": True},
+        ]
+    )
+    assert router.eligible() == ["a-0", "a-1"]
+    for _ in range(8):
+        assert router.pick() == "a-1"  # never the drained/wedged/dead ones
+    # stale snapshots stamp nothing: routing on old evidence is worse
+    # than the topic's default partition spread
+    clock[0] = 20.0
+    assert router.pick() is None
+    assert router.stats()["fresh"] is False
+
+
+def test_router_affinity_pins_until_ineligible():
+    clock = [0.0]
+    router = ReplicaRouter(
+        fresh_s=100.0, affinity_ttl_s=50.0, clock=lambda: clock[0]
+    )
+    router.observe(
+        [
+            {"replica": "a-0", "queued": 0, "occupancy": 0, "slots": 8},
+            {"replica": "a-1", "queued": 3, "occupancy": 4, "slots": 8},
+        ]
+    )
+    assert router.pick("alice") == "a-0"
+    # load flips — but alice stays pinned (her prefix blocks live there)
+    router.observe(
+        [
+            {"replica": "a-0", "queued": 9, "occupancy": 8, "slots": 8},
+            {"replica": "a-1", "queued": 0, "occupancy": 0, "slots": 8},
+        ]
+    )
+    assert router.pick("alice") == "a-0"
+    assert router.pick("bob") == "a-1"  # fresh tenants go least-loaded
+    # the pinned replica drains: affinity breaks immediately
+    router.observe(
+        [
+            {"replica": "a-0", "queued": 0, "occupancy": 0, "slots": 8,
+             "draining": True},
+            {"replica": "a-1", "queued": 0, "occupancy": 0, "slots": 8},
+        ]
+    )
+    assert router.pick("alice") == "a-1"
+    stats = router.stats()
+    assert stats["affinity_hits"] >= 1
+    assert stats["affinity_rerouted"] == 1
+    assert stats["replicas"]["a-0"]["eligible"] is False
+
+
+def test_split_replica_target():
+    assert split_replica_target("chat-ai-2") == ("chat-ai", 2)
+    assert split_replica_target("2") == ("", 2)
+    assert split_replica_target("chat-ai") == ("chat-ai", None)
+
+
+def test_runner_honors_replica_header(run_async):
+    """The consumer half of routing: records stamped for a sibling
+    replica of the SAME agent re-produce to the input topic (bounce
+    header incremented) and commit; records for this replica, for other
+    agents' pods, unstamped, or over the bounce cap process locally."""
+    from langstream_tpu.api.record import SimpleRecord
+    from langstream_tpu.runtime.runner import AgentRunner
+    from langstream_tpu.runtime.tracker import SourceRecordTracker
+
+    class _Producer:
+        def __init__(self):
+            self.written = []
+            self.started = False
+
+        async def start(self):
+            self.started = True
+
+        async def write(self, record):
+            self.written.append(record)
+
+        async def close(self):
+            pass
+
+    class _Runtime:
+        def __init__(self, producer):
+            self.producer = producer
+
+        def create_producer(self, agent_id, config):
+            return self.producer
+
+    class _Input:
+        topic = "in-topic"
+
+    class _Node:
+        input = _Input()
+
+    async def main():
+        runner = AgentRunner.__new__(AgentRunner)
+        runner.node = _Node()
+        runner.agent_id = "chat-ai"
+        runner._routing_base = "chat-ai"
+        runner._routing_ordinal = 0
+        runner._reroute_producer = None
+        runner.records_rerouted = 0
+        producer = _Producer()
+        runner.topics_runtime = _Runtime(producer)
+        committed = []
+
+        async def commit(records):
+            committed.extend(records)
+
+        runner.tracker = SourceRecordTracker(commit)
+
+        mine = SimpleRecord("a", headers=((REPLICA_HEADER, "chat-ai-0"),))
+        unstamped = SimpleRecord("b")
+        other_agent = SimpleRecord(
+            "c", headers=((REPLICA_HEADER, "chat-out-1"),)
+        )
+        sibling = SimpleRecord(
+            "d", headers=((REPLICA_HEADER, "chat-ai-1"),)
+        )
+        capped = SimpleRecord(
+            "e",
+            headers=(
+                (REPLICA_HEADER, "chat-ai-1"),
+                (BOUNCE_HEADER, "2"),
+            ),
+        )
+        kept = await runner._honor_replica_routing(
+            [mine, unstamped, other_agent, sibling, capped]
+        )
+        assert [r.value for r in kept] == ["a", "b", "c", "e"]
+        assert [r.value for r in producer.written] == ["d"]
+        assert producer.written[0].header(BOUNCE_HEADER) == "1"
+        assert [r.value for r in committed] == ["d"]
+        assert runner.records_rerouted == 1
+
+    run_async(main())
+
+
+def test_runner_routing_is_defensive(run_async):
+    """Hostile or unlucky inputs must never kill the consume loop: a
+    garbage bounce header (client-suppliable via gateway payloads) reads
+    as over the cap, a keyed record serves locally (its key hashes back
+    to this very partition — a bounce cannot converge), and a broker
+    failure during the re-produce falls back to local serving instead of
+    becoming the replica's fatal loop error."""
+    from langstream_tpu.api.record import SimpleRecord
+    from langstream_tpu.runtime.runner import AgentRunner
+    from langstream_tpu.runtime.tracker import SourceRecordTracker
+
+    class _BrokenProducer:
+        async def start(self):
+            pass
+
+        async def write(self, record):
+            raise ConnectionResetError("leader election in progress")
+
+        async def close(self):
+            pass
+
+    class _Runtime:
+        def create_producer(self, agent_id, config):
+            return _BrokenProducer()
+
+    class _Input:
+        topic = "in-topic"
+
+    class _Node:
+        input = _Input()
+
+    async def main():
+        runner = AgentRunner.__new__(AgentRunner)
+        runner.node = _Node()
+        runner.agent_id = "chat-ai"
+        runner._routing_base = "chat-ai"
+        runner._routing_ordinal = 0
+        runner._reroute_producer = None
+        runner.records_rerouted = 0
+        runner.topics_runtime = _Runtime()
+        committed = []
+
+        async def commit(records):
+            committed.extend(records)
+
+        runner.tracker = SourceRecordTracker(commit)
+
+        garbage_bounce = SimpleRecord(
+            "a",
+            headers=(
+                (REPLICA_HEADER, "chat-ai-1"),
+                (BOUNCE_HEADER, "not-a-number"),
+            ),
+        )
+        keyed = SimpleRecord(
+            "b", key="tenant-42", headers=((REPLICA_HEADER, "chat-ai-1"),)
+        )
+        broker_down = SimpleRecord(
+            "c", headers=((REPLICA_HEADER, "chat-ai-1"),)
+        )
+        kept = await runner._honor_replica_routing(
+            [garbage_bounce, keyed, broker_down]
+        )
+        # every record survives locally; nothing rerouted, nothing raised
+        assert [r.value for r in kept] == ["a", "b", "c"]
+        assert runner.records_rerouted == 0
+        assert committed == []
+        # the broken producer was dropped so the next bounce rebuilds it
+        assert runner._reroute_producer is None
+
+    run_async(main())
+
+
+def test_gateway_stamps_routing_header():
+    from langstream_tpu.gateway.server import GatewayRegistry, GatewayServer
+
+    registry = GatewayRegistry()
+    server = GatewayServer(registry=registry, port=free_port())
+    # no router yet: nothing stamped
+    headers = {}
+    server._stamp_replica(headers, "t1", "chat", {}, {})
+    assert REPLICA_HEADER not in headers
+    registry.update_fleet(
+        "t1", "chat",
+        [
+            {"replica": "chat-ai-0", "queued": 5, "occupancy": 2, "slots": 4},
+            {"replica": "chat-ai-1", "queued": 0, "occupancy": 0, "slots": 4},
+        ],
+    )
+    headers = {}
+    server._stamp_replica(
+        headers, "t1", "chat", {"tenant": "alice"}, {}
+    )
+    assert headers[REPLICA_HEADER] == "chat-ai-1"
+    # a client-supplied stamp is honored, never overwritten
+    explicit = {REPLICA_HEADER: "chat-ai-0"}
+    server._stamp_replica(explicit, "t1", "chat", {"tenant": "alice"}, {})
+    assert explicit[REPLICA_HEADER] == "chat-ai-0"
+    # unregister drops the router with the app
+    registry.unregister("t1", "chat")
+    assert registry.router("t1", "chat") is None
+
+
+# --------------------------------------------------------------------------
+# control plane: /autoscaler route + deploy validation 400
+# --------------------------------------------------------------------------
+
+
+def test_controlplane_autoscaler_route_and_bad_autoscale_400(run_async):
+    from langstream_tpu.controlplane.server import (
+        ControlPlaneServer,
+        LocalComputeRuntime,
+    )
+    from langstream_tpu.controlplane.stores import InMemoryApplicationStore
+
+    pipeline = """
+module: default
+id: app
+topics:
+  - name: "in-topic"
+    creation-mode: create-if-not-exists
+  - name: "out-topic"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: "svc"
+    type: "ai-chat-completions"
+    input: "in-topic"
+    output: "out-topic"
+    configuration:
+      model: "tiny"
+      completion-field: "value.answer"
+      prompt:
+        - role: user
+          content: "{{% value.q}}"
+"""
+    configuration = """
+configuration:
+  resources:
+    - type: "tpu-serving-configuration"
+      name: "tpu"
+      configuration:
+        model: "tiny"
+        autoscale:
+          min-replicas: 3
+          max-replicas: 2
+"""
+    instance = "instance:\n  streamingCluster:\n    type: memory\n"
+
+    async def main():
+        control = ControlPlaneServer(
+            store=InMemoryApplicationStore(),
+            compute=LocalComputeRuntime(),
+            port=free_port(),
+        )
+        await control.start()
+        session = aiohttp.ClientSession()
+        api = f"http://127.0.0.1:{control.port}"
+        try:
+            async with session.put(f"{api}/api/tenants/t1") as resp:
+                assert resp.status == 200
+            # malformed autoscale: 400 at deploy, before any pod exists
+            async with session.post(
+                f"{api}/api/applications/t1/badfleet",
+                json={
+                    "files": {
+                        "pipeline.yaml": pipeline,
+                        "configuration.yaml": configuration,
+                    },
+                    "instance": instance,
+                },
+            ) as resp:
+                assert resp.status == 400
+                assert "autoscale" in (await resp.text())
+            # an app without an active autoscaler answers enabled: false
+            async with session.get(
+                f"{api}/api/applications/t1/ghost/autoscaler"
+            ) as resp:
+                assert resp.status == 200
+                assert await resp.json() == {"enabled": False}
+        finally:
+            await session.close()
+            await control.stop()
+            await _close_engines()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# engine_top: fleet panel + scale-thrash analyze
+# --------------------------------------------------------------------------
+
+
+def _fleet_payload(decisions=()):
+    return {
+        "enabled": True,
+        "spec": {
+            "min-replicas": 1, "max-replicas": 4, "cooldown-s": 60,
+            "scale-up-window-s": 10, "scale-down-window-s": 120,
+        },
+        "replicas": [
+            {"replica": "chat-ai-0", "queued": 2, "occupancy": 6,
+             "slots": 8, "state": "ok", "draining": False,
+             "slo_alerting": []},
+            {"replica": "chat-ai-1", "queued": 0, "occupancy": 1,
+             "slots": 8, "state": "ok", "draining": True,
+             "slo_alerting": ["ttft"]},
+            {"replica": "chat-ai-2", "unreachable": True},
+        ],
+        "decisions": list(decisions),
+        "scale_ups": 2,
+        "scale_downs": 1,
+        "cooldown_remaining_s": 12.5,
+        "pressure_for_s": 4.0,
+        "idle_for_s": None,
+    }
+
+
+def test_engine_top_renders_fleet_panel():
+    engine_top = _load_engine_top()
+    frame = engine_top.render_fleet(
+        _fleet_payload(
+            [
+                {
+                    "m_s": 100.0, "action": "up", "from": 1, "to": 2,
+                    "outcome": "scaled",
+                    "reasons": ["queue depth 40 over 1 healthy replicas"],
+                    "evidence": {},
+                }
+            ]
+        )
+    )
+    assert "== fleet ==" in frame
+    assert "replicas 3 (min 1 / max 4)" in frame
+    assert "chat-ai-1" in frame and "DRAINING" in frame
+    assert "SLO:ttft" in frame
+    assert "UNREACHABLE" in frame
+    assert "scale    up 1->2 [scaled] queue depth 40" in frame
+    assert "not active" in engine_top.render_fleet({"enabled": False})
+
+
+def test_engine_top_analyze_flags_scale_thrash(tmp_path):
+    engine_top = _load_engine_top()
+    # up/down flip-flops inside one cooldown window: thrash
+    decisions = []
+    t = 0.0
+    for action in ("up", "down", "up", "down", "up"):
+        decisions.append(
+            {"m_s": t, "action": action, "from": 1, "to": 2,
+             "outcome": "scaled", "reasons": []}
+        )
+        t += 5.0
+    text = engine_top.analyze(_fleet_payload(decisions))
+    assert "scale thrash" in text
+    # a well-spaced history stays unflagged
+    calm = [
+        {"m_s": i * 400.0, "action": a, "from": 1, "to": 2,
+         "outcome": "scaled", "reasons": []}
+        for i, a in enumerate(("up", "down", "up", "down"))
+    ]
+    text = engine_top.analyze(_fleet_payload(calm))
+    assert "scale thrash" not in text
+    assert "no scale anomalies" in text
+
+
+# --------------------------------------------------------------------------
+# the chaos acceptance e2e: flood → scale up, starve → drain + scale down
+# --------------------------------------------------------------------------
+
+
+class FakeFleetBackend:
+    """A fake-kube fleet: the StatefulSet lives in InMemoryKubeApi, each
+    'pod' is a REAL in-process serving engine — so scale/drain decisions
+    exercise the true drain/preempt/requeue machinery while the cluster
+    state stays scripted."""
+
+    def __init__(self, api, namespace, sts_name, config):
+        self.api = api
+        self.namespace = namespace
+        self.sts_name = sts_name
+        self.config = config
+        self.engines = {}
+        self.calls = []
+        self._sync_engines()
+
+    def _sts(self):
+        return self.api.get("StatefulSet", self.namespace, self.sts_name)
+
+    def replicas(self) -> int:
+        return int(self._sts()["spec"]["replicas"])
+
+    def _sync_engines(self):
+        from langstream_tpu.serving.engine import TpuServingEngine
+
+        for i in range(self.replicas()):
+            pod = f"{self.sts_name}-{i}"
+            if pod not in self.engines:
+                self.engines[pod] = TpuServingEngine(self.config)
+
+    def observe(self):
+        out = []
+        for i in range(self.replicas()):
+            pod = f"{self.sts_name}-{i}"
+            engine = self.engines.get(pod)
+            if engine is None:
+                out.append({"replica": pod, "unreachable": True})
+                continue
+            stats = engine.stats()
+            health = stats["health"]
+            scheduler = stats["scheduler"]
+            classes = scheduler.get("classes") or {}
+            out.append(
+                {
+                    "replica": pod,
+                    "queued": stats["queued"],
+                    "queue_interactive": (
+                        (classes.get("interactive") or {}).get("depth", 0)
+                    ),
+                    "occupancy": stats["active"],
+                    "slots": stats["slots"],
+                    "shed_total": scheduler.get("shed", 0) or 0,
+                    "state": health["state"],
+                    "draining": health["draining"],
+                    "slo_alerting": tuple(
+                        (stats.get("slo") or {}).get("alerting", ())
+                    ),
+                }
+            )
+        return out
+
+    def set_replicas(self, n: int):
+        self.calls.append(("set_replicas", n))
+        sts = self._sts()
+        sts["spec"]["replicas"] = int(n)
+        sts.setdefault("metadata", {}).setdefault("annotations", {})[
+            AUTOSCALE_ANNOTATION
+        ] = "true"
+        self.api.apply(sts)
+        self._sync_engines()
+
+    async def drain(self, replica: str, grace_s: float):
+        self.calls.append(("drain", replica))
+        engine = self.engines.get(replica)
+        if engine is None:
+            return None
+        return await engine.drain(grace_s)
+
+    async def close(self):
+        for engine in self.engines.values():
+            await engine.close()
+
+
+def test_chaos_flood_scales_up_starve_drains_down_zero_lost(run_async):
+    """The acceptance chaos e2e: flood one replica until the autoscaler
+    scales the fake-kube StatefulSet up, then starve until it drains
+    the victim (highest ordinal) and scales back down — asserting that
+    every submitted request completes or is explicitly shed with a
+    retry hint, that the drain requeues the victim's in-flight
+    generation with byte-identical output, and that the router never
+    selects a draining replica."""
+    from langstream_tpu.gateway.server import GatewayRegistry
+    from langstream_tpu.serving.qos import RateLimited
+
+    api = InMemoryKubeApi()
+    api.apply(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": "chat-ai",
+                "namespace": "langstream-t1",
+                "labels": {"langstream-application": "chat"},
+            },
+            "spec": {
+                "serviceName": "chat-ai",
+                "replicas": 1,
+                "template": {"spec": {"containers": [{"name": "runtime"}]}},
+            },
+        }
+    )
+    spec = AutoscaleSpec.from_dict(
+        {
+            "min-replicas": 1,
+            "max-replicas": 2,
+            "scale-up-window-s": 0,
+            "scale-down-window-s": 0,
+            "cooldown-s": 0,
+            "drain-grace-s": 120,
+            "queue-depth-per-replica": 3,
+            "idle-occupancy": 0.6,
+            # this e2e pins queue-driven scaling + no-loss drain; the
+            # degraded-health signal (own unit tests) stays off because
+            # a CPU flood leaves flood-era KV-saturation samples in the
+            # flight ring that read as lingering scale-up pressure during
+            # the starve phase, blocking the "down" decision
+            "degraded": False,
+        }
+    )
+
+    async def main():
+        backend = FakeFleetBackend(
+            api, "langstream-t1", "chat-ai", _fleet_config()
+        )
+        registry = GatewayRegistry()
+        scaler = FleetAutoscaler(
+            spec,
+            backend,
+            on_observation=lambda obs: registry.update_fleet(
+                "t1", "chat", obs
+            ),
+        )
+        submitted: list[asyncio.Task] = []
+        try:
+            # ---- flood: queue depth past the threshold on one replica
+            eng0 = backend.engines["chat-ai-0"]
+            for i in range(8):
+                submitted.append(
+                    asyncio.ensure_future(
+                        eng0.generate(f"flood request {i}", {"max-tokens": 4})
+                    )
+                )
+            await asyncio.sleep(0)  # let submissions enqueue
+            entry = await scaler.step()
+            assert entry is not None and entry["action"] == "up", entry
+            assert entry["outcome"] == "scaled"
+            assert any("queue depth" in r for r in entry["reasons"])
+            assert backend.replicas() == 2
+            sts = api.get("StatefulSet", "langstream-t1", "chat-ai")
+            assert sts["metadata"]["annotations"][AUTOSCALE_ANNOTATION] == (
+                "true"
+            )
+            # the router consumed the same snapshot the scaler judged
+            assert registry.router("t1", "chat") is not None
+
+            # the flood completes: nothing lost while scaling
+            flood = await asyncio.gather(*submitted, return_exceptions=True)
+            submitted.clear()
+
+            # ---- byte-identity baseline for the victim's generation:
+            # run it undisturbed on the SURVIVOR engine (identical
+            # config + seed → identical weights; f32 greedy is exactly
+            # shape-independent, so batch composition cannot leak in)
+            prompt = "chaos drain victim generation"
+            baseline = await backend.engines["chat-ai-0"].generate(
+                prompt, {"max-tokens": 20}
+            )
+
+            # ---- starve with one generation in flight on the victim
+            eng1 = backend.engines["chat-ai-1"]
+            progressed = asyncio.Event()
+            seen = 0
+
+            def on_token(token, logprob, last):
+                nonlocal seen
+                seen += 1
+                if seen >= 3:
+                    progressed.set()
+
+            victim_task = asyncio.ensure_future(
+                eng1.generate(prompt, {"max-tokens": 20}, on_token=on_token)
+            )
+            submitted.append(victim_task)
+            await asyncio.wait_for(progressed.wait(), timeout=60)
+
+            entry = await scaler.step()
+            assert entry is not None and entry["action"] == "down", entry
+            assert entry["outcome"] == "scaled"
+            assert entry["victim"] == "chat-ai-1"
+            # drain-before-terminate ordering: the victim drained before
+            # the replica count dropped
+            assert backend.calls[-2:] == [
+                ("drain", "chat-ai-1"),
+                ("set_replicas", 1),
+            ]
+            assert backend.replicas() == 1
+            drain_report = entry["drain"]
+            assert drain_report["requeued"] >= 1
+            assert drain_report["shed"] == 0
+
+            # the drained generation completed byte-identically: the
+            # acceptance invariant — preempt-by-drain + front-of-class
+            # resume reproduces the undisturbed stream exactly
+            victim_result = await asyncio.wait_for(victim_task, timeout=60)
+            assert victim_result["tokens"] == baseline["tokens"]
+            assert victim_result["text"] == baseline["text"]
+
+            # the victim engine's evidence trail: drain begin/end events
+            # bracket a preempt with reason="drain"; stats/health carry
+            # the terminal drain posture
+            events = eng1.flight.recent_events(0)
+            stages = [e["stage"] for e in events if e["kind"] == "drain"]
+            assert stages == ["begin", "end"]
+            assert any(
+                e.get("reason") == "drain"
+                for e in events
+                if e["kind"] == "preempt"
+            )
+            section = eng1.stats()["drain"]
+            assert section["draining"] is True
+            assert section["requeued"] >= 1 and section["shed"] == 0
+            health = eng1.health()
+            assert health["draining"] is True and health["ready"] is False
+
+            # the router never selects the drained replica; affinity
+            # lands every tenant on the survivor
+            registry.update_fleet("t1", "chat", backend.observe())
+            router = registry.router("t1", "chat")
+            assert router.eligible() == ["chat-ai-0"]
+            for tenant in ("alice", "bob", None):
+                assert router.pick(tenant) == "chat-ai-0"
+
+            # new arrivals on the drained engine shed explicitly
+            with pytest.raises(RateLimited) as exc:
+                await eng1.generate("late", {"max-tokens": 2})
+            assert exc.value.retry_after > 0
+
+            # ---- the zero-lost ledger: every submitted request either
+            # returned a result or an explicit RateLimited with a retry
+            # hint — nothing vanished
+            for outcome in [*flood, victim_result]:
+                if isinstance(outcome, dict):
+                    assert outcome["tokens"]
+                else:
+                    assert isinstance(outcome, RateLimited)
+                    assert outcome.retry_after > 0
+
+            # the autoscaler status is a serializable operator surface
+            status = scaler.status()
+            assert status["scale_ups"] == 1 and status["scale_downs"] == 1
+            json.dumps(status)
+        finally:
+            for task in submitted:
+                if not task.done():
+                    task.cancel()
+            await backend.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# graftcheck FLEET rules: TP/TN beyond the registry fixtures
+# --------------------------------------------------------------------------
+
+
+def test_fleet601_gated_write_anywhere_up_the_if_chain():
+    """The cooldown gate may sit any number of ifs above the write —
+    what matters is that SOME enclosing condition names it."""
+    import textwrap
+
+    from langstream_tpu.analysis import ALL_RULES, analyze_source
+
+    path = "langstream_tpu/controlplane/autoscaler.py"
+    gated = textwrap.dedent(
+        """
+        def step(self, backend, decision, now):
+            if self._cooldown_ok(now):
+                if decision.action == "up":
+                    backend.set_replicas(decision.target)
+        """
+    )
+    assert [f.rule for f in analyze_source(gated, path, ALL_RULES)] == []
+    ungated = textwrap.dedent(
+        """
+        def step(self, backend, decision, now):
+            if decision.action == "up":
+                backend.set_replicas(decision.target)
+        """
+    )
+    assert [f.rule for f in analyze_source(ungated, path, ALL_RULES)] == [
+        "FLEET601"
+    ]
+    # scale_statefulset is the other write spelling; other modules are
+    # out of scope
+    other = analyze_source(
+        ungated.replace("set_replicas", "scale_statefulset"),
+        path,
+        ALL_RULES,
+    )
+    assert [f.rule for f in other] == ["FLEET601"]
+    assert (
+        analyze_source(
+            ungated, "langstream_tpu/k8s/compute.py", ALL_RULES
+        )
+        == []
+    )
+
+
+def test_fleet602_blocking_in_decision_but_not_in_observe():
+    import textwrap
+
+    from langstream_tpu.analysis import ALL_RULES, analyze_source
+
+    path = "langstream_tpu/controlplane/autoscaler.py"
+    blocking_decide = textwrap.dedent(
+        """
+        import time
+
+        def decide(self, observations, now):
+            time.sleep(0.1)
+            return "none"
+        """
+    )
+    ids = [f.rule for f in analyze_source(blocking_decide, path, ALL_RULES)]
+    assert "FLEET602" in ids
+    lock_in_helper = textwrap.dedent(
+        """
+        def _pressure_reasons(self, obs):
+            with self._lock:
+                return []
+        """
+    )
+    ids = [f.rule for f in analyze_source(lock_in_helper, path, ALL_RULES)]
+    assert "FLEET602" in ids
+    # observe/apply are the sanctioned I/O edges — not policed
+    io_in_observe = textwrap.dedent(
+        """
+        import urllib.request
+
+        def observe(self):
+            with urllib.request.urlopen("http://pod:8080/x") as r:
+                return r.read()
+        """
+    )
+    ids = [f.rule for f in analyze_source(io_in_observe, path, ALL_RULES)]
+    assert "FLEET602" not in ids

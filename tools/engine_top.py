@@ -21,6 +21,11 @@ omitting them.
     python tools/engine_top.py --url http://pod:8080/flight --interval 2
     python tools/engine_top.py --once                   # one frame, no clear
 
+Pointing ``--url`` at the control plane's autoscaler route
+(``/api/applications/{t}/{n}/autoscaler``) renders the FLEET panel
+instead: per-replica occupancy/queue/health rows plus the autoscaler's
+last decisions with their evidence (docs/FLEET.md).
+
 Post-mortem mode decomposes a saved dump — either a raw ``/flight``
 payload (``curl pod:8080/flight > dump.json``) or a bench record whose
 ``flight`` rollup rode along (BENCH_r06+) — into mean-step device/host/
@@ -28,8 +33,9 @@ stall shares and flags anomaly windows: recompile storms, KV-pool
 exhaustion, unbounded queue growth, pipeline overlap collapse
 (sustained ``overlap_ratio`` near 0 while occupancy is high), the
 wedged-device flag (no step progress while work is queued — the r03
-hang shape, read from the dump's ``health`` section), and SLO
-objectives in fast burn.
+hang shape, read from the dump's ``health`` section), SLO objectives in
+fast burn, and — for saved autoscaler payloads — scale thrash (≥3
+direction changes inside one cooldown window).
 
     python tools/engine_top.py --analyze dump.json
     python tools/engine_top.py --analyze BENCH_r06.json
@@ -187,6 +193,67 @@ def render(report: list[dict]) -> str:
     return "\n".join(lines).rstrip()
 
 
+def render_fleet(payload: dict) -> str:
+    """Fleet panel: the autoscaler status payload
+    (``/api/applications/{t}/{n}/autoscaler``) — declared policy, one
+    line per replica (occupancy bar, queue, health/drain posture), and
+    the decision tail with its evidence."""
+    if not payload.get("enabled", True):
+        return "fleet    autoscaler not active for this application"
+    lines: list[str] = []
+    spec = payload.get("spec") or {}
+    lines.append(
+        f"== fleet ==  replicas {len(payload.get('replicas') or [])} "
+        f"(min {spec.get('min-replicas', '?')} / max "
+        f"{spec.get('max-replicas', '?')})   "
+        f"ups {payload.get('scale_ups', 0)}  downs "
+        f"{payload.get('scale_downs', 0)}   cooldown "
+        f"{payload.get('cooldown_remaining_s', 0):g}s left"
+    )
+    pressure = payload.get("pressure_for_s")
+    idle = payload.get("idle_for_s")
+    if pressure is not None:
+        lines.append(
+            f"fleet    scale-up pressure sustained {pressure:g}s "
+            f"(window {spec.get('scale-up-window-s', '?')}s)"
+        )
+    if idle is not None:
+        lines.append(
+            f"fleet    idle {idle:g}s "
+            f"(scale-down window {spec.get('scale-down-window-s', '?')}s)"
+        )
+    for replica in payload.get("replicas") or []:
+        name = replica.get("replica", "?")
+        if replica.get("unreachable"):
+            lines.append(f"replica  {name:24s} UNREACHABLE")
+            continue
+        slots = replica.get("slots") or 0
+        occ = replica.get("occupancy") or 0
+        state = replica.get("state", "ok")
+        badges = []
+        if state != "ok":
+            badges.append(state.upper())
+        if replica.get("draining"):
+            badges.append("DRAINING")
+        if replica.get("slo_alerting"):
+            badges.append(f"SLO:{','.join(replica['slo_alerting'])}")
+        lines.append(
+            f"replica  {name:24s} [{_bar(occ / slots if slots else 0, 12)}] "
+            f"{occ}/{slots}  queue {replica.get('queued', 0)}"
+            + (f"  {' '.join(badges)}" if badges else "")
+        )
+    for decision in (payload.get("decisions") or [])[-6:]:
+        reasons = "; ".join(decision.get("reasons") or []) or "-"
+        drain = decision.get("drain")
+        lines.append(
+            f"scale    {decision.get('action')} "
+            f"{decision.get('from')}->{decision.get('to')} "
+            f"[{decision.get('outcome')}] {reasons}"
+            + (f"  drain={drain}" if drain else "")
+        )
+    return "\n".join(lines)
+
+
 def _render_health(health: dict | None) -> list[str]:
     """Watchdog panel: state (upper-cased when not ok so a wedge jumps
     off the screen), last-step age vs the wedge window, queued/in-flight
@@ -302,6 +369,58 @@ def _collect_flight_dicts(obj, found: list[dict], label: str = "") -> None:
     elif isinstance(obj, list):
         for i, value in enumerate(obj):
             _collect_flight_dicts(value, found, f"{label}[{i}]")
+
+
+def _collect_fleet_dicts(obj, found: list[dict], label: str = "") -> None:
+    """Recursively find autoscaler status payloads (dicts carrying a
+    ``decisions`` list + ``spec``) — the shape an operator saves with
+    ``curl .../autoscaler > fleet.json``."""
+    if isinstance(obj, dict):
+        if isinstance(obj.get("decisions"), list) and isinstance(
+            obj.get("spec"), dict
+        ):
+            found.append({"label": label or "fleet", "src": obj})
+            return
+        for key, value in obj.items():
+            _collect_fleet_dicts(
+                value, found, f"{label}.{key}" if label else str(key)
+            )
+    elif isinstance(obj, list):
+        for i, value in enumerate(obj):
+            _collect_fleet_dicts(value, found, f"{label}[{i}]")
+
+
+def _scale_thrash(decisions: list, cooldown_s: float) -> str | None:
+    """≥3 scale direction changes inside one cooldown window. With the
+    cooldown enforced this is impossible — so when it fires, something
+    bypassed or misconfigured the gate (cooldown near zero, two scalers
+    fighting over one StatefulSet, manual kubectl patches racing the
+    loop), and the fleet paid a schedule+warmup / drain per flip."""
+    window = cooldown_s if cooldown_s > 0 else 300.0
+    scaled = sorted(
+        (
+            d
+            for d in decisions
+            if d.get("outcome") == "scaled"
+            and d.get("action") in ("up", "down")
+            and d.get("m_s") is not None
+        ),
+        key=lambda d: d["m_s"],
+    )
+    changes = [
+        d["m_s"]
+        for prev, d in zip(scaled, scaled[1:])
+        if d["action"] != prev["action"]
+    ]
+    for i in range(len(changes) - 2):
+        if changes[i + 2] - changes[i] <= window:
+            return (
+                f"scale thrash: >=3 direction changes within one cooldown "
+                f"window ({window:g}s) — the cooldown gate is being "
+                f"bypassed or is configured too small; each flip pays a "
+                f"pod schedule + warmup up and a drain down"
+            )
+    return None
 
 
 def _growth(series: list) -> tuple[float, float] | None:
@@ -503,12 +622,33 @@ def analyze(dump) -> str:
     step device/host/stall shares plus anomaly flags."""
     found: list[dict] = []
     _collect_flight_dicts(dump, found)
-    if not found:
+    fleet_found: list[dict] = []
+    _collect_fleet_dicts(dump, fleet_found)
+    if not found and not fleet_found:
         raise ValueError(
-            "no flight data found in the dump (expected a /flight payload "
-            "or a bench record with a 'flight' rollup)"
+            "no flight data found in the dump (expected a /flight payload, "
+            "a bench record with a 'flight' rollup, or an autoscaler "
+            "status payload)"
         )
     lines: list[str] = []
+    for item in fleet_found:
+        payload = item["src"]
+        decisions = payload.get("decisions") or []
+        spec = payload.get("spec") or {}
+        lines.append(f"== fleet {item['label']} ==")
+        lines.append(
+            f"replicas {len(payload.get('replicas') or [])}  decisions "
+            f"{len(decisions)}  ups {payload.get('scale_ups', 0)}  downs "
+            f"{payload.get('scale_downs', 0)}"
+        )
+        thrash = _scale_thrash(
+            decisions, float(spec.get("cooldown-s", 0) or 0)
+        )
+        if thrash:
+            lines.append(f"  !! {thrash}")
+        else:
+            lines.append("  no scale anomalies flagged")
+        lines.append("")
     for item in found:
         entry = item["src"]
         summary = entry.get("summary") or entry
@@ -588,10 +728,15 @@ def analyze(dump) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _fetch(url: str, timeout: float = 5.0) -> list[dict]:
+def _fetch(url: str, timeout: float = 5.0):
+    """The /flight report list — or the autoscaler status dict when the
+    URL points at the control plane's /autoscaler route (main() renders
+    the fleet panel for dict payloads)."""
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         payload = json.loads(resp.read())
-    return payload if isinstance(payload, list) else []
+    if isinstance(payload, (list, dict)):
+        return payload
+    return []
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -629,7 +774,12 @@ def main(argv: list[str] | None = None) -> int:
     try:
         while True:
             try:
-                frame = render(_fetch(args.url))
+                payload = _fetch(args.url)
+                frame = (
+                    render_fleet(payload)
+                    if isinstance(payload, dict)
+                    else render(payload)
+                )
             except (OSError, ValueError) as e:
                 frame = f"fetch {args.url} failed: {e}"
             if args.once:
