@@ -139,6 +139,13 @@ DEGRADED = os.environ.get("BENCH_DEGRADED") == "1"
 
 PROMPT = "Benchmarking the TPU serving engine end to end. " * 4
 
+# Record schema version (BENCH_NOTES.md "Record format"): stamped on
+# every emitted record so tools/perf_diff.py can align rounds across
+# code changes. Bump when a record key changes meaning, not when keys
+# are merely added. v2 = schema stamp + per-phase program-variant
+# census + device.hbm_source (rounds r01–r05 are implicitly v1).
+BENCH_SCHEMA = 2
+
 
 _FORCE_XLA = os.environ.get("BENCH_FORCE_XLA") == "1"
 
@@ -360,6 +367,7 @@ def _record(headline: dict, detail: dict) -> dict:
         shape = f"per-chip shard proxy of Llama-3-8B TP8, {kv_desc}, {gen}"
     tok_s = headline.get("tok_s", 0.0)
     return {
+        "schema": BENCH_SCHEMA,
         "metric": f"tok/s/chip {MODEL or 'unselected'} {wdtype} decode ({shape})",
         "value": tok_s,
         "unit": "tok/s/chip",
@@ -407,6 +415,12 @@ def run_bench() -> dict:
             # detected TPU generation (device_kind fallback when the
             # TPU_ACCELERATOR_TYPE env var is unset); None off-TPU
             "generation": _PROBE_INFO.get("generation"),
+            # per-chip capacity + provenance: "memory_stats" when the
+            # allocator exposes bytes_limit, "table:<gen>" when it hides
+            # stats on a real chip (the r05 "hbm": null failure mode),
+            # "unknown" off-TPU
+            "hbm_bytes": _PROBE_INFO.get("hbm_bytes"),
+            "hbm_source": _PROBE_INFO.get("hbm_source"),
         }
 
     # ---- headline decode: fallback chain, each attempt a FRESH child ----
@@ -547,9 +561,17 @@ def _child_probe() -> dict:
             result["ok"] = True
             result["backend"] = jax.default_backend()
             result["hbm"] = _mem_snapshot()
-            from langstream_tpu.serving.profiling import detect_generation
+            from langstream_tpu.serving.profiling import (
+                detect_generation,
+                detect_hbm_capacity,
+            )
 
             result["generation"] = detect_generation()
+            # per-chip capacity with its provenance: allocator truth
+            # ("memory_stats") or the per-generation table fallback
+            # ("table:<gen>") — the r05 "hbm": null fix, recorded so the
+            # record says WHICH source the roofline was judged against
+            result["hbm_bytes"], result["hbm_source"] = detect_hbm_capacity()
         except Exception as e:  # pragma: no cover - device-dependent
             result["error"] = f"{type(e).__name__}: {e}"
 
@@ -691,6 +713,12 @@ async def run_decode_bench(
     from langstream_tpu.serving.flight import bench_rollup
 
     flight = bench_rollup(engine.flight.summary())
+    # program-variant census + per-program achieved-vs-expected
+    # (serving/attribution.py): stamps WHICH compiled programs served
+    # this leg, so perf_diff can align rounds across code changes and a
+    # step-time shift reads against the variant set that produced it
+    attribution = engine.attribution.report()
+    programs = {p["program"]: p["dispatches"] for p in attribution}
     # mean dispatched-step wall excluding idle gaps (the engine_top
     # convention): the number the pipeline ablation compares across legs
     totals = flight.get("totals") or {}
@@ -722,6 +750,8 @@ async def run_decode_bench(
             "hbm_utilization": round(roof.utilization(achieved_step_ms), 3),
         },
         "flight": flight,
+        "programs": programs,
+        "attribution": attribution,
     }
     await engine.close()
     return out

@@ -272,6 +272,47 @@ def check_engine(engine, clock):
 ))
 
 _register(RuleExample(
+    rule="OBS505",
+    tp={
+        "langstream_tpu/serving/attribution.py": '''\
+import jax
+
+class ProgramLedger:
+    def report(self, engine):
+        # an attribution poll that syncs the device hangs exactly when
+        # the operator asks which program owns the stall — and the lock
+        # queues behind the wedged dispatch holding it
+        jax.block_until_ready(engine.last_out)
+        with engine.dispatch_lock:
+            return dict(self.costs)
+''',
+    },
+    tn={
+        "langstream_tpu/serving/attribution.py": '''\
+class ProgramLedger:
+    def report(self):
+        # the sanctioned shape: C-level snapshot copies + arithmetic —
+        # nothing that can wait on the device, a lock, or I/O
+        out = []
+        for program, cost in list(self.costs.items()):
+            samples = sorted(list(self.times.get(program) or ()))
+            out.append({"program": program, "n": len(samples)})
+        return out
+''',
+    },
+    fix=(
+        "Attribution reads must judge evidence the engine loop already "
+        "recorded: snapshot containers with list()/dict() copies, read "
+        "byte totals computed once at engine init (never walk live "
+        "donated arrays), and do arithmetic on the snapshot. If a "
+        "number needs the device or a lock to compute, record it on "
+        "the engine loop at dispatch time and let the read path "
+        "snapshot it (see serving/attribution.py and "
+        "_DeviceLru.device_bytes)."
+    ),
+))
+
+_register(RuleExample(
     rule="FLEET601",
     tp={
         "langstream_tpu/controlplane/autoscaler.py": '''\

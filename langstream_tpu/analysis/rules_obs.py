@@ -39,6 +39,18 @@ the pod probe handlers (``_probe_healthz``/``_probe_ready`` in
 are exempt everywhere: they are deferred work (warmup tasks, factories)
 the probe only creates, never runs inline. The sanctioned pattern is
 snapshot reads (``list(deque)``, attribute loads) plus arithmetic.
+
+OBS505 extends the same wait-free contract to the *attribution plane*
+(OBS504's shape, different scope): everything in
+``serving/attribution.py`` (the program cost ledger and memory ledger —
+writes are engine-loop container mutations, reads are poll-time
+snapshots), the pod ``/attribution``/``/memory`` payload builders
+(``_attribution_payload``/``_memory_payload`` in ``runtime/pod.py``),
+and the engine's attribution surface
+(``attribution_section``/``attribution_report``/``_memory_ledger``/
+``device_bytes`` in ``serving/``). A ledger poll that syncs the device
+or takes a lock hangs or queues exactly when an operator asks which
+program owns the stall.
 """
 
 from __future__ import annotations
@@ -268,57 +280,131 @@ def _health_functions(mod: Module) -> Iterator[ast.AST]:
             yield node
 
 
+def _waitfree_violations(
+    fn: ast.AST,
+) -> Iterator[tuple[ast.AST, str, str]]:
+    """(node, offender, kind) for everything in ``fn`` that can wait:
+    device syncs, blocking I/O, lock acquisition — the shared scanner
+    behind OBS504 (health plane) and OBS505 (attribution plane). Nested
+    defs are deferred work (warmup tasks, factories) — the caller never
+    runs their bodies inline, so they are exempt (the same exemption
+    OBS503 grants dispatch closures)."""
+    nested: set[int] = set()
+    for inner in ast.walk(fn):
+        if (
+            isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and inner is not fn
+        ):
+            nested.update(id(n) for n in ast.walk(inner))
+    for node in ast.walk(fn):
+        if id(node) in nested:
+            continue
+        offender = kind = None
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _DEVICE_SYNC_CALLS:
+                offender, kind = f"{name}()", "device sync"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DEVICE_SYNC_ATTRS
+            ):
+                offender, kind = f".{node.func.attr}()", "device sync"
+            elif name in _BLOCKING_CALLS or name in _EXTRA_BLOCKING:
+                offender, kind = f"{name}()", "blocking call"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FILE_IO_ATTRS
+            ):
+                offender, kind = f".{node.func.attr}()", "blocking call"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                offender, kind = f"{name or '.acquire'}()", "lock"
+        elif isinstance(node, ast.With):
+            if any(_lockish(item.context_expr) for item in node.items):
+                offender, kind = "with <lock>", "lock"
+        if offender is not None:
+            yield node, offender, kind
+
+
 def check_blocking_in_health_plane(mod: Module) -> Iterator[Finding]:
     for fn in _health_functions(mod):
-        # nested defs are deferred work (warmup tasks, factories) — the
-        # probe never runs their bodies inline (same exemption OBS503
-        # grants dispatch closures)
-        nested: set[int] = set()
-        for inner in ast.walk(fn):
-            if (
-                isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and inner is not fn
-            ):
-                nested.update(id(n) for n in ast.walk(inner))
-        for node in ast.walk(fn):
-            if id(node) in nested:
-                continue
-            offender = kind = None
-            if isinstance(node, ast.Call):
-                name = call_name(node)
-                if name in _DEVICE_SYNC_CALLS:
-                    offender, kind = f"{name}()", "device sync"
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _DEVICE_SYNC_ATTRS
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "OBS504",
+                node,
+                f"{kind} {offender} in a health-check/watchdog path "
+                f"(`{fn.name}`): probes must stay wait-free — a "
+                f"device sync hangs with the device, a lock queues "
+                f"behind the wedged dispatch holding it, blocking "
+                f"I/O stalls the verdict; use snapshot reads "
+                f"(list(deque), attribute loads) and arithmetic only",
+            )
+
+
+#: the attribution-plane module: EVERY function in it is either a ledger
+#: write on the engine loop (container mutation only) or a read path a
+#: /attribution poll runs inline — both must be wait-free
+_ATTRIBUTION_MODULE = "langstream_tpu/serving/attribution.py"
+
+#: named attribution read paths outside that module: the pod endpoint
+#: payload builders and the engine's attribution surface
+_ATTRIBUTION_FUNCS_BY_FILE = {
+    "langstream_tpu/runtime/pod.py": {
+        "_attribution_payload",
+        "_memory_payload",
+    },
+    "langstream_tpu/serving/": {
+        "attribution_section",
+        "attribution_report",
+        "_memory_ledger",
+        "device_bytes",
+    },
+}
+
+
+def _attribution_functions(mod: Module) -> Iterator[ast.AST]:
+    whole_module = mod.path.endswith(_ATTRIBUTION_MODULE)
+    named: set[str] = set()
+    for prefix, names in _ATTRIBUTION_FUNCS_BY_FILE.items():
+        if prefix in mod.path or mod.path.endswith(prefix):
+            named = names
+            break
+    if not whole_module and not named:
+        return
+    nested_fns: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if inner is not node and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
                 ):
-                    offender, kind = f".{node.func.attr}()", "device sync"
-                elif name in _BLOCKING_CALLS or name in _EXTRA_BLOCKING:
-                    offender, kind = f"{name}()", "blocking call"
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _FILE_IO_ATTRS
-                ):
-                    offender, kind = f".{node.func.attr}()", "blocking call"
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "acquire"
-                ):
-                    offender, kind = f"{name or '.acquire'}()", "lock"
-            elif isinstance(node, ast.With):
-                if any(_lockish(item.context_expr) for item in node.items):
-                    offender, kind = "with <lock>", "lock"
-            if offender is not None:
-                yield mod.finding(
-                    "OBS504",
-                    node,
-                    f"{kind} {offender} in a health-check/watchdog path "
-                    f"(`{fn.name}`): probes must stay wait-free — a "
-                    f"device sync hangs with the device, a lock queues "
-                    f"behind the wedged dispatch holding it, blocking "
-                    f"I/O stalls the verdict; use snapshot reads "
-                    f"(list(deque), attribute loads) and arithmetic only",
-                )
+                    nested_fns.add(id(inner))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(node) in nested_fns:
+            continue
+        if whole_module or node.name in named:
+            yield node
+
+
+def check_blocking_in_attribution_plane(mod: Module) -> Iterator[Finding]:
+    for fn in _attribution_functions(mod):
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "OBS505",
+                node,
+                f"{kind} {offender} in an attribution/ledger read path "
+                f"(`{fn.name}`): the attribution plane must stay "
+                f"wait-free — a /attribution or /memory poll that syncs "
+                f"the device hangs exactly when the operator asks which "
+                f"program owns the stall, a lock queues behind the "
+                f"wedged dispatch holding it, and blocking I/O stalls "
+                f"the ledger; use snapshot reads (list()/dict() copies, "
+                f"attribute loads) and arithmetic only",
+            )
 
 
 RULES = [
@@ -349,5 +435,13 @@ RULES = [
         summary="device sync, blocking I/O, or lock acquisition in a "
         "health-check/watchdog path (probes must be wait-free)",
         check=check_blocking_in_health_plane,
+    ),
+    Rule(
+        id="OBS505",
+        family="obs",
+        summary="device sync, blocking I/O, or lock acquisition in an "
+        "attribution/ledger read path (serving/attribution.py and the "
+        "/attribution//memory handlers must be wait-free)",
+        check=check_blocking_in_attribution_plane,
     ),
 ]

@@ -321,6 +321,22 @@ class LocalComputeRuntime:
             return []
         return [e for e in flight_report() if e["model"] in models]
 
+    def attribution(self, tenant: str, name: str) -> list[dict[str, Any]]:
+        """Device-attribution payloads for the /attribution aggregation
+        route (per-program cost ledger + HBM memory ledger,
+        serving/attribution.py), scoped to the app's declared models
+        exactly like :meth:`flight` — dev-mode engines are
+        process-global, and one tenant's route must not read another's
+        device economics."""
+        from langstream_tpu.serving.engine import attribution_report
+
+        models = self._declared_models(tenant, name)
+        if models is None:
+            return []
+        return [
+            e for e in attribution_report() if e.get("model") in models
+        ]
+
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         runner = self.runners.get((tenant, name))
         return runner.agent_info() if runner else []
@@ -388,6 +404,10 @@ class ControlPlaneServer:
                 ),
                 web.get(
                     "/api/applications/{tenant}/{name}/flight", self._flight
+                ),
+                web.get(
+                    "/api/applications/{tenant}/{name}/attribution",
+                    self._attribution,
                 ),
                 web.get("/api/applications/{tenant}/{name}/qos", self._qos),
                 web.get(
@@ -771,6 +791,20 @@ class ControlPlaneServer:
         tenant = request.match_info["tenant"]
         name = request.match_info["name"]
         report = await asyncio.to_thread(self.compute.flight, tenant, name)
+        return web.json_response(report)
+
+    async def _attribution(self, request: web.Request) -> web.Response:
+        """Per-application device-attribution aggregation (beside
+        /flight, same fan-in shape): per-program achieved-vs-expected
+        ledger + HBM memory ledger — in-process engines in dev mode,
+        per-pod /attribution endpoints under the k8s compute runtime."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        report = await asyncio.to_thread(
+            self.compute.attribution, tenant, name
+        )
         return web.json_response(report)
 
     async def _qos(self, request: web.Request) -> web.Response:

@@ -295,6 +295,22 @@ class KubernetesComputeRuntime:
                     merged.append({"pod": pod, **entry})
         return merged
 
+    def attribution(self, tenant: str, name: str) -> list[dict[str, Any]]:
+        """Fan in the application pods' ``/attribution`` payloads —
+        device attribution (per-program cost ledger + HBM memory
+        ledger) concatenates per engine per pod exactly like
+        :meth:`flight`, with timed-out pods surfaced as ``unreachable``
+        members, never dropped."""
+        merged: list[dict[str, Any]] = []
+        for pod, chunk in self._pod_json_fanin(tenant, name, "/attribution"):
+            if chunk is None:
+                merged.append({"pod": pod, "unreachable": True})
+                continue
+            for entry in chunk if isinstance(chunk, list) else []:
+                if isinstance(entry, dict):
+                    merged.append({"pod": pod, **entry})
+        return merged
+
     def _summary_section_fanin(
         self, tenant: str, name: str, section: str
     ) -> dict[str, Any]:

@@ -408,6 +408,12 @@ class BlockManager:
         usable = self.layout.num_blocks - 1
         return self._reserved / usable if usable > 0 else 0.0
 
+    def prefix_block_count(self) -> int:
+        """Blocks currently pinned by the content-addressed prefix cache
+        — a single GIL-atomic ``len``, so the attribution memory ledger
+        can read it wait-free from any thread (OBS505)."""
+        return len(self._prefix)
+
     def stats(self) -> dict:
         return {
             "num_blocks": self.layout.num_blocks,

@@ -66,9 +66,23 @@ from langstream_tpu.models.encoder import (
     init_encoder_params,
 )
 from langstream_tpu.models.tokenizer import Tokenizer, load_tokenizer
+from langstream_tpu.serving.attribution import (
+    ModelShape,
+    ProgramLedger,
+    decode_cost,
+    memory_ledger,
+    prefill_cost,
+    tree_device_bytes,
+    verify_cost,
+)
 from langstream_tpu.serving.flight import FlightRecorder
 from langstream_tpu.serving.health import EngineWatchdog, SloSpec, SloTracker
-from langstream_tpu.serving.profiling import ProfilerHooks
+from langstream_tpu.serving.profiling import (
+    ProfilerHooks,
+    detect_generation,
+    detect_hbm_capacity,
+    detect_hbm_gbps,
+)
 from langstream_tpu.serving.qos import (
     PRIORITY_CLASSES,
     QosSpec,
@@ -503,6 +517,20 @@ class _DeviceLru:
         with self._lock:
             self._entries.clear()
 
+    def device_bytes(self) -> int:
+        """Device bytes pinned by the cached entries — the memory
+        ledger's ``device-lru``/``sampler-state`` owners. Lock-FREE by
+        design (graftcheck OBS505): the attribution read path must never
+        queue behind a dispatch holding the LRU lock, so the entries are
+        snapshotted with a single C-level ``list()`` copy (the same
+        reader contract the flight recorder uses) and summed with
+        attribute reads only."""
+        entries = list(self._entries.values())
+        total = 0
+        for entry in entries:
+            total += tree_device_bytes(entry)
+        return total
+
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {
@@ -830,6 +858,76 @@ class TpuServingEngine:
         self._deferred_releases: list[int] = []
         # jax.profiler trace + HLO dump hooks (env-gated, off by default)
         self.profiler = ProfilerHooks()
+        # device attribution plane (serving/attribution.py): the per-
+        # program cost ledger fed from the loop's flight records, plus
+        # the static facts the HBM memory ledger needs. Weight/cache
+        # byte totals are computed ONCE here — the cache handles are
+        # donated and rebound on the dispatch thread, so readers must
+        # never walk the live arrays (their shapes are fixed for the
+        # engine's life anyway).
+        mc = self.model_config
+        self.attribution = ProgramLedger()
+        self._weights_bytes = tree_device_bytes(self.params)
+        self._kv_cache_bytes = tree_device_bytes(
+            self.cache_k
+        ) + tree_device_bytes(self.cache_v)
+        self._kv_block_bytes = (
+            self._kv_cache_bytes // self.paged_layout.num_blocks
+            if self.block_mgr is not None
+            else 0
+        )
+        act_bytes = np.dtype(mc.dtype).itemsize
+        if self.is_moe:
+            # routed experts: the host can't know which experts fire, so
+            # the FLOPs term estimates params from the measured bytes —
+            # divided by the ACTUAL weight width (int8 → 1, else the
+            # model dtype's itemsize, so model_dtype=float32 doesn't
+            # double the estimate)
+            n_params = self._weights_bytes // (
+                1 if self.config.quantize == "int8" else act_bytes
+            )
+        else:
+            from langstream_tpu.models.llama import param_count
+
+            n_params = param_count(mc)
+        if self.config.kv_quantize == "int8":
+            kv_row_bytes = mc.head_dim + 4  # int8 row + f32 scale
+        else:
+            kv_row_bytes = mc.head_dim * act_bytes
+        self._prog_shape = ModelShape(
+            layers=mc.layers,
+            hidden=mc.hidden,
+            heads=mc.heads,
+            kv_heads=mc.kv_heads,
+            head_dim=mc.head_dim,
+            intermediate=getattr(
+                mc, "intermediate", getattr(mc, "moe_intermediate", 0)
+            ),
+            vocab=mc.vocab_size,
+            weight_bytes=self._weights_bytes,
+            param_count=n_params,
+            kv_row_bytes=kv_row_bytes,
+            act_bytes=act_bytes,
+        )
+        # device identity is fixed for the engine's life: capacity
+        # (allocator truth or the per-generation table) and bandwidth
+        # resolve once, never on the attribution read path
+        self._hbm_limit, self._hbm_limit_source = detect_hbm_capacity()
+        self._hbm_gbps = detect_hbm_gbps()
+        self._hbm_generation = detect_generation()
+        # hbm_bytes_by_owner Prometheus mirrors (refreshed whenever the
+        # attribution section is computed: stats(), /attribution, /memory)
+        self._m_hbm_owner = {
+            owner: reporter.gauge(
+                f"hbm_bytes_{owner.replace('-', '_')}",
+                f"resident HBM bytes attributed to {owner} "
+                f"(serving/attribution.py memory ledger; slack = detected "
+                f"limit minus every accounted owner)",
+            )
+            for owner in (
+                "weights", "kv-pool", "sampler-state", "device-lru", "slack",
+            )
+        }
 
     # ------------------------------------------------------------------
     # model + jit setup
@@ -1413,6 +1511,113 @@ class TpuServingEngine:
         self.flight.event("recompile", what=kind, variant=repr(key))
         self._m_recompiles(1)
 
+    # ------------------------------------------------------------------
+    # attribution-ledger plumbing (serving/attribution.py)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _sampler_code(sampler_mode: tuple) -> str:
+        """Compact sampler-variant tag for program ids."""
+        use_top_p, use_top_k, all_greedy = sampler_mode
+        if all_greedy:
+            return "greedy"
+        tag = "sample"
+        if use_top_k:
+            tag += "-tk"
+        if use_top_p:
+            tag += "-tp"
+        return tag
+
+    def _window_rows(self, window: int | None) -> int:
+        """Cache rows a decode/verify variant actually sweeps per slot:
+        paged variants specialize on block-table columns, dense on row
+        windows (None = the full cache)."""
+        if self.block_mgr is not None:
+            blocks = window or self.paged_layout.max_blocks_per_slot
+            return blocks * self.paged_layout.block_size
+        return window or self.model_config.max_seq_len
+
+    def _program_decode(
+        self, window: int | None, k_steps: int, sampler_mode: tuple,
+        pen: bool,
+    ) -> str:
+        """Program id for a decode-chunk variant; registers its cost
+        model on first sight (arithmetic only — loop-thread safe)."""
+        rows = self._window_rows(window)
+        program = (
+            f"decode:w{rows}:k{k_steps}:{self._sampler_code(sampler_mode)}"
+            + (":pen" if pen else "")
+        )
+        if not self.attribution.known(program):
+            self.attribution.register(
+                program,
+                decode_cost(
+                    self._prog_shape,
+                    slots=self.config.slots,
+                    window_rows=rows,
+                    k_steps=k_steps,
+                    hbm_gbps=self._hbm_gbps,
+                ),
+            )
+        return program
+
+    def _program_prefill(
+        self, bucket: int, rows: int, sampler_mode: tuple,
+    ) -> str:
+        program = (
+            f"prefill:p{bucket}:b{rows}:{self._sampler_code(sampler_mode)}"
+        )
+        if not self.attribution.known(program):
+            self.attribution.register(
+                program,
+                prefill_cost(
+                    self._prog_shape,
+                    rows=rows,
+                    tokens_per_row=bucket,
+                    prefix_rows=0,
+                    hbm_gbps=self._hbm_gbps,
+                ),
+            )
+        return program
+
+    def _program_prefill_continue(
+        self, nrb: int, rows: int, chunk: int, sampler_mode: tuple,
+    ) -> str:
+        program = (
+            f"prefill-continue:nrb{nrb}:b{rows}:c{chunk}:"
+            f"{self._sampler_code(sampler_mode)}"
+        )
+        if not self.attribution.known(program):
+            self.attribution.register(
+                program,
+                prefill_cost(
+                    self._prog_shape,
+                    rows=rows,
+                    tokens_per_row=chunk,
+                    prefix_rows=nrb * self.paged_layout.block_size,
+                    hbm_gbps=self._hbm_gbps,
+                ),
+            )
+        return program
+
+    def _program_verify(self, nrb: int, sampler_mode: tuple) -> str:
+        drafts = self.config.speculative_drafts
+        program = (
+            f"verify:nrb{nrb}:d{drafts}:{self._sampler_code(sampler_mode)}"
+        )
+        if not self.attribution.known(program):
+            self.attribution.register(
+                program,
+                verify_cost(
+                    self._prog_shape,
+                    slots=self.config.slots,
+                    window_rows=nrb * self.paged_layout.block_size,
+                    drafts=drafts,
+                    hbm_gbps=self._hbm_gbps,
+                ),
+            )
+        return program
+
     def _admission_stall(self) -> str | None:
         """Why queued work is not being admitted right now (None when the
         queue is empty or admission would succeed on the next pass)."""
@@ -1440,12 +1645,22 @@ class TpuServingEngine:
         overlapped_s: float = 0.0,
         spec_accepted: int = 0,
         spec_rejected: int = 0,
+        program: str | None = None,
     ) -> None:
         """One flight sample per dispatched burst, plus its Prometheus
         mirrors. ``overlapped_s`` is host work the pipelined loop ran
         under an in-flight dispatch's device shadow (see flight.py).
-        Hot-path discipline (graftcheck OBS503): deque appends and
-        counter bumps only — no I/O, no locks."""
+        ``program`` keys the sample by the compiled variant that ran and
+        feeds the attribution ledger's measured side (achieved-vs-
+        expected per program, serving/attribution.py) — credited with
+        the blocked wait PLUS the overlapped host share: under the
+        pipelined loop the device keeps executing while the host works
+        in its shadow, so the wait alone would systematically understate
+        device time and flatter the per-program ratio exactly when
+        pipelining is on. Hot-path discipline (graftcheck OBS503): deque
+        appends and counter bumps only — no I/O, no locks."""
+        if program is not None:
+            self.attribution.observe(program, device_s + overlapped_s)
         stall = self._admission_stall()
         kv_used = (
             self.block_mgr.used_ratio() if self.block_mgr is not None else None
@@ -1464,6 +1679,7 @@ class TpuServingEngine:
             spec_accepted=spec_accepted,
             spec_rejected=spec_rejected,
             queue_by_class=depths,
+            program=program,
         )
         # watchdog heartbeat: a recorded dispatch IS step progress
         self.watchdog.beat(sample["queue_depth"])
@@ -1604,6 +1820,51 @@ class TpuServingEngine:
         if self.slo is None:
             return None
         return self.slo.status()
+
+    def attribution_section(self) -> dict[str, Any]:
+        """The device-attribution payload: per-program achieved-vs-
+        expected ledger plus the HBM memory ledger — what
+        ``stats()["attribution"]``, the pod ``/attribution``/``/memory``
+        endpoints, and the control-plane fan-in serve. Wait-free by
+        contract (graftcheck OBS505, the attribution twin of OBS504):
+        snapshot reads and arithmetic only — an attribution poll must
+        answer even while the engine is wedged mid-dispatch. The
+        ``hbm_bytes_by_owner`` Prometheus gauges refresh here, so any
+        reader keeps the scrape surface current."""
+        memory = self._memory_ledger()
+        owners = memory["hbm_bytes_by_owner"]
+        for owner, gauge in self._m_hbm_owner.items():
+            gauge(owners.get(owner) or 0)
+        return {
+            "model": self.config.model,
+            "slots": self.config.slots,
+            "generation": self._hbm_generation,
+            "hbm_gbps_assumed": self._hbm_gbps,
+            "programs": self.attribution.report(),
+            "memory": memory,
+        }
+
+    def _memory_ledger(self) -> dict[str, Any]:
+        """Live ``hbm_bytes_by_owner`` breakdown (serving/attribution.py
+        :func:`memory_ledger`). Weight/pool totals were computed once at
+        init (the shapes are fixed; the live handles are donated and
+        rebound on the dispatch thread, so readers never touch them);
+        the LRU and prefix-cache terms are snapshot reads."""
+        prefix_blocks = (
+            self.block_mgr.prefix_block_count()
+            if self.block_mgr is not None
+            else 0
+        )
+        return memory_ledger(
+            weights_bytes=self._weights_bytes,
+            kv_pool_bytes=self._kv_cache_bytes,
+            prefix_blocks=prefix_blocks,
+            bytes_per_block=self._kv_block_bytes,
+            sampler_bytes=self._sampler_dev_cache.device_bytes(),
+            tables_bytes=self._tables_dev_cache.device_bytes(),
+            limit_bytes=self._hbm_limit,
+            limit_source=self._hbm_limit_source,
+        )
 
     @staticmethod
     def _sampler_mode(temps, topks, topps) -> tuple:
@@ -1852,6 +2113,9 @@ class TpuServingEngine:
             # drain-before-terminate posture + last drain's counts
             # (docs/FLEET.md): the autoscaler's evidence trail
             "drain": self._drain_section(),
+            # device attribution plane: per-program achieved-vs-expected
+            # ledger + hbm_bytes_by_owner (serving/attribution.py)
+            "attribution": self.attribution_section(),
         }
         slo = self.slo_status()
         if slo is not None:
@@ -2323,6 +2587,7 @@ class TpuServingEngine:
                 self._topps[active_mask],
             )
             fn = self._verify_fn(nrb, sampler_mode)
+            program = self._program_verify(nrb, sampler_mode)
             # host state snapshotted on the LOOP thread: the verify step
             # yields to admission between iterations, which rewrites the
             # sampler arrays — the dispatch closure must not re-read
@@ -2422,6 +2687,7 @@ class TpuServingEngine:
                 tokens=self.total_generated - emitted_before,
                 spec_accepted=accepted_step,
                 spec_rejected=rejected_step,
+                program=program,
             )
             await self._flush_emits(live)
             if (
@@ -2745,6 +3011,11 @@ class TpuServingEngine:
                 else self._window_for(max_len)
             )
 
+        # program ids of dispatched-but-unrecorded chunks, FIFO (≤ 2 in
+        # flight under the depth-2 pipeline): each flight record pops the
+        # oldest so measured device time lands on the variant that ran it
+        prog_q: list[str] = []
+
         def _submit(tokens, lengths, key, window, tables, first=False):
             """Loop-thread half of a chunk dispatch: resolve the jit
             variant (so the ``_decode_chunk_fns``/``_compiled_shapes``
@@ -2754,6 +3025,7 @@ class TpuServingEngine:
             Returns the executor future — awaited immediately by the
             sequential path, left in flight by the pipelined one."""
             decode_fn = self._decode_fn(sampler_mode, window, K, pen)
+            prog_q.append(self._program_decode(window, K, sampler_mode, pen))
             counts_np = _build_counts() if pen else None
             if light:
                 self._light_chunks += 1
@@ -2784,6 +3056,7 @@ class TpuServingEngine:
                 self._flight_record(
                     "decode", device_s=fetch_s,
                     tokens=self.total_generated - gen_before,
+                    program=prog_q.pop(0) if prog_q else None,
                 )
                 await self._flush_emits(active)
                 if self._burst_should_yield(finished):
@@ -2809,6 +3082,7 @@ class TpuServingEngine:
             self._flight_record(
                 "decode", device_s=fetch_s, overlapped_s=overlapped_s,
                 tokens=self.total_generated - gen_before,
+                program=prog_q.pop(0) if prog_q else None,
             )
             await self._flush_emits(active)
 
@@ -2879,6 +3153,7 @@ class TpuServingEngine:
                     "decode", device_s=fetch_s,
                     overlapped_s=overlapped_s,
                     tokens=self.total_generated - gen_before,
+                    program=prog_q.pop(0) if prog_q else None,
                 )
                 if self._burst_should_yield(finished, pipelined=True):
                     if not self._stop:
@@ -2890,6 +3165,7 @@ class TpuServingEngine:
                         self._pending_chunk = (
                             out, list(active),
                             [self.slots[i].request for i in active], K,
+                            prog_q.pop(0) if prog_q else None,
                         )
                         return
                     # stopping: nothing will drain a pending chunk — do it
@@ -2916,7 +3192,7 @@ class TpuServingEngine:
         if pending is None:
             return
         self._pending_chunk = None
-        out, active, expected, k_steps = pending
+        out, active, expected, k_steps, program = pending
         chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
             self._executor, partial(self._fetch_chunk, out[0], k_steps)
         )
@@ -2925,6 +3201,7 @@ class TpuServingEngine:
         self._flight_record(
             "decode", device_s=fetch_s,
             tokens=self.total_generated - gen_before,
+            program=program,
         )
         await self._flush_emits(active)
 
@@ -2989,6 +3266,7 @@ class TpuServingEngine:
         fn = self._prefill_continue_fn(mode, nrb)
         # the continuation variant re-traces per (rows, chunk, window) shape
         self._note_compile("prefill-continue", (mode, nrb, Bp, C))
+        program = self._program_prefill_continue(nrb, Bp, C, mode)
         sel_np = self.block_mgr.tables[slot_ids]
         key = self._split_key()
 
@@ -3066,7 +3344,8 @@ class TpuServingEngine:
                 done_slots.append(slot_id)
                 self._m_tokens(1)
         self._flight_record(
-            "prefill", device_s=device_s, tokens=len(done_slots)
+            "prefill", device_s=device_s, tokens=len(done_slots),
+            program=program,
         )
         if done_slots:
             await self._flush_emits(done_slots)
@@ -3219,10 +3498,14 @@ class TpuServingEngine:
                 self._note_compile(
                     "prefill-continue", (prefill_mode, nrb, Bp, bucket)
                 )
+                program = self._program_prefill_continue(
+                    nrb, Bp, bucket, prefill_mode
+                )
             else:
                 prefill_fn = self._prefill_fn(prefill_mode)
                 # same Python variant, fresh XLA program per (bucket, rows)
                 self._note_compile("prefill", (prefill_mode, bucket, Bp))
+                program = self._program_prefill(bucket, Bp, prefill_mode)
 
             def _run():
                 if self._lockstep is not None:
@@ -3310,7 +3593,8 @@ class TpuServingEngine:
                 admitted_slots.append(slot_id)
             self._m_tokens(len(batch))
             self._flight_record(
-                "prefill", device_s=device_s, tokens=len(batch)
+                "prefill", device_s=device_s, tokens=len(batch),
+                program=program,
             )
             await self._flush_emits(admitted_slots)
 
@@ -3589,6 +3873,20 @@ def flight_report(
             entry["events"] = engine.flight.recent_events()
         report.append(entry)
     return report
+
+
+def attribution_report() -> list[dict[str, Any]]:
+    """Per-engine device-attribution payloads for the pod
+    ``/attribution`` and ``/memory`` endpoints and the control-plane
+    fan-in. Wait-free by contract (graftcheck OBS505): the instance map
+    is snapshotted WITHOUT ``_instances_lock`` — the same rationale as
+    :func:`health_report` (a ledger poll during an incident must never
+    queue behind an engine constructor holding the lock), and a torn
+    read at worst misses a brand-new engine for one poll."""
+    return [
+        engine.attribution_section()
+        for engine in list(TpuServingEngine._instances.values())
+    ]
 
 
 def health_report() -> list[dict[str, Any]]:

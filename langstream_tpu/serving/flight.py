@@ -167,6 +167,7 @@ class FlightRecorder:
         spec_accepted: int = 0,
         spec_rejected: int = 0,
         queue_by_class: dict[str, int] | None = None,
+        program: str | None = None,
     ) -> dict[str, Any]:
         """Record one dispatched burst. ``wall`` is the time since the
         previous boundary. ``overlapped_s`` is host work the pipelined
@@ -176,7 +177,10 @@ class FlightRecorder:
         ``host = wall − device`` stays the *exposed* host time and the
         wall decomposition remains exact. ``queue_by_class`` (QoS engines
         only) keeps the sample schema unchanged for FIFO engines by being
-        omitted when None."""
+        omitted when None. ``program`` keys the sample by the compiled
+        program variant that ran (the attribution ledger's id,
+        serving/attribution.py) — omitted when unknown so pre-attribution
+        consumers see an unchanged schema."""
         now = time.monotonic()
         wall_ms = (now - self._last_mark) * 1000.0
         self._last_mark = now
@@ -209,6 +213,8 @@ class FlightRecorder:
             entry["spec_rejected"] = spec_rejected
         if queue_by_class is not None:
             entry["queue_by_class"] = dict(queue_by_class)
+        if program is not None:
+            entry["program"] = program
         self._samples.append(entry)
         self.recorded += 1
         self.wall_ms += wall_ms

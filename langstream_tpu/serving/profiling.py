@@ -178,6 +178,17 @@ class DecodeRoofline:
 # published HBM bandwidth by TPU generation (GB/s); used for reporting only
 _HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0}
 
+# published per-chip HBM capacity by generation — the fallback when the
+# platform's allocator hides memory stats (several TPU plugins return None
+# from memory_stats(), which is how BENCH_r05 recorded "hbm": null on a
+# real chip). Used for reporting and the attribution memory ledger.
+_HBM_CAPACITY_BYTES = {
+    "v5e": 16 * 2**30,
+    "v5p": 95 * 2**30,
+    "v4": 32 * 2**30,
+    "v6e": 32 * 2**30,
+}
+
 # jax device_kind substrings → generation key (plugins spell these several
 # ways: "TPU v5 lite", "TPU v5e", "TPU v6 lite", ...). Checked in order so
 # the lite variants match before the bare version numbers.
@@ -217,18 +228,31 @@ def detect_generation() -> str | None:
     return None
 
 
-def detect_hbm_bytes() -> int | None:
-    """Physical HBM per chip from the allocator's ``bytes_limit`` when the
-    platform exposes memory stats (several TPU plugins return None)."""
+def detect_hbm_capacity() -> tuple[int | None, str]:
+    """(per-chip HBM bytes, source) — allocator truth when the platform
+    exposes memory stats (``source: "memory_stats"``), else the published
+    per-generation capacity table (``source: "table:<gen>"`` — the fix
+    for BENCH_r05 recording ``"hbm": null`` on a real chip whose plugin
+    hides allocator stats), else ``(None, "unknown")`` (CPU/GPU)."""
     try:
         import jax
 
         stats = jax.local_devices()[0].memory_stats()
         if stats and stats.get("bytes_limit"):
-            return int(stats["bytes_limit"])
+            return int(stats["bytes_limit"]), "memory_stats"
     except Exception as e:
         log.debug("memory_stats unavailable: %s", e)
-    return None
+    generation = detect_generation()
+    if generation in _HBM_CAPACITY_BYTES:
+        return _HBM_CAPACITY_BYTES[generation], f"table:{generation}"
+    return None, "unknown"
+
+
+def detect_hbm_bytes() -> int | None:
+    """Physical HBM per chip: the allocator's ``bytes_limit`` when
+    exposed, falling back to the per-generation capacity table (see
+    :func:`detect_hbm_capacity` for the source annotation)."""
+    return detect_hbm_capacity()[0]
 
 
 def detect_hbm_gbps(default: float = 819.0) -> float:

@@ -842,6 +842,95 @@ def test_obs504_tn_snapshot_reads_and_out_of_scope_functions():
 
 
 # --------------------------------------------------------------------------
+# OBS505 — attribution/ledger read paths must be wait-free
+# --------------------------------------------------------------------------
+
+
+def test_obs505_tp_sync_lock_and_io_in_attribution_module():
+    # EVERY function in serving/attribution.py is policed: a device
+    # sync, a lock acquisition, and blocking I/O each fire
+    ids = rule_ids(
+        """
+        import jax
+
+        def report(ledger, engine):
+            jax.block_until_ready(engine.last_out)
+            with engine.dispatch_lock:
+                costs = dict(ledger.costs)
+            with open("/tmp/ledger.json", "w") as f:
+                f.write(str(costs))
+            return costs
+        """,
+        path="langstream_tpu/serving/attribution.py",
+    )
+    assert ids == ["OBS505", "OBS505", "OBS505"]
+
+
+def test_obs505_tp_pod_payload_and_engine_surface():
+    # the pod /attribution//memory payload builders and the engine's
+    # attribution surface are policed by name; .item() is a device
+    # sync, .acquire() a lock
+    ids = rule_ids(
+        """
+        def _memory_payload():
+            return {"free": free_gauge.value.item()}
+        """,
+        path="langstream_tpu/runtime/pod.py",
+    )
+    assert ids == ["OBS505"]
+    ids = rule_ids(
+        """
+        class Engine:
+            def attribution_section(self):
+                self._instances_lock.acquire()
+                try:
+                    return {"programs": list(self._programs)}
+                finally:
+                    self._instances_lock.release()
+        """,
+        path="langstream_tpu/serving/engine.py",
+    )
+    assert ids == ["OBS505"]
+
+
+def test_obs505_tn_snapshot_reads_and_out_of_scope():
+    # the sanctioned shape — C-level snapshot copies + arithmetic —
+    # stays silent, nested defs are exempt, and the same lock outside a
+    # policed function/module doesn't fire
+    assert (
+        rule_ids(
+            """
+            def report(ledger):
+                out = []
+                for program, cost in list(ledger.costs.items()):
+                    samples = sorted(list(ledger.times.get(program) or ()))
+                    out.append({"program": program, "n": len(samples)})
+                return out
+
+            def build(engine):
+                def _observe(program, device_s):
+                    with engine.ring_lock:
+                        engine.ring.append((program, device_s))
+                return _observe
+            """,
+            path="langstream_tpu/serving/attribution.py",
+        )
+        == []
+    )
+    # a lock in a non-attribution engine method is OBS505-silent (other
+    # rules own those paths)
+    assert "OBS505" not in rule_ids(
+        """
+        class Engine:
+            def get_or_create(self, config):
+                with self._instances_lock:
+                    return self._instances[config]
+        """,
+        path="langstream_tpu/serving/engine.py",
+    )
+
+
+# --------------------------------------------------------------------------
 # QOS601 — unbounded asyncio.Queue in serving/ or gateway/
 # --------------------------------------------------------------------------
 

@@ -48,11 +48,24 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.request
 
 SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    sign = "-" if n < 0 else ""
+    n = abs(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024:
+            return f"{sign}{n:.1f}{unit}" if unit != "B" else f"{sign}{n:.0f}B"
+        n /= 1024
+    return f"{sign}{n:.1f}TB"
 
 
 def _bar(frac: float | None, width: int = 24) -> str:
@@ -103,6 +116,18 @@ def render(report: list[dict]) -> str:
             # control-plane fan-in marker: the pod timed out — the most
             # important line on the screen during an incident
             lines.append(f"== pod {entry.get('pod', '?')} UNREACHABLE ==")
+            lines.append("")
+            continue
+        if "summary" not in entry and (
+            entry.get("programs") is not None
+            or entry.get("memory") is not None
+        ):
+            # /attribution payload entry (no flight summary): render the
+            # attribution panels alone
+            pod = f" @ {entry['pod']}" if entry.get("pod") else ""
+            lines.append(f"== engine {entry.get('model', '?')}{pod} ==")
+            lines.extend(_render_memory(entry.get("memory")))
+            lines.extend(_render_programs(entry.get("programs")))
             lines.append("")
             continue
         summary = entry.get("summary", {})
@@ -348,6 +373,91 @@ def _render_scheduler(scheduler: dict | None, events: list[dict]) -> list[str]:
     return lines
 
 
+def _render_memory(memory: dict | None) -> list[str]:
+    """HBM memory-ledger panel: one bar per owner against the detected
+    (or table-fallback) limit, plus the prefix-cache sub-owner and the
+    slack line. Absent on pre-attribution payloads."""
+    if not memory:
+        return []
+    owners = memory.get("hbm_bytes_by_owner") or {}
+    limit = memory.get("limit_bytes")
+    lines = [
+        f"hbm      limit {_fmt_bytes(limit)} "
+        f"({memory.get('limit_source', '?')})  accounted "
+        f"{_fmt_bytes(memory.get('accounted_bytes'))}"
+    ]
+    for owner, owned in sorted(
+        owners.items(), key=lambda kv: -(kv[1] or 0)
+    ):
+        frac = (owned or 0) / limit if limit else 0.0
+        lines.append(
+            f"  {owner:13s} [{_bar(frac, 16)}] {_fmt_bytes(owned)}"
+        )
+    prefix = memory.get("kv_pool_prefix_bytes")
+    if prefix:
+        lines.append(
+            f"  {'^ prefix-cache':13s} {_fmt_bytes(prefix)} of the kv-pool "
+            f"holds cached prefix blocks"
+        )
+    return lines
+
+
+def _render_programs(programs: list | None, top: int = 8) -> list[str]:
+    """Per-program attribution panel: expected bytes, measured p50, and
+    the achieved-vs-expected ratio (the per-program roofline), heaviest
+    programs first."""
+    if not programs:
+        return []
+    lines = [
+        "program                                   disp   expect   "
+        "meas-p50   ach/exp"
+    ]
+    for program in programs[:top]:
+        expected = program.get("expected") or {}
+        ratio = program.get("achieved_vs_expected")
+        measured = program.get("measured_ms_p50")
+        lines.append(
+            f"  {str(program.get('program', '?'))[:38]:38s} "
+            f"{program.get('dispatches', 0):6d} "
+            f"{_fmt_ms(expected.get('expected_ms')):>8s} "
+            f"{_fmt_ms(measured):>10s} "
+            + (f"{ratio:9.3f}" if ratio is not None else "        -")
+        )
+    return lines
+
+
+def _degraded_programs(programs: list, min_dispatches: int = 8) -> list[str]:
+    """Programs whose achieved/expected ratio degrades vs the rest of
+    the dump: flagged when a program with a meaningful dispatch count
+    runs below half the median ratio of its peers — the roofline gap
+    has a name, not a blend."""
+    rated = [
+        p for p in programs
+        if p.get("achieved_vs_expected") is not None
+        and p.get("dispatches", 0) >= min_dispatches
+    ]
+    if len(rated) < 2:
+        return []
+    ratios = sorted(p["achieved_vs_expected"] for p in rated)
+    median = ratios[len(ratios) // 2]
+    if median <= 0:
+        return []
+    flags = []
+    for program in rated:
+        ratio = program["achieved_vs_expected"]
+        if ratio < 0.5 * median:
+            flags.append(
+                f"program attribution gap: {program.get('program')} runs at "
+                f"{ratio} of its expected roofline vs a {median} median "
+                f"across the dump ({program.get('dispatches')} dispatches, "
+                f"measured p50 {program.get('measured_ms_p50')}ms) — this "
+                f"program owns a disproportionate share of the device gap; "
+                f"profile it (tools/trace_attrib.py over a /profile "
+                f"capture) before blaming the blended roofline"
+            )
+    return flags
+
+
 # ---------------------------------------------------------------------------
 # post-mortem analysis
 # ---------------------------------------------------------------------------
@@ -369,6 +479,25 @@ def _collect_flight_dicts(obj, found: list[dict], label: str = "") -> None:
     elif isinstance(obj, list):
         for i, value in enumerate(obj):
             _collect_flight_dicts(value, found, f"{label}[{i}]")
+
+
+def _collect_attrib_dicts(obj, found: list[dict], label: str = "") -> None:
+    """Recursively find device-attribution payloads (dicts carrying a
+    ``programs`` list next to a ``memory`` ledger — the shape
+    ``/attribution`` serves and ``stats()["attribution"]`` embeds)."""
+    if isinstance(obj, dict):
+        if isinstance(obj.get("programs"), list) and isinstance(
+            obj.get("memory"), dict
+        ):
+            found.append({"label": label or obj.get("model", ""), "src": obj})
+            return
+        for key, value in obj.items():
+            _collect_attrib_dicts(
+                value, found, f"{label}.{key}" if label else str(key)
+            )
+    elif isinstance(obj, list):
+        for i, value in enumerate(obj):
+            _collect_attrib_dicts(value, found, f"{label}[{i}]")
 
 
 def _collect_fleet_dicts(obj, found: list[dict], label: str = "") -> None:
@@ -624,11 +753,13 @@ def analyze(dump) -> str:
     _collect_flight_dicts(dump, found)
     fleet_found: list[dict] = []
     _collect_fleet_dicts(dump, fleet_found)
-    if not found and not fleet_found:
+    attrib_found: list[dict] = []
+    _collect_attrib_dicts(dump, attrib_found)
+    if not found and not fleet_found and not attrib_found:
         raise ValueError(
             "no flight data found in the dump (expected a /flight payload, "
-            "a bench record with a 'flight' rollup, or an autoscaler "
-            "status payload)"
+            "a bench record with a 'flight' rollup, an /attribution "
+            "payload, or an autoscaler status payload)"
         )
     lines: list[str] = []
     for item in fleet_found:
@@ -720,6 +851,31 @@ def analyze(dump) -> str:
         if not flags:
             lines.append("  no anomaly windows flagged")
         lines.append("")
+    for item in attrib_found:
+        entry = item["src"]
+        label = entry.get("model") or item["label"] or "engine"
+        pod = f" @ {entry['pod']}" if entry.get("pod") else ""
+        lines.append(f"== attribution {label}{pod} ==")
+        lines.extend(_render_memory(entry.get("memory")))
+        lines.extend(_render_programs(entry.get("programs")))
+        memory = entry.get("memory") or {}
+        slack = memory.get("slack_bytes")
+        limit = memory.get("limit_bytes")
+        flagged = False
+        if slack is not None and slack < 0:
+            flagged = True
+            lines.append(
+                f"  !! memory ledger overcommitted: accounted owners "
+                f"exceed the detected limit by {_fmt_bytes(-slack)} — the "
+                f"capacity table or the accounting is wrong; expect "
+                f"RESOURCE_EXHAUSTED"
+            )
+        for flag in _degraded_programs(entry.get("programs") or []):
+            flagged = True
+            lines.append(f"  !! {flag}")
+        if not flagged:
+            lines.append("  no attribution anomalies flagged")
+        lines.append("")
     return "\n".join(lines).rstrip()
 
 
@@ -757,18 +913,41 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--analyze",
         metavar="DUMP_JSON",
-        help="post-mortem: decompose a saved /flight payload or bench record",
+        nargs="+",
+        help="post-mortem: decompose a saved /flight payload or bench "
+        "record; TWO OR MORE dumps run the cross-run perf diff "
+        "(tools/perf_diff.py) on top, oldest first",
     )
     args = parser.parse_args(argv)
 
     if args.analyze:
+        dumps: list[tuple[str, dict]] = []
         try:
-            with open(args.analyze) as f:
-                dump = json.load(f)
-            print(analyze(dump))
+            for path in args.analyze:
+                with open(path) as f:
+                    dump = json.load(f)
+                dumps.append((path, dump))
+                if len(args.analyze) > 1:
+                    print(f"---- {path} ----")
+                print(analyze(dump))
         except (OSError, ValueError) as e:
             print(f"analyze failed: {e}", file=sys.stderr)
             return 2
+        if len(dumps) > 1:
+            # cross-run regression sentry: same diff perf_diff runs,
+            # loaded from the sibling tool so the noise bands and
+            # direction table stay single-sourced; the already-parsed
+            # payloads are handed over, never re-read from disk
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            import perf_diff
+
+            print()
+            results, any_regression = perf_diff.diff_payloads(dumps)
+            for base_path, new_path, result in results:
+                print(perf_diff.render(
+                    base_path, new_path, result, perf_diff.DEFAULT_THRESHOLD
+                ))
+            return 1 if any_regression else 0
         return 0
 
     try:

@@ -1,0 +1,319 @@
+#!/usr/bin/env python3
+"""perf diff: the perf-regression sentry over bench/flight rollups.
+
+The bench trajectory (``BENCH_r*.json``) and saved ``/flight`` dumps
+are a monitored series, not JSON archaeology: this tool aligns two or
+more rounds and flags the metrics that regressed beyond a noise band —
+step time, overlap ratio, HBM utilization, speculative uplift, gateway
+TTFT, throughput — each with its direction of "worse" declared, so a
+30% step-time regression is flagged as exactly that and identical
+rollups stay quiet.
+
+    python tools/perf_diff.py BENCH_r05.json BENCH_r06.json
+    python tools/perf_diff.py BENCH_r0*.json --threshold 0.2
+    python tools/perf_diff.py old_flight.json new_flight.json --json
+
+Accepted inputs (auto-detected per file):
+
+- bench records (``bench.py`` output: ``{"metric", "value", "detail"}``,
+  schema-stamped from BENCH_r06 on — see BENCH_NOTES.md);
+- saved ``/flight`` payloads (a list of engine entries with
+  ``summary.totals``/``summary.window``);
+- bare flight rollups (``bench_rollup`` dicts).
+
+Alignment: metrics are extracted into one flat namespace per file; only
+metrics present in BOTH sides of a pair are compared (a phase that was
+skipped in one round is reported as coverage drift, not a regression).
+The bench record's ``schema`` version and program-variant census ride
+along: a census change between rounds is annotated so a step-time shift
+can be read against "the engine compiles different programs now".
+
+``engine_top --analyze A.json B.json`` runs the same diff. Exit code:
+0 quiet, 1 when any regression is flagged, 2 on usage errors.
+Zero dependencies (stdlib only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: metric name → direction in which it gets WORSE ("up" = a higher
+#: value is a regression). Every comparison key must be declared here —
+#: an undeclared metric is ignored rather than guessed.
+METRICS: dict[str, str] = {
+    "tok_s": "down",
+    "step_ms_p50": "up",
+    "mean_step_ms": "up",
+    "host_exposed_ms_p50": "up",
+    "host_overhead_ms_p50": "up",
+    "overlap_ratio": "down",
+    "hbm_utilization": "down",
+    "speculative_uplift": "down",
+    "speculative_accepted_per_step": "down",
+    "gateway_ttft_p50_s": "up",
+    "prefix_cache_speedup": "down",
+    "recompile_count": "up",
+}
+
+#: default noise band: relative change below this is never flagged
+DEFAULT_THRESHOLD = 0.15
+
+
+def _first(d: dict, *keys, default=None):
+    for key in keys:
+        if isinstance(d, dict) and d.get(key) is not None:
+            return d[key]
+    return default
+
+
+def _walk_flight_rollups(obj, found: list[dict]) -> None:
+    """Every flight-rollup-shaped dict in the payload (bench ``flight``
+    keys or ``summary`` entries of a /flight dump)."""
+    if isinstance(obj, dict):
+        totals = (obj.get("summary") or {}).get("totals") or obj.get("totals")
+        if isinstance(totals, dict) and "device_ms" in totals:
+            found.append(obj)
+            return
+        for value in obj.values():
+            _walk_flight_rollups(value, found)
+    elif isinstance(obj, list):
+        for value in obj:
+            _walk_flight_rollups(value, found)
+
+
+def extract_metrics(payload) -> dict:
+    """Flatten one file's payload into ``{metric: value}`` plus the
+    alignment context (``schema``, program census)."""
+    out: dict = {"metrics": {}, "schema": None, "programs": {}}
+    metrics = out["metrics"]
+
+    if isinstance(payload, dict) and "detail" in payload:
+        # bench record
+        out["schema"] = payload.get("schema")
+        if isinstance(payload.get("value"), (int, float)):
+            metrics["tok_s"] = payload["value"]
+        detail = payload.get("detail") or {}
+        # headline leg: the kv-layout entry carrying the roofline
+        for leg in detail.values():
+            if not isinstance(leg, dict):
+                continue
+            roofline = leg.get("roofline")
+            if isinstance(roofline, dict):
+                if roofline.get("hbm_utilization") is not None:
+                    metrics.setdefault(
+                        "hbm_utilization", roofline["hbm_utilization"]
+                    )
+                if leg.get("mean_step_ms") is not None:
+                    metrics.setdefault("mean_step_ms", leg["mean_step_ms"])
+                if leg.get("overlap_ratio") is not None:
+                    metrics.setdefault("overlap_ratio", leg["overlap_ratio"])
+                if isinstance(leg.get("programs"), dict):
+                    out["programs"].update(leg["programs"])
+                flight = leg.get("flight")
+                if isinstance(flight, dict):
+                    for key in (
+                        "step_ms_p50", "host_exposed_ms_p50",
+                        "host_overhead_ms_p50",
+                    ):
+                        if flight.get(key) is not None:
+                            metrics.setdefault(key, flight[key])
+                    if flight.get("recompile_count") is not None:
+                        metrics.setdefault(
+                            "recompile_count", flight["recompile_count"]
+                        )
+        spec = detail.get("speculative")
+        if isinstance(spec, dict):
+            if spec.get("uplift") is not None:
+                metrics["speculative_uplift"] = spec["uplift"]
+            if spec.get("accepted_per_step") is not None:
+                metrics["speculative_accepted_per_step"] = spec[
+                    "accepted_per_step"
+                ]
+        if detail.get("gateway_ttft_p50_s") is not None:
+            metrics["gateway_ttft_p50_s"] = detail["gateway_ttft_p50_s"]
+        prefix = detail.get("prefix_cache")
+        if isinstance(prefix, dict) and prefix.get("speedup") is not None:
+            metrics["prefix_cache_speedup"] = prefix["speedup"]
+        return out
+
+    # /flight dump or bare rollup(s): merge windows across engines
+    rollups: list[dict] = []
+    _walk_flight_rollups(payload, rollups)
+    for entry in rollups:
+        summary = entry.get("summary") or entry
+        window = summary.get("window") or summary
+        for key in (
+            "step_ms_p50", "host_exposed_ms_p50", "host_overhead_ms_p50",
+            "overlap_ratio", "tok_s",
+        ):
+            if _first(window, key) is not None:
+                metrics.setdefault(key, window[key])
+        totals = summary.get("totals") or {}
+        recompiles = _first(
+            totals, "recompiles", default=entry.get("recompile_count")
+        )
+        if recompiles is not None:
+            metrics.setdefault("recompile_count", recompiles)
+        # attribution payloads riding in the dump
+        for program in entry.get("programs") or []:
+            if isinstance(program, dict) and program.get("program"):
+                out["programs"][program["program"]] = program.get(
+                    "dispatches", 0
+                )
+    return out
+
+
+def diff_metrics(
+    base: dict, new: dict, threshold: float = DEFAULT_THRESHOLD
+) -> dict:
+    """Compare two extractions. Returns ``regressions`` (beyond the
+    noise band, in the declared worse direction), ``improvements``
+    (beyond the band the other way — reported, never flagged), and
+    ``notes`` (coverage/schema/census drift)."""
+    regressions: list[dict] = []
+    improvements: list[dict] = []
+    notes: list[str] = []
+    base_m, new_m = base["metrics"], new["metrics"]
+    for metric, worse in METRICS.items():
+        b, n = base_m.get(metric), new_m.get(metric)
+        if b is None or n is None:
+            if (b is None) != (n is None):
+                notes.append(
+                    f"{metric}: only in "
+                    f"{'new' if b is None else 'base'} round (coverage "
+                    f"drift, not compared)"
+                )
+            continue
+        if not isinstance(b, (int, float)) or not isinstance(n, (int, float)):
+            continue
+        if b == 0:
+            continue
+        change = (n - b) / abs(b)
+        entry = {
+            "metric": metric,
+            "base": b,
+            "new": n,
+            "change": round(change, 4),
+        }
+        if abs(change) < threshold:
+            continue
+        regressed = change > 0 if worse == "up" else change < 0
+        (regressions if regressed else improvements).append(entry)
+    if base.get("schema") != new.get("schema"):
+        notes.append(
+            f"schema drift: base {base.get('schema')!r} vs new "
+            f"{new.get('schema')!r}"
+        )
+    bp, np_ = set(base.get("programs") or ()), set(new.get("programs") or ())
+    if bp and np_ and bp != np_:
+        gone, fresh = sorted(bp - np_), sorted(np_ - bp)
+        notes.append(
+            "program census changed"
+            + (f"; dropped: {', '.join(gone[:4])}" if gone else "")
+            + (f"; new: {', '.join(fresh[:4])}" if fresh else "")
+            + " — read step-time shifts against the new variant set"
+        )
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "notes": notes,
+    }
+
+
+def render(label_base: str, label_new: str, result: dict,
+           threshold: float) -> str:
+    lines = [f"== {label_base} -> {label_new} =="]
+    for entry in result["regressions"]:
+        lines.append(
+            f"  !! REGRESSION {entry['metric']}: {entry['base']} -> "
+            f"{entry['new']} ({100 * entry['change']:+.1f}%)"
+        )
+    for entry in result["improvements"]:
+        lines.append(
+            f"  improvement {entry['metric']}: {entry['base']} -> "
+            f"{entry['new']} ({100 * entry['change']:+.1f}%)"
+        )
+    for note in result["notes"]:
+        lines.append(f"  note: {note}")
+    if not result["regressions"]:
+        lines.append(
+            f"  no regressions beyond ±{100 * threshold:.0f}% noise band"
+        )
+    return "\n".join(lines)
+
+
+def diff_payloads(
+    labeled: list[tuple[str, object]], threshold: float = DEFAULT_THRESHOLD
+) -> tuple[list[tuple[str, str, dict]], bool]:
+    """Pairwise diffs over consecutive already-loaded payloads (label,
+    parsed JSON), oldest first — the entry point for callers that hold
+    the dumps in memory (engine_top's multi-dump ``--analyze`` loads
+    each file once for decomposition and hands the payloads here).
+    Returns the pair results and whether any regression was flagged."""
+    extracted = [
+        (label, extract_metrics(payload)) for label, payload in labeled
+    ]
+    results = []
+    any_regression = False
+    for (base_label, base), (new_label, new) in zip(extracted, extracted[1:]):
+        result = diff_metrics(base, new, threshold)
+        any_regression = any_regression or bool(result["regressions"])
+        results.append((base_label, new_label, result))
+    return results, any_regression
+
+
+def diff_files(
+    paths: list[str], threshold: float = DEFAULT_THRESHOLD
+) -> tuple[list[tuple[str, str, dict]], bool]:
+    """Pairwise diffs over consecutive files (sorted order is the
+    caller's business — pass rounds oldest first). Returns the pair
+    results and whether any regression was flagged."""
+    labeled = []
+    for path in paths:
+        with open(path) as f:
+            labeled.append((path, json.load(f)))
+    return diff_payloads(labeled, threshold)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="flag perf regressions between bench/flight rounds"
+    )
+    parser.add_argument(
+        "files", nargs="+",
+        help="two or more BENCH_r*.json records or saved /flight dumps, "
+        "oldest first (consecutive pairs are compared)",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=DEFAULT_THRESHOLD,
+        help=f"relative noise band (default {DEFAULT_THRESHOLD})",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit results as JSON"
+    )
+    args = parser.parse_args(argv)
+    if len(args.files) < 2:
+        parser.error("need at least two files to diff")
+    try:
+        results, any_regression = diff_files(args.files, args.threshold)
+    except (OSError, ValueError) as e:
+        print(f"perf_diff failed: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(
+            [
+                {"base": b, "new": n, **result}
+                for b, n, result in results
+            ],
+            indent=2,
+        ))
+    else:
+        for base_path, new_path, result in results:
+            print(render(base_path, new_path, result, args.threshold))
+    return 1 if any_regression else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

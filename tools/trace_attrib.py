@@ -1,0 +1,239 @@
+#!/usr/bin/env python3
+"""trace attrib: per-op device-time buckets from a profiler capture.
+
+The attribution ledger (``serving/attribution.py``) is *analytical*: it
+predicts what each program should cost from model shape and pairs it
+with measured dispatch time. When the model and the chip disagree — a
+program whose achieved-vs-expected ratio is far off with no host-side
+explanation — the post-mortem needs op-level device truth. This tool
+parses a ``ProfilerHooks`` capture (``LS_TPU_PROFILE_DIR`` /
+``/profile/start`` — ``jax.profiler`` writes Chrome-trace
+``*.trace.json.gz`` files under ``plugins/profile/<run>/``) into
+per-op device-time buckets:
+
+    attention / mlp / collectives / copies / sampling / other
+
+so "this decode program runs at 0.3× its roofline" decomposes into
+"because 40% of its device time is layout copies", without TensorBoard
+or Perfetto in the loop.
+
+    python tools/trace_attrib.py /tmp/profile            # capture dir
+    python tools/trace_attrib.py trace.json.gz --json    # one file
+    python tools/trace_attrib.py trace.json --top 10
+
+Zero dependencies (stdlib only). Classification is a keyword table over
+XLA op names — fused ops bucket by their first matching keyword, in
+table order (attention before mlp: an "attention" fusion full of dots
+is attention). The table is a heuristic, printed with the output so a
+surprising bucket is auditable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import re
+import sys
+
+#: bucket → name keywords, checked IN ORDER (first match wins). Op and
+#: fusion names are lower-cased before matching.
+BUCKET_KEYWORDS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("attention", (
+        "attention", "flash", "paged", "softmax", "logits_qk", "qk",
+        "masked_fill", "rope",
+    )),
+    ("collectives", (
+        "all-reduce", "all_reduce", "allreduce",
+        "all-gather", "all_gather", "allgather",
+        "reduce-scatter", "reduce_scatter",
+        "all-to-all", "all_to_all", "alltoall",
+        "collective", "psum", "ppermute", "permute", "send", "recv",
+    )),
+    ("sampling", (
+        "sort", "top-k", "top_k", "topk", "argmax", "arg_max", "rng",
+        "random", "gumbel", "sample", "threefry", "iota",
+    )),
+    ("copies", (
+        "copy", "transpose", "reshape", "broadcast", "concatenate",
+        "slice", "gather", "scatter", "dynamic-update", "dynamic_update",
+        "pad", "bitcast", "convert", "tuple", "infeed", "outfeed",
+        "memset",
+    )),
+    ("mlp", (
+        "dot", "einsum", "matmul", "convolution", "gemm", "mlp", "gate",
+        "fusion", "cublas", "custom-call", "custom_call",
+    )),
+)
+
+BUCKETS = tuple(name for name, _ in BUCKET_KEYWORDS) + ("other",)
+
+
+def classify(name: str) -> str:
+    lowered = name.lower()
+    for bucket, keywords in BUCKET_KEYWORDS:
+        if any(k in lowered for k in keywords):
+            return bucket
+    return "other"
+
+
+def _load_trace(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        return json.load(f)
+
+
+def find_trace_files(root: str) -> list[str]:
+    """Trace files under a capture dir (``plugins/profile/<run>/…``), or
+    the file itself when pointed at one directly."""
+    if os.path.isfile(root):
+        return [root]
+    found: list[str] = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for filename in filenames:
+            if filename.endswith((".trace.json.gz", "trace.json.gz",
+                                  ".trace.json", "trace.json")):
+                found.append(os.path.join(dirpath, filename))
+    return sorted(found)
+
+
+def _device_pids(trace: dict) -> set[int]:
+    """pids whose process_name metadata looks like a device lane (TPU /
+    GPU / XLA device streams). Empty when the trace carries no such
+    metadata — the caller then buckets every complete event (CPU-only
+    captures still decompose usefully)."""
+    pids: set[int] = set()
+    for event in trace.get("traceEvents", []):
+        if event.get("ph") == "M" and event.get("name") == "process_name":
+            pname = str((event.get("args") or {}).get("name", "")).lower()
+            if re.search(r"tpu|gpu|xla|/device:|device:|accelerator", pname):
+                pids.add(event.get("pid"))
+    return pids
+
+
+def bucket_events(trace: dict) -> dict:
+    """Per-bucket totals over one trace's complete (``ph: X``) events.
+    Durations are Chrome-trace microseconds; output is milliseconds."""
+    device_pids = _device_pids(trace)
+    totals: dict[str, float] = {b: 0.0 for b in BUCKETS}
+    counts: dict[str, int] = {b: 0 for b in BUCKETS}
+    by_op: dict[str, dict[str, float]] = {b: {} for b in BUCKETS}
+    for event in trace.get("traceEvents", []):
+        if event.get("ph") != "X":
+            continue
+        if device_pids and event.get("pid") not in device_pids:
+            continue
+        dur_us = event.get("dur")
+        name = event.get("name")
+        if not name or not isinstance(dur_us, (int, float)):
+            continue
+        bucket = classify(name)
+        ms = dur_us / 1000.0
+        totals[bucket] += ms
+        counts[bucket] += 1
+        by_op[bucket][name] = by_op[bucket].get(name, 0.0) + ms
+    return {"totals_ms": totals, "counts": counts, "by_op": by_op}
+
+
+def merge(parts: list[dict]) -> dict:
+    out = {
+        "totals_ms": {b: 0.0 for b in BUCKETS},
+        "counts": {b: 0 for b in BUCKETS},
+        "by_op": {b: {} for b in BUCKETS},
+    }
+    for part in parts:
+        for b in BUCKETS:
+            out["totals_ms"][b] += part["totals_ms"][b]
+            out["counts"][b] += part["counts"][b]
+            for op, ms in part["by_op"][b].items():
+                out["by_op"][b][op] = out["by_op"][b].get(op, 0.0) + ms
+    return out
+
+
+def report(agg: dict, top: int = 5) -> dict:
+    """The serializable report: per-bucket device ms, share, event
+    count, and the top ops by time."""
+    total_ms = sum(agg["totals_ms"].values())
+    buckets = {}
+    for bucket in BUCKETS:
+        ms = agg["totals_ms"][bucket]
+        ops = sorted(
+            agg["by_op"][bucket].items(), key=lambda kv: -kv[1]
+        )[:top]
+        buckets[bucket] = {
+            "device_ms": round(ms, 3),
+            "share": round(ms / total_ms, 4) if total_ms else 0.0,
+            "events": agg["counts"][bucket],
+            "top_ops": [
+                {"name": op, "device_ms": round(op_ms, 3)}
+                for op, op_ms in ops
+            ],
+        }
+    return {"total_device_ms": round(total_ms, 3), "buckets": buckets}
+
+
+def render(rep: dict) -> str:
+    lines = [f"device time {rep['total_device_ms']:.1f}ms by op bucket:"]
+    ranked = sorted(
+        rep["buckets"].items(), key=lambda kv: -kv[1]["device_ms"]
+    )
+    for bucket, info in ranked:
+        if not info["events"]:
+            continue
+        bar = "█" * int(round(info["share"] * 32))
+        lines.append(
+            f"  {bucket:12s} {info['device_ms']:10.1f}ms "
+            f"{100 * info['share']:5.1f}%  {bar}"
+        )
+        for op in info["top_ops"][:3]:
+            lines.append(
+                f"               {op['name'][:48]:48s} "
+                f"{op['device_ms']:.1f}ms"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="bucket a jax.profiler capture into per-op device time"
+    )
+    parser.add_argument(
+        "path",
+        help="ProfilerHooks capture dir (LS_TPU_PROFILE_DIR) or a "
+        "trace.json[.gz] file",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    parser.add_argument(
+        "--top", type=int, default=5, help="top ops per bucket (default 5)"
+    )
+    args = parser.parse_args(argv)
+
+    files = find_trace_files(args.path)
+    if not files:
+        print(f"no trace files under {args.path!r} (expected "
+              f"*.trace.json[.gz] — is LS_TPU_PROFILE_DIR pointed at a "
+              f"finished capture?)", file=sys.stderr)
+        return 2
+    parts = []
+    for path in files:
+        try:
+            parts.append(bucket_events(_load_trace(path)))
+        except (OSError, ValueError) as e:
+            print(f"skipping {path}: {e}", file=sys.stderr)
+    if not parts:
+        print("no parseable trace files", file=sys.stderr)
+        return 2
+    rep = report(merge(parts), top=args.top)
+    rep["files"] = files
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
