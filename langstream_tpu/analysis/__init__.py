@@ -1,6 +1,6 @@
 """graftcheck: first-party static analysis for the langstream-tpu tree.
 
-Eleven rule families tuned to this codebase's actual failure modes:
+Twelve rule families tuned to this codebase's actual failure modes:
 
 ==========  ==============================================================
 JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
@@ -34,6 +34,9 @@ FLOW1001-4  dataflow: donated jit buffers read before rebinding,
 FLEET601/2  fleet autoscaler discipline: replica-count writes not gated
             by a cooldown check, and blocking I/O or lock acquisition
             inside the reconcile loop's decision section
+POOL701     kv-transfer plane discipline: blocking I/O, locks, or device
+            syncs in the KV handoff serialization path outside the
+            sanctioned ``_fetch*`` stages (disaggregated pools)
 ==========  ==============================================================
 
 RACE/INV/FLOW are **project rules**: they run over a whole-program index
@@ -76,6 +79,7 @@ from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
 from langstream_tpu.analysis.rules_perf import RULES as _PERF_RULES
+from langstream_tpu.analysis.rules_pool import RULES as _POOL_RULES
 from langstream_tpu.analysis.rules_qos import RULES as _QOS_RULES
 from langstream_tpu.analysis.rules_race import RULES as _RACE_RULES
 from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
@@ -89,6 +93,7 @@ ALL_RULES: list[Rule] = [
     *_QOS_RULES,
     *_PERF_RULES,
     *_FLEET_RULES,
+    *_POOL_RULES,
 ]
 
 #: whole-program rules (run over the ProjectIndex, not per file)

@@ -313,6 +313,47 @@ class ProgramLedger:
 ))
 
 _register(RuleExample(
+    rule="POOL701",
+    tp={
+        "langstream_tpu/serving/kvtransfer.py": '''\
+import jax
+
+def serialize_handoff(header, gathered):
+    # a device sync inside serialization stalls the engine loop against
+    # the device on EVERY export — and a lock queues the handoff behind
+    # whatever dispatch holds it
+    jax.block_until_ready(gathered)
+    with header["engine"].dispatch_lock:
+        return bytes(header["request"], "utf-8")
+''',
+    },
+    tn={
+        "langstream_tpu/serving/kvtransfer.py": '''\
+import jax
+
+def serialize_handoff(header, arrays):
+    # the sanctioned shape: header JSON + host-array bytes, no waits
+    chunks = [arrays[name].tobytes() for name in sorted(arrays)]
+    return b"LSKV" + b"".join(chunks)
+
+def _fetch_rows(gathered):
+    # the ONE sanctioned sync point: a _fetch* stage, run on the
+    # dispatch thread and timed (mirrors the engine's _fetch_chunk)
+    jax.block_until_ready(gathered)
+    return gathered
+''',
+    },
+    fix=(
+        "Keep kv-transfer serialization to header JSON plus tobytes() on "
+        "HOST arrays, and confine the one device sync to a dispatch-"
+        "thread _fetch* stage (kvtransfer._fetch_rows), timed like the "
+        "engine's _fetch_chunk. Locks and blocking I/O have no place on "
+        "the handoff path — a /kv/export pickup must answer even while "
+        "the engine is mid-dispatch (docs/DISAGG.md)."
+    ),
+))
+
+_register(RuleExample(
     rule="FLEET601",
     tp={
         "langstream_tpu/controlplane/autoscaler.py": '''\

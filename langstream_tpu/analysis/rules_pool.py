@@ -1,0 +1,119 @@
+"""Disaggregated-pool rules: KV-transfer plane discipline (POOL701).
+
+The KV handoff plane (``serving/kvtransfer.py``, docs/DISAGG.md) sits on
+both pools' hot paths: the prefill engine serializes finished-prefill
+blocks at the loop's safe point, and the decode engine's import handlers
+answer while decode bursts are in flight. POOL701 is OBS504's shape over
+that plane: **blocking I/O, lock acquisition, or a device sync anywhere
+in the kv-transfer serialization path outside the sanctioned fetch
+points** is a red gate —
+
+- a device sync in the serialize/deserialize helpers stalls the engine
+  loop against the device for every export (the one legitimate sync is
+  the designated ``_fetch*`` stage, run on the dispatch thread and
+  timed, exactly like the engine's ``_fetch_chunk``);
+- a lock queues the export — or a ``/kv/export`` pickup — behind
+  whatever dispatch holds it, exactly when the decode pool is waiting;
+- blocking I/O in the wire helpers turns every handoff into a host
+  stall the flight recorder would have to attribute to "host".
+
+Scope: every function in ``serving/kvtransfer.py`` except the
+sanctioned fetch stages (``_fetch_rows`` — names starting ``_fetch``),
+the engine's kv-transfer surface (export/import orchestration and the
+wait-free sections/pops), and the pod payload builder
+(``_kv_export_payload`` in ``runtime/pod.py``). Nested defs are exempt
+everywhere — they are the dispatch-thread closures where the timed sync
+legitimately lives (the same exemption OBS503/OBS504 grant).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule
+from langstream_tpu.analysis.rules_obs import _waitfree_violations
+
+#: the transfer-plane module: EVERY function is on the serialization
+#: path unless it is a designated fetch stage
+_TRANSFER_MODULE = "langstream_tpu/serving/kvtransfer.py"
+
+#: sanctioned fetch-stage prefix: the one place a device sync belongs
+#: (run on the dispatch thread, timed — mirrors PERF701's stages)
+_FETCH_PREFIX = "_fetch"
+
+#: named kv-transfer functions outside the module: the engine's handoff
+#: orchestration + wait-free surfaces, and the pod payload builder
+_TRANSFER_FUNCS_BY_FILE = {
+    "langstream_tpu/runtime/pod.py": {"_kv_export_payload"},
+    "langstream_tpu/serving/": {
+        "kv_fingerprint",
+        "kv_transfer_section",
+        "take_export",
+        "take_kv_export",
+        "_export_ready_slots",
+        "_export_slot",
+        "_apply_imports",
+        "_shed_import",
+        "import_handoff",
+        "import_kv_handoff",
+    },
+}
+
+
+def _transfer_functions(mod: Module) -> Iterator[ast.AST]:
+    whole_module = mod.path.endswith(_TRANSFER_MODULE)
+    named: set[str] = set()
+    for prefix, names in _TRANSFER_FUNCS_BY_FILE.items():
+        if prefix in mod.path or mod.path.endswith(prefix):
+            named = names
+            break
+    if not whole_module and not named:
+        return
+    nested_fns: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if inner is not node and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested_fns.add(id(inner))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(node) in nested_fns:
+            continue
+        if node.name.startswith(_FETCH_PREFIX):
+            continue  # the sanctioned fetch stage
+        if whole_module or node.name in named:
+            yield node
+
+
+def check_blocking_in_transfer_plane(mod: Module) -> Iterator[Finding]:
+    for fn in _transfer_functions(mod):
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "POOL701",
+                node,
+                f"{kind} {offender} in the kv-transfer serialization path "
+                f"(`{fn.name}`): the handoff plane must stay wait-free "
+                f"outside the sanctioned _fetch* stage — a device sync "
+                f"stalls the engine loop per export, a lock queues the "
+                f"handoff behind the dispatch holding it, blocking I/O "
+                f"turns every transfer into exposed host time; move the "
+                f"sync into the dispatch-thread _fetch stage (timed) and "
+                f"keep serialization to header JSON + host-array bytes "
+                f"(docs/DISAGG.md)",
+            )
+
+
+RULES = [
+    Rule(
+        id="POOL701",
+        family="pool",
+        summary="device sync, blocking I/O, or lock acquisition in the "
+        "kv-transfer serialization path outside the sanctioned _fetch* "
+        "stages (the handoff plane must be wait-free)",
+        check=check_blocking_in_transfer_plane,
+    ),
+]

@@ -59,6 +59,26 @@ log = logging.getLogger(__name__)
 #: resetting it to the CR's parallelism every tick
 AUTOSCALE_ANNOTATION = "langstream.tpu/autoscale"
 
+#: disaggregated serving pool roles (docs/DISAGG.md)
+POOL_ROLES = ("prefill", "decode")
+
+#: per-pool signal defaults (docs/DISAGG.md): each pool scales on ITS
+#: OWN bottleneck. The prefill pool is prompt-compute bound — queue
+#: depth is its pressure, and KV reservation never fires (prefill slots
+#: turn over per prompt; kv-reserved=1.0 can never be strictly
+#: exceeded). The decode pool is KV-residency bound — reserved-fraction
+#: is its pressure, and queue thresholds are parked out of reach (its
+#: queue is fed by handoffs the prefill pool already admission-gated).
+#: Any key may be overridden in the pool's declared autoscale section.
+POOL_SIGNAL_DEFAULTS: dict[str, dict[str, Any]] = {
+    "prefill": {"kv-reserved": 1.0},
+    "decode": {
+        "queue-depth-per-replica": 1e9,
+        "interactive-depth-per-replica": 1e9,
+        "kv-reserved": 0.85,
+    },
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class AutoscaleSpec:
@@ -102,6 +122,11 @@ class AutoscaleSpec:
     #: optional agent id naming the StatefulSet to scale when the app has
     #: several (defaults to the app's single scalable serving STS)
     agent: str | None = None
+    #: disaggregated pool this policy scales ("prefill" / "decode", set
+    #: by the ``pools:`` section — docs/DISAGG.md); None = the classic
+    #: single-fleet policy. The backend resolves the pool's StatefulSet
+    #: (the ``-prefill``/``-decode`` split the manifest factory emits).
+    pool: str | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -123,6 +148,7 @@ class AutoscaleSpec:
             "idle-occupancy": self.idle_occupancy,
             "idle-queue": self.idle_queue,
             "agent": self.agent,
+            "pool": self.pool,
         }
 
     @classmethod
@@ -188,6 +214,12 @@ class AutoscaleSpec:
         if shed_delta < 1:
             raise ValueError("autoscale.shed-delta must be >= 1")
         agent = _get("agent", None)
+        pool = _get("pool", None)
+        if pool is not None and pool not in POOL_ROLES:
+            raise ValueError(
+                f"autoscale.pool must be one of {list(POOL_ROLES)}, "
+                f"got {pool!r}"
+            )
         return cls(
             enabled=_parse_bool(_get("enabled", True)),
             min_replicas=min_r,
@@ -205,6 +237,7 @@ class AutoscaleSpec:
             idle_occupancy=idle_occ,
             idle_queue=int(_get("idle-queue", 0)),
             agent=str(agent) if agent is not None else None,
+            pool=str(pool) if pool is not None else None,
         )
 
 
@@ -214,16 +247,70 @@ def _parse_bool(v: Any) -> bool:
     return bool(v)
 
 
+def pool_autoscale_spec(role: str, declared: Any) -> "AutoscaleSpec | None":
+    """Build one pool's :class:`AutoscaleSpec` from its declared
+    ``pools.<role>.autoscale`` section, folding in the role's signal
+    defaults (prefill scales on queue depth, decode on KV reserved
+    fraction — docs/DISAGG.md). ``declared`` is the pool's entry (a
+    mapping, possibly without an ``autoscale`` key → None: the pool
+    exists but is not autoscaled). Raises ValueError on malformed
+    config (the deploy-validation contract)."""
+    if role not in POOL_ROLES:
+        raise ValueError(
+            f"unknown pool role {role!r}; known: {list(POOL_ROLES)}"
+        )
+    if declared is None:
+        declared = {}
+    if not isinstance(declared, dict):
+        raise ValueError(
+            f"pools.{role} must be a mapping, got {type(declared).__name__}"
+        )
+    section = declared.get("autoscale")
+    if section is None:
+        return None
+    if not isinstance(section, dict):
+        raise ValueError(
+            f"pools.{role}.autoscale must be a mapping, "
+            f"got {type(section).__name__}"
+        )
+    merged = dict(POOL_SIGNAL_DEFAULTS[role])
+    merged.update(section)
+    merged["pool"] = role
+    return AutoscaleSpec.from_dict(merged)
+
+
+def _serving_pools(res_configuration: dict | None) -> dict[str, Any] | None:
+    """The ``pools:`` section of a tpu-serving-configuration resource
+    (None when absent). Validates role names eagerly."""
+    pools = (res_configuration or {}).get("pools")
+    if pools is None:
+        return None
+    if not isinstance(pools, dict) or not pools:
+        raise ValueError(
+            "pools section must be a non-empty mapping of role -> config"
+        )
+    unknown = sorted(set(pools) - set(POOL_ROLES))
+    if unknown:
+        raise ValueError(
+            f"pools: unknown role(s) {unknown}; known: {list(POOL_ROLES)}"
+        )
+    return pools
+
+
 def validate_application_autoscale(application) -> None:
     """Deploy-time validation: parse every ``tpu-serving-configuration``
-    resource's ``autoscale`` section so a malformed policy fails the
-    deploy (HTTP 400) instead of the first reconcile — the same contract
-    the qos/slo validators keep."""
+    resource's ``autoscale`` section AND its ``pools`` section (the
+    disaggregated split's per-pool policies) so a malformed policy fails
+    the deploy (HTTP 400) instead of the first reconcile — the same
+    contract the qos/slo validators keep."""
     for name, res in (getattr(application, "resources", None) or {}).items():
         if getattr(res, "type", None) != "tpu-serving-configuration":
             continue
         try:
             AutoscaleSpec.from_dict((res.configuration or {}).get("autoscale"))
+            pools = _serving_pools(res.configuration or {})
+            for role, declared in (pools or {}).items():
+                pool_autoscale_spec(role, declared)
         except ValueError as e:
             raise ValueError(
                 f"resource {name!r}: invalid autoscale section: {e}"
@@ -233,18 +320,42 @@ def validate_application_autoscale(application) -> None:
 def application_autoscale_spec(application) -> "AutoscaleSpec | None":
     """The app's enabled autoscale policy, or None (first declared
     serving resource wins — one fleet per app)."""
+    specs = application_autoscale_specs(application)
+    for spec in specs:
+        if spec.pool is None:
+            return spec
+    return specs[0] if specs else None
+
+
+def application_autoscale_specs(application) -> "list[AutoscaleSpec]":
+    """Every enabled autoscale policy the app declares — one for a
+    classic single fleet, one PER POOL for a disaggregated split
+    (``pools.prefill.autoscale`` / ``pools.decode.autoscale``,
+    docs/DISAGG.md). First declared serving resource wins."""
     for res in (getattr(application, "resources", None) or {}).values():
         if getattr(res, "type", None) != "tpu-serving-configuration":
             continue
         try:
+            pools = _serving_pools(res.configuration or {})
+            if pools is not None:
+                specs = []
+                for role in POOL_ROLES:  # stable order
+                    if role not in pools:
+                        continue
+                    spec = pool_autoscale_spec(role, pools[role])
+                    if spec is not None and spec.enabled:
+                        specs.append(spec)
+                if specs:
+                    return specs
+                continue
             spec = AutoscaleSpec.from_dict(
                 (res.configuration or {}).get("autoscale")
             )
         except ValueError:
             continue  # deploy validation already rejected new configs
         if spec is not None and spec.enabled:
-            return spec
-    return None
+            return [spec]
+    return []
 
 
 # ---------------------------------------------------------------------------
@@ -269,6 +380,9 @@ class ReplicaObservation:
     state: str = "ok"          # ok | degraded | wedged
     draining: bool = False
     slo_alerting: tuple = ()
+    #: disaggregated pool role ("combined" / "prefill" / "decode") — the
+    #: router's phase filter keys off this (docs/DISAGG.md)
+    pool: str = "combined"
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -283,6 +397,7 @@ class ReplicaObservation:
             "state": self.state,
             "draining": self.draining,
             "slo_alerting": list(self.slo_alerting),
+            "pool": self.pool,
         }
 
 
@@ -774,11 +889,15 @@ def observation_from_summary(
     kv_used: float | None = None
     state = "ok"
     draining = False
+    pool = "combined"
     alerting: set[str] = set()
     rank = {"ok": 0, "degraded": 1, "wedged": 2}
     for entry in entries if isinstance(entries, list) else []:
         if not isinstance(entry, dict):
             continue
+        entry_pool = entry.get("pool_role")
+        if entry_pool in ("prefill", "decode"):
+            pool = entry_pool
         scheduler = entry.get("scheduler") or {}
         queued += int(
             scheduler.get("depth", scheduler.get("queued", 0)) or 0
@@ -817,4 +936,5 @@ def observation_from_summary(
         state=state,
         draining=draining,
         slo_alerting=tuple(sorted(alerting)),
+        pool=pool,
     )

@@ -36,7 +36,7 @@ from langstream_tpu.controlplane.stores import (
 )
 from langstream_tpu.controlplane.autoscaler import (
     FleetAutoscaler,
-    application_autoscale_spec,
+    application_autoscale_specs,
     validate_application_autoscale,
 )
 from langstream_tpu.core.parser import ModelBuilder
@@ -435,8 +435,11 @@ class ControlPlaneServer:
         # per-application fleet autoscalers (controlplane/autoscaler.py):
         # created at deploy for apps whose serving resource declares an
         # enabled autoscale section AND whose compute runtime can scale
-        # (the k8s runtime; dev mode has no replicas to scale)
-        self.autoscalers: dict[tuple[str, str], FleetAutoscaler] = {}
+        # (the k8s runtime; dev mode has no replicas to scale). A
+        # disaggregated app (pools: section, docs/DISAGG.md) runs one
+        # reconcile loop PER POOL — prefill scales on queue depth,
+        # decode on KV reserved fraction, each against its own STS.
+        self.autoscalers: dict[tuple[str, str], list[FleetAutoscaler]] = {}
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)
@@ -455,19 +458,21 @@ class ControlPlaneServer:
     # ---- fleet autoscaler lifecycle --------------------------------------
 
     async def _stop_autoscaler(self, key: tuple[str, str]) -> None:
-        scaler = self.autoscalers.pop(key, None)
-        if scaler is not None:
+        scalers = self.autoscalers.pop(key, None)
+        for scaler in scalers or []:
             await scaler.stop()
 
     async def _sync_autoscaler(self, stored: StoredApplication, application) -> None:
-        """(Re)start the app's fleet autoscaler after a deploy: one
-        reconcile loop per app with an enabled ``autoscale:`` section,
-        driving the compute runtime's scaling backend. Dev-mode compute
-        has no replicas, so apps there simply never get one."""
+        """(Re)start the app's fleet autoscaler(s) after a deploy: one
+        reconcile loop per enabled ``autoscale:`` policy — a single loop
+        for a classic fleet, one per pool for a disaggregated split
+        (docs/DISAGG.md) — driving the compute runtime's scaling
+        backend. Dev-mode compute has no replicas, so apps there simply
+        never get one."""
         key = (stored.tenant, stored.name)
         await self._stop_autoscaler(key)
-        spec = application_autoscale_spec(application)
-        if spec is None:
+        specs = application_autoscale_specs(application)
+        if not specs:
             return
         backend_factory = getattr(self.compute, "autoscaler_backend", None)
         if backend_factory is None:
@@ -477,34 +482,57 @@ class ControlPlaneServer:
                 stored.tenant, stored.name, type(self.compute).__name__,
             )
             return
-        backend = backend_factory(stored.tenant, stored.name, spec)
-        if backend is None:
-            return
         registry = getattr(self.compute, "gateway_registry", None)
-        on_observation = None
-        if registry is not None:
-            tenant, name = stored.tenant, stored.name
+        scalers: list[FleetAutoscaler] = []
+        for spec in specs:
+            backend = backend_factory(stored.tenant, stored.name, spec)
+            if backend is None:
+                continue
+            on_observation = None
+            if registry is not None:
+                tenant, name = stored.tenant, stored.name
+                source = spec.pool or ""
 
-            def on_observation(obs, _t=tenant, _n=name, _r=registry):
-                # the router consumes the same fleet snapshot the scaler
-                # judges — one fan-in, two consumers
-                _r.update_fleet(_t, _n, obs)
+                def on_observation(
+                    obs, _t=tenant, _n=name, _r=registry, _s=source
+                ):
+                    # the router consumes the same fleet snapshot the
+                    # scaler judges — one fan-in, two consumers; split
+                    # fleets tag the source pool so the router keeps
+                    # the union of both pools' observations
+                    _r.update_fleet(_t, _n, obs, source=_s)
 
-        scaler = FleetAutoscaler(spec, backend, on_observation=on_observation)
-        scaler.start()
-        self.autoscalers[key] = scaler
+            scaler = FleetAutoscaler(
+                spec, backend, on_observation=on_observation
+            )
+            scaler.start()
+            scalers.append(scaler)
+        if scalers:
+            self.autoscalers[key] = scalers
 
     async def _autoscaler(self, request: web.Request) -> web.Response:
         """Per-application autoscaler status: declared policy, latest
         per-replica observations, and the decision ring (scale events
         with their evidence). Apps without an active autoscaler answer
-        ``{"enabled": false}`` — an operator polling the route learns
-        the distinction between "no policy" and "no decisions yet"."""
+        ``{"enabled": false}``; a disaggregated app answers a
+        ``pools`` mapping with one status per pool policy (a classic
+        single-policy app keeps the flat payload engine_top and the
+        PR 9 tests already consume)."""
         key = (request.match_info["tenant"], request.match_info["name"])
-        scaler = self.autoscalers.get(key)
-        if scaler is None:
+        scalers = self.autoscalers.get(key)
+        if not scalers:
             return web.json_response({"enabled": False})
-        return web.json_response(scaler.status())
+        if len(scalers) == 1 and scalers[0].spec.pool is None:
+            return web.json_response(scalers[0].status())
+        return web.json_response(
+            {
+                "enabled": True,
+                "pools": {
+                    (scaler.spec.pool or "default"): scaler.status()
+                    for scaler in scalers
+                },
+            }
+        )
 
     # ---- tenants ---------------------------------------------------------
 

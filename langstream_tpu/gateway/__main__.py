@@ -103,6 +103,15 @@ async def _sync_registry(registry, control_plane_url: str) -> None:
             body = await resp.json()
         if body.get("enabled") and body.get("replicas"):
             registry.update_fleet(tenant, app_name, body["replicas"])
+        elif body.get("enabled") and body.get("pools"):
+            # disaggregated app (docs/DISAGG.md): one autoscaler status
+            # per pool — feed each pool's replicas under its own source
+            # so the router keeps the union of both pools
+            for pool, status in body["pools"].items():
+                if status.get("replicas"):
+                    registry.update_fleet(
+                        tenant, app_name, status["replicas"], source=pool
+                    )
 
     async with aiohttp.ClientSession(headers=headers) as session:
         while True:

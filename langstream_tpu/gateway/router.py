@@ -76,6 +76,9 @@ class ReplicaRouter:
         self.picks = 0
         self.affinity_hits = 0
         self.affinity_rerouted = 0
+        # disaggregated pools (docs/DISAGG.md): the phase of the latest
+        # pick ("prefill"/"decode"/"any") — engine_top's split-fleet view
+        self.last_pick_phase: str | None = None
 
     # -- snapshot ingestion ---------------------------------------------
 
@@ -106,6 +109,28 @@ class ReplicaRouter:
         )
 
     @staticmethod
+    def _pool(snapshot: dict[str, Any]) -> str:
+        return snapshot.get("pool") or "combined"
+
+    def _pooled(self) -> bool:
+        """True once any replica declares a split pool role — the
+        moment phase filtering engages. Combined-only fleets never see
+        it, so today's behavior stays bit-for-bit."""
+        return any(
+            self._pool(snap) != "combined"
+            for snap in self._replicas.values()
+        )
+
+    def _phase_ok(self, snapshot: dict[str, Any], phase: str | None) -> bool:
+        """Phase filter (disaggregated fleets only): new requests go to
+        the prefill pool, handoffs to the decode pool; a combined
+        replica in a mixed fleet serves either phase."""
+        if phase is None or not self._pooled():
+            return True
+        pool = self._pool(snapshot)
+        return pool == phase or pool == "combined"
+
+    @staticmethod
     def _load(snapshot: dict[str, Any]) -> float:
         """(1 + queue depth) × (1 + occupancy/slots): a replica with an
         empty queue and an empty batch scores 1.0; queue growth scales
@@ -121,22 +146,45 @@ class ReplicaRouter:
             if self._eligible(snap)
         )
 
-    def pick(self, tenant: str | None = None) -> str | None:
+    def pick(
+        self,
+        tenant: str | None = None,
+        phase: str | None = None,
+        exclude: Any = (),
+    ) -> str | None:
         """The replica for one record: the tenant's pinned replica while
         it stays eligible and fresh, else the least-loaded eligible
         replica (ties break on name for determinism). ``None`` when the
         fleet view is stale or empty — stamp nothing, let the topic's
-        partition spread route."""
+        partition spread route.
+
+        ``phase`` (disaggregated fleets, docs/DISAGG.md) restricts the
+        choice to that pool — ``"prefill"`` for new requests,
+        ``"decode"`` for KV handoff targets; it is a no-op while every
+        replica is ``combined``, so a classic fleet's routing stays
+        bit-for-bit. ``exclude`` names replicas the caller already tried
+        (a decode replica that answered 503 — retry the next one)."""
         if not self.fresh():
             return None
+        exclude = set(exclude or ())
         candidates = [
             (self._load(snap), name)
             for name, snap in self._replicas.items()
             if self._eligible(snap)
+            and self._phase_ok(snap, phase)
+            and name not in exclude
         ]
         if not candidates:
             return None
         now = self._clock()
+        self.last_pick_phase = phase or "any"
+        if phase == "decode":
+            # handoff targets are pure least-loaded: session affinity is
+            # a prefix-cache-locality lever, and prefix blocks live on
+            # the PREFILL pool — pinning decode picks under the tenant
+            # would thrash the prefill pin instead
+            self.picks += 1
+            return min(candidates)[1]
         if tenant:
             pinned = self._affinity.get(tenant)
             if pinned is not None:
@@ -145,6 +193,8 @@ class ReplicaRouter:
                 if (
                     snap is not None
                     and self._eligible(snap)
+                    and self._phase_ok(snap, phase)
+                    and replica not in exclude
                     and now - pinned_at <= self.affinity_ttl_s
                 ):
                     # refresh the pin: an active conversation keeps its
@@ -167,6 +217,17 @@ class ReplicaRouter:
     # -- introspection ---------------------------------------------------
 
     def stats(self) -> dict[str, Any]:
+        # per-pool eligibility census: the split-fleet view engine_top's
+        # pools panel renders (combined-only fleets report one
+        # "combined" row — the pre-disaggregation shape, just grouped)
+        pools: dict[str, dict[str, int]] = {}
+        for snap in self._replicas.values():
+            entry = pools.setdefault(
+                self._pool(snap), {"replicas": 0, "eligible": 0}
+            )
+            entry["replicas"] += 1
+            if self._eligible(snap):
+                entry["eligible"] += 1
         return {
             "replicas": {
                 name: {
@@ -177,9 +238,12 @@ class ReplicaRouter:
                     "draining": bool(snap.get("draining")),
                     "state": snap.get("state", "ok"),
                     "unreachable": bool(snap.get("unreachable")),
+                    "pool": self._pool(snap),
                 }
                 for name, snap in sorted(self._replicas.items())
             },
+            "pools": {k: pools[k] for k in sorted(pools)},
+            "last_pick_phase": self.last_pick_phase,
             "fresh": self.fresh(),
             "picks": self.picks,
             "affinity_hits": self.affinity_hits,

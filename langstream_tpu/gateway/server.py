@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Any
 
 from aiohttp import WSMsgType, web
@@ -77,6 +78,15 @@ class GatewayRegistry:
         # snapshots produce paths stamp nothing and the topic's normal
         # partition spread routes
         self._routers: dict[tuple[str, str], ReplicaRouter] = {}
+        # per-source (pool) fleet snapshots feeding each router, each
+        # stamped with its push time: split fleets have one autoscaler
+        # per pool, and the router needs the union of their latest
+        # observations — with per-source aging so a removed pool's
+        # replicas drop out of the merge (docs/DISAGG.md)
+        self._fleet_sources: dict[
+            tuple[str, str],
+            dict[str, tuple[float, list[dict[str, Any]]]],
+        ] = {}
 
     def register(self, tenant: str, app_id: str, application: Application) -> None:
         self._apps[(tenant, app_id)] = application
@@ -89,18 +99,44 @@ class GatewayRegistry:
         self._apps.pop((tenant, app_id), None)
         self._qos_limiters.pop((tenant, app_id), None)
         self._routers.pop((tenant, app_id), None)
+        self._fleet_sources.pop((tenant, app_id), None)
         for key in [k for k in self._service_uris if k[:2] == (tenant, app_id)]:
             del self._service_uris[key]
 
     def update_fleet(
-        self, tenant: str, app_id: str, snapshots: list[dict[str, Any]]
+        self,
+        tenant: str,
+        app_id: str,
+        snapshots: list[dict[str, Any]],
+        source: str = "",
     ) -> None:
         """Feed the app's router fresh per-replica observations (the
         autoscaler's observe() output — it already fans in exactly the
-        evidence routing needs, so the two consume one snapshot)."""
-        self._routers.setdefault(
-            (tenant, app_id), ReplicaRouter()
-        ).observe(snapshots)
+        evidence routing needs, so the two consume one snapshot).
+        ``source`` names the feeding pool for disaggregated fleets
+        (docs/DISAGG.md): each pool's autoscaler observes only its own
+        StatefulSet, so the router's view is the union of the latest
+        snapshot from EVERY source — one pool's push must not evict the
+        other pool's replicas. Each source's contribution carries its
+        own freshness: a source that stops pushing (a pool removed on
+        redeploy, a dead autoscaler loop) ages out of the merge within
+        the router's freshness window instead of keeping ghost replicas
+        routable forever just because a sibling source stays live."""
+        key = (tenant, app_id)
+        router = self._routers.setdefault(key, ReplicaRouter())
+        now = time.monotonic()
+        sources = self._fleet_sources.setdefault(key, {})
+        sources[source] = (now, list(snapshots))
+        for stale in [
+            s
+            for s, (stamped, _) in sources.items()
+            if now - stamped > router.fresh_s
+        ]:
+            del sources[stale]
+        merged = [
+            snap for _, chunk in sources.values() for snap in chunk
+        ]
+        router.observe(merged)
 
     def router(self, tenant: str, app_id: str) -> ReplicaRouter | None:
         return self._routers.get((tenant, app_id))
@@ -110,11 +146,14 @@ class GatewayRegistry:
     ) -> str | None:
         """The replica one produced record should land on (None = don't
         stamp): least-loaded eligible member, with session affinity on
-        the QoS tenant so a conversation keeps its prefix-cache blocks."""
+        the QoS tenant so a conversation keeps its prefix-cache blocks.
+        Gateway-produced records are NEW requests, so a disaggregated
+        fleet routes them to the prefill pool (phase filtering is a
+        no-op while every replica is combined — docs/DISAGG.md)."""
         router = self._routers.get((tenant, app_id))
         if router is None:
             return None
-        return router.pick(qos_tenant)
+        return router.pick(qos_tenant, phase="prefill")
 
     def qos_limiter(self, tenant: str, app_id: str) -> TenantLimiter | None:
         """The app's gateway-side QoS limiter (None when the app declares

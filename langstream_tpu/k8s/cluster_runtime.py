@@ -85,6 +85,17 @@ class KubernetesClusterRuntime:
                 }
             )
             disk = node.resources.disk
+            # disaggregated serving pools (docs/DISAGG.md): an agent
+            # whose configuration declares `pool-roles` splits into one
+            # StatefulSet per role (the manifest factory reads the CR
+            # option; pods learn their role via LS_POOL_ROLE)
+            node_cfg = getattr(node, "configuration", None) or {}
+            pool_roles = node_cfg.get("pool-roles") or node_cfg.get(
+                "pool_roles"
+            )
+            options: dict[str, Any] = {"codeArchiveId": code_archive_id}
+            if pool_roles:
+                options["poolRoles"] = pool_roles
             cr = AgentCustomResource(
                 name=name,
                 namespace=namespace,
@@ -107,7 +118,7 @@ class KubernetesClusterRuntime:
                         if disk
                         else None
                     ),
-                    options={"codeArchiveId": code_archive_id},
+                    options=options,
                 ),
             )
             self.api.apply(cr.to_dict())

@@ -538,9 +538,41 @@ class StatefulSetFleetBackend:
             wanted = AgentResourcesFactory.agent_resource_name(
                 self.name, self.spec.agent
             )
+            pool = getattr(self.spec, "pool", None)
+            wanted_names = {wanted}
+            if pool:
+                wanted_names.add(f"{wanted}-{pool}")
             candidates = [
-                s for s in candidates if s["metadata"]["name"] == wanted
+                s
+                for s in candidates
+                if s["metadata"]["name"] in wanted_names
             ]
+        if self.spec is not None and getattr(self.spec, "pool", None):
+            # disaggregated split (docs/DISAGG.md): each pool's policy
+            # scales ITS StatefulSet — the factory names them
+            # `<agent-sts>-<role>`
+            suffix = f"-{self.spec.pool}"
+            pooled = [
+                s
+                for s in candidates
+                if s["metadata"]["name"].endswith(suffix)
+            ]
+            if candidates and not pooled:
+                # StatefulSets exist but none carries this pool's
+                # suffix: the app declared a pools: autoscale policy
+                # without the agent-level pool-roles split — a
+                # misconfiguration, not a not-yet-materialized STS, so
+                # say so instead of lazily resolving forever
+                log.warning(
+                    "application %s/%s declares a pools.%s autoscale "
+                    "policy but no '-%s' StatefulSet exists (agents: "
+                    "%s) — declare pool-roles on the serving agent so "
+                    "the fleet actually splits (docs/DISAGG.md)",
+                    self.tenant, self.name, self.spec.pool,
+                    self.spec.pool,
+                    sorted(s["metadata"]["name"] for s in candidates),
+                )
+            candidates = pooled
         if not candidates:
             return None
         if len(candidates) > 1:

@@ -142,6 +142,31 @@ class AgentResourcesFactory:
             },
         }
 
+    @staticmethod
+    def pool_roles(cr: AgentCustomResource) -> dict[str, int] | None:
+        """The agent's declared disaggregated pools (docs/DISAGG.md):
+        ``{role: replicas}`` from the CR's ``poolRoles`` option — a list
+        (``[prefill, decode]``, parallelism replicas each) or a mapping
+        (``{prefill: 1, decode: 3}``). None = classic combined serving.
+        Raises ValueError on unknown roles — a bad split must fail the
+        reconcile loudly, not deploy one mislabeled fleet."""
+        declared = (cr.spec.options or {}).get("poolRoles") or (
+            cr.spec.options or {}
+        ).get("pool-roles")
+        if not declared:
+            return None
+        parallelism = max(1, cr.spec.resources.parallelism)
+        if isinstance(declared, dict):
+            roles = {str(k): max(1, int(v)) for k, v in declared.items()}
+        else:
+            roles = {str(r): parallelism for r in declared}
+        unknown = sorted(set(roles) - {"prefill", "decode"})
+        if unknown:
+            raise ValueError(
+                f"unknown pool role(s) {unknown}; known: prefill, decode"
+            )
+        return roles
+
     @classmethod
     def generate_statefulsets(
         cls,
@@ -150,13 +175,28 @@ class AgentResourcesFactory:
         image_pull_policy: str = "IfNotPresent",
     ) -> list[dict[str, Any]]:
         """One STS for single-host agents (replicas = parallelism); one STS
-        *per logical replica* for multi-host slices (replicas = hosts)."""
+        *per logical replica* for multi-host slices (replicas = hosts);
+        one STS *per pool role* for disaggregated serving agents
+        (``poolRoles`` option — docs/DISAGG.md): ``<name>-prefill`` /
+        ``<name>-decode``, each pod told its role via ``LS_POOL_ROLE``
+        so both pools share one agent config secret."""
         chips = mesh_chips(cr.spec.resources.device_mesh)
         parallelism = max(1, cr.spec.resources.parallelism)
         base = cls.agent_resource_name(cr.spec.application_id, cr.spec.agent_id)
         service = base
+        pools = cls.pool_roles(cr)
 
         if chips == 0:
+            if pools:
+                return [
+                    cls._statefulset(
+                        cr, name=f"{base}-{role}", service=service,
+                        replicas=replicas, placement=None,
+                        image_pull_policy=image_pull_policy,
+                        logical_replica=None, pool_role=role,
+                    )
+                    for role, replicas in sorted(pools.items())
+                ]
             return [
                 cls._statefulset(
                     cr, name=base, service=service, replicas=parallelism,
@@ -167,6 +207,16 @@ class AgentResourcesFactory:
 
         placement = tpu_placement(accelerator, chips)
         if placement["hosts"] == 1:
+            if pools:
+                return [
+                    cls._statefulset(
+                        cr, name=f"{base}-{role}", service=service,
+                        replicas=replicas, placement=placement,
+                        image_pull_policy=image_pull_policy,
+                        logical_replica=None, pool_role=role,
+                    )
+                    for role, replicas in sorted(pools.items())
+                ]
             return [
                 cls._statefulset(
                     cr, name=base, service=service, replicas=parallelism,
@@ -174,6 +224,14 @@ class AgentResourcesFactory:
                     logical_replica=None,
                 )
             ]
+        if pools:
+            # a multi-host slice's STS replica count is the slice's HOST
+            # count — there is no per-pool replica axis to split on
+            raise ValueError(
+                "poolRoles is not supported on multi-host slices: the "
+                "slice's StatefulSet replicas are its hosts, not serving "
+                "capacity (scale pools as single-host agents)"
+            )
         # multi-host: parallelism logical replicas × hosts pods each
         return [
             cls._statefulset(
@@ -231,6 +289,7 @@ class AgentResourcesFactory:
         placement: dict[str, Any] | None,
         image_pull_policy: str,
         logical_replica: int | None,
+        pool_role: str | None = None,
     ) -> dict[str, Any]:
         spec = cr.spec
         env = [
@@ -278,6 +337,13 @@ class AgentResourcesFactory:
             env.append(
                 {"name": "LS_LOGICAL_REPLICA", "value": str(logical_replica)}
             )
+        if pool_role is not None:
+            # disaggregated pools (docs/DISAGG.md): both pool STSs mount
+            # the SAME agent config secret; the role is per-StatefulSet
+            # deployment identity, so it rides the env and
+            # ServingConfig.from_dict picks it up as the pool-role
+            # fallback
+            env.append({"name": "LS_POOL_ROLE", "value": pool_role})
 
         volume_mounts = [
             {"name": "app-config", "mountPath": "/app-config"},

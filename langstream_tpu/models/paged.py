@@ -120,11 +120,15 @@ def write_rows(
 
     Invalid rows are redirected to a scratch row (block 0 never backs live
     data; see BlockManager) so the scatter stays shape-static. An int8 pool
-    quantises the rows here — write sites stay layout-agnostic.
+    quantises the rows here — write sites stay layout-agnostic. Rows that
+    are ALREADY quantized (an int8 ``{"q","s"}`` pair, e.g. a KV handoff
+    payload from another replica's identical pool) scatter verbatim, so a
+    transfer never pays a dequant/requant round trip.
     """
     quant = isinstance(cache, dict)
     nb, bs, KhD = (cache["q"] if quant else cache).shape[1:]
-    B, T = rows.shape[1], rows.shape[2]
+    rows_data = rows["q"] if isinstance(rows, dict) else rows
+    B, T = rows_data.shape[1], rows_data.shape[2]
     pos = starts[:, None] + jnp.arange(T)[None, :]          # (B, T)
     # clamp: invalid rows may compute positions past the table; they're
     # redirected to scratch below, the clamp just keeps indexing in-bounds
@@ -145,6 +149,12 @@ def write_rows(
 
     if not quant:
         return scatter(cache, rows)
+    if isinstance(rows, dict):
+        # pre-quantized rows (KV handoff): bit-exact pass-through
+        return {
+            "q": scatter(cache["q"], rows["q"]),
+            "s": scatter(cache["s"], rows["s"]),
+        }
     from langstream_tpu.models.kvquant import quantize_rows
 
     L = rows.shape[0]

@@ -376,6 +376,7 @@ def memory_ledger(
     tables_bytes: int,
     limit_bytes: int | None,
     limit_source: str,
+    in_transit_bytes: int = 0,
 ) -> dict[str, Any]:
     """Assemble the ``hbm_bytes_by_owner`` breakdown.
 
@@ -392,6 +393,10 @@ def memory_ledger(
         "kv-pool": kv_pool_bytes,
         "sampler-state": sampler_bytes,
         "device-lru": tables_bytes,
+        # KV handoff payloads serialized but not yet picked up by the
+        # decode pool (docs/DISAGG.md): host-resident, but accounted in
+        # the same ledger so a stalled handoff pipeline names its bytes
+        "in-transit": in_transit_bytes,
     }
     accounted = sum(owners.values())
     slack = None

@@ -39,7 +39,7 @@ import logging
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from typing import Any, Awaitable, Callable
@@ -248,6 +248,17 @@ class ServingConfig:
     # queue-wait quantiles, shed rate, and availability, evaluated
     # engine-side with multi-window burn rates; None disables tracking
     slo: SloSpec | None = None
+    # disaggregated prefill/decode pools (docs/DISAGG.md): "combined"
+    # (default) serves both phases in one engine — every pre-existing
+    # behavior, bit for bit. "prefill" runs admission/prefill (chunked,
+    # prefix-cache-aware) then EXPORTS the request's KV blocks over the
+    # handoff plane (serving/kvtransfer.py) instead of decoding;
+    # "decode" additionally accepts imports that join the decode batch
+    # directly, skipping prefill. Both split roles require kv-layout=
+    # paged (the handoff serializes paged blocks). Deployed pods get the
+    # role from the StatefulSet split's LS_POOL_ROLE env (from_dict
+    # fallback) so both pools share one agent config secret.
+    pool_role: str = "combined"
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -287,6 +298,7 @@ class ServingConfig:
             "speculative-drafts": self.speculative_drafts,
             "model-dtype": self.model_dtype,
             "qos": self.qos.to_dict() if self.qos is not None else None,
+            "pool-role": self.pool_role,
             "pipeline": self.pipeline,
             "wedge-window-s": self.wedge_window_s,
             "slo": self.slo.to_dict() if self.slo is not None else None,
@@ -349,6 +361,15 @@ class ServingConfig:
                 d.get("speculative-drafts", d.get("speculative_drafts", 0))
             ),
             qos=QosSpec.from_dict(d.get("qos")),
+            pool_role=str(
+                d.get(
+                    "pool-role",
+                    d.get(
+                        "pool_role",
+                        os.environ.get("LS_POOL_ROLE") or "combined",
+                    ),
+                )
+            ),
             pipeline=_parse_bool(d.get("pipeline", True)),
             wedge_window_s=float(
                 d.get("wedge-window-s", d.get("wedge_window_s", 60.0))
@@ -418,6 +439,11 @@ class _Request:
     # happened (feeds the resume-latency histogram)
     preemptions: int = 0
     preempt_time: float | None = None
+    # KV handoff (docs/DISAGG.md): True for a request admitted through
+    # /kv/import on a decode-pool engine — its KV state arrived over the
+    # wire, so admission skipped prefill entirely (request_timings carry
+    # the marker the disagg e2e asserts on)
+    imported: bool = False
 
     @property
     def context_tokens(self) -> list[int]:
@@ -659,6 +685,28 @@ class TpuServingEngine:
         self._drain_shed = 0
         self._drain_base_completed = 0
         self._drain_report: dict[str, Any] | None = None
+        # disaggregated pools (docs/DISAGG.md): the handoff plane's
+        # engine-side state. Exports are finished-prefill payloads keyed
+        # by request id, awaiting pickup via /kv/export/{request}
+        # (bounded: an abandoned handoff must not pin host memory
+        # forever); imports queue here and are applied by the engine
+        # loop at its safe point, exactly like admission. The in-transit
+        # byte counter feeds the HBM ledger's `in-transit` owner so a
+        # handoff's cost is never invisible.
+        self._pool_role = config.pool_role
+        self._exports: "OrderedDict[str, dict[str, Any]]" = OrderedDict()
+        self._export_seq = 0
+        self._export_cap = max(
+            8, int(os.environ.get("LS_TPU_KV_EXPORT_CAP", "256") or 256)
+        )
+        self._pending_imports: deque = deque()
+        self._kv_in_transit_bytes = 0
+        self.kv_exports_total = 0
+        self.kv_exports_evicted = 0
+        self.kv_imports_total = 0
+        self.kv_import_sheds = 0
+        self.kv_export_bytes = 0
+        self.kv_import_bytes = 0
         self.completed_requests = 0
         # per-request {queue_wait, prefill, ttft} seconds, newest last —
         # the gateway bench reads this to attribute client-measured TTFT
@@ -832,6 +880,33 @@ class TpuServingEngine:
                 "how long a victim had been running when preempted (the "
                 "decode progress the preemption put at risk)",
             )
+        # KV handoff observability (split-pool engines only, so a
+        # combined engine's /metrics surface stays unchanged): transfer
+        # time histograms + byte/count totals — the handoff cost must
+        # never be invisible (docs/DISAGG.md)
+        self._m_kv_export_hist = None
+        self._m_kv_import_hist = None
+        self._m_kv_export_bytes = None
+        self._m_kv_import_bytes = None
+        if self._pool_role != "combined":
+            self._m_kv_export_hist = reporter.histogram(
+                "kv_export_seconds",
+                "device gather + serialization wall time per KV handoff "
+                "export (prefill pool)",
+            )
+            self._m_kv_import_hist = reporter.histogram(
+                "kv_import_seconds",
+                "block allocation + device scatter wall time per KV "
+                "handoff import (decode pool)",
+            )
+            self._m_kv_export_bytes = reporter.counter(
+                "kv_export_bytes_total",
+                "serialized KV handoff bytes exported to decode replicas",
+            )
+            self._m_kv_import_bytes = reporter.counter(
+                "kv_import_bytes_total",
+                "serialized KV handoff bytes imported from prefill replicas",
+            )
         self._warmup_task: asyncio.Task | None = None
         # device-side upload caches (content-keyed, LRU-bounded): block
         # tables and the sampler/active-mask tuple change rarely between
@@ -925,7 +1000,8 @@ class TpuServingEngine:
                 f"limit minus every accounted owner)",
             )
             for owner in (
-                "weights", "kv-pool", "sampler-state", "device-lru", "slack",
+                "weights", "kv-pool", "sampler-state", "device-lru",
+                "in-transit", "slack",
             )
         }
 
@@ -1006,6 +1082,20 @@ class TpuServingEngine:
         if self.config.kv_quantize not in (None, "none", "int8"):
             raise ValueError(
                 f"unknown kv_quantize mode {self.config.kv_quantize!r}"
+            )
+        if self.config.pool_role not in ("combined", "prefill", "decode"):
+            raise ValueError(
+                f"unknown pool_role {self.config.pool_role!r}; known: "
+                f"combined, prefill, decode"
+            )
+        if (
+            self.config.pool_role != "combined"
+            and self.config.kv_layout != "paged"
+        ):
+            raise ValueError(
+                "pool-role prefill/decode requires kv-layout=paged (the "
+                "KV handoff plane serializes paged blocks; a dense cache "
+                "has no block tables to hand off)"
             )
         if self.config.prefill_chunk > 0 and self.config.kv_layout != "paged":
             raise ValueError(
@@ -1862,6 +1952,10 @@ class TpuServingEngine:
             bytes_per_block=self._kv_block_bytes,
             sampler_bytes=self._sampler_dev_cache.device_bytes(),
             tables_bytes=self._tables_dev_cache.device_bytes(),
+            # serialized handoff payloads awaiting pickup (host bytes,
+            # attributed so a stalled handoff pipeline is visible in the
+            # same ledger operators already watch)
+            in_transit_bytes=self._kv_in_transit_bytes,
             limit_bytes=self._hbm_limit,
             limit_source=self._hbm_limit_source,
         )
@@ -2113,6 +2207,10 @@ class TpuServingEngine:
             # drain-before-terminate posture + last drain's counts
             # (docs/FLEET.md): the autoscaler's evidence trail
             "drain": self._drain_section(),
+            # disaggregated-pool posture + handoff counters
+            # (docs/DISAGG.md): combined engines report role=combined
+            # with zeroed counters
+            "kvtransfer": self.kv_transfer_section(),
             # device attribution plane: per-program achieved-vs-expected
             # ledger + hbm_bytes_by_owner (serving/attribution.py)
             "attribution": self.attribution_section(),
@@ -2280,6 +2378,426 @@ class TpuServingEngine:
         return out
 
     # ------------------------------------------------------------------
+    # KV handoff plane: disaggregated prefill/decode pools (docs/DISAGG.md)
+    # ------------------------------------------------------------------
+
+    def kv_fingerprint(self) -> dict[str, Any]:
+        """The layout facts a KV handoff must agree on end to end —
+        serialized into every export header and checked on import
+        (mismatch → :class:`~langstream_tpu.serving.kvtransfer.
+        LayoutMismatch` → HTTP 409). Pure attribute reads (POOL701)."""
+        mc = self.model_config
+        return {
+            "model": self.config.model,
+            "dtype": str(np.dtype(mc.dtype).name),
+            "kv-quantize": self.config.kv_quantize or None,
+            "kv-block-size": self.config.kv_block_size,
+            "layers": mc.layers,
+            "kv-heads": mc.kv_heads,
+            "head-dim": mc.head_dim,
+            "max-seq-len": mc.max_seq_len,
+        }
+
+    def kv_transfer_section(self) -> dict[str, Any]:
+        """The ``stats()["kvtransfer"]`` / flight-summary section:
+        transfer counters + in-transit posture. Wait-free (POOL701):
+        attribute reads and ``len`` only."""
+        return {
+            "role": self._pool_role,
+            "exports": self.kv_exports_total,
+            "exports_evicted": self.kv_exports_evicted,
+            "imports": self.kv_imports_total,
+            "import_sheds": self.kv_import_sheds,
+            "export_bytes": self.kv_export_bytes,
+            "import_bytes": self.kv_import_bytes,
+            "pending_exports": len(self._exports),
+            "pending_imports": len(self._pending_imports),
+            "in_transit_bytes": self._kv_in_transit_bytes,
+        }
+
+    def take_export(self, request_id: str) -> bytes | None:
+        """Pop one serialized handoff payload (the pod
+        ``/kv/export/{request}`` handler). Single ``dict.pop`` — wait-free
+        (POOL701); the payload leaves the in-transit ledger here."""
+        entry = self._exports.pop(request_id, None)
+        if entry is None:
+            return None
+        self._kv_in_transit_bytes -= entry["bytes"]
+        return entry["payload"]
+
+    async def _export_ready_slots(self, loop) -> None:
+        """Prefill-pool half of the handoff: every slot whose prefill
+        completed (it would join decode on a combined engine) exports
+        its KV blocks + request snapshot and releases, so the slot and
+        its reservation immediately serve the next prompt. Runs at the
+        loop's safe point — no dispatch in flight."""
+        for slot_id, slot in enumerate(self.slots):
+            request = slot.request
+            if request is None or slot.prefilling:
+                continue
+            if request.future.cancelled():
+                # caller gave up between prefill and export: nothing to
+                # hand off — free the slot + reservation. The tenant
+                # post-debit still happens (same rule as _flush_emits:
+                # cancelled requests' tokens burned engine capacity)
+                slot.request = None
+                slot.prefill_done = 0
+                self._lengths[slot_id] = 0
+                if self.block_mgr is not None:
+                    self.block_mgr.release(slot_id)
+                self.scheduler.on_finished(request)
+                continue
+            if request.future.done():
+                continue
+            await self._export_slot(loop, slot_id, request)
+
+    async def _export_slot(self, loop, slot_id: int, request) -> None:
+        """Export one finished-prefill slot: gather its pool rows (the
+        one device sync lives in kvtransfer's sanctioned ``_fetch_rows``
+        stage, on the dispatch thread, timed), serialize, stash the
+        payload for pickup, release the slot, and resolve the caller's
+        future with the handoff ticket."""
+        from langstream_tpu.serving import kvtransfer
+
+        t_start = time.monotonic()
+        rows = int(self._lengths[slot_id])
+        nrb = self._read_blocks_for(max(rows, 1))
+        blocks_live = self.block_mgr.blocks_needed(max(rows, 1))
+        table_row = self.block_mgr.tables[slot_id].copy()
+
+        def _run():
+            gathered_k, gathered_v = kvtransfer.gather_slot(
+                self.cache_k, self.cache_v, table_row, nrb
+            )
+            return kvtransfer._fetch_rows(gathered_k, gathered_v, rows)
+
+        arrays, device_s = await loop.run_in_executor(self._executor, _run)
+        self._export_seq += 1
+        rid = f"{self.config.model}-{self._export_seq:08d}"
+        now = time.monotonic()
+        first = request.first_token_time or now
+        admit = request.admit_time or first
+        timings = {
+            "queue_wait": admit - request.enqueue_time,
+            "prefill": first - admit,
+            "ttft": first - request.enqueue_time,
+        }
+        header = {
+            "fingerprint": self.kv_fingerprint(),
+            "request": rid,
+            "prompt-digest": kvtransfer.prompt_digest(request.prompt_tokens),
+            "prompt-tokens": list(request.prompt_tokens),
+            "generated": list(request.generated),
+            "logprobs": list(request.logprobs),
+            "current-token": int(self._current[slot_id]),
+            "kv-rows": rows,
+            "max-tokens": request.max_tokens,
+            "temperature": request.temperature,
+            "top-k": request.top_k,
+            "top-p": request.top_p,
+            "presence-penalty": request.presence_penalty,
+            "frequency-penalty": request.frequency_penalty,
+            "stop": list(request.stop),
+            "tenant": request.tenant,
+            "priority": request.priority,
+            "timings": {k: round(v, 6) for k, v in timings.items()},
+        }
+        payload = kvtransfer.serialize_handoff(header, arrays)
+        # release BEFORE stashing: the slot serves the next prompt now;
+        # published prefix blocks stay cached (the cache holds its refs)
+        slot = self.slots[slot_id]
+        slot.request = None
+        slot.prefilling = False
+        slot.prefill_done = 0
+        self._lengths[slot_id] = 0
+        self.block_mgr.release(slot_id)
+        if not request.warmup:
+            self._exports[rid] = {
+                "payload": payload,
+                "bytes": len(payload),
+                "blocks": blocks_live,
+                "m_s": now,
+            }
+            self._kv_in_transit_bytes += len(payload)
+            while len(self._exports) > self._export_cap:
+                evicted_rid, evicted = self._exports.popitem(last=False)
+                self._kv_in_transit_bytes -= evicted["bytes"]
+                # an evicted export is a LOST handoff (its blocks were
+                # released at export time): the decode pool's pickup
+                # will 404 and the caller must re-prefill — loud by
+                # contract, the handoff cost is never invisible
+                self.kv_exports_evicted += 1
+                self.flight.event(
+                    "kv-export-dropped",
+                    request=evicted_rid,
+                    bytes=evicted["bytes"],
+                    age_s=round(now - evicted["m_s"], 3),
+                    cap=self._export_cap,
+                )
+            self.kv_exports_total += 1
+            self.kv_export_bytes += len(payload)
+            self.request_timings.append(
+                {**{k: round(v, 6) for k, v in timings.items()},
+                 "decode": 0.0,
+                 "tokens": float(len(request.generated)),
+                 "handoff": 1.0}
+            )
+            self._m_ttft_hist(timings["ttft"])
+            self._m_queue_wait_hist(timings["queue_wait"])
+            self._slo_record("availability", True)
+            self._slo_record_latency("ttft", timings["ttft"])
+            self._slo_record_latency("queue-wait", timings["queue_wait"])
+        if self._m_kv_export_hist is not None:
+            self._m_kv_export_hist(time.monotonic() - t_start)
+        if self._m_kv_export_bytes is not None and not request.warmup:
+            self._m_kv_export_bytes(len(payload))
+        self.flight.event(
+            "kv-export",
+            request=rid,
+            bytes=len(payload),
+            blocks=blocks_live,
+            rows=rows,
+            ms=round((time.monotonic() - t_start) * 1000.0, 3),
+            device_ms=round(device_s * 1000.0, 3),
+            warmup=request.warmup,
+        )
+        self.scheduler.on_finished(request)
+        self.completed_requests += 1
+        if not request.future.done():
+            request.future.set_result(
+                {
+                    "handoff": rid,
+                    "tokens": list(request.generated),
+                    "text": self.tokenizer.decode(request.generated),
+                    "logprobs": list(request.logprobs),
+                    "num_prompt_tokens": len(request.prompt_tokens),
+                    "num_completion_tokens": len(request.generated),
+                    "ttft": timings["ttft"],
+                    "queue_wait": timings["queue_wait"],
+                    "prefill": timings["prefill"],
+                    "finish_reason": "handoff",
+                }
+            )
+
+    async def import_handoff(
+        self, payload: bytes, header: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        """Decode-pool half of the handoff: admit a request whose KV
+        state arrived over the wire — blocks allocate through the
+        BlockManager, rows scatter back via ``write_rows``, and the
+        request joins the decode batch directly (prefill skipped; the
+        ``request_timings`` entry carries ``imported`` so the skip is
+        assertable). Raises :class:`~langstream_tpu.serving.kvtransfer.
+        LayoutMismatch` on a wire/fingerprint mismatch (pod → 409) and
+        :class:`RateLimited` when the pool cannot take it right now
+        (pod → 503 + Retry-After; the router retries the next decode
+        replica)."""
+        from langstream_tpu.serving import kvtransfer
+
+        if self._stop:
+            raise RuntimeError(
+                "serving engine is stopped (closed or lockstep group broken)"
+            )
+        if self._pool_role == "prefill":
+            raise kvtransfer.LayoutMismatch(
+                "prefill-role engine does not accept KV imports"
+            )
+        if self.block_mgr is None:
+            raise kvtransfer.LayoutMismatch(
+                "kv-layout=dense engine cannot accept a paged KV handoff"
+            )
+        header, arrays = kvtransfer.deserialize_handoff(payload, header)
+        kvtransfer.check_fingerprint(
+            self.kv_fingerprint(), header.get("fingerprint") or {}
+        )
+        if self._draining:
+            raise RateLimited(
+                "draining", 1.0,
+                "engine is draining; retry another decode replica",
+            )
+        prompt = [int(t) for t in header.get("prompt-tokens") or []]
+        generated = [int(t) for t in header.get("generated") or []]
+        rows = int(header.get("kv-rows") or 0)
+        max_tokens = int(header.get("max-tokens") or 0)
+        if rows < 1 or rows >= self.model_config.max_seq_len:
+            raise kvtransfer.LayoutMismatch(
+                f"handoff kv-rows {rows} outside (0, "
+                f"{self.model_config.max_seq_len})"
+            )
+        for name, arr in arrays.items():
+            if arr.shape[0] != self.model_config.layers or arr.shape[1] < rows:
+                raise kvtransfer.LayoutMismatch(
+                    f"handoff array {name!r} shape {arr.shape} does not "
+                    f"cover {self.model_config.layers} layers x {rows} rows"
+                )
+        if not self.block_mgr.fits_ever(len(prompt) + max_tokens + 1):
+            raise ValueError(
+                f"imported request needs {len(prompt) + max_tokens + 1} "
+                f"tokens of KV, more than this pool can ever hold"
+            )
+        request = _Request(
+            prompt_tokens=prompt,
+            max_tokens=max_tokens,
+            temperature=float(header.get("temperature") or 0.0),
+            top_k=int(header.get("top-k") or 0),
+            top_p=float(header.get("top-p") or 1.0),
+            on_token=None,
+            future=asyncio.get_running_loop().create_future(),
+            loop=asyncio.get_running_loop(),
+            enqueue_time=time.monotonic(),
+            presence_penalty=float(header.get("presence-penalty") or 0.0),
+            frequency_penalty=float(header.get("frequency-penalty") or 0.0),
+            generated=generated,
+            logprobs=[float(x) for x in header.get("logprobs") or []],
+            stop=_normalize_stop(header.get("stop")),
+            tenant=str(header.get("tenant") or ""),
+            priority=normalize_priority(header.get("priority")),
+            imported=True,
+        )
+        self._pending_imports.append(
+            (header, arrays, request, len(payload))
+        )
+        self._ensure_loop()
+        self._wake.set()
+        return await request.future
+
+    @staticmethod
+    def _resource_exhausted(error: BaseException) -> bool:
+        """True for a device allocator failure (jaxlib RESOURCE_EXHAUSTED)
+        or the BlockManager's pool-exhaustion RuntimeError — the refusals
+        ROADMAP item 5 wants adapted to, not died from."""
+        text = f"{type(error).__name__}: {error}"
+        return "RESOURCE_EXHAUSTED" in text or "pool exhausted" in text
+
+    def _shed_import(self, request, reason: str, detail: str) -> None:
+        """Refuse one pending import explicitly: RateLimited with a retry
+        hint, so the pod handler answers 503 + Retry-After and the router
+        retries the next decode replica (never a silent loss)."""
+        self.kv_import_sheds += 1
+        self.flight.event(
+            "shed", reason=reason, tenant=request.tenant,
+            priority=request.priority, retry_after_s=1.0, imported=True,
+        )
+        if not request.future.done():
+            request.future.set_exception(RateLimited(reason, 1.0, detail))
+
+    async def _apply_imports(self, loop) -> None:
+        """Admit every queued KV import at the loop's safe point. Each
+        import needs a free slot and a worst-case block reservation —
+        exactly admission's contract; refusals are explicit 503-shaped
+        sheds (the decode pool is saturated and the router should spread
+        the handoff), and a RESOURCE_EXHAUSTED during block allocation
+        sheds instead of failing the request."""
+        from langstream_tpu.serving import kvtransfer
+
+        while self._pending_imports:
+            header, arrays, request, nbytes = self._pending_imports.popleft()
+            if request.future.done():
+                continue  # caller gave up while queued
+            if self._draining:
+                self._shed_import(
+                    request, "draining",
+                    "engine is draining; retry another decode replica",
+                )
+                continue
+            free = next(
+                (i for i, s in enumerate(self.slots) if s.free), None
+            )
+            total = len(request.prompt_tokens) + request.max_tokens + 1
+            if free is None:
+                self._shed_import(
+                    request, "no-free-slot",
+                    "decode pool has no free slot; retry another replica",
+                )
+                continue
+            if not self.block_mgr.can_admit(total):
+                self._shed_import(
+                    request, "kv-import-capacity",
+                    "decode pool cannot reserve the request's worst-case "
+                    "KV blocks; retry another replica",
+                )
+                continue
+            rows = int(header["kv-rows"])
+            t_start = time.monotonic()
+            try:
+                self.block_mgr.admit(free, total)
+                self.block_mgr.ensure_capacity(free, rows)
+            except RuntimeError as e:
+                # the first slice of the RESOURCE_EXHAUSTED adaptation
+                # story (ROADMAP item 5): allocator refusal is a shed,
+                # never a request failure
+                self.block_mgr.release(free)
+                if self._resource_exhausted(e):
+                    self._shed_import(
+                        request, "kv-import-capacity",
+                        f"block allocation failed ({e}); retry another "
+                        f"replica",
+                    )
+                    continue
+                raise
+            table_row = self.block_mgr.tables[free].copy()
+            padded = _bucket(rows, hi=self.model_config.max_seq_len)
+
+            def _run(arrays=arrays, table_row=table_row, rows=rows,
+                     padded=padded):
+                out_k, out_v = kvtransfer.scatter_slot(
+                    self.cache_k, self.cache_v, arrays, table_row, rows,
+                    padded,
+                )
+                # donated pools re-bound on the dispatch thread — the
+                # same side every dispatch closure reads them (RACE801)
+                self.cache_k, self.cache_v = out_k, out_v
+                t_dev = time.monotonic()
+                # graftcheck: disable=JAX104 the one per-import sync, off-loop and timed
+                jax.block_until_ready((out_k, out_v))
+                return time.monotonic() - t_dev
+
+            try:
+                device_s = await loop.run_in_executor(self._executor, _run)
+            except Exception as e:
+                self.block_mgr.release(free)
+                if self._resource_exhausted(e):
+                    self._shed_import(
+                        request, "kv-import-oom",
+                        f"device allocation failed mid-scatter ({e}); "
+                        f"retry another replica",
+                    )
+                    continue
+                raise
+            slot = self.slots[free]
+            slot.request = request
+            slot.prefilling = False
+            slot.prefill_done = 0
+            self._lengths[free] = rows
+            self._current[free] = int(header["current-token"])
+            self._temps[free] = request.temperature
+            self._topks[free] = request.top_k
+            self._topps[free] = request.top_p
+            self._pres[free] = request.presence_penalty
+            self._freq[free] = request.frequency_penalty
+            now = time.monotonic()
+            # prefill is SKIPPED: admit == first-token boundary (the
+            # handoff's first token was produced on the prefill pool)
+            request.admit_time = now
+            request.first_token_time = now
+            self.kv_imports_total += 1
+            self.kv_import_bytes += nbytes
+            if self._m_kv_import_hist is not None:
+                self._m_kv_import_hist(time.monotonic() - t_start)
+            if self._m_kv_import_bytes is not None:
+                self._m_kv_import_bytes(nbytes)
+            self.flight.event(
+                "kv-import",
+                request=header.get("request"),
+                digest=header.get("prompt-digest"),
+                bytes=nbytes,
+                blocks=self.block_mgr.blocks_needed(max(rows, 1)),
+                rows=rows,
+                ms=round((time.monotonic() - t_start) * 1000.0, 3),
+                device_ms=round(device_s * 1000.0, 3),
+            )
+
+    # ------------------------------------------------------------------
     # engine loop
     # ------------------------------------------------------------------
 
@@ -2306,6 +2824,13 @@ class TpuServingEngine:
         self.watchdog.beat(self.scheduler.qsize())
         while not self._stop:
             try:
+                if self._pending_imports:
+                    # KV handoff imports land at the loop's safe point,
+                    # exactly like admission: a free slot + a worst-case
+                    # block reservation, then the wire rows scatter in
+                    # and the request joins decode with NO prefill
+                    # (docs/DISAGG.md)
+                    await self._apply_imports(loop)
                 if not self.scheduler.empty():
                     await self._admit(loop)
                 # a pipelined burst may have left a decode chunk in
@@ -2335,6 +2860,12 @@ class TpuServingEngine:
                     # one bounded chunk per loop pass: long prefills make
                     # progress without stalling the decode bursts below
                     await self._advance_prefills(loop)
+                if self._pool_role == "prefill":
+                    # disaggregated prefill pool: every slot whose
+                    # prefill just finished exports its KV blocks and
+                    # releases instead of decoding — the decode pool
+                    # picks the payload up over the pod HTTP plane
+                    await self._export_ready_slots(loop)
                 active = [
                     i
                     for i, s in enumerate(self.slots)
@@ -2423,6 +2954,11 @@ class TpuServingEngine:
                 request.future.set_exception(error)
                 if not request.warmup:
                     self._slo_record("availability", False)
+        for pending in list(self._pending_imports):
+            request = pending[2]
+            if not request.future.done():
+                request.future.set_exception(error)
+        self._pending_imports.clear()
         self._pending_emits.clear()
         self._finished_requests.clear()
 
@@ -3788,6 +4324,12 @@ class TpuServingEngine:
                 "decode": done_t - first,
                 "tokens": float(len(request.generated)),
             }
+            if request.imported:
+                # KV-import admission skipped prefill entirely: the
+                # marker the disagg acceptance asserts on (queue_wait/
+                # prefill here are decode-pod-local and ~0 by design —
+                # the prefill pool's share rode the handoff header)
+                timing["imported"] = 1.0
             if not request.warmup:
                 # warmup probes never enter the latency record: their TTFT
                 # is XLA compile time, which would poison both the
@@ -3864,6 +4406,10 @@ def flight_report(
             # drain posture: the autoscaler's fan-in reads draining/shed
             # counts off the same summary (no extra engine surface)
             "drain": engine._drain_section(),
+            # pool role + handoff counters: the router and per-pool
+            # autoscalers classify replicas off this same summary
+            "pool_role": engine.config.pool_role,
+            "kvtransfer": engine.kv_transfer_section(),
         }
         slo = engine.slo_status()
         if slo is not None:
@@ -3938,6 +4484,46 @@ async def drain_engines(grace_s: float = 30.0) -> dict[str, Any]:
         remaining = max(0.5, deadline - time.monotonic())
         reports[engine.config.model] = await engine.drain(remaining)
     return reports
+
+
+def take_kv_export(request_id: str) -> bytes | None:
+    """Pop one serialized KV handoff payload from whichever live engine
+    holds it (the pod ``GET /kv/export/{request}`` handler). Wait-free
+    (POOL701): instance-map snapshot + one dict pop per engine."""
+    for engine in list(TpuServingEngine._instances.values()):
+        payload = engine.take_export(request_id)
+        if payload is not None:
+            return payload
+    return None
+
+
+async def import_kv_handoff(payload: bytes) -> dict[str, Any]:
+    """Route one KV handoff payload to this pod's matching engine (the
+    ``POST /kv/import`` handler): the header's fingerprint model picks
+    the engine, decode-role engines first (a combined paged engine also
+    accepts — the dev/test posture). Raises
+    :class:`~langstream_tpu.serving.kvtransfer.LayoutMismatch` when no
+    engine here can take it."""
+    from langstream_tpu.serving.kvtransfer import LayoutMismatch, peek_header
+
+    header = peek_header(payload)
+    model = (header.get("fingerprint") or {}).get("model")
+    candidates = [
+        engine
+        for engine in list(TpuServingEngine._instances.values())
+        if engine.config.model == model
+        and engine.block_mgr is not None
+        and engine.config.pool_role != "prefill"
+    ]
+    if not candidates:
+        raise LayoutMismatch(
+            f"no decode-capable paged engine for model {model!r} in this pod"
+        )
+    candidates.sort(
+        key=lambda e: 0 if e.config.pool_role == "decode" else 1
+    )
+    # the peeked header rides along so the token-list JSON parses once
+    return await candidates[0].import_handoff(payload, header=header)
 
 
 def profile_engines(action: str, trace_dir: str | None = None) -> dict[str, bool]:

@@ -189,6 +189,10 @@ def render(report: list[dict]) -> str:
         if kv_used is not None:
             lines.append(f"kv pool  [{_bar(kv_used)}] {100 * kv_used:.1f}% used")
         lines.extend(_render_scheduler(entry.get("scheduler"), events))
+        lines.extend(
+            _render_pool(entry.get("pool_role"), entry.get("kvtransfer"),
+                         summary)
+        )
         spec_acc = totals.get("spec_accepted") or 0
         spec_rej = totals.get("spec_rejected") or 0
         if spec_acc or spec_rej:
@@ -218,13 +222,57 @@ def render(report: list[dict]) -> str:
     return "\n".join(lines).rstrip()
 
 
+def _render_pool(
+    pool_role, kvtransfer: dict | None, summary: dict
+) -> list[str]:
+    """Disaggregated-pool panel (docs/DISAGG.md): role, transfer rates,
+    and in-transit bytes. Silent for combined engines with no handoff
+    activity — pre-disagg payloads render unchanged."""
+    kvtransfer = kvtransfer or {}
+    role = pool_role or kvtransfer.get("role") or "combined"
+    transfers = (kvtransfer.get("exports") or 0) + (
+        kvtransfer.get("imports") or 0
+    )
+    if role == "combined" and not transfers:
+        return []
+    span_s = (summary.get("window") or {}).get("span_s") or 0
+    rate = f"{transfers / span_s:.2f}/s" if span_s else "-"
+    lines = [
+        f"pool     role {role.upper()}   transfers {transfers} ({rate})   "
+        f"in-transit {_fmt_bytes(kvtransfer.get('in_transit_bytes') or 0)} "
+        f"({kvtransfer.get('pending_exports') or 0} pending)"
+    ]
+    if kvtransfer.get("exports"):
+        lines.append(
+            f"pool     exports {kvtransfer['exports']} "
+            f"({_fmt_bytes(kvtransfer.get('export_bytes') or 0)})"
+        )
+    if kvtransfer.get("imports") or kvtransfer.get("import_sheds"):
+        lines.append(
+            f"pool     imports {kvtransfer.get('imports') or 0} "
+            f"({_fmt_bytes(kvtransfer.get('import_bytes') or 0)})  "
+            f"sheds {kvtransfer.get('import_sheds') or 0}"
+        )
+    return lines
+
+
 def render_fleet(payload: dict) -> str:
     """Fleet panel: the autoscaler status payload
     (``/api/applications/{t}/{n}/autoscaler``) — declared policy, one
     line per replica (occupancy bar, queue, health/drain posture), and
-    the decision tail with its evidence."""
+    the decision tail with its evidence. Disaggregated apps answer one
+    status per pool (docs/DISAGG.md): each renders as its own fleet
+    block, headed by the pool name."""
     if not payload.get("enabled", True):
         return "fleet    autoscaler not active for this application"
+    if payload.get("pools"):
+        blocks = []
+        for pool in sorted(payload["pools"]):
+            status = payload["pools"][pool]
+            blocks.append(
+                f"== pool {pool.upper()} ==\n{render_fleet(status)}"
+            )
+        return "\n".join(blocks)
     lines: list[str] = []
     spec = payload.get("spec") or {}
     lines.append(
@@ -256,6 +304,9 @@ def render_fleet(payload: dict) -> str:
         occ = replica.get("occupancy") or 0
         state = replica.get("state", "ok")
         badges = []
+        pool = replica.get("pool") or "combined"
+        if pool != "combined":
+            badges.append(pool.upper())
         if state != "ok":
             badges.append(state.upper())
         if replica.get("draining"):
