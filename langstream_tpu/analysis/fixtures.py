@@ -313,6 +313,50 @@ class ProgramLedger:
 ))
 
 _register(RuleExample(
+    rule="OBS506",
+    tp={
+        "langstream_tpu/serving/journey.py": '''\
+import jax
+
+class JourneyLedger:
+    def events(self, journey_id, engine):
+        # a /journey read that syncs the device hangs exactly when the
+        # operator asks where a wedged request's time went — and the
+        # lock queues the stitcher behind the dispatch holding it
+        jax.block_until_ready(engine.last_out)
+        with engine.dispatch_lock:
+            return list(self._entries[journey_id])
+''',
+    },
+    tn={
+        "langstream_tpu/serving/journey.py": '''\
+class JourneyLedger:
+    def record(self, journey_id, kind):
+        # writes: GIL-atomic container appends + counter bumps only
+        entry = self._entries.get(journey_id)
+        if entry is not None:
+            entry.append({"kind": kind})
+            self.recorded_events += 1
+
+    def events(self, journey_id):
+        # reads: list() snapshot copies + arithmetic, nothing that waits
+        entry = self._entries.get(journey_id)
+        return list(entry) if entry is not None else []
+''',
+    },
+    fix=(
+        "Journey writes must be GIL-atomic container appends at the "
+        "sites where the engine already records flight events — never "
+        "behind a lock, never touching the device. Journey reads (the "
+        "pod /journey payload builder, the control-plane stitcher) "
+        "snapshot with list()/dict() copies and do pure arithmetic "
+        "(stitch/segments in serving/journey.py). Anything that needs "
+        "the device or a lock must be recorded at dispatch time and "
+        "snapshotted later, the flight-recorder pattern."
+    ),
+))
+
+_register(RuleExample(
     rule="POOL701",
     tp={
         "langstream_tpu/serving/kvtransfer.py": '''\

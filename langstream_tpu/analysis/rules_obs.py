@@ -51,6 +51,19 @@ and the engine's attribution surface
 ``device_bytes`` in ``serving/``). A ledger poll that syncs the device
 or takes a lock hangs or queues exactly when an operator asks which
 program owns the stall.
+
+OBS506 extends it once more to the *request journey plane*: everything
+in ``serving/journey.py`` (the per-request lifecycle ledger — writes
+are GIL-atomic appends at the engine's flight-event sites, on the
+dispatch path; reads are ``list()`` snapshots plus stitch arithmetic),
+the pod ``/journey`` payload builder (``_journey_payload`` in
+``runtime/pod.py``), and the dev-mode control-plane payload builder
+(``journey`` in ``controlplane/server.py``). A journey write that took
+a lock would serialize the engine loop behind readers; a journey read
+that synced the device would hang exactly when an operator asks where
+a wedged request's time went. (The k8s compute runtime's ``journey``
+fan-in is excluded by scope: it is pod HTTP I/O by design and runs in
+a worker thread, like the traces fan-in.)
 """
 
 from __future__ import annotations
@@ -250,19 +263,26 @@ _DEVICE_SYNC_CALLS = {
 _DEVICE_SYNC_ATTRS = {"block_until_ready", "item", "copy_to_host"}
 
 
-def _health_functions(mod: Module) -> Iterator[ast.AST]:
-    whole_module = mod.path.endswith(_HEALTH_MODULE)
+def _scoped_functions(
+    mod: Module,
+    module_suffix: str,
+    funcs_by_file: dict[str, set[str]],
+) -> Iterator[ast.AST]:
+    """The shared scope iterator behind OBS504/OBS505/OBS506: every
+    top-level function of the plane's own module (``module_suffix``),
+    plus the named functions of the other files in ``funcs_by_file``.
+    Nested defs are deferred work (warmup tasks, factories, dispatch
+    closures) and get their own exemption in the checker — never yield
+    them as policed functions in their own right, or whole-module mode
+    would re-scan exactly the bodies the exemption excludes."""
+    whole_module = mod.path.endswith(module_suffix)
     named: set[str] = set()
-    for prefix, names in _HEALTH_FUNCS_BY_FILE.items():
+    for prefix, names in funcs_by_file.items():
         if prefix in mod.path or mod.path.endswith(prefix):
             named = names
             break
     if not whole_module and not named:
         return
-    # nested defs are deferred work (warmup tasks, factories) and get
-    # their own exemption in the checker — never yield them as policed
-    # functions in their own right, or whole-module mode would re-scan
-    # exactly the bodies the exemption excludes
     nested_fns: set[int] = set()
     for node in ast.walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -278,6 +298,10 @@ def _health_functions(mod: Module) -> Iterator[ast.AST]:
             continue
         if whole_module or node.name in named:
             yield node
+
+
+def _health_functions(mod: Module) -> Iterator[ast.AST]:
+    return _scoped_functions(mod, _HEALTH_MODULE, _HEALTH_FUNCS_BY_FILE)
 
 
 def _waitfree_violations(
@@ -365,29 +389,9 @@ _ATTRIBUTION_FUNCS_BY_FILE = {
 
 
 def _attribution_functions(mod: Module) -> Iterator[ast.AST]:
-    whole_module = mod.path.endswith(_ATTRIBUTION_MODULE)
-    named: set[str] = set()
-    for prefix, names in _ATTRIBUTION_FUNCS_BY_FILE.items():
-        if prefix in mod.path or mod.path.endswith(prefix):
-            named = names
-            break
-    if not whole_module and not named:
-        return
-    nested_fns: set[int] = set()
-    for node in ast.walk(mod.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for inner in ast.walk(node):
-                if inner is not node and isinstance(
-                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
-                ):
-                    nested_fns.add(id(inner))
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if id(node) in nested_fns:
-            continue
-        if whole_module or node.name in named:
-            yield node
+    return _scoped_functions(
+        mod, _ATTRIBUTION_MODULE, _ATTRIBUTION_FUNCS_BY_FILE
+    )
 
 
 def check_blocking_in_attribution_plane(mod: Module) -> Iterator[Finding]:
@@ -404,6 +408,41 @@ def check_blocking_in_attribution_plane(mod: Module) -> Iterator[Finding]:
                 f"wedged dispatch holding it, and blocking I/O stalls "
                 f"the ledger; use snapshot reads (list()/dict() copies, "
                 f"attribute loads) and arithmetic only",
+            )
+
+
+#: the journey-plane module: EVERY function in it is either a ledger
+#: write on the engine dispatch path (container appends only) or a read
+#: the /journey endpoints and the control-plane stitcher run inline
+_JOURNEY_MODULE = "langstream_tpu/serving/journey.py"
+
+#: named journey read paths outside that module: the pod endpoint
+#: payload builder and the dev-mode control-plane stitcher (the k8s
+#: runtime's journey fan-in is pod HTTP I/O by design, off this scope)
+_JOURNEY_FUNCS_BY_FILE = {
+    "langstream_tpu/runtime/pod.py": {"_journey_payload"},
+    "langstream_tpu/controlplane/server.py": {"journey"},
+}
+
+
+def _journey_functions(mod: Module) -> Iterator[ast.AST]:
+    return _scoped_functions(mod, _JOURNEY_MODULE, _JOURNEY_FUNCS_BY_FILE)
+
+
+def check_blocking_in_journey_plane(mod: Module) -> Iterator[Finding]:
+    for fn in _journey_functions(mod):
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "OBS506",
+                node,
+                f"{kind} {offender} in a request-journey ledger path "
+                f"(`{fn.name}`): the journey plane must stay wait-free "
+                f"— a ledger write that takes a lock serializes the "
+                f"engine dispatch path behind readers, a /journey read "
+                f"that syncs the device hangs exactly when the operator "
+                f"asks where a wedged request's time went; use "
+                f"GIL-atomic appends, list()/dict() snapshot copies, "
+                f"and arithmetic only",
             )
 
 
@@ -443,5 +482,13 @@ RULES = [
         "attribution/ledger read path (serving/attribution.py and the "
         "/attribution//memory handlers must be wait-free)",
         check=check_blocking_in_attribution_plane,
+    ),
+    Rule(
+        id="OBS506",
+        family="obs",
+        summary="device sync, blocking I/O, or lock acquisition in a "
+        "request-journey ledger path (serving/journey.py and the "
+        "/journey payload builders must be wait-free)",
+        check=check_blocking_in_journey_plane,
     ),
 ]

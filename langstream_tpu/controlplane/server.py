@@ -222,6 +222,25 @@ class LocalComputeRuntime:
             if any(svc in agent_ids for svc in summary["services"])
         ]
 
+    def journey(self, tenant: str, name: str, journey_id: str) -> dict[str, Any]:
+        """Stitched request journey for the
+        ``/api/applications/{t}/{n}/journey/{id}`` route. Dev mode runs
+        every agent, the gateway, and the engines in-process, so the
+        process-global ledger (serving/journey.py) already holds the
+        whole journey — the "stitch" is over one partial. Scoped like
+        :meth:`traces`: the journey must verifiably touch one of the
+        app's declared models (the engine's submit/import/finish edges
+        carry ``model``), so one tenant's route can't read another's
+        request lifecycles. Wait-free (graftcheck OBS506): snapshot
+        reads + stitch arithmetic only."""
+        from langstream_tpu.serving.journey import JOURNEYS, stitch
+
+        models = self._declared_models(tenant, name) or set()
+        events = JOURNEYS.events(journey_id)
+        if not any(e.get("model") in models for e in events):
+            return {}
+        return stitch(journey_id, [events])
+
     def qos(self, tenant: str, name: str) -> dict[str, Any]:
         """QoS status for the /qos route: the app's declared qos sections
         plus each live engine's scheduler counters (per-class queued/
@@ -408,6 +427,10 @@ class ControlPlaneServer:
                 web.get(
                     "/api/applications/{tenant}/{name}/attribution",
                     self._attribution,
+                ),
+                web.get(
+                    "/api/applications/{tenant}/{name}/journey/{journey_id}",
+                    self._journey,
                 ),
                 web.get("/api/applications/{tenant}/{name}/qos", self._qos),
                 web.get(
@@ -868,6 +891,25 @@ class ControlPlaneServer:
         name = request.match_info["name"]
         report = await asyncio.to_thread(self.compute.slo, tenant, name)
         return web.json_response(report)
+
+    async def _journey(self, request: web.Request) -> web.Response:
+        """One request's stitched cross-pod journey: the pods' partial
+        ledgers merged into a single ordered timeline with its segment
+        decomposition (serving/journey.py stitch; the disaggregated case
+        — prefill pod + decode pod + bounced replicas — is the point).
+        Dev mode stitches the in-process ledger; the k8s runtime fans in
+        the pods' ``/journey/{id}`` endpoints."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        journey_id = request.match_info["journey_id"]
+        stitched = await asyncio.to_thread(
+            self.compute.journey, tenant, name, journey_id
+        )
+        if not stitched or not stitched.get("events"):
+            raise web.HTTPNotFound(reason=f"unknown journey {journey_id!r}")
+        return web.json_response(stitched)
 
     async def _trace(self, request: web.Request) -> web.Response:
         import asyncio

@@ -62,6 +62,14 @@ def _hex_id(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
 
 
+def fresh_trace_id() -> str:
+    """A new 32-hex trace-id-shaped identifier. The journey ledger
+    (serving/journey.py) keys untraced requests with one of these so a
+    journey id is always trace-id-shaped — ``/journey/{id}`` consumers
+    never need to care whether the request was traced."""
+    return _hex_id(16)
+
+
 @dataclass(frozen=True)
 class TraceContext:
     """One (trace, parent-span) coordinate — what the header encodes."""

@@ -34,12 +34,13 @@ from langstream_tpu.api.topics import (
     OFFSET_HEADER,
     TopicConnectionsRuntimeRegistry,
 )
-from langstream_tpu.core.tracing import TRACE_HEADER, start_span
+from langstream_tpu.core.tracing import TRACE_HEADER, TraceContext, start_span
 from langstream_tpu.gateway.auth import (
     AuthenticationException,
     get_auth_provider,
 )
 from langstream_tpu.gateway.router import REPLICA_HEADER, ReplicaRouter
+from langstream_tpu.serving.journey import JOURNEYS
 from langstream_tpu.serving.qos import (
     QosSpec,
     TenantLimiter,
@@ -444,6 +445,23 @@ class GatewayServer:
             headers[REPLICA_HEADER] = replica
         return headers
 
+    @staticmethod
+    def _journey_produce(headers: dict[str, Any]) -> None:
+        """Record the gateway-side journey edge (serving/journey.py) for
+        one ADMITTED produce, keyed by the trace id stamped into the
+        record — the engine's submit/admit edges chain onto it, so the
+        gateway→engine gap ("ingest": broker + agent hop) becomes a
+        named TTFT segment. Called only after the QoS gate admits the
+        message: a throttled request never entered the system, and a
+        burst of 429s must not FIFO-evict live journeys from the
+        bounded ledger."""
+        ctx = TraceContext.parse(headers.get(TRACE_HEADER))
+        if ctx is not None:
+            JOURNEYS.record(
+                ctx.trace_id, "gateway-produce",
+                replica=headers.get(REPLICA_HEADER),
+            )
+
     #: max distinct tenant labels on the throttle counter — tenant names
     #: can be client-chosen on unauthenticated gateways, and Prometheus
     #: label cardinality (and this dict) must not grow with them
@@ -606,6 +624,7 @@ class GatewayServer:
                             }
                         )
                         continue
+                    self._journey_produce(headers)
                     record = make_record(
                         value=payload.get("value"),
                         key=payload.get("key"),
@@ -654,6 +673,7 @@ class GatewayServer:
                 return self._throttle_http(
                     qos_tenant, retry, headers[TRACE_HEADER]
                 )
+        self._journey_produce(headers)
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
         producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
         await producer.start()
@@ -795,6 +815,7 @@ class GatewayServer:
                             }
                         )
                         continue
+                    self._journey_produce(headers)
                     with span:
                         await producer.write(
                             make_record(
@@ -951,6 +972,7 @@ class GatewayServer:
             "gateway.service",
         )
         self._stamp_replica(headers, tenant, app_id, params, principal)
+        self._journey_produce(headers)
         try:
             # `with span:` so a broker failure mid-write/read still closes
             # the span with its error (end() is idempotent — the explicit

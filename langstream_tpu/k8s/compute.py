@@ -276,6 +276,38 @@ class KubernetesComputeRuntime:
             )
         return list(by_trace.values())
 
+    def journey(
+        self, tenant: str, name: str, journey_id: str
+    ) -> dict[str, Any]:
+        """Stitch one request's journey across the application's pods
+        (the ``/api/applications/{t}/{n}/journey/{id}`` route): each pod
+        serves its PARTIAL event ledger on ``/journey/{id}``, and the
+        merge orders every pod's edges into one timeline with its
+        segment decomposition — the disaggregated case is the point
+        (prefill pod, decode pod, and any bounced replica each hold a
+        partial; docs/OBSERVABILITY.md "Request journey plane"). Events
+        are tagged with their pod before stitching so the waterfall
+        names where each edge happened. Unreachable pods simply
+        contribute nothing — a partial timeline with a flagged gap
+        beats a 502."""
+        from langstream_tpu.serving.journey import stitch
+
+        partials: list[list[dict[str, Any]]] = []
+        for pod, chunk in self._pod_json_fanin(
+            tenant, name, f"/journey/{journey_id}"
+        ):
+            if isinstance(chunk, list) and chunk:
+                partials.append(
+                    [
+                        {"pod": pod, **event}
+                        for event in chunk
+                        if isinstance(event, dict)
+                    ]
+                )
+        if not partials:
+            return {}
+        return stitch(journey_id, partials)
+
     def flight(self, tenant: str, name: str) -> list[dict[str, Any]]:
         """Fan in the application pods' ``/flight`` reports. Unlike traces
         (one logical trace spans pods, so partial rollups merge), a flight

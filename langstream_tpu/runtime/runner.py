@@ -510,6 +510,18 @@ class AgentRunner:
                     )
             return False
         self.records_rerouted += 1
+        # journey ledger (serving/journey.py): a replica bounce is a
+        # lifecycle edge an operator must be able to SEE when a request's
+        # TTFT decomposes — keyed by the record's trace id, like every
+        # other edge of the journey
+        ctx = TraceContext.parse(record.header(TRACE_HEADER))
+        if ctx is not None:
+            from langstream_tpu.serving.journey import JOURNEYS
+
+            JOURNEYS.record(
+                ctx.trace_id, "bounce",
+                agent=self.agent_id, replica=self.replica, bounces=bounces,
+            )
         # the re-produced copy is this record's continuation: commit the
         # original (zero local results) so the source offset advances
         self.tracker.track(record, 0)

@@ -49,7 +49,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from langstream_tpu.api.metrics import PrometheusMetricsReporter
-from langstream_tpu.core.tracing import current_context, record_span
+from langstream_tpu.core.tracing import (
+    TraceContext,
+    current_context,
+    fresh_trace_id,
+    record_span,
+)
 from langstream_tpu.models.llama import (
     LlamaConfig,
     init_kv_cache,
@@ -76,6 +81,7 @@ from langstream_tpu.serving.attribution import (
     verify_cost,
 )
 from langstream_tpu.serving.flight import FlightRecorder
+from langstream_tpu.serving.journey import JOURNEYS
 from langstream_tpu.serving.health import EngineWatchdog, SloSpec, SloTracker
 from langstream_tpu.serving.profiling import (
     ProfilerHooks,
@@ -444,6 +450,17 @@ class _Request:
     # wire, so admission skipped prefill entirely (request_timings carry
     # the marker the disagg e2e asserts on)
     imported: bool = False
+    # request-journey ledger key (serving/journey.py): the trace id when
+    # the request is traced, a fresh trace-id-shaped local id otherwise;
+    # None for warmup probes (no journey). Rides the kvtransfer header
+    # so the decode pool's edges land in the SAME journey.
+    journey_id: "str | None" = None
+    # decode-pool marker: the first NEW token emitted after a KV import
+    # closes the decode-admission/first-step journey edge exactly once;
+    # import_base_tokens pins how many generated tokens ARRIVED with the
+    # handoff, so the edge fires on genuinely new work
+    first_step_noted: bool = False
+    import_base_tokens: int = 0
 
     @property
     def context_tokens(self) -> list[int]:
@@ -2079,6 +2096,20 @@ class TpuServingEngine:
             tenant=str(options.get("qos-tenant", "") or ""),
             priority=normalize_priority(options.get("priority")),
         )
+        if not _warmup_probe:
+            # journey ledger key: the trace id when traced (the one id
+            # that already spans gateway → broker → engine and now rides
+            # the kvtransfer header), a fresh same-shaped id otherwise
+            request.journey_id = (
+                request.trace.trace_id
+                if request.trace is not None
+                else fresh_trace_id()
+            )
+            self._journey(
+                request, "submit",
+                model=self.config.model, role=self._pool_role,
+                prompt_tokens=len(tokens), max_tokens=max_tokens,
+            )
         try:
             if self._draining and not _warmup_probe:
                 # drain-before-terminate: admission is closed. The shed
@@ -2098,6 +2129,10 @@ class TpuServingEngine:
             self.flight.event(
                 "shed", reason=e.reason, tenant=request.tenant,
                 priority=request.priority,
+                retry_after_s=e.retry_after,
+            )
+            self._journey(
+                request, "shed", reason=e.reason,
                 retry_after_s=e.retry_after,
             )
             if e.reason == "draining":
@@ -2415,15 +2450,28 @@ class TpuServingEngine:
             "in_transit_bytes": self._kv_in_transit_bytes,
         }
 
-    def take_export(self, request_id: str) -> bytes | None:
-        """Pop one serialized handoff payload (the pod
-        ``/kv/export/{request}`` handler). Single ``dict.pop`` — wait-free
-        (POOL701); the payload leaves the in-transit ledger here."""
+    def take_export_entry(self, request_id: str) -> dict[str, Any] | None:
+        """Pop one export entry (payload + the stashed trace/journey
+        coordinates — what the pod ``/kv/export/{request}`` handler
+        needs to echo the trace header). Wait-free (POOL701): dict pops
+        and journey-ledger appends only; the payload leaves the
+        in-transit ledger here and the pickup lands as an
+        ``export-taken`` journey edge (the handoff-wait/transfer split)."""
         entry = self._exports.pop(request_id, None)
         if entry is None:
             return None
         self._kv_in_transit_bytes -= entry["bytes"]
-        return entry["payload"]
+        JOURNEYS.record(
+            entry.get("journey"), "export-taken",
+            handoff=request_id, bytes=entry["bytes"],
+        )
+        return entry
+
+    def take_export(self, request_id: str) -> bytes | None:
+        """Pop one serialized handoff payload (bytes-only spelling of
+        :meth:`take_export_entry` — the tests' and chainers' surface)."""
+        entry = self.take_export_entry(request_id)
+        return None if entry is None else entry["payload"]
 
     async def _export_ready_slots(self, loop) -> None:
         """Prefill-pool half of the handoff: every slot whose prefill
@@ -2446,6 +2494,7 @@ class TpuServingEngine:
                 if self.block_mgr is not None:
                     self.block_mgr.release(slot_id)
                 self.scheduler.on_finished(request)
+                self._journey(request, "cancelled")
                 continue
             if request.future.done():
                 continue
@@ -2485,6 +2534,16 @@ class TpuServingEngine:
         header = {
             "fingerprint": self.kv_fingerprint(),
             "request": rid,
+            # trace continuity (docs/OBSERVABILITY.md "Request journey
+            # plane"): the decode pool parents its kv-import/decode spans
+            # under the prefill-side trace, and its journey edges land in
+            # the SAME per-request ledger — one trace_id end to end
+            "trace": (
+                request.trace.to_header()
+                if request.trace is not None
+                else None
+            ),
+            "journey": request.journey_id,
             "prompt-digest": kvtransfer.prompt_digest(request.prompt_tokens),
             "prompt-tokens": list(request.prompt_tokens),
             "generated": list(request.generated),
@@ -2517,6 +2576,11 @@ class TpuServingEngine:
                 "bytes": len(payload),
                 "blocks": blocks_live,
                 "m_s": now,
+                # stashed so the pod's /kv/export pickup can echo the
+                # trace header and close the journey's handoff-wait edge
+                # without re-parsing the payload header
+                "trace": header["trace"],
+                "journey": request.journey_id,
             }
             self._kv_in_transit_bytes += len(payload)
             while len(self._exports) > self._export_cap:
@@ -2561,6 +2625,26 @@ class TpuServingEngine:
             device_ms=round(device_s * 1000.0, 3),
             warmup=request.warmup,
         )
+        self._journey(
+            request, "export", handoff=rid, bytes=len(payload), rows=rows,
+            ms=round((time.monotonic() - t_start) * 1000.0, 3),
+            device_ms=round(device_s * 1000.0, 3),
+            model=self.config.model, role=self._pool_role,
+        )
+        if request.trace is not None and not request.warmup:
+            # a handoff request never reaches _flush_emits' finish path,
+            # so its prefill-side phase spans materialize HERE — the
+            # trace the decode pool's kv-import/decode spans join
+            svc = f"engine:{self.config.model}"
+            record_span("engine.queue", svc, request.trace,
+                        request.enqueue_time, admit)
+            record_span("engine.prefill", svc, request.trace, admit, first,
+                        attributes={
+                            "prompt-tokens": len(request.prompt_tokens)
+                        })
+            record_span("engine.kv-export", svc, request.trace, t_start,
+                        time.monotonic(),
+                        attributes={"bytes": len(payload), "rows": rows})
         self.scheduler.on_finished(request)
         self.completed_requests += 1
         if not request.future.done():
@@ -2580,18 +2664,24 @@ class TpuServingEngine:
             )
 
     async def import_handoff(
-        self, payload: bytes, header: dict[str, Any] | None = None
+        self,
+        payload: bytes,
+        header: dict[str, Any] | None = None,
+        trace_header: str | None = None,
     ) -> dict[str, Any]:
         """Decode-pool half of the handoff: admit a request whose KV
         state arrived over the wire — blocks allocate through the
         BlockManager, rows scatter back via ``write_rows``, and the
         request joins the decode batch directly (prefill skipped; the
         ``request_timings`` entry carries ``imported`` so the skip is
-        assertable). Raises :class:`~langstream_tpu.serving.kvtransfer.
-        LayoutMismatch` on a wire/fingerprint mismatch (pod → 409) and
-        :class:`RateLimited` when the pool cannot take it right now
-        (pod → 503 + Retry-After; the router retries the next decode
-        replica)."""
+        assertable). The wire header's ``trace``/``journey`` (falling
+        back to ``trace_header``, the pod's ``langstream-trace`` request
+        header) join this engine's spans and journey edges to the
+        prefill-side trace — one trace_id end to end. Raises
+        :class:`~langstream_tpu.serving.kvtransfer.LayoutMismatch` on a
+        wire/fingerprint mismatch (pod → 409) and :class:`RateLimited`
+        when the pool cannot take it right now (pod → 503 +
+        Retry-After; the router retries the next decode replica)."""
         from langstream_tpu.serving import kvtransfer
 
         if self._stop:
@@ -2635,6 +2725,12 @@ class TpuServingEngine:
                 f"imported request needs {len(prompt) + max_tokens + 1} "
                 f"tokens of KV, more than this pool can ever hold"
             )
+        # trace continuity: the wire header's context first (the prefill
+        # engine stamped it), then the pod HTTP header (a chainer that
+        # forwarded langstream-trace without a trace-aware payload)
+        trace = kvtransfer.trace_context(header)
+        if trace is None:
+            trace = TraceContext.parse(trace_header)
         request = _Request(
             prompt_tokens=prompt,
             max_tokens=max_tokens,
@@ -2653,6 +2749,16 @@ class TpuServingEngine:
             tenant=str(header.get("tenant") or ""),
             priority=normalize_priority(header.get("priority")),
             imported=True,
+            trace=trace,
+        )
+        request.import_base_tokens = len(generated)
+        request.journey_id = kvtransfer.journey_id(header) or (
+            trace.trace_id if trace is not None else fresh_trace_id()
+        )
+        self._journey(
+            request, "import-received", bytes=len(payload),
+            handoff=header.get("request"),
+            model=self.config.model, role=self._pool_role,
         )
         self._pending_imports.append(
             (header, arrays, request, len(payload))
@@ -2678,6 +2784,7 @@ class TpuServingEngine:
             "shed", reason=reason, tenant=request.tenant,
             priority=request.priority, retry_after_s=1.0, imported=True,
         )
+        self._journey(request, "shed", reason=reason, imported=True)
         if not request.future.done():
             request.future.set_exception(RateLimited(reason, 1.0, detail))
 
@@ -2796,6 +2903,21 @@ class TpuServingEngine:
                 ms=round((time.monotonic() - t_start) * 1000.0, 3),
                 device_ms=round(device_s * 1000.0, 3),
             )
+            self._journey(
+                request, "import", bytes=nbytes, rows=rows,
+                ms=round((time.monotonic() - t_start) * 1000.0, 3),
+                device_ms=round(device_s * 1000.0, 3),
+                model=self.config.model, role=self._pool_role,
+            )
+            if request.trace is not None:
+                # the decode-pool spans join the prefill-side trace: the
+                # import (block admit + scatter) as its own child, the
+                # decode phase via the usual completion-time spans
+                record_span(
+                    "engine.kv-import", f"engine:{self.config.model}",
+                    request.trace, t_start, now,
+                    attributes={"bytes": nbytes, "rows": rows},
+                )
 
     # ------------------------------------------------------------------
     # engine loop
@@ -2937,10 +3059,12 @@ class TpuServingEngine:
         self._pending_chunk = None
         self._defer_release = False
         self._deferred_releases.clear()
+        error_text = f"{type(error).__name__}: {error}"[:160]
         for slot_id, slot in enumerate(self.slots):
             request = slot.request
             if request is not None and not request.future.done():
                 request.future.set_exception(error)
+                self._journey(request, "fail", error=error_text)
                 if not request.warmup:
                     self._slo_record("availability", False)
             slot.request = None
@@ -2952,15 +3076,25 @@ class TpuServingEngine:
         for request in self.scheduler.drain():
             if not request.future.done():
                 request.future.set_exception(error)
+                self._journey(request, "fail", error=error_text)
                 if not request.warmup:
                     self._slo_record("availability", False)
         for pending in list(self._pending_imports):
             request = pending[2]
             if not request.future.done():
                 request.future.set_exception(error)
+                self._journey(request, "fail", error=error_text)
         self._pending_imports.clear()
         self._pending_emits.clear()
         self._finished_requests.clear()
+
+    def _journey(self, request: "_Request", kind: str, **detail: Any) -> None:
+        """Append one lifecycle edge to the request's journey ledger
+        (serving/journey.py). Wait-free appends on the dispatch path by
+        OBS506's contract; warmup probes carry no journey id and record
+        nothing."""
+        if request.journey_id is not None:
+            JOURNEYS.record(request.journey_id, kind, **detail)
 
     def _maybe_preempt(self) -> bool:
         """Preemptive load shedding under KV pressure: when admission is
@@ -3021,6 +3155,10 @@ class TpuServingEngine:
             tenant=request.tenant,
             generated=len(request.generated),
         )
+        self._journey(
+            request, "preempt", reason=reason,
+            generated=len(request.generated),
+        )
         if request.trace is not None:
             record_span(
                 "engine.preempt", f"engine:{self.config.model}",
@@ -3043,6 +3181,10 @@ class TpuServingEngine:
             tenant=request.tenant,
             generated=len(request.generated),
             waited_ms=round(waited * 1000.0, 3),
+        )
+        self._journey(
+            request, "resume", waited_ms=round(waited * 1000.0, 3),
+            generated=len(request.generated),
         )
         if request.trace is not None:
             record_span(
@@ -3863,6 +4005,7 @@ class TpuServingEngine:
                     # a resumed request keeps its ORIGINAL first-token
                     # time: TTFT measures the client-visible first token
                     request.first_token_time = now
+                    self._journey(request, "first-token")
                 slot.prefilling = False
                 # register BEFORE emitting: a max-tokens=1 / instant-EOS
                 # request is released inside _emit_token, and registering
@@ -3966,6 +4109,7 @@ class TpuServingEngine:
                     slot.prefill_done = reuse
                     request.admit_time = time.monotonic()
                     self._note_resume(request)
+                    self._journey(request, "admit", chunked=True)
                     if reuse:
                         self.prefix_hits += 1
                         self.prefix_tokens += reuse
@@ -3995,6 +4139,7 @@ class TpuServingEngine:
                 self.slots[slot_id].request = request
                 request.admit_time = admit_now
                 self._note_resume(request)
+                self._journey(request, "admit")
                 if self.block_mgr is not None:
                     self.block_mgr.ensure_capacity(
                         slot_id, len(request.context_tokens)
@@ -4125,6 +4270,7 @@ class TpuServingEngine:
                 self._freq[slot_id] = request.frequency_penalty
                 if request.first_token_time is None:
                     request.first_token_time = now
+                    self._journey(request, "first-token")
                 self._emit_token(slot_id, int(next_np[i]), float(logprob_np[i]))
                 admitted_slots.append(slot_id)
             self._m_tokens(len(batch))
@@ -4285,15 +4431,36 @@ class TpuServingEngine:
             result = request.on_token(token, logprob, done)
             if asyncio.iscoroutine(result):
                 await result
+        # decode-pool first-step edge: the first NEW token after a KV
+        # import closes the decode-admission segment (the emits list
+        # above only carries on_token subscribers; imported handoffs
+        # stream nothing, so the finished/slot scan below is the spot
+        # that sees every request). One attribute check per emit batch.
+        for slot in self.slots:
+            request = slot.request
+            if (
+                request is not None
+                and request.imported
+                and not request.first_step_noted
+                and len(request.generated) > request.import_base_tokens
+            ):
+                request.first_step_noted = True
+                self._journey(request, "first-step")
         finished, self._finished_requests = self._finished_requests, []
         for request, is_eos in finished:
             # tenant tokens/s accounting (QoS post-debit): cancelled
             # requests debit too — their tokens burned engine capacity
             self.scheduler.on_finished(request)
+            if request.imported and not request.first_step_noted:
+                # finished inside its first emit batch: the slot is
+                # already released, so the scan above never saw it
+                request.first_step_noted = True
+                self._journey(request, "first-step")
             if request.future.cancelled():
                 # aborted by the caller: not a served request — keep it out
                 # of the request-rate/TTFT metrics (a disconnect storm must
                 # not read as healthy throughput) and skip the decode
+                self._journey(request, "cancelled")
                 continue
             self.completed_requests += 1
             self._m_requests()
@@ -4346,6 +4513,14 @@ class TpuServingEngine:
                 self._slo_record("availability", True)
                 self._slo_record_latency("ttft", timing["ttft"])
                 self._slo_record_latency("queue-wait", timing["queue_wait"])
+            self._journey(
+                request, "finish",
+                reason=(
+                    "stop" if is_eos or request.stop_matched else "length"
+                ),
+                tokens=len(request.generated),
+                model=self.config.model,
+            )
             if request.trace is not None:
                 # materialize the request's phases as child spans from the
                 # timestamps above — no extra clocks in the decode loop,
@@ -4486,22 +4661,30 @@ async def drain_engines(grace_s: float = 30.0) -> dict[str, Any]:
     return reports
 
 
-def take_kv_export(request_id: str) -> bytes | None:
-    """Pop one serialized KV handoff payload from whichever live engine
-    holds it (the pod ``GET /kv/export/{request}`` handler). Wait-free
+def take_kv_export(request_id: str) -> dict[str, Any] | None:
+    """Pop one KV handoff export entry — ``{"payload", "bytes",
+    "trace", "journey", ...}`` — from whichever live engine holds it
+    (the pod ``GET /kv/export/{request}`` handler; the stashed trace
+    rides back as the response's ``langstream-trace`` header). Wait-free
     (POOL701): instance-map snapshot + one dict pop per engine."""
     for engine in list(TpuServingEngine._instances.values()):
-        payload = engine.take_export(request_id)
-        if payload is not None:
-            return payload
+        entry = engine.take_export_entry(request_id)
+        if entry is not None:
+            return entry
     return None
 
 
-async def import_kv_handoff(payload: bytes) -> dict[str, Any]:
+async def import_kv_handoff(
+    payload: bytes, trace_header: str | None = None
+) -> dict[str, Any]:
     """Route one KV handoff payload to this pod's matching engine (the
     ``POST /kv/import`` handler): the header's fingerprint model picks
     the engine, decode-role engines first (a combined paged engine also
-    accepts — the dev/test posture). Raises
+    accepts — the dev/test posture). ``trace_header`` is the pod HTTP
+    request's ``langstream-trace`` value — the fallback trace parent
+    when the payload header carries none. The result echoes the
+    effective trace so the chainer (and the pod response header) can
+    keep propagating it. Raises
     :class:`~langstream_tpu.serving.kvtransfer.LayoutMismatch` when no
     engine here can take it."""
     from langstream_tpu.serving.kvtransfer import LayoutMismatch, peek_header
@@ -4523,7 +4706,13 @@ async def import_kv_handoff(payload: bytes) -> dict[str, Any]:
         key=lambda e: 0 if e.config.pool_role == "decode" else 1
     )
     # the peeked header rides along so the token-list JSON parses once
-    return await candidates[0].import_handoff(payload, header=header)
+    result = await candidates[0].import_handoff(
+        payload, header=header, trace_header=trace_header
+    )
+    trace = header.get("trace") or trace_header
+    if trace and "trace" not in result:
+        result = {**result, "trace": trace}
+    return result
 
 
 def profile_engines(action: str, trace_dir: str | None = None) -> dict[str, bool]:

@@ -18,7 +18,12 @@ kv-quantize mode, block size, cache geometry — the facts that decide
 whether a foreign pool's rows can land in ours at all), the **prompt
 digest** (chained blake2b, same construction as the prefix cache's
 block digests), the generated-token snapshot, the per-request sampling
-params, and an array manifest (name/dtype/shape/byte offsets). Arrays
+params, the **trace context** (``trace``: the ``langstream-trace``
+header value, so the decode pool's ``engine.kv-import``/``engine.decode``
+spans join the prefill-side trace; ``journey``: the request-journey
+ledger key, serving/journey.py) with the prefill-side span ``timings``
+(queue-wait / prefill / ttft), and an array manifest
+(name/dtype/shape/byte offsets). Arrays
 follow as raw bytes in manifest order: the K and V rows of the slot's
 live positions, gathered dense from the paged pool — ``{"k","v"}`` for
 bf16/f32 pools, ``{"k.q","k.s","v.q","v.s"}`` for int8 pools (the
@@ -76,6 +81,27 @@ class LayoutMismatch(ValueError):
     layout fingerprint that disagrees on any geometry/dtype fact. The
     pod ``/kv/import`` handler maps this to HTTP 409 — a refusal, never
     a retry (no decode replica of the same fleet will accept it either)."""
+
+
+def trace_context(header: dict[str, Any]):
+    """The handoff header's trace coordinate back as a
+    :class:`~langstream_tpu.core.tracing.TraceContext` (None when the
+    header carries none, or a malformed one — a bad trace must never
+    refuse a handoff the layout accepts)."""
+    from langstream_tpu.core.tracing import TraceContext
+
+    return TraceContext.parse(header.get("trace"))
+
+
+def journey_id(header: dict[str, Any]) -> str | None:
+    """The request-journey ledger key riding the header: the explicit
+    ``journey`` field, falling back to the trace id (they are the same
+    value for traced requests — serving/journey.py)."""
+    jid = header.get("journey")
+    if isinstance(jid, str) and jid:
+        return jid
+    ctx = trace_context(header)
+    return ctx.trace_id if ctx is not None else None
 
 
 def prompt_digest(tokens) -> str:

@@ -1,0 +1,779 @@
+"""Cross-pool request journey plane (docs/OBSERVABILITY.md).
+
+Layers covered: the bounded ledger (ring caps with ACCOUNTED eviction),
+the segment classification + stitch arithmetic (gap-free tiling, the
+anomaly checks), the acceptance e2e — a split-pool run over the pod
+HTTP plane produces ONE trace_id spanning gateway → prefill →
+kv-transfer → decode spans AND a stitched, monotonically-ordered
+``/journey/{id}`` timeline whose segment sum matches the measured
+end-to-end wall within 10% — the chaos e2e (preempt + drain-requeue +
+handoff + decode yields a complete timeline with zero missing edges),
+the control-plane fan-in (dev-mode model scoping; k8s cross-pod
+stitch), graftcheck OBS506 (wait-free journey paths), the bench/diff
+instrumentation (``journey_segments`` in bench JSON, perf_diff
+worse-directions), and the tools (``tools/journey.py`` waterfall/
+aggregate/critical-path, ``engine_top --analyze`` on a stitched dump
+flagging transfer-dominated TTFT).
+"""
+
+import asyncio
+import importlib.util
+import json
+import socket
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+
+from langstream_tpu.core.tracing import (
+    SPANS,
+    TraceContext,
+    reset_current,
+    set_current,
+    start_span,
+)
+from langstream_tpu.serving import journey as journey_mod
+from langstream_tpu.serving.journey import (
+    JOURNEYS,
+    JourneyLedger,
+    classify_edge,
+    segments,
+    stitch,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _load_tool(name: str):
+    path = Path(__file__).resolve().parents[1] / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _disagg_config(**overrides):
+    from langstream_tpu.serving.engine import ServingConfig
+
+    base = dict(
+        model="tiny", slots=2, max_seq_len=128, decode_chunk=4,
+        model_dtype="float32", kv_layout="paged", kv_block_size=16,
+        kv_pool_blocks=24, prefix_cache=False,
+    )
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+def _ev(t_ms: float, kind: str, **detail):
+    return {"seq": 0, "t_ms": t_ms, "m_s": t_ms / 1000.0, "kind": kind,
+            **detail}
+
+
+# --------------------------------------------------------------------------
+# ledger: ring bounds with accounted eviction
+# --------------------------------------------------------------------------
+
+
+def test_ledger_ring_bounds_and_eviction_accounting():
+    ledger = JourneyLedger(max_requests=4, max_events=8)
+    for i in range(6):
+        ledger.record(f"req-{i}", "submit")
+    # FIFO eviction of whole journeys, counted — never silent
+    assert len(ledger.ids()) == 4
+    assert ledger.ids() == [f"req-{i}" for i in range(2, 6)]
+    assert ledger.evicted_requests == 2
+    # per-journey event cap: deque drops oldest-first, counted
+    for i in range(12):
+        ledger.record("req-5", "edge", i=i)
+    events = ledger.events("req-5")
+    assert len(events) == 8
+    assert ledger.dropped_events == 12 + 1 - 8  # submit + 12 edges, cap 8
+    stats = ledger.stats()
+    assert stats["evicted_requests"] == 2
+    assert stats["dropped_events"] == 5
+    assert stats["recorded_events"] == 6 + 12
+    # summaries carry retained vs recorded so the loss is visible
+    summary = next(
+        s for s in ledger.summaries() if s["journey"] == "req-5"
+    )
+    assert summary["events"] == 8 and summary["recorded"] == 13
+    # falsy ids record nothing (warmup probes)
+    ledger.record(None, "submit")
+    ledger.record("", "submit")
+    assert ledger.stats()["recorded_events"] == 18
+
+
+def test_ledger_event_schema_and_order():
+    ledger = JourneyLedger(max_requests=8, max_events=8)
+    ledger.record("r", "submit", model="tiny")
+    ledger.record("r", "admit")
+    events = ledger.events("r")
+    assert [e["kind"] for e in events] == ["submit", "admit"]
+    assert events[0]["model"] == "tiny"
+    assert events[0]["t_ms"] <= events[1]["t_ms"]
+    assert events[0]["seq"] < events[1]["seq"]
+    assert ledger.events("unknown") == []
+
+
+# --------------------------------------------------------------------------
+# classification + stitch arithmetic
+# --------------------------------------------------------------------------
+
+
+def test_classify_and_segments_tile_the_timeline():
+    assert classify_edge("submit", "admit") == "queue"
+    assert classify_edge("admit", "first-token") == "prefill"
+    assert classify_edge("export-taken", "import-received") == "transfer"
+    assert classify_edge("import-received", "import") == "decode-admission"
+    assert classify_edge("import", "first-step") == "first-step"
+    assert classify_edge("preempt", "resume") == "preempted"
+    # unknown pairs still tile, labeled explicitly
+    assert classify_edge("x", "y") == "x->y"
+
+    events = [
+        _ev(1000.0, "submit"),
+        _ev(1010.0, "admit"),
+        _ev(1050.0, "first-token"),
+        _ev(1080.0, "finish"),
+    ]
+    segs = segments(events)
+    assert [s["segment"] for s in segs] == ["queue", "prefill", "decode"]
+    # gap-free tiling: segment sum == last - first, exactly
+    assert sum(s["ms"] for s in segs) == pytest.approx(80.0)
+
+
+def test_stitch_merges_partials_and_flags_anomalies():
+    prefill_pod = [
+        _ev(1000.0, "submit"), _ev(1010.0, "admit"),
+        _ev(1050.0, "first-token"),
+        _ev(1060.0, "export"), _ev(1070.0, "export-taken"),
+    ]
+    decode_pod = [
+        _ev(1090.0, "import-received"), _ev(1100.0, "import"),
+        _ev(1110.0, "first-step"), _ev(1200.0, "finish"),
+    ]
+    stitched = stitch("j1", [decode_pod, prefill_pod])
+    kinds = [e["kind"] for e in stitched["events"]]
+    assert kinds == [
+        "submit", "admit", "first-token", "export", "export-taken",
+        "import-received", "import", "first-step", "finish",
+    ]
+    assert stitched["complete"] is True
+    assert stitched["anomalies"] == []
+    assert stitched["total_ms"] == pytest.approx(200.0)
+    assert stitched["by_segment_ms"]["transfer"] == pytest.approx(20.0)
+    assert stitched["by_segment_ms"]["decode-admission"] == pytest.approx(10.0)
+    # sum of segments tiles the total
+    assert sum(stitched["by_segment_ms"].values()) == pytest.approx(200.0)
+
+    # export without import = lost/in-transit handoff
+    lost = stitch("j2", [prefill_pod + [_ev(1300.0, "fail", error="x")]])
+    assert any("export without matching import" in a for a in lost["anomalies"])
+    # cross-pod clock skew reorders the chain — flagged, never hidden
+    skewed = stitch("j3", [[_ev(1000.0, "submit")],
+                           [_ev(990.0, "admit"), _ev(1020.0, "finish")]])
+    assert any("canonical order" in a for a in skewed["anomalies"])
+    # preempt never resumed on a finished journey
+    hung = stitch("j4", [[_ev(1000.0, "submit"), _ev(1010.0, "admit"),
+                          _ev(1020.0, "preempt"), _ev(1030.0, "fail")]])
+    assert any("preempt without matching resume" in a for a in hung["anomalies"])
+
+
+def test_tools_journey_classify_table_matches_serving():
+    """tools/journey.py is stdlib-only by design and duplicates the edge
+    table — this pin keeps the two from drifting."""
+    tool = _load_tool("journey")
+    assert tool.EDGE_SEGMENTS == journey_mod.EDGE_SEGMENTS
+
+
+# --------------------------------------------------------------------------
+# THE acceptance e2e: one trace id + a stitched gap-free timeline whose
+# segment sum matches the measured wall
+# --------------------------------------------------------------------------
+
+
+def test_split_pool_single_trace_and_stitched_journey(run_async, monkeypatch):
+    from langstream_tpu.runtime.pod import _serve_info
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    prompt = "journey plane acceptance prompt"
+
+    async def main():
+        JOURNEYS.clear()
+        SPANS.clear()
+        pre = TpuServingEngine.get_or_create(
+            _disagg_config(pool_role="prefill")
+        )
+        dec = TpuServingEngine.get_or_create(
+            _disagg_config(pool_role="decode")
+        )
+        port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(port))
+        server = await _serve_info(None)
+        # the gateway-side root span: ambient context parents the engine
+        # spans exactly the way the runner's per-record context does
+        root = start_span("gateway.produce", service="gateway")
+        token = set_current(root.context())
+        trace_id = root.trace_id
+        try:
+            t0 = time.monotonic()
+            handoff = await pre.generate(prompt, {"max-tokens": 10})
+            reset_current(token)
+            rid = handoff["handoff"]
+            base = f"http://127.0.0.1:{port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/kv/export/{rid}") as resp:
+                    assert resp.status == 200
+                    # satellite: the pod handoff plane ECHOES the trace
+                    echoed = resp.headers.get("langstream-trace")
+                    assert echoed is not None
+                    assert TraceContext.parse(echoed).trace_id == trace_id
+                    payload = await resp.read()
+                async with session.post(
+                    f"{base}/kv/import", data=payload,
+                ) as resp:
+                    assert resp.status == 200
+                    assert (
+                        TraceContext.parse(
+                            resp.headers.get("langstream-trace")
+                        ).trace_id
+                        == trace_id
+                    )
+                    result = await resp.json()
+                wall_s = time.monotonic() - t0
+                assert result["tokens"]
+
+                # ONE trace_id spans gateway, prefill, kv-transfer, and
+                # decode spans
+                root.end()
+                spans = SPANS.spans(trace_id)
+                names = {s["name"] for s in spans}
+                assert {
+                    "gateway.produce", "engine.queue", "engine.prefill",
+                    "engine.kv-export", "engine.kv-import", "engine.decode",
+                } <= names
+                assert {s["trace_id"] for s in spans} == {trace_id}
+
+                # the pod serves the partial ledger, keyed by the SAME id
+                async with session.get(f"{base}/journey/{trace_id}") as resp:
+                    assert resp.status == 200
+                    events = await resp.json()
+                async with session.get(f"{base}/journey") as resp:
+                    index = await resp.json()
+                assert any(s["journey"] == trace_id for s in index)
+
+            stitched = stitch(trace_id, [events])
+            kinds = [e["kind"] for e in stitched["events"]]
+            # zero missing edges across the whole disaggregated path
+            for kind in (
+                "submit", "admit", "first-token", "export", "export-taken",
+                "import-received", "import", "first-step", "finish",
+            ):
+                assert kind in kinds, f"missing journey edge {kind!r}"
+            # monotonically ordered, gap-free (anomaly-free) timeline
+            t_series = [e["t_ms"] for e in stitched["events"]]
+            assert t_series == sorted(t_series)
+            assert stitched["anomalies"] == []
+            assert stitched["complete"] is True
+            # the acceptance bound: segment sum == measured e2e wall
+            # within 10% (+50ms absolute slack for sub-second runs)
+            total_s = stitched["total_ms"] / 1000.0
+            assert abs(total_s - wall_s) <= 0.10 * wall_s + 0.05, (
+                f"journey total {total_s:.3f}s vs measured wall "
+                f"{wall_s:.3f}s"
+            )
+            # the split's cost is named: transfer + decode-admission are
+            # real segments of this timeline
+            assert stitched["by_segment_ms"].get("transfer", 0) > 0
+            assert stitched["by_segment_ms"].get("decode-admission", 0) > 0
+        finally:
+            server.close()
+            await pre.close()
+            await dec.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# chaos e2e: preempt + drain-requeue + handoff + decode, zero missing edges
+# --------------------------------------------------------------------------
+
+
+def test_chaos_journey_completeness_through_drain_and_handoff(run_async):
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    config = _disagg_config(
+        pool_role="prefill", prefill_chunk=8, max_seq_len=256,
+        kv_pool_blocks=40,
+    )
+    prompt = "chaos journey completeness prompt " * 4
+
+    async def main():
+        JOURNEYS.clear()
+        victim = TpuServingEngine(config)
+        decode = TpuServingEngine(
+            _disagg_config(
+                pool_role="decode", max_seq_len=256, kv_pool_blocks=40
+            )
+        )
+        try:
+            task = asyncio.ensure_future(
+                victim.generate(prompt, {"max-tokens": 8})
+            )
+            for _ in range(2000):
+                if any(s.prefilling for s in victim.slots):
+                    break
+                await asyncio.sleep(0.005)
+            assert any(s.prefilling for s in victim.slots)
+            # drain mid-prefill: the request is preempted, requeued
+            # front-of-class, and completes its prefill + export inside
+            # the grace budget
+            report = await victim.drain(60.0)
+            assert report["requeued"] >= 1 and report["shed"] == 0
+            handoff = await asyncio.wait_for(task, timeout=60)
+            assert handoff["finish_reason"] == "handoff"
+            payload = victim.take_export(handoff["handoff"])
+            result = await decode.import_handoff(payload)
+            assert result["tokens"]
+
+            jid = next(
+                j for j in JOURNEYS.ids()
+                if any(
+                    e["kind"] == "preempt"
+                    for e in JOURNEYS.events(j)
+                )
+            )
+            stitched = stitch(jid, [JOURNEYS.events(jid)])
+            kinds = [e["kind"] for e in stitched["events"]]
+            # one timeline, zero missing edges across preempt →
+            # drain-requeue → re-prefill → handoff → decode
+            for kind in (
+                "submit", "admit", "preempt", "resume", "first-token",
+                "export", "export-taken", "import-received", "import",
+                "first-step", "finish",
+            ):
+                assert kind in kinds, f"missing journey edge {kind!r}"
+            preempt = next(
+                e for e in stitched["events"] if e["kind"] == "preempt"
+            )
+            assert preempt["reason"] == "drain"
+            # monotone timestamps, no structural anomalies
+            t_series = [e["t_ms"] for e in stitched["events"]]
+            assert t_series == sorted(t_series)
+            assert stitched["anomalies"] == []
+            assert stitched["complete"] is True
+            # the re-prefill is visible: two admits bracket the preempt
+            assert kinds.count("admit") == 2
+        finally:
+            await victim.close()
+            await decode.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# control-plane fan-in: dev-mode scoping + k8s cross-pod stitch
+# --------------------------------------------------------------------------
+
+
+def _fake_runner(model: str = "tiny"):
+    res = SimpleNamespace(
+        type="tpu-serving-configuration", configuration={"model": model}
+    )
+    return SimpleNamespace(
+        application=SimpleNamespace(resources={"serving": res}), runners=[]
+    )
+
+
+def test_dev_mode_journey_route_scopes_by_declared_model():
+    from langstream_tpu.controlplane.server import LocalComputeRuntime
+
+    JOURNEYS.clear()
+    runtime = LocalComputeRuntime()
+    runtime.runners[("t1", "app")] = _fake_runner("tiny")
+    JOURNEYS.record("j-tiny", "submit", model="tiny")
+    JOURNEYS.record("j-tiny", "finish", model="tiny", tokens=3)
+    JOURNEYS.record("j-other", "submit", model="llama3-8b")
+
+    stitched = runtime.journey("t1", "app", "j-tiny")
+    assert stitched["journey"] == "j-tiny"
+    assert [e["kind"] for e in stitched["events"]] == ["submit", "finish"]
+    # another app's journey (different model) is invisible to this route
+    assert runtime.journey("t1", "app", "j-other") == {}
+    # undeployed app: nothing leaks
+    assert runtime.journey("t2", "ghost", "j-tiny") == {}
+
+
+def test_k8s_journey_fanin_stitches_pod_partials(monkeypatch):
+    from langstream_tpu.k8s.client import InMemoryKubeApi
+    from langstream_tpu.k8s.compute import KubernetesComputeRuntime
+
+    runtime = KubernetesComputeRuntime(InMemoryKubeApi())
+    partials = {
+        "chat-ai-prefill-0": [
+            _ev(1000.0, "submit"), _ev(1010.0, "admit"),
+            _ev(1050.0, "first-token"), _ev(1060.0, "export"),
+        ],
+        "chat-ai-decode-0": [
+            _ev(1090.0, "import-received"), _ev(1100.0, "import"),
+            _ev(1110.0, "first-step"), _ev(1200.0, "finish"),
+        ],
+    }
+
+    def fake_fanin(tenant, name, path):
+        assert path == "/journey/j9"
+        return [
+            ("chat-ai-prefill-0", partials["chat-ai-prefill-0"]),
+            ("chat-ai-decode-0", partials["chat-ai-decode-0"]),
+            ("chat-ai-prefill-1", None),  # unreachable pod: no partial
+        ]
+
+    monkeypatch.setattr(runtime, "_pod_json_fanin", fake_fanin)
+    stitched = runtime.journey("t1", "chat", "j9")
+    kinds = [e["kind"] for e in stitched["events"]]
+    assert kinds == [
+        "submit", "admit", "first-token", "export", "import-received",
+        "import", "first-step", "finish",
+    ]
+    # every event names the pod it happened on
+    assert stitched["events"][0]["pod"] == "chat-ai-prefill-0"
+    assert stitched["events"][-1]["pod"] == "chat-ai-decode-0"
+    assert stitched["by_segment_ms"]["transfer"] == pytest.approx(30.0)
+    # no pods answered: empty, never a crash
+    monkeypatch.setattr(
+        runtime, "_pod_json_fanin", lambda t, n, p: [("p-0", None)]
+    )
+    assert runtime.journey("t1", "chat", "j9") == {}
+
+
+# --------------------------------------------------------------------------
+# graftcheck OBS506: wait-free journey paths (TP/TN beyond the fixtures)
+# --------------------------------------------------------------------------
+
+
+def test_obs506_scope_and_sanctioned_shapes():
+    import textwrap
+
+    from langstream_tpu.analysis import ALL_RULES, analyze_source
+
+    path = "langstream_tpu/serving/journey.py"
+    sync_in_read = textwrap.dedent(
+        """
+        import jax
+
+        def events(journeys):
+            jax.block_until_ready(journeys)
+            return journeys
+        """
+    )
+    ids = [f.rule for f in analyze_source(sync_in_read, path, ALL_RULES)]
+    assert "OBS506" in ids
+    # lock in a ledger write path
+    locked = textwrap.dedent(
+        """
+        def record(self, journey_id, kind):
+            with self._lock:
+                self._entries[journey_id].append(kind)
+        """
+    )
+    ids = [f.rule for f in analyze_source(locked, path, ALL_RULES)]
+    assert "OBS506" in ids
+    # the sanctioned shape: snapshot copies + arithmetic
+    clean = textwrap.dedent(
+        """
+        def events(self, journey_id):
+            entry = self._entries.get(journey_id)
+            return list(entry) if entry is not None else []
+        """
+    )
+    assert "OBS506" not in [
+        f.rule for f in analyze_source(clean, path, ALL_RULES)
+    ]
+    # the pod payload builder is policed
+    pod = textwrap.dedent(
+        """
+        def _journey_payload(journey_id):
+            with open("/tmp/journeys") as f:
+                return f.read()
+        """
+    )
+    ids = [
+        f.rule
+        for f in analyze_source(
+            pod, "langstream_tpu/runtime/pod.py", ALL_RULES
+        )
+    ]
+    assert "OBS506" in ids
+    # the dev-mode control-plane stitcher is policed
+    cp = textwrap.dedent(
+        """
+        import jax
+
+        def journey(self, tenant, name, journey_id):
+            jax.block_until_ready(tenant)
+            return {}
+        """
+    )
+    ids = [
+        f.rule
+        for f in analyze_source(
+            cp, "langstream_tpu/controlplane/server.py", ALL_RULES
+        )
+    ]
+    assert "OBS506" in ids
+    # the k8s fan-in does pod HTTP I/O by design — out of scope
+    k8s = textwrap.dedent(
+        """
+        import urllib.request
+
+        def journey(self, tenant, name, journey_id):
+            return urllib.request.urlopen("http://pod:8080/journey").read()
+        """
+    )
+    assert "OBS506" not in [
+        f.rule
+        for f in analyze_source(
+            k8s, "langstream_tpu/k8s/compute.py", ALL_RULES
+        )
+    ]
+    # nested defs (deferred work) are exempt
+    nested = textwrap.dedent(
+        """
+        import jax
+
+        def stitch(journey_id, partials):
+            def _later():
+                jax.block_until_ready(partials)
+            return _later
+        """
+    )
+    assert "OBS506" not in [
+        f.rule for f in analyze_source(nested, path, ALL_RULES)
+    ]
+
+
+# --------------------------------------------------------------------------
+# perf_diff: journey segment fields with worse-directions
+# --------------------------------------------------------------------------
+
+
+def _bench_record(transfer_p50: float) -> dict:
+    return {
+        "metric": "tok/s",
+        "value": 100.0,
+        "schema": 2,
+        "detail": {
+            "journey_segments": {
+                "queue": {"p50_s": 0.05, "p99_s": 0.1, "n": 64},
+                "transfer": {"p50_s": transfer_p50,
+                             "p99_s": transfer_p50 * 2, "n": 64},
+                "decode-admission": {"p50_s": 0.01, "p99_s": 0.02, "n": 64},
+            },
+        },
+    }
+
+
+def test_perf_diff_flags_journey_segment_regressions():
+    perf_diff = _load_tool("perf_diff")
+    base = perf_diff.extract_metrics(_bench_record(0.10))
+    assert base["metrics"]["journey_transfer_p50_s"] == 0.10
+    assert base["metrics"]["journey_queue_p99_s"] == 0.1
+    assert base["metrics"]["journey_decode_admission_p50_s"] == 0.01
+
+    results, regressed = perf_diff.diff_payloads(
+        [("r1", _bench_record(0.10)), ("r2", _bench_record(0.30))]
+    )
+    assert regressed
+    flagged = {e["metric"] for e in results[0][2]["regressions"]}
+    assert "journey_transfer_p50_s" in flagged
+    assert "journey_transfer_p99_s" in flagged
+    # unchanged segments stay quiet
+    assert "journey_queue_p50_s" not in flagged
+    # coverage drift (segment absent in one round) is a note, never a
+    # regression — the combined-fleet baseline has no transfer segment
+    no_transfer = _bench_record(0.10)
+    del no_transfer["detail"]["journey_segments"]["transfer"]
+    results, regressed = perf_diff.diff_payloads(
+        [("r1", no_transfer), ("r2", _bench_record(0.10))]
+    )
+    assert not regressed
+    assert any("journey_transfer_p50_s" in n for n in results[0][2]["notes"])
+    # bare gateway_bench output (no bench-record wrapper) extracts too
+    bare = {"gateway_ttft_p50_s": 0.2,
+            "journey_segments": {"queue": {"p50_s": 0.05, "p99_s": 0.1}}}
+    assert (
+        perf_diff.extract_metrics(bare)["metrics"]["journey_queue_p50_s"]
+        == 0.05
+    )
+
+
+# --------------------------------------------------------------------------
+# tools: journey waterfall/aggregate + engine_top --analyze on a dump
+# --------------------------------------------------------------------------
+
+
+def _stitched(transfer_ms: float, prefill_ms: float, jid: str = "j1") -> dict:
+    events = [
+        _ev(1000.0, "submit"),
+        _ev(1010.0, "admit"),
+        _ev(1010.0 + prefill_ms, "first-token"),
+        _ev(1015.0 + prefill_ms, "export"),
+        _ev(1015.0 + prefill_ms + transfer_ms, "import-received"),
+        _ev(1020.0 + prefill_ms + transfer_ms, "import"),
+        _ev(1025.0 + prefill_ms + transfer_ms, "first-step"),
+        _ev(1100.0 + prefill_ms + transfer_ms, "finish"),
+    ]
+    return stitch(jid, [events])
+
+
+def test_tools_journey_waterfall_critical_path_and_flags(tmp_path):
+    tool = _load_tool("journey")
+    # transfer (40ms) dominates prefill (20ms) → anomaly + critical path
+    stitched = _stitched(transfer_ms=40.0, prefill_ms=20.0)
+    text = tool.render_waterfall(stitched)
+    assert "== journey j1 ==" in text
+    assert "transfer" in text and "decode-admission" in text
+    assert "critical path: transfer" in text
+    assert "transfer-dominated TTFT" in text
+    # a prefill-dominated journey stays unflagged
+    calm = _stitched(transfer_ms=5.0, prefill_ms=200.0)
+    assert "transfer-dominated" not in tool.render_waterfall(calm)
+    # bounce thrash flag
+    bouncy = stitch("jb", [[
+        _ev(1000.0, "gateway-produce"),
+        _ev(1001.0, "bounce"), _ev(1002.0, "bounce"),
+        _ev(1003.0, "bounce"), _ev(1004.0, "bounce"),
+        _ev(1010.0, "submit"), _ev(1020.0, "admit"),
+        _ev(1050.0, "first-token"), _ev(1090.0, "finish"),
+    ]])
+    assert any("replica bounces" in f for f in tool.journey_flags(bouncy))
+    # aggregate: p50/p99 per segment + the dominated histogram
+    agg = tool.aggregate(
+        [_stitched(40.0, 20.0, "a"), _stitched(60.0, 20.0, "b"),
+         _stitched(10.0, 200.0, "c")]
+    )
+    assert agg["journeys"] == 3
+    assert agg["segments"]["transfer"]["n"] == 3
+    assert agg["ttft_critical_path"].get("transfer", 0) >= 2
+    assert "transfer" in tool.render_aggregate(agg)
+    # the CLI end to end over a dump file
+    dump = tmp_path / "journeys.json"
+    dump.write_text(json.dumps([_stitched(40.0, 20.0)]))
+    assert tool.main([str(dump)]) == 0
+    assert tool.main(["--aggregate", str(dump)]) == 0
+    # raw partial event lists stitch locally
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps([
+        [_ev(1000.0, "submit"), _ev(1010.0, "admit")],
+        [_ev(1030.0, "first-token"), _ev(1050.0, "finish")],
+    ]))
+    assert tool.main([str(raw)]) == 0
+
+
+def test_engine_top_analyze_flags_transfer_dominated_journeys():
+    engine_top = _load_tool("engine_top")
+    # a dump of stitched journeys where the handoff dwarfs prefill
+    dump = [_stitched(80.0, 10.0, "a"), _stitched(90.0, 12.0, "b")]
+    text = engine_top.analyze(dump)
+    assert "== journey a ==" in text
+    assert "transfer-dominated TTFT" in text
+    assert "transfer-dominated TTFT at p50" in text
+    # prefill-dominated journeys stay quiet
+    calm = [_stitched(5.0, 300.0, "a"), _stitched(6.0, 280.0, "b")]
+    text = engine_top.analyze(calm)
+    assert "transfer-dominated" not in text
+    assert "no journey anomalies flagged" in text
+
+
+# --------------------------------------------------------------------------
+# gateway journey edge + engine submit/finish edges in-process
+# --------------------------------------------------------------------------
+
+
+def test_engine_records_combined_journey_edges(run_async):
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        JOURNEYS.clear()
+        engine = TpuServingEngine(_disagg_config())
+        try:
+            ctx = TraceContext.new()
+            token = set_current(ctx)
+            await engine.generate("combined journey", {"max-tokens": 4})
+            reset_current(token)
+            events = JOURNEYS.events(ctx.trace_id)
+            kinds = [e["kind"] for e in events]
+            assert kinds[0] == "submit"
+            assert {"admit", "first-token", "finish"} <= set(kinds)
+            # the combined decomposition: queue + prefill + decode
+            segs = {s["segment"] for s in segments(events)}
+            assert {"queue", "prefill", "decode"} <= segs
+            finish = next(e for e in events if e["kind"] == "finish")
+            assert finish["model"] == "tiny"
+            assert finish["tokens"] == 4
+            # untraced requests still get a journey (local id)
+            before = set(JOURNEYS.ids())
+            await engine.generate("untraced", {"max-tokens": 2})
+            fresh = set(JOURNEYS.ids()) - before
+            assert len(fresh) == 1
+            assert {
+                e["kind"] for e in JOURNEYS.events(fresh.pop())
+            } >= {"submit", "admit", "first-token", "finish"}
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_gateway_records_journey_edge_only_for_admitted_produces():
+    from langstream_tpu.gateway.server import GatewayServer
+
+    JOURNEYS.clear()
+    server = GatewayServer.__new__(GatewayServer)
+    server.registry = SimpleNamespace(
+        route_replica=lambda tenant, app_id, affinity: "app-ai-1"
+    )
+    ctx = TraceContext.new()
+    headers = {"langstream-trace": ctx.to_header()}
+    # stamping alone records nothing: a produce the QoS gate then
+    # throttles must not enter (and FIFO-evict) the bounded ledger
+    server._stamp_replica(headers, "t", "app", {"tenant": "alice"}, {})
+    assert JOURNEYS.events(ctx.trace_id) == []
+    # the admitted-write site records the edge with the routing choice
+    server._journey_produce(headers)
+    events = JOURNEYS.events(ctx.trace_id)
+    assert [e["kind"] for e in events] == ["gateway-produce"]
+    assert events[0]["replica"] == "app-ai-1"
+
+
+def test_ttft_critical_path_excludes_post_first_token_preemption():
+    """A 5 s mid-decode preemption must not masquerade as a TTFT
+    problem: the critical path is computed over the timeline up to the
+    first client-visible token, and the post-resume run to finish is
+    classified decode."""
+    tool = _load_tool("journey")
+    events = [
+        _ev(0.0, "submit"), _ev(10.0, "admit"),
+        _ev(200.0, "first-token"),
+        _ev(400.0, "preempt", reason="no-kv-blocks"),
+        _ev(5400.0, "resume"), _ev(5410.0, "admit"),
+        _ev(6000.0, "finish"),
+    ]
+    stitched = stitch("jp", [events])
+    # the post-resume interval is decode, not an unclassified label
+    assert stitched["by_segment_ms"]["decode"] == pytest.approx(
+        200.0 + 590.0
+    )
+    name, ms = tool.ttft_critical_path(stitched)
+    assert name == "prefill" and ms == pytest.approx(190.0)
+    # split-pool journeys cut at the decode pool's first-step (the
+    # first token the CLIENT sees), not the prefill-side first-token
+    split = _stitched(transfer_ms=400.0, prefill_ms=20.0, jid="js")
+    name, _ = tool.ttft_critical_path(split)
+    assert name == "transfer"

@@ -34,8 +34,12 @@ exhaustion, unbounded queue growth, pipeline overlap collapse
 (sustained ``overlap_ratio`` near 0 while occupancy is high), the
 wedged-device flag (no step progress while work is queued — the r03
 hang shape, read from the dump's ``health`` section), SLO objectives in
-fast burn, and — for saved autoscaler payloads — scale thrash (≥3
-direction changes inside one cooldown window).
+fast burn, — for saved autoscaler payloads — scale thrash (≥3
+direction changes inside one cooldown window), and — for stitched
+request-journey payloads (``/api/applications/{t}/{n}/journey/{id}``,
+tools/journey.py) — per-segment TTFT totals with a transfer-dominated
+flag when the handoff cost exceeds prefill at p50 (disaggregation
+costing more than it saves).
 
     python tools/engine_top.py --analyze dump.json
     python tools/engine_top.py --analyze BENCH_r06.json
@@ -570,6 +574,45 @@ def _collect_fleet_dicts(obj, found: list[dict], label: str = "") -> None:
             _collect_fleet_dicts(value, found, f"{label}[{i}]")
 
 
+def _collect_journey_dicts(obj, found: list[dict], label: str = "") -> None:
+    """Recursively find stitched request-journey payloads (dicts carrying
+    a ``segments`` list next to an ``events`` list — the shape the
+    control plane's ``/journey/{id}`` route and tools/journey.py
+    serve)."""
+    if isinstance(obj, dict):
+        if isinstance(obj.get("segments"), list) and isinstance(
+            obj.get("events"), list
+        ):
+            found.append(
+                {"label": label or str(obj.get("journey", "")), "src": obj}
+            )
+            return
+        for key, value in obj.items():
+            _collect_journey_dicts(
+                value, found, f"{label}.{key}" if label else str(key)
+            )
+    elif isinstance(obj, list):
+        for i, value in enumerate(obj):
+            _collect_journey_dicts(value, found, f"{label}[{i}]")
+
+
+def _journey_tool():
+    """The sibling journey tool (tools/journey.py), loaded the way the
+    multi-dump diff loads perf_diff — so the segment tables and flag
+    thresholds stay single-sourced across the two CLIs."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import journey
+
+    return journey
+
+
+def _pct_ms(values: list) -> float | None:
+    values = sorted(v for v in values if v is not None)
+    if not values:
+        return None
+    return values[min(len(values) - 1, int(0.50 * len(values)))]
+
+
 def _scale_thrash(decisions: list, cooldown_s: float) -> str | None:
     """≥3 scale direction changes inside one cooldown window. With the
     cooldown enforced this is impossible — so when it fires, something
@@ -806,11 +849,14 @@ def analyze(dump) -> str:
     _collect_fleet_dicts(dump, fleet_found)
     attrib_found: list[dict] = []
     _collect_attrib_dicts(dump, attrib_found)
-    if not found and not fleet_found and not attrib_found:
+    journey_found: list[dict] = []
+    _collect_journey_dicts(dump, journey_found)
+    if not found and not fleet_found and not attrib_found and not journey_found:
         raise ValueError(
             "no flight data found in the dump (expected a /flight payload, "
             "a bench record with a 'flight' rollup, an /attribution "
-            "payload, or an autoscaler status payload)"
+            "payload, an autoscaler status payload, or a stitched "
+            "/journey payload)"
         )
     lines: list[str] = []
     for item in fleet_found:
@@ -927,6 +973,56 @@ def analyze(dump) -> str:
         if not flagged:
             lines.append("  no attribution anomalies flagged")
         lines.append("")
+    if journey_found:
+        jt = _journey_tool()
+        journeys = [item["src"] for item in journey_found]
+        handoff_p50s, prefill_p50s = [], []
+        for item in journey_found:
+            journey = item["src"]
+            totals = jt.by_segment(journey)
+            handoff = sum(
+                totals.get(s, 0.0) for s in jt.HANDOFF_SEGMENTS
+            )
+            if handoff:
+                handoff_p50s.append(handoff)
+            if totals.get("prefill"):
+                prefill_p50s.append(totals["prefill"])
+            label = journey.get("journey") or item["label"] or "journey"
+            lines.append(f"== journey {label} ==")
+            lines.append(
+                f"total {_fmt_ms(journey.get('total_ms'))} over "
+                f"{len(journey.get('events') or [])} events"
+            )
+            for name, ms in sorted(
+                totals.items(), key=lambda kv: -kv[1]
+            ):
+                lines.append(f"  {name:18s} {_fmt_ms(ms)}")
+            flags = jt.journey_flags(journey)
+            for flag in flags:
+                lines.append(f"  !! {flag}")
+            if not flags:
+                lines.append("  no journey anomalies flagged")
+            lines.append("")
+        # the aggregate view: transfer-dominated TTFT at p50 across the
+        # dump's journeys (one slow handoff is noise; the p50 crossing
+        # prefill means disaggregation costs more than it saves)
+        handoff_p50 = _pct_ms(handoff_p50s)
+        prefill_p50 = _pct_ms(prefill_p50s)
+        if (
+            len(journeys) > 1
+            and handoff_p50 is not None
+            and prefill_p50 is not None
+            and handoff_p50 > prefill_p50
+        ):
+            lines.append(
+                f"!! transfer-dominated TTFT at p50 across "
+                f"{len(journeys)} journeys: handoff "
+                f"{_fmt_ms(handoff_p50)} > prefill {_fmt_ms(prefill_p50)} "
+                f"— the disaggregated split is costing more than it "
+                f"saves; co-locate, batch the transfers, or move to a "
+                f"device-to-device path (docs/DISAGG.md)"
+            )
+            lines.append("")
     return "\n".join(lines).rstrip()
 
 

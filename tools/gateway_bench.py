@@ -184,11 +184,19 @@ async def run_gateway_bench(
             )
 
         # drop warmup requests from the engine-side timing samples so the
-        # TTFT decomposition below covers only the measured window
+        # TTFT decomposition below covers only the measured window — and
+        # from the journey ledger, which decomposes the same window per
+        # request (serving/journey.py)
+        from langstream_tpu.serving.journey import (
+            JOURNEYS,
+            segments as journey_segments,
+        )
+
         with TpuServingEngine._instances_lock:
             engines = list(TpuServingEngine._instances.values())
         for engine in engines:
             engine.request_timings.clear()
+        JOURNEYS.clear()
 
         rng = random.Random(seed)
         tasks: list[asyncio.Task] = []
@@ -235,6 +243,34 @@ async def run_gateway_bench(
                     max(0.0, pct(ttfts, 0.50) - pct(engine_ttfts, 0.50)), 4
                 ),
             })
+        # per-request journey segments (serving/journey.py): the same
+        # TTFT decomposition as above, but per REQUEST and per lifecycle
+        # edge — queue vs prefill vs (under split pools) transfer vs
+        # decode-admission vs first-step — the instrument the split-pool
+        # bench round compares against the combined baseline. Segments
+        # absent from this run's topology (no handoffs on a combined
+        # fleet) simply don't appear; perf_diff reports that as coverage
+        # drift, never a regression.
+        seg_samples: dict[str, list[float]] = {}
+        for jid in JOURNEYS.ids():
+            for seg in journey_segments(JOURNEYS.events(jid)):
+                seg_samples.setdefault(seg["segment"], []).append(
+                    seg["ms"] / 1000.0
+                )
+        journey_out: dict[str, Any] = {}
+        for name in (
+            "ingest", "queue", "prefill", "export", "handoff-wait",
+            "transfer", "decode-admission", "first-step", "decode",
+        ):
+            values = sorted(seg_samples.get(name) or [])
+            if values:
+                journey_out[name] = {
+                    "p50_s": round(pct(values, 0.50), 4),
+                    "p99_s": round(pct(values, 0.99), 4),
+                    "n": len(values),
+                }
+        if journey_out:
+            out["journey_segments"] = journey_out
         # decode roofline: the HBM-bandwidth floor for one decode step at
         # this engine shape (profiling.decode_step_bytes), so a recorded
         # tok/s number carries its achieved-vs-possible context. Achieved

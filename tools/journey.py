@@ -1,0 +1,447 @@
+#!/usr/bin/env python3
+"""journey: render stitched request journeys, attribute the TTFT
+critical path, and flag lifecycle anomalies.
+
+The request journey plane (serving/journey.py, docs/OBSERVABILITY.md)
+records one append-only event list per request across every pod it
+touched; the control plane stitches the partials under
+``/api/applications/{t}/{n}/journey/{id}``. This tool is the operator
+end of that plane:
+
+- **waterfall** (default): one stitched journey rendered as a span
+  waterfall — every lifecycle edge with its offset, and each inter-event
+  segment (queue / prefill / export / transfer / decode-admission /
+  first-step / decode …) as a scaled bar, so "where did this request's
+  7.8 s go" reads off one screen;
+- **critical path**: per journey, the segment that dominated its TTFT
+  (submit → first visible token), and over a SET of journeys the
+  p50/p99 per segment plus a histogram of which segment dominated —
+  the aggregate that tells you whether to attack the queue, the
+  prefill, or the handoff;
+- **anomalies**: transfer time exceeding prefill time (disaggregation
+  costing more than it saves), a re-prefill after preemption (the
+  resume re-pays the prompt), and more than ``--max-bounces`` replica
+  bounces (routing thrash).
+
+    python tools/journey.py stitched.json                  # waterfall
+    python tools/journey.py --url http://cp:8090/api/applications/t/app/journey/<id>
+    python tools/journey.py --aggregate dump1.json dump2.json ...
+
+Accepted inputs (auto-detected per file): a stitched journey payload
+(the control-plane route's shape), a list of stitched journeys, a raw
+event list or list of per-pod partial event lists (stitched locally —
+the same ordering-and-classify rules as serving/journey.py, duplicated
+here so the tool stays dependency-free; ``tests/test_journey.py`` pins
+the two tables equal), or a ``/journey`` pod payload.
+
+Zero dependencies (stdlib only), like ``engine_top``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+#: (previous kind, next kind) → segment name — MUST match
+#: serving/journey.py's EDGE_SEGMENTS (pinned by tests/test_journey.py)
+EDGE_SEGMENTS: dict[tuple[str, str], str] = {
+    ("gateway-produce", "submit"): "ingest",
+    ("bounce", "submit"): "ingest",
+    ("gateway-produce", "bounce"): "ingest",
+    ("bounce", "bounce"): "ingest",
+    ("submit", "admit"): "queue",
+    ("submit", "shed"): "queue",
+    ("admit", "first-token"): "prefill",
+    ("first-token", "export"): "export",
+    ("export", "export-taken"): "handoff-wait",
+    ("export-taken", "import-received"): "transfer",
+    ("export", "import-received"): "transfer",
+    ("import-received", "import"): "decode-admission",
+    ("import", "first-step"): "first-step",
+    ("first-step", "finish"): "decode",
+    ("first-token", "finish"): "decode",
+    ("preempt", "resume"): "preempted",
+    ("resume", "admit"): "requeue",
+    ("first-token", "preempt"): "decode",
+    ("first-step", "preempt"): "decode",
+    ("admit", "finish"): "decode",
+}
+
+#: segments that are part of TTFT (everything before the first token
+#: the CLIENT can see: the decode pool's first step for a handoff, the
+#: first-token edge otherwise)
+TTFT_SEGMENTS = (
+    "ingest", "queue", "prefill", "export", "handoff-wait", "transfer",
+    "decode-admission", "first-step", "preempted", "requeue",
+)
+
+#: the handoff cost a disaggregated fleet pays on top of a co-located
+#: run — compared against prefill for the transfer-dominated flag
+HANDOFF_SEGMENTS = ("export", "handoff-wait", "transfer", "decode-admission")
+
+
+def classify_edge(prev_kind: str, next_kind: str) -> str:
+    return EDGE_SEGMENTS.get(
+        (prev_kind, next_kind), f"{prev_kind}->{next_kind}"
+    )
+
+
+def stitch_events(journey_id: str, partials: list) -> dict:
+    """Local stitch over raw partial event lists (same semantics as
+    serving/journey.py stitch: stable sort on the wall anchor, tiling
+    segment decomposition)."""
+    tagged = []
+    for pi, part in enumerate(partials):
+        for idx, event in enumerate(part or []):
+            if isinstance(event, dict):
+                tagged.append(
+                    (float(event.get("t_ms") or 0.0), pi, idx, event)
+                )
+    tagged.sort(key=lambda t: (t[0], t[1], t[2]))
+    events = [t[3] for t in tagged]
+    segments = []
+    for prev, nxt in zip(events, events[1:]):
+        segments.append(
+            {
+                "segment": classify_edge(
+                    str(prev.get("kind")), str(nxt.get("kind"))
+                ),
+                "from": prev.get("kind"),
+                "to": nxt.get("kind"),
+                "t_ms": prev.get("t_ms"),
+                "ms": round(
+                    float(nxt.get("t_ms") or 0.0)
+                    - float(prev.get("t_ms") or 0.0),
+                    3,
+                ),
+            }
+        )
+    by_segment: dict[str, float] = {}
+    for seg in segments:
+        by_segment[seg["segment"]] = round(
+            by_segment.get(seg["segment"], 0.0) + seg["ms"], 3
+        )
+    total = (
+        round(
+            float(events[-1].get("t_ms") or 0.0)
+            - float(events[0].get("t_ms") or 0.0),
+            3,
+        )
+        if events
+        else 0.0
+    )
+    return {
+        "journey": journey_id,
+        "events": events,
+        "segments": segments,
+        "by_segment_ms": by_segment,
+        "total_ms": total,
+    }
+
+
+def _is_event(obj) -> bool:
+    return isinstance(obj, dict) and "kind" in obj and "t_ms" in obj
+
+
+def load_journeys(payload, label: str = "journey") -> list[dict]:
+    """Normalize any accepted input shape into stitched journey dicts."""
+    if isinstance(payload, dict):
+        if isinstance(payload.get("segments"), list) and isinstance(
+            payload.get("events"), list
+        ):
+            return [payload]                      # already stitched
+        if isinstance(payload.get("journeys"), list):
+            out = []
+            for i, sub in enumerate(payload["journeys"]):
+                out.extend(load_journeys(sub, f"{label}[{i}]"))
+            return out
+        return []
+    if isinstance(payload, list):
+        if all(_is_event(e) for e in payload) and payload:
+            return [stitch_events(label, [payload])]   # raw event list
+        if payload and all(
+            isinstance(p, list) and all(_is_event(e) for e in p)
+            for p in payload
+        ):
+            return [stitch_events(label, payload)]     # per-pod partials
+        out = []
+        for i, sub in enumerate(payload):
+            out.extend(load_journeys(sub, f"{label}[{i}]"))
+        return out
+    return []
+
+
+# ---------------------------------------------------------------------------
+# analysis
+# ---------------------------------------------------------------------------
+
+
+def by_segment(journey: dict) -> dict[str, float]:
+    if isinstance(journey.get("by_segment_ms"), dict):
+        return dict(journey["by_segment_ms"])
+    totals: dict[str, float] = {}
+    for seg in journey.get("segments") or []:
+        totals[seg["segment"]] = totals.get(seg["segment"], 0.0) + (
+            seg.get("ms") or 0.0
+        )
+    return totals
+
+
+def _ttft_cutoff(events: list) -> int | None:
+    """Index of the first CLIENT-visible token edge: the decode pool's
+    ``first-step`` when the journey handed off, ``first-token``
+    otherwise. None when the journey never produced one."""
+    kinds = [str(e.get("kind")) for e in events]
+    if "first-step" in kinds:
+        return kinds.index("first-step")
+    if "first-token" in kinds:
+        return kinds.index("first-token")
+    return None
+
+
+def ttft_critical_path(journey: dict) -> tuple[str, float] | None:
+    """(dominant segment, its ms) over the journey's TTFT — the
+    timeline UP TO the first client-visible token. Segments after it
+    (a mid-decode preemption, the decode itself) never enter, so a 5 s
+    decode-phase preempt can't masquerade as a TTFT problem. Falls back
+    to the name-based filter when the payload carries no events."""
+    events = journey.get("events") or []
+    cutoff = _ttft_cutoff(events)
+    if cutoff is not None:
+        totals: dict[str, float] = {}
+        for prev, nxt in zip(events[:cutoff], events[1 : cutoff + 1]):
+            name = classify_edge(str(prev.get("kind")), str(nxt.get("kind")))
+            totals[name] = totals.get(name, 0.0) + (
+                float(nxt.get("t_ms") or 0.0) - float(prev.get("t_ms") or 0.0)
+            )
+        ttft = {k: v for k, v in totals.items() if v > 0}
+    else:
+        ttft = {
+            k: v
+            for k, v in by_segment(journey).items()
+            if k in TTFT_SEGMENTS and v > 0
+        }
+    if not ttft:
+        return None
+    name = max(ttft, key=lambda k: ttft[k])
+    return name, round(ttft[name], 3)
+
+
+def journey_flags(journey: dict, max_bounces: int = 3) -> list[str]:
+    """Per-journey anomaly flags."""
+    flags = list(journey.get("anomalies") or [])
+    totals = by_segment(journey)
+    handoff = sum(totals.get(s, 0.0) for s in HANDOFF_SEGMENTS)
+    prefill = totals.get("prefill", 0.0)
+    if handoff and prefill and handoff > prefill:
+        flags.append(
+            f"transfer-dominated TTFT: handoff cost {handoff:.1f}ms "
+            f"(export+wait+transfer+admission) exceeds prefill "
+            f"{prefill:.1f}ms — disaggregation is costing more than it "
+            f"saves on this request"
+        )
+    kinds = [str(e.get("kind")) for e in journey.get("events") or []]
+    if "preempt" in kinds and kinds.count("admit") > 1:
+        flags.append(
+            "re-prefill after preempt: the resume re-paid the prompt's "
+            "prefill — expected under KV pressure/drain, but a hot loop "
+            "of these means the pool is undersized"
+        )
+    bounces = kinds.count("bounce")
+    if bounces > max_bounces:
+        flags.append(
+            f"{bounces} replica bounces (> {max_bounces}): the routing "
+            f"target keeps moving — check fleet churn or stale router "
+            f"snapshots"
+        )
+    return flags
+
+
+def _pct(sorted_values: list[float], q: float) -> float:
+    return sorted_values[
+        min(len(sorted_values) - 1, int(q * len(sorted_values)))
+    ]
+
+
+def aggregate(journeys: list[dict]) -> dict:
+    """p50/p99 per segment over a set of journeys + the critical-path
+    histogram (which segment dominated each journey's TTFT)."""
+    samples: dict[str, list[float]] = {}
+    dominated: dict[str, int] = {}
+    for journey in journeys:
+        for name, ms in by_segment(journey).items():
+            samples.setdefault(name, []).append(ms)
+        critical = ttft_critical_path(journey)
+        if critical is not None:
+            dominated[critical[0]] = dominated.get(critical[0], 0) + 1
+    segments = {}
+    for name, values in samples.items():
+        values = sorted(values)
+        segments[name] = {
+            "n": len(values),
+            "p50_ms": round(_pct(values, 0.50), 3),
+            "p99_ms": round(_pct(values, 0.99), 3),
+        }
+    return {
+        "journeys": len(journeys),
+        "segments": segments,
+        "ttft_critical_path": dict(
+            sorted(dominated.items(), key=lambda kv: -kv[1])
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def render_waterfall(journey: dict, width: int = 40) -> str:
+    events = journey.get("events") or []
+    segments = journey.get("segments") or []
+    total = float(journey.get("total_ms") or 0.0) or 1.0
+    lines = [
+        f"== journey {journey.get('journey', '?')} ==  "
+        f"{len(events)} events over {journey.get('total_ms', 0.0):.1f}ms"
+        + ("" if journey.get("complete", True) else "  [INCOMPLETE]")
+    ]
+    if events:
+        t0 = float(events[0].get("t_ms") or 0.0)
+        for event in events:
+            offset = float(event.get("t_ms") or 0.0) - t0
+            pod = f" @{event['pod']}" if event.get("pod") else ""
+            detail = {
+                k: v
+                for k, v in event.items()
+                if k not in ("kind", "t_ms", "m_s", "seq", "pod")
+                and v is not None
+            }
+            lines.append(
+                f"  {offset:9.1f}ms  {str(event.get('kind')):16s}{pod}"
+                + (f"  {detail}" if detail else "")
+            )
+    if segments:
+        lines.append("  --")
+        for seg in segments:
+            frac = max(0.0, (seg.get("ms") or 0.0) / total)
+            bar = "█" * max(
+                1 if (seg.get("ms") or 0.0) > 0 else 0,
+                int(round(frac * width)),
+            )
+            lines.append(
+                f"  {seg['segment']:18s} {seg.get('ms', 0.0):9.1f}ms  {bar}"
+            )
+    critical = ttft_critical_path(journey)
+    if critical is not None:
+        lines.append(
+            f"  critical path: {critical[0]} ({critical[1]:.1f}ms of the "
+            f"TTFT)"
+        )
+    for flag in journey_flags(journey):
+        lines.append(f"  !! {flag}")
+    return "\n".join(lines)
+
+
+def render_aggregate(agg: dict) -> str:
+    lines = [f"== {agg['journeys']} journeys =="]
+    lines.append("  segment             n      p50        p99")
+    for name in sorted(
+        agg["segments"], key=lambda n: -agg["segments"][n]["p50_ms"]
+    ):
+        entry = agg["segments"][name]
+        lines.append(
+            f"  {name:18s} {entry['n']:4d} {entry['p50_ms']:8.1f}ms "
+            f"{entry['p99_ms']:9.1f}ms"
+        )
+    if agg["ttft_critical_path"]:
+        dominated = "  ".join(
+            f"{name}:{count}"
+            for name, count in agg["ttft_critical_path"].items()
+        )
+        lines.append(f"  TTFT dominated by   {dominated}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render stitched request journeys; attribute the "
+        "TTFT critical path"
+    )
+    parser.add_argument(
+        "files", nargs="*",
+        help="stitched journey dumps (control-plane /journey/{id} "
+        "payloads, raw event lists, or lists of either)",
+    )
+    parser.add_argument(
+        "--url", help="fetch one journey from a control-plane/pod URL"
+    )
+    parser.add_argument(
+        "--aggregate", action="store_true",
+        help="p50/p99 per segment + critical-path histogram over every "
+        "journey in the inputs (instead of one waterfall each)",
+    )
+    parser.add_argument(
+        "--max-bounces", type=int, default=3,
+        help="replica bounces beyond this are flagged (default 3)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the analysis as JSON"
+    )
+    args = parser.parse_args(argv)
+    if not args.files and not args.url:
+        parser.error("need journey dump files or --url")
+
+    journeys: list[dict] = []
+    try:
+        if args.url:
+            with urllib.request.urlopen(args.url, timeout=10) as resp:
+                journeys.extend(load_journeys(json.loads(resp.read())))
+        for path in args.files:
+            with open(path) as f:
+                journeys.extend(load_journeys(json.load(f), label=path))
+    except (OSError, ValueError) as e:
+        print(f"journey load failed: {e}", file=sys.stderr)
+        return 2
+    if not journeys:
+        print(
+            "no journeys found (expected a stitched /journey payload, a "
+            "raw event list, or a list of either)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.aggregate:
+        agg = aggregate(journeys)
+        print(json.dumps(agg, indent=2) if args.json else render_aggregate(agg))
+        return 0
+    if args.json:
+        print(
+            json.dumps(
+                [
+                    {
+                        "journey": j.get("journey"),
+                        "by_segment_ms": by_segment(j),
+                        "critical_path": ttft_critical_path(j),
+                        "flags": journey_flags(j, args.max_bounces),
+                    }
+                    for j in journeys
+                ],
+                indent=2,
+            )
+        )
+        return 0
+    for journey in journeys:
+        print(render_waterfall(journey))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

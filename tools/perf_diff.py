@@ -55,6 +55,22 @@ METRICS: dict[str, str] = {
     "gateway_ttft_p50_s": "up",
     "prefix_cache_speedup": "down",
     "recompile_count": "up",
+    # per-request journey segments (serving/journey.py, recorded by
+    # gateway_bench as `journey_segments`): every TTFT component is
+    # worse when it grows — the instrument for the split-pool bench
+    # round (ROADMAP item 3)
+    "journey_ingest_p50_s": "up",
+    "journey_ingest_p99_s": "up",
+    "journey_queue_p50_s": "up",
+    "journey_queue_p99_s": "up",
+    "journey_prefill_p50_s": "up",
+    "journey_prefill_p99_s": "up",
+    "journey_transfer_p50_s": "up",
+    "journey_transfer_p99_s": "up",
+    "journey_decode_admission_p50_s": "up",
+    "journey_decode_admission_p99_s": "up",
+    "journey_first_step_p50_s": "up",
+    "journey_first_step_p99_s": "up",
 }
 
 #: default noise band: relative change below this is never flagged
@@ -66,6 +82,22 @@ def _first(d: dict, *keys, default=None):
         if isinstance(d, dict) and d.get(key) is not None:
             return d[key]
     return default
+
+
+def _journey_metrics(section, metrics: dict) -> None:
+    """Flatten a ``journey_segments`` section ({segment: {p50_s, p99_s}})
+    into the declared ``journey_<segment>_<q>`` metric names. Segments
+    without a declared direction are ignored, never guessed."""
+    if not isinstance(section, dict):
+        return
+    for segment, values in section.items():
+        if not isinstance(values, dict):
+            continue
+        key = "journey_" + str(segment).replace("-", "_")
+        for quantile in ("p50_s", "p99_s"):
+            name = f"{key}_{quantile}"
+            if name in METRICS and values.get(quantile) is not None:
+                metrics.setdefault(name, values[quantile])
 
 
 def _walk_flight_rollups(obj, found: list[dict]) -> None:
@@ -136,8 +168,15 @@ def extract_metrics(payload) -> dict:
         prefix = detail.get("prefix_cache")
         if isinstance(prefix, dict) and prefix.get("speedup") is not None:
             metrics["prefix_cache_speedup"] = prefix["speedup"]
+        _journey_metrics(detail.get("journey_segments"), metrics)
+        for leg in detail.values():
+            if isinstance(leg, dict):
+                _journey_metrics(leg.get("journey_segments"), metrics)
         return out
 
+    # bare gateway_bench output: journey segments ride the top level
+    if isinstance(payload, dict):
+        _journey_metrics(payload.get("journey_segments"), metrics)
     # /flight dump or bare rollup(s): merge windows across engines
     rollups: list[dict] = []
     _walk_flight_rollups(payload, rollups)
