@@ -132,6 +132,7 @@ KV_QUANT_PINNED = "BENCH_KV_QUANT" in os.environ
 RUN_GATEWAY = os.environ.get("BENCH_GATEWAY", "1") != "0"
 RUN_PAGED = os.environ.get("BENCH_PAGED", "1") != "0"
 RUN_PREFIX = os.environ.get("BENCH_PREFIX", "1") != "0"
+RUN_PREFIX_WARM = os.environ.get("BENCH_PREFIX_WARM", "1") != "0"
 RUN_KV_INT8 = os.environ.get("BENCH_KV_INT8", "1") != "0"
 RUN_SPEC = os.environ.get("BENCH_SPEC", "1") != "0"
 RUN_QOS = os.environ.get("BENCH_QOS", "1") != "0"
@@ -514,6 +515,11 @@ def run_bench() -> dict:
     optional("qos_mix", RUN_QOS)
     # detail key kept from rounds 1-4 ("prefix_cache") for record tooling
     optional("prefix", RUN_PREFIX, detail_key="prefix_cache",
+             budget_cap=min(PHASE_BUDGET_S, 300))
+    # tiered prefix store (docs/PREFIX.md): N tenants share one system
+    # prompt across 2 replicas; records per-tier hits + hydrate-vs-
+    # recompute TTFT + router prefix-affinity counters
+    optional("prefix_warm", RUN_PREFIX_WARM,
              budget_cap=min(PHASE_BUDGET_S, 300))
 
     return _record(headline, detail)
@@ -1090,6 +1096,13 @@ async def _child_phase(phase: str) -> dict:
     if phase == "prefix":
         return await _phase(
             run_prefix_cache_phase(), budget_s=min(PHASE_BUDGET_S, 300)
+        )
+    if phase == "prefix_warm":
+        sys.path.insert(0, os.path.join(os.path.dirname(_BENCH_PATH), "tools"))
+        from gateway_bench import run_warm_prefix_phase
+
+        return await _phase(
+            run_warm_prefix_phase(), budget_s=min(PHASE_BUDGET_S, 300)
         )
     raise ValueError(f"unknown bench phase {phase!r}")
 

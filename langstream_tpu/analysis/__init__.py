@@ -79,6 +79,7 @@ from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
 from langstream_tpu.analysis.rules_perf import RULES as _PERF_RULES
+from langstream_tpu.analysis.rules_pfx import RULES as _PFX_RULES
 from langstream_tpu.analysis.rules_pool import RULES as _POOL_RULES
 from langstream_tpu.analysis.rules_qos import RULES as _QOS_RULES
 from langstream_tpu.analysis.rules_race import RULES as _RACE_RULES
@@ -94,6 +95,7 @@ ALL_RULES: list[Rule] = [
     *_PERF_RULES,
     *_FLEET_RULES,
     *_POOL_RULES,
+    *_PFX_RULES,
 ]
 
 #: whole-program rules (run over the ProjectIndex, not per file)

@@ -398,6 +398,67 @@ def _fetch_rows(gathered):
 ))
 
 _register(RuleExample(
+    rule="PFX801",
+    tp={
+        "langstream_tpu/serving/prefixstore.py": '''\
+import jax
+
+class PrefixStore:
+    def take_t1(self, digest_hex, engine):
+        # a T1 promotion take that syncs the device queues EVERY
+        # admission behind the dispatch in flight — and the lock queues
+        # the lookup behind whatever holds it
+        jax.block_until_ready(engine.last_out)
+        with self._lock:
+            return self._t1.pop(digest_hex, None)
+
+    def _shrink_t1(self, storage):
+        while self.t1_bytes > self.budget:
+            digest, entry = self._t1.popitem(last=False)
+            # blocking T2 I/O inside the eviction DECISION: every
+            # byte-budget walk becomes a per-pass host stall
+            storage.put(digest, open("/tmp/x", "rb").read())
+''',
+    },
+    tn={
+        "langstream_tpu/serving/prefixstore.py": '''\
+class PrefixStore:
+    def take_t1(self, digest_hex):
+        # the sanctioned shape: GIL-atomic container ops + arithmetic
+        entry = self._t1.pop(digest_hex, None)
+        if entry is not None:
+            self.t1_bytes -= entry["nbytes"]
+        return entry
+
+    def _shrink_t1(self):
+        # the eviction DECISION only moves the entry onto the handoff
+        # deque; the background hydrator does the object-storage I/O
+        while self.t1_bytes > self.budget and self._t1:
+            digest, entry = self._t1.popitem(last=False)
+            self.t1_bytes -= entry["nbytes"]
+            self._jobs.append(("put", digest, entry))
+            self._kick.set()
+
+    def _io_put(self, storage, digest, entry):
+        # hydrator thread: T2 I/O is exempt HERE by design
+        storage.put(digest, entry["blob"])
+''',
+    },
+    fix=(
+        "Keep every T0/T1 lookup, promotion take, and eviction decision "
+        "to GIL-atomic container ops plus arithmetic — they run at the "
+        "engine loop's safe point, on the admission path. Anything that "
+        "must touch object storage becomes a job on the hydrator's "
+        "handoff deque (PrefixStore._io_* processes it on the "
+        "background thread and hands the result back through the "
+        "results deque for apply_results to apply loop-side). Device "
+        "syncs belong only in the dispatch-thread closures the engine "
+        "already times (the promote scatter / demote gather _run "
+        "closures — docs/PREFIX.md)."
+    ),
+))
+
+_register(RuleExample(
     rule="FLEET601",
     tp={
         "langstream_tpu/controlplane/autoscaler.py": '''\

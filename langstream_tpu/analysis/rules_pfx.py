@@ -1,0 +1,139 @@
+"""Tiered-prefix-store rules: tier-plane discipline (PFX801).
+
+The tiered prefix store (``serving/prefixstore.py``, docs/PREFIX.md)
+sits directly on the admission path: every ``_admit`` pass may consult
+T0/T1 membership, take promotion candidates, and decide evictions at
+the loop's safe point, and the ``stats()["prefixstore"]`` section is a
+poll surface like the attribution/journey planes. PFX801 is OBS504's
+shape over that plane: **a device sync, blocking I/O, or lock
+acquisition in a T0/T1 lookup or eviction-decision path** is a red
+gate —
+
+- a lookup that blocks queues EVERY admission behind it, exactly the
+  TTFT the tiers exist to cut;
+- an eviction decision that touches the device or disk turns the
+  byte-budget walk into a per-pass host stall the flight recorder
+  would misattribute;
+- the router's prefix-affinity map runs on the gateway's produce hot
+  path — a blocking pick stalls every client.
+
+T2 object-storage I/O is **exempt by design**: it lives on the
+background hydrator thread (``PrefixStore._io_*`` methods and the
+:class:`PrefixStorage` backends), which talks to the loop exclusively
+through handoff deques. Nested defs are exempt everywhere — they are
+dispatch-thread closures (the promote scatter / demote gather), the
+same exemption OBS503/POOL701 grant.
+
+Scope: the named decision-path functions below — the store's
+loop-side surface, the BlockManager's prefix-chain surface, the
+router's prefix-map paths, and the engine's tier-maintenance surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule
+from langstream_tpu.analysis.rules_obs import _waitfree_violations
+
+#: the tier plane's decision paths, per file. The hydrator (`_io_*`)
+#: and the PrefixStorage backends are deliberately absent: their
+#: blocking I/O is the design (background thread + handoff deques).
+_PFX_FUNCS_BY_FILE = {
+    "langstream_tpu/serving/prefixstore.py": {
+        "t1_has",
+        "t2_has",
+        "hydrating",
+        "take_t1",
+        "insert_t1",
+        "_shrink_t1",
+        "note_promoted",
+        "request_hydration",
+        "apply_results",
+        "_trim_t2",
+        "drain_events",
+        "ledger",
+        "stats",
+        "prefix_digest_for_text",
+    },
+    "langstream_tpu/models/paged.py": {
+        "match_prefix",
+        "chain_digests",
+        "prefix_has",
+        "evictable_prefixes",
+        "drop_prefix",
+        "install_prefix_chain",
+        "prefix_block_count",
+    },
+    "langstream_tpu/gateway/router.py": {
+        "pick",
+        "observe",
+        "stats",
+        "_pin_tenant",
+        "_pin_prefix",
+    },
+    "langstream_tpu/serving/engine.py": {
+        "_prefix_tier_step",
+        "_prefix_demote_pending",
+        "_chain_t2_candidates",
+        "prefix_store_section",
+        "_note_prefix_pool_evict",
+        "_emit_prefix_events",
+    },
+}
+
+
+def _tier_functions(mod: Module) -> Iterator[ast.AST]:
+    named: set[str] = set()
+    for prefix, names in _PFX_FUNCS_BY_FILE.items():
+        if prefix in mod.path or mod.path.endswith(prefix):
+            named = names
+            break
+    if not named:
+        return
+    nested_fns: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if inner is not node and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested_fns.add(id(inner))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(node) in nested_fns:
+            continue
+        if node.name in named:
+            yield node
+
+
+def check_blocking_in_tier_plane(mod: Module) -> Iterator[Finding]:
+    for fn in _tier_functions(mod):
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "PFX801",
+                node,
+                f"{kind} {offender} in a prefix-tier lookup/eviction-"
+                f"decision path (`{fn.name}`): the tier plane must stay "
+                f"wait-free — a T0/T1 lookup that blocks queues every "
+                f"admission behind it, and the router's prefix map runs "
+                f"on the produce hot path; keep decisions to GIL-atomic "
+                f"container ops + arithmetic and push ALL T2 object-"
+                f"storage I/O onto the background hydrator (`_io_*` "
+                f"jobs over the handoff deques — docs/PREFIX.md)",
+            )
+
+
+RULES = [
+    Rule(
+        id="PFX801",
+        family="pfx",
+        summary="device sync, blocking I/O, or lock acquisition in a "
+        "prefix-tier lookup or eviction-decision path (T0/T1 decisions "
+        "and the router prefix map must be wait-free; T2 I/O belongs "
+        "on the background hydrator)",
+        check=check_blocking_in_tier_plane,
+    ),
+]

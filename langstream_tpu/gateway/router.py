@@ -18,6 +18,15 @@ placed to serve it. This module is the gateway's half of that loop:
   the replica stays eligible and the affinity entry is fresh. Affinity
   is advisory: an ineligible replica breaks it immediately and the
   session re-pins to the new least-loaded pick.
+- **Prefix affinity** on the chained prompt-prefix digest the gateway
+  stamps (``langstream-prefix-digest``, serving/prefixstore.py): repeat
+  traffic for one shared system prompt lands on the replica whose
+  tiered prefix store already holds its blocks — across DIFFERENT
+  tenants, which tenant affinity cannot see (N tenants sharing a
+  preamble is exactly the shape the prefix tiers exist for,
+  docs/PREFIX.md). More specific than the tenant pin, so it is
+  consulted first; prefix-less traffic takes the pre-existing path
+  bit for bit.
 - The choice is stamped as the ``langstream-replica`` record header; the
   serving agent's consumer honors it (``runtime/runner.py``): a replica
   that reads a record stamped for a sibling re-produces it back to the
@@ -73,9 +82,15 @@ class ReplicaRouter:
         self._observed_at: float | None = None
         # tenant -> [replica, pinned_at]
         self._affinity: "OrderedDict[str, list]" = OrderedDict()
+        # prompt-prefix digest -> [replica, pinned_at] (docs/PREFIX.md):
+        # bounded like the tenant map — digests derive from prompt text,
+        # which clients control
+        self._prefix_affinity: "OrderedDict[str, list]" = OrderedDict()
         self.picks = 0
         self.affinity_hits = 0
         self.affinity_rerouted = 0
+        self.prefix_hits = 0
+        self.prefix_rerouted = 0
         # disaggregated pools (docs/DISAGG.md): the phase of the latest
         # pick ("prefill"/"decode"/"any") — engine_top's split-fleet view
         self.last_pick_phase: str | None = None
@@ -151,6 +166,7 @@ class ReplicaRouter:
         tenant: str | None = None,
         phase: str | None = None,
         exclude: Any = (),
+        prefix: str | None = None,
     ) -> str | None:
         """The replica for one record: the tenant's pinned replica while
         it stays eligible and fresh, else the least-loaded eligible
@@ -163,7 +179,14 @@ class ReplicaRouter:
         ``"decode"`` for KV handoff targets; it is a no-op while every
         replica is ``combined``, so a classic fleet's routing stays
         bit-for-bit. ``exclude`` names replicas the caller already tried
-        (a decode replica that answered 503 — retry the next one)."""
+        (a decode replica that answered 503 — retry the next one).
+
+        ``prefix`` (the gateway's chained prompt-prefix digest,
+        docs/PREFIX.md) pins MORE specifically than the tenant: repeat
+        traffic for one shared system prompt returns to the replica
+        whose prefix tiers hold its blocks, whatever tenant sent it.
+        Consulted before the tenant pin; ``None`` (prefix-less traffic)
+        leaves the pre-existing choice bit for bit."""
         if not self.fresh():
             return None
         exclude = set(exclude or ())
@@ -185,6 +208,31 @@ class ReplicaRouter:
             # would thrash the prefill pin instead
             self.picks += 1
             return min(candidates)[1]
+        if prefix:
+            pinned = self._prefix_affinity.get(prefix)
+            if pinned is not None:
+                replica, pinned_at = pinned
+                snap = self._replicas.get(replica)
+                if (
+                    snap is not None
+                    and self._eligible(snap)
+                    and self._phase_ok(snap, phase)
+                    and replica not in exclude
+                    and now - pinned_at <= self.affinity_ttl_s
+                ):
+                    # the replica already holding this prompt's prefix
+                    # blocks (T0/T1/T2 — docs/PREFIX.md): warm TTFT
+                    # beats load spread for shared-preamble traffic
+                    pinned[1] = now
+                    self._prefix_affinity.move_to_end(prefix)
+                    self.picks += 1
+                    self.prefix_hits += 1
+                    if tenant:
+                        # keep the tenant pin converged on the same
+                        # replica so the two affinity maps never fight
+                        self._pin_tenant(tenant, replica, now)
+                    return replica
+                self.prefix_rerouted += 1
         if tenant:
             pinned = self._affinity.get(tenant)
             if pinned is not None:
@@ -203,16 +251,29 @@ class ReplicaRouter:
                     self._affinity.move_to_end(tenant)
                     self.picks += 1
                     self.affinity_hits += 1
+                    if prefix:
+                        self._pin_prefix(prefix, replica, now)
                     return replica
                 self.affinity_rerouted += 1
         choice = min(candidates)[1]
         self.picks += 1
         if tenant:
-            self._affinity[tenant] = [choice, now]
-            self._affinity.move_to_end(tenant)
-            while len(self._affinity) > self.MAX_AFFINITY:
-                self._affinity.popitem(last=False)
+            self._pin_tenant(tenant, choice, now)
+        if prefix:
+            self._pin_prefix(prefix, choice, now)
         return choice
+
+    def _pin_tenant(self, tenant: str, replica: str, now: float) -> None:
+        self._affinity[tenant] = [replica, now]
+        self._affinity.move_to_end(tenant)
+        while len(self._affinity) > self.MAX_AFFINITY:
+            self._affinity.popitem(last=False)
+
+    def _pin_prefix(self, prefix: str, replica: str, now: float) -> None:
+        self._prefix_affinity[prefix] = [replica, now]
+        self._prefix_affinity.move_to_end(prefix)
+        while len(self._prefix_affinity) > self.MAX_AFFINITY:
+            self._prefix_affinity.popitem(last=False)
 
     # -- introspection ---------------------------------------------------
 
@@ -249,6 +310,12 @@ class ReplicaRouter:
             "affinity_hits": self.affinity_hits,
             "affinity_rerouted": self.affinity_rerouted,
             "pinned_tenants": len(self._affinity),
+            # prefix-affinity counters (docs/PREFIX.md): repeat shared-
+            # preamble traffic landing back on the replica holding its
+            # blocks vs pins broken by an ineligible/stale replica
+            "prefix_hits": self.prefix_hits,
+            "prefix_rerouted": self.prefix_rerouted,
+            "pinned_prefixes": len(self._prefix_affinity),
         }
 
 

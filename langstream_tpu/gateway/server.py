@@ -40,6 +40,10 @@ from langstream_tpu.gateway.auth import (
     get_auth_provider,
 )
 from langstream_tpu.gateway.router import REPLICA_HEADER, ReplicaRouter
+from langstream_tpu.serving.prefixstore import (
+    PREFIX_HEADER,
+    prefix_digest_for_text,
+)
 from langstream_tpu.serving.journey import JOURNEYS
 from langstream_tpu.serving.qos import (
     QosSpec,
@@ -143,18 +147,26 @@ class GatewayRegistry:
         return self._routers.get((tenant, app_id))
 
     def route_replica(
-        self, tenant: str, app_id: str, qos_tenant: str | None
+        self,
+        tenant: str,
+        app_id: str,
+        qos_tenant: str | None,
+        prefix: str | None = None,
     ) -> str | None:
         """The replica one produced record should land on (None = don't
         stamp): least-loaded eligible member, with session affinity on
-        the QoS tenant so a conversation keeps its prefix-cache blocks.
-        Gateway-produced records are NEW requests, so a disaggregated
-        fleet routes them to the prefill pool (phase filtering is a
-        no-op while every replica is combined — docs/DISAGG.md)."""
+        the QoS tenant so a conversation keeps its prefix-cache blocks,
+        and — more specifically — prefix affinity on the stamped
+        prompt-prefix digest so shared-preamble traffic from ANY tenant
+        returns to the replica whose prefix tiers hold its blocks
+        (docs/PREFIX.md). Gateway-produced records are NEW requests, so
+        a disaggregated fleet routes them to the prefill pool (phase
+        filtering is a no-op while every replica is combined —
+        docs/DISAGG.md)."""
         router = self._routers.get((tenant, app_id))
         if router is None:
             return None
-        return router.pick(qos_tenant, phase="prefill")
+        return router.pick(qos_tenant, phase="prefill", prefix=prefix)
 
     def qos_limiter(self, tenant: str, app_id: str) -> TenantLimiter | None:
         """The app's gateway-side QoS limiter (None when the app declares
@@ -424,6 +436,7 @@ class GatewayServer:
         app_id: str,
         params: dict[str, Any],
         principal: dict[str, Any],
+        value: Any = None,
     ) -> dict[str, Any]:
         """Stamp the routing choice onto one produced record (in place).
         Per-message, not per-connection: load shifts and affinity pins
@@ -435,12 +448,29 @@ class GatewayServer:
         would funnel all anonymous traffic onto one replica, defeating
         least-loaded routing exactly in the common dev/bench setup. A
         client-supplied stamp is honored — explicit targeting (debug,
-        pinned benchmarks) beats the router's heuristic."""
+        pinned benchmarks) beats the router's heuristic.
+
+        ``value`` is the record's prompt payload: when it is long
+        enough, its chained prefix digest is stamped as the
+        ``langstream-prefix-digest`` header and routes by prefix
+        affinity — N tenants sharing one system prompt converge on the
+        replica whose prefix tiers hold its blocks (docs/PREFIX.md).
+        Short or absent values stamp nothing and route exactly as
+        before."""
+        prefix = prefix_digest_for_text(value)
+        if prefix is not None and PREFIX_HEADER not in headers:
+            headers[PREFIX_HEADER] = prefix
         if REPLICA_HEADER in headers:
             return headers
         qos_tenant, _ = self._qos_identity(params, principal)
         affinity = qos_tenant if qos_tenant != "anonymous" else None
-        replica = self.registry.route_replica(tenant, app_id, affinity)
+        if prefix is not None:
+            replica = self.registry.route_replica(
+                tenant, app_id, affinity, prefix=prefix
+            )
+        else:
+            # prefix-less traffic keeps the pre-tier call shape exactly
+            replica = self.registry.route_replica(tenant, app_id, affinity)
         if replica is not None:
             headers[REPLICA_HEADER] = replica
         return headers
@@ -603,7 +633,10 @@ class GatewayServer:
                         {**(payload.get("headers") or {}), **inject},
                         "gateway.produce",
                     )
-                    self._stamp_replica(headers, tenant, app_id, params, principal)
+                    self._stamp_replica(
+                        headers, tenant, app_id, params, principal,
+                        value=payload.get("value"),
+                    )
                     retry = (
                         limiter.admit_request(qos_tenant)
                         if limiter is not None
@@ -665,7 +698,10 @@ class GatewayServer:
         headers, span = self._traced_headers(
             {**(payload.get("headers") or {}), **inject}, "gateway.produce"
         )
-        self._stamp_replica(headers, tenant, app_id, params, principal)
+        self._stamp_replica(
+            headers, tenant, app_id, params, principal,
+            value=payload.get("value"),
+        )
         if limiter is not None:
             retry = limiter.admit_request(qos_tenant)
             if retry is not None:
@@ -796,7 +832,10 @@ class GatewayServer:
                         {**(payload.get("headers") or {}), **inject},
                         "gateway.chat",
                     )
-                    self._stamp_replica(headers, tenant, app_id, params, principal)
+                    self._stamp_replica(
+                        headers, tenant, app_id, params, principal,
+                        value=payload.get("value"),
+                    )
                     retry = (
                         limiter.admit_request(qos_tenant)
                         if limiter is not None
@@ -971,7 +1010,10 @@ class GatewayServer:
             },
             "gateway.service",
         )
-        self._stamp_replica(headers, tenant, app_id, params, principal)
+        self._stamp_replica(
+            headers, tenant, app_id, params, principal,
+            value=payload.get("value"),
+        )
         self._journey_produce(headers)
         try:
             # `with span:` so a broker failure mid-write/read still closes

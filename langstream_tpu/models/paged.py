@@ -216,6 +216,11 @@ class BlockManager:
         self.layout = layout
         self._free = list(range(layout.num_blocks - 1, 0, -1))  # block 0 reserved
         self._reserved = 0
+        # tiered prefix store hook (serving/prefixstore.py): called with
+        # (digest_hex, block) when pool pressure organically evicts a
+        # cached prefix block WITHOUT a demotion — the tier ledgers must
+        # see every byte leave, never silently
+        self.on_prefix_evict = None
         # per-slot: shared (adopted, refcounted) prefix blocks + owned tail
         self._slot_shared: list[list[int]] = [[] for _ in range(slots)]
         self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
@@ -253,15 +258,41 @@ class BlockManager:
             prev = h.digest()
             yield prev
 
-    def match_prefix(self, prompt_tokens) -> tuple[list[int], int]:
+    def chain_digests(self, prompt_tokens, limit: int | None = None):
+        """The prompt's chained full-block digests as a list (the lazy
+        :meth:`_digests` walk, bounded). ``limit`` defaults to the same
+        ``(len(prompt)-1)//block_size`` bound :meth:`match_prefix` uses —
+        at least one token must prefill to produce logits. Wait-free
+        beyond the hashing itself (PFX801's T0 lookup path)."""
+        bs = self.layout.block_size
+        if limit is None:
+            limit = (len(prompt_tokens) - 1) // bs
+        out: list[bytes] = []
+        for i, d in enumerate(self._digests(prompt_tokens)):
+            if i >= limit:
+                break
+            out.append(d)
+        return out
+
+    def prefix_has(self, digest: bytes) -> bool:
+        """Whether the T0 cache holds a block for this chain digest."""
+        return digest in self._prefix
+
+    def match_prefix(
+        self, prompt_tokens, digests=None
+    ) -> tuple[list[int], int]:
         """Longest cached chain covering at most ``len(prompt)-1`` tokens
         (at least one token must prefill to produce logits). Returns
         (blocks, reused_token_count) WITHOUT claiming them — call
-        :meth:`adopt_prefix` after admission."""
+        :meth:`adopt_prefix` after admission. ``digests`` lets a caller
+        that already walked :meth:`chain_digests` (the tiered store's
+        admission path hashes the chain once and shares it) skip
+        re-hashing the prompt."""
         bs = self.layout.block_size
         limit = (len(prompt_tokens) - 1) // bs
         blocks: list[int] = []
-        for i, d in enumerate(self._digests(prompt_tokens)):
+        walk = digests if digests is not None else self._digests(prompt_tokens)
+        for i, d in enumerate(walk):
             if i >= limit:
                 break
             b = self._prefix.get(d)
@@ -322,8 +353,110 @@ class BlockManager:
             if parent and parent in self._nchildren:
                 self._nchildren[parent] -= 1
             self._unref(b)
+            if self.on_prefix_evict is not None:
+                # pool pressure dropped a cached block with no demotion:
+                # the tier ledgers record the loss (serving/prefixstore.py)
+                self.on_prefix_evict(d.hex(), b)
             return True
         return False
+
+    # -- tiered prefix store surface (serving/prefixstore.py) ----------
+    # Demotion picks LRU cache-only LEAF blocks (the same candidates
+    # _evict_one would drop), the engine gathers their rows to host on
+    # the dispatch thread, then drop_prefix() frees them; promotion
+    # allocates fresh blocks via install_prefix_chain() and the engine
+    # scatters the T1 rows back in. All decision paths are wait-free
+    # (PFX801): dict walks and list ops, no I/O, no device syncs.
+
+    def evictable_prefixes(
+        self, max_n: int
+    ) -> list[tuple[bytes, int, bytes]]:
+        """Up to ``max_n`` demotion candidates, LRU-first: cache-only
+        (refcount 1) leaf blocks as ``(digest, block, parent_digest)``.
+        Pure read — nothing is claimed until :meth:`drop_prefix`."""
+        out: list[tuple[bytes, int, bytes]] = []
+        for d, b in self._prefix.items():  # insertion order = LRU
+            if len(out) >= max_n:
+                break
+            if self._refs.get(b, 0) != 1:
+                continue
+            if self._nchildren.get(d, 0) > 0:
+                continue
+            out.append((d, b, self._parent.get(d, b"")))
+        return out
+
+    def drop_prefix(self, digest: bytes) -> int | None:
+        """Targeted :meth:`_evict_one`: free ONE cached block by digest
+        (cache-only leaves only — a block a slot still reads, or an
+        interior chain link, refuses with ``None``). The demotion path
+        calls this only AFTER the block's rows are safely on host."""
+        b = self._prefix.get(digest)
+        if b is None:
+            return None
+        if self._refs.get(b, 0) != 1:
+            return None
+        if self._nchildren.get(digest, 0) > 0:
+            return None
+        del self._prefix[digest]
+        del self._block_digest[b]
+        parent = self._parent.pop(digest, b"")
+        self._nchildren.pop(digest, None)
+        if parent and parent in self._nchildren:
+            self._nchildren[parent] -= 1
+        self._unref(b)
+        return b
+
+    def install_prefix_chain(
+        self, chain: list[tuple[bytes, bytes]]
+    ) -> list[int] | None:
+        """Allocate + publish fresh cache-owned blocks for a promoted
+        chain segment (``[(digest, parent_digest), ...]`` in chain
+        order; the first parent must already be cached or empty). The
+        engine scatters the promoted rows into the returned blocks
+        before any admission adopts them. All-or-nothing: an allocation
+        failure mid-chain rolls the published links back and returns
+        ``None`` (the promotion falls back to cold compute)."""
+        if not chain:
+            return []
+        first_parent = chain[0][1]
+        if first_parent and first_parent not in self._prefix:
+            return None  # broken linkage: would orphan the whole segment
+        installed: list[tuple[bytes, int, bytes]] = []
+        try:
+            for digest, parent in chain:
+                if digest in self._prefix:
+                    # raced with a concurrent register: keep the cached
+                    # block, roll back our partial segment
+                    raise RuntimeError("digest already cached")
+                # mark the parent interior BEFORE allocating: _alloc may
+                # evict a cache-only leaf to find space, and the parent
+                # must not be that leaf or the new link would orphan
+                if parent:
+                    self._nchildren[parent] = (
+                        self._nchildren.get(parent, 0) + 1
+                    )
+                try:
+                    b = self._alloc()  # refcount 1: cache-owned
+                except RuntimeError:
+                    if parent and parent in self._nchildren:
+                        self._nchildren[parent] -= 1
+                    raise
+                self._prefix[digest] = b
+                self._block_digest[b] = digest
+                self._parent[digest] = parent
+                self._nchildren.setdefault(digest, 0)
+                installed.append((digest, b, parent))
+        except RuntimeError:
+            for digest, b, parent in reversed(installed):
+                del self._prefix[digest]
+                del self._block_digest[b]
+                self._parent.pop(digest, None)
+                self._nchildren.pop(digest, None)
+                if parent and parent in self._nchildren:
+                    self._nchildren[parent] -= 1
+                self._unref(b)
+            return None
+        return [b for _, b, _ in installed]
 
     # -- refcounted block lifecycle (every live block holds ≥1 ref:
     # its owning/adopting slots and, once published, the cache) ---------

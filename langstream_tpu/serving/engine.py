@@ -83,6 +83,7 @@ from langstream_tpu.serving.attribution import (
 from langstream_tpu.serving.flight import FlightRecorder
 from langstream_tpu.serving.journey import JOURNEYS
 from langstream_tpu.serving.health import EngineWatchdog, SloSpec, SloTracker
+from langstream_tpu.serving.prefixstore import PrefixStore, PrefixStoreSpec
 from langstream_tpu.serving.profiling import (
     ProfilerHooks,
     detect_generation,
@@ -265,6 +266,15 @@ class ServingConfig:
     # role from the StatefulSet split's LS_POOL_ROLE env (from_dict
     # fallback) so both pools share one agent config secret.
     pool_role: str = "combined"
+    # tiered prefix-KV store (serving/prefixstore.py, docs/PREFIX.md):
+    # None keeps the single-replica HBM-only prefix cache, bit for bit.
+    # A spec layers T1 (host-RAM spill under a byte budget) and T2
+    # (object storage via the kvtransfer wire format) under the T0
+    # cache: eviction demotes T0→T1→T2, admission promotes/hydrates on
+    # hit, and cross-replica cold starts of shared system prompts
+    # hydrate instead of recomputing. Requires kv-layout=paged with
+    # prefix-cache on.
+    prefix_store: "PrefixStoreSpec | None" = None
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -300,6 +310,11 @@ class ServingConfig:
             "dense-kernel": self.dense_kernel,
             "prefix-cache": self.prefix_cache,
             "prefix-cache-max-suffix": self.prefix_cache_max_suffix,
+            "prefix-store": (
+                self.prefix_store.to_dict()
+                if self.prefix_store is not None
+                else None
+            ),
             "prefill-chunk": self.prefill_chunk,
             "speculative-drafts": self.speculative_drafts,
             "model-dtype": self.model_dtype,
@@ -359,6 +374,9 @@ class ServingConfig:
                     "prefix-cache-max-suffix",
                     d.get("prefix_cache_max_suffix", 4096),
                 )
+            ),
+            prefix_store=PrefixStoreSpec.from_dict(
+                d.get("prefix-store", d.get("prefix_store"))
             ),
             prefill_chunk=int(
                 d.get("prefill-chunk", d.get("prefill_chunk", 0))
@@ -445,6 +463,10 @@ class _Request:
     # happened (feeds the resume-latency histogram)
     preemptions: int = 0
     preempt_time: float | None = None
+    # tiered prefix store (serving/prefixstore.py): True once admission
+    # has stashed this request for a T2 hydration — it never stashes
+    # twice, so a failed/timed-out hydration falls back to cold compute
+    hydrate_attempted: bool = False
     # KV handoff (docs/DISAGG.md): True for a request admitted through
     # /kv/import on a decode-pool engine — its KV state arrived over the
     # wire, so admission skipped prefill entirely (request_timings carry
@@ -1021,6 +1043,62 @@ class TpuServingEngine:
                 "in-transit", "slack",
             )
         }
+        # tiered prefix store (serving/prefixstore.py, docs/PREFIX.md):
+        # T1 host-RAM spill + T2 object storage under the T0 prefix
+        # cache. Constructed only for a paged engine with the cache on
+        # (validated above); requests stalled on a T2 hydration are
+        # stashed OFF the scheduler so they never head-block admission.
+        self.prefix_store: PrefixStore | None = None
+        self._prefix_hydrating: list = []  # (request, deadline_m, digests)
+        self.prefix_t0_evictions = 0
+        self._m_prefix_tier: dict[str, Any] = {}
+        if (
+            config.prefix_store is not None
+            and config.prefix_store.enabled
+            and self.block_mgr is not None
+            and config.prefix_cache
+        ):
+            self.prefix_store = PrefixStore(
+                config.prefix_store,
+                fingerprint=self.kv_fingerprint(),
+                block_bytes=self._kv_block_bytes,
+                rows_per_block=self.paged_layout.block_size,
+            )
+            # pool-pressure evictions bypass demotion: record the loss
+            self.block_mgr.on_prefix_evict = self._note_prefix_pool_evict
+            self._m_prefix_tier = {
+                "t0_bytes": reporter.gauge(
+                    "prefix_tier_t0_bytes",
+                    "HBM bytes held by cached prefix blocks (the paged "
+                    "pool's prefix sub-owner; budget = prefix-store "
+                    "t0-bytes)",
+                ),
+                "t1_bytes": reporter.gauge(
+                    "prefix_tier_t1_bytes",
+                    "host-RAM bytes held by T1 spilled prefix blocks",
+                ),
+                "t2_bytes": reporter.gauge(
+                    "prefix_tier_t2_bytes",
+                    "object-storage payload bytes indexed in T2",
+                ),
+                "t1_hits": reporter.counter(
+                    "prefix_t1_promotions_total",
+                    "prefix blocks promoted T1→T0 at admission",
+                ),
+                "t2_hits": reporter.counter(
+                    "prefix_t2_hydrations_total",
+                    "prefix blocks hydrated T2→T1 for an admission",
+                ),
+                "demotions": reporter.counter(
+                    "prefix_demotions_total",
+                    "prefix blocks demoted down-tier (T0→T1 and T1→T2)",
+                ),
+                "evictions": reporter.counter(
+                    "prefix_evictions_total",
+                    "prefix blocks evicted from any tier (bytes left the "
+                    "store — counted, never silent)",
+                ),
+            }
 
     # ------------------------------------------------------------------
     # model + jit setup
@@ -1114,6 +1192,19 @@ class TpuServingEngine:
                 "KV handoff plane serializes paged blocks; a dense cache "
                 "has no block tables to hand off)"
             )
+        if self.config.prefix_store is not None and self.config.prefix_store.enabled:
+            if self.config.kv_layout != "paged":
+                raise ValueError(
+                    "prefix-store requires kv-layout=paged (the tiers "
+                    "demote/promote content-addressed pool blocks; a dense "
+                    "cache has none)"
+                )
+            if not self.config.prefix_cache:
+                raise ValueError(
+                    "prefix-store requires prefix-cache=true (T0 IS the "
+                    "automatic prefix cache; without it there is nothing "
+                    "to demote or promote)"
+                )
         if self.config.prefill_chunk > 0 and self.config.kv_layout != "paged":
             raise ValueError(
                 "prefill-chunk requires kv-layout=paged (chunked prefill "
@@ -2253,6 +2344,11 @@ class TpuServingEngine:
         slo = self.slo_status()
         if slo is not None:
             out["slo"] = slo
+        if self.prefix_store is not None:
+            # tiered prefix store: per-tier bytes/budgets, hit and
+            # demotion/eviction counters, exact byte ledger
+            # (docs/PREFIX.md)
+            out["prefixstore"] = self.prefix_store_section()
         if self.block_mgr is not None:
             out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
         if self.config.speculative_drafts > 0:
@@ -2273,6 +2369,8 @@ class TpuServingEngine:
             await self._loop_task
         if self._lockstep is not None:
             self._lockstep.close()
+        if self.prefix_store is not None:
+            self.prefix_store.close()
         # wait=True: the loop task above is done, so the executor queue is
         # empty or finishing its last closure — joining it here is what
         # makes the reference drops below race-free (the dispatch thread
@@ -2347,13 +2445,18 @@ class TpuServingEngine:
                 self.scheduler.empty()
                 and all(s.free for s in self.slots)
                 and self._pending_chunk is None
+                and not self._prefix_hydrating
             ):
                 break
             await asyncio.sleep(0.02)
-        leftovers = self.scheduler.qsize() + sum(
-            1
-            for s in self.slots
-            if s.request is not None and not s.request.future.done()
+        leftovers = (
+            self.scheduler.qsize()
+            + len(self._prefix_hydrating)
+            + sum(
+                1
+                for s in self.slots
+                if s.request is not None and not s.request.future.done()
+            )
         )
         if leftovers:
             # grace exhausted: shed the remainder loudly. _fail_inflight
@@ -2386,6 +2489,18 @@ class TpuServingEngine:
         drain wait; the preempt/resume round-trip is what makes a
         drained generation byte-identical to an undisturbed one."""
         requeued = 0
+        # requests stashed awaiting a T2 prefix hydration rejoin the
+        # queue NOW (cold compute if their blobs never landed): a drain
+        # must serve or shed every accepted request, and a stash that
+        # outlives the loop would strand its future. Reversed: each
+        # requeues at the FRONT, so newest-first keeps arrival order.
+        for request, _deadline, _digests in reversed(self._prefix_hydrating):
+            if request.future.done():
+                continue
+            self._journey(request, "hydrate-done", timeout=True, drain=True)
+            self.scheduler.requeue_front(request)
+            requeued += 1
+        self._prefix_hydrating = []
         for slot_id, slot in enumerate(self.slots):
             request = slot.request
             if request is None or request.future.done():
@@ -2946,6 +3061,11 @@ class TpuServingEngine:
         self.watchdog.beat(self.scheduler.qsize())
         while not self._stop:
             try:
+                if self.prefix_store is not None:
+                    # tier bookkeeping first: hydrations that landed
+                    # requeue at class front, so the admission passes
+                    # below see them immediately (docs/PREFIX.md)
+                    self._prefix_tier_step()
                 if self._pending_imports:
                     # KV handoff imports land at the loop's safe point,
                     # exactly like admission: a free slot + a worst-case
@@ -2978,6 +3098,11 @@ class TpuServingEngine:
                     # re-run admission so the waiter lands this pass
                     if self._maybe_preempt():
                         await self._admit(loop)
+                if self.prefix_store is not None:
+                    # T0 byte-budget demotions ride the same safe point
+                    # (the pending chunk above is settled, so the gather
+                    # reads stable pool contents)
+                    await self._demote_prefix_blocks(loop)
                 if self._has_prefilling():
                     # one bounded chunk per loop pass: long prefills make
                     # progress without stalling the decode bursts below
@@ -2998,8 +3123,23 @@ class TpuServingEngine:
                 if not active:
                     if self.scheduler.empty() and not self._has_prefilling():
                         self._wake.clear()
+                        # a stashed hydration resolves on the hydrator
+                        # thread, and a T0 cache over its byte budget
+                        # has demotions to drain: poll tightly while
+                        # either is pending so TTFT pays milliseconds
+                        # (hydration) and spilled blocks reach the
+                        # durable tier promptly instead of one bounded
+                        # batch per idle second
+                        idle_s = (
+                            0.02
+                            if self._prefix_hydrating
+                            or self._prefix_demote_pending()
+                            else 1.0
+                        )
                         try:
-                            await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                            await asyncio.wait_for(
+                                self._wake.wait(), timeout=idle_s
+                            )
                         except asyncio.TimeoutError:
                             pass
                         # the whole gap was engine idle time: record it so
@@ -3085,6 +3225,14 @@ class TpuServingEngine:
                 request.future.set_exception(error)
                 self._journey(request, "fail", error=error_text)
         self._pending_imports.clear()
+        for stashed in self._prefix_hydrating:
+            request = stashed[0]
+            if not request.future.done():
+                request.future.set_exception(error)
+                self._journey(request, "fail", error=error_text)
+                if not request.warmup:
+                    self._slo_record("availability", False)
+        self._prefix_hydrating.clear()
         self._pending_emits.clear()
         self._finished_requests.clear()
 
@@ -3193,6 +3341,300 @@ class TpuServingEngine:
                 attributes={"generated": len(request.generated)},
             )
         request.preempt_time = None
+
+    # ------------------------------------------------------------------
+    # tiered prefix store (serving/prefixstore.py, docs/PREFIX.md)
+    # ------------------------------------------------------------------
+
+    def _note_prefix_pool_evict(self, digest_hex: str, block: int) -> None:
+        """Pool pressure organically evicted a cached prefix block with
+        no demotion (BlockManager._evict_one): record the T0 loss so the
+        tier ledgers never lose bytes silently. Wait-free: a counter
+        bump and a flight append."""
+        self.prefix_t0_evictions += 1
+        if self._m_prefix_tier:
+            self._m_prefix_tier["evictions"](1)
+        self.flight.event(
+            "prefix-evict",
+            tier="t0",
+            digest=digest_hex[:16],
+            bytes=self._kv_block_bytes,
+            reason="pool-pressure",
+        )
+
+    def _emit_prefix_events(self) -> None:
+        """Drain the store's pending event feed into the flight ring and
+        mirror each transition onto its Prometheus counter — the ONE
+        emission path, so the scrape surface can never drift from the
+        flight events (wait-free: appends + counter bumps, PFX801)."""
+        for kind, detail in self.prefix_store.drain_events():
+            self.flight.event(kind, **detail)
+            if not self._m_prefix_tier:
+                continue
+            if kind == "prefix-demote":
+                self._m_prefix_tier["demotions"](1)
+            elif kind == "prefix-evict":
+                self._m_prefix_tier["evictions"](1)
+            elif kind == "prefix-promote":
+                self._m_prefix_tier["t1_hits"](detail.get("blocks") or 1)
+            elif (
+                kind == "prefix-hydrate"
+                and detail.get("stage") == "fetched"
+            ):
+                self._m_prefix_tier["t2_hits"](1)
+
+    def _prefix_tier_step(self) -> None:
+        """Loop-safe-point tier bookkeeping (wait-free, PFX801): apply
+        the hydrator's results, emit the store's pending flight events,
+        and settle the hydration stash — a request whose T2 fetches
+        landed in T1 (or timed out / failed) requeues at the FRONT of
+        its class so the admission pass right after this finds it."""
+        store = self.prefix_store
+        if store is None:
+            return
+        store.apply_results()
+        self._emit_prefix_events()
+        if not self._prefix_hydrating:
+            return
+        now = time.monotonic()
+        still_waiting = []
+        # reversed: each settled request requeues at the FRONT, so
+        # walking newest-first leaves the oldest stashed request at the
+        # actual head — arrival order survives a same-pass settle burst
+        for request, deadline, digests in reversed(self._prefix_hydrating):
+            if request.future.cancelled():
+                self._journey(request, "cancelled", stage="prefix-hydrate")
+                continue
+            ready = all(store.t1_has(d) for d in digests)
+            pending = any(store.hydrating(d) for d in digests)
+            if not ready and pending and now < deadline:
+                still_waiting.append((request, deadline, digests))
+                continue
+            # ready, failed, or timed out: admission decides what the
+            # T1 tier can actually cover — a partial hydration still
+            # promotes its landed blocks and prefills the rest
+            hit = sum(1 for d in digests if store.t1_has(d))
+            timed_out = not ready and now >= deadline
+            if timed_out:
+                store.hydrate_failures += 1
+            self.flight.event(
+                "prefix-hydrate",
+                stage="timeout" if timed_out else "done",
+                blocks=hit,
+                requested=len(digests),
+            )
+            self._journey(
+                request, "hydrate-done",
+                blocks=hit, requested=len(digests),
+                timeout=timed_out,
+            )
+            self.scheduler.requeue_front(request)
+        still_waiting.reverse()  # restore arrival order in the stash
+        self._prefix_hydrating = still_waiting
+
+    def _prefix_demote_pending(self) -> bool:
+        """Whether the T0 prefix cache sits over its byte budget with
+        demotion candidates available — the loop polls tightly while
+        true so spill drains promptly. Wait-free (PFX801)."""
+        store = self.prefix_store
+        if store is None or store.spec.t0_bytes is None:
+            return False
+        if (
+            self.block_mgr.prefix_block_count() * self._kv_block_bytes
+            <= store.spec.t0_bytes
+        ):
+            return False
+        return bool(self.block_mgr.evictable_prefixes(1))
+
+    def _chain_t2_candidates(self, chain: list[bytes]) -> list[str]:
+        """The prompt-chain digests an admission should WAIT for: the
+        consecutive run, starting where T0+T1 coverage ends, of digests
+        the T2 index knows. ``chain`` is the admission's shared
+        :meth:`BlockManager.chain_digests` walk. Empty = nothing worth
+        stashing for. Wait-free: dict membership only (PFX801)."""
+        store = self.prefix_store
+        out: list[str] = []
+        for d in chain:
+            if self.block_mgr.prefix_has(d):
+                continue
+            h = d.hex()
+            if store.t1_has(h):
+                continue
+            if store.t2_has(h) or store.hydrating(h):
+                out.append(h)
+            else:
+                break  # chain gap: deeper links are unreachable anyway
+        return out
+
+    async def _promote_prefix(
+        self, loop, request: "_Request", chain: list[bytes]
+    ) -> int:
+        """Promote the T1 run extending this prompt's T0 chain back into
+        freshly allocated pool blocks (T1→T0): take the entries, install
+        cache-owned blocks, and scatter the host rows in on the dispatch
+        thread (the kvtransfer pack path — one timed dispatch, donated
+        pools rebound there like every other dispatch closure). After
+        this, the ordinary ``match_prefix`` walk sees the longer chain
+        and the suffix prefill shrinks accordingly. Returns the number
+        of blocks promoted (0 = nothing to do or no pool space)."""
+        store = self.prefix_store
+        run: list[tuple[bytes, bytes]] = []  # (digest, parent)
+        prev = b""
+        for d in chain:
+            if self.block_mgr.prefix_has(d):
+                prev = d
+                continue
+            if run or store.t1_has(d.hex()):
+                if not store.t1_has(d.hex()):
+                    break
+                run.append((d, prev))
+                prev = d
+            else:
+                break
+        if not run:
+            return 0
+        entries = []
+        for d, _parent in run:
+            entry = store.take_t1(d.hex())
+            if entry is None:  # raced with a shrink: stop the run here
+                run = run[: len(entries)]
+                break
+            entries.append(entry)
+        if not entries:
+            return 0
+        blocks = self.block_mgr.install_prefix_chain(run)
+        if blocks is None:
+            # no pool space even after eviction: put the entries back
+            # (MRU — they were just wanted) and compute cold
+            for (d, parent), entry in zip(run, entries):
+                store.insert_t1(
+                    d.hex(), parent.hex() if parent else "",
+                    entry["arrays"], source="t2",
+                )
+            return 0
+        bs = self.paged_layout.block_size
+        nbytes = sum(e["nbytes"] for e in entries)
+        rows = len(blocks) * bs
+        # shape-static scatter: rows pad to the same power-of-two bucket
+        # and the table row to the full slot width, so promotions of any
+        # run length share the import path's jit variants instead of
+        # compiling one program per chain length (pad rows mask to the
+        # scratch block exactly like /kv/import)
+        padded = _bucket(rows, hi=self.model_config.max_seq_len)
+        table_row = np.zeros(
+            self.paged_layout.max_blocks_per_slot, dtype=np.int32
+        )
+        table_row[: len(blocks)] = blocks
+
+        def _run():
+            from langstream_tpu.serving import kvtransfer
+
+            # one scatter covering the whole promoted run: concatenate
+            # the per-block rows in chain order and write them through
+            # the slot-shaped pack path with a block-table row of the
+            # freshly installed blocks
+            names = sorted(entries[0]["arrays"])
+            arrays = {
+                name: np.concatenate(
+                    [e["arrays"][name] for e in entries], axis=1
+                )
+                for name in names
+            }
+            out_k, out_v = kvtransfer.scatter_slot(
+                self.cache_k, self.cache_v, arrays,
+                table_row, rows, padded,
+            )
+            # donated pools re-bound on the dispatch thread (RACE801:
+            # single thread role, same contract as every dispatch)
+            self.cache_k, self.cache_v = out_k, out_v
+            t_dev = time.monotonic()
+            # graftcheck: disable=JAX104 the one per-dispatch sync, moved off-loop and timed
+            jax.block_until_ready((out_k, out_v))
+            return time.monotonic() - t_dev
+
+        device_s = await loop.run_in_executor(self._executor, _run)
+        store.note_promoted(len(blocks), nbytes, device_ms=device_s * 1e3)
+        self._emit_prefix_events()
+        return len(blocks)
+
+    async def _demote_prefix_blocks(self, loop) -> None:
+        """T0 byte-budget enforcement at the loop's safe point: while
+        the prefix cache sits over ``t0-bytes``, gather LRU cache-only
+        leaf blocks to host (ONE timed dispatch-thread fetch for the
+        batch) and hand their rows to the T1 tier, then free the pool
+        blocks. Bounded per pass so a storm never starves admission."""
+        store = self.prefix_store
+        budget = store.spec.t0_bytes
+        if budget is None:
+            return
+        t0_bytes = self.block_mgr.prefix_block_count() * self._kv_block_bytes
+        over = t0_bytes - budget
+        if over <= 0 or self._kv_block_bytes <= 0:
+            return
+        want = min(4, -(-over // self._kv_block_bytes))
+        candidates = self.block_mgr.evictable_prefixes(want)
+        if not candidates:
+            return
+        bs = self.paged_layout.block_size
+
+        def _run():
+            from langstream_tpu.serving import kvtransfer
+
+            out = []
+            for digest, block, parent in candidates:
+                gathered_k, gathered_v = kvtransfer.gather_slot(
+                    self.cache_k, self.cache_v,
+                    np.asarray([block], dtype=np.int32), 1,
+                )
+                arrays, device_s = kvtransfer._fetch_rows(
+                    gathered_k, gathered_v, bs
+                )
+                arrays = {
+                    name: np.ascontiguousarray(a)
+                    for name, a in arrays.items()
+                }
+                out.append((digest, parent, arrays, device_s))
+            return out
+
+        gathered = await loop.run_in_executor(self._executor, _run)
+        for digest, parent, arrays, _device_s in gathered:
+            if self.block_mgr.drop_prefix(digest) is None:
+                continue  # re-referenced while gathering: keep it in T0
+            store.insert_t1(
+                digest.hex(), parent.hex() if parent else "", arrays
+            )
+        self._emit_prefix_events()
+
+    def prefix_store_section(self) -> dict[str, Any]:
+        """``stats()["prefixstore"]`` / flight-summary section: per-tier
+        bytes vs budget, hit/demotion/eviction counters, and the exact
+        byte ledger. Wait-free (PFX801): snapshot reads + arithmetic;
+        the tier gauges refresh here so any reader keeps the scrape
+        surface current."""
+        store = self.prefix_store
+        t0_blocks = (
+            self.block_mgr.prefix_block_count()
+            if self.block_mgr is not None
+            else 0
+        )
+        t0_bytes = t0_blocks * self._kv_block_bytes
+        section = {
+            "t0": {
+                "blocks": t0_blocks,
+                "bytes": t0_bytes,
+                "budget_bytes": store.spec.t0_bytes,
+                "hits": self.prefix_hits,
+                "tokens_reused": self.prefix_tokens,
+                "pool_evictions": self.prefix_t0_evictions,
+            },
+            "hydrating_requests": len(self._prefix_hydrating),
+            **store.stats(),
+        }
+        if self._m_prefix_tier:
+            self._m_prefix_tier["t0_bytes"](t0_bytes)
+            self._m_prefix_tier["t1_bytes"](store.t1_bytes)
+            self._m_prefix_tier["t2_bytes"](store.t2_bytes)
+        return section
 
     def _draft_tokens(
         self, slot_id: int, num_drafts: int
@@ -4059,6 +4501,49 @@ class TpuServingEngine:
                 if request.future.cancelled():
                     self.scheduler.pop()  # caller gave up while queued
                     continue
+                # one chain-digest walk per admission attempt, shared by
+                # the hydration check, the promotion, and match_prefix
+                # below — the admission path hashes the prompt ONCE
+                chain = (
+                    self.block_mgr.chain_digests(request.context_tokens)
+                    if self.prefix_store is not None
+                    and use_prefix
+                    and not request.preemptions
+                    else None
+                )
+                if (
+                    chain is not None
+                    and not request.hydrate_attempted
+                    and not self._draining
+                ):
+                    # tiered prefix store: when the prompt's chain
+                    # extends into T2 (object storage), stash the
+                    # request OFF the queue while the background
+                    # hydrator pulls the blobs into T1 — it requeues at
+                    # class front the moment they land (or the timeout
+                    # falls it back to cold compute). Never head-blocks:
+                    # the loop moves on to the next admission candidate.
+                    request.hydrate_attempted = True
+                    missing = self._chain_t2_candidates(chain)
+                    if missing and self.prefix_store.request_hydration(
+                        missing
+                    ):
+                        self.scheduler.pop()
+                        deadline = (
+                            time.monotonic()
+                            + self.prefix_store.spec.hydrate_timeout_s
+                        )
+                        self._prefix_hydrating.append(
+                            (request, deadline, missing)
+                        )
+                        self.flight.event(
+                            "prefix-hydrate", stage="begin",
+                            blocks=len(missing),
+                        )
+                        self._journey(
+                            request, "hydrate-begin", blocks=len(missing)
+                        )
+                        continue
                 if self.block_mgr is not None and not self.block_mgr.can_admit(
                     len(request.prompt_tokens) + request.max_tokens + 1
                 ):
@@ -4074,7 +4559,14 @@ class TpuServingEngine:
                 # preemption dropped; untouched requests see ctx == prompt
                 ctx = request.context_tokens
                 if use_prefix and not request.preemptions:
-                    blocks, reuse = self.block_mgr.match_prefix(ctx)
+                    if chain is not None:
+                        # promote the T1 run extending this prompt's T0
+                        # chain back into pool blocks, so the match
+                        # below sees the longer chain (docs/PREFIX.md)
+                        await self._promote_prefix(loop, request, chain)
+                    blocks, reuse = self.block_mgr.match_prefix(
+                        ctx, digests=chain
+                    )
                     if (
                         reuse
                         and len(ctx) - reuse
@@ -4586,6 +5078,11 @@ def flight_report(
             "pool_role": engine.config.pool_role,
             "kvtransfer": engine.kv_transfer_section(),
         }
+        if engine.prefix_store is not None:
+            # tier hit/byte/budget posture: rides /flight/summary so
+            # engine_top's prefix panel and the control-plane fan-in
+            # need no extra engine surface
+            entry["prefixstore"] = engine.prefix_store_section()
         slo = engine.slo_status()
         if slo is not None:
             entry["slo"] = slo

@@ -33,6 +33,7 @@ is flagged, never hidden). ``m_s`` is the in-process monotonic stamp
 for same-pod math. Kinds (the lifecycle vocabulary)::
 
     gateway-produce  bounce  submit  admit  preempt  resume
+    hydrate-begin  hydrate-done
     first-token  export  export-taken  import-received  import
     first-step  finish  shed  fail  cancelled
 
@@ -85,6 +86,7 @@ LIFECYCLE_CHAIN = (
 SEGMENT_ORDER = (
     "ingest",
     "queue",
+    "prefix-hydrate",
     "prefill",
     "export",
     "handoff-wait",
@@ -107,6 +109,13 @@ EDGE_SEGMENTS: dict[tuple[str, str], str] = {
     ("bounce", "bounce"): "ingest",
     ("submit", "admit"): "queue",
     ("submit", "shed"): "queue",
+    # tiered prefix store (docs/PREFIX.md): an admission stashed while
+    # the hydrator pulls its prompt's T2 blobs into T1 — the interval
+    # the warm-start either pays instead of prefill or writes off at
+    # the hydrate timeout
+    ("submit", "hydrate-begin"): "queue",
+    ("hydrate-begin", "hydrate-done"): "prefix-hydrate",
+    ("hydrate-done", "admit"): "queue",
     ("admit", "first-token"): "prefill",
     ("first-token", "export"): "export",       # gather + serialize
     ("export", "export-taken"): "handoff-wait",

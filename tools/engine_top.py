@@ -197,6 +197,7 @@ def render(report: list[dict]) -> str:
             _render_pool(entry.get("pool_role"), entry.get("kvtransfer"),
                          summary)
         )
+        lines.extend(_render_prefix(entry.get("prefixstore"), events))
         spec_acc = totals.get("spec_accepted") or 0
         spec_rej = totals.get("spec_rejected") or 0
         if spec_acc or spec_rej:
@@ -256,6 +257,78 @@ def _render_pool(
             f"pool     imports {kvtransfer.get('imports') or 0} "
             f"({_fmt_bytes(kvtransfer.get('import_bytes') or 0)})  "
             f"sheds {kvtransfer.get('import_sheds') or 0}"
+        )
+    return lines
+
+
+def _render_prefix(prefixstore: dict | None, events: list[dict]) -> list[str]:
+    """Tiered-prefix-store panel (docs/PREFIX.md): per-tier bytes vs
+    budget bars, hit ratios, and the eviction tail. Silent for engines
+    without a prefix-store section — pre-tier payloads render
+    unchanged."""
+    if not prefixstore:
+        return []
+    lines: list[str] = []
+    t0 = prefixstore.get("t0") or {}
+    t1 = prefixstore.get("t1") or {}
+    t2 = prefixstore.get("t2") or {}
+
+    def _tier_line(name: str, section: dict, extra: str) -> str:
+        used = section.get("bytes") or 0
+        budget = section.get("budget_bytes")
+        if budget is not None:
+            frac = 1.0 if not budget else min(1.0, used / budget)
+            if not used and not budget:
+                frac = 0.0
+            bar = f"[{_bar(frac, 16)}] {_fmt_bytes(used)}/{_fmt_bytes(budget)}"
+        else:
+            bar = f"{_fmt_bytes(used)} (unbudgeted)"
+        return f"prefix   {name} {bar}  {extra}"
+
+    t0_hits = t0.get("hits") or 0
+    lines.append(
+        _tier_line(
+            "T0", t0,
+            f"blocks {t0.get('blocks') or 0}  hits {t0_hits}  "
+            f"reused {t0.get('tokens_reused') or 0} tok",
+        )
+    )
+    t1_hits = t1.get("hits") or 0
+    t1_misses = t1.get("misses") or 0
+    t1_looked = t1_hits + t1_misses
+    t1_ratio = f"{100 * t1_hits / t1_looked:.0f}%" if t1_looked else "-"
+    lines.append(
+        _tier_line(
+            "T1", t1,
+            f"entries {t1.get('entries') or 0}  hit {t1_ratio} "
+            f"({t1_hits}/{t1_looked})",
+        )
+    )
+    if t2.get("enabled"):
+        lines.append(
+            _tier_line(
+                "T2", t2,
+                f"entries {t2.get('entries') or 0}  hydrations "
+                f"{prefixstore.get('hydrations') or 0}  in-transit "
+                f"{_fmt_bytes(t2.get('in_transit_bytes') or 0)}",
+            )
+        )
+    lines.append(
+        f"prefix   demote {prefixstore.get('demotions_t0_t1') or 0}"
+        f"→T1 {prefixstore.get('demotions_t1_t2') or 0}→T2   "
+        f"promote {prefixstore.get('promotions') or 0}   evict "
+        f"{prefixstore.get('evictions') or 0}   refused "
+        f"{prefixstore.get('fingerprint_refusals') or 0}"
+    )
+    tail = [
+        e for e in events
+        if str(e.get("kind", "")).startswith("prefix-evict")
+    ][-3:]
+    for event in tail:
+        lines.append(
+            f"prefix   evict {event.get('tier')} {event.get('digest')} "
+            f"{_fmt_bytes(event.get('bytes') or 0)} "
+            f"({event.get('reason')})"
         )
     return lines
 

@@ -53,6 +53,13 @@ EDGE_SEGMENTS: dict[tuple[str, str], str] = {
     ("bounce", "bounce"): "ingest",
     ("submit", "admit"): "queue",
     ("submit", "shed"): "queue",
+    # tiered prefix store (docs/PREFIX.md): an admission stashed while
+    # the hydrator pulls its prompt's T2 blobs into T1 — the interval
+    # the warm-start either pays instead of prefill or writes off at
+    # the hydrate timeout
+    ("submit", "hydrate-begin"): "queue",
+    ("hydrate-begin", "hydrate-done"): "prefix-hydrate",
+    ("hydrate-done", "admit"): "queue",
     ("admit", "first-token"): "prefill",
     ("first-token", "export"): "export",
     ("export", "export-taken"): "handoff-wait",

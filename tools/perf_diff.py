@@ -55,6 +55,15 @@ METRICS: dict[str, str] = {
     "gateway_ttft_p50_s": "up",
     "prefix_cache_speedup": "down",
     "recompile_count": "up",
+    # tiered prefix store (docs/PREFIX.md, gateway_bench warm-prefix
+    # phase): warm/hydrated TTFT grows = regression, hydrate-vs-
+    # recompute speedup shrinks = regression
+    "prefix_warm_ttft_p50_s": "up",
+    "prefix_warm_ttft_p99_s": "up",
+    "prefix_hydrate_ttft_s": "up",
+    "prefix_hydrate_speedup": "down",
+    "journey_prefix_hydrate_p50_s": "up",
+    "journey_prefix_hydrate_p99_s": "up",
     # per-request journey segments (serving/journey.py, recorded by
     # gateway_bench as `journey_segments`): every TTFT component is
     # worse when it grows — the instrument for the split-pool bench
@@ -168,6 +177,18 @@ def extract_metrics(payload) -> dict:
         prefix = detail.get("prefix_cache")
         if isinstance(prefix, dict) and prefix.get("speedup") is not None:
             metrics["prefix_cache_speedup"] = prefix["speedup"]
+        # tiered-prefix-store warm phase (gateway_bench
+        # run_warm_prefix_phase): warm/hydrated TTFT + cross-replica
+        # hydrate-vs-recompute speedup
+        warm = detail.get("prefix_warm")
+        if isinstance(warm, dict):
+            for key in (
+                "prefix_warm_ttft_p50_s", "prefix_warm_ttft_p99_s",
+                "prefix_hydrate_ttft_s", "prefix_hydrate_speedup",
+            ):
+                if warm.get(key) is not None:
+                    metrics[key] = warm[key]
+            _journey_metrics(warm.get("journey_segments"), metrics)
         _journey_metrics(detail.get("journey_segments"), metrics)
         for leg in detail.values():
             if isinstance(leg, dict):
