@@ -61,8 +61,8 @@ Phases (BASELINE.md targets: >= 2000 tok/s/chip, p50 gateway TTFT < 200ms):
 Env knobs: BENCH_MODEL (tiny|llama-1b|llama3-8b|...), BENCH_SLOTS,
 BENCH_DECODE_CHUNK, BENCH_QUANTIZE (int8|none), BENCH_KV (dense|paged),
 BENCH_KV_QUANT (int8|none), BENCH_GATEWAY=0 / BENCH_PAGED=0 /
-BENCH_PREFIX=0 / BENCH_KV_INT8=0 / BENCH_SPEC=0 / BENCH_QOS=0 to skip
-phases.
+BENCH_PREFIX=0 / BENCH_KV_INT8=0 / BENCH_SPEC=0 / BENCH_QOS=0 /
+BENCH_OOM=0 to skip phases.
 
 Offline note: weights are random-init (no checkpoint files in this
 environment) — identical FLOPs/bytes to trained weights, so throughput is
@@ -136,6 +136,7 @@ RUN_PREFIX_WARM = os.environ.get("BENCH_PREFIX_WARM", "1") != "0"
 RUN_KV_INT8 = os.environ.get("BENCH_KV_INT8", "1") != "0"
 RUN_SPEC = os.environ.get("BENCH_SPEC", "1") != "0"
 RUN_QOS = os.environ.get("BENCH_QOS", "1") != "0"
+RUN_OOM = os.environ.get("BENCH_OOM", "1") != "0"
 DEGRADED = os.environ.get("BENCH_DEGRADED") == "1"
 
 PROMPT = "Benchmarking the TPU serving engine end to end. " * 4
@@ -521,6 +522,10 @@ def run_bench() -> dict:
     # recompute TTFT + router prefix-affinity counters
     optional("prefix_warm", RUN_PREFIX_WARM,
              budget_cap=min(PHASE_BUDGET_S, 300))
+    # device-survival storm (docs/RESILIENCE.md): injected
+    # RESOURCE_EXHAUSTED burst mid-flood; records shrink/recover counts,
+    # shed rate, and the zero-silent-loss completed-vs-submitted ledger
+    optional("oom_storm", RUN_OOM, budget_cap=min(PHASE_BUDGET_S, 240))
 
     return _record(headline, detail)
 
@@ -1103,6 +1108,13 @@ async def _child_phase(phase: str) -> dict:
 
         return await _phase(
             run_warm_prefix_phase(), budget_s=min(PHASE_BUDGET_S, 300)
+        )
+    if phase == "oom_storm":
+        sys.path.insert(0, os.path.join(os.path.dirname(_BENCH_PATH), "tools"))
+        from gateway_bench import run_oom_storm_phase
+
+        return await _phase(
+            run_oom_storm_phase(), budget_s=min(PHASE_BUDGET_S, 240)
         )
     raise ValueError(f"unknown bench phase {phase!r}")
 

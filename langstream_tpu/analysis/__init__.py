@@ -37,6 +37,10 @@ FLEET601/2  fleet autoscaler discipline: replica-count writes not gated
 POOL701     kv-transfer plane discipline: blocking I/O, locks, or device
             syncs in the KV handoff serialization path outside the
             sanctioned ``_fetch*`` stages (disaggregated pools)
+FLT901      fault-tolerance: a broad except on the engine's device-
+            dispatch paths swallowing the error without consulting the
+            RESOURCE_EXHAUSTED classifier or re-raising (the pool-shrink
+            adaptation silently disabled)
 ==========  ==============================================================
 
 RACE/INV/FLOW are **project rules**: they run over a whole-program index
@@ -74,6 +78,7 @@ from langstream_tpu.analysis.project import ProjectIndex, ProjectRule
 from langstream_tpu.analysis.rules_async import RULES as _ASYNC_RULES
 from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
 from langstream_tpu.analysis.rules_fleet import RULES as _FLEET_RULES
+from langstream_tpu.analysis.rules_flt import RULES as _FLT_RULES
 from langstream_tpu.analysis.rules_flow import RULES as _FLOW_RULES
 from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
@@ -96,6 +101,7 @@ ALL_RULES: list[Rule] = [
     *_FLEET_RULES,
     *_POOL_RULES,
     *_PFX_RULES,
+    *_FLT_RULES,
 ]
 
 #: whole-program rules (run over the ProjectIndex, not per file)

@@ -530,3 +530,43 @@ class FleetAutoscaler:
         "shape, never fetch mid-decide."
     ),
 ))
+
+_register(RuleExample(
+    rule="FLT901",
+    tp={
+        "langstream_tpu/serving/engine.py": '''\
+class TpuServingEngine:
+    async def _decode_burst(self, loop, active):
+        try:
+            out = await loop.run_in_executor(self._executor, self._step)
+        except Exception:
+            # swallowed: an allocator failure becomes a silent no-op —
+            # no shrink, no shed, the request just never answers
+            return
+        self._apply(out)
+''',
+    },
+    tn={
+        "langstream_tpu/serving/engine.py": '''\
+class TpuServingEngine:
+    async def _decode_burst(self, loop, active):
+        try:
+            out = await loop.run_in_executor(self._executor, self._step)
+        except Exception as e:
+            # the sanctioned shape: classify, adapt, re-raise the rest
+            if self._resource_exhausted(e):
+                self._shed_or_shrink(e)
+                return
+            raise
+        self._apply(out)
+''',
+    },
+    fix=(
+        "On the engine's device-dispatch paths, every broad except must "
+        "first consult self._resource_exhausted(e) — allocator failures "
+        "route to the pool-shrink/shed adaptation (docs/RESILIENCE.md) — "
+        "and `raise` everything it does not explicitly handle. A broad "
+        "handler that returns/passes turns device memory pressure into "
+        "silent request loss."
+    ),
+))

@@ -114,6 +114,11 @@ class AutoscaleSpec:
     #: scale up on sustained degraded health (recompile storm, KV
     #: saturation, pipeline overlap collapse — the watchdog's predicates)
     degraded: bool = True
+    #: scale up while any replica is serving under a shrunken KV budget
+    #: (adaptive pool-shrink after a device allocator failure,
+    #: docs/RESILIENCE.md): the replica adapted instead of dying, but
+    #: the fleet lost capacity it should get back elsewhere
+    pool_shrink: bool = True
     # -- scale-down idleness thresholds (ALL must hold) --
     #: fleet-wide occupancy fraction below which replicas are idle
     idle_occupancy: float = 0.10
@@ -145,6 +150,7 @@ class AutoscaleSpec:
             "shed-delta": self.shed_delta,
             "slo-fast-burn": self.slo_fast_burn,
             "degraded": self.degraded,
+            "pool-shrink": self.pool_shrink,
             "idle-occupancy": self.idle_occupancy,
             "idle-queue": self.idle_queue,
             "agent": self.agent,
@@ -234,6 +240,7 @@ class AutoscaleSpec:
             shed_delta=shed_delta,
             slo_fast_burn=_parse_bool(_get("slo-fast-burn", True)),
             degraded=_parse_bool(_get("degraded", True)),
+            pool_shrink=_parse_bool(_get("pool-shrink", True)),
             idle_occupancy=idle_occ,
             idle_queue=int(_get("idle-queue", 0)),
             agent=str(agent) if agent is not None else None,
@@ -383,6 +390,11 @@ class ReplicaObservation:
     #: disaggregated pool role ("combined" / "prefill" / "decode") — the
     #: router's phase filter keys off this (docs/DISAGG.md)
     pool: str = "combined"
+    #: device-survival posture (docs/RESILIENCE.md): cumulative adaptive
+    #: pool-shrinks, and whether any KV budget is withheld RIGHT NOW —
+    #: a shrunk replica serves degraded capacity the fleet must replace
+    pool_shrinks: int = 0
+    budget_withheld: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -398,6 +410,8 @@ class ReplicaObservation:
             "draining": self.draining,
             "slo_alerting": list(self.slo_alerting),
             "pool": self.pool,
+            "pool_shrinks": self.pool_shrinks,
+            "budget_withheld": self.budget_withheld,
         }
 
 
@@ -526,6 +540,14 @@ class FleetAutoscaler:
                 reasons.append(
                     f"degraded replicas {degraded} (recompile storm / KV "
                     f"saturation / overlap collapse)"
+                )
+        if spec.pool_shrink:
+            shrunk = [o.replica for o in healthy if o.budget_withheld]
+            if shrunk:
+                reasons.append(
+                    f"KV budget withheld on {shrunk} (adaptive pool-shrink "
+                    f"after a device allocator failure — the replica "
+                    f"degraded instead of dying; replace its capacity)"
                 )
         return reasons
 
@@ -890,6 +912,8 @@ def observation_from_summary(
     state = "ok"
     draining = False
     pool = "combined"
+    pool_shrinks = 0
+    budget_withheld = False
     alerting: set[str] = set()
     rank = {"ok": 0, "degraded": 1, "wedged": 2}
     for entry in entries if isinstance(entries, list) else []:
@@ -923,6 +947,11 @@ def observation_from_summary(
         drain_section = entry.get("drain") or {}
         shed += int(drain_section.get("shed", 0) or 0)
         shed += int(scheduler.get("shed", 0) or 0)
+        survival = entry.get("survival") or {}
+        pool_shrinks += int(survival.get("shrinks", 0) or 0)
+        budget_withheld = budget_withheld or bool(
+            survival.get("withheld_blocks", 0) or 0
+        )
     if healthz is not None and healthz.get("status") == "wedged":
         state = "wedged"
     return ReplicaObservation(
@@ -937,4 +966,6 @@ def observation_from_summary(
         draining=draining,
         slo_alerting=tuple(sorted(alerting)),
         pool=pool,
+        pool_shrinks=pool_shrinks,
+        budget_withheld=budget_withheld,
     )

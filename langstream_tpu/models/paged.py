@@ -216,6 +216,14 @@ class BlockManager:
         self.layout = layout
         self._free = list(range(layout.num_blocks - 1, 0, -1))  # block 0 reserved
         self._reserved = 0
+        # adaptive pool-shrink (docs/RESILIENCE.md): blocks withheld from
+        # the admission budget after a device allocator failure. Purely a
+        # LOGICAL reduction — the pool arrays stay allocated; admission
+        # just reserves against a smaller usable count until the engine's
+        # recovery probe restores it. Floored so the largest admissible
+        # request can still ever fit (a shrunk pool must degrade, never
+        # deadlock the queue).
+        self._budget_reduction = 0
         # tiered prefix store hook (serving/prefixstore.py): called with
         # (digest_hex, block) when pool pressure organically evicts a
         # cached prefix block WITHOUT a demotion — the tier ledgers must
@@ -493,11 +501,57 @@ class BlockManager:
 
     def can_admit(self, total_tokens: int) -> bool:
         need = self.blocks_needed(total_tokens)
-        usable = self.layout.num_blocks - 1  # block 0 is scratch
         return (
-            self._reserved + need <= usable
+            self._reserved + need <= self.usable_blocks
             and need <= self.layout.max_blocks_per_slot
         )
+
+    # -- adaptive budget (pool-shrink, docs/RESILIENCE.md) --------------
+
+    @property
+    def configured_blocks(self) -> int:
+        """The configured usable pool (block 0 is scratch)."""
+        return self.layout.num_blocks - 1
+
+    @property
+    def usable_blocks(self) -> int:
+        """The LIVE admission budget: configured minus withheld."""
+        return self.configured_blocks - self._budget_reduction
+
+    @property
+    def budget_reduction(self) -> int:
+        return self._budget_reduction
+
+    @property
+    def reserved_blocks(self) -> int:
+        return self._reserved
+
+    def _budget_floor(self) -> int:
+        """Never shrink below one max-size slot's worth: requests that
+        passed ``fits_ever`` must stay admissible *eventually* or they
+        would queue forever under a shrink that never fully restores."""
+        return min(self.layout.max_blocks_per_slot, self.configured_blocks)
+
+    def reduce_budget(self, blocks: int) -> int:
+        """Withhold up to ``blocks`` from the admission budget (clamped
+        to the floor). Returns the blocks actually withheld — 0 means
+        the budget is already at its floor. Existing reservations may
+        transiently exceed the new budget; ``can_admit`` simply refuses
+        new work until completions (or preemptions) drain them."""
+        actual = max(0, min(int(blocks), self.usable_blocks - self._budget_floor()))
+        self._budget_reduction += actual
+        return actual
+
+    def restore_budget(self, blocks: int | None = None) -> int:
+        """Return withheld blocks to the budget (all of them when
+        ``blocks`` is None). Returns the blocks actually restored."""
+        actual = (
+            self._budget_reduction
+            if blocks is None
+            else max(0, min(int(blocks), self._budget_reduction))
+        )
+        self._budget_reduction -= actual
+        return actual
 
     def admit(self, slot: int, total_tokens: int) -> None:
         need = self.blocks_needed(total_tokens)
@@ -508,9 +562,11 @@ class BlockManager:
 
     # -- growth --------------------------------------------------------
 
-    def ensure_capacity(self, slot: int, tokens: int) -> bool:
+    def ensure_capacity(self, slot: int, tokens: int) -> int:
         """Allocate physical blocks so ``tokens`` positions fit. Returns
-        True if the table changed.
+        the number of blocks allocated (0 = table unchanged; truthy
+        exactly when it changed, so boolean callers keep working — and
+        the pool-grow flight events can carry block/byte counts).
 
         Growth is capped at the slot's admission reservation: speculative
         decode chunks may request coverage past the request's true maximum,
@@ -520,14 +576,14 @@ class BlockManager:
         need = self.blocks_needed(tokens)
         if self._slot_reservation[slot]:
             need = min(need, self._slot_reservation[slot])
-        changed = False
+        grown = 0
         while len(self._slot_shared[slot]) + len(self._slot_blocks[slot]) < need:
             b = self._alloc()
             idx = len(self._slot_shared[slot]) + len(self._slot_blocks[slot])
             self._slot_blocks[slot].append(b)
             self.tables[slot, idx] = b
-            changed = True
-        return changed
+            grown += 1
+        return grown
 
     def release(self, slot: int) -> None:
         for b in self._slot_shared[slot] + self._slot_blocks[slot]:
@@ -547,9 +603,11 @@ class BlockManager:
         unallocated — an allocated-fullness gauge would read near empty
         exactly when ``no-kv-blocks`` stalls fire. Physical allocation
         (free/live/cached) lives in :meth:`stats`. Cheap enough for the
-        flight recorder to sample per burst."""
-        usable = self.layout.num_blocks - 1
-        return self._reserved / usable if usable > 0 else 0.0
+        flight recorder to sample per burst. Measured against the LIVE
+        budget: a shrunk pool reports the pressure admissions actually
+        face, not the configured capacity they temporarily lost."""
+        usable = self.usable_blocks
+        return self._reserved / usable if usable > 0 else 1.0
 
     def prefix_block_count(self) -> int:
         """Blocks currently pinned by the content-addressed prefix cache
@@ -562,6 +620,10 @@ class BlockManager:
             "num_blocks": self.layout.num_blocks,
             "free_blocks": len(self._free),
             "reserved_blocks": self._reserved,
+            # adaptive pool-shrink posture: the live admission budget vs
+            # what the config sized (withheld > 0 = shrunk right now)
+            "budget_blocks": self.usable_blocks,
+            "withheld_blocks": self._budget_reduction,
             # distinct physical blocks: shared prefix blocks adopted by
             # several slots count once (live + free + cache-only ≤ usable)
             "live_blocks": len(

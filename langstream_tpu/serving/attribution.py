@@ -377,6 +377,7 @@ def memory_ledger(
     limit_bytes: int | None,
     limit_source: str,
     in_transit_bytes: int = 0,
+    kv_withheld_bytes: int = 0,
 ) -> dict[str, Any]:
     """Assemble the ``hbm_bytes_by_owner`` breakdown.
 
@@ -387,7 +388,11 @@ def memory_ledger(
     *negative* slack is reported honestly (the accounting or the
     capacity table is wrong — either way the operator must see it).
     Prefix-cache blocks live inside the KV pool arrays, so they are a
-    sub-owner (``kv_pool_prefix_bytes``), never added to the sum."""
+    sub-owner (``kv_pool_prefix_bytes``), never added to the sum — and
+    so are budget blocks withheld by an adaptive pool-shrink
+    (``kv_pool_withheld_bytes``, docs/RESILIENCE.md): the arrays stay
+    allocated through a shrink, only the admission budget moves, so the
+    owner sum is identical across shrink/restore by construction."""
     owners: dict[str, int] = {
         "weights": weights_bytes,
         "kv-pool": kv_pool_bytes,
@@ -407,6 +412,7 @@ def memory_ledger(
         "hbm_bytes_by_owner": owners,
         "accounted_bytes": accounted,
         "kv_pool_prefix_bytes": prefix_blocks * bytes_per_block,
+        "kv_pool_withheld_bytes": kv_withheld_bytes,
         "limit_bytes": limit_bytes,
         "limit_source": limit_source,
         "slack_bytes": slack,

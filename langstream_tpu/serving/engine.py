@@ -37,6 +37,7 @@ import asyncio
 import dataclasses
 import logging
 import os
+import re
 import threading
 import time
 from collections import OrderedDict, deque
@@ -80,7 +81,14 @@ from langstream_tpu.serving.attribution import (
     tree_device_bytes,
     verify_cost,
 )
+from langstream_tpu.serving.faults import (
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    plans_from_env,
+)
 from langstream_tpu.serving.flight import FlightRecorder
+from langstream_tpu.serving.journal import RequestJournal, request_entry
 from langstream_tpu.serving.journey import JOURNEYS
 from langstream_tpu.serving.health import EngineWatchdog, SloSpec, SloTracker
 from langstream_tpu.serving.prefixstore import PrefixStore, PrefixStoreSpec
@@ -95,6 +103,7 @@ from langstream_tpu.serving.qos import (
     QosSpec,
     RateLimited,
     normalize_priority,
+    priority_rank,
 )
 from langstream_tpu.serving.sampler import sample_tokens
 from langstream_tpu.serving.scheduler import make_scheduler
@@ -114,6 +123,24 @@ _MODEL_CONFIGS = {
 # and cache geometry, routed-expert FFN plugged into the shared layer math
 # (models/moe.py `moe_serving_ffn`). Lazy: moe.py imports only when used.
 _MOE_MODELS = ("moe-tiny", "moe-8x7b", "mixtral-8x7b")
+
+#: adaptive pool-shrink (docs/RESILIENCE.md): preempt-and-retry rounds a
+#: stranded (never-prefilled) request gets before its failure stops
+#: being treated as transient pressure and it is shed loudly — the
+#: bound that keeps a deterministically failing dispatch from
+#: livelocking the loop in an admit→OOM→requeue cycle
+_SHRINK_RETRY_CAP = 3
+
+#: jaxlib/XLA allocator-failure spellings (plus the BlockManager's own
+#: "pool exhausted") — the classifier behind the degrade-don't-die path
+#: (docs/RESILIENCE.md). One compiled regex so every catch site agrees.
+_RESOURCE_EXHAUSTED_RE = re.compile(
+    r"RESOURCE_EXHAUSTED"
+    r"|pool exhausted"
+    r"|Out of memory"
+    r"|Failed to allocate"
+    r"|Allocation .* exceeds"
+)
 
 
 def _resolve_model_config(name: str, max_seq_len: int):
@@ -275,6 +302,30 @@ class ServingConfig:
     # hydrate instead of recomputing. Requires kv-layout=paged with
     # prefix-cache on.
     prefix_store: "PrefixStoreSpec | None" = None
+    # device-survival plane (docs/RESILIENCE.md): a device allocator
+    # failure (RESOURCE_EXHAUSTED and its jaxlib spellings) at a
+    # pool-grow/prefill/scatter seam no longer fails every in-flight
+    # request — the engine SHRINKS its effective KV admission budget by
+    # shrink-fraction of the configured pool, preempts the lowest-class
+    # victims to free their worst-case reservations (resume is the PR 4
+    # byte-identical path), and schedules a recovery probe that restores
+    # one shrink quantum per quiet shrink-recovery-s window. Repeated
+    # shrinks inside one window escalate to DEGRADED health.
+    shrink_fraction: float = 0.125
+    shrink_recovery_s: float = 30.0
+    # fault injection (serving/faults.py — TESTS AND CHAOS DRILLS ONLY):
+    # declared FaultPlans arm the engine's device-touching seams to
+    # raise synthetic RESOURCE_EXHAUSTED errors or stall a dispatch.
+    # Empty (the default) leaves the hot path bit-for-bit unchanged —
+    # every seam check is one attribute test against None. The
+    # LS_TPU_FAULTS env var (JSON list of plans) arms a deployed pod.
+    faults: tuple = ()
+    # crash-requeue journal (serving/journal.py): a directory where every
+    # accepted submission is journaled at admit and retired at
+    # finish/shed/fail; a restarting engine replays the live entries
+    # front-of-class, so an engine death no longer silently drops
+    # accepted work. None (default) disables — hot path unchanged.
+    journal_dir: str | None = None
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -323,6 +374,10 @@ class ServingConfig:
             "pipeline": self.pipeline,
             "wedge-window-s": self.wedge_window_s,
             "slo": self.slo.to_dict() if self.slo is not None else None,
+            "shrink-fraction": self.shrink_fraction,
+            "shrink-recovery-s": self.shrink_recovery_s,
+            "faults": [p.to_dict() for p in self.faults],
+            "journal-dir": self.journal_dir,
         }
 
     @classmethod
@@ -399,6 +454,24 @@ class ServingConfig:
                 d.get("wedge-window-s", d.get("wedge_window_s", 60.0))
             ),
             slo=SloSpec.from_dict(d.get("slo")),
+            shrink_fraction=float(
+                d.get("shrink-fraction", d.get("shrink_fraction", 0.125))
+            ),
+            shrink_recovery_s=float(
+                d.get("shrink-recovery-s", d.get("shrink_recovery_s", 30.0))
+            ),
+            faults=tuple(
+                FaultPlan.from_dict(p) for p in (d.get("faults") or ())
+            ),
+            journal_dir=(
+                d.get(
+                    "journal-dir",
+                    d.get(
+                        "journal_dir",
+                        os.environ.get("LS_TPU_JOURNAL_DIR") or None,
+                    ),
+                )
+            ),
         )
 
 
@@ -1099,6 +1172,78 @@ class TpuServingEngine:
                     "store — counted, never silent)",
                 ),
             }
+        # device-survival plane (docs/RESILIENCE.md): fault injection,
+        # adaptive pool-shrink, crash-requeue journal. Default config
+        # keeps the hot path bit-for-bit: _faults is None (every seam
+        # check is one attribute test), the journal is None, and the
+        # recovery probe's loop check is one None test per pass.
+        if not 0.0 < config.shrink_fraction <= 1.0:
+            raise ValueError("shrink_fraction must be in (0, 1]")
+        if config.shrink_recovery_s <= 0:
+            raise ValueError("shrink_recovery_s must be > 0")
+        plans = tuple(config.faults) or plans_from_env()
+        self._faults = FaultInjector(plans) if plans else None
+        # fired faults hand off loop-ward through a deque: the seams
+        # span both thread roles, the flight ring's emission is loop-side
+        self._fault_fired: deque = deque()
+        self.pool_shrinks = 0
+        self.pool_restores = 0
+        self.shrink_preempted = 0
+        self._shrink_recover_at: float | None = None
+        # preempts/sheds performed INLINE at a catch site (the chunked-
+        # prefill grow handler) before the loop-level shrink pass runs:
+        # the pass folds them into its evidence and its did-we-adapt
+        # verdict — a tiny pool whose budget is already at its floor
+        # must still count an inline requeue as adaptation, not fall
+        # through to failing every in-flight request
+        self._shrink_inline_preempted = 0
+        self._shrink_inline_shed = 0
+        self._m_shrinks = None
+        self._m_restores = None
+        self._m_budget = None
+        if self.block_mgr is not None:
+            self._m_shrinks = reporter.counter(
+                "pool_shrinks_total",
+                "adaptive KV-budget shrinks after a device allocator "
+                "failure (degrade-don't-die: evidence rides the "
+                "pool-shrink flight events)",
+            )
+            self._m_restores = reporter.counter(
+                "pool_restores_total",
+                "shrink quanta restored by the recovery probe after a "
+                "quiet window",
+            )
+            self._m_budget = reporter.gauge(
+                "kv_budget_blocks",
+                "live paged-KV admission budget in blocks (configured "
+                "pool minus blocks withheld by adaptive shrink)",
+            )
+            self._m_budget(self.block_mgr.usable_blocks)
+        self.journal: RequestJournal | None = None
+        self._m_journal_depth = None
+        if config.journal_dir:
+            self.journal = RequestJournal(
+                config.journal_dir,
+                on_evict=lambda rid: self.flight.event(
+                    "journal-evict", request=rid
+                ),
+                # identity stamp: entries journaled under a different
+                # model/tokenizer are refused at replay — their token
+                # ids mean nothing to this engine (the dir itself is
+                # engine-private by contract)
+                fingerprint={
+                    "model": config.model,
+                    "tokenizer": config.tokenizer or "byte",
+                },
+            )
+            self._m_journal_depth = reporter.gauge(
+                "journal_depth",
+                "admitted-but-unfinished requests in the crash-requeue "
+                "journal",
+            )
+            self._journal_replay_pending = self.journal.pending()
+        else:
+            self._journal_replay_pending = []
 
     # ------------------------------------------------------------------
     # model + jit setup
@@ -1963,7 +2108,14 @@ class TpuServingEngine:
             queued=queued,
             occupancy=occupancy,
             samples=self.flight.recent(240),
-            events=self.flight.recent_events(64),
+            # 256, not the display tail's 64: the shrink-pressure
+            # predicate compares pool-shrink events across a whole
+            # recovery window, and a busy engine emits >64 events
+            # (pool-grows, the shrink's own preempt/resume pairs)
+            # between two shrinks — a short tail would age the first
+            # one out exactly under the sustained pressure the
+            # escalation exists to flag (the ring holds 512)
+            events=self.flight.recent_events(256),
             # a lockstep-broken engine stays registered but refuses all
             # requests: only a pod restart recovers the slice, so it
             # reports wedged and the liveness probe does the recycling
@@ -1994,6 +2146,15 @@ class TpuServingEngine:
             "warmup": warmup,
             "draining": self._draining,
             "ready": ready,
+            # adaptive pool-shrink posture (docs/RESILIENCE.md): blocks
+            # currently withheld from the KV admission budget — the pod
+            # probes surface it so an operator reading /healthz sees a
+            # degraded-capacity replica without another round trip
+            "budget_withheld": (
+                self.block_mgr.budget_reduction
+                if self.block_mgr is not None
+                else 0
+            ),
         }
 
     def _warmup_state(self) -> str:
@@ -2066,6 +2227,14 @@ class TpuServingEngine:
             in_transit_bytes=self._kv_in_transit_bytes,
             limit_bytes=self._hbm_limit,
             limit_source=self._hbm_limit_source,
+            # adaptive pool-shrink: budget blocks withheld after a device
+            # allocator failure — a sub-owner of the (unchanged) pool
+            # bytes, so the owner sum is identical across shrink/restore
+            kv_withheld_bytes=(
+                self.block_mgr.budget_reduction * self._kv_block_bytes
+                if self.block_mgr is not None
+                else 0
+            ),
         )
 
     @staticmethod
@@ -2237,6 +2406,13 @@ class TpuServingEngine:
             # the shed-rate objective counts every submission: admitted =
             # good, refused = bad (recorded in the except arm above)
             self._slo_record("shed-rate", True)
+            if self.journal is not None:
+                # crash-requeue journal (docs/RESILIENCE.md): the work is
+                # accepted NOW — journaled before the caller ever sees a
+                # future, retired when finish/shed/fail answers it
+                self.journal.admit(request_entry(request))
+                if self._m_journal_depth is not None:
+                    self._m_journal_depth(self.journal.depth())
         self._ensure_loop()
         self._wake.set()
         return await request.future
@@ -2340,6 +2516,10 @@ class TpuServingEngine:
             # device attribution plane: per-program achieved-vs-expected
             # ledger + hbm_bytes_by_owner (serving/attribution.py)
             "attribution": self.attribution_section(),
+            # device-survival plane (docs/RESILIENCE.md): live KV budget
+            # vs configured, shrink/restore counters, fault-injection
+            # state, crash-requeue journal depth
+            "survival": self.survival_section(),
         }
         slo = self.slo_status()
         if slo is not None:
@@ -2371,6 +2551,10 @@ class TpuServingEngine:
             self._lockstep.close()
         if self.prefix_store is not None:
             self.prefix_store.close()
+        if self.journal is not None:
+            # flush the retire tail: a clean shutdown leaves a journal
+            # that replays exactly the work this process never answered
+            self.journal.close()
         # wait=True: the loop task above is done, so the executor queue is
         # empty or finishing its last closure — joining it here is what
         # makes the reference drops below race-free (the dispatch thread
@@ -2762,6 +2946,10 @@ class TpuServingEngine:
                         attributes={"bytes": len(payload), "rows": rows})
         self.scheduler.on_finished(request)
         self.completed_requests += 1
+        # the handoff IS this pool's finish (a handed-off request never
+        # reaches _flush_emits' finish path): retire its journal entry,
+        # or a restart would replay work the decode pool already served
+        self._journal_retire(request)
         if not request.future.done():
             request.future.set_result(
                 {
@@ -2884,11 +3072,59 @@ class TpuServingEngine:
 
     @staticmethod
     def _resource_exhausted(error: BaseException) -> bool:
-        """True for a device allocator failure (jaxlib RESOURCE_EXHAUSTED)
-        or the BlockManager's pool-exhaustion RuntimeError — the refusals
-        ROADMAP item 5 wants adapted to, not died from."""
+        """True for a device allocator failure or the BlockManager's
+        pool-exhaustion RuntimeError — the refusals ROADMAP item 5 wants
+        adapted to, not died from. Covers every jaxlib allocator
+        spelling observed across backends/versions (the canonical
+        ``RESOURCE_EXHAUSTED:`` status prefix, the BFC allocator's
+        ``Out of memory while trying to allocate``, the PJRT client's
+        ``Failed to allocate request``, and TFRT's ``Allocation ...
+        exceeds`` phrasing) — a spelling this misses dies instead of
+        adapting, so each one is pinned by a unit test."""
         text = f"{type(error).__name__}: {error}"
-        return "RESOURCE_EXHAUSTED" in text or "pool exhausted" in text
+        return bool(_RESOURCE_EXHAUSTED_RE.search(text))
+
+    def _fault(self, site: str) -> None:
+        """Fault-injection seam check (serving/faults.py — tests/chaos
+        drills only). Production engines carry ``_faults = None``, so
+        this is ONE attribute test on the hot path. A fired fault is
+        stashed on the ``_fault_fired`` handoff deque (the seams span
+        the loop AND the dispatch thread; the flight ring's counters are
+        loop-side state, so emission happens at the loop's safe point —
+        chaos assertions read the emitted ``fault-injected`` events,
+        never guess), then the action runs: a synthetic
+        RESOURCE_EXHAUSTED raise, or a stall of whichever thread hit
+        the seam."""
+        faults = self._faults
+        if faults is None:
+            return
+        action = faults.fire(site)
+        if action is None:
+            return
+        self._fault_fired.append(
+            {
+                "site": site,
+                "shape": action.shape,
+                "fire": action.seq,
+                "hang_ms": (
+                    action.hang_ms if action.shape == "hang" else None
+                ),
+            }
+        )
+        if action.shape == "hang":
+            # the r03 shape: the dispatch goes quiet. The watchdog
+            # heartbeat stops while work stays pending, so /healthz
+            # must flip WEDGED until the stall resolves.
+            time.sleep(action.hang_ms / 1000.0)
+            return
+        raise InjectedFault(site, action.message)
+
+    def _drain_fault_events(self) -> None:
+        """Emit stashed ``fault-injected`` events at the loop's safe
+        point (and before any ``pool-shrink`` evidence, so the ring
+        reads cause-then-effect)."""
+        while self._fault_fired:
+            self.flight.event("fault-injected", **self._fault_fired.popleft())
 
     def _shed_import(self, request, reason: str, detail: str) -> None:
         """Refuse one pending import explicitly: RateLimited with a retry
@@ -2962,6 +3198,7 @@ class TpuServingEngine:
 
             def _run(arrays=arrays, table_row=table_row, rows=rows,
                      padded=padded):
+                self._fault("scatter")
                 out_k, out_v = kvtransfer.scatter_slot(
                     self.cache_k, self.cache_v, arrays, table_row, rows,
                     padded,
@@ -3059,8 +3296,22 @@ class TpuServingEngine:
         # fresh heartbeat at loop start: the wedge window measures from
         # here, not from engine construction
         self.watchdog.beat(self.scheduler.qsize())
+        if self._journal_replay_pending:
+            # crash-requeue (docs/RESILIENCE.md): the previous process
+            # died with accepted work unfinished — replay it through the
+            # QoS front-of-class resume path before any new admission
+            self._replay_journal(loop)
         while not self._stop:
             try:
+                if self._fault_fired:
+                    # chaos-drill evidence first: injected faults land in
+                    # the ring before whatever they caused this pass
+                    self._drain_fault_events()
+                if self._shrink_recover_at is not None:
+                    # pool-shrink recovery probe: one quiet window with
+                    # no further allocator failures restores one shrink
+                    # quantum (wait-free check; docs/RESILIENCE.md)
+                    self._shrink_step()
                 if self.prefix_store is not None:
                     # tier bookkeeping first: hydrations that landed
                     # requeue at class front, so the admission passes
@@ -3165,6 +3416,16 @@ class TpuServingEngine:
                     await self._decode_burst(loop, active)
             except Exception as e:  # device/runtime error: fail in-flight work,
                 # free the slots, keep serving (callers see the exception)
+                if (
+                    self._lockstep is None
+                    and self._resource_exhausted(e)
+                    and self._maybe_pool_shrink(e)
+                ):
+                    # degrade-don't-die (docs/RESILIENCE.md): device
+                    # memory pressure is a load signal. The budget
+                    # shrank, the victims requeued front-of-class, and
+                    # the loop keeps serving — nothing was failed.
+                    continue
                 log.exception("serving engine step failed")
                 from langstream_tpu.serving.lockstep import LockstepBroken
 
@@ -3199,14 +3460,22 @@ class TpuServingEngine:
         self._pending_chunk = None
         self._defer_release = False
         self._deferred_releases.clear()
+        # stale inline-adaptation counters must not leak into a later,
+        # unrelated shrink pass's evidence
+        self._shrink_inline_preempted = 0
+        self._shrink_inline_shed = 0
         error_text = f"{type(error).__name__}: {error}"[:160]
         for slot_id, slot in enumerate(self.slots):
             request = slot.request
-            if request is not None and not request.future.done():
-                request.future.set_exception(error)
-                self._journey(request, "fail", error=error_text)
-                if not request.warmup:
-                    self._slo_record("availability", False)
+            if request is not None:
+                if not request.future.done():
+                    request.future.set_exception(error)
+                    self._journey(request, "fail", error=error_text)
+                    if not request.warmup:
+                        self._slo_record("availability", False)
+                # an explicitly failed request was ANSWERED — retire its
+                # journal entry so a restart never replays served errors
+                self._journal_retire(request)
             slot.request = None
             slot.prefilling = False
             slot.prefill_done = 0
@@ -3219,6 +3488,7 @@ class TpuServingEngine:
                 self._journey(request, "fail", error=error_text)
                 if not request.warmup:
                     self._slo_record("availability", False)
+            self._journal_retire(request)
         for pending in list(self._pending_imports):
             request = pending[2]
             if not request.future.done():
@@ -3232,6 +3502,7 @@ class TpuServingEngine:
                 self._journey(request, "fail", error=error_text)
                 if not request.warmup:
                     self._slo_record("availability", False)
+            self._journal_retire(request)
         self._prefix_hydrating.clear()
         self._pending_emits.clear()
         self._finished_requests.clear()
@@ -3341,6 +3612,325 @@ class TpuServingEngine:
                 attributes={"generated": len(request.generated)},
             )
         request.preempt_time = None
+
+    # ------------------------------------------------------------------
+    # device-survival plane: adaptive pool-shrink + crash-requeue
+    # (docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+
+    def _shed_stranded(self, slot_id: int, error: Exception) -> None:
+        """Shed one stranded (never-prefilled) request whose dispatch
+        keeps failing past the shrink retry cap: the device demonstrably
+        cannot serve it right now, so the answer is an explicit
+        ``RateLimited`` + Retry-After — the gateway/router resends to a
+        replica with memory — never an unbounded admit→OOM→requeue
+        livelock and never a silent drop."""
+        slot = self.slots[slot_id]
+        request = slot.request
+        slot.request = None
+        slot.prefilling = False
+        slot.prefill_done = 0
+        self._lengths[slot_id] = 0
+        if self.block_mgr is not None:
+            self.block_mgr.release(slot_id)
+        self.flight.event(
+            "shed", reason="device-oom", tenant=request.tenant,
+            priority=request.priority, retry_after_s=2.0,
+            retries=request.preemptions,
+        )
+        self._journey(
+            request, "shed", reason="device-oom",
+            retries=request.preemptions,
+        )
+        if self._m_shed is not None:
+            self._m_shed(1)
+        if not request.warmup:
+            self._slo_record("availability", False)
+        self._journal_retire(request)
+        if not request.future.done():
+            request.future.set_exception(
+                RateLimited(
+                    "device-oom", 2.0,
+                    f"device memory pressure persisted across "
+                    f"{request.preemptions} adaptation retries "
+                    f"({type(error).__name__}: {error}); retry another "
+                    f"replica",
+                )
+            )
+
+    def _shrink_victim(self) -> int | None:
+        """The next preemption victim under device memory pressure: the
+        occupied slot in the LOWEST priority class, breaking ties on
+        least generated progress (cheapest byte-identical resume).
+        Prefilling slots are eligible — their worst-case reservations
+        are exactly the bytes the shrink needs back."""
+        best = None
+        best_key = None
+        for slot_id, slot in enumerate(self.slots):
+            request = slot.request
+            if request is None:
+                continue
+            key = (
+                -priority_rank(request.priority),  # lowest class first
+                len(request.generated),            # cheapest redo
+            )
+            if best_key is None or key < best_key:
+                best, best_key = slot_id, key
+        return best
+
+    def _maybe_pool_shrink(self, error: Exception) -> bool:
+        """Adapt to a device allocator failure instead of dying: withhold
+        one shrink quantum from the KV admission budget, preempt the
+        lowest-class victims until the surviving reservations fit it
+        (requeued FRONT-of-class — resume is the PR 4 byte-identical
+        path), and arm the recovery probe. Runs on the loop thread from
+        the loop's exception edge — no dispatch is in flight (the failed
+        one already raised; an abandoned pipelined chunk re-derives on
+        the next dispatch from unchanged host state, greedy-identically).
+        Returns False when nothing could be adapted (budget at its floor
+        AND nothing to preempt) — the caller falls through to the loud
+        ``_fail_inflight`` path, never a silent retry loop."""
+        bm = self.block_mgr
+        if bm is None:
+            return False
+        # cause before effect in the event ring: a fault injected on the
+        # dispatch thread emits here, ahead of its pool-shrink evidence
+        self._drain_fault_events()
+        quantum = max(
+            1, int(bm.configured_blocks * self.config.shrink_fraction)
+        )
+        reduced = bm.reduce_budget(quantum)
+        reserved_before = bm.reserved_blocks
+        # adaptation a catch site already performed inline this pass
+        preempted = self._shrink_inline_preempted
+        shed = self._shrink_inline_shed
+        self._shrink_inline_preempted = 0
+        self._shrink_inline_shed = 0
+        # FIRST: sweep slots whose monolithic prefill never completed —
+        # the failed dispatch may have been their prefill, so no KV was
+        # ever written (_lengths still 0, prefilling False). Left in
+        # place they would join the next decode burst and emit garbage
+        # from unwritten cache rows; requeued they re-prefill correctly.
+        # (Chunked prefills are excluded by prefilling=True and resume
+        # from their committed prefill_done either way.) Retries are
+        # BOUNDED: a request whose dispatch keeps failing even as the
+        # budget hits its floor would otherwise livelock the loop in an
+        # admit→OOM→requeue cycle forever — past the cap it is shed
+        # LOUDLY (RateLimited + Retry-After: another replica may have
+        # the memory this one demonstrably does not).
+        for slot_id, slot in enumerate(self.slots):
+            if (
+                slot.request is not None
+                and not slot.prefilling
+                and int(self._lengths[slot_id]) == 0
+            ):
+                if slot.request.preemptions >= _SHRINK_RETRY_CAP:
+                    self._shed_stranded(slot_id, error)
+                    shed += 1
+                else:
+                    self._preempt_slot(slot_id, reason="pool-shrink")
+                    preempted += 1
+        while bm.reserved_blocks > bm.usable_blocks:
+            victim = self._shrink_victim()
+            if victim is None:
+                break
+            self._preempt_slot(victim, reason="pool-shrink")
+            preempted += 1
+        if reduced == 0 and preempted == 0 and shed == 0:
+            return False
+        now = time.monotonic()
+        self.pool_shrinks += 1
+        self.shrink_preempted += preempted
+        self._shrink_recover_at = now + self.config.shrink_recovery_s
+        if self._m_shrinks is not None:
+            self._m_shrinks(1)
+        if self._m_budget is not None:
+            self._m_budget(bm.usable_blocks)
+        # the evidence event PRECEDES any admission against the reduced
+        # budget (same loop pass): site + error text, what was withheld,
+        # what preemption freed, and the budget admissions now face
+        self.flight.event(
+            "pool-shrink",
+            site=getattr(error, "fault_site", None) or "device",
+            error=f"{type(error).__name__}: {error}"[:160],
+            withheld_blocks=reduced,
+            withheld_bytes=reduced * self._kv_block_bytes,
+            freed_blocks=reserved_before - bm.reserved_blocks,
+            freed_bytes=(
+                (reserved_before - bm.reserved_blocks)
+                * self._kv_block_bytes
+            ),
+            preempted=preempted,
+            shed=shed,
+            budget_blocks=bm.usable_blocks,
+            configured_blocks=bm.configured_blocks,
+            recovery_s=self.config.shrink_recovery_s,
+        )
+        log.warning(
+            "device memory pressure (%s): KV budget shrunk to %d/%d "
+            "blocks, %d victims requeued front-of-class",
+            type(error).__name__, bm.usable_blocks, bm.configured_blocks,
+            preempted,
+        )
+        return True
+
+    def _shrink_step(self) -> None:
+        """Recovery probe (loop safe point, wait-free): after one quiet
+        ``shrink_recovery_s`` window — no further allocator failures,
+        which would have pushed ``_shrink_recover_at`` out — restore one
+        shrink quantum. Staged, not all-at-once: if the pressure is
+        still there, the next failure re-shrinks immediately and the
+        thrash is visible in the event ring (engine_top --analyze flags
+        it) instead of oscillating the whole budget."""
+        at = self._shrink_recover_at
+        bm = self.block_mgr
+        if at is None or bm is None or time.monotonic() < at:
+            return
+        quantum = max(
+            1, int(bm.configured_blocks * self.config.shrink_fraction)
+        )
+        restored = bm.restore_budget(quantum)
+        if restored:
+            self.pool_restores += 1
+            if self._m_restores is not None:
+                self._m_restores(1)
+            if self._m_budget is not None:
+                self._m_budget(bm.usable_blocks)
+            self.flight.event(
+                "pool-restore",
+                restored_blocks=restored,
+                restored_bytes=restored * self._kv_block_bytes,
+                budget_blocks=bm.usable_blocks,
+                configured_blocks=bm.configured_blocks,
+            )
+        if bm.budget_reduction == 0:
+            self._shrink_recover_at = None
+            self._wake.set()  # restored headroom is an admission signal
+        else:
+            self._shrink_recover_at = (
+                time.monotonic() + self.config.shrink_recovery_s
+            )
+
+    def _replay_journal(self, loop) -> None:
+        """Requeue the previous process's admitted-but-unfinished
+        journal entries FRONT-of-class (the drain/preemption resume
+        path), ahead of anything this process accepted since. The
+        original callers' futures died with their process — each replay
+        gets a fresh future whose completion (or explicit failure)
+        retires the entry, so the journal converges to empty exactly
+        once per entry."""
+        entries, self._journal_replay_pending = (
+            self._journal_replay_pending, []
+        )
+        replayed = 0
+        # reversed: each requeues at the FRONT of its class, so
+        # newest-first preserves the original admit order
+        for entry in reversed(entries):
+            try:
+                tokens = [int(t) for t in entry["prompt"]]
+                # the same clamps generate() applies at accept time: the
+                # restarted engine may run a smaller max-seq-len/pool
+                # than the one that journaled the entry
+                max_prompt = self.model_config.max_seq_len - 2
+                if len(tokens) > max_prompt:
+                    tokens = tokens[-max_prompt:]
+                max_tokens = min(
+                    int(entry["max-tokens"]),
+                    self.model_config.max_seq_len - len(tokens) - 1,
+                )
+                if max_tokens < 1 or (
+                    self.block_mgr is not None
+                    and not self.block_mgr.fits_ever(
+                        len(tokens) + max_tokens + 1
+                    )
+                ):
+                    # generate() refuses never-fitting requests up front
+                    # and admission relies on that invariant — a replayed
+                    # entry that can no longer fit would head-block
+                    # admission FOREVER (and re-wedge every restart, as
+                    # it is never answered and so never retired). Refuse
+                    # it loudly instead.
+                    raise ValueError(
+                        "request no longer fits the restarted engine's "
+                        "KV pool"
+                    )
+                request = _Request(
+                    prompt_tokens=tokens,
+                    max_tokens=max_tokens,
+                    temperature=float(entry.get("temperature", 0.0)),
+                    top_k=int(entry.get("top-k", 0)),
+                    top_p=float(entry.get("top-p", 1.0)),
+                    on_token=None,
+                    future=loop.create_future(),
+                    loop=loop,
+                    enqueue_time=time.monotonic(),
+                    stop=_normalize_stop(entry.get("stop")),
+                    presence_penalty=float(
+                        entry.get("presence-penalty", 0.0)
+                    ),
+                    frequency_penalty=float(
+                        entry.get("frequency-penalty", 0.0)
+                    ),
+                    tenant=str(entry.get("tenant", "") or ""),
+                    priority=normalize_priority(entry.get("priority")),
+                )
+            except (KeyError, TypeError, ValueError) as e:
+                # a corrupt entry is retired loudly, never replayed as
+                # garbage and never left to wedge every future restart
+                log.error("journal entry unreplayable (%s): %r", e, entry)
+                self.journal.retire(entry.get("id"))
+                continue
+            request.journey_id = entry.get("id")
+            # nobody awaits a replayed future: swallow its outcome so a
+            # shed replay can't die as "exception never retrieved"
+            request.future.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None
+            )
+            self._journey(request, "journal-replay")
+            self.scheduler.requeue_front(request)
+            replayed += 1
+        if replayed:
+            self.journal.note_replayed(replayed)
+            self.flight.event("journal-replay", requests=replayed)
+            log.info(
+                "journal replay: %d admitted-but-unfinished requests "
+                "requeued front-of-class", replayed,
+            )
+
+    def _journal_retire(self, request: "_Request") -> None:
+        """Retire one request's journal entry (finish/shed/fail — every
+        path that ANSWERS the caller). Wait-free: a deque append."""
+        if self.journal is not None and not request.warmup:
+            self.journal.retire(request.journey_id)
+            if self._m_journal_depth is not None:
+                self._m_journal_depth(self.journal.depth())
+
+    def survival_section(self) -> dict[str, Any]:
+        """The ``stats()["survival"]`` / flight-summary section: live
+        budget posture, shrink/restore counters, fault-injection state,
+        journal depth. Wait-free (attribute reads + small copies) — the
+        autoscaler's fan-in and ``engine_top`` read it from
+        ``/flight/summary``."""
+        bm = self.block_mgr
+        out: dict[str, Any] = {
+            "shrinks": self.pool_shrinks,
+            "restores": self.pool_restores,
+            "shrink_preempted": self.shrink_preempted,
+            "recovery_s": self.config.shrink_recovery_s,
+            "recovering": self._shrink_recover_at is not None,
+        }
+        if bm is not None:
+            out["budget_blocks"] = bm.usable_blocks
+            out["configured_blocks"] = bm.configured_blocks
+            out["withheld_blocks"] = bm.budget_reduction
+            out["withheld_bytes"] = (
+                bm.budget_reduction * self._kv_block_bytes
+            )
+        if self._faults is not None:
+            out["faults"] = self._faults.stats()
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
+        return out
 
     # ------------------------------------------------------------------
     # tiered prefix store (serving/prefixstore.py, docs/PREFIX.md)
@@ -3684,18 +4274,25 @@ class TpuServingEngine:
             if not live:
                 return
             tokens = np.zeros((self.config.slots, D1), dtype=np.int32)
-            grown = 0
+            self._fault("pool-grow")
+            grown_blocks = grown_slots = 0
             drafted_real: dict[int, int] = {}
             for slot_id in live:
-                grown += self.block_mgr.ensure_capacity(
+                n = self.block_mgr.ensure_capacity(
                     slot_id, min(int(self._lengths[slot_id]) + D1, S)
                 )
+                grown_blocks += n
+                grown_slots += bool(n)
                 tokens[slot_id, 0] = self._current[slot_id]
                 drafts, n_real = self._draft_tokens(slot_id, D)
                 drafted_real[slot_id] = n_real
                 tokens[slot_id, 1:] = drafts
-            if grown:
-                self.flight.event("pool-grow", slots=grown, phase="verify")
+            if grown_blocks:
+                self.flight.event(
+                    "pool-grow", slots=grown_slots, blocks=grown_blocks,
+                    bytes=grown_blocks * self._kv_block_bytes,
+                    phase="verify",
+                )
             tables = self.block_mgr.tables.copy()
             active_mask = np.zeros(self.config.slots, dtype=bool)
             active_mask[live] = True
@@ -3877,6 +4474,7 @@ class TpuServingEngine:
         recorder subtracts from wall time to expose the host share."""
         B = self.config.slots
         n = k_steps * B
+        self._fault("fetch")
         t_dev = time.monotonic()
         flat = np.asarray(packed)
         fetch_s = time.monotonic() - t_dev
@@ -4036,8 +4634,9 @@ class TpuServingEngine:
             round-trip)."""
             if not paged:
                 return None
+            self._fault("pool-grow")
             S = self.model_config.max_seq_len
-            grown = 0
+            grown_blocks = grown_slots = 0
             for slot_id in active:
                 request = self.slots[slot_id].request
                 if request is not None:
@@ -4051,9 +4650,15 @@ class TpuServingEngine:
                         int(self._lengths[slot_id]) + (pending_chunks + 1) * K,
                         cap, S,
                     )
-                    grown += self.block_mgr.ensure_capacity(slot_id, need)
-            if grown:
-                self.flight.event("pool-grow", slots=grown, phase="decode")
+                    n = self.block_mgr.ensure_capacity(slot_id, need)
+                    grown_blocks += n
+                    grown_slots += bool(n)
+            if grown_blocks:
+                self.flight.event(
+                    "pool-grow", slots=grown_slots, blocks=grown_blocks,
+                    bytes=grown_blocks * self._kv_block_bytes,
+                    phase="decode",
+                )
             return self.block_mgr.tables.copy()
 
         def _dispatch(tokens, lengths, key, window, tables, decode_fn,
@@ -4350,6 +4955,7 @@ class TpuServingEngine:
         # reservation is exactly what blocks live admissions)
         for i, s in enumerate(self.slots):
             if s.prefilling and s.request.future.cancelled():
+                self._journal_retire(s.request)
                 s.request = None
                 s.prefilling = False
                 s.prefill_done = 0
@@ -4391,6 +4997,7 @@ class TpuServingEngine:
         key = self._split_key()
 
         def _run():
+            self._fault("prefill")
             if self._lockstep is not None:
                 self._lockstep.broadcast(
                     {
@@ -4500,6 +5107,9 @@ class TpuServingEngine:
                     break
                 if request.future.cancelled():
                     self.scheduler.pop()  # caller gave up while queued
+                    # the caller walked away — answered by cancellation,
+                    # so a restart must not replay it
+                    self._journal_retire(request)
                     continue
                 # one chain-digest walk per admission attempt, shared by
                 # the hydration check, the promotion, and match_prefix
@@ -4594,11 +5204,45 @@ class TpuServingEngine:
                     )
                     if blocks:
                         self.block_mgr.adopt_prefix(slot_id, blocks)
-                    self.block_mgr.ensure_capacity(slot_id, len(ctx))
                     slot = self.slots[slot_id]
+                    # slot claimed BEFORE the physical grow: an allocator
+                    # failure below is then recoverable (a popped request
+                    # in no slot would be invisible to every failure
+                    # path). The chunked claim must undo ITSELF on a
+                    # grow failure: a prefilling slot whose table never
+                    # grew would scatter its chunks into the scratch
+                    # block (silent corruption), and the shrink sweep
+                    # deliberately leaves prefilling slots alone —
+                    # requeue (or shed past the retry cap) HERE, then
+                    # re-raise so the loop's shrink pass still adapts.
                     slot.request = request
                     slot.prefilling = True
                     slot.prefill_done = reuse
+                    try:
+                        self._fault("pool-grow")
+                        self.block_mgr.ensure_capacity(slot_id, len(ctx))
+                    except Exception as e:
+                        # monolithic members selected earlier this pass
+                        # are popped + reserved but NOT yet slotted —
+                        # invisible to every failure path (the shrink
+                        # sweep and _fail_inflight both walk slots):
+                        # undo them first, reservations released and
+                        # requeued front in order
+                        for sid, req, _r in reversed(batch):
+                            self.block_mgr.release(sid)
+                            self.scheduler.requeue_front(req)
+                        batch.clear()
+                        if not self._resource_exhausted(e):
+                            raise
+                        if request.preemptions >= _SHRINK_RETRY_CAP:
+                            self._shed_stranded(slot_id, e)
+                            self._shrink_inline_shed += 1
+                        else:
+                            self._preempt_slot(
+                                slot_id, reason="pool-shrink"
+                            )
+                            self._shrink_inline_preempted += 1
+                        raise
                     request.admit_time = time.monotonic()
                     self._note_resume(request)
                     self._journey(request, "admit", chunked=True)
@@ -4632,7 +5276,13 @@ class TpuServingEngine:
                 request.admit_time = admit_now
                 self._note_resume(request)
                 self._journey(request, "admit")
-                if self.block_mgr is not None:
+            # physical grows AFTER every batch member owns its slot: an
+            # allocator failure here is then recoverable by the shrink
+            # pass's preempt-and-requeue sweep (a popped request in no
+            # slot would be invisible to every failure path)
+            if self.block_mgr is not None:
+                self._fault("pool-grow")
+                for slot_id, request, _reuse in batch:
                     self.block_mgr.ensure_capacity(
                         slot_id, len(request.context_tokens)
                     )
@@ -4681,6 +5331,7 @@ class TpuServingEngine:
                 program = self._program_prefill(bucket, Bp, prefill_mode)
 
             def _run():
+                self._fault("prefill")
                 if self._lockstep is not None:
                     desc = {
                         "sampler_mode": list(prefill_mode),
@@ -4943,6 +5594,9 @@ class TpuServingEngine:
             # tenant tokens/s accounting (QoS post-debit): cancelled
             # requests debit too — their tokens burned engine capacity
             self.scheduler.on_finished(request)
+            # crash-requeue journal: the request is ANSWERED (result,
+            # cancellation — either way nothing is left to replay)
+            self._journal_retire(request)
             if request.imported and not request.first_step_noted:
                 # finished inside its first emit batch: the slot is
                 # already released, so the scan above never saw it
@@ -5077,6 +5731,10 @@ def flight_report(
             # autoscalers classify replicas off this same summary
             "pool_role": engine.config.pool_role,
             "kvtransfer": engine.kv_transfer_section(),
+            # device-survival posture (docs/RESILIENCE.md): the
+            # autoscaler reads pool-shrink pressure off this same
+            # summary, engine_top renders the survival panel from it
+            "survival": engine.survival_section(),
         }
         if engine.prefix_store is not None:
             # tier hit/byte/budget posture: rides /flight/summary so

@@ -1,0 +1,197 @@
+"""Deterministic fault-injection plane for the serving engine.
+
+BENCH rounds r03/r04 were lost to the device outright (RESOURCE_EXHAUSTED
+cascades; a pod unresponsive after 150 s) and nothing could *reproduce*
+those failures on demand — every survival mechanism shipped untested
+against the exact shape it exists for. This module makes device failure a
+first-class, scriptable test input (docs/RESILIENCE.md):
+
+- :class:`FaultPlan` — one declared fault: the engine **site** it fires at
+  (``pool-grow`` / ``prefill`` / ``scatter`` / ``fetch``), how many passes
+  through the site to skip first (``after``), how many times it fires
+  before disarming (``count``), and its **shape** — ``oom`` raises a
+  synthetic allocator failure whose message matches the real jaxlib
+  RESOURCE_EXHAUSTED spellings, ``hang`` stalls the call for ``hang_ms``
+  (the r03 unresponsive-device shape: the dispatch never returns, the
+  watchdog heartbeat stops, ``/healthz`` must flip).
+- :class:`FaultInjector` — the armed registry the engine's device-touching
+  seams consult. Arming is explicit (``ServingConfig.faults`` or the
+  ``LS_TPU_FAULTS`` env var, **tests and chaos drills only**); a
+  production engine carries ``None`` and every seam check compiles down
+  to one attribute test. Every fired fault is returned to the engine so
+  it emits a ``fault-injected`` flight event — chaos assertions read the
+  event ring, they never guess whether the fault actually landed.
+
+Determinism contract: ``after``/``count`` are plain pass counters per
+plan, bumped at the site (single-threaded per site: the engine loop or
+the one dispatch thread), so a chaos test can aim a fault at exactly the
+N-th pool-grow of a flood and get the same burst every run. The module
+never imports jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+#: engine seams a plan may target (docs/RESILIENCE.md fault-site table)
+FAULT_SITES = ("pool-grow", "prefill", "scatter", "fetch")
+
+#: fault shapes: a synthetic allocator refusal, or a stalled dispatch
+FAULT_SHAPES = ("oom", "hang")
+
+#: the default synthetic message — spelled like the real jaxlib failure so
+#: the engine's ``_resource_exhausted`` classifier treats injected and
+#: genuine faults identically (that equivalence is the whole point)
+_DEFAULT_MESSAGE = "RESOURCE_EXHAUSTED: injected device allocator failure"
+
+
+class InjectedFault(RuntimeError):
+    """A synthetic device failure raised at an armed engine seam. Carries
+    the site so the shrink machinery's evidence names where it fired."""
+
+    def __init__(self, site: str, message: str):
+        super().__init__(message)
+        self.fault_site = site
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One declared fault (frozen/hashable: rides ``ServingConfig``)."""
+
+    site: str
+    shape: str = "oom"
+    #: passes through the site to let through before the first fire
+    after: int = 0
+    #: times the fault fires before disarming (fail-then-recover)
+    count: int = 1
+    #: stall duration for ``shape="hang"`` (milliseconds)
+    hang_ms: float = 0.0
+    message: str = _DEFAULT_MESSAGE
+
+    def __post_init__(self):
+        if self.site not in FAULT_SITES:
+            raise ValueError(
+                f"fault site must be one of {list(FAULT_SITES)}, "
+                f"got {self.site!r}"
+            )
+        if self.shape not in FAULT_SHAPES:
+            raise ValueError(
+                f"fault shape must be one of {list(FAULT_SHAPES)}, "
+                f"got {self.shape!r}"
+            )
+        if self.after < 0:
+            raise ValueError("fault after must be >= 0")
+        if self.count < 1:
+            raise ValueError("fault count must be >= 1")
+        if self.shape == "hang" and self.hang_ms <= 0:
+            raise ValueError("hang faults need hang-ms > 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "site": self.site,
+            "shape": self.shape,
+            "after": self.after,
+            "count": self.count,
+            "hang-ms": self.hang_ms,
+            "message": self.message,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FaultPlan":
+        if isinstance(d, FaultPlan):
+            return d
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"fault plan must be a mapping, got {type(d).__name__}"
+            )
+        return cls(
+            site=str(d.get("site", "")),
+            shape=str(d.get("shape", "oom")),
+            after=int(d.get("after", 0)),
+            count=int(d.get("count", 1)),
+            hang_ms=float(d.get("hang-ms", d.get("hang_ms", 0.0))),
+            message=str(d.get("message", _DEFAULT_MESSAGE)),
+        )
+
+
+def plans_from_env(env: dict | None = None) -> tuple[FaultPlan, ...]:
+    """Parse ``LS_TPU_FAULTS`` (a JSON list of plan dicts) — the arm path
+    for chaos drills against a deployed pod. Malformed JSON raises: a
+    chaos run whose faults silently failed to arm would assert against a
+    healthy engine and "pass"."""
+    raw = (env if env is not None else os.environ).get("LS_TPU_FAULTS", "")
+    if not raw.strip():
+        return ()
+    parsed = json.loads(raw)
+    if not isinstance(parsed, list):
+        raise ValueError("LS_TPU_FAULTS must be a JSON list of fault plans")
+    return tuple(FaultPlan.from_dict(p) for p in parsed)
+
+
+@dataclasses.dataclass
+class FaultAction:
+    """What the engine must do for one fired fault."""
+
+    site: str
+    shape: str
+    hang_ms: float
+    message: str
+    #: 1-based fire index within the plan (event evidence)
+    seq: int
+
+
+class FaultInjector:
+    """The armed per-engine registry. ``fire(site)`` is consulted at each
+    seam pass — the seams span the engine loop AND the dispatch thread,
+    so the pass/fire counters live under one tiny lock (uncontended:
+    the two threads alternate by construction, and the injector only
+    exists at all when a test armed it), returning the
+    :class:`FaultAction` to perform or ``None``. One plan fires per pass
+    even when several target the same site (deterministic ordering:
+    declaration order)."""
+
+    def __init__(self, plans: tuple[FaultPlan, ...]):
+        import threading
+
+        self.plans = tuple(plans)
+        self._lock = threading.Lock()
+        self._seen = [0] * len(self.plans)
+        self._fired = [0] * len(self.plans)
+
+    def fire(self, site: str) -> FaultAction | None:
+        with self._lock:
+            for i, plan in enumerate(self.plans):
+                if plan.site != site:
+                    continue
+                self._seen[i] += 1
+                if self._seen[i] <= plan.after:
+                    continue
+                if self._fired[i] >= plan.count:
+                    continue  # disarmed: fail-then-recover
+                self._fired[i] += 1
+                return FaultAction(
+                    site=site,
+                    shape=plan.shape,
+                    hang_ms=plan.hang_ms,
+                    message=plan.message,
+                    seq=self._fired[i],
+                )
+        return None
+
+    def stats(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [
+                {
+                    "site": plan.site,
+                    "shape": plan.shape,
+                    "after": plan.after,
+                    "count": plan.count,
+                    "seen": self._seen[i],
+                    "fired": self._fired[i],
+                    "armed": self._fired[i] < plan.count,
+                }
+                for i, plan in enumerate(self.plans)
+            ]

@@ -53,8 +53,16 @@ work failed — the ``reason`` field tells them apart), ``resume`` (a
 preempted request re-admitted), ``shed`` (a request refused by QoS
 policy: tenant throttle or full class queue), ``lockstep-divergence``,
 ``health`` (a watchdog state transition — ok/degraded/wedged, with the
-stall evidence; serving/health.py), and ``alert`` (an SLO objective's
-multi-window burn rate crossed the page threshold, or recovered).
+stall evidence; serving/health.py), ``alert`` (an SLO objective's
+multi-window burn rate crossed the page threshold, or recovered), and
+the device-survival plane's events (docs/RESILIENCE.md): ``pool-shrink``
+(a device allocator failure shrank the KV admission budget — site,
+withheld/freed bytes, victims preempted, the new budget),
+``pool-restore`` (the recovery probe returned a shrink quantum),
+``fault-injected`` (a chaos-drill fault fired at an engine seam —
+serving/faults.py), and ``journal-replay``/``journal-evict`` (the
+crash-requeue journal replayed recovered work / shed its oldest entry
+at the bound).
 Under a QoS scheduler each sample additionally carries ``queue_by_class``
 (per-priority-class queue depths — what ``engine_top --analyze`` watches
 for sustained interactive-class growth).

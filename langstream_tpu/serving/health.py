@@ -100,6 +100,37 @@ def recompile_storm(
     return None
 
 
+def shrink_pressure(
+    events: list[dict[str, Any]],
+    now_s: float,
+    k: int = 2,
+) -> str | None:
+    """Sustained device memory pressure (docs/RESILIENCE.md): ≥ ``k``
+    ``pool-shrink`` events inside one recovery window of now — the
+    engine is adapting faster than it can recover, so the autoscaler
+    and ``/healthz`` must see DEGRADED, not a quietly shrinking budget.
+    The window comes from the events themselves (each carries its
+    ``recovery_s``); payloads without ``m_s`` stamps never flag."""
+    shrinks = [
+        e
+        for e in events
+        if e.get("kind") == "pool-shrink" and e.get("m_s") is not None
+    ]
+    if not shrinks:
+        return None
+    window = max(float(e.get("recovery_s") or 30.0) for e in shrinks)
+    recent = [e for e in shrinks if now_s - e["m_s"] <= window]
+    if len(recent) >= k:
+        last = max(e["m_s"] for e in recent)
+        return (
+            f"device memory pressure: {len(recent)} pool-shrink events "
+            f"inside one {window:.0f}s recovery window (last "
+            f"{now_s - last:.1f}s ago) — the KV budget is shrinking "
+            f"faster than it recovers"
+        )
+    return None
+
+
 def kv_saturation(
     samples: list[dict[str, Any]],
     frac: float = 0.95,
@@ -235,6 +266,7 @@ class EngineWatchdog:
                 recompile_storm(events or [], now),
                 kv_saturation(samples or []),
                 overlap_collapse(samples or []),
+                shrink_pressure(events or [], now),
             ):
                 if reason:
                     reasons.append(reason)

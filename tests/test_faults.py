@@ -1,0 +1,1157 @@
+"""Device-survival plane tests (docs/RESILIENCE.md).
+
+Layers covered: the fault-injection registry units (plan validation,
+arm/disarm fire counting, LS_TPU_FAULTS parsing), the broadened
+RESOURCE_EXHAUSTED classifier (one test per jaxlib spelling), the
+BlockManager budget surface (reduce/restore clamps + a shrink/restore
+storm whose ledger must stay exact), the crash-requeue journal units
+(admit/retire/compaction/eviction/torn lines), the chaos e2e acceptance
+(injected OOM at pool-grow mid-flood → pool-shrink with evidence →
+every request completes byte-identically → budget restores; injected
+hang → watchdog WEDGED → ``/healthz`` 503 → recovery), journal
+replay-after-restart (zero silent loss, exactly-once retire,
+front-of-class order), the default-config hot path staying bit-for-bit
+(no injector, zero survival counters, identical greedy tokens), and the
+downstream consumers: the health shrink-pressure predicate, the
+autoscaler's pool-shrink signal, engine_top's survival panel + thrash
+flag, the oom_storm bench phase, and perf_diff's worse-directions.
+"""
+
+import asyncio
+import importlib.util
+import json
+import random
+import time
+from pathlib import Path
+
+import pytest
+
+from langstream_tpu.models.paged import BlockManager, PagedLayout
+from langstream_tpu.serving.faults import (
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    plans_from_env,
+)
+from langstream_tpu.serving.journal import RequestJournal
+
+
+def _load_tool(name: str):
+    path = Path(__file__).resolve().parents[1] / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _base_config(**kw):
+    from langstream_tpu.serving.engine import ServingConfig
+
+    d = dict(
+        model="tiny", slots=4, max_seq_len=192, model_dtype="float32",
+        kv_layout="paged", kv_block_size=16, decode_chunk=4,
+        default_max_tokens=24, shrink_recovery_s=0.3,
+    )
+    d.update(kw)
+    return ServingConfig(**d)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / FaultInjector units
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_validation_rejects():
+    with pytest.raises(ValueError):
+        FaultPlan(site="nonsense")
+    with pytest.raises(ValueError):
+        FaultPlan(site="pool-grow", shape="explode")
+    with pytest.raises(ValueError):
+        FaultPlan(site="pool-grow", count=0)
+    with pytest.raises(ValueError):
+        FaultPlan(site="pool-grow", after=-1)
+    with pytest.raises(ValueError):
+        FaultPlan(site="prefill", shape="hang", hang_ms=0)
+
+
+def test_fault_plan_round_trip():
+    plan = FaultPlan(site="prefill", shape="hang", after=3, count=2,
+                     hang_ms=250.0)
+    assert FaultPlan.from_dict(plan.to_dict()) == plan
+
+
+def test_injector_fires_after_then_disarms():
+    inj = FaultInjector((FaultPlan(site="pool-grow", after=2, count=2),))
+    # two passes let through, then exactly two fires, then disarmed
+    assert inj.fire("pool-grow") is None
+    assert inj.fire("pool-grow") is None
+    a1 = inj.fire("pool-grow")
+    a2 = inj.fire("pool-grow")
+    assert a1 is not None and a1.seq == 1
+    assert a2 is not None and a2.seq == 2
+    assert inj.fire("pool-grow") is None  # fail-then-recover
+    assert inj.fire("prefill") is None    # other sites untouched
+    st = inj.stats()[0]
+    assert st["fired"] == 2 and not st["armed"]
+
+
+def test_plans_from_env_parse_and_reject():
+    env = {"LS_TPU_FAULTS": json.dumps(
+        [{"site": "fetch", "shape": "oom", "after": 1}]
+    )}
+    (plan,) = plans_from_env(env)
+    assert plan.site == "fetch" and plan.after == 1
+    assert plans_from_env({}) == ()
+    with pytest.raises(ValueError):
+        plans_from_env({"LS_TPU_FAULTS": "{\"site\": \"fetch\"}"})
+    with pytest.raises(Exception):
+        plans_from_env({"LS_TPU_FAULTS": "not json"})
+
+
+# ---------------------------------------------------------------------------
+# the RESOURCE_EXHAUSTED classifier: one test per jaxlib spelling
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "message",
+    [
+        "RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes",
+        "Out of memory while trying to allocate 17179869184 bytes",
+        "Failed to allocate request for 1.20GiB (1288490189B) on device",
+        "Allocation of 4096000000 bytes exceeds 90% of free system memory",
+        "paged KV pool exhausted despite reservation accounting",
+    ],
+)
+def test_resource_exhausted_spellings(message):
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    assert TpuServingEngine._resource_exhausted(RuntimeError(message))
+
+
+def test_resource_exhausted_negative():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    for message in (
+        "ValueError: shapes do not match",
+        "connection reset by peer",
+        "INVALID_ARGUMENT: bad block table",
+    ):
+        assert not TpuServingEngine._resource_exhausted(
+            RuntimeError(message)
+        )
+
+
+def test_injected_fault_matches_classifier():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    err = InjectedFault("pool-grow", "RESOURCE_EXHAUSTED: injected")
+    assert TpuServingEngine._resource_exhausted(err)
+    assert err.fault_site == "pool-grow"
+
+
+# ---------------------------------------------------------------------------
+# BlockManager budget surface
+# ---------------------------------------------------------------------------
+
+
+def _mgr(num_blocks=33, block_size=16, max_seq=256, slots=4):
+    layout = PagedLayout(
+        block_size=block_size, num_blocks=num_blocks,
+        max_blocks_per_slot=-(-max_seq // block_size),
+    )
+    return BlockManager(layout, slots)
+
+
+def test_budget_reduce_restore_clamps():
+    mgr = _mgr()  # 32 usable, floor = max_blocks_per_slot = 16
+    assert mgr.configured_blocks == 32
+    assert mgr.reduce_budget(10) == 10
+    assert mgr.usable_blocks == 22
+    # clamped at the floor: only 6 more can be withheld
+    assert mgr.reduce_budget(100) == 6
+    assert mgr.usable_blocks == 16
+    assert mgr.reduce_budget(1) == 0  # at the floor
+    assert mgr.restore_budget(4) == 4
+    assert mgr.usable_blocks == 20
+    assert mgr.restore_budget() == 12  # the rest
+    assert mgr.usable_blocks == 32 and mgr.budget_reduction == 0
+    assert mgr.restore_budget(5) == 0  # nothing withheld
+
+
+def test_budget_gates_admission_and_used_ratio():
+    mgr = _mgr()
+    assert mgr.can_admit(16 * 16)  # a max-size slot fits the fresh pool
+    mgr.admit(0, 10 * 16)
+    assert mgr.used_ratio() == pytest.approx(10 / 32)
+    mgr.reduce_budget(16)  # usable 16 < reserved 10 + need 10
+    assert not mgr.can_admit(10 * 16)
+    assert mgr.can_admit(6 * 16)
+    assert mgr.used_ratio() == pytest.approx(10 / 16)
+    mgr.restore_budget()
+    assert mgr.can_admit(10 * 16)
+    stats = mgr.stats()
+    assert stats["budget_blocks"] == 32 and stats["withheld_blocks"] == 0
+
+
+def test_ensure_capacity_returns_block_count():
+    mgr = _mgr()
+    mgr.admit(0, 80)  # 5 blocks reserved
+    assert mgr.ensure_capacity(0, 40) == 3
+    assert mgr.ensure_capacity(0, 40) == 0
+    assert mgr.ensure_capacity(0, 80) == 2
+
+
+def test_budget_ledger_exact_under_shrink_restore_storm():
+    """Property test: a random storm of admit/release/reduce/restore ops
+    never breaks the budget invariants — usable stays within
+    [floor, configured], reduction always equals configured - usable,
+    full restore returns exactly to configured, and reservation
+    accounting is untouched by budget moves."""
+    rng = random.Random(1234)
+    mgr = _mgr(num_blocks=65, slots=8)
+    floor = min(mgr.layout.max_blocks_per_slot, mgr.configured_blocks)
+    admitted: dict[int, int] = {}
+    for _ in range(600):
+        op = rng.choice(("admit", "release", "reduce", "restore"))
+        if op == "admit":
+            slot = rng.randrange(8)
+            tokens = rng.randrange(16, 200)
+            if slot not in admitted and mgr.can_admit(tokens):
+                mgr.admit(slot, tokens)
+                admitted[slot] = mgr.blocks_needed(tokens)
+        elif op == "release" and admitted:
+            slot = rng.choice(list(admitted))
+            mgr.release(slot)
+            del admitted[slot]
+        elif op == "reduce":
+            want = rng.randrange(0, 30)
+            got = mgr.reduce_budget(want)
+            assert got <= want
+        else:
+            want = rng.choice([None, rng.randrange(0, 30)])
+            before = mgr.budget_reduction
+            got = mgr.restore_budget(want)
+            assert got <= before
+        assert floor <= mgr.usable_blocks <= mgr.configured_blocks
+        assert (
+            mgr.budget_reduction
+            == mgr.configured_blocks - mgr.usable_blocks
+        )
+        assert mgr.reserved_blocks == sum(admitted.values())
+    mgr.restore_budget()
+    assert mgr.usable_blocks == mgr.configured_blocks
+
+
+# ---------------------------------------------------------------------------
+# ServingConfig round trip
+# ---------------------------------------------------------------------------
+
+
+def test_config_round_trips_survival_keys():
+    from langstream_tpu.serving.engine import ServingConfig
+
+    cfg = ServingConfig(
+        model="tiny", kv_layout="paged", shrink_fraction=0.25,
+        shrink_recovery_s=7.5, journal_dir="/tmp/j",
+        faults=(FaultPlan(site="fetch", after=1),),
+    )
+    back = ServingConfig.from_dict(cfg.to_dict())
+    assert back.shrink_fraction == 0.25
+    assert back.shrink_recovery_s == 7.5
+    assert back.journal_dir == "/tmp/j"
+    assert back.faults == (FaultPlan(site="fetch", after=1),)
+    # hashable: engines are singleton-cached by config
+    hash(back)
+
+
+def test_engine_rejects_bad_shrink_config():
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    with pytest.raises(ValueError):
+        TpuServingEngine(_base_config(shrink_fraction=0.0))
+    with pytest.raises(ValueError):
+        TpuServingEngine(_base_config(shrink_recovery_s=0.0))
+
+
+# ---------------------------------------------------------------------------
+# crash-requeue journal units
+# ---------------------------------------------------------------------------
+
+
+def _entry(i: int) -> dict:
+    return {
+        "id": f"req-{i}", "prompt": [1, 2, 3 + i], "max-tokens": 8,
+        "temperature": 0.0, "top-k": 0, "top-p": 1.0,
+        "presence-penalty": 0.0, "frequency-penalty": 0.0,
+        "stop": [], "tenant": f"t{i}", "priority": "default",
+    }
+
+
+def test_journal_admit_retire_and_reload(tmp_path):
+    j = RequestJournal(str(tmp_path))
+    for i in range(4):
+        j.admit(_entry(i))
+    j.retire("req-1")
+    j.retire("req-1")  # idempotent double retire
+    j.retire("never-admitted")
+    assert j.flush(5.0)
+    st = j.stats()
+    assert st["appended"] == 4 and st["retired"] == 1
+    j.close()
+    # a fresh journal (the restarted process) sees exactly the live set
+    j2 = RequestJournal(str(tmp_path))
+    pending = j2.pending()
+    assert [e["id"] for e in pending] == ["req-0", "req-2", "req-3"]
+    assert pending[0]["prompt"] == [1, 2, 3]
+    j2.close()
+
+
+def test_journal_bound_evicts_oldest_loudly(tmp_path):
+    evicted = []
+    j = RequestJournal(str(tmp_path), max_entries=3,
+                       on_evict=evicted.append)
+    for i in range(5):
+        j.admit(_entry(i))
+    assert j.flush(5.0)
+    assert evicted == ["req-0", "req-1"]
+    assert j.stats()["live"] == 3 and j.stats()["evicted"] == 2
+    j.close()
+    j2 = RequestJournal(str(tmp_path))
+    assert [e["id"] for e in j2.pending()] == ["req-2", "req-3", "req-4"]
+    j2.close()
+
+
+def test_journal_compacts_and_tolerates_torn_tail(tmp_path):
+    j = RequestJournal(str(tmp_path), max_entries=4)
+    # enough churn to exceed the 256-op compaction threshold
+    for i in range(200):
+        j.admit(_entry(i))
+        j.retire(f"req-{i}")
+    j.admit(_entry(999))
+    assert j.flush(10.0)
+    j.close()
+    path = tmp_path / "requests.jsonl"
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) <= 16  # compacted to ~the live set, not 401 ops
+    # torn trailing line (crash mid-append) is skipped, never fatal
+    with open(path, "a") as fh:
+        fh.write('{"op": "admit", "id": "torn-req", "pro')
+    j2 = RequestJournal(str(tmp_path))
+    assert [e["id"] for e in j2.pending()] == ["req-999"]
+    j2.close()
+
+
+def test_journal_refuses_mismatched_fingerprint(tmp_path):
+    """Entries journaled under a different model/tokenizer identity are
+    never offered for replay (their token ids mean nothing here), but
+    stay live — counted, never silently erased."""
+    j = RequestJournal(str(tmp_path), fingerprint={"model": "tiny"})
+    j.admit(_entry(0))
+    assert j.flush(5.0)
+    j.close()
+    other = RequestJournal(
+        str(tmp_path), fingerprint={"model": "llama-1b"}
+    )
+    assert other.pending() == []
+    assert other.stats()["mismatched"] == 1
+    assert other.stats()["live"] == 1  # preserved, not erased
+    other.close()
+    # the same identity replays it
+    same = RequestJournal(str(tmp_path), fingerprint={"model": "tiny"})
+    assert [e["id"] for e in same.pending()] == ["req-0"]
+    same.close()
+
+
+def test_journal_file_stays_bounded_across_restarts(tmp_path):
+    """The compaction threshold counts ops ON DISK, not the live set —
+    a crash-looping pod (many lives, each journaling a few ops) must
+    not grow the file without bound."""
+    for _ in range(6):
+        j = RequestJournal(str(tmp_path), max_entries=8)
+        for i in range(40):
+            j.admit(_entry(i))
+            j.retire(f"req-{i}")
+        assert j.flush(10.0)
+        j.close()
+    lines = (tmp_path / "requests.jsonl").read_text().splitlines()
+    # 6 lives x 80 ops = 480 ops written; the bound (max(256, 32)) must
+    # have compacted along the way instead of resetting every restart
+    assert len(lines) <= 256 + 80
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: injected OOM at pool-grow mid-flood
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_oom_at_pool_grow_byte_identical_and_recovers(run_async):
+    """The acceptance proof: a RESOURCE_EXHAUSTED burst injected at the
+    pool-grow seam mid-flood shrinks the budget (pool-shrink event with
+    evidence BEFORE any admission against it), every submitted request
+    still completes with byte-identical greedy output (f32), and the
+    recovery probe restores the full budget after the quiet window."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    prompts = [f"chaos request {i} says hello" for i in range(6)]
+
+    async def run(faults=()):
+        engine = TpuServingEngine(_base_config(faults=faults))
+        try:
+            outs = await asyncio.gather(*(
+                engine.generate(p, {"max-tokens": 16, "temperature": 0})
+                for p in prompts
+            ))
+            if faults:
+                for _ in range(100):
+                    if not engine.stats()["survival"]["withheld_blocks"]:
+                        break
+                    await asyncio.sleep(0.05)
+            survival = engine.stats()["survival"]
+            events = engine.flight.recent_events(0)
+            return outs, survival, events
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    base, surv0, _ = run_async(run())
+    assert surv0["shrinks"] == 0 and surv0["restores"] == 0
+
+    faults = (FaultPlan(site="pool-grow", after=3, count=2),)
+    outs, survival, events = run_async(run(faults))
+
+    # zero loss, byte-identical resumes (greedy, f32-pinned)
+    assert [o["text"] for o in outs] == [o["text"] for o in base]
+    assert [o["tokens"] for o in outs] == [o["tokens"] for o in base]
+    assert survival["shrinks"] >= 1
+    assert survival["restores"] >= 1
+    assert survival["withheld_blocks"] == 0  # fully recovered
+    kinds = [e["kind"] for e in events]
+    assert "fault-injected" in kinds
+    assert "pool-shrink" in kinds and "pool-restore" in kinds
+    shrink = next(e for e in events if e["kind"] == "pool-shrink")
+    # the evidence the issue demands: site, bytes, new budget
+    assert shrink["site"] == "pool-grow"
+    assert shrink["withheld_blocks"] >= 1
+    assert shrink["withheld_bytes"] > 0
+    assert shrink["budget_blocks"] < shrink["configured_blocks"]
+    assert shrink["recovery_s"] == pytest.approx(0.3)
+    # cause precedes effect in the ring
+    assert kinds.index("fault-injected") < kinds.index("pool-shrink")
+
+
+def test_chaos_oom_at_prefill_dispatch_byte_identical(run_async):
+    """An allocator failure in the PREFILL dispatch itself strands the
+    just-admitted batch in slots with no KV written — the shrink pass
+    must sweep those un-prefilled slots back to the queue (decoding
+    them would emit garbage from unwritten cache rows) and every
+    request must still complete byte-identically."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    prompts = [f"prefill fault request {i}" for i in range(5)]
+
+    async def run(faults=()):
+        engine = TpuServingEngine(_base_config(faults=faults))
+        try:
+            outs = await asyncio.gather(*(
+                engine.generate(p, {"max-tokens": 12, "temperature": 0})
+                for p in prompts
+            ))
+            return [o["text"] for o in outs], engine.stats()["survival"]
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    base, _ = run_async(run())
+    faults = (FaultPlan(site="prefill", shape="oom", count=1),)
+    texts, survival = run_async(run(faults))
+    assert texts == base
+    assert survival["shrinks"] >= 1
+
+
+def test_replay_refuses_request_that_no_longer_fits(tmp_path, run_async):
+    """A journaled request that can never fit the restarted engine's
+    pool is retired loudly instead of head-blocking admission forever
+    (and re-wedging every restart)."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    journal_dir = str(tmp_path / "jfit")
+    # hand-write a journal whose entry wants far more KV than the tiny
+    # pool can EVER hold (generate() would have refused it up front)
+    j = RequestJournal(
+        journal_dir,
+        fingerprint={"model": "tiny", "tokenizer": "byte"},
+    )
+    poison = dict(_entry(0), **{"prompt": list(range(64)),
+                                "max-tokens": 100000})
+    j.admit(poison)
+    j.admit(_entry(1))
+    assert j.flush(5.0)
+    j.close()
+
+    async def run():
+        engine = TpuServingEngine(
+            _base_config(journal_dir=journal_dir, slots=2)
+        )
+        try:
+            # a fresh request must still serve: the poison entry was
+            # refused (max-tokens clamps to the window; had it still
+            # not fit, fits_ever refuses) — never left to head-block
+            fresh = await engine.generate(
+                "post-restart request", {"max-tokens": 4,
+                                         "temperature": 0}
+            )
+            for _ in range(200):
+                if engine.journal.depth() == 0:
+                    break
+                await asyncio.sleep(0.05)
+            return fresh, engine.journal.stats()
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    fresh, stats = run_async(run())
+    assert fresh["tokens"]
+    assert stats["live"] == 0  # both entries answered or refused-retired
+
+
+def test_chaos_oom_at_chunked_prefill_grow_byte_identical(run_async):
+    """An allocator failure in the CHUNKED-prefill admission grow (the
+    slot is claimed, prefilling=True, but its table never grew) must
+    requeue that request — left in place its chunks would scatter into
+    the scratch block and read back silent garbage — and every request
+    still completes byte-identically."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    prompts = [
+        f"chunked prefill fault request number {i} with a longer prompt"
+        for i in range(4)
+    ]
+
+    async def run(faults=()):
+        engine = TpuServingEngine(
+            _base_config(faults=faults, prefill_chunk=8, slots=2)
+        )
+        try:
+            outs = await asyncio.gather(*(
+                engine.generate(p, {"max-tokens": 10, "temperature": 0})
+                for p in prompts
+            ))
+            return [o["text"] for o in outs], engine.stats()["survival"]
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    base, _ = run_async(run())
+    faults = (FaultPlan(site="pool-grow", shape="oom", count=1),)
+    texts, survival = run_async(run(faults))
+    assert texts == base
+    assert survival["shrinks"] >= 1
+
+
+def test_persistent_prefill_failure_sheds_instead_of_livelocking(run_async):
+    """A dispatch that fails EVERY time (pressure that never clears)
+    must not livelock the loop in an admit→OOM→requeue cycle: after the
+    bounded retry cap the request is shed loudly with RateLimited +
+    Retry-After, and the engine keeps serving."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+    from langstream_tpu.serving.qos import RateLimited
+
+    faults = (FaultPlan(site="prefill", shape="oom", count=1000),)
+
+    async def run():
+        engine = TpuServingEngine(_base_config(faults=faults))
+        try:
+            with pytest.raises(RateLimited) as e:
+                await asyncio.wait_for(
+                    engine.generate("doomed request",
+                                    {"max-tokens": 8, "temperature": 0}),
+                    timeout=20.0,
+                )
+            events = engine.flight.recent_events(0)
+            return e.value, events
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    err, events = run_async(run())
+    assert err.reason == "device-oom"
+    assert err.retry_after > 0
+    sheds = [e for e in events
+             if e["kind"] == "shed" and e.get("reason") == "device-oom"]
+    assert sheds and sheds[0]["retries"] >= 3
+
+
+def test_prefill_pool_handoff_retires_journal(tmp_path, run_async):
+    """A prefill-role engine's handoff finish (future resolved in
+    _export_slot, never reaching _flush_emits) retires the journal
+    entry — a restart must not replay work the decode pool served."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    journal_dir = str(tmp_path / "jprefill")
+
+    async def run():
+        engine = TpuServingEngine(
+            _base_config(journal_dir=journal_dir, slots=2,
+                         pool_role="prefill")
+        )
+        try:
+            out = await engine.generate(
+                "handoff me", {"max-tokens": 8, "temperature": 0}
+            )
+            assert out["finish_reason"] == "handoff"
+            assert engine.journal.flush(5.0)
+            return engine.journal.stats()
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    stats = run_async(run())
+    assert stats["appended"] == 1
+    assert stats["retired"] == 1 and stats["live"] == 0
+
+
+def test_chaos_oom_preempts_lowest_class_victims(run_async):
+    """When the shrunk budget no longer covers the live reservations,
+    the LOWEST-class victims are preempted (worst-case reservations
+    freed, requeued front-of-class) — and still complete correctly."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+    from langstream_tpu.serving.qos import QosSpec
+
+    config = _base_config(
+        slots=4,
+        qos=QosSpec.from_dict({}),
+        # half the budget vanishes per shrink: reservations must spill
+        shrink_fraction=0.5,
+        faults=(FaultPlan(site="pool-grow", after=6, count=1),),
+    )
+
+    async def run():
+        engine = TpuServingEngine(config)
+        try:
+            outs = await asyncio.gather(*(
+                engine.generate(
+                    f"victim candidate {i} reporting",
+                    {
+                        "max-tokens": 24, "temperature": 0,
+                        "priority": "batch" if i % 2 else "interactive",
+                    },
+                )
+                for i in range(6)
+            ))
+            sched = engine.stats()["scheduler"]
+            survival = engine.stats()["survival"]
+            events = engine.flight.recent_events(0)
+            return outs, sched, survival, events
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    outs, sched, survival, events = run_async(run())
+    assert len(outs) == 6 and all("text" in o for o in outs)
+    assert survival["shrinks"] >= 1
+    if survival["shrink_preempted"]:
+        # victims were needed: the batch class pays before interactive
+        assert sched["classes"]["batch"]["preempted"] >= 1
+        assert sched["classes"]["interactive"]["preempted"] == 0
+        preempts = [
+            e for e in events
+            if e["kind"] == "preempt" and e.get("reason") == "pool-shrink"
+        ]
+        assert preempts and all(
+            p["priority"] == "batch" for p in preempts
+        )
+
+
+def test_chaos_hang_wedges_healthz_then_recovers(run_async):
+    """The r03 shape: an injected hang at the prefill seam stalls the
+    dispatch, the watchdog heartbeat stops while work is pending, and
+    ``/healthz`` flips 503 WEDGED — then recovers when the stall ends."""
+    from langstream_tpu.runtime.pod import _probe_healthz
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    config = _base_config(
+        wedge_window_s=0.25,
+        faults=(
+            FaultPlan(site="prefill", shape="hang", hang_ms=1200.0),
+        ),
+    )
+
+    async def run():
+        engine = TpuServingEngine.get_or_create(config)
+        try:
+            task = asyncio.ensure_future(
+                engine.generate("hang me", {"max-tokens": 4,
+                                            "temperature": 0})
+            )
+            wedged_status = None
+            wedged_body = None
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                status, body = _probe_healthz()
+                if status == 503:
+                    wedged_status, wedged_body = status, body
+                    break
+                await asyncio.sleep(0.05)
+            result = await task  # the stall resolves; the request serves
+            # progress resumed: health recovers
+            recovered = None
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                status, _ = _probe_healthz()
+                if status == 200:
+                    recovered = status
+                    break
+                await asyncio.sleep(0.05)
+            # the fault-injected evidence drains at the loop's next
+            # safe point — give the loop a pass before reading the ring
+            deadline = time.monotonic() + 2.0
+            events = engine.flight.recent_events(0)
+            while time.monotonic() < deadline and not any(
+                e["kind"] == "fault-injected" for e in events
+            ):
+                await asyncio.sleep(0.05)
+                events = engine.flight.recent_events(0)
+            return wedged_status, wedged_body, result, recovered, events
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    wedged_status, body, result, recovered, events = run_async(run())
+    assert wedged_status == 503
+    assert body["wedged"] == ["tiny"]
+    assert result["tokens"]  # zero loss: the hung request still answered
+    assert recovered == 200
+    assert any(e["kind"] == "fault-injected" and e["shape"] == "hang"
+               for e in events)
+
+
+# ---------------------------------------------------------------------------
+# journal replay-after-restart e2e
+# ---------------------------------------------------------------------------
+
+
+def test_journal_replay_after_restart_zero_loss(tmp_path):
+    """Engine A accepts work and 'crashes' (the process's loop dies
+    without close()); engine B on the same journal dir replays the
+    admitted-but-unfinished requests front-of-class, completes them,
+    and retires each exactly once — the journal converges to empty."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    journal_dir = str(tmp_path / "journal")
+
+    async def crash_phase():
+        # a long hang at the prefill seam pins the 'crashing' engine:
+        # no accepted request can finish (and so retire its entry)
+        # before the process abandons them
+        engine = TpuServingEngine(
+            _base_config(
+                journal_dir=journal_dir, slots=2,
+                faults=(FaultPlan(site="prefill", shape="hang",
+                                  hang_ms=3000.0, count=1),),
+            )
+        )
+        # submissions journaled at accept; the engine never gets to run
+        # them (we abandon the loop mid-flight — the crash)
+        tasks = [
+            asyncio.ensure_future(engine.generate(
+                f"journaled request {i}",
+                {"max-tokens": 6, "temperature": 0,
+                 "qos-tenant": f"t{i}"},
+            ))
+            for i in range(3)
+        ]
+        await asyncio.sleep(0)  # submissions enqueue
+        assert engine.journal.flush(5.0)
+        assert engine.journal.stats()["live"] == 3
+        # the crash: the engine dies FIRST (its loop never observes the
+        # callers going away — an explicitly cancelled caller would be
+        # ANSWERED and legitimately retired), then the callers' futures
+        # die with the process
+        if engine._loop_task is not None:
+            engine._loop_task.cancel()
+        for t in tasks:
+            t.cancel()
+        # no close(): the 'crash' leaves the journal's live set on disk
+        TpuServingEngine.reset_instances()
+
+    asyncio.run(crash_phase())
+
+    async def restart_phase():
+        engine = TpuServingEngine(
+            _base_config(journal_dir=journal_dir, slots=2)
+        )
+        try:
+            # a brand-new submission arrives first; the replay must still
+            # serve the recovered work FRONT-of-class
+            fresh = await engine.generate(
+                "fresh post-restart request", {"max-tokens": 4,
+                                               "temperature": 0}
+            )
+            for _ in range(200):
+                if engine.journal.depth() == 0:
+                    break
+                await asyncio.sleep(0.05)
+            stats = engine.journal.stats()
+            events = engine.flight.recent_events(0)
+            completed = engine.completed_requests
+            return fresh, stats, events, completed
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    fresh, stats, events, completed = asyncio.run(restart_phase())
+    assert fresh["tokens"]
+    # zero silent loss: all three recovered requests replayed + finished,
+    # each retired exactly once (+1 retire for the fresh request this
+    # process both admitted and served)
+    assert stats["replayed"] == 3
+    assert stats["retired"] == 4
+    assert stats["live"] == 0 and stats["pending_ops"] == 0
+    assert completed >= 4  # 3 replays + the fresh request
+    assert any(
+        e["kind"] == "journal-replay" and e["requests"] == 3
+        for e in events
+    )
+    # a third process finds nothing to replay (exactly-once)
+    j = RequestJournal(journal_dir)
+    assert j.pending() == []
+    j.close()
+
+
+def test_journal_retires_on_finish_and_fail(run_async, tmp_path):
+    """Finished requests retire their entries inline; an engine-level
+    failure retires too (the caller was ANSWERED with the error — a
+    restart must not replay served failures)."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+    from langstream_tpu.serving.qos import RateLimited
+
+    journal_dir = str(tmp_path / "j2")
+
+    async def run():
+        engine = TpuServingEngine(
+            _base_config(journal_dir=journal_dir, slots=2)
+        )
+        try:
+            await engine.generate("finish me", {"max-tokens": 4,
+                                                "temperature": 0})
+            assert engine.journal.flush(5.0)
+            assert engine.journal.stats()["live"] == 0
+            # queued work failed explicitly by a drain-expiry shed is
+            # answered → retired
+            task = asyncio.ensure_future(engine.generate(
+                "shed me", {"max-tokens": 64, "temperature": 0}
+            ))
+            await asyncio.sleep(0)
+            engine._fail_inflight(RateLimited("draining", 1.0, "test"))
+            with pytest.raises(RateLimited):
+                await task
+            assert engine.journal.flush(5.0)
+            return engine.journal.stats()
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    stats = run_async(run())
+    assert stats["live"] == 0
+    assert stats["retired"] == 2
+
+
+# ---------------------------------------------------------------------------
+# default config: the hot path stays bit-for-bit
+# ---------------------------------------------------------------------------
+
+
+def test_default_config_hot_path_unchanged(run_async):
+    """Fault injection disabled (default) leaves the engine with NO
+    injector (one attribute test per seam), zero survival counters, and
+    greedy output identical to an engine whose armed plan never fires."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    prompts = [f"default path request {i}" for i in range(3)]
+
+    async def run(cfg):
+        engine = TpuServingEngine(cfg)
+        try:
+            outs = [
+                await engine.generate(p, {"max-tokens": 8,
+                                          "temperature": 0})
+                for p in prompts
+            ]
+            return outs, engine._faults, engine.stats()["survival"]
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    outs_default, injector, survival = run_async(run(_base_config()))
+    assert injector is None
+    assert survival["shrinks"] == 0 and survival["restores"] == 0
+    assert "journal" not in survival and "faults" not in survival
+
+    inert = (FaultPlan(site="pool-grow", after=10**9),)
+    outs_armed, injector_armed, _ = run_async(
+        run(_base_config(faults=inert))
+    )
+    assert injector_armed is not None
+    assert [o["tokens"] for o in outs_default] == [
+        o["tokens"] for o in outs_armed
+    ]
+    assert [o["text"] for o in outs_default] == [
+        o["text"] for o in outs_armed
+    ]
+
+
+def test_pool_grow_events_carry_bytes(run_async):
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def run():
+        engine = TpuServingEngine(_base_config())
+        try:
+            await engine.generate("grow the pool please",
+                                  {"max-tokens": 40, "temperature": 0})
+            return (
+                engine.flight.recent_events(0), engine._kv_block_bytes
+            )
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    events, block_bytes = run_async(run())
+    grows = [e for e in events if e["kind"] == "pool-grow"]
+    assert grows, "decode growth must emit pool-grow"
+    for e in grows:
+        assert e["blocks"] >= 1
+        assert e["bytes"] == e["blocks"] * block_bytes
+
+
+def test_memory_ledger_reflects_withheld_budget(run_async):
+    """The HBM ledger sums exactly across shrink/restore: the pool's
+    bytes never move (the arrays stay allocated), and the withheld
+    budget is reported as a sub-owner."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def run():
+        engine = TpuServingEngine(_base_config())
+        try:
+            before = engine._memory_ledger()
+            engine.block_mgr.reduce_budget(4)
+            during = engine._memory_ledger()
+            engine.block_mgr.restore_budget()
+            after = engine._memory_ledger()
+            return before, during, after, engine._kv_block_bytes
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    before, during, after, block_bytes = run_async(run())
+    for ledger in (before, during, after):
+        owners = ledger["hbm_bytes_by_owner"]
+        if ledger["limit_bytes"] is not None:
+            assert sum(owners.values()) == ledger["limit_bytes"]
+    assert before["kv_pool_withheld_bytes"] == 0
+    assert during["kv_pool_withheld_bytes"] == 4 * block_bytes
+    assert after["kv_pool_withheld_bytes"] == 0
+    # the pool owner itself is constant across shrink/restore
+    assert (
+        before["hbm_bytes_by_owner"]["kv-pool"]
+        == during["hbm_bytes_by_owner"]["kv-pool"]
+        == after["hbm_bytes_by_owner"]["kv-pool"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# health predicate + autoscaler signal
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_pressure_predicate():
+    from langstream_tpu.serving.health import shrink_pressure
+
+    now = 1000.0
+    mk = lambda age, rec=10.0: {
+        "kind": "pool-shrink", "m_s": now - age, "recovery_s": rec,
+    }
+    # one shrink: adapting, not degraded
+    assert shrink_pressure([mk(1.0)], now) is None
+    # two inside one recovery window: sustained pressure
+    reason = shrink_pressure([mk(8.0), mk(1.0)], now)
+    assert reason and "pool-shrink" in reason
+    # two but far apart relative to the window: quiet
+    assert shrink_pressure([mk(50.0), mk(1.0)], now) is None
+    # stampless payloads never flag
+    assert shrink_pressure(
+        [{"kind": "pool-shrink", "recovery_s": 10.0}], now
+    ) is None
+
+
+def test_watchdog_degrades_on_repeated_shrinks():
+    from langstream_tpu.serving.health import EngineWatchdog
+
+    clock = [100.0]
+    wd = EngineWatchdog(wedge_window_s=60.0, clock=lambda: clock[0])
+    wd.beat(0)
+    events = [
+        {"kind": "pool-shrink", "m_s": 99.0, "recovery_s": 30.0},
+        {"kind": "pool-shrink", "m_s": 99.5, "recovery_s": 30.0},
+    ]
+    verdict = wd.evaluate(queued=0, occupancy=0, events=events)
+    assert verdict["state"] == "degraded"
+    assert any("pool-shrink" in r for r in verdict["reasons"])
+
+
+def test_autoscaler_scales_up_on_pool_shrink_pressure():
+    from langstream_tpu.controlplane.autoscaler import (
+        AutoscaleSpec,
+        FleetAutoscaler,
+        ReplicaObservation,
+        observation_from_summary,
+    )
+
+    # the observation folds the flight-summary survival section
+    obs = observation_from_summary(
+        "pod-0",
+        [{
+            "model": "tiny", "slots": 4,
+            "survival": {"shrinks": 2, "withheld_blocks": 5},
+        }],
+    )
+    assert obs.pool_shrinks == 2 and obs.budget_withheld
+    assert obs.to_dict()["budget_withheld"] is True
+
+    spec = AutoscaleSpec.from_dict(
+        {"max-replicas": 4, "scale-up-window-s": 0, "cooldown-s": 0}
+    )
+    assert spec.pool_shrink  # default on, kebab round-trips
+    assert AutoscaleSpec.from_dict(spec.to_dict()).pool_shrink
+    scaler = FleetAutoscaler(spec, backend=None, clock=lambda: 100.0)
+    decision = scaler.decide(
+        [ReplicaObservation(replica="pod-0", budget_withheld=True)],
+        now=100.0,
+    )
+    assert decision.action == "up"
+    assert any("pool-shrink" in r for r in decision.reasons)
+    # the signal is declinable
+    off = AutoscaleSpec.from_dict(
+        {"pool-shrink": False, "scale-up-window-s": 0, "cooldown-s": 0}
+    )
+    scaler_off = FleetAutoscaler(off, backend=None, clock=lambda: 100.0)
+    decision_off = scaler_off.decide(
+        [ReplicaObservation(replica="pod-0", budget_withheld=True)],
+        now=100.0,
+    )
+    assert decision_off.action == "none"
+
+
+# ---------------------------------------------------------------------------
+# engine_top: survival panel + thrash analyzer
+# ---------------------------------------------------------------------------
+
+
+def test_engine_top_renders_survival_panel():
+    top = _load_tool("engine_top")
+    out = top.render([{
+        "model": "tiny", "slots": 4,
+        "summary": {"totals": {}, "window": {}},
+        "survival": {
+            "shrinks": 2, "restores": 1, "shrink_preempted": 3,
+            "budget_blocks": 18, "configured_blocks": 24,
+            "withheld_blocks": 6, "withheld_bytes": 98304,
+            "recovering": True, "recovery_s": 30.0,
+            "journal": {"live": 2, "replayed": 5},
+        },
+        "events": [{
+            "kind": "pool-shrink", "t_ms": 1000.0, "site": "pool-grow",
+            "withheld_blocks": 3, "freed_blocks": 6, "preempted": 2,
+            "budget_blocks": 18, "configured_blocks": 24,
+        }],
+    }])
+    assert "18/24 blocks" in out
+    assert "WITHHELD 6" in out
+    assert "shrinks 2" in out
+    assert "journal 2 live/5 replayed" in out
+    assert "site pool-grow" in out
+
+
+def test_engine_top_analyze_flags_shrink_thrash():
+    top = _load_tool("engine_top")
+    events = [
+        {"kind": "pool-shrink", "t_ms": 1000.0 + i * 2000.0,
+         "recovery_s": 30.0}
+        for i in range(3)
+    ]
+    flags = top._anomalies({
+        "summary": {"totals": {}, "window": {}},
+        "events": events,
+        "survival": {"withheld_blocks": 4, "configured_blocks": 24},
+    })
+    assert any("shrink-recover thrash" in f for f in flags)
+    assert any("KV budget withheld" in f for f in flags)
+    # two shrinks, or three spread far beyond the window, stay quiet
+    spread = [
+        {"kind": "pool-shrink", "t_ms": 1000.0 + i * 120000.0,
+         "recovery_s": 30.0}
+        for i in range(3)
+    ]
+    flags_quiet = top._anomalies({
+        "summary": {"totals": {}, "window": {}},
+        "events": spread,
+    })
+    assert not any("thrash" in f for f in flags_quiet)
+
+
+# ---------------------------------------------------------------------------
+# bench phase + perf_diff directions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_oom_storm_bench_phase_smoke():
+    gateway_bench = _load_tool("gateway_bench")
+    out = asyncio.run(
+        gateway_bench.run_oom_storm_phase(
+            requests=8, max_tokens=8, burst_after=2, burst_count=1
+        )
+    )
+    assert out["submitted"] == 8
+    assert out["zero_silent_loss"] is True
+    assert out["completed"] + out["shed"] == 8
+    assert out["oom_storm_shrinks"] >= 1
+    assert out["budget_recovered"] is True
+    assert out["faults_injected"] >= 1
+    assert out["shrink_evidence"][0]["site"] == "pool-grow"
+
+
+def test_perf_diff_extracts_oom_storm_metrics():
+    perf_diff = _load_tool("perf_diff")
+    record = {
+        "schema": 2,
+        "value": 100.0,
+        "detail": {
+            "oom_storm": {
+                "oom_storm_shed_rate": 0.1,
+                "oom_storm_completed_fraction": 0.9,
+                "oom_storm_shrinks": 2,
+                "oom_storm_ttft_p50_s": 0.5,
+                "oom_storm_ttft_p99_s": 1.5,
+            }
+        },
+    }
+    metrics = perf_diff.extract_metrics(record)["metrics"]
+    assert metrics["oom_storm_shed_rate"] == 0.1
+    assert metrics["oom_storm_shrinks"] == 2
+    # directions are declared, worse-direction semantics verified
+    assert perf_diff.METRICS["oom_storm_shed_rate"] == "up"
+    assert perf_diff.METRICS["oom_storm_completed_fraction"] == "down"
+    base = {"schema": 2, "value": 100.0,
+            "detail": {"oom_storm": {"oom_storm_shed_rate": 0.05}}}
+    new = {"schema": 2, "value": 100.0,
+           "detail": {"oom_storm": {"oom_storm_shed_rate": 0.5}}}
+    results, regressed = perf_diff.diff_payloads(
+        [("base", base), ("new", new)]
+    )
+    assert regressed
+    (_, _, result), = results
+    assert any(
+        r["metric"] == "oom_storm_shed_rate"
+        for r in result["regressions"]
+    )

@@ -1111,6 +1111,111 @@ def test_perf701_tn_host_math_outside_dispatch_methods():
 
 
 # --------------------------------------------------------------------------
+# FLT901 — broad except swallowing a device-dispatch error without
+# consulting _resource_exhausted or re-raising
+# --------------------------------------------------------------------------
+
+
+def test_flt901_tp_swallowed_dispatch_exception():
+    """A broad except that returns/passes on the dispatch path disables
+    the allocator-failure adaptation: the request neither completes nor
+    sheds."""
+    ids = rule_ids(
+        """
+        class Engine:
+            async def _decode_burst(self, loop, active):
+                try:
+                    out = await loop.run_in_executor(None, self._step)
+                except Exception:
+                    return  # swallowed: silent request loss
+                return out
+        """
+    )
+    assert "FLT901" in ids
+
+
+def test_flt901_tp_bare_except_in_dispatch_closure():
+    """Bare except inside a nested dispatch closure inherits the scope."""
+    ids = rule_ids(
+        """
+        class Engine:
+            async def _apply_imports(self, loop):
+                def _run():
+                    try:
+                        return self._scatter()
+                    except:  # noqa: E722
+                        pass
+
+                return await loop.run_in_executor(None, _run)
+        """
+    )
+    assert "FLT901" in ids
+
+
+def test_flt901_tn_classify_reraise_and_out_of_scope():
+    # the sanctioned shape: consult the classifier, re-raise the rest
+    assert "FLT901" not in rule_ids(
+        """
+        class Engine:
+            async def _run_loop(self):
+                try:
+                    await self._step()
+                except Exception as e:
+                    if self._resource_exhausted(e):
+                        self._maybe_pool_shrink(e)
+                        return
+                    raise
+        """
+    )
+    # a handler that re-raises on every path is not a swallow
+    assert "FLT901" not in rule_ids(
+        """
+        class Engine:
+            async def _decode_burst(self, loop, active):
+                try:
+                    await self._step()
+                except Exception as e:
+                    self._log(e)
+                    raise
+        """
+    )
+    # narrow handlers are decisions, not swallows (EXC401/402 territory)
+    assert "FLT901" not in rule_ids(
+        """
+        class Engine:
+            def _fetch_chunk(self, packed, k):
+                try:
+                    packed.copy_to_host_async()
+                except AttributeError:
+                    pass
+        """
+    )
+    # outside the dispatch-path methods the rule does not apply
+    assert "FLT901" not in rule_ids(
+        """
+        class Engine:
+            async def generate(self, prompt):
+                try:
+                    await self._warmup()
+                except Exception:
+                    pass
+        """
+    )
+    # outside serving/engine.py the rule does not apply
+    assert "FLT901" not in rule_ids(
+        """
+        class Engine:
+            async def _decode_burst(self, loop, active):
+                try:
+                    await self._step()
+                except Exception:
+                    return
+        """,
+        path="langstream_tpu/serving/lockstep.py",
+    )
+
+
+# --------------------------------------------------------------------------
 # suppressions + GC000
 # --------------------------------------------------------------------------
 

@@ -198,6 +198,7 @@ def render(report: list[dict]) -> str:
                          summary)
         )
         lines.extend(_render_prefix(entry.get("prefixstore"), events))
+        lines.extend(_render_survival(entry.get("survival"), events))
         spec_acc = totals.get("spec_accepted") or 0
         spec_rej = totals.get("spec_rejected") or 0
         if spec_acc or spec_rej:
@@ -329,6 +330,63 @@ def _render_prefix(prefixstore: dict | None, events: list[dict]) -> list[str]:
             f"prefix   evict {event.get('tier')} {event.get('digest')} "
             f"{_fmt_bytes(event.get('bytes') or 0)} "
             f"({event.get('reason')})"
+        )
+    return lines
+
+
+def _render_survival(survival: dict | None, events: list[dict]) -> list[str]:
+    """Device-survival panel (docs/RESILIENCE.md): the live KV admission
+    budget vs configured (an active shrink is the line an operator must
+    see during an OOM storm), shrink/restore counters, crash-requeue
+    journal depth, and the most recent pool-shrink's evidence."""
+    if not isinstance(survival, dict):
+        return []
+    shrinks = survival.get("shrinks") or 0
+    journal = survival.get("journal")
+    budget = survival.get("budget_blocks")
+    configured = survival.get("configured_blocks")
+    if not shrinks and not journal and not survival.get("faults"):
+        return []  # nothing survival-relevant has happened on this engine
+    lines: list[str] = []
+    if budget is not None and configured:
+        frac = budget / configured
+        withheld = survival.get("withheld_blocks") or 0
+        lines.append(
+            f"budget   [{_bar(frac)}] {budget}/{configured} blocks"
+            + (
+                f"   WITHHELD {withheld} "
+                f"({_fmt_bytes(survival.get('withheld_bytes') or 0)})"
+                if withheld
+                else ""
+            )
+        )
+    tail = (
+        f"shrinks {shrinks}  restores {survival.get('restores') or 0}  "
+        f"preempted {survival.get('shrink_preempted') or 0}"
+    )
+    if survival.get("recovering"):
+        tail += f"  recovering (window {survival.get('recovery_s')}s)"
+    if isinstance(journal, dict):
+        tail += (
+            f"  journal {journal.get('live', 0)} live"
+            f"/{journal.get('replayed', 0)} replayed"
+        )
+    lines.append(f"survive  {tail}")
+    last = next(
+        (
+            e
+            for e in reversed(events)
+            if e.get("kind") == "pool-shrink"
+        ),
+        None,
+    )
+    if last is not None:
+        lines.append(
+            f"shrink   site {last.get('site')}  withheld "
+            f"{last.get('withheld_blocks')} blk  freed "
+            f"{last.get('freed_blocks')} blk  preempted "
+            f"{last.get('preempted')}  -> budget "
+            f"{last.get('budget_blocks')}/{last.get('configured_blocks')}"
         )
     return lines
 
@@ -819,6 +877,39 @@ def _anomalies(entry: dict) -> list[str]:
     collapse = _overlap_collapse(entry, summary, totals, samples)
     if collapse:
         flags.append(collapse)
+    # shrink-recover thrash (docs/RESILIENCE.md): >=3 pool-shrink events
+    # inside ONE recovery window — the budget oscillates (shrink, recover,
+    # immediately re-shrink), meaning the pressure is structural (pool too
+    # small for the workload / a leak) and the adaptation is just hiding
+    # it. Uses the events' own recovery_s so a tuned window still flags.
+    shrink_events = [
+        e for e in events if e.get("kind") == "pool-shrink"
+    ]
+    if len(shrink_events) >= 3:
+        window_ms = max(
+            float(e.get("recovery_s") or 30.0) for e in shrink_events
+        ) * 1000.0
+        stamps = sorted(
+            e["t_ms"] for e in shrink_events if e.get("t_ms") is not None
+        )
+        for i in range(len(stamps) - 2):
+            if stamps[i + 2] - stamps[i] <= window_ms:
+                flags.append(
+                    f"shrink-recover thrash: >=3 pool-shrink events inside "
+                    f"one {window_ms / 1000.0:.0f}s recovery window — the "
+                    f"KV budget is oscillating; the device pressure is "
+                    f"structural (grow kv-pool-blocks, lower max-tokens, "
+                    f"or scale out), not transient"
+                )
+                break
+    survival = entry.get("survival")
+    if isinstance(survival, dict) and survival.get("withheld_blocks"):
+        flags.append(
+            f"KV budget withheld: {survival['withheld_blocks']} of "
+            f"{survival.get('configured_blocks')} blocks held back after "
+            f"a device allocator failure — capacity is degraded until "
+            f"the recovery probe restores it"
+        )
     # wedged device (the r03 hang shape): the health section a /flight
     # dump carries self-diagnoses — no step progress while work was
     # queued/in flight. Flag on the recorded verdict, and re-derive from

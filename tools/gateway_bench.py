@@ -74,6 +74,17 @@ instance:
 """
 
 
+def _pct(sorted_values, q: float):
+    """Nearest-rank percentile of an already-sorted list (None when
+    empty) — the ONE helper every phase quantiles with, so the rounding
+    semantics can never drift between phases."""
+    if not sorted_values:
+        return None
+    return sorted_values[
+        min(len(sorted_values) - 1, int(q * len(sorted_values)))
+    ]
+
+
 def _yaml_serving(serving: dict[str, Any]) -> str:
     return "\n".join(
         f"        {key}: {json.dumps(value)}"
@@ -207,10 +218,7 @@ async def run_gateway_bench(
         ttfts = sorted(s["ttft"] for s in samples)
         e2es = sorted(s["e2e"] for s in samples)
 
-        def pct(sorted_values, q):
-            return sorted_values[
-                min(len(sorted_values) - 1, int(q * len(sorted_values)))
-            ]
+        pct = _pct
 
         out = {
             "gateway_ttft_p50_s": round(pct(ttfts, 0.50), 4),
@@ -546,9 +554,7 @@ async def run_warm_prefix_phase(
     TpuServingEngine.reset_instances()
 
     ttfts.sort()
-
-    def pct(values, q):
-        return values[min(len(values) - 1, int(q * len(values)))]
+    pct = _pct
 
     out: dict[str, Any] = {
         "tenants": tenants,
@@ -599,6 +605,109 @@ async def run_warm_prefix_phase(
             }
         }
     return out
+
+
+async def run_oom_storm_phase(
+    *,
+    serving: dict[str, Any] | None = None,
+    requests: int = 24,
+    max_tokens: int = 16,
+    burst_after: int = 4,
+    burst_count: int = 2,
+) -> dict[str, Any]:
+    """Survival phase (docs/RESILIENCE.md): flood one paged engine and
+    inject a RESOURCE_EXHAUSTED burst at the pool-grow seam mid-phase
+    (serving/faults.py), then record how the engine *adapted* — shrink
+    and recover counts, shed rate, and the completed-vs-submitted
+    ledger. The acceptance this phase instruments is zero silent loss:
+    every submitted request either completes or is RateLimited with a
+    retry hint; ``zero_silent_loss`` is the recorded verdict, and
+    ``perf_diff`` declares the worse-directions so a regression that
+    starts dropping work under pressure is flagged, not averaged away."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+    from langstream_tpu.serving.faults import FaultPlan
+    from langstream_tpu.serving.qos import RateLimited
+
+    serving = dict(serving or {})
+    serving.setdefault("model", "tiny")
+    serving.setdefault("slots", 4)
+    serving.setdefault("max-seq-len", 256)
+    serving.setdefault("decode-chunk", 4)
+    serving.setdefault("model-dtype", "float32")
+    serving.setdefault("kv-layout", "paged")
+    serving.setdefault("kv-block-size", 16)
+    serving.setdefault("shrink-recovery-s", 0.5)
+    serving["faults"] = [
+        {
+            "site": "pool-grow",
+            "shape": "oom",
+            "after": burst_after,
+            "count": burst_count,
+        }
+    ]
+    config = ServingConfig.from_dict(serving)
+    engine = TpuServingEngine(config)
+    t_start = time.monotonic()
+    results = await asyncio.gather(
+        *(
+            engine.generate(
+                f"oom storm request {i} reporting in",
+                {"max-tokens": max_tokens, "temperature": 0},
+            )
+            for i in range(requests)
+        ),
+        return_exceptions=True,
+    )
+    completed = sum(1 for r in results if isinstance(r, dict))
+    shed = sum(1 for r in results if isinstance(r, RateLimited))
+    other_failures = requests - completed - shed
+    ttfts = sorted(r["ttft"] for r in results if isinstance(r, dict))
+    # wait out the recovery probe: the phase records whether the budget
+    # actually came back, not just that it shrank
+    for _ in range(200):
+        if not engine.stats()["survival"].get("withheld_blocks", 0):
+            break
+        await asyncio.sleep(0.05)
+    survival = engine.stats()["survival"]
+    events = engine.flight.recent_events(0)
+    shrink_events = [e for e in events if e["kind"] == "pool-shrink"]
+    await engine.close()
+    TpuServingEngine.reset_instances()
+
+    def pct(values, q):
+        v = _pct(values, q)
+        return round(v, 4) if v is not None else None
+
+    return {
+        "submitted": requests,
+        "completed": completed,
+        "shed": shed,
+        "other_failures": other_failures,
+        "oom_storm_completed_fraction": round(completed / requests, 4),
+        "oom_storm_shed_rate": round(shed / requests, 4),
+        # the acceptance ledger: every miss is a loud RateLimited shed
+        "zero_silent_loss": (completed + shed) == requests,
+        "oom_storm_shrinks": survival["shrinks"],
+        "oom_storm_restores": survival["restores"],
+        "shrink_preempted": survival["shrink_preempted"],
+        "budget_recovered": not survival.get("withheld_blocks", 0),
+        "faults_injected": sum(
+            1 for e in events if e["kind"] == "fault-injected"
+        ),
+        "shrink_evidence": [
+            {
+                k: e.get(k)
+                for k in (
+                    "site", "withheld_blocks", "freed_blocks",
+                    "preempted", "budget_blocks", "configured_blocks",
+                )
+            }
+            for e in shrink_events
+        ],
+        "oom_storm_ttft_p50_s": pct(ttfts, 0.50),
+        "oom_storm_ttft_p99_s": pct(ttfts, 0.99),
+        "wall_s": round(time.monotonic() - t_start, 3),
+    }
 
 
 if __name__ == "__main__":

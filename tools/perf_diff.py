@@ -80,6 +80,16 @@ METRICS: dict[str, str] = {
     "journey_decode_admission_p99_s": "up",
     "journey_first_step_p50_s": "up",
     "journey_first_step_p99_s": "up",
+    # device-survival storm (docs/RESILIENCE.md, gateway_bench
+    # run_oom_storm_phase): more sheds / fewer completions under the
+    # same injected burst = the adaptation regressed; more shrinks =
+    # the engine needed more budget cuts to survive the same pressure;
+    # a slower p99 = the storm leaked into latency it used to absorb
+    "oom_storm_shed_rate": "up",
+    "oom_storm_completed_fraction": "down",
+    "oom_storm_shrinks": "up",
+    "oom_storm_ttft_p50_s": "up",
+    "oom_storm_ttft_p99_s": "up",
 }
 
 #: default noise band: relative change below this is never flagged
@@ -189,6 +199,17 @@ def extract_metrics(payload) -> dict:
                 if warm.get(key) is not None:
                     metrics[key] = warm[key]
             _journey_metrics(warm.get("journey_segments"), metrics)
+        # device-survival storm (gateway_bench run_oom_storm_phase):
+        # shed/completion/shrink posture under an injected OOM burst
+        storm = detail.get("oom_storm")
+        if isinstance(storm, dict):
+            for key in (
+                "oom_storm_shed_rate", "oom_storm_completed_fraction",
+                "oom_storm_shrinks", "oom_storm_ttft_p50_s",
+                "oom_storm_ttft_p99_s",
+            ):
+                if storm.get(key) is not None:
+                    metrics[key] = storm[key]
         _journey_metrics(detail.get("journey_segments"), metrics)
         for leg in detail.values():
             if isinstance(leg, dict):
