@@ -76,6 +76,12 @@ class _AIAgentBase(SingleRecordProcessor):
             priority = headers.get("langstream-qos-priority")
             if priority:
                 options["priority"] = priority
+            deadline = headers.get("langstream-deadline")
+            if deadline:
+                # the gateway's end-to-end budget (serving/handoff.py):
+                # the engine's admission gate enforces it 504-shaped, so
+                # the same deadline the client saw bounds the device work
+                options["deadline"] = deadline
         return options
 
 

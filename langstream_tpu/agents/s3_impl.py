@@ -214,12 +214,20 @@ class SyncS3Client:
     """Blocking twin of :class:`AsyncS3Client` (urllib) for code storage —
     deployer Jobs and init containers are synchronous."""
 
+    #: explicit socket bound on every blocking request (graftcheck
+    #: NET1201): the prefix-store hydrator and deployer Jobs block on
+    #: this client, and a dead endpoint must become a loud error inside
+    #: a bounded window, never a thread parked in recv forever
+    DEFAULT_TIMEOUT_S = 30.0
+
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
-                 region: str = "us-east-1"):
+                 region: str = "us-east-1",
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
         self.endpoint = endpoint.rstrip("/")
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region or "us-east-1"
+        self.timeout_s = float(timeout_s)
 
     def _request(self, method: str, path: str, *, payload: bytes = b"",
                  ok: tuple[int, ...] = (200, 204)) -> tuple[int, bytes]:
@@ -232,7 +240,7 @@ class SyncS3Client:
             url, data=payload or None, headers=headers, method=method
         )
         try:
-            with urllib.request.urlopen(req) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 status, body = resp.status, resp.read()
         except urllib.error.HTTPError as e:
             status, body = e.code, e.read()

@@ -41,6 +41,10 @@ FLT901      fault-tolerance: a broad except on the engine's device-
             dispatch paths swallowing the error without consulting the
             RESOURCE_EXHAUSTED classifier or re-raising (the pool-shrink
             adaptation silently disabled)
+NET1201     network discipline: a blocking HTTP/socket call on a
+            serving/gateway/k8s-compute path without an explicit
+            timeout argument (a dead peer parks the thread forever;
+            the deadline plane cannot bound what never returns)
 ==========  ==============================================================
 
 RACE/INV/FLOW are **project rules**: they run over a whole-program index
@@ -82,6 +86,7 @@ from langstream_tpu.analysis.rules_flt import RULES as _FLT_RULES
 from langstream_tpu.analysis.rules_flow import RULES as _FLOW_RULES
 from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
+from langstream_tpu.analysis.rules_net import RULES as _NET_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
 from langstream_tpu.analysis.rules_perf import RULES as _PERF_RULES
 from langstream_tpu.analysis.rules_pfx import RULES as _PFX_RULES
@@ -102,6 +107,7 @@ ALL_RULES: list[Rule] = [
     *_POOL_RULES,
     *_PFX_RULES,
     *_FLT_RULES,
+    *_NET_RULES,
 ]
 
 #: whole-program rules (run over the ProjectIndex, not per file)

@@ -570,3 +570,43 @@ class TpuServingEngine:
         "silent request loss."
     ),
 ))
+
+_register(RuleExample(
+    rule="NET1201",
+    tp={
+        "langstream_tpu/serving/chainer_client.py": '''\
+import urllib.request
+
+
+def offer_handoff(url: str, payload: bytes) -> bytes:
+    # no timeout: a dead decode pod parks this thread in recv forever
+    with urllib.request.urlopen(url, data=payload) as resp:
+        return resp.read()
+''',
+    },
+    tn={
+        "langstream_tpu/serving/chainer_client.py": '''\
+import urllib.request
+
+from langstream_tpu.serving.handoff import socket_timeout_s
+
+
+def offer_handoff(url: str, payload: bytes, deadline: float | None) -> bytes:
+    # the sanctioned shape: every blocking hop carries an explicit bound,
+    # derived from the request's remaining deadline budget when one rides
+    with urllib.request.urlopen(
+        url, data=payload, timeout=socket_timeout_s(deadline)
+    ) as resp:
+        return resp.read()
+''',
+    },
+    fix=(
+        "Every blocking HTTP/socket call on a serving/gateway/"
+        "k8s-compute path passes an explicit timeout= argument. When "
+        "the request carries a langstream-deadline, derive the bound "
+        "from the remaining budget (serving/handoff.py "
+        "socket_timeout_s); otherwise pick a finite cap. A call with "
+        "no bound turns one dead peer into a stuck thread — the "
+        "stranded-handoff failure class docs/RESILIENCE.md refuses."
+    ),
+))

@@ -1,0 +1,146 @@
+"""Network-discipline rules: explicit timeouts on blocking calls.
+
+NET1201 polices the cross-replica failure domain's first commandment
+(docs/RESILIENCE.md "Distributed failure domain"): a **blocking HTTP or
+socket call on a serving/gateway/k8s-compute path must carry an explicit
+timeout argument**. Every cross-replica hop in this tree — the handoff
+chainer's ``/kv/import`` offers, the control plane's pod fan-ins, the
+autoscaler's ``/drain``, the prefix hydrator's object-storage fetches —
+is a place where the *other* pod may be dead, and a timeout-less call
+parks a thread in ``recv`` until kingdom come: the exact stranded-export
+shape PR 15 exists to kill. The deadline plane derives its socket
+timeouts from the remaining budget (``serving/handoff.py
+socket_timeout_s``); this rule guarantees no call slips under it
+unbounded.
+
+Flagged callables (the blocking stdlib/requests spellings):
+
+- ``urllib.request.urlopen(...)`` without ``timeout=``
+- ``socket.create_connection(addr)`` without a timeout (second
+  positional or keyword)
+- ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)``
+  constructed without ``timeout=``
+- ``requests.get/post/put/delete/head/patch/request(...)`` without
+  ``timeout=`` (requests' default is *no* timeout — the classic trap)
+
+Sanctioned shapes, by design:
+
+- any of the above WITH an explicit ``timeout=`` (deriving it from the
+  deadline budget via ``socket_timeout_s`` is the preferred spelling);
+- a ``**kwargs`` splat at the call site (the timeout may ride inside —
+  flagging it would force suppressions on forwarding wrappers);
+- async I/O (aiohttp / asyncio streams): cancellation-scoped by the
+  event loop, with its own ClientTimeout discipline — a different rule's
+  jurisdiction.
+
+Scope: ``serving/``, ``gateway/``, ``k8s/compute.py`` — plus
+``agents/s3_impl.py``'s synchronous client, which the serving prefix
+tiers block on (the hydrator thread calls it; the first tree scan with
+this rule caught exactly that client missing its timeout, and the fix
+shipped with the rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule
+
+#: path fragments inside the policed failure domain
+_SCOPE_FRAGMENTS = ("serving/", "gateway/")
+_SCOPE_FILES = ("k8s/compute.py", "agents/s3_impl.py")
+
+#: callable spellings that block on the network: name -> (sanctioned
+#: receivers — "" is the bare from-import spelling; matching the
+#: receiver keeps `loop.create_connection` (asyncio) and a local
+#: object's own `create_connection` method out of the rule — and the
+#: 1-based positional index at which the timeout may ride, None when
+#: the signature has no positional timeout)
+_BLOCKING_CALLS = {
+    "urlopen": ({"request", "urllib", ""}, 3),
+    "create_connection": ({"socket", ""}, 2),
+    "HTTPConnection": ({"client", "http", ""}, None),
+    "HTTPSConnection": ({"client", "http", ""}, None),
+}
+
+#: requests' verb surface (module attribute calls only — a local
+#: function named `get` must not trip the rule)
+_REQUESTS_VERBS = {
+    "get", "post", "put", "delete", "head", "patch", "options", "request",
+}
+
+
+def _in_scope(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(frag in norm for frag in _SCOPE_FRAGMENTS) or any(
+        norm.endswith(f) for f in _SCOPE_FILES
+    )
+
+
+def _call_name(call: ast.Call) -> tuple[str, str]:
+    """(attr-or-name, receiver-name): ``urllib.request.urlopen`` →
+    ``("urlopen", "request")``, ``requests.get`` → ``("get",
+    "requests")``, bare ``urlopen(...)`` → ``("urlopen", "")``."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value
+        recv_name = (
+            recv.attr if isinstance(recv, ast.Attribute)
+            else recv.id if isinstance(recv, ast.Name) else ""
+        )
+        return fn.attr, recv_name
+    if isinstance(fn, ast.Name):
+        return fn.id, ""
+    return "", ""
+
+
+def _has_timeout(call: ast.Call, positional_at: int | None) -> bool:
+    for kw in call.keywords:
+        if kw.arg is None:
+            return True  # **kwargs splat: the timeout may ride inside
+        if kw.arg == "timeout":
+            return True
+    return positional_at is not None and len(call.args) >= positional_at
+
+
+def check_blocking_call_without_timeout(mod: Module) -> Iterator[Finding]:
+    if not _in_scope(mod.path):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name, recv = _call_name(node)
+        flagged = False
+        if name in _BLOCKING_CALLS:
+            receivers, positional_at = _BLOCKING_CALLS[name]
+            flagged = recv in receivers and not _has_timeout(
+                node, positional_at
+            )
+        elif recv == "requests" and name in _REQUESTS_VERBS:
+            flagged = not _has_timeout(node, None)
+        if flagged:
+            yield mod.finding(
+                "NET1201",
+                node,
+                f"blocking network call {name!r} on a serving/gateway/"
+                f"k8s-compute path without an explicit timeout: if the "
+                f"far pod is dead this parks the thread in recv forever "
+                f"— the stranded-handoff shape the distributed-"
+                f"resilience plane exists to kill. Pass timeout= "
+                f"(derive it from the deadline budget via "
+                f"serving/handoff.py socket_timeout_s when one applies)",
+            )
+
+
+RULES = [
+    Rule(
+        id="NET1201",
+        family="net",
+        summary="blocking HTTP/socket call without an explicit timeout "
+        "on a serving/gateway/k8s-compute path (a dead peer parks the "
+        "thread forever; the deadline plane cannot bound what never "
+        "returns)",
+        check=check_blocking_call_without_timeout,
+    ),
+]

@@ -48,8 +48,14 @@ path).
 from __future__ import annotations
 
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Callable
+
+from langstream_tpu.serving.handoff import (
+    OPEN,
+    BreakerSpec,
+    CircuitBreaker,
+)
 
 #: record header carrying the routing choice; the serving agent's
 #: consumer honors it (see runtime/runner.py)
@@ -74,10 +80,31 @@ class ReplicaRouter:
         fresh_s: float = 15.0,
         affinity_ttl_s: float = 600.0,
         clock: Callable[[], float] = time.monotonic,
+        breaker: BreakerSpec | None = None,
     ):
         self.fresh_s = fresh_s
         self.affinity_ttl_s = affinity_ttl_s
         self._clock = clock
+        # circuit breakers (serving/handoff.py, docs/RESILIENCE.md):
+        # one per replica, created lazily on the FIRST report_failure/
+        # report_success — a router nobody reports outcomes to (the
+        # classic produce-only gateway) carries an empty dict and routes
+        # bit-for-bit as before
+        self.breaker_spec = breaker or BreakerSpec()
+        self._breakers: dict[str, CircuitBreaker] = {}
+        # 503 Retry-After holds: replica -> monotonic release stamp. A
+        # replica that shed WITH a hint is not re-offered until the hint
+        # elapses (`exclude=` only ever lasted one pick)
+        self._holds: dict[str, float] = {}
+        self.holds_applied = 0
+        # breaker transitions, newest last — stats() serves the tail and
+        # on_breaker_event (when wired, e.g. by the handoff chainer)
+        # mirrors each into a flight ring
+        self.events: deque = deque(maxlen=64)
+        self.on_breaker_event: Callable[[str, str, dict], None] | None = None
+        # network fault seam (serving/faults.py `route` site): tests arm
+        # an injector so a routing outage is a deterministic input
+        self.fault_injector = None
         self._replicas: dict[str, dict[str, Any]] = {}
         self._observed_at: float | None = None
         # tenant -> [replica, pinned_at]
@@ -122,6 +149,75 @@ class ReplicaRouter:
             or snapshot.get("draining")
             or snapshot.get("state") == "wedged"
         )
+
+    # -- failure feedback: breakers + Retry-After holds ------------------
+
+    def _emit_breaker(self, kind: str, replica: str, **detail: Any) -> None:
+        detail["open_replicas"] = sum(
+            1 for b in self._breakers.values() if b.state == OPEN
+        )
+        entry = {"kind": kind, "replica": replica, "m_s": self._clock(),
+                 **detail}
+        self.events.append(entry)
+        if self.on_breaker_event is not None:
+            self.on_breaker_event(kind, replica, detail)
+
+    def report_failure(self, replica: str, kind: str = "error") -> None:
+        """One failed call against ``replica`` (timeout / refused / bad
+        HTTP): feeds its rolling breaker window; enough inside the
+        window flip it OPEN and it leaves every subsequent ``pick``
+        until a half-open probe proves it back (docs/RESILIENCE.md)."""
+        breaker = self._breakers.get(replica)
+        if breaker is None:
+            breaker = self._breakers[replica] = CircuitBreaker(
+                self.breaker_spec, clock=self._clock
+            )
+        before = breaker.state
+        after = breaker.record_failure(kind)
+        if after == OPEN and before != OPEN:
+            self._emit_breaker(
+                "breaker-open", replica,
+                failures=breaker.stats()["window_failures"],
+                last_kind=kind,
+            )
+
+    def report_success(self, replica: str) -> None:
+        """One successful call: closes a half-open breaker (the probe
+        proved the replica back) and clears the failure window."""
+        breaker = self._breakers.get(replica)
+        if breaker is None:
+            return
+        before = breaker.state
+        after = breaker.record_success()
+        if before != after:
+            self._emit_breaker("breaker-close", replica)
+
+    def hold(self, replica: str, retry_after_s: float) -> None:
+        """503 ``Retry-After`` hold: the replica shed WITH a hint, so it
+        is not re-offered until the hint elapses — a plain ``exclude=``
+        only lasted one pick, and the next pick walked straight back
+        into the saturated replica."""
+        self._holds[replica] = self._clock() + max(0.0, retry_after_s)
+        self.holds_applied += 1
+
+    def _routable(self, name: str, now: float) -> bool:
+        """Breaker + hold gate on top of snapshot eligibility. Expired
+        holds are dropped here (the map self-cleans on the pick path)."""
+        until = self._holds.get(name)
+        if until is not None:
+            if now < until:
+                return False
+            del self._holds[name]
+        breaker = self._breakers.get(name)
+        return breaker is None or breaker.can_serve(now)
+
+    def _chosen(self, name: str) -> str:
+        """Account the pick against a half-open breaker's probe budget
+        (only a pick that actually routes traffic burns a probe)."""
+        breaker = self._breakers.get(name)
+        if breaker is not None:
+            breaker.note_probe()
+        return name
 
     @staticmethod
     def _pool(snapshot: dict[str, Any]) -> str:
@@ -187,8 +283,22 @@ class ReplicaRouter:
         whose prefix tiers hold its blocks, whatever tenant sent it.
         Consulted before the tenant pin; ``None`` (prefix-less traffic)
         leaves the pre-existing choice bit for bit."""
+        if self.fault_injector is not None:
+            # deterministic routing outage (serving/faults.py `route`
+            # site): drop = no pick this pass, error = the registry blew
+            # up — both shapes chaos tests aim at the chainer's
+            # no-healthy-replica path
+            action = self.fault_injector.fire("route")
+            if action is not None:
+                if action.shape == "error":
+                    raise RuntimeError(action.message)
+                if action.shape == "delay-ms":
+                    time.sleep(action.hang_ms / 1000.0)  # graftcheck: disable=PFX801 injected routing stall (tests/chaos only; a production router carries no injector)
+                else:
+                    return None
         if not self.fresh():
             return None
+        now = self._clock()
         exclude = set(exclude or ())
         candidates = [
             (self._load(snap), name)
@@ -196,10 +306,10 @@ class ReplicaRouter:
             if self._eligible(snap)
             and self._phase_ok(snap, phase)
             and name not in exclude
+            and self._routable(name, now)
         ]
         if not candidates:
             return None
-        now = self._clock()
         self.last_pick_phase = phase or "any"
         if phase == "decode":
             # handoff targets are pure least-loaded: session affinity is
@@ -207,7 +317,7 @@ class ReplicaRouter:
             # the PREFILL pool — pinning decode picks under the tenant
             # would thrash the prefill pin instead
             self.picks += 1
-            return min(candidates)[1]
+            return self._chosen(min(candidates)[1])
         if prefix:
             pinned = self._prefix_affinity.get(prefix)
             if pinned is not None:
@@ -218,6 +328,7 @@ class ReplicaRouter:
                     and self._eligible(snap)
                     and self._phase_ok(snap, phase)
                     and replica not in exclude
+                    and self._routable(replica, now)
                     and now - pinned_at <= self.affinity_ttl_s
                 ):
                     # the replica already holding this prompt's prefix
@@ -231,7 +342,7 @@ class ReplicaRouter:
                         # keep the tenant pin converged on the same
                         # replica so the two affinity maps never fight
                         self._pin_tenant(tenant, replica, now)
-                    return replica
+                    return self._chosen(replica)
                 self.prefix_rerouted += 1
         if tenant:
             pinned = self._affinity.get(tenant)
@@ -243,6 +354,7 @@ class ReplicaRouter:
                     and self._eligible(snap)
                     and self._phase_ok(snap, phase)
                     and replica not in exclude
+                    and self._routable(replica, now)
                     and now - pinned_at <= self.affinity_ttl_s
                 ):
                     # refresh the pin: an active conversation keeps its
@@ -253,7 +365,7 @@ class ReplicaRouter:
                     self.affinity_hits += 1
                     if prefix:
                         self._pin_prefix(prefix, replica, now)
-                    return replica
+                    return self._chosen(replica)
                 self.affinity_rerouted += 1
         choice = min(candidates)[1]
         self.picks += 1
@@ -261,7 +373,7 @@ class ReplicaRouter:
             self._pin_tenant(tenant, choice, now)
         if prefix:
             self._pin_prefix(prefix, choice, now)
-        return choice
+        return self._chosen(choice)
 
     def _pin_tenant(self, tenant: str, replica: str, now: float) -> None:
         self._affinity[tenant] = [replica, now]
@@ -316,6 +428,24 @@ class ReplicaRouter:
             "prefix_hits": self.prefix_hits,
             "prefix_rerouted": self.prefix_rerouted,
             "pinned_prefixes": len(self._prefix_affinity),
+            # circuit-breaker posture (docs/RESILIENCE.md): per-replica
+            # state machines + the transition tail the autoscaler/
+            # engine_top read; breaker_open_replicas is the headline
+            # pressure gauge (routable capacity lost to dead pods)
+            "breakers": {
+                name: b.stats() for name, b in sorted(self._breakers.items())
+            },
+            "breaker_open_replicas": sum(
+                1 for b in self._breakers.values() if b.state == OPEN
+            ),
+            "breaker_events": list(self.events),
+            # live Retry-After holds (replica -> seconds until release)
+            "held_replicas": {
+                name: round(max(0.0, until - self._clock()), 3)
+                for name, until in sorted(self._holds.items())
+                if until > self._clock()
+            },
+            "holds_applied": self.holds_applied,
         }
 
 

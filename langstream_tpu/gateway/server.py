@@ -40,6 +40,7 @@ from langstream_tpu.gateway.auth import (
     get_auth_provider,
 )
 from langstream_tpu.gateway.router import REPLICA_HEADER, ReplicaRouter
+from langstream_tpu.serving.handoff import DEADLINE_HEADER
 from langstream_tpu.serving.prefixstore import (
     PREFIX_HEADER,
     prefix_digest_for_text,
@@ -429,6 +430,39 @@ class GatewayServer:
         tenant, priority = self._qos_identity(params, principal)
         return {QOS_TENANT_HEADER: tenant, QOS_PRIORITY_HEADER: priority}
 
+    def _stamp_deadline(
+        self,
+        headers: dict[str, Any],
+        limiter: TenantLimiter | None,
+        params: dict[str, str],
+        priority: str,
+    ) -> dict[str, Any]:
+        """Stamp the record's end-to-end deadline (in place):
+        ``langstream-deadline`` = absolute epoch seconds, enforced
+        504-shaped by every engine on the request's path (serving/
+        handoff.py, docs/RESILIENCE.md). A client-supplied header wins;
+        a ``deadline-s`` query param is a client-relative budget; and an
+        app whose qos section opts in (``deadline-headers: true``) gets
+        the per-class default stamped on everything else. No deadline
+        anywhere → headers stay byte-identical (the default-config
+        pin)."""
+        if headers.get(DEADLINE_HEADER):
+            return headers  # explicit client budget: honored end to end
+        raw = params.get("deadline-s")
+        if raw is not None:
+            try:
+                headers[DEADLINE_HEADER] = repr(
+                    time.time() + max(0.0, float(raw))
+                )
+            except (TypeError, ValueError):
+                pass  # malformed param degrades to "no deadline"
+            return headers
+        if limiter is not None and limiter.spec.deadline_headers:
+            headers[DEADLINE_HEADER] = repr(
+                time.time() + limiter.spec.class_policy(priority).deadline_s
+            )
+        return headers
+
     def _stamp_replica(
         self,
         headers: dict[str, Any],
@@ -609,7 +643,7 @@ class GatewayServer:
         except AuthenticationException as e:
             raise web.HTTPUnauthorized(reason=str(e))
         limiter = self.registry.qos_limiter(tenant, app_id)
-        qos_tenant, _ = self._qos_identity(params, principal)
+        qos_tenant, qos_priority = self._qos_identity(params, principal)
         # an already-empty bucket refuses the upgrade itself with a real
         # 429 (per-message throttling below covers mid-stream exhaustion)
         self._ws_throttle_gate(limiter, qos_tenant)
@@ -636,6 +670,9 @@ class GatewayServer:
                     self._stamp_replica(
                         headers, tenant, app_id, params, principal,
                         value=payload.get("value"),
+                    )
+                    self._stamp_deadline(
+                        headers, limiter, params, qos_priority
                     )
                     retry = (
                         limiter.admit_request(qos_tenant)
@@ -690,7 +727,7 @@ class GatewayServer:
             raise web.HTTPUnauthorized(reason=str(e))
         payload = await self._json_body(request)
         limiter = self.registry.qos_limiter(tenant, app_id)
-        qos_tenant, _ = self._qos_identity(params, principal)
+        qos_tenant, qos_priority = self._qos_identity(params, principal)
         inject = {
             **self._mapped_headers(gateway.produce_headers, params, principal),
             **self._qos_headers(limiter, params, principal),
@@ -702,6 +739,7 @@ class GatewayServer:
             headers, tenant, app_id, params, principal,
             value=payload.get("value"),
         )
+        self._stamp_deadline(headers, limiter, params, qos_priority)
         if limiter is not None:
             retry = limiter.admit_request(qos_tenant)
             if retry is not None:
@@ -801,7 +839,7 @@ class GatewayServer:
         if not questions_topic or not answers_topic:
             raise web.HTTPBadRequest(reason="chat gateway needs questions/answers topics")
         limiter = self.registry.qos_limiter(tenant, app_id)
-        qos_tenant, _ = self._qos_identity(params, principal)
+        qos_tenant, qos_priority = self._qos_identity(params, principal)
         self._ws_throttle_gate(limiter, qos_tenant)
         ws = web.WebSocketResponse()
         await ws.prepare(request)
@@ -835,6 +873,9 @@ class GatewayServer:
                     self._stamp_replica(
                         headers, tenant, app_id, params, principal,
                         value=payload.get("value"),
+                    )
+                    self._stamp_deadline(
+                        headers, limiter, params, qos_priority
                     )
                     retry = (
                         limiter.admit_request(qos_tenant)
@@ -996,11 +1037,11 @@ class GatewayServer:
         # service round-trips stamp the QoS identity too (the engine's own
         # buckets backstop them); gateway-side shedding stays on the
         # produce/chat paths where a retry hint is actionable
+        limiter = self.registry.qos_limiter(tenant, app_id)
+        _, qos_priority = self._qos_identity(params, principal)
         inject = {
             **self._mapped_headers(gateway.produce_headers, params, principal),
-            **self._qos_headers(
-                self.registry.qos_limiter(tenant, app_id), params, principal
-            ),
+            **self._qos_headers(limiter, params, principal),
         }
         headers, span = self._traced_headers(
             {
@@ -1014,6 +1055,7 @@ class GatewayServer:
             headers, tenant, app_id, params, principal,
             value=payload.get("value"),
         )
+        self._stamp_deadline(headers, limiter, params, qos_priority)
         self._journey_produce(headers)
         try:
             # `with span:` so a broker failure mid-write/read still closes

@@ -88,6 +88,11 @@ from langstream_tpu.serving.faults import (
     plans_from_env,
 )
 from langstream_tpu.serving.flight import FlightRecorder
+from langstream_tpu.serving.handoff import (
+    DeadlineExceeded,
+    parse_deadline,
+    remaining_s,
+)
 from langstream_tpu.serving.journal import RequestJournal, request_entry
 from langstream_tpu.serving.journey import JOURNEYS
 from langstream_tpu.serving.health import EngineWatchdog, SloSpec, SloTracker
@@ -556,6 +561,11 @@ class _Request:
     # handoff, so the edge fires on genuinely new work
     first_step_noted: bool = False
     import_base_tokens: int = 0
+    # end-to-end deadline (serving/handoff.py, docs/RESILIENCE.md):
+    # absolute WALL-CLOCK epoch seconds — the one clock every replica
+    # on the request's path can compare against. None = no deadline,
+    # every check one attribute test (the default-config pin).
+    deadline: "float | None" = None
 
     @property
     def context_tokens(self) -> list[int]:
@@ -568,6 +578,27 @@ class _Request:
         if not self.generated:
             return self.prompt_tokens
         return self.prompt_tokens + self.generated
+
+
+def _deadline_from_options(options: dict) -> float | None:
+    """The request's absolute epoch deadline out of its options:
+    ``deadline`` (epoch seconds — the forwarded ``langstream-deadline``
+    header) wins over ``deadline-s`` (caller-relative budget). Malformed
+    values degrade to None — a garbage deadline must never refuse work
+    the budget allows (the same posture as :func:`parse_deadline`)."""
+    deadline = parse_deadline(options.get("deadline"))
+    if deadline is not None:
+        return deadline
+    rel = options.get("deadline-s")
+    if rel is None:
+        return None
+    try:
+        rel = float(rel)
+    except (TypeError, ValueError):
+        return None
+    # a non-positive relative budget means "expired on arrival" — the
+    # admission check refuses it loudly rather than dropping the field
+    return time.time() + max(0.0, rel)  # graftcheck: disable=OBS501 deadlines are wall-clock by design (cross-replica epoch stamps)
 
 
 def _normalize_stop(value) -> list[str]:
@@ -1000,7 +1031,40 @@ class TpuServingEngine:
         self._m_kv_import_hist = None
         self._m_kv_export_bytes = None
         self._m_kv_import_bytes = None
+        # cross-replica failure domain (serving/handoff.py,
+        # docs/RESILIENCE.md "Distributed failure domain"): handoff
+        # re-offer/fallback counters fed by the chainer, deadline
+        # shed/overrun counters fed by the admission and finish paths.
+        # The Prometheus spellings register below for split-pool engines
+        # only (retry/fallback) or lazily on first use (deadline) — a
+        # combined-pool, deadline-less engine keeps the exact
+        # pre-existing scrape surface (the default-config pin).
+        self.handoff_retries = 0
+        self.handoff_fallbacks = 0
+        self.deadline_sheds = 0
+        self.deadline_overruns = 0
+        # exported-but-unsettled handoffs: request id -> journal id. An
+        # entry retires only when the chainer confirms the decode side
+        # ANSWERED (completion or terminal refusal) — a decode pod that
+        # dies mid-handoff leaves the entry live, so a restart replays
+        # the request as fresh work instead of losing it invisibly.
+        self._handoff_journal: "OrderedDict[str, str]" = OrderedDict()
+        self._reporter = reporter
+        self._m_handoff_retries = None
+        self._m_handoff_fallbacks = None
+        self._m_deadline_shed = None
+        self._m_breaker_open = None
         if self._pool_role != "combined":
+            self._m_handoff_retries = reporter.counter(
+                "handoff_retries_total",
+                "KV handoff offers re-routed to another decode replica "
+                "after a timeout/refusal/shed (serving/handoff.py)",
+            )
+            self._m_handoff_fallbacks = reporter.counter(
+                "handoff_fallbacks_total",
+                "KV handoffs decoded LOCALLY after the re-offer cap "
+                "(every decode replica dead, held, or refusing)",
+            )
             self._m_kv_export_hist = reporter.histogram(
                 "kv_export_seconds",
                 "device gather + serialization wall time per KV handoff "
@@ -1183,6 +1247,11 @@ class TpuServingEngine:
             raise ValueError("shrink_recovery_s must be > 0")
         plans = tuple(config.faults) or plans_from_env()
         self._faults = FaultInjector(plans) if plans else None
+        if self.prefix_store is not None and self._faults is not None:
+            # the t2-get network seam (serving/faults.py): the hydrator
+            # consults the SAME injector the device seams use, so one
+            # chaos plan scripts both failure domains
+            self.prefix_store._fault_injector = self._faults
         # fired faults hand off loop-ward through a deque: the seams
         # span both thread roles, the flight ring's emission is loop-side
         self._fault_fired: deque = deque()
@@ -2355,6 +2424,11 @@ class TpuServingEngine:
             frequency_penalty=float(options.get("frequency-penalty", 0.0)),
             tenant=str(options.get("qos-tenant", "") or ""),
             priority=normalize_priority(options.get("priority")),
+            # end-to-end deadline (docs/RESILIENCE.md): "deadline" is
+            # the absolute epoch stamp the gateway/agent forwarded from
+            # the langstream-deadline header; "deadline-s" a caller-
+            # relative budget. Malformed values degrade to None.
+            deadline=_deadline_from_options(options),
         )
         if not _warmup_probe:
             # journey ledger key: the trace id when traced (the one id
@@ -2370,6 +2444,13 @@ class TpuServingEngine:
                 model=self.config.model, role=self._pool_role,
                 prompt_tokens=len(tokens), max_tokens=max_tokens,
             )
+        if request.deadline is not None and not _warmup_probe:
+            left = remaining_s(request.deadline)
+            if left <= 0.0:
+                # the deadline acceptance contract: an unmeetable budget
+                # is refused with an explicit event BEFORE the request
+                # ever queues — never a silent late completion
+                raise self._note_deadline_shed(request, "submit", left)
         try:
             if self._draining and not _warmup_probe:
                 # drain-before-terminate: admission is closed. The shed
@@ -2747,19 +2828,114 @@ class TpuServingEngine:
             "pending_exports": len(self._exports),
             "pending_imports": len(self._pending_imports),
             "in_transit_bytes": self._kv_in_transit_bytes,
+            # cross-replica failure domain (serving/handoff.py): chainer
+            # re-offers/fallbacks and handoffs awaiting the decode
+            # side's answer (their journal entries stay live)
+            "retries": self.handoff_retries,
+            "fallbacks": self.handoff_fallbacks,
+            "unsettled_handoffs": len(self._handoff_journal),
         }
 
-    def take_export_entry(self, request_id: str) -> dict[str, Any] | None:
+    def handoff_settled(self, request_id: str) -> None:
+        """The decode side ANSWERED this handoff — a completed result or
+        a terminal refusal (409/504, which the decode side recorded) —
+        so the prefill-side journal entry retires. Until this call the
+        entry stays live: a decode pod dying mid-handoff leaves it to
+        replay as a fresh request on restart (docs/RESILIENCE.md).
+        Wait-free: a dict pop + the journal's deque handoff."""
+        journal_id = self._handoff_journal.pop(request_id, None)
+        if journal_id is not None and self.journal is not None:
+            self.journal.retire(journal_id)
+            if self._m_journal_depth is not None:
+                self._m_journal_depth(self.journal.depth())
+
+    def note_handoff_retry(
+        self, request_id: str, replica: str | None = None,
+        attempt: int = 0, reason: str = "",
+    ) -> None:
+        """One chainer re-offer (serving/handoff.py): counter + flight
+        event, so a retry storm is visible in the ring and engine_top's
+        ``--analyze`` can flag it. Wait-free."""
+        self.handoff_retries += 1
+        if self._m_handoff_retries is not None:
+            self._m_handoff_retries(1)
+        self.flight.event(
+            "handoff-retry", request=request_id, replica=replica,
+            attempt=attempt, reason=str(reason)[:160],
+        )
+
+    def note_handoff_fallback(self, request_id: str, attempts: int = 0) -> None:
+        """The chainer gave up on the decode pool and is importing the
+        payload locally: counter + flight event (never invisible — a
+        fallback means this prefill replica now pays a decode)."""
+        self.handoff_fallbacks += 1
+        if self._m_handoff_fallbacks is not None:
+            self._m_handoff_fallbacks(1)
+        self.flight.event(
+            "handoff-fallback", request=request_id, attempts=attempts,
+        )
+
+    def note_breaker_open(self, open_replicas: int = 0) -> None:
+        """Mirror of the router's breaker pressure: a lazily-registered
+        gauge (first breaker event only — a fleet that never trips one
+        keeps the pre-existing scrape surface)."""
+        if self._m_breaker_open is None:
+            self._m_breaker_open = self._reporter.gauge(
+                "breaker_open_replicas",
+                "replicas currently excluded from routing by an OPEN "
+                "circuit breaker (gateway/router.py; docs/RESILIENCE.md)",
+            )
+        self._m_breaker_open(open_replicas)
+
+    def note_fault_fired(self, **detail: Any) -> None:
+        """Loop-side spelling of the ``fault-injected`` evidence event
+        for the NETWORK seams (the chainer runs on the event loop, so
+        no deque handoff is needed — cause still lands in the ring
+        before the retry/fallback it triggers)."""
+        self.flight.event("fault-injected", **detail)
+
+    def take_export_entry(
+        self, request_id: str, settle: bool = True
+    ) -> dict[str, Any] | None:
         """Pop one export entry (payload + the stashed trace/journey
-        coordinates — what the pod ``/kv/export/{request}`` handler
+        coordinates — what the pod ``GET /kv/export/{request}`` handler
         needs to echo the trace header). Wait-free (POOL701): dict pops
         and journey-ledger appends only; the payload leaves the
         in-transit ledger here and the pickup lands as an
-        ``export-taken`` journey edge (the handoff-wait/transfer split)."""
+        ``export-taken`` journey edge (the handoff-wait/transfer split).
+
+        ``settle`` (default True — the PULL model): the pickup is the
+        last event this engine will ever see for the handoff, so the
+        journal entry retires here, exactly as it did pre-chainer. The
+        chainer passes ``settle=False``: it stays in the loop and
+        settles on the decode side's actual answer, so a decode pod
+        dying after pickup still replays from this journal."""
+        if self._faults is not None:
+            # http-export network fault seam (serving/faults.py): the
+            # pickup "never arrives" — drop answers None (the pod maps
+            # it to 404) WITHOUT popping, so a retried pickup can still
+            # succeed once the fault disarms; the journal keeps the
+            # entry live either way (chaos drills only)
+            action = self._faults.fire("http-export")
+            if action is not None:
+                self._fault_fired.append(
+                    {"site": "http-export", "shape": action.shape,
+                     "fire": action.seq, "hang_ms": None}
+                )
+                if action.shape == "delay-ms":
+                    # injected pickup stall (tests/chaos only; unarmed
+                    # engines never reach this branch)
+                    time.sleep(action.hang_ms / 1000.0)
+                elif action.shape == "error":
+                    raise RuntimeError(action.message)
+                else:
+                    return None
         entry = self._exports.pop(request_id, None)
         if entry is None:
             return None
         self._kv_in_transit_bytes -= entry["bytes"]
+        if settle:
+            self.handoff_settled(request_id)
         JOURNEYS.record(
             entry.get("journey"), "export-taken",
             handoff=request_id, bytes=entry["bytes"],
@@ -2781,6 +2957,12 @@ class TpuServingEngine:
         for slot_id, slot in enumerate(self.slots):
             request = slot.request
             if request is None or slot.prefilling:
+                continue
+            if request.imported:
+                # a local-fallback import (serving/handoff.py): this
+                # request already WENT through the handoff plane and
+                # every decode replica refused it — it decodes here,
+                # on the combined path, and must never re-export
                 continue
             if request.future.cancelled():
                 # caller gave up between prefill and export: nothing to
@@ -2858,6 +3040,10 @@ class TpuServingEngine:
             "stop": list(request.stop),
             "tenant": request.tenant,
             "priority": request.priority,
+            # the end-to-end deadline rides the wire beside the trace:
+            # the decode pool enforces the SAME budget the gateway
+            # stamped (docs/RESILIENCE.md)
+            "deadline": request.deadline,
             "timings": {k: round(v, 6) for k, v in timings.items()},
         }
         payload = kvtransfer.serialize_handoff(header, arrays)
@@ -2880,6 +3066,9 @@ class TpuServingEngine:
                 # without re-parsing the payload header
                 "trace": header["trace"],
                 "journey": request.journey_id,
+                # the chainer derives every offer's socket timeout from
+                # this (serving/handoff.py socket_timeout_s)
+                "deadline": request.deadline,
             }
             self._kv_in_transit_bytes += len(payload)
             while len(self._exports) > self._export_cap:
@@ -2946,10 +3135,20 @@ class TpuServingEngine:
                         attributes={"bytes": len(payload), "rows": rows})
         self.scheduler.on_finished(request)
         self.completed_requests += 1
-        # the handoff IS this pool's finish (a handed-off request never
-        # reaches _flush_emits' finish path): retire its journal entry,
-        # or a restart would replay work the decode pool already served
-        self._journal_retire(request)
+        # the handoff is NOT this request's end of life for the journal:
+        # the decode side can still die before completion, and retiring
+        # here made that loss invisible (the PR 15 satellite fix). The
+        # entry stays live, keyed under the handoff id, until the
+        # chainer confirms the decode side ANSWERED (handoff_settled) —
+        # a crash anywhere in between replays the request as fresh work
+        # from the prefill-side journal. Bounded: overflow drops the
+        # MAPPING loudly (replay-over-loss — the entry stays live and
+        # the journal's own bound is the final backstop).
+        if self.journal is not None and not request.warmup:
+            self._handoff_journal[rid] = request.journey_id
+            while len(self._handoff_journal) > 4 * self._export_cap:
+                old_rid, _old_jid = self._handoff_journal.popitem(last=False)
+                self.flight.event("handoff-settle-evict", request=old_rid)
         if not request.future.done():
             request.future.set_result(
                 {
@@ -2971,6 +3170,8 @@ class TpuServingEngine:
         payload: bytes,
         header: dict[str, Any] | None = None,
         trace_header: str | None = None,
+        deadline: float | None = None,
+        local_fallback: bool = False,
     ) -> dict[str, Any]:
         """Decode-pool half of the handoff: admit a request whose KV
         state arrived over the wire — blocks allocate through the
@@ -2991,7 +3192,13 @@ class TpuServingEngine:
             raise RuntimeError(
                 "serving engine is stopped (closed or lockstep group broken)"
             )
-        if self._pool_role == "prefill":
+        if self._pool_role == "prefill" and not local_fallback:
+            # local_fallback is the chainer's escape hatch (serving/
+            # handoff.py): when every decode replica is dead/held/
+            # refusing, the prefill engine imports its OWN payload and
+            # the request rejoins the combined decode path — the
+            # serialized snapshot is the complete state, so the result
+            # is byte-identical to the disaggregated path
             raise kvtransfer.LayoutMismatch(
                 "prefill-role engine does not accept KV imports"
             )
@@ -3053,6 +3260,14 @@ class TpuServingEngine:
             priority=normalize_priority(header.get("priority")),
             imported=True,
             trace=trace,
+            # deadline continuity: the wire header's stamp (the prefill
+            # side carried the ORIGINAL budget) wins over the pod HTTP
+            # header's copy — both are the same epoch clock, and
+            # parse_deadline only ever returns None or a positive stamp
+            deadline=(
+                parse_deadline(header.get("deadline"))
+                or parse_deadline(deadline)
+            ),
         )
         request.import_base_tokens = len(generated)
         request.journey_id = kvtransfer.journey_id(header) or (
@@ -3063,6 +3278,16 @@ class TpuServingEngine:
             handoff=header.get("request"),
             model=self.config.model, role=self._pool_role,
         )
+        if (
+            request.deadline is not None
+            and remaining_s(request.deadline) <= 0.0
+        ):
+            # expired in transit: refuse 504-shaped BEFORE queueing the
+            # scatter (the pod maps this to HTTP 504; an overrun this
+            # early must never burn blocks/device work). After the
+            # journey id is bound, so the refusal lands as a terminal
+            # edge in the request's ledger instead of vanishing.
+            raise self._note_deadline_shed(request, "kv-import", 0.0)
         self._pending_imports.append(
             (header, arrays, request, len(payload))
         )
@@ -3126,6 +3351,56 @@ class TpuServingEngine:
         while self._fault_fired:
             self.flight.event("fault-injected", **self._fault_fired.popleft())
 
+    def _note_deadline_shed(
+        self, request, where: str, left: float, estimate: float = 0.0
+    ) -> DeadlineExceeded:
+        """Record one deadline refusal (counter + lazy metric + a
+        ``deadline-exceeded`` flight event with the budget evidence) and
+        build the 504-shaped error the caller raises/sets. The metric
+        registers on FIRST use so a deadline-less engine's scrape
+        surface stays byte-identical (the default-config pin)."""
+        self.deadline_sheds += 1
+        if self._m_deadline_shed is None:
+            self._m_deadline_shed = self._reporter.counter(
+                "deadline_shed_total",
+                "requests refused because the remaining langstream-"
+                "deadline budget could not cover the admission estimate "
+                "(504-shaped; docs/RESILIENCE.md)",
+            )
+        self._m_deadline_shed(1)
+        self.flight.event(
+            "deadline-exceeded",
+            where=where,
+            remaining_s=round(left, 6),
+            estimate_s=round(estimate, 6),
+            tenant=request.tenant,
+            priority=request.priority,
+        )
+        self._journey(
+            request, "deadline-exceeded", where=where,
+            remaining_s=round(left, 6),
+        )
+        if not request.warmup:
+            self._slo_record("shed-rate", False)
+        return DeadlineExceeded(
+            f"deadline exceeded at {where}: {left:.3f}s of budget left, "
+            f"admission estimate {estimate:.3f}s",
+            overrun_s=max(0.0, estimate - left),
+        )
+
+    def _admit_estimate_s(self) -> float:
+        """The admission-time cost estimate a deadline must still cover:
+        the median recent prefill time (enqueue-side work the engine is
+        ABOUT to spend on the device). No history → 0.0, so a fresh
+        engine only sheds already-expired budgets — the estimate
+        tightens as evidence accumulates, never guesses ahead of it."""
+        vals = sorted(
+            t.get("prefill", 0.0)
+            for t in list(self.request_timings)[-32:]
+            if not t.get("imported")
+        )
+        return vals[len(vals) // 2] if vals else 0.0
+
     def _shed_import(self, request, reason: str, detail: str) -> None:
         """Refuse one pending import explicitly: RateLimited with a retry
         hint, so the pod handler answers 503 + Retry-After and the router
@@ -3152,6 +3427,20 @@ class TpuServingEngine:
             header, arrays, request, nbytes = self._pending_imports.popleft()
             if request.future.done():
                 continue  # caller gave up while queued
+            if request.deadline is not None:
+                # the deadline rode the wire header: an import whose
+                # budget died in transit is refused 504-shaped before
+                # any block allocation or scatter (the pod handler maps
+                # DeadlineExceeded to HTTP 504; the chainer treats it
+                # as terminal — no sibling replica has more budget)
+                left = remaining_s(request.deadline)
+                if left <= 0.0:
+                    err = self._note_deadline_shed(
+                        request, "kv-import", left
+                    )
+                    if not request.future.done():
+                        request.future.set_exception(err)
+                    continue
             if self._draining:
                 self._shed_import(
                     request, "draining",
@@ -3873,6 +4162,10 @@ class TpuServingEngine:
                     ),
                     tenant=str(entry.get("tenant", "") or ""),
                     priority=normalize_priority(entry.get("priority")),
+                    # the original end-to-end budget replays with the
+                    # entry: the admission deadline gate sheds it loudly
+                    # if the crash already spent it
+                    deadline=parse_deadline(entry.get("deadline")),
                 )
             except (KeyError, TypeError, ValueError) as e:
                 # a corrupt entry is retired loudly, never replayed as
@@ -3918,6 +4211,14 @@ class TpuServingEngine:
             "shrink_preempted": self.shrink_preempted,
             "recovery_s": self.config.shrink_recovery_s,
             "recovering": self._shrink_recover_at is not None,
+            # cross-replica failure domain (docs/RESILIENCE.md
+            # "Distributed failure domain"): 504-shaped deadline
+            # refusals and post-hoc overruns, chainer re-offers and
+            # local-decode fallbacks — engine_top's panel reads these
+            "deadline_sheds": self.deadline_sheds,
+            "deadline_overruns": self.deadline_overruns,
+            "handoff_retries": self.handoff_retries,
+            "handoff_fallbacks": self.handoff_fallbacks,
         }
         if bm is not None:
             out["budget_blocks"] = bm.usable_blocks
@@ -5111,6 +5412,22 @@ class TpuServingEngine:
                     # so a restart must not replay it
                     self._journal_retire(request)
                     continue
+                if request.deadline is not None:
+                    # deadline gate (docs/RESILIENCE.md): shed BEFORE
+                    # any device work when the remaining budget cannot
+                    # cover the admission estimate — an explicit
+                    # 504-shaped refusal beats a silent late completion
+                    left = remaining_s(request.deadline)
+                    estimate = self._admit_estimate_s()
+                    if left <= estimate:
+                        self.scheduler.pop()
+                        err = self._note_deadline_shed(
+                            request, "admission", left, estimate
+                        )
+                        self._journal_retire(request)
+                        if not request.future.done():
+                            request.future.set_exception(err)
+                        continue
                 # one chain-digest walk per admission attempt, shared by
                 # the hydration check, the promotion, and match_prefix
                 # below — the admission path hashes the prompt ONCE
@@ -5627,6 +5944,24 @@ class TpuServingEngine:
             done_t = time.monotonic()
             first = request.first_token_time or done_t
             admit = request.admit_time or first
+            if request.deadline is not None:
+                # the deadline acceptance's second half: a request that
+                # completes PAST its budget still answers (the work is
+                # done; discarding it helps nobody) but the overrun is
+                # recorded — never a silent late completion
+                overrun = time.time() - request.deadline  # graftcheck: disable=OBS501 deadline overrun compares epoch stamps, not a latency
+                if overrun > 0:
+                    self.deadline_overruns += 1
+                    self.flight.event(
+                        "deadline-overrun",
+                        overrun_s=round(overrun, 6),
+                        tokens=len(request.generated),
+                        tenant=request.tenant,
+                    )
+                    self._journey(
+                        request, "deadline-overrun",
+                        overrun_s=round(overrun, 6),
+                    )
             timing = {
                 "queue_wait": admit - request.enqueue_time,
                 "prefill": first - admit,
@@ -5830,7 +6165,9 @@ def take_kv_export(request_id: str) -> dict[str, Any] | None:
 
 
 async def import_kv_handoff(
-    payload: bytes, trace_header: str | None = None
+    payload: bytes,
+    trace_header: str | None = None,
+    deadline_header: str | None = None,
 ) -> dict[str, Any]:
     """Route one KV handoff payload to this pod's matching engine (the
     ``POST /kv/import`` handler): the header's fingerprint model picks
@@ -5860,9 +6197,12 @@ async def import_kv_handoff(
     candidates.sort(
         key=lambda e: 0 if e.config.pool_role == "decode" else 1
     )
-    # the peeked header rides along so the token-list JSON parses once
+    # the peeked header rides along so the token-list JSON parses once;
+    # the pod's langstream-deadline request header is the fallback
+    # budget when the wire header predates the deadline plane
     result = await candidates[0].import_handoff(
-        payload, header=header, trace_header=trace_header
+        payload, header=header, trace_header=trace_header,
+        deadline=parse_deadline(deadline_header),
     )
     trace = header.get("trace") or trace_header
     if trace and "trace" not in result:

@@ -36,11 +36,27 @@ import json
 import os
 from typing import Any
 
-#: engine seams a plan may target (docs/RESILIENCE.md fault-site table)
-FAULT_SITES = ("pool-grow", "prefill", "scatter", "fetch")
+#: engine seams a plan may target (docs/RESILIENCE.md fault-site table).
+#: The first four are device seams (PR 14); the network seams make the
+#: cross-replica failure domain scriptable too — ``http-export`` /
+#: ``http-import`` are the handoff chainer's pickup/offer HTTP calls
+#: (serving/handoff.py), ``t2-get`` the prefix hydrator's object-storage
+#: fetch (serving/prefixstore.py), ``route`` the replica router's pick
+#: (gateway/router.py).
+FAULT_SITES = (
+    "pool-grow", "prefill", "scatter", "fetch",
+    "http-export", "http-import", "t2-get", "route",
+)
 
-#: fault shapes: a synthetic allocator refusal, or a stalled dispatch
-FAULT_SHAPES = ("oom", "hang")
+#: fault shapes: a synthetic allocator refusal, a stalled dispatch, and
+#: the three network shapes — ``drop`` (connection refused/reset before
+#: any HTTP answer), ``delay-ms`` (the call completes ``hang_ms`` late:
+#: the deadline/timeout plane must absorb it), ``error`` (a synthetic
+#: HTTP 500 — the pod answered, wrongly)
+FAULT_SHAPES = ("oom", "hang", "drop", "delay-ms", "error")
+
+#: shapes that stall for ``hang_ms`` and therefore require it > 0
+_TIMED_SHAPES = ("hang", "delay-ms")
 
 #: the default synthetic message — spelled like the real jaxlib failure so
 #: the engine's ``_resource_exhausted`` classifier treats injected and
@@ -86,8 +102,8 @@ class FaultPlan:
             raise ValueError("fault after must be >= 0")
         if self.count < 1:
             raise ValueError("fault count must be >= 1")
-        if self.shape == "hang" and self.hang_ms <= 0:
-            raise ValueError("hang faults need hang-ms > 0")
+        if self.shape in _TIMED_SHAPES and self.hang_ms <= 0:
+            raise ValueError(f"{self.shape} faults need hang-ms > 0")
 
     def to_dict(self) -> dict[str, Any]:
         return {

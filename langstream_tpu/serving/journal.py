@@ -70,6 +70,12 @@ def request_entry(request) -> dict[str, Any]:
         "stop": list(request.stop),
         "tenant": request.tenant,
         "priority": request.priority,
+        # end-to-end deadline (serving/handoff.py): absolute epoch
+        # seconds, or None. A replayed entry keeps its ORIGINAL budget —
+        # the restarted engine's admission gate sheds it loudly if the
+        # crash outlived it (an expired replay must not complete
+        # silently late)
+        "deadline": getattr(request, "deadline", None),
     }
 
 

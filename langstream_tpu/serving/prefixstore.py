@@ -402,8 +402,15 @@ class PrefixStore:
         block_bytes: int,
         rows_per_block: int,
         clock: Callable[[], float] = time.monotonic,
+        fault_injector=None,
     ):
         self.spec = spec
+        # network fault seam (serving/faults.py `t2-get` site): the
+        # engine hands its armed injector down so a chaos test can drop
+        # or delay the hydrator's object-storage fetch deterministically
+        # (a failed fetch takes the existing hydrate-timeout → cold-
+        # compute fallback, so the shapes compose). None in production.
+        self._fault_injector = fault_injector
         self.fingerprint = dict(fingerprint)
         self.block_bytes = int(block_bytes)
         self.rows_per_block = int(rows_per_block)
@@ -892,6 +899,22 @@ class PrefixStore:
         self._results.append(("put-done", digest_hex, len(blob)))
 
     def _io_fetch(self, storage: PrefixStorage, digest_hex: str) -> None:
+        if self._fault_injector is not None:
+            action = self._fault_injector.fire("t2-get")
+            if action is not None:
+                # hydrator thread: stalls/drops here never touch the
+                # engine loop — a drop reports fetch-missing (the blob
+                # "vanished"), the timeout machinery does the rest
+                self._events.append(
+                    ("fault-injected",
+                     {"site": "t2-get", "shape": action.shape,
+                      "fire": action.seq})
+                )
+                if action.shape == "delay-ms":
+                    time.sleep(action.hang_ms / 1000.0)
+                elif action.shape in ("drop", "error", "oom", "hang"):
+                    self._results.append(("fetch-missing", digest_hex))
+                    return
         try:
             blob = storage.get(digest_hex)
         except Exception:

@@ -181,6 +181,13 @@ class QosSpec:
     tenants: tuple[TenantPolicy, ...] = ()
     preempt: bool = True
     max_preemptions: int = 2
+    # end-to-end deadline stamping (serving/handoff.py, docs/
+    # RESILIENCE.md): when True the gateway stamps langstream-deadline
+    # = now + the class's deadline-s on every produced record that did
+    # not bring its own, and the engine's admission gate enforces it
+    # 504-shaped. Opt-in: existing QoS deployments treat deadline-s as
+    # the preemption cost model only, bit for bit.
+    deadline_headers: bool = False
 
     def class_policy(self, name: str) -> ClassPolicy:
         for policy in self.classes:
@@ -205,6 +212,7 @@ class QosSpec:
             "tenants": {p.name: p.to_dict() for p in self.tenants},
             "preempt": self.preempt,
             "max-preemptions": self.max_preemptions,
+            "deadline-headers": self.deadline_headers,
         }
 
     @classmethod
@@ -287,6 +295,9 @@ class QosSpec:
             tenants=tuple(tenants),
             preempt=_parse_bool(d.get("preempt", True)),
             max_preemptions=max_preemptions,
+            deadline_headers=_parse_bool(
+                d.get("deadline-headers", d.get("deadline_headers", False))
+            ),
         )
 
 

@@ -582,10 +582,12 @@ def test_persistent_prefill_failure_sheds_instead_of_livelocking(run_async):
     assert sheds and sheds[0]["retries"] >= 3
 
 
-def test_prefill_pool_handoff_retires_journal(tmp_path, run_async):
-    """A prefill-role engine's handoff finish (future resolved in
-    _export_slot, never reaching _flush_emits) retires the journal
-    entry — a restart must not replay work the decode pool served."""
+def test_prefill_pool_handoff_settles_journal(tmp_path, run_async):
+    """A prefill-role engine's handoff finish parks the journal entry
+    UNSETTLED (the decode side may still die before completion —
+    docs/RESILIENCE.md "Distributed failure domain"); the chainer's
+    handoff_settled() is what retires it, exactly once. PR 14 retired
+    at handoff, which made a decode-side death invisible."""
     from langstream_tpu.serving.engine import TpuServingEngine
 
     journal_dir = str(tmp_path / "jprefill")
@@ -601,6 +603,17 @@ def test_prefill_pool_handoff_retires_journal(tmp_path, run_async):
             )
             assert out["finish_reason"] == "handoff"
             assert engine.journal.flush(5.0)
+            # live until the decode side ANSWERS: a crash in between
+            # replays the request instead of losing it invisibly
+            mid = engine.journal.stats()
+            assert mid["live"] == 1
+            assert engine.stats()["kvtransfer"]["unsettled_handoffs"] == 1
+            engine.handoff_settled(out["handoff"])
+            engine.handoff_settled(out["handoff"])  # idempotent
+            assert engine.journal.flush(5.0)
+            assert (
+                engine.stats()["kvtransfer"]["unsettled_handoffs"] == 0
+            )
             return engine.journal.stats()
         finally:
             await engine.close()

@@ -1220,6 +1220,128 @@ def test_flt901_tn_classify_reraise_and_out_of_scope():
 # --------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# NET1201: blocking network calls without explicit timeouts
+# ---------------------------------------------------------------------------
+
+
+def test_net1201_tp_blocking_calls_without_timeout():
+    """urlopen / create_connection / HTTPConnection / requests.* without
+    timeout= on an in-scope path all fire."""
+    src = """
+        import socket
+        import urllib.request
+
+        def offer(url, payload):
+            with urllib.request.urlopen(url, data=payload) as resp:
+                return resp.read()
+
+        def connect(addr):
+            return socket.create_connection(addr)
+        """
+    ids = rule_ids(src, path="langstream_tpu/serving/handoff_client.py")
+    assert ids.count("NET1201") == 2
+    ids = rule_ids(
+        """
+        import requests
+
+        def fanin(url):
+            return requests.get(url).json()
+        """,
+        path="langstream_tpu/k8s/compute.py",
+    )
+    assert "NET1201" in ids
+    ids = rule_ids(
+        """
+        import http.client
+
+        def probe(host):
+            return http.client.HTTPSConnection(host)
+        """,
+        path="langstream_tpu/gateway/poller.py",
+    )
+    assert "NET1201" in ids
+
+
+def test_net1201_tn_timeouts_splats_and_scope():
+    # explicit timeout kwarg: the sanctioned shape
+    assert "NET1201" not in rule_ids(
+        """
+        import urllib.request
+
+        def offer(url, payload, timeout_s):
+            with urllib.request.urlopen(
+                url, data=payload, timeout=timeout_s
+            ) as resp:
+                return resp.read()
+        """,
+        path="langstream_tpu/serving/handoff_client.py",
+    )
+    # create_connection's second positional IS the timeout
+    assert "NET1201" not in rule_ids(
+        """
+        import socket
+
+        def connect(addr):
+            return socket.create_connection(addr, 10.0)
+        """,
+        path="langstream_tpu/serving/lockstep_client.py",
+    )
+    # a **kwargs splat may carry the timeout: forwarding wrappers exempt
+    assert "NET1201" not in rule_ids(
+        """
+        import urllib.request
+
+        def forward(url, **kw):
+            return urllib.request.urlopen(url, **kw)
+        """,
+        path="langstream_tpu/gateway/forward.py",
+    )
+    # out of scope: the same spelling elsewhere in the tree is another
+    # rule's problem (the failure domain is serving/gateway/k8s-compute)
+    assert "NET1201" not in rule_ids(
+        """
+        import urllib.request
+
+        def fetch(url):
+            return urllib.request.urlopen(url).read()
+        """,
+        path="langstream_tpu/agents/webcrawler.py",
+    )
+    # a local helper named get() is not requests.get
+    assert "NET1201" not in rule_ids(
+        """
+        class Store:
+            def get(self, key):
+                return self._data.get(key)
+
+        def read(store, key):
+            return store.get(key)
+        """,
+        path="langstream_tpu/serving/prefix_index.py",
+    )
+    # asyncio's loop.create_connection (and an object's own method of
+    # that name) is cancellation-scoped — the receiver gate keeps it out
+    assert "NET1201" not in rule_ids(
+        """
+        async def connect(loop, factory, pool):
+            await loop.create_connection(factory, host="h", port=1)
+            return pool.create_connection()
+        """,
+        path="langstream_tpu/gateway/conn.py",
+    )
+    # urlopen's THIRD positional is the timeout: bounded, not a finding
+    assert "NET1201" not in rule_ids(
+        """
+        import urllib.request
+
+        def fetch(url, payload):
+            return urllib.request.urlopen(url, payload, 30.0).read()
+        """,
+        path="langstream_tpu/serving/fetcher.py",
+    )
+
+
 def test_inline_suppression_with_reason_silences_finding():
     ids = rule_ids(
         """

@@ -1,0 +1,1351 @@
+"""Cross-replica failure domain (docs/RESILIENCE.md "Distributed
+failure domain"): end-to-end deadlines, handoff retry/re-route with
+local-decode fallback, router circuit breakers + Retry-After holds, and
+the network fault sites.
+
+Layers covered: deadline-budget arithmetic units (stamp → remaining →
+socket-timeout, clock-skew clamp to non-negative), retry-policy
+determinism, the breaker state machine (CLOSED→OPEN→HALF_OPEN→CLOSED
+with probe accounting), router hold/breaker gating + the `route` fault
+site, chainer semantics per decode-side answer (200/503/409/504/
+timeout/refused), the dead-decode-pod chaos e2e (byte-identity,
+``shed==0``, breaker exclusion), the local-decode fallback byte
+identity, the deadline e2e (504-shaped refusal before any device work;
+overrun events on late completions), the crash-mid-handoff journal
+replay, gateway/agent deadline stamping, the default-config pin, and
+the partition_storm bench phase + perf_diff extraction.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from langstream_tpu.gateway.router import ReplicaRouter
+from langstream_tpu.serving.faults import FaultInjector, FaultPlan
+from langstream_tpu.serving.handoff import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerSpec,
+    CircuitBreaker,
+    DeadlineExceeded,
+    HandoffChainer,
+    HandoffLost,
+    RetryPolicy,
+    parse_deadline,
+    remaining_s,
+    socket_timeout_s,
+)
+
+
+def _cfg(**overrides):
+    from langstream_tpu.serving.engine import ServingConfig
+
+    # f32 + paged: the byte-identity posture every handoff/preemption
+    # equivalence test in the tree pins (greedy streams exactly
+    # shape-independent)
+    base = dict(
+        model="tiny", slots=2, max_seq_len=128, decode_chunk=4,
+        model_dtype="float32", kv_layout="paged", kv_block_size=16,
+        kv_pool_blocks=24, prefix_cache=False,
+    )
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+# --------------------------------------------------------------------------
+# deadline-budget arithmetic
+# --------------------------------------------------------------------------
+
+
+def test_parse_deadline_malformed_degrades_to_none():
+    assert parse_deadline(None) is None
+    assert parse_deadline("garbage") is None
+    assert parse_deadline("") is None
+    assert parse_deadline(-5.0) is None
+    assert parse_deadline(0) is None
+    assert parse_deadline("1234.5") == 1234.5
+    assert parse_deadline(1234.5) == 1234.5
+
+
+def test_remaining_clamps_clock_skew_to_non_negative():
+    now = 1000.0
+    assert remaining_s(None, now) is None
+    assert remaining_s(1002.5, now) == 2.5
+    # a skewed clock put the deadline in our past: "expired now", never
+    # a negative that could flow into a timeout computation
+    assert remaining_s(990.0, now) == 0.0
+
+
+def test_socket_timeout_derivation_floor_and_cap():
+    now = 1000.0
+    # no deadline: the explicit finite cap (NET1201's contract)
+    assert socket_timeout_s(None, now) == 30.0
+    # plenty of budget: capped
+    assert socket_timeout_s(now + 300.0, now) == 30.0
+    # mid-range budget: the remaining budget IS the timeout
+    assert socket_timeout_s(now + 3.0, now) == 3.0
+    # nearly expired (and skew-expired): floored, the deadline check
+    # does the refusing — not ECONNABORTED
+    assert socket_timeout_s(now + 0.001, now) == 0.05
+    assert socket_timeout_s(now - 5.0, now) == 0.05
+
+
+def test_deadline_from_options():
+    from langstream_tpu.serving.engine import _deadline_from_options
+
+    assert _deadline_from_options({}) is None
+    assert _deadline_from_options({"deadline": "garbage"}) is None
+    assert _deadline_from_options({"deadline": 1234.5}) == 1234.5
+    # absolute wins over relative
+    assert _deadline_from_options(
+        {"deadline": 99.0, "deadline-s": 5}
+    ) == 99.0
+    t0 = time.time()
+    rel = _deadline_from_options({"deadline-s": 5})
+    assert t0 + 4.5 <= rel <= time.time() + 5.5
+    # non-positive relative budget = expired on arrival, not dropped
+    expired = _deadline_from_options({"deadline-s": -3})
+    assert expired is not None and expired <= time.time()
+
+
+# --------------------------------------------------------------------------
+# retry policy
+# --------------------------------------------------------------------------
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_s=0.0)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_s=1.0, backoff_cap_s=0.5)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.5)
+
+
+def test_retry_policy_deterministic_capped_backoff():
+    policy = RetryPolicy(attempts=5, backoff_s=0.1, backoff_cap_s=0.5,
+                         jitter=0.25)
+    # deterministic in (key, attempt): a chaos run replays the schedule
+    assert policy.delay_s(2, "req-1") == policy.delay_s(2, "req-1")
+    # different keys jitter differently (the anti-thundering-herd point)
+    assert policy.delay_s(2, "req-1") != policy.delay_s(2, "req-2")
+    # jitter bounded: base * (1 +/- 0.25), cap respected
+    for attempt in range(5):
+        base = min(0.1 * (2.0 ** attempt), 0.5)
+        d = policy.delay_s(attempt, "req-1")
+        assert base * 0.74 <= d <= base * 1.26
+    # jitter=0 is the pure exponential
+    flat = RetryPolicy(backoff_s=0.1, backoff_cap_s=0.5, jitter=0.0)
+    assert [flat.delay_s(i) for i in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+
+# --------------------------------------------------------------------------
+# circuit breaker state machine
+# --------------------------------------------------------------------------
+
+
+def _breaker(spec=None):
+    clock = [0.0]
+    b = CircuitBreaker(
+        spec or BreakerSpec(failures=3, window_s=10.0, open_s=5.0),
+        clock=lambda: clock[0],
+    )
+    return b, clock
+
+
+def test_breaker_closed_to_open_inside_window():
+    b, clock = _breaker()
+    assert b.state == CLOSED and b.can_serve()
+    b.record_failure(); b.record_failure()
+    assert b.state == CLOSED  # under the threshold
+    b.record_failure()
+    assert b.state == OPEN and not b.can_serve()
+    assert b.opens == 1
+
+
+def test_breaker_window_ages_out_old_failures():
+    b, clock = _breaker()
+    b.record_failure(); b.record_failure()
+    clock[0] = 11.0  # both fall outside the 10 s window
+    b.record_failure()
+    assert b.state == CLOSED
+
+
+def test_breaker_success_clears_the_window():
+    b, clock = _breaker()
+    b.record_failure(); b.record_failure()
+    b.record_success()
+    b.record_failure(); b.record_failure()
+    assert b.state == CLOSED  # the window counts consecutive trouble
+
+
+def test_breaker_half_open_probe_accounting():
+    b, clock = _breaker()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == OPEN
+    clock[0] = 4.9
+    assert not b.can_serve()
+    clock[0] = 5.1
+    # open_s elapsed: HALF_OPEN, with a probe budget
+    assert b.can_serve()
+    assert b.state == HALF_OPEN
+    # can_serve is non-consuming (a stats poll must not burn probes)
+    assert b.can_serve() and b.can_serve()
+    b.note_probe()  # real traffic routed: one probe slot spent
+    assert not b.can_serve()  # budget (1) exhausted until the report
+    # the probe failed: straight back to OPEN for a fresh window
+    assert b.record_failure() == OPEN
+    assert b.opens == 2
+    clock[0] = 10.2
+    assert b.can_serve()
+    b.note_probe()
+    # the probe succeeded: CLOSED, counters clean
+    assert b.record_success() == CLOSED
+    assert b.closes == 1
+    assert b.can_serve()
+
+
+def test_breaker_unreported_probe_releases_after_open_s():
+    """A granted probe whose outcome never reports back (a picker with
+    no feedback path, a caller that died mid-call) releases after
+    another open_s — a breaker must never exclude a replica forever."""
+    b, clock = _breaker()
+    for _ in range(3):
+        b.record_failure()
+    clock[0] = 5.1
+    assert b.can_serve()
+    b.note_probe()          # granted... and the outcome never arrives
+    assert not b.can_serve()
+    clock[0] = 10.0
+    assert not b.can_serve()  # still inside the probe's grace
+    clock[0] = 10.2           # open_s past the grant: probe released
+    assert b.can_serve()
+    b.note_probe()
+    assert b.record_success() == CLOSED
+
+
+def test_breaker_timeout_kind_counted():
+    b, _ = _breaker()
+    b.record_failure("timeout")
+    assert b.stats()["timeouts"] == 1
+    assert b.stats()["last_kind"] == "timeout"
+
+
+def test_breaker_spec_validation():
+    with pytest.raises(ValueError):
+        BreakerSpec(failures=0)
+    with pytest.raises(ValueError):
+        BreakerSpec(window_s=0)
+    with pytest.raises(ValueError):
+        BreakerSpec(half_open_probes=0)
+
+
+# --------------------------------------------------------------------------
+# fault-plan extension: network sites + shapes
+# --------------------------------------------------------------------------
+
+
+def test_fault_plan_network_sites_and_shapes_roundtrip():
+    for site in ("http-export", "http-import", "t2-get", "route"):
+        plan = FaultPlan(site=site, shape="drop")
+        assert FaultPlan.from_dict(plan.to_dict()) == plan
+    plan = FaultPlan(site="http-import", shape="delay-ms", hang_ms=25.0)
+    assert FaultPlan.from_dict(plan.to_dict()) == plan
+    plan = FaultPlan(site="route", shape="error", message="injected 500")
+    assert FaultPlan.from_dict(plan.to_dict()) == plan
+
+
+def test_fault_plan_delay_requires_duration():
+    with pytest.raises(ValueError):
+        FaultPlan(site="http-import", shape="delay-ms")
+    with pytest.raises(ValueError):
+        FaultPlan(site="t2-get", shape="bogus")
+    with pytest.raises(ValueError):
+        FaultPlan(site="not-a-site", shape="drop")
+
+
+def test_injector_network_site_pass_counting():
+    injector = FaultInjector(
+        (FaultPlan(site="http-import", shape="drop", after=1, count=2),)
+    )
+    assert injector.fire("http-import") is None          # after=1
+    assert injector.fire("route") is None                # other site
+    a1 = injector.fire("http-import")
+    a2 = injector.fire("http-import")
+    assert a1.shape == a2.shape == "drop"
+    assert (a1.seq, a2.seq) == (1, 2)
+    assert injector.fire("http-import") is None          # disarmed
+
+
+# --------------------------------------------------------------------------
+# router: Retry-After holds + breaker gating + route faults
+# --------------------------------------------------------------------------
+
+
+def _router(**kw):
+    clock = [0.0]
+    r = ReplicaRouter(clock=lambda: clock[0], **kw)
+    r.observe([
+        {"replica": "dec-0", "queued": 0, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+        {"replica": "dec-1", "queued": 5, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+    ])
+    return r, clock
+
+
+def test_router_retry_after_hold_outlasts_one_pick():
+    """The satellite fix: a 503-with-hint replica is not re-offered
+    until the hint elapses — `exclude=` only ever lasted one pick."""
+    r, clock = _router()
+    assert r.pick(phase="decode") == "dec-0"
+    r.hold("dec-0", 5.0)
+    # every pick inside the hold window skips it, not just the next one
+    for _ in range(4):
+        assert r.pick(phase="decode") == "dec-1"
+    assert r.stats()["held_replicas"] == {"dec-0": 5.0}
+    clock[0] = 5.1
+    r.observe([
+        {"replica": "dec-0", "queued": 0, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+        {"replica": "dec-1", "queued": 5, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+    ])
+    assert r.pick(phase="decode") == "dec-0"  # hold expired
+    assert r.stats()["held_replicas"] == {}
+    assert r.stats()["holds_applied"] == 1
+
+
+def test_router_breaker_excludes_and_rehabilitates():
+    r, clock = _router(breaker=BreakerSpec(failures=2, open_s=3.0))
+    r.report_failure("dec-0"); r.report_failure("dec-0")
+    stats = r.stats()
+    assert stats["breakers"]["dec-0"]["state"] == OPEN
+    assert stats["breaker_open_replicas"] == 1
+    assert [e["kind"] for e in stats["breaker_events"]] == ["breaker-open"]
+    # excluded from every pick while OPEN
+    for _ in range(4):
+        assert r.pick(phase="decode") == "dec-1"
+    clock[0] = 3.1
+    r.observe([
+        {"replica": "dec-0", "queued": 0, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+        {"replica": "dec-1", "queued": 5, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+    ])
+    # half-open probe: the least-loaded pick returns and burns the budget
+    assert r.pick(phase="decode") == "dec-0"
+    assert r.pick(phase="decode") == "dec-1"  # probe outstanding
+    r.report_success("dec-0")
+    assert r.stats()["breakers"]["dec-0"]["state"] == CLOSED
+    assert r.pick(phase="decode") == "dec-0"
+    kinds = [e["kind"] for e in r.stats()["breaker_events"]]
+    assert kinds == ["breaker-open", "breaker-close"]
+
+
+def test_router_breaker_gates_affinity_pins():
+    r, clock = _router(breaker=BreakerSpec(failures=1))
+    r.observe([
+        {"replica": "a", "queued": 0, "occupancy": 0, "slots": 4},
+        {"replica": "b", "queued": 9, "occupancy": 0, "slots": 4},
+    ])
+    assert r.pick("tenant-x") == "a"          # pins tenant-x -> a
+    r.report_failure("a")
+    # the pin is breaker-gated: a tripped replica breaks affinity too
+    assert r.pick("tenant-x") == "b"
+
+
+def test_router_route_fault_site():
+    r, _ = _router()
+    # one plan fires per pass, declaration order: the drop consumes the
+    # first pick; once disarmed the error plan takes the second
+    r.fault_injector = FaultInjector(
+        (FaultPlan(site="route", shape="drop", count=1),
+         FaultPlan(site="route", shape="error", count=1,
+                   message="registry down"))
+    )
+    assert r.pick(phase="decode") is None        # drop: no pick
+    with pytest.raises(RuntimeError, match="registry down"):
+        r.pick(phase="decode")
+    assert r.pick(phase="decode") == "dec-0"     # disarmed: normal again
+
+
+# --------------------------------------------------------------------------
+# chainer semantics (stub engine + scripted transports)
+# --------------------------------------------------------------------------
+
+
+class _StubFlight:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **detail):
+        self.events.append({"kind": kind, **detail})
+
+
+class _StubEngine:
+    def __init__(self, deadline=None, entry_missing=False):
+        self.flight = _StubFlight()
+        self._faults = None
+        self.handoff_retries = 0
+        self.handoff_fallbacks = 0
+        self.settled = []
+        self.local_imports = 0
+        self._entry = None if entry_missing else {
+            "payload": b"PAYLOAD", "bytes": 7, "trace": None,
+            "journey": "j-1", "deadline": deadline,
+        }
+
+    def take_export_entry(self, rid, settle=True):
+        assert settle is False  # the chainer must never settle at pickup
+        entry, self._entry = self._entry, None
+        return entry
+
+    def handoff_settled(self, rid):
+        self.settled.append(rid)
+
+    def note_handoff_retry(self, rid, **kw):
+        self.handoff_retries += 1
+        self.flight.event("handoff-retry", request=rid, **kw)
+
+    def note_handoff_fallback(self, rid, attempts=0):
+        self.handoff_fallbacks += 1
+        self.flight.event("handoff-fallback", request=rid,
+                          attempts=attempts)
+
+    def note_breaker_open(self, open_replicas=0):
+        pass
+
+    def note_fault_fired(self, **detail):
+        self.flight.event("fault-injected", **detail)
+
+    async def import_handoff(self, payload, local_fallback=False):
+        assert local_fallback
+        self.local_imports += 1
+        return {"tokens": [1, 2], "text": "local", "finish_reason": "stop"}
+
+
+async def _no_sleep(_s):
+    return None
+
+
+def _decode_router(clock=None):
+    clock = clock or [0.0]
+    r = ReplicaRouter(clock=lambda: clock[0],
+                      breaker=BreakerSpec(failures=2, open_s=5.0))
+    r.observe([
+        {"replica": "dec-0", "queued": 0, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+        {"replica": "dec-1", "queued": 1, "occupancy": 0, "slots": 4,
+         "pool": "decode"},
+    ])
+    return r
+
+
+def test_chainer_retry_after_hint_holds_replica(run_async):
+    engine = _StubEngine()
+    router = _decode_router()
+    calls = []
+
+    async def transport(replica, payload, headers, timeout_s):
+        calls.append(replica)
+        if replica == "dec-0":
+            return 503, {"retry_after_s": 9.0}, {}
+        return 200, {"tokens": [5], "finish_reason": "stop"}, {}
+
+    chainer = HandoffChainer(engine, router=router, transport=transport,
+                             sleep=_no_sleep)
+    result = run_async(chainer.chain({"handoff": "r-1"}))
+    assert result["tokens"] == [5]
+    assert calls == ["dec-0", "dec-1"]
+    # the shedding replica is HELD for the hint, not just one pick
+    assert router.stats()["held_replicas"] == {"dec-0": 9.0}
+    assert engine.settled == ["r-1"]
+    assert engine.handoff_retries == 1
+    assert chainer.stats()["retries"] == 1
+
+
+def test_chainer_timeout_feeds_breaker_and_reroutes(run_async):
+    engine = _StubEngine()
+    router = _decode_router()
+
+    async def transport(replica, payload, headers, timeout_s):
+        if replica == "dec-0":
+            raise asyncio.TimeoutError()
+        return 200, {"tokens": [5]}, {}
+
+    chainer = HandoffChainer(engine, router=router, transport=transport,
+                             sleep=_no_sleep)
+    result = run_async(chainer.chain({"handoff": "r-2"}))
+    assert result["tokens"] == [5]
+    assert router.stats()["breakers"]["dec-0"]["timeouts"] == 1
+    # success on a never-failed replica creates no breaker entry at all
+    assert "dec-1" not in router.stats()["breakers"]
+
+
+def test_chainer_409_is_terminal_and_settles(run_async):
+    engine = _StubEngine()
+    router = _decode_router()
+
+    async def transport(replica, payload, headers, timeout_s):
+        return 409, {"error": "layout mismatch"}, {}
+
+    chainer = HandoffChainer(engine, router=router, transport=transport,
+                             sleep=_no_sleep)
+    with pytest.raises(LookupError, match="layout"):
+        run_async(chainer.chain({"handoff": "r-3"}))
+    # the decode side ANSWERED: the journal entry retires, no fallback
+    assert engine.settled == ["r-3"]
+    assert engine.local_imports == 0
+
+
+def test_chainer_504_is_terminal_deadline(run_async):
+    engine = _StubEngine()
+    router = _decode_router()
+
+    async def transport(replica, payload, headers, timeout_s):
+        return 504, {"error": "deadline exceeded in transit"}, {}
+
+    chainer = HandoffChainer(engine, router=router, transport=transport,
+                             sleep=_no_sleep)
+    with pytest.raises(DeadlineExceeded):
+        run_async(chainer.chain({"handoff": "r-4"}))
+    assert engine.settled == ["r-4"]
+    assert engine.local_imports == 0
+
+
+def test_chainer_falls_back_after_cap_and_no_replicas(run_async):
+    engine = _StubEngine()
+    router = _decode_router()
+
+    async def transport(replica, payload, headers, timeout_s):
+        raise ConnectionError("refused")
+
+    chainer = HandoffChainer(
+        engine, router=router, transport=transport,
+        policy=RetryPolicy(attempts=3, backoff_s=0.001), sleep=_no_sleep,
+    )
+    result = run_async(chainer.chain({"handoff": "r-5"}))
+    assert result["text"] == "local"
+    assert engine.local_imports == 1
+    assert engine.handoff_fallbacks == 1
+    # exclusion is one pick deep: dec-0 fails on attempts 0 and 2, which
+    # trips its breaker (failures=2) before the cap forces the fallback
+    kinds = [e["kind"] for e in engine.flight.events]
+    assert kinds.count("handoff-retry") == 3
+    assert "handoff-fallback" in kinds
+    # breaker transitions mirrored onto the engine's flight ring
+    assert "breaker-open" in kinds
+    assert router.stats()["breakers"]["dec-0"]["state"] == OPEN
+
+
+def test_chainer_deadline_derives_transport_timeout(run_async):
+    deadline = time.time() + 4.0
+    engine = _StubEngine(deadline=deadline)
+    router = _decode_router()
+    seen = []
+
+    async def transport(replica, payload, headers, timeout_s):
+        seen.append((headers.get("langstream-deadline"), timeout_s))
+        return 200, {"tokens": [1]}, {}
+
+    chainer = HandoffChainer(engine, router=router, transport=transport,
+                             sleep=_no_sleep)
+    run_async(chainer.chain({"handoff": "r-6"}))
+    header, timeout_s = seen[0]
+    assert parse_deadline(header) == deadline
+    assert 0.05 <= timeout_s <= 4.0  # derived from the remaining budget
+
+
+def test_chainer_lost_export_is_loud(run_async):
+    engine = _StubEngine(entry_missing=True)
+    chainer = HandoffChainer(engine, router=_decode_router(),
+                             transport=None, sleep=_no_sleep)
+    with pytest.raises(HandoffLost):
+        run_async(chainer.chain({"handoff": "gone"}))
+    with pytest.raises(ValueError):
+        run_async(chainer.chain({"not-a-ticket": 1}))
+
+
+def test_chainer_http_import_fault_drop(run_async):
+    """The http-import network fault site: an armed drop turns a
+    healthy offer into a refused connection, deterministically."""
+    engine = _StubEngine()
+    engine._faults = FaultInjector(
+        (FaultPlan(site="http-import", shape="drop", count=1),)
+    )
+    router = _decode_router()
+    calls = []
+
+    async def transport(replica, payload, headers, timeout_s):
+        calls.append(replica)
+        return 200, {"tokens": [9]}, {}
+
+    chainer = HandoffChainer(engine, router=router, transport=transport,
+                             sleep=_no_sleep)
+    result = run_async(chainer.chain({"handoff": "r-7"}))
+    assert result["tokens"] == [9]
+    # first offer dropped BEFORE the transport saw it; second landed
+    assert calls == ["dec-1"]
+    kinds = [e["kind"] for e in engine.flight.events]
+    assert "fault-injected" in kinds and "handoff-retry" in kinds
+
+
+def test_http_export_fault_pickup_never_arrives(run_async):
+    """The http-export site: an armed drop makes the pickup 'never
+    arrive' (None / pod 404) WITHOUT consuming the payload — a retried
+    pickup succeeds once the fault disarms, and the journal entry stays
+    live throughout."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        pre = TpuServingEngine(_cfg(
+            pool_role="prefill",
+            faults=(FaultPlan(site="http-export", shape="drop", count=1),),
+        ))
+        try:
+            ticket = await pre.generate("pickup drop", {"max-tokens": 6})
+            rid = ticket["handoff"]
+            assert pre.take_export_entry(rid) is None  # the drop
+            entry = pre.take_export_entry(rid)         # disarmed: lands
+            assert entry is not None and entry["payload"]
+            assert pre.take_export_entry(rid) is None  # consumed once
+        finally:
+            await pre.close()
+            TpuServingEngine.reset_instances()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# engine e2e: deadlines
+# --------------------------------------------------------------------------
+
+
+def test_deadline_e2e_unmeetable_refused_before_device_work(run_async):
+    """The deadline acceptance: an expired budget is refused with an
+    explicit deadline-exceeded event and a 504-shaped error before any
+    device work is dispatched."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(_cfg())
+        try:
+            with pytest.raises(DeadlineExceeded):
+                await engine.generate(
+                    "expired before it began",
+                    {"max-tokens": 8, "deadline-s": 0},
+                )
+            events = engine.flight.recent_events(0)
+            shed = [e for e in events if e["kind"] == "deadline-exceeded"]
+            assert shed and shed[0]["where"] == "submit"
+            # refused before ANY device work: nothing dispatched, nothing
+            # completed, no slot ever claimed
+            assert engine.completed_requests == 0
+            assert engine.flight.steps_by_phase == {} or not any(
+                engine.flight.steps_by_phase.values()
+            )
+            assert engine.stats()["survival"]["deadline_sheds"] == 1
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_deadline_e2e_admission_gate_sheds_on_estimate(run_async):
+    """A deadline that survives submit but cannot cover the admission
+    estimate (median recent prefill) sheds at the admission gate —
+    still before the prefill dispatch."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(_cfg())
+        try:
+            # seed the estimate with fake history: prefill "costs" 10 s
+            for _ in range(8):
+                engine.request_timings.append(
+                    {"queue_wait": 0.0, "prefill": 10.0, "ttft": 10.0}
+                )
+            with pytest.raises(DeadlineExceeded):
+                await engine.generate(
+                    "one second of budget against a ten second estimate",
+                    {"max-tokens": 8, "deadline-s": 1.0},
+                )
+            shed = [
+                e for e in engine.flight.recent_events(0)
+                if e["kind"] == "deadline-exceeded"
+            ]
+            assert shed and shed[0]["where"] == "admission"
+            assert shed[0]["estimate_s"] == 10.0
+            assert engine.completed_requests == 0
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_deadline_e2e_late_completion_records_overrun(run_async):
+    """A request that completes past its deadline still answers, but
+    the overrun lands as an explicit event — never silent."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(_cfg())
+
+        async def slow_consumer(token, logprob, last):
+            # the deterministic overrun: each emitted token costs 0.1 s
+            # of CLIENT time, so completion lands well past the 0.25 s
+            # budget however fast the warm-cache compile was
+            await asyncio.sleep(0.1)
+
+        try:
+            # a budget that survives submit and the admission gate (no
+            # history -> estimate 0) but cannot survive the consumer
+            result = await engine.generate(
+                "a budget the token stream outspends",
+                {"max-tokens": 4, "deadline-s": 0.25},
+                on_token=slow_consumer,
+            )
+            assert result["tokens"]
+            overruns = [
+                e for e in engine.flight.recent_events(0)
+                if e["kind"] == "deadline-overrun"
+            ]
+            assert overruns and overruns[0]["overrun_s"] > 0
+            assert engine.stats()["survival"]["deadline_overruns"] == 1
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_deadline_rides_the_wire_header(run_async):
+    """The kvtransfer header carries the deadline, and an expired import
+    refuses 504-shaped before any block allocation."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+    from langstream_tpu.serving.kvtransfer import peek_header
+
+    async def main():
+        deadline = time.time() + 60.0
+        pre = TpuServingEngine(_cfg(pool_role="prefill"))
+        dec = TpuServingEngine(_cfg(pool_role="decode"))
+        try:
+            ticket = await pre.generate(
+                "deadline rides the handoff wire",
+                {"max-tokens": 6, "deadline": deadline},
+            )
+            payload = pre.take_export(ticket["handoff"])
+            assert peek_header(payload)["deadline"] == deadline
+            # the wire header's own (live) stamp wins over the pod
+            # header, so expiry is tested on a payload with NO wire
+            # deadline, where the pod-header fallback applies
+            ticket2 = await pre.generate(
+                "no wire deadline this time", {"max-tokens": 6},
+            )
+            payload2 = pre.take_export(ticket2["handoff"])
+            assert peek_header(payload2)["deadline"] is None
+            with pytest.raises(DeadlineExceeded):
+                await dec.import_handoff(
+                    payload2, deadline=time.time() - 1.0,
+                )
+            assert dec.stats()["survival"]["deadline_sheds"] >= 1
+        finally:
+            await pre.close()
+            await dec.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# engine e2e: the dead-decode-pod chaos + local fallback (acceptance)
+# --------------------------------------------------------------------------
+
+
+def test_chaos_decode_pod_killed_mid_handoff_byte_identical(run_async):
+    """THE acceptance e2e: a decode replica is dead and the network
+    drops a burst of offers (http-import faults armed) — the request
+    completes via re-handoff, greedy tokens+text byte-identical to an
+    undisturbed run, shed==0, and the breaker excludes the dead replica
+    from every subsequent pick."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    prompt = "chaos: decode pod dies mid handoff"
+
+    async def main():
+        combined = TpuServingEngine(_cfg())
+        baseline = await combined.generate(prompt, {"max-tokens": 10})
+        await combined.close()
+        TpuServingEngine.reset_instances()
+
+        pre = TpuServingEngine(_cfg(
+            pool_role="prefill",
+            # two injected drops: with one-pick-deep exclusion the dead
+            # replica takes offers 0 and 2 (the second trips its
+            # breaker), the live replica's offer 1 drops to the
+            # partition, and offer 3 lands
+            faults=(FaultPlan(site="http-import", shape="drop", count=2),),
+        ))
+        dec = TpuServingEngine(_cfg(pool_role="decode"))
+        router = ReplicaRouter(breaker=BreakerSpec(failures=2, open_s=60.0))
+        router.observe([
+            {"replica": "dead-0", "queued": 0, "occupancy": 0, "slots": 2,
+             "pool": "decode"},
+            {"replica": "live-1", "queued": 1, "occupancy": 0, "slots": 2,
+             "pool": "decode"},
+        ])
+
+        async def transport(replica, payload, headers, timeout_s):
+            if replica == "dead-0":
+                raise ConnectionError("connection refused (pod killed)")
+            result = await dec.import_handoff(payload)
+            return 200, result, {}
+
+        chainer = HandoffChainer(
+            pre, router=router, transport=transport,
+            policy=RetryPolicy(attempts=5, backoff_s=0.005,
+                               backoff_cap_s=0.02),
+        )
+        try:
+            ticket = await pre.generate(prompt, {"max-tokens": 10})
+            assert ticket["finish_reason"] == "handoff"
+            result = await chainer.chain(ticket)
+            # byte-identical to the undisturbed combined run
+            assert result["tokens"] == baseline["tokens"]
+            assert result["text"] == baseline["text"]
+            # zero sheds anywhere: the storm was absorbed, not refused
+            assert pre.scheduler.stats().get("shed", 0) in (0, None) or \
+                pre.scheduler.stats()["shed"] == 0
+            assert dec.kv_import_sheds == 0
+            assert pre.stats()["survival"]["deadline_sheds"] == 0
+            # the dead replica tripped its breaker and is excluded from
+            # EVERY subsequent pick
+            assert router.stats()["breakers"]["dead-0"]["state"] == OPEN
+            for _ in range(10):
+                assert router.pick(phase="decode") != "dead-0"
+            # evidence: injected fault + retries in the prefill ring
+            kinds = [e["kind"] for e in pre.flight.recent_events(0)]
+            assert "fault-injected" in kinds
+            assert "handoff-retry" in kinds
+            assert "breaker-open" in kinds
+        finally:
+            await pre.close()
+            await dec.close()
+            TpuServingEngine.reset_instances()
+
+    run_async(main())
+
+
+def test_local_decode_fallback_byte_identical(run_async):
+    """Every decode replica dead: after the cap the chainer imports the
+    payload back into the prefill engine and the request completes
+    LOCALLY, byte-identical — and the slot never re-exports."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    prompt = "local decode fallback prompt"
+
+    async def main():
+        combined = TpuServingEngine(_cfg())
+        baseline = await combined.generate(prompt, {"max-tokens": 10})
+        await combined.close()
+        TpuServingEngine.reset_instances()
+
+        pre = TpuServingEngine(_cfg(pool_role="prefill"))
+        router = ReplicaRouter(breaker=BreakerSpec(failures=1))
+        router.observe([
+            {"replica": "dead-0", "queued": 0, "occupancy": 0, "slots": 2,
+             "pool": "decode"},
+        ])
+
+        async def transport(replica, payload, headers, timeout_s):
+            raise ConnectionError("refused")
+
+        chainer = HandoffChainer(
+            pre, router=router, transport=transport,
+            policy=RetryPolicy(attempts=2, backoff_s=0.005),
+        )
+        try:
+            ticket = await pre.generate(prompt, {"max-tokens": 10})
+            result = await chainer.chain(ticket)
+            assert result["tokens"] == baseline["tokens"]
+            assert result["text"] == baseline["text"]
+            assert result["finish_reason"] == baseline["finish_reason"]
+            assert chainer.fallbacks == 1
+            assert pre.handoff_fallbacks == 1
+            # the local decode is a real import on this engine (timings
+            # carry the marker), and it never re-exported
+            timing = list(pre.request_timings)[-1]
+            assert timing.get("imported") == 1.0
+            assert pre.kv_exports_total == 1  # the original export only
+            kinds = [e["kind"] for e in pre.flight.recent_events(0)]
+            assert "handoff-fallback" in kinds
+        finally:
+            await pre.close()
+            TpuServingEngine.reset_instances()
+
+    run_async(main())
+
+
+def test_chainer_over_real_pod_http_plane(run_async, monkeypatch):
+    """The production transport end to end: the chainer offers the
+    payload over REAL aiohttp to the pod `/kv/import` endpoint — the
+    dead replica is a closed port (genuine connection refused), the live
+    one a real pod server — and the result is byte-identical."""
+    import socket
+
+    from langstream_tpu.runtime.pod import _serve_info
+    from langstream_tpu.serving.engine import TpuServingEngine
+    from langstream_tpu.serving.handoff import http_transport
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    prompt = "real pod http plane chainer prompt"
+
+    async def main():
+        combined = TpuServingEngine(_cfg())
+        baseline = await combined.generate(prompt, {"max-tokens": 8})
+        await combined.close()
+        TpuServingEngine.reset_instances()
+
+        pre = TpuServingEngine.get_or_create(_cfg(pool_role="prefill"))
+        dec = TpuServingEngine.get_or_create(_cfg(pool_role="decode"))
+        live_port = free_port()
+        dead_port = free_port()  # nothing ever listens here
+        monkeypatch.setenv("LS_HTTP_PORT", str(live_port))
+        server = await _serve_info(None)
+        router = ReplicaRouter(breaker=BreakerSpec(failures=2))
+        router.observe([
+            {"replica": "dead-0", "queued": 0, "occupancy": 0, "slots": 2,
+             "pool": "decode"},
+            {"replica": "live-1", "queued": 1, "occupancy": 0, "slots": 2,
+             "pool": "decode"},
+        ])
+        urls = {
+            "dead-0": f"http://127.0.0.1:{dead_port}",
+            "live-1": f"http://127.0.0.1:{live_port}",
+        }
+        chainer = HandoffChainer(
+            pre, router=router,
+            transport=http_transport(lambda replica: urls[replica]),
+            policy=RetryPolicy(attempts=4, backoff_s=0.005,
+                               backoff_cap_s=0.02),
+        )
+        try:
+            ticket = await pre.generate(
+                prompt, {"max-tokens": 8, "deadline-s": 120},
+            )
+            result = await chainer.chain(ticket)
+            assert result["tokens"] == baseline["tokens"]
+            assert result["text"] == baseline["text"]
+            assert chainer.retries >= 1  # the refused port cost one offer
+            assert chainer.fallbacks == 0
+            assert pre.journal is None  # no journal configured: no leak
+            assert pre.stats()["kvtransfer"]["unsettled_handoffs"] == 0
+        finally:
+            server.close()
+            await pre.close()
+            await dec.close()
+            TpuServingEngine.reset_instances()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# journal x handoff: the crash-mid-handoff replay (satellite fix)
+# --------------------------------------------------------------------------
+
+
+def test_crash_mid_handoff_replays_from_prefill_journal(tmp_path):
+    """A handed-off request whose decode side crashed before completion
+    replays from the PREFILL-side journal entry as a fresh request —
+    retire-at-handoff (PR 14) made that loss invisible; settle-at-answer
+    makes it recoverable."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    journal_dir = str(tmp_path / "journal")
+    prompt = "crash mid handoff replay prompt"
+
+    async def handoff_phase():
+        pre = TpuServingEngine(
+            _cfg(pool_role="prefill", journal_dir=journal_dir)
+        )
+        ticket = await pre.generate(prompt, {"max-tokens": 6})
+        assert ticket["finish_reason"] == "handoff"
+        # the CHAINER picked the payload up (settle=False — the pull
+        # model's pod pickup settles at take instead)... and the decode
+        # side died before completing. No settle ever arrives.
+        assert pre.take_export_entry(
+            ticket["handoff"], settle=False
+        ) is not None
+        assert pre.journal.flush(5.0)
+        # the satellite's point: the entry is STILL LIVE after handoff
+        assert pre.journal.depth() == 1
+        assert pre.stats()["kvtransfer"]["unsettled_handoffs"] == 1
+        # the crash: loop dies, no close()
+        if pre._loop_task is not None:
+            pre._loop_task.cancel()
+        TpuServingEngine.reset_instances()
+
+    asyncio.run(handoff_phase())
+
+    async def restart_phase():
+        engine = TpuServingEngine(_cfg(journal_dir=journal_dir))
+        try:
+            baseline = await engine.generate(prompt, {"max-tokens": 6})
+            for _ in range(200):
+                if engine.journal.depth() == 0:
+                    break
+                await asyncio.sleep(0.05)
+            return (
+                baseline, engine.journal.stats(),
+                engine.completed_requests,
+                [e["kind"] for e in engine.flight.recent_events(0)],
+            )
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    baseline, stats, completed, kinds = asyncio.run(restart_phase())
+    # the orphaned handoff replayed as a fresh request and completed
+    assert stats["replayed"] == 1
+    assert stats["live"] == 0
+    assert completed == 2  # the replay + the fresh baseline request
+    assert "journal-replay" in kinds
+
+
+def test_pull_pickup_settles_journal_at_take(tmp_path, run_async):
+    """The PULL model (pod GET /kv/export, no chainer): the pickup is
+    the last event the prefill side ever sees, so the journal entry
+    retires at take — the pre-chainer behavior, so a chainer-less
+    deployment's journal cannot grow one live entry per served
+    handoff."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        pre = TpuServingEngine(_cfg(
+            pool_role="prefill", journal_dir=str(tmp_path / "jpull"),
+        ))
+        try:
+            ticket = await pre.generate("pull me", {"max-tokens": 6})
+            assert pre.journal.flush(5.0)
+            assert pre.journal.depth() == 1
+            assert pre.take_export(ticket["handoff"]) is not None
+            assert pre.journal.flush(5.0)
+            assert pre.journal.depth() == 0
+            assert pre.stats()["kvtransfer"]["unsettled_handoffs"] == 0
+        finally:
+            await pre.close()
+            TpuServingEngine.reset_instances()
+
+    run_async(main())
+
+
+def test_settle_retires_journal_without_restart(tmp_path, run_async):
+    """The happy path: the chainer's settle (completed result) retires
+    the prefill-side entry immediately — no replay on restart."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        pre = TpuServingEngine(_cfg(
+            pool_role="prefill", journal_dir=str(tmp_path / "j2"),
+        ))
+        dec = TpuServingEngine(_cfg(pool_role="decode"))
+        router = ReplicaRouter()
+        router.observe([
+            {"replica": "live", "queued": 0, "occupancy": 0, "slots": 2,
+             "pool": "decode"},
+        ])
+
+        async def transport(replica, payload, headers, timeout_s):
+            return 200, await dec.import_handoff(payload), {}
+
+        chainer = HandoffChainer(pre, router=router, transport=transport)
+        try:
+            ticket = await pre.generate("settle me", {"max-tokens": 6})
+            assert pre.journal.depth() == 1
+            await chainer.chain(ticket)
+            assert pre.journal.flush(5.0)
+            assert pre.journal.depth() == 0
+            assert pre.stats()["kvtransfer"]["unsettled_handoffs"] == 0
+        finally:
+            await pre.close()
+            await dec.close()
+            TpuServingEngine.reset_instances()
+
+    run_async(main())
+
+
+def test_journal_entry_carries_deadline():
+    from langstream_tpu.serving.journal import request_entry
+
+    class _Req:
+        journey_id = "j"
+        prompt_tokens = [1, 2]
+        max_tokens = 4
+        temperature = 0.0
+        top_k = 0
+        top_p = 1.0
+        presence_penalty = 0.0
+        frequency_penalty = 0.0
+        stop = []
+        tenant = ""
+        priority = "default"
+        deadline = 1234.5
+
+    assert request_entry(_Req())["deadline"] == 1234.5
+
+
+# --------------------------------------------------------------------------
+# gateway + agent plumbing
+# --------------------------------------------------------------------------
+
+
+def test_qos_spec_deadline_headers_roundtrip():
+    from langstream_tpu.serving.qos import QosSpec
+
+    spec = QosSpec.from_dict({"deadline-headers": True})
+    assert spec.deadline_headers is True
+    assert QosSpec.from_dict(spec.to_dict()).deadline_headers is True
+    # default off: existing QoS deployments keep deadline-s as the
+    # preemption cost model only
+    assert QosSpec.from_dict({}).deadline_headers is False
+
+
+def test_gateway_stamp_deadline_paths():
+    from langstream_tpu.gateway.server import GatewayServer
+    from langstream_tpu.serving.handoff import DEADLINE_HEADER
+    from langstream_tpu.serving.qos import QosSpec, TenantLimiter
+
+    server = GatewayServer()
+    # 1) client header wins, untouched
+    headers = {DEADLINE_HEADER: "123.5"}
+    server._stamp_deadline(headers, None, {}, "default")
+    assert headers[DEADLINE_HEADER] == "123.5"
+    # 2) param:deadline-s stamps now + budget (no limiter needed)
+    headers = {}
+    t0 = time.time()
+    server._stamp_deadline(headers, None, {"deadline-s": "5"}, "default")
+    stamped = parse_deadline(headers[DEADLINE_HEADER])
+    assert t0 + 4.5 <= stamped <= time.time() + 5.5
+    # malformed param degrades to no deadline
+    headers = {}
+    server._stamp_deadline(headers, None, {"deadline-s": "soon"}, "default")
+    assert DEADLINE_HEADER not in headers
+    # 3) qos opt-in stamps the class default
+    limiter = TenantLimiter(
+        QosSpec.from_dict(
+            {"deadline-headers": True,
+             "classes": {"interactive": {"deadline-s": 7.0}}}
+        )
+    )
+    headers = {}
+    t0 = time.time()
+    server._stamp_deadline(headers, limiter, {}, "interactive")
+    stamped = parse_deadline(headers[DEADLINE_HEADER])
+    assert t0 + 6.5 <= stamped <= time.time() + 7.5
+    # 4) qos WITHOUT the opt-in stamps nothing (the default-config pin)
+    limiter = TenantLimiter(QosSpec.from_dict({}))
+    headers = {}
+    server._stamp_deadline(headers, limiter, {}, "interactive")
+    assert headers == {}
+
+
+def test_ai_agent_forwards_deadline_header():
+    from langstream_tpu.agents.ai import _AIAgentBase
+    from langstream_tpu.api.record import make_record
+
+    agent = object.__new__(_AIAgentBase)
+    agent.configuration = {"max-tokens": 8}
+    record = make_record(
+        value="q", headers={"langstream-deadline": "1234.5",
+                            "langstream-qos-tenant": "acme"},
+    )
+    options = agent._options(record)
+    assert options["deadline"] == "1234.5"
+    assert options["qos-tenant"] == "acme"
+    # no header, no key — the engine sees no deadline at all
+    assert "deadline" not in agent._options(make_record(value="q"))
+
+
+# --------------------------------------------------------------------------
+# default-config pin
+# --------------------------------------------------------------------------
+
+
+def test_default_config_pin_no_new_metrics_or_behavior(run_async):
+    """Engines without deadlines, faults, or split pools keep the
+    existing scrape surface and byte-identical output."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def main():
+        plain = TpuServingEngine(_cfg())
+        try:
+            result = await plain.generate("pin prompt", {"max-tokens": 8})
+            # combined-pool engine: none of the new metric closures exist
+            assert plain._m_handoff_retries is None
+            assert plain._m_handoff_fallbacks is None
+            assert plain._m_deadline_shed is None
+            assert plain._m_breaker_open is None
+            # and nothing cross-replica ever fired
+            survival = plain.stats()["survival"]
+            assert survival["deadline_sheds"] == 0
+            assert survival["deadline_overruns"] == 0
+            assert survival["handoff_retries"] == 0
+            assert survival["handoff_fallbacks"] == 0
+            assert plain.stats()["kvtransfer"]["unsettled_handoffs"] == 0
+            kinds = {e["kind"] for e in plain.flight.recent_events(0)}
+            assert not kinds & {
+                "deadline-exceeded", "deadline-overrun", "handoff-retry",
+                "handoff-fallback", "breaker-open",
+            }
+            return result
+        finally:
+            await plain.close()
+            TpuServingEngine.reset_instances()
+
+    result = run_async(main())
+
+    async def with_far_deadline():
+        engine = TpuServingEngine(_cfg())
+        try:
+            # a generous deadline changes nothing about the output
+            return await engine.generate(
+                "pin prompt", {"max-tokens": 8, "deadline-s": 3600},
+            )
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    deadline_result = run_async(with_far_deadline())
+    assert deadline_result["tokens"] == result["tokens"]
+    assert deadline_result["text"] == result["text"]
+
+
+# --------------------------------------------------------------------------
+# bench phase + perf_diff
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_partition_storm_phase_smoke(run_async):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    from gateway_bench import run_partition_storm_phase
+
+    out = run_async(run_partition_storm_phase(requests=6, max_tokens=6))
+    assert out["submitted"] == 6
+    assert out["zero_silent_loss"] is True
+    assert out["dead_replica_excluded"] is True
+    assert out["partition_storm_breaker_opens"] >= 1
+    assert (
+        out["partition_storm_rehandoffs"] + out["partition_storm_fallbacks"]
+        >= 1
+    )
+    for key in (
+        "partition_storm_shed_rate", "partition_storm_completed_fraction",
+        "partition_storm_fallbacks", "partition_storm_ttft_p99_s",
+    ):
+        assert key in out
+
+
+def test_perf_diff_partition_directions_and_extraction():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import perf_diff
+
+    for key, direction in (
+        ("partition_storm_shed_rate", "up"),
+        ("partition_storm_completed_fraction", "down"),
+        ("partition_storm_fallbacks", "up"),
+        ("partition_storm_ttft_p99_s", "up"),
+    ):
+        assert perf_diff.METRICS[key] == direction
+    payload = {
+        "detail": {
+            "partition_storm": {
+                "partition_storm_shed_rate": 0.0,
+                "partition_storm_completed_fraction": 1.0,
+                "partition_storm_fallbacks": 3,
+                "partition_storm_ttft_p99_s": 0.42,
+            }
+        }
+    }
+    metrics = perf_diff.extract_metrics(payload)["metrics"]
+    assert metrics["partition_storm_fallbacks"] == 3.0
+    assert metrics["partition_storm_ttft_p99_s"] == 0.42
+
+
+# --------------------------------------------------------------------------
+# engine_top: panel + retry-storm / flapping analyze flags
+# --------------------------------------------------------------------------
+
+
+def _engine_top():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import engine_top
+
+    return engine_top
+
+
+def test_engine_top_renders_xreplica_panel():
+    engine_top = _engine_top()
+    entry = {
+        "model": "tiny", "slots": 2,
+        "summary": {"window": {}, "totals": {}},
+        "survival": {
+            "shrinks": 0, "restores": 0, "shrink_preempted": 0,
+            "deadline_sheds": 2, "deadline_overruns": 1,
+            "handoff_retries": 4, "handoff_fallbacks": 1,
+            "faults": [{"site": "http-import"}],
+        },
+        "events": [
+            {"kind": "breaker-open", "replica": "dec-0",
+             "open_replicas": 1, "t_ms": 1, "m_s": 1.0},
+        ],
+        "samples": [],
+    }
+    lines = engine_top._render_survival(
+        entry["survival"], entry["events"]
+    )
+    text = "\n".join(lines)
+    assert "deadline sheds 2" in text
+    assert "re-handoffs 4" in text
+    assert "local fallbacks 1" in text
+    assert "breakers open 1" in text
+
+
+def test_engine_top_analyze_flags_retry_storm_and_flapping():
+    engine_top = _engine_top()
+    events = [
+        {"kind": "handoff-retry", "request": "r-1", "t_ms": i, "m_s": i}
+        for i in range(3)
+    ] + [
+        {"kind": "breaker-open", "replica": "dec-0", "t_ms": 10 + i,
+         "m_s": 10.0 + i, "open_replicas": 1}
+        for i in range(3)
+    ]
+    dump = [{
+        "model": "tiny", "slots": 2,
+        "summary": {"window": {}, "totals": {
+            "device_ms": 10.0, "host_ms": 1.0, "stall_ms": 0.0,
+            "wall_ms": 11.0, "steps": 4,
+        }},
+        "events": events, "samples": [],
+    }]
+    report = engine_top.analyze(dump)
+    assert "retry storm" in report
+    assert "flapping" in report

@@ -35,7 +35,10 @@ exhaustion, unbounded queue growth, pipeline overlap collapse
 wedged-device flag (no step progress while work is queued — the r03
 hang shape, read from the dump's ``health`` section), SLO objectives in
 fast burn, — for saved autoscaler payloads — scale thrash (≥3
-direction changes inside one cooldown window), and — for stitched
+direction changes inside one cooldown window), handoff retry storms
+(one request re-offered ≥3 times) and breaker flapping (one replica's
+breaker opening ≥3 times in the event window — docs/RESILIENCE.md
+"Distributed failure domain"), and — for stitched
 request-journey payloads (``/api/applications/{t}/{n}/journey/{id}``,
 tools/journey.py) — per-segment TTFT totals with a transfer-dominated
 flag when the handoff cost exceeds prefill at p50 (disaggregation
@@ -388,6 +391,33 @@ def _render_survival(survival: dict | None, events: list[dict]) -> list[str]:
             f"{last.get('preempted')}  -> budget "
             f"{last.get('budget_blocks')}/{last.get('configured_blocks')}"
         )
+    # cross-replica failure domain (docs/RESILIENCE.md "Distributed
+    # failure domain"): deadline refusals/overruns and the handoff
+    # chainer's re-offer/fallback ledger — rendered only once any of it
+    # has happened, so a quiet engine's panel is unchanged
+    deadline_sheds = survival.get("deadline_sheds") or 0
+    overruns = survival.get("deadline_overruns") or 0
+    retries = survival.get("handoff_retries") or 0
+    fallbacks = survival.get("handoff_fallbacks") or 0
+    if deadline_sheds or overruns or retries or fallbacks:
+        line = (
+            f"xreplica deadline sheds {deadline_sheds}  "
+            f"overruns {overruns}  re-handoffs {retries}  "
+            f"local fallbacks {fallbacks}"
+        )
+        breaker = next(
+            (
+                e for e in reversed(events)
+                if e.get("kind") in ("breaker-open", "breaker-close")
+            ),
+            None,
+        )
+        if breaker is not None:
+            line += (
+                f"  breakers open {breaker.get('open_replicas', 0)}"
+                f" (last {breaker.get('kind')}: {breaker.get('replica')})"
+            )
+        lines.append(line)
     return lines
 
 
@@ -902,6 +932,44 @@ def _anomalies(entry: dict) -> list[str]:
                     f"or scale out), not transient"
                 )
                 break
+    # retry storm (docs/RESILIENCE.md "Distributed failure domain"):
+    # one request re-offered >=3 times means the decode pool is not
+    # taking handoffs (dead/held/refusing replicas) and the chainer is
+    # burning its cap per request — the fleet is partitioned or
+    # under-provisioned, and local fallbacks are about to eat the
+    # prefill pool's decode capacity
+    retry_by_request: dict = {}
+    for e in events:
+        if e.get("kind") == "handoff-retry":
+            key = e.get("request") or "?"
+            retry_by_request[key] = retry_by_request.get(key, 0) + 1
+    stormy = {k: n for k, n in retry_by_request.items() if n >= 3}
+    if stormy:
+        worst = max(stormy.items(), key=lambda kv: kv[1])
+        flags.append(
+            f"handoff retry storm: {len(stormy)} request(s) re-offered "
+            f">=3 times (worst {worst[0]}: {worst[1]} re-offers) — the "
+            f"decode pool is refusing/dead; check breaker states and "
+            f"pool capacity before local fallbacks saturate prefill"
+        )
+    # breaker flapping: >=3 opens of ONE replica in the event tail means
+    # the half-open probes keep succeeding into a replica that keeps
+    # failing — the failure is load-shaped (saturation), not death, and
+    # the fix is capacity/holds, not exclusion
+    opens_by_replica: dict = {}
+    for e in events:
+        if e.get("kind") == "breaker-open":
+            key = e.get("replica") or "?"
+            opens_by_replica[key] = opens_by_replica.get(key, 0) + 1
+    flapping = {k: n for k, n in opens_by_replica.items() if n >= 3}
+    if flapping:
+        worst = max(flapping.items(), key=lambda kv: kv[1])
+        flags.append(
+            f"breaker flapping: replica {worst[0]} opened {worst[1]}x in "
+            f"the event window — half-open probes keep re-admitting a "
+            f"replica that keeps failing; the failure is load-shaped "
+            f"(use Retry-After holds / scale the pool), not a dead pod"
+        )
     survival = entry.get("survival")
     if isinstance(survival, dict) and survival.get("withheld_blocks"):
         flags.append(

@@ -710,6 +710,171 @@ async def run_oom_storm_phase(
     }
 
 
+async def run_partition_storm_phase(
+    *,
+    serving: dict[str, Any] | None = None,
+    requests: int = 16,
+    max_tokens: int = 10,
+    drop_after: int = 2,
+    drop_count: int = 3,
+) -> dict[str, Any]:
+    """Cross-replica failure phase (docs/RESILIENCE.md "Distributed
+    failure domain"): a prefill pool hands every request off through the
+    :class:`~langstream_tpu.serving.handoff.HandoffChainer` to a
+    two-replica decode pool where one replica is DEAD (every offer
+    refuses the connection) and the network additionally drops a burst
+    of offers mid-phase (``http-import`` fault site). Records what the
+    resilience plane *did* about it — re-handoffs, breaker opens,
+    local-decode fallbacks, deadline sheds — and the completed-vs-
+    submitted ledger. The acceptance this phase instruments: zero silent
+    loss and a breaker that keeps the dead replica out of the rotation;
+    ``perf_diff`` declares the worse-directions so a regression that
+    starts shedding (or falling back) under partition is flagged."""
+    from langstream_tpu.gateway.router import ReplicaRouter
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+    from langstream_tpu.serving.handoff import (
+        BreakerSpec,
+        DeadlineExceeded,
+        HandoffChainer,
+        RetryPolicy,
+    )
+    from langstream_tpu.serving.qos import RateLimited
+
+    serving = dict(serving or {})
+    serving.setdefault("model", "tiny")
+    serving.setdefault("slots", 4)
+    serving.setdefault("max-seq-len", 256)
+    serving.setdefault("decode-chunk", 4)
+    serving.setdefault("model-dtype", "float32")
+    serving.setdefault("kv-layout", "paged")
+    serving.setdefault("kv-block-size", 16)
+    serving.setdefault("prefix-cache", False)
+    pre_cfg = ServingConfig.from_dict(
+        {**serving, "pool-role": "prefill",
+         # the mid-phase network partition: a burst of offers to the
+         # LIVE replica drops too, so the chainer's backoff + re-route
+         # discipline is exercised beyond the always-dead pod
+         "faults": [{"site": "http-import", "shape": "drop",
+                     "after": drop_after, "count": drop_count}]}
+    )
+    dec_cfg = ServingConfig.from_dict({**serving, "pool-role": "decode"})
+    pre = TpuServingEngine(pre_cfg)
+    dec = TpuServingEngine(dec_cfg)
+    # open_s is SHORT so the live replica (whose offers the injected
+    # drop burst also hits) rehabilitates through a half-open probe
+    # mid-phase; the dead replica's probes keep failing, so it stays out
+    # fresh_s: the phase observes once up front, and the first
+    # generate pays the XLA compile — on a cold cache that alone
+    # outlives the 15 s default, after which every pick would return
+    # None and the whole phase would silently degenerate to local
+    # fallbacks (the same guard the gateway phase's router carries)
+    router = ReplicaRouter(
+        fresh_s=3600.0, breaker=BreakerSpec(failures=2, open_s=0.25)
+    )
+    router.observe([
+        {"replica": "pool-decode-0", "queued": 0, "occupancy": 0,
+         "slots": serving["slots"], "pool": "decode"},
+        {"replica": "pool-decode-1", "queued": 0, "occupancy": 0,
+         "slots": serving["slots"], "pool": "decode"},
+    ])
+
+    async def transport(replica, payload, headers, timeout_s):
+        if replica == "pool-decode-0":
+            # the killed decode pod: connect refused, forever
+            raise ConnectionError("connection refused (pod killed)")
+        try:
+            result = await dec.import_handoff(payload)
+        except RateLimited as e:
+            # the Transport contract (serving/handoff.py): sheds arrive
+            # as HTTP answers, exactly as the pod handler maps them
+            return 503, {"error": str(e), "retry_after_s": e.retry_after}, {}
+        except DeadlineExceeded as e:
+            return 504, {"error": str(e)}, {}
+        return 200, result, {}
+
+    chainer = HandoffChainer(
+        pre, router=router, transport=transport,
+        policy=RetryPolicy(attempts=4, backoff_s=0.01, backoff_cap_s=0.1),
+    )
+    t_start = time.monotonic()
+    # bound in-flight handoffs to the pool's slot count: a local-decode
+    # fallback needs a free slot, and an unbounded flood would convert
+    # capacity waits into 503 sheds (imports shed rather than queue —
+    # docs/DISAGG.md), which is not what this phase measures
+    gate = asyncio.Semaphore(int(serving["slots"]))
+
+    async def one(i: int) -> dict[str, Any]:
+      async with gate:
+        t0 = time.monotonic()
+        ticket = await pre.generate(
+            f"partition storm request {i} reporting in",
+            {"max-tokens": max_tokens, "temperature": 0},
+        )
+        result = await chainer.chain(ticket)
+        return {
+            "wall_s": time.monotonic() - t0,
+            "ttft_s": ticket.get("ttft", 0.0),
+            "tokens": len(result.get("tokens") or ()),
+        }
+
+    results = await asyncio.gather(
+        *(one(i) for i in range(requests)), return_exceptions=True
+    )
+    completed = [r for r in results if isinstance(r, dict)]
+    shed = sum(
+        1 for r in results if isinstance(r, (RateLimited, DeadlineExceeded))
+    )
+    other_failures = len(results) - len(completed) - shed
+    ttfts = sorted(r["ttft_s"] for r in completed)
+    walls = sorted(r["wall_s"] for r in completed)
+    events = pre.flight.recent_events(0)
+    survival = pre.stats()["survival"]
+    rstats = router.stats()
+    # the exclusion verdict reads the breaker STATE, not a post-phase
+    # pick race: with open_s tuned short for mid-phase rehabilitation, a
+    # pick can legitimately hand the dead replica a half-open PROBE —
+    # what must never happen is its breaker closing (a probe succeeding)
+    dead_state = rstats["breakers"].get("pool-decode-0", {}).get("state")
+    await pre.close()
+    await dec.close()
+    TpuServingEngine.reset_instances()
+
+    def pct(values, q):
+        v = _pct(values, q)
+        return round(v, 4) if v is not None else None
+
+    return {
+        "submitted": requests,
+        "completed": len(completed),
+        "shed": shed,
+        "other_failures": other_failures,
+        "partition_storm_completed_fraction": round(
+            len(completed) / requests, 4
+        ),
+        "partition_storm_shed_rate": round(shed / requests, 4),
+        "zero_silent_loss": (len(completed) + shed) == requests,
+        # what the resilience plane did (the re-offer ledger)
+        "partition_storm_rehandoffs": chainer.retries,
+        "partition_storm_fallbacks": chainer.fallbacks,
+        "partition_storm_breaker_opens": sum(
+            b["opens"] for b in rstats["breakers"].values()
+        ),
+        "partition_storm_deadline_sheds": survival["deadline_sheds"],
+        "breaker_open_replicas": rstats["breaker_open_replicas"],
+        "dead_replica_excluded": dead_state in ("open", "half-open"),
+        "faults_injected": sum(
+            1 for e in events if e["kind"] == "fault-injected"
+        ),
+        "handoff_retry_events": sum(
+            1 for e in events if e["kind"] == "handoff-retry"
+        ),
+        "partition_storm_ttft_p50_s": pct(ttfts, 0.50),
+        "partition_storm_ttft_p99_s": pct(ttfts, 0.99),
+        "partition_storm_wall_p99_s": pct(walls, 0.99),
+        "wall_s": round(time.monotonic() - t_start, 3),
+    }
+
+
 if __name__ == "__main__":
     import os
     import sys

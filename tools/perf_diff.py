@@ -90,6 +90,14 @@ METRICS: dict[str, str] = {
     "oom_storm_shrinks": "up",
     "oom_storm_ttft_p50_s": "up",
     "oom_storm_ttft_p99_s": "up",
+    # cross-replica failure storm (gateway_bench run_partition_storm_
+    # phase): more sheds / fewer completions / more local-decode
+    # fallbacks / slower TTFT under a dead decode replica is the
+    # resilience plane regressing
+    "partition_storm_shed_rate": "up",
+    "partition_storm_completed_fraction": "down",
+    "partition_storm_fallbacks": "up",
+    "partition_storm_ttft_p99_s": "up",
 }
 
 #: default noise band: relative change below this is never flagged
@@ -202,6 +210,16 @@ def extract_metrics(payload) -> dict:
         # device-survival storm (gateway_bench run_oom_storm_phase):
         # shed/completion/shrink posture under an injected OOM burst
         storm = detail.get("oom_storm")
+        partition = detail.get("partition_storm")
+        if isinstance(partition, dict):
+            for key in (
+                "partition_storm_shed_rate",
+                "partition_storm_completed_fraction",
+                "partition_storm_fallbacks",
+                "partition_storm_ttft_p99_s",
+            ):
+                if partition.get(key) is not None:
+                    metrics[key] = float(partition[key])
         if isinstance(storm, dict):
             for key in (
                 "oom_storm_shed_rate", "oom_storm_completed_fraction",
