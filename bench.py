@@ -379,6 +379,53 @@ def _record(headline: dict, detail: dict) -> dict:
     }
 
 
+def _analyzer_stats() -> dict:
+    """graftcheck self-stats for the record (stdlib-only, safe in the
+    no-JAX parent): the tier-1 gate pays the analyzer's wall time on
+    every run, so its cost and escape-hatch counts are a perf surface
+    perf_diff should watch like any other."""
+    try:
+        from langstream_tpu.analysis import (
+            ALL_RULES,
+            PROJECT_RULES,
+            PROJECT_RULES_BY_ID,
+            RULES_BY_ID,
+            iter_py_files,
+            load_baseline,
+        )
+        from langstream_tpu.analysis import run as run_analysis
+        from langstream_tpu.analysis.core import (
+            PACKAGE_ROOT,
+            Module,
+            parse_suppressions,
+        )
+
+        report = run_analysis(ALL_RULES, project_rules=PROJECT_RULES)
+        families: dict[str, int] = {}
+        for f in report.new + report.baselined:
+            rule = RULES_BY_ID.get(f.rule) or PROJECT_RULES_BY_ID.get(f.rule)
+            fam = rule.family if rule is not None else "framework"
+            families[fam] = families.get(fam, 0) + 1
+        suppressions = 0
+        for path in iter_py_files(PACKAGE_ROOT):
+            try:
+                by_line, _ = parse_suppressions(
+                    Module(path.as_posix(), path.read_text())
+                )
+            except (OSError, SyntaxError, UnicodeDecodeError):
+                continue
+            suppressions += len(by_line)
+        return {
+            "analyzer_wall_s": round(report.analysis_seconds, 3),
+            "violations": len(report.new),
+            "findings_by_family": dict(sorted(families.items())),
+            "suppressions": suppressions,
+            "baseline_entries": len(load_baseline()),
+        }
+    except Exception as e:  # the bench record never dies to its own meta
+        return {"error": str(e)[:200]}
+
+
 def run_bench() -> dict:
     """Parent orchestration: probe, then one child per phase, re-emitting
     the record as each lands. No JAX in this process — ever."""
@@ -390,6 +437,8 @@ def run_bench() -> dict:
         "isolation": "fresh child process per phase",
         **({"degraded": "cpu"} if DEGRADED else {}),
     }
+    if _remaining() > 180:
+        detail["analyzer"] = _analyzer_stats()
     headline: dict = {"tok_s": 0.0}
 
     probe = _probe_device()

@@ -1,6 +1,6 @@
 """graftcheck: first-party static analysis for the langstream-tpu tree.
 
-Twelve rule families tuned to this codebase's actual failure modes:
+Rule families tuned to this codebase's actual failure modes:
 
 ==========  ==============================================================
 JAX101-104  JAX hazards: host syncs inside traced code / the decode hot
@@ -45,14 +45,24 @@ NET1201     network discipline: a blocking HTTP/socket call on a
             serving/gateway/k8s-compute path without an explicit
             timeout argument (a dead peer parks the thread forever;
             the deadline plane cannot bound what never returns)
+SPMD1301-3  lockstep SPMD divergence over the execution-context layer:
+            host-local branches ahead of a jitted dispatch on the
+            replay path, host-local jit cache keys, and engine hot-path
+            dispatches with no lockstep broadcast in the method tree
+HOT1401/2   hot-path host syncs with device-taint evidence: blocking
+            materialization (np.asarray / .item() / float() / .tolist())
+            and implicit __bool__ on a device value inside the hot-loop
+            context, outside the sanctioned fetch stages
 ==========  ==============================================================
 
-RACE/INV/FLOW are **project rules**: they run over a whole-program index
+RACE/INV/FLOW/SPMD/HOT are **project rules**: they run over a
+whole-program index
 (``analysis/project.py`` — symbol table, call graph, thread roles,
-per-class attribute access sets) instead of one file at a time; FLOW
-additionally builds per-function CFGs, reaching definitions, and taint
-(``analysis/dataflow.py``). GC001 flags suppressions that no longer
-silence anything, so escapes can't rot.
+per-class attribute access sets, execution contexts) instead of one
+file at a time; FLOW/SPMD/HOT additionally build per-function CFGs,
+reaching definitions, and taint (``analysis/dataflow.py``). GC001 flags
+suppressions that no longer silence anything, and GC002 flags
+suppressions naming a rule id that does not exist, so escapes can't rot.
 
 Run it: ``python -m langstream_tpu.analysis`` (or ``tools/graftcheck.py``),
 ``--changed`` for files differing from HEAD (plus their call-graph
@@ -84,6 +94,7 @@ from langstream_tpu.analysis.rules_exceptions import RULES as _EXC_RULES
 from langstream_tpu.analysis.rules_fleet import RULES as _FLEET_RULES
 from langstream_tpu.analysis.rules_flt import RULES as _FLT_RULES
 from langstream_tpu.analysis.rules_flow import RULES as _FLOW_RULES
+from langstream_tpu.analysis.rules_hot import RULES as _HOT_RULES
 from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_net import RULES as _NET_RULES
@@ -94,6 +105,7 @@ from langstream_tpu.analysis.rules_pool import RULES as _POOL_RULES
 from langstream_tpu.analysis.rules_qos import RULES as _QOS_RULES
 from langstream_tpu.analysis.rules_race import RULES as _RACE_RULES
 from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
+from langstream_tpu.analysis.rules_spmd import RULES as _SPMD_RULES
 
 ALL_RULES: list[Rule] = [
     *_JAX_RULES,
@@ -115,6 +127,8 @@ PROJECT_RULES: list[ProjectRule] = [
     *_RACE_RULES,
     *_INV_RULES,
     *_FLOW_RULES,
+    *_SPMD_RULES,
+    *_HOT_RULES,
 ]
 
 RULES_BY_ID: dict[str, Rule] = {r.id: r for r in ALL_RULES}

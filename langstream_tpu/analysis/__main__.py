@@ -20,6 +20,8 @@ Modes:
 - ``--no-baseline`` — report baselined findings too (audit mode);
 - ``--jobs N`` — per-file scanning on N threads (default
   ``min(4, cpus)``; the project index stays a single build);
+- ``--profile`` — per-rule and per-layer wall timings (sequential,
+  cache-bypassing pass: a diagnosis mode, not the gate path);
 - ``--format text|json|sarif`` — machine-readable output for CI
   annotation (SARIF 2.1.0).
 
@@ -123,6 +125,9 @@ def explain_rule(rule_id: str) -> int:
         "GC000": "suppression without a reason",
         "GC001": "stale suppression: a disable= comment that no longer "
         "silences anything",
+        "GC002": "unknown rule id in a suppression: the disable= comment "
+        "names a rule that is not registered (typo or deleted rule), so "
+        "it silences nothing while looking like an audited escape",
     }
     if rule is None and rule_id not in framework:
         known = sorted(
@@ -167,7 +172,7 @@ def _as_json(report: Report, stale: list) -> dict:
             "symbol": f.symbol, "message": f.message,
         }
 
-    return {
+    out = {
         "violations": [enc(f) for f in report.new],
         "baselined": [enc(f) for f in report.baselined],
         "stale_baseline": [
@@ -177,6 +182,9 @@ def _as_json(report: Report, stale: list) -> dict:
         "parse_errors": list(report.parse_errors),
         "analysis_seconds": round(report.analysis_seconds, 4),
     }
+    if report.profile is not None:
+        out["profile"] = report.profile
+    return out
 
 
 def _as_sarif(report: Report, stale: list) -> dict:
@@ -195,6 +203,8 @@ def _as_sarif(report: Report, stale: list) -> dict:
          "shortDescription": {"text": "suppression without a reason"}},
         {"id": "GC001",
          "shortDescription": {"text": "stale suppression"}},
+        {"id": "GC002",
+         "shortDescription": {"text": "unknown rule id in a suppression"}},
     ]
     results = [
         {
@@ -289,6 +299,11 @@ def main(argv: list[str] | None = None) -> int:
         "--format", choices=("text", "json", "sarif"), default="text",
         help="output format (json/sarif are CI-annotation friendly)",
     )
+    parser.add_argument(
+        "--profile", action="store_true",
+        help="time each rule and analysis layer (forces a sequential, "
+        "cache-bypassing pass — slower than a plain run)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -332,6 +347,7 @@ def main(argv: list[str] | None = None) -> int:
         ALL_RULES, files=files, baseline=baseline,
         project_rules=PROJECT_RULES, project_index=project_index,
         jobs=args.jobs if args.jobs is not None else _default_jobs(),
+        profile=args.profile,
     )
 
     # a subset scan (--changed / explicit paths) can't see findings in the
@@ -368,6 +384,13 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(stale)} stale baseline entr(ies) in {scanned} "
             f"[{report.analysis_seconds:.2f}s]"
         )
+        if report.profile is not None:
+            print("\nprofile: layers")
+            for name, secs in report.profile["layers"].items():
+                print(f"  {name:<14} {secs:8.3f}s")
+            print("profile: rules (slowest first)")
+            for rule_id, secs in report.profile["rules"].items():
+                print(f"  {rule_id:<14} {secs:8.3f}s")
     if report.parse_errors:
         return 2
     return 0 if not report.new and not stale else 1

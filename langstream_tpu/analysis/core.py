@@ -197,18 +197,26 @@ def _apply_suppressions(
     return kept
 
 
+#: framework ids a suppression may always name (they are emitted by the
+#: driver itself, not by a registered rule, so GC002 must not flag them)
+FRAMEWORK_RULE_IDS = frozenset({"GC000", "GC001", "GC002"})
+
+
 def stale_suppression_findings(
     path: str,
     by_line: dict[int, set[str]],
     used: set[tuple[int, str]],
     known_rules: set[str],
+    known_complete: bool = False,
 ) -> list[Finding]:
     """GC001: a suppression that silences nothing is rot — the code was
     fixed (or the comment drifted) and the dead suppression would mask a
     future regression on that line. Rule ids outside ``known_rules`` are
-    skipped rather than flagged: a per-file scan cannot evaluate a
-    project-rule suppression (and a typo'd id is self-correcting — it
-    suppresses nothing, so the real finding still fails the gate)."""
+    skipped rather than flagged on a partial scan: a per-file scan cannot
+    evaluate a project-rule suppression. When ``known_complete`` is True
+    (a full run with every rule family loaded) an unknown id is GC002 —
+    it can only be a typo or a rule that was deleted, and either way the
+    comment silences nothing while *looking* like an audited escape."""
     problems: list[Finding] = []
     for line, rules in sorted(by_line.items()):
         for rule in sorted(rules):
@@ -222,6 +230,14 @@ def stale_suppression_findings(
                     ))
                 continue
             if rule not in known_rules:
+                if known_complete and rule not in FRAMEWORK_RULE_IDS:
+                    problems.append(Finding(
+                        rule="GC002", path=path, line=line,
+                        symbol="<suppression>",
+                        message=f"unknown rule id in suppression: {rule} "
+                        f"is not a registered rule — fix the typo or "
+                        f"remove the disable comment",
+                    ))
                 continue
             if (line, rule) not in used:
                 problems.append(Finding(
@@ -281,6 +297,9 @@ class Report:
     stale_baseline: list[BaselineEntry]  # entries matching nothing (fail)
     parse_errors: list[str]
     analysis_seconds: float = 0.0  # wall time of the whole analysis pass
+    #: only set by ``run(..., profile=True)``: {"layers": {stage: s},
+    #: "rules": {rule id: s}} — per-rule seconds summed across files
+    profile: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -337,20 +356,34 @@ _FILE_RESULT_CACHE_CAP = 4096
 
 
 def _check_file(
-    rel: str, source: str, rules: list[Rule], rules_key: str
+    rel: str,
+    source: str,
+    rules: list[Rule],
+    rules_key: str,
+    rule_timings: dict[str, float] | None = None,
 ) -> tuple[list[Finding], dict[int, set[str]], list[Finding]]:
     """Raw (pre-suppression) findings + suppression map + GC000 problems
     for one file, content-hash cached. Raises SyntaxError on bad source
-    (never cached)."""
+    (never cached). ``rule_timings`` (the ``--profile`` path) bypasses
+    the cache — a cache hit would attribute zero seconds to every rule —
+    and accumulates per-rule wall seconds into the given dict."""
     digest = hashlib.sha256(source.encode()).hexdigest()
-    cached = _FILE_RESULT_CACHE.get((rel, rules_key))
-    if cached is not None and cached[0] == digest:
-        return cached[1], cached[2], cached[3]
+    if rule_timings is None:
+        cached = _FILE_RESULT_CACHE.get((rel, rules_key))
+        if cached is not None and cached[0] == digest:
+            return cached[1], cached[2], cached[3]
     mod = Module(rel, source)
     suppressions, problems = parse_suppressions(mod)
     raw: list[Finding] = []
     for rule in rules:
-        raw.extend(rule.check(mod))
+        if rule_timings is None:
+            raw.extend(rule.check(mod))
+        else:
+            t = time.perf_counter()
+            raw.extend(rule.check(mod))
+            rule_timings[rule.id] = (
+                rule_timings.get(rule.id, 0.0) + time.perf_counter() - t
+            )
     if len(_FILE_RESULT_CACHE) >= _FILE_RESULT_CACHE_CAP:
         _FILE_RESULT_CACHE.clear()
     _FILE_RESULT_CACHE[(rel, rules_key)] = (
@@ -368,6 +401,7 @@ def run(
     project_files: Iterable[Path] | None = None,
     project_index=None,
     jobs: int | None = None,
+    profile: bool = False,
 ) -> Report:
     """The driver: per-file rules over ``files``, then project rules over
     the whole-program index, then stale-suppression (GC001) and baseline
@@ -389,8 +423,16 @@ def run(
     release work to C). The project index stays a single build and the
     report stays byte-identical to a sequential run — results are folded
     back in input order.
+
+    ``profile`` fills :attr:`Report.profile` with per-layer and per-rule
+    wall seconds. It forces a sequential, cache-bypassing per-file pass
+    (a thread pool would interleave rule timings; a cache hit would
+    attribute zero cost), so a profiled run is slower than a plain one —
+    it is a diagnosis mode, not the gate path.
     """
     t0 = time.perf_counter()
+    rule_timings: dict[str, float] | None = {} if profile else None
+    layer_timings: dict[str, float] = {}
     rules = list(rules)
     project_rules = list(project_rules or ())
     explicit_root = repo_root is not None
@@ -410,6 +452,7 @@ def run(
     rules_key = ",".join(r.id for r in rules)
 
     # read sources sequentially (cheap, keeps error attribution simple)
+    t_read = time.perf_counter()
     sources: list[tuple[str, str]] = []
     for file_path in files:
         file_path = Path(file_path)
@@ -418,21 +461,26 @@ def run(
             sources.append((rel, file_path.read_text()))
         except (OSError, UnicodeDecodeError) as e:
             parse_errors.append(f"{rel}: unreadable: {e}")
+    layer_timings["read"] = time.perf_counter() - t_read
 
     def _checked(item: tuple[str, str]):
         rel, source = item
         try:
-            return rel, source, _check_file(rel, source, rules, rules_key)
+            return rel, source, _check_file(
+                rel, source, rules, rules_key, rule_timings
+            )
         except SyntaxError as e:
             return rel, source, e
 
-    if jobs and jobs > 1 and len(sources) > 1:
+    t_per_file = time.perf_counter()
+    if not profile and jobs and jobs > 1 and len(sources) > 1:
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=jobs) as pool:
             results = list(pool.map(_checked, sources))
     else:
-        results = map(_checked, sources)
+        results = list(map(_checked, sources))
+    layer_timings["per_file"] = time.perf_counter() - t_per_file
 
     for rel, source, outcome in results:
         if isinstance(outcome, SyntaxError):
@@ -445,6 +493,7 @@ def run(
         findings.extend(problems)
         findings.extend(_apply_suppressions(raw, suppressions, used))
 
+    t_index = time.perf_counter()
     if project_rules and project_index is not None:
         index = project_index
     elif project_rules:
@@ -479,10 +528,19 @@ def run(
         # ProjectIndex.build skips unparseable sources itself (scanned
         # files' syntax errors were already reported above)
         index = ProjectIndex.build(sorted(index_sources.items()))
+    layer_timings["index_build"] = time.perf_counter() - t_index
 
+    t_project = time.perf_counter()
     if project_rules:
         for rule in project_rules:
-            for finding in rule.check(index):
+            t_rule = time.perf_counter()
+            rule_findings = list(rule.check(index))
+            if rule_timings is not None:
+                rule_timings[rule.id] = (
+                    rule_timings.get(rule.id, 0.0)
+                    + time.perf_counter() - t_rule
+                )
+            for finding in rule_findings:
                 suppressions = suppression_maps.get(finding.path)
                 if suppressions is not None:
                     line = _suppression_line_for(finding, suppressions)
@@ -497,12 +555,17 @@ def run(
                         continue
                 if finding.path in scanned:
                     findings.append(finding)
+    layer_timings["project_rules"] = time.perf_counter() - t_project
 
+    # a run with project rules loaded carries the full rule registry, so
+    # an id outside it is a typo'd / deleted rule (GC002), not a rule
+    # family this entry point merely can't see
     known_ids = {r.id for r in rules} | {r.id for r in project_rules}
     for rel in scanned:
         findings.extend(
             stale_suppression_findings(
-                rel, suppression_maps[rel], used_suppressions[rel], known_ids
+                rel, suppression_maps[rel], used_suppressions[rel],
+                known_ids, known_complete=bool(project_rules),
             )
         )
 
@@ -521,12 +584,28 @@ def run(
         else:
             new.append(finding)
     stale = [e for e in baseline if e.key() not in matched]
+    elapsed = time.perf_counter() - t0
+    profile_data = None
+    if profile:
+        layer_timings["total"] = elapsed
+        profile_data = {
+            "layers": {k: round(v, 6) for k, v in layer_timings.items()},
+            "rules": {
+                k: round(v, 6)
+                for k, v in sorted(
+                    (rule_timings or {}).items(),
+                    key=lambda kv: kv[1],
+                    reverse=True,
+                )
+            },
+        }
     return Report(
         new=new,
         baselined=baselined,
         stale_baseline=stale,
         parse_errors=parse_errors,
-        analysis_seconds=time.perf_counter() - t0,
+        analysis_seconds=elapsed,
+        profile=profile_data,
     )
 
 

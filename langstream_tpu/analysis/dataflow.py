@@ -482,6 +482,14 @@ class TaintSpec:
       source *by itself* (independent of operand taint);
     - :meth:`is_sanctioner` — True when a call's *value* is clean no
       matter what its arguments carry (the bucketing functions);
+    - :meth:`launders_attr` — True when an attribute *read* is clean no
+      matter what its base carries (static metadata like ``x.shape`` on
+      a device array, which never forces a transfer);
+    - :meth:`call_propagates_args` — False when a call's result should
+      NOT union its arguments' labels: only the callee expression and
+      explicit sources/summaries count. Specs tracking a *residency*
+      property want this (``Foo(device_array)`` is not itself a device
+      array), specs tracking *data provenance* keep the default.
     """
 
     def source_label(self, expr: ast.AST) -> str | None:
@@ -489,6 +497,12 @@ class TaintSpec:
 
     def is_sanctioner(self, call: ast.Call) -> bool:
         return False
+
+    def launders_attr(self, attr: ast.Attribute) -> bool:
+        return False
+
+    def call_propagates_args(self, call: ast.Call) -> bool:
+        return True
 
 
 @dataclasses.dataclass
@@ -519,6 +533,15 @@ def _expr_taint(
         return frozenset()
     if isinstance(expr, ast.Call) and spec.is_sanctioner(expr):
         return frozenset()
+    if isinstance(expr, ast.Attribute) and spec.launders_attr(expr):
+        return frozenset()
+    if isinstance(expr, ast.Call) and not spec.call_propagates_args(expr):
+        out = set()
+        label = spec.source_label(expr)
+        if label is not None:
+            out.add(label)
+        out |= _expr_taint(expr.func, env, spec)
+        return frozenset(out)
     out: set[str] = set()
     label = spec.source_label(expr)
     if label is not None:

@@ -610,3 +610,212 @@ def offer_handoff(url: str, payload: bytes, deadline: float | None) -> bytes:
         "stranded-handoff failure class docs/RESILIENCE.md refuses."
     ),
 ))
+
+_register(RuleExample(
+    rule="SPMD1301",
+    tp={
+        "langstream_tpu/serving/lockstep.py": '''\
+import time
+
+class LockstepFollower:
+    def run(self, engine, steps):
+        for step in steps:
+            # host-local clock read decides control flow AHEAD of the
+            # jitted dispatch: each replica reads a different clock, so
+            # one follower returns early while the leader dispatches —
+            # the collective inside the computation deadlocks the mesh
+            if time.monotonic() > step.deadline:
+                return
+            fn = engine._decode_fn(step.batch)
+            fn(step.tokens)
+''',
+    },
+    tn={
+        "langstream_tpu/serving/lockstep.py": '''\
+class LockstepFollower:
+    def run(self, engine, steps):
+        for step in steps:
+            # the sanctioned shape: the guard is lockstep-replicated
+            # state (broadcast by the leader), identical on every
+            # replica, so all replicas take the same branch
+            if step.lockstep_stop:
+                return
+            fn = engine._decode_fn(step.batch)
+            fn(step.tokens)
+''',
+    },
+    fix=(
+        "A branch ahead of a lockstep dispatch may only consult "
+        "replicated state: values the leader broadcast over the "
+        "lockstep channel (spell it so — `step.lockstep_stop`, "
+        "`self._stopping_lockstep`). Host-local reads (time.*, "
+        "random.*, os.environ, socket.gethostname) diverge per "
+        "replica; move them to the leader, broadcast the decision, "
+        "and branch on the broadcast result."
+    ),
+))
+
+_register(RuleExample(
+    rule="SPMD1302",
+    tp={
+        "langstream_tpu/serving/engine.py": '''\
+import time
+
+class TpuServingEngine:
+    def _decode_loop(self, tokens):
+        self._lockstep.broadcast(len(tokens))
+        # a host-local value as the specialization key: replicas hash
+        # different keys, compile different programs, and the lockstep
+        # mesh dispatches mismatched executables
+        fn = self._decode_fn(int(time.time()) % 7)
+        return fn(tokens)
+''',
+    },
+    tn={
+        "langstream_tpu/serving/engine.py": '''\
+class TpuServingEngine:
+    def _decode_loop(self, tokens):
+        self._lockstep.broadcast(len(tokens))
+        # the sanctioned shape: the key is derived from the request
+        # batch every replica received identically
+        fn = self._decode_fn(len(tokens))
+        return fn(tokens)
+''',
+    },
+    fix=(
+        "Specialization-getter arguments (_decode_fn / _prefill_fn / "
+        "_verify_fn) are jit cache keys: every replica must compute "
+        "the same key or the mesh compiles divergent programs. Derive "
+        "keys from the (broadcast) batch shape, never from host-local "
+        "sources (time.*, random.*, os.environ, hostname) — and note "
+        "casts do not launder divergence: int(time.time()) is still "
+        "per-replica."
+    ),
+))
+
+_register(RuleExample(
+    rule="SPMD1303",
+    tp={
+        "langstream_tpu/serving/engine.py": '''\
+class TpuServingEngine:
+    def _decode_loop(self, batch):
+        # a hot-path dispatch with NO lockstep broadcast anywhere in
+        # the method tree: followers replaying the schedule have no
+        # way to learn this step's shape, so the mesh diverges
+        fn = self._decode_fn(batch.rows)
+        return fn(batch.tokens)
+''',
+    },
+    tn={
+        "langstream_tpu/serving/engine.py": '''\
+class TpuServingEngine:
+    def _decode_loop(self, batch):
+        # the sanctioned shape: the leader broadcasts the step
+        # descriptor over the lockstep channel before dispatching
+        rows = self._lockstep.broadcast(batch.rows)
+        fn = self._decode_fn(rows)
+        return fn(batch.tokens)
+''',
+    },
+    fix=(
+        "Every engine hot-path method tree that dispatches through a "
+        "specialization getter must broadcast the step descriptor over "
+        "the lockstep channel first (`self._lockstep.broadcast(...)`), "
+        "so followers replay the identical dispatch sequence. The "
+        "check is method-granular: the broadcast belongs in the same "
+        "outermost method tree as the dispatch it describes."
+    ),
+))
+
+_register(RuleExample(
+    rule="HOT1401",
+    tp={
+        "langstream_tpu/serving/engine.py": '''\
+import jax.numpy as jnp
+
+from langstream_tpu.serving.sample import pick
+
+class TpuServingEngine:
+    def _decode_loop(self):
+        logits = jnp.zeros((4,))
+        return pick(logits)
+''',
+        "langstream_tpu/serving/sample.py": '''\
+import jax.numpy as jnp
+import numpy as np
+
+def pick(logits):
+    idx = jnp.argmax(logits)
+    # blocking materialization INSIDE the hot loop (reached from
+    # _decode_loop): the host stalls against the device every token
+    return int(np.asarray(idx))
+''',
+    },
+    tn={
+        "langstream_tpu/serving/engine.py": '''\
+import jax.numpy as jnp
+import numpy as np
+
+class TpuServingEngine:
+    def _decode_loop(self):
+        self._pending = jnp.zeros((4,))
+        return self._fetch_chunk()
+
+    def _fetch_chunk(self):
+        # the ONE sanctioned sync point: a _fetch* stage, run on the
+        # dispatch thread and timed — materialization is its job
+        return np.asarray(self._pending)
+''',
+    },
+    fix=(
+        "Materialization (np.asarray / .item() / float() / .tolist() / "
+        "block_until_ready) on a device value reachable from the "
+        "decode hot loop belongs in a sanctioned fetch stage: a "
+        "`_fetch*` method (or a dispatch closure's `_run`), where the "
+        "engine overlaps the sync with the next dispatch and times it. "
+        "Keep the hot loop itself submit-only."
+    ),
+))
+
+_register(RuleExample(
+    rule="HOT1402",
+    tp={
+        "langstream_tpu/serving/engine.py": '''\
+import jax.numpy as jnp
+
+class TpuServingEngine:
+    def _decode_loop(self, tokens):
+        done = jnp.any(tokens == 0)
+        # implicit __bool__ on a device value: the innocuous-looking
+        # `if` blocks the hot loop against the device every iteration
+        if done:
+            return None
+        return tokens
+''',
+    },
+    tn={
+        "langstream_tpu/serving/engine.py": '''\
+import jax.numpy as jnp
+
+class TpuServingEngine:
+    def _decode_loop(self, tokens):
+        # the sanctioned shape: the fetch stage materializes ONCE and
+        # the hot loop branches on the host-side result
+        done = self._fetch_done(tokens)
+        if done:
+            return None
+        return tokens
+
+    def _fetch_done(self, tokens):
+        return bool(jnp.any(tokens == 0))
+''',
+    },
+    fix=(
+        "Never let a device value reach `if`/`while`/`assert` in the "
+        "hot loop — each implicit __bool__ is a hidden "
+        "block_until_ready. Materialize once in a `_fetch*` stage "
+        "(`bool(...)` there is sanctioned) and branch on the returned "
+        "host value, or restructure so the branch happens inside the "
+        "jitted computation (jnp.where / lax.cond)."
+    ),
+))

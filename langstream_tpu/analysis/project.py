@@ -102,6 +102,48 @@ SYNC_FETCH_ATTRS = {"block_until_ready", "item"}
 UNAMBIGUOUS_SYNC_CALLS = {"jax.block_until_ready", "jax.device_get"}
 UNAMBIGUOUS_SYNC_ATTRS = {"block_until_ready"}
 
+# -- execution contexts (the device-boundary model, docs/ANALYSIS.md) ------
+#
+# Complementing thread ROLES (which thread runs a function), CONTEXTS say
+# which *protocol regime* a function executes under — the facts the
+# SPMD13xx / HOT14xx rule families key on:
+
+#: hot decode/draft loop — transitive closure from the engine loop safe
+#: point and the speculative pipeline; one call per chunk (or token)
+CTX_HOT = "hot"
+#: sanctioned fetch stage — the lexical ``_fetch*`` / ``_run`` dispatch
+#: closures where the one timed device→host sync per chunk belongs
+CTX_FETCH = "fetch"
+#: lockstep follower replay path — closure from ``LockstepFollower.run``;
+#: control flow here must be a pure function of broadcast descriptors
+CTX_REPLAY = "replay"
+
+#: engine-file roots of the hot context: the loop safe point, the burst
+#: dispatch entries (the PERF701/INV902 vocabulary), and the speculative
+#: draft pipeline
+HOT_CONTEXT_ROOTS = (
+    "_run_loop", "_decode_loop", "_decode_once",
+    "_decode_burst", "_drain_pending", "_speculative_burst",
+    "_advance_prefills", "_admit", "_process_chunk", "_emit_token",
+    "_flush_emits", "_draft_tokens",
+)
+
+#: jit specialization getters: calling one resolves (or compiles) a jit
+#: variant — the call's arguments ARE the jit cache key, and its result
+#: is the device-dispatch callable. In lockstep mode every host must
+#: resolve the same variant (SPMD1302) and every dispatch must be
+#: broadcast first (SPMD1303)
+JIT_GETTER_NAMES = (
+    "_decode_fn", "_prefill_fn", "_prefill_continue_fn", "_verify_fn",
+)
+
+
+def is_fetch_stage_name(name: str) -> bool:
+    """The sanctioned fetch-stage spellings: ``_fetch*`` helpers and the
+    off-loop ``_run`` dispatch closures (exact — ``_run_loop`` is the hot
+    loop itself, not a fetch stage)."""
+    return name.startswith("_fetch") or name == "_run"
+
 
 @dataclasses.dataclass(frozen=True)
 class ProjectRule:
@@ -785,6 +827,7 @@ class ProjectIndex:
         self._resolve_attr_types()
         self._resolve_calls()
         self.roles: dict[str, frozenset[str]] = self._infer_roles()
+        self.contexts: dict[str, frozenset[str]] = self._infer_contexts()
 
     # -- construction ----------------------------------------------------
 
@@ -974,6 +1017,57 @@ class ProjectIndex:
                         changed = True
         return {q: frozenset(r) for q, r in roles.items()}
 
+    # -- execution contexts ----------------------------------------------
+
+    def _context_closure(self, roots: list[str]) -> set[str]:
+        """Closure from ``roots`` over call + submit + loop-callback edges
+        (a dispatch closure handed to ``run_in_executor`` still runs per
+        chunk; a spawned *thread* does not inherit the caller's cadence).
+        Two propagation cuts: constructors (identical-construction-path by
+        design) and fetch stages (a fetch stage is tagged but its callees
+        are sanctioned by the stage's timing contract, so the tag stops
+        there)."""
+        root_set = {r for r in roots if r in self.functions}
+        seen: set[str] = set()
+        stack = list(root_set)
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fn = self.functions[q]
+            if q not in root_set and (
+                fn.name == "__init__"
+                or any(is_fetch_stage_name(s) for s in fn.scope_names)
+            ):
+                continue
+            for callee in fn.calls | fn.submits | fn.loop_cbs:
+                if callee not in seen and callee in self.functions:
+                    stack.append(callee)
+        return seen
+
+    def _infer_contexts(self) -> dict[str, frozenset[str]]:
+        ctx: dict[str, set[str]] = {q: set() for q in self.functions}
+        hot_roots: list[str] = []
+        replay_roots: list[str] = []
+        for fn in self.functions.values():
+            # sanctioned fetch stages are lexical: the _fetch* helpers and
+            # the off-loop _run dispatch closures (incl. everything nested
+            # inside one)
+            if any(is_fetch_stage_name(s) for s in fn.scope_names):
+                ctx[fn.qname].add(CTX_FETCH)
+            if (fn.path.endswith("serving/engine.py")
+                    and fn.name in HOT_CONTEXT_ROOTS):
+                hot_roots.append(fn.qname)
+            if (fn.name == "run" and fn.cls is not None
+                    and "lockstep" in fn.path
+                    and "follower" in fn.cls.rsplit(".", 1)[-1].lower()):
+                replay_roots.append(fn.qname)
+        for tag, roots in ((CTX_HOT, hot_roots), (CTX_REPLAY, replay_roots)):
+            for q in self._context_closure(roots):
+                ctx[q].add(tag)
+        return {q: frozenset(s) for q, s in ctx.items()}
+
     # -- queries ---------------------------------------------------------
 
     def resolve_call(self, raw: RawCall, fn: FunctionInfo) -> str | None:
@@ -1052,6 +1146,9 @@ class ProjectIndex:
 
     def role_of(self, qname: str) -> frozenset[str]:
         return self.roles.get(qname, frozenset())
+
+    def context_of(self, qname: str) -> frozenset[str]:
+        return self.contexts.get(qname, frozenset())
 
 
 def conflicting_roles(a: frozenset[str], b: frozenset[str]) -> bool:

@@ -1,0 +1,612 @@
+"""Hot-path host-synchronization rules (HOT1401/1402), built on the
+execution-context layer (``project.py``: CTX_HOT / CTX_FETCH /
+CTX_REPLAY) and a device-array taint over the dataflow CFGs.
+
+BENCH_r05 showed the speculative draft loop is host-bound, not
+acceptance-bound (0.23x uplift, 40.6 ms/step against an 11.8 ms
+roofline): host syncs keep leaking onto the decode tail. PERF701
+polices the engine file's dispatch-path method bodies lexically, and
+INV902 extends the *unambiguous* sync spellings across the call graph —
+but both go quiet exactly where the r05 leaks live: ``np.asarray`` /
+``.item()`` in helper modules (ambiguous without types), ``float()`` /
+``.tolist()`` anywhere, and implicit ``__bool__`` on a device value
+(``if logits_changed:`` blocks the host just as hard as
+``block_until_ready``). The device taint supplies the missing evidence:
+
+- **HOT1401 — blocking host materialization in the hot context.** A
+  conversion/sync whose argument provably carries a device value —
+  ``np.asarray``/``np.array`` (off the engine file, where PERF701/INV902
+  already own the spelling), ``float()``/``int()``/``bool()`` with a
+  single device argument, ``.item()``/``.tolist()``, and
+  ``jax.block_until_ready``/``jax.device_get`` at sites the INV902
+  closure cannot reach — inside a CTX_HOT function but outside a
+  sanctioned fetch stage and outside a lockstep branch.
+- **HOT1402 — implicit ``__bool__`` on a device value.** An
+  ``if``/``while``/ternary/``assert`` test carrying device taint in a
+  CTX_HOT/CTX_REPLAY function: Python calls ``__bool__``, which is a
+  synchronous device→host transfer in disguise (and a TracerBoolError
+  under jit — traced functions are excluded, JAX102's turf). Identity
+  tests (``x is None``) never materialize and stay silent.
+
+Taint model (docs/ANALYSIS.md, "device-boundary model"): sources are
+``jnp.*``/``jax.lax.*``/``jax.random.*`` results, ``jax.device_put``,
+reads of instance attributes observed holding device values
+(``self.cache_k = jnp.zeros(...)`` anywhere in the file set), calls of
+the jit-specialization getters (their result is the device-dispatch
+callable; calling it yields device arrays by child-union), and calls to
+functions whose summaries say they return device values. Sanctioners —
+the value is host-clean afterwards — are exactly the materializers
+(``np.asarray``, ``.item()``, casts, ``jax.device_get``; the *sink*
+fires where the sync happens, not downstream), host-value builtins
+(``len``/``str``/``isinstance``/...), the sanctioned fetch stages
+(``_fetch*``/``_run`` and executor submissions targeting one), and
+static-metadata attribute reads (``x.shape``/``x.dtype``), which never
+force a transfer. Known limits, precision over recall: function
+parameters are not seeded from call-site taint (a helper that only ever
+*receives* device arrays needs an in-function source to convict), and
+device-attribute names are matched receiver-insensitively.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator
+
+from langstream_tpu.analysis import dataflow as df
+from langstream_tpu.analysis.core import Finding, Module, dotted_name
+from langstream_tpu.analysis.project import (
+    CTX_FETCH,
+    CTX_HOT,
+    CTX_REPLAY,
+    JIT_GETTER_NAMES,
+    FunctionInfo,
+    ProjectIndex,
+    ProjectRule,
+    RawCall,
+)
+from langstream_tpu.analysis.rules_inv import (
+    _DISPATCH_ENTRIES as _INV_ENTRIES,
+    _engine_entry_qnames,
+)
+from langstream_tpu.analysis.rules_jax import traced_functions
+from langstream_tpu.analysis.rules_perf import _DISPATCH_FUNCS as _PERF_FUNCS
+
+_ENGINE_FILE = "serving/engine.py"
+
+#: the taint label
+DEVICE = "device"
+
+#: value-producing calls whose result lives on the device
+_DEVICE_CALL_PREFIXES = (
+    "jnp.", "jax.numpy.", "lax.", "jax.lax.", "jax.random.",
+)
+_DEVICE_CALLS = {"jax.device_put"}
+
+#: conversions that block the host until the device value lands
+_NP_CONVERSIONS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_MATERIALIZE_ATTRS = {"item", "tolist"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+#: builtins whose value is host data regardless of argument residency
+_HOST_VALUE_CALLS = {
+    "len", "str", "repr", "format", "isinstance", "hasattr", "getattr",
+    "type", "range", "id", "print", "sorted", "min", "max", "sum",
+}
+
+#: static metadata reads — never a transfer
+_HOST_METADATA_ATTRS = {
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "sharding",
+    "device", "devices", "name", "qname", "path",
+}
+
+_MAX_SUMMARY_ROUNDS = 3
+
+
+# --------------------------------------------------------------------------
+# shared helpers (also used by rules_spmd)
+# --------------------------------------------------------------------------
+
+
+def exprs_of_node(node: df.CFGNode) -> list[ast.AST]:
+    """The expressions a CFG node *evaluates itself*: the whole simple
+    statement for ``stmt`` nodes, only the header expression for
+    branch/loop heads (their bodies are separate nodes)."""
+    stmt = node.ast_node
+    if stmt is None:
+        return []
+    if node.kind == "stmt":
+        return [stmt]
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    return []
+
+
+def calls_in_expr(expr: ast.AST) -> Iterator[ast.Call]:
+    """Call expressions under ``expr``, nested defs excluded."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def is_fetchish(expr: ast.AST) -> bool:
+    """Does ``expr`` denote a sanctioned fetch stage — a ``_fetch*``
+    helper or the off-loop ``_run`` dispatch closure — directly or
+    through ``functools.partial``?"""
+    d = dotted_name(expr)
+    if d is not None:
+        leaf = d.split(".")[-1]
+        return leaf.startswith("_fetch") or leaf == "_run"
+    if isinstance(expr, ast.Call) and expr.args:
+        leaf = (dotted_name(expr.func) or "").split(".")[-1]
+        if leaf == "partial":
+            return is_fetchish(expr.args[0])
+    return False
+
+
+def mentions_lockstep(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        d = dotted_name(sub) or ""
+        if d.endswith("_lockstep") or d.endswith(".lockstep"):
+            return True
+    return False
+
+
+def lockstep_spans(mod: Module) -> list[tuple[int, int]]:
+    """Lexical line ranges of every ``if …_lockstep…:`` statement in the
+    file — inside one, host fetches are the broadcast protocol's cost by
+    design (same exemption as PERF701/INV902)."""
+    spans = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.If) and mentions_lockstep(node.test):
+            spans.append((node.lineno, node.end_lineno or node.lineno))
+    return spans
+
+
+def in_spans(line: int, spans: list[tuple[int, int]]) -> bool:
+    return any(lo <= line <= hi for lo, hi in spans)
+
+
+def raw_for_callee(expr: ast.AST) -> RawCall | None:
+    if isinstance(expr, ast.Name):
+        return RawCall(kind="name", name=expr.id, line=expr.lineno)
+    if isinstance(expr, ast.Attribute):
+        if (isinstance(expr.value, ast.Name)
+                and expr.value.id in ("self", "cls")):
+            return RawCall(kind="self", name=expr.attr, line=expr.lineno)
+        d = dotted_name(expr)
+        if d is not None:
+            return RawCall(kind="dotted", name=d, line=expr.lineno)
+    return None
+
+
+def resolve_callee(
+    index: ProjectIndex, fn_info: FunctionInfo | None, expr: ast.AST
+) -> str | None:
+    if fn_info is None:
+        return None
+    raw = raw_for_callee(expr)
+    if raw is None:
+        return None
+    return index.resolve_call(raw, fn_info)
+
+
+def own_stmts(fn_node: ast.AST) -> Iterator[ast.stmt]:
+    """Statements of the function excluding nested defs (separate flow
+    functions)."""
+    stack = list(fn_node.body)
+    while stack:
+        stmt = stack.pop()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield stmt
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+            elif isinstance(child, ast.excepthandler):
+                stack.extend(child.body)
+
+
+# --------------------------------------------------------------------------
+# the device-taint layer
+# --------------------------------------------------------------------------
+
+
+class _DeviceSpec(df.TaintSpec):
+    def __init__(
+        self,
+        returns_device: set[str],
+        device_attrs: set[str],
+        resolve: Callable[[ast.Call], str | None],
+    ):
+        self._returns_device = returns_device
+        self._device_attrs = device_attrs
+        self._resolve = resolve
+
+    def source_label(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func) or ""
+            if d in _DEVICE_CALLS or d.startswith(_DEVICE_CALL_PREFIXES):
+                return DEVICE
+            if d.split(".")[-1] in JIT_GETTER_NAMES:
+                # the getter's value is the device-dispatch callable;
+                # calling it yields device arrays via child-union
+                return DEVICE
+            callee = self._resolve(expr)
+            if callee is not None and callee in self._returns_device:
+                return DEVICE
+        elif isinstance(expr, ast.Attribute):
+            if expr.attr in self._device_attrs:
+                return DEVICE
+        return None
+
+    def is_sanctioner(self, call: ast.Call) -> bool:
+        d = dotted_name(call.func) or ""
+        if d in _NP_CONVERSIONS or d in _DEVICE_GET:
+            return True  # the sink fires AT the sync; value is host after
+        if isinstance(call.func, ast.Name) and (
+            call.func.id in _CAST_BUILTINS
+            or call.func.id in _HOST_VALUE_CALLS
+        ):
+            return True
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _MATERIALIZE_ATTRS):
+            return True
+        if is_fetchish(call.func):
+            return True  # fetch stages return host data by contract
+        leaf = d.split(".")[-1]
+        if leaf in ("run_in_executor", "submit") and any(
+            is_fetchish(a) for a in call.args
+        ):
+            return True  # awaiting a submitted fetch stage yields host data
+        return False
+
+    def launders_attr(self, attr: ast.Attribute) -> bool:
+        return attr.attr in _HOST_METADATA_ATTRS
+
+    def call_propagates_args(self, call: ast.Call) -> bool:
+        # residency property: Foo(device_array) builds a host object —
+        # a call's result is device only via an explicit source/summary
+        # or a device-valued callee (`fn = engine._decode_fn(...);
+        # fn(*args)`), never through argument child-union
+        return False
+
+
+def _is_fetch_stage_info(info: FunctionInfo | None, qname: str) -> bool:
+    names = info.scope_names if info is not None else tuple(
+        qname.split(".")
+    )
+    return any(n.startswith("_fetch") or n == "_run" for n in names)
+
+
+def device_layer(index: ProjectIndex) -> dict:
+    """The shared device-taint facts, computed once per index:
+
+    - ``scope`` — qnames in CTX_HOT or CTX_REPLAY;
+    - ``flows`` — qname → FlowFunction for every function in the scope's
+      files (summaries need the constructors/initializers too);
+    - ``taints`` — qname → TaintState under the final summaries;
+    - ``modules`` / ``traced`` / ``spans`` — per-path Module, traced
+      (name, lineno) pairs, lockstep If spans;
+    - ``inv_covered`` — qnames INV902's closure already polices.
+    """
+    cached = getattr(index, "_device_layer", None)
+    if cached is not None:
+        return cached
+
+    scope = {
+        q for q, tags in index.contexts.items()
+        if CTX_HOT in tags or CTX_REPLAY in tags
+    }
+    paths = sorted({
+        index.functions[q].path for q in scope if q in index.functions
+    })
+    flows: dict[str, df.FlowFunction] = {}
+    modules: dict[str, Module] = {}
+    traced: dict[str, set[tuple[str, int]]] = {}
+    spans: dict[str, list[tuple[int, int]]] = {}
+    for path in paths:
+        src = index.sources.get(path)
+        if src is None:
+            continue
+        try:
+            ff = df.flow_index(path, src)
+            mod = Module(path, src)
+        except SyntaxError:
+            continue
+        flows.update(ff.functions)
+        modules[path] = mod
+        traced[path] = {
+            (f.name, f.lineno)
+            for f in traced_functions(mod)
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        spans[path] = lockstep_spans(mod)
+
+    returns_device: set[str] = set()
+    device_attrs: set[str] = set()
+    taints: dict[str, df.TaintState] = {}
+
+    def _resolver(info: FunctionInfo | None):
+        return lambda call: resolve_callee(index, info, call.func)
+
+    for _ in range(_MAX_SUMMARY_ROUNDS):
+        changed = False
+        for qname, fn in flows.items():
+            info = index.functions.get(qname)
+            spec = _DeviceSpec(returns_device, device_attrs,
+                               _resolver(info))
+            try:
+                taint = df.run_taint(fn.cfg, spec)
+            except RecursionError:
+                continue
+            taints[qname] = taint
+            for stmt in own_stmts(fn.node):
+                node = fn.cfg.node_for(stmt)
+                if node is None:
+                    continue
+                if (isinstance(stmt, ast.Return)
+                        and stmt.value is not None
+                        and qname not in returns_device
+                        and not _is_fetch_stage_info(info, qname)
+                        and DEVICE in taint.expr_labels(stmt.value,
+                                                        node.idx)):
+                    returns_device.add(qname)
+                    changed = True
+                if isinstance(stmt, ast.Assign):
+                    if DEVICE not in taint.expr_labels(stmt.value,
+                                                      node.idx):
+                        continue
+                    for target in stmt.targets:
+                        targets = (
+                            target.elts
+                            if isinstance(target, (ast.Tuple, ast.List))
+                            else [target]
+                        )
+                        for t in targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and t.attr not in device_attrs):
+                                device_attrs.add(t.attr)
+                                changed = True
+        if not changed:
+            break
+
+    entries = _engine_entry_qnames(index, _INV_ENTRIES)
+    inv_covered = index.reachable(entries) if entries else set()
+
+    layer = {
+        "scope": scope,
+        "flows": flows,
+        "taints": taints,
+        "modules": modules,
+        "traced": traced,
+        "spans": spans,
+        "inv_covered": inv_covered,
+        "returns_device": returns_device,
+        "device_attrs": device_attrs,
+    }
+    index._device_layer = layer
+    return layer
+
+
+def _scoped_functions(
+    index: ProjectIndex, layer: dict, tags: frozenset[str]
+) -> Iterator[tuple[str, df.FlowFunction, FunctionInfo]]:
+    """Scope-filtered (qname, flow, info) triples: in one of ``tags``,
+    not a fetch stage, under ``serving/``, not jit-traced."""
+    for qname in sorted(layer["scope"]):
+        info = index.functions.get(qname)
+        fn = layer["flows"].get(qname)
+        if info is None or fn is None:
+            continue
+        ctx = index.contexts.get(qname, frozenset())
+        if not (ctx & tags) or CTX_FETCH in ctx:
+            continue
+        if "serving/" not in f"/{info.path}":
+            continue
+        if (info.name, info.lineno) in layer["traced"].get(info.path,
+                                                          set()):
+            continue
+        yield qname, fn, info
+
+
+# --------------------------------------------------------------------------
+# HOT1401 — blocking host materialization in the hot context
+# --------------------------------------------------------------------------
+
+
+def _materialize_sites(
+    call: ast.Call, in_engine: bool, inv_covered: bool
+) -> Iterator[tuple[ast.AST, str]]:
+    """(tainted-operand, spelling) pairs for one call, pre-filtered by
+    the non-overlap contract with PERF701/INV902/JAX104: on the engine
+    file (and in INV902's closure) the shared sync vocabulary belongs to
+    the older rules; the vocabulary only HOT1401 has — device-tainted
+    casts and ``.tolist()`` — is reported everywhere in scope."""
+    d = dotted_name(call.func) or ""
+    # ambiguous spellings (np.asarray / .item()): PERF701 owns the
+    # engine file; off-engine INV902 deliberately skips them, so the
+    # taint evidence here is the only line of defense
+    if d in _NP_CONVERSIONS and call.args and not in_engine:
+        yield call.args[0], f"{d}(...)"
+    # unambiguous syncs: INV902's closure reports these wherever it
+    # reaches, on or off the engine file
+    unambiguous_covered = in_engine or inv_covered
+    if d in _DEVICE_GET and call.args and not unambiguous_covered:
+        yield call.args[0], f"{d}(...)"
+    if (d == "jax.block_until_ready" and call.args
+            and not unambiguous_covered):
+        yield call.args[0], "jax.block_until_ready(...)"
+    if isinstance(call.func, ast.Attribute):
+        if (call.func.attr == "block_until_ready"
+                and not unambiguous_covered):
+            yield call.func.value, ".block_until_ready()"
+        if call.func.attr == "item" and not in_engine:
+            yield call.func.value, ".item()"
+        if call.func.attr == "tolist":
+            yield call.func.value, ".tolist()"
+    if (isinstance(call.func, ast.Name)
+            and call.func.id in _CAST_BUILTINS
+            and len(call.args) == 1):
+        yield call.args[0], f"{call.func.id}(...)"
+
+
+def check_hot_materialization(index: ProjectIndex) -> Iterator[Finding]:
+    layer = device_layer(index)
+    for qname, fn, info in _scoped_functions(
+        index, layer, frozenset({CTX_HOT})
+    ):
+        taint = layer["taints"].get(qname)
+        if taint is None:
+            continue
+        in_engine = info.path.endswith(_ENGINE_FILE)
+        inv_covered = qname in layer["inv_covered"]
+        spans = layer["spans"].get(info.path, [])
+        for node in fn.cfg.nodes:
+            for expr in exprs_of_node(node):
+                for call in calls_in_expr(expr):
+                    if in_spans(call.lineno, spans):
+                        continue
+                    for operand, spelling in _materialize_sites(
+                        call, in_engine, inv_covered
+                    ):
+                        if DEVICE not in taint.expr_labels(operand,
+                                                           node.idx):
+                            continue
+                        yield Finding(
+                            rule="HOT1401",
+                            path=info.path,
+                            line=call.lineno,
+                            symbol=".".join(info.scope_names),
+                            message=(
+                                f"{spelling} materializes a device "
+                                f"value on the hot decode/draft path "
+                                f"(`{info.name}` is in the hot-loop "
+                                f"closure) outside a sanctioned fetch "
+                                f"stage: the host blocks until the "
+                                f"device flushes, which is the r05 "
+                                f"host-bound draft-loop class — defer "
+                                f"to _fetch_chunk / the off-loop _run "
+                                f"closure, or keep the value "
+                                f"device-resident (docs/ANALYSIS.md, "
+                                f"device-boundary model)"
+                            ),
+                        )
+
+
+# --------------------------------------------------------------------------
+# HOT1402 — implicit __bool__ on a device value
+# --------------------------------------------------------------------------
+
+
+def _bool_test_labels(
+    expr: ast.AST, labels: Callable[[ast.AST], frozenset[str]]
+) -> frozenset[str]:
+    """Labels that reach the actual ``__bool__`` call of a condition:
+    identity comparisons never materialize; and/or/not recurse into
+    their operands."""
+    if isinstance(expr, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+    ):
+        return frozenset()
+    if isinstance(expr, ast.BoolOp):
+        out: frozenset[str] = frozenset()
+        for value in expr.values:
+            out |= _bool_test_labels(value, labels)
+        return out
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return _bool_test_labels(expr.operand, labels)
+    return labels(expr)
+
+
+def _condition_sites(
+    fn: df.FlowFunction,
+) -> Iterator[tuple[int, int, ast.AST, str]]:
+    """(cfg idx, line, test expr, kind) for every implicit-bool site."""
+    for node in fn.cfg.nodes:
+        stmt = node.ast_node
+        if stmt is None:
+            continue
+        if node.kind == "head" and isinstance(stmt, (ast.If, ast.While)):
+            kind = "if" if isinstance(stmt, ast.If) else "while"
+            yield node.idx, stmt.lineno, stmt.test, kind
+        elif node.kind == "stmt":
+            if isinstance(stmt, ast.Assert):
+                yield node.idx, stmt.lineno, stmt.test, "assert"
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(sub, ast.IfExp):
+                    yield (node.idx, getattr(sub, "lineno", stmt.lineno),
+                           sub.test, "conditional expression")
+
+
+def check_hot_implicit_bool(index: ProjectIndex) -> Iterator[Finding]:
+    layer = device_layer(index)
+    for qname, fn, info in _scoped_functions(
+        index, layer, frozenset({CTX_HOT, CTX_REPLAY})
+    ):
+        taint = layer["taints"].get(qname)
+        if taint is None:
+            continue
+        spans = layer["spans"].get(info.path, [])
+        for idx, line, test, kind in _condition_sites(fn):
+            if in_spans(line, spans) or mentions_lockstep(test):
+                continue
+            got = _bool_test_labels(
+                test, lambda e: taint.expr_labels(e, idx)
+            )
+            if DEVICE not in got:
+                continue
+            yield Finding(
+                rule="HOT1402",
+                path=info.path,
+                line=line,
+                symbol=".".join(info.scope_names),
+                message=(
+                    f"this {kind} test carries a device value: Python "
+                    f"calls __bool__ on it, which is a synchronous "
+                    f"device→host transfer in disguise — on the hot "
+                    f"decode/draft path it serializes the host against "
+                    f"the device every iteration; compare against a "
+                    f"host-materialized copy from the fetch stage, or "
+                    f"test identity (`x is None`), which never "
+                    f"materializes (docs/ANALYSIS.md, device-boundary "
+                    f"model)"
+                ),
+            )
+
+
+RULES = [
+    ProjectRule(
+        id="HOT1401",
+        family="hot",
+        summary="blocking host materialization of a device-tainted value "
+        "(np.asarray / .item() / float() / .tolist() / block_until_ready) "
+        "in the hot-loop context outside a sanctioned fetch stage",
+        check=check_hot_materialization,
+    ),
+    ProjectRule(
+        id="HOT1402",
+        family="hot",
+        summary="implicit __bool__ on a device-tainted value in a hot-loop "
+        "or lockstep-replay condition — a synchronous device→host transfer "
+        "in disguise",
+        check=check_hot_implicit_bool,
+    ),
+]

@@ -1,0 +1,396 @@
+"""Lockstep SPMD-divergence rules (SPMD1301-1303), built on the
+execution-context layer (``project.py``) and a host-local taint over
+the dataflow CFGs.
+
+The multi-host lockstep protocol (``serving/lockstep.py``,
+docs/LOCKSTEP.md) keeps N processes issuing the *same* jitted dispatches
+in the *same* order: the leader broadcasts a step descriptor, followers
+replay it. The failure mode is silent and fatal — the moment one host's
+control flow diverges before a collective, every host blocks inside XLA
+waiting for peers that took a different branch, and the slice hangs with
+no exception anywhere (ROADMAP item 1). Three statically checkable
+protocol invariants:
+
+- **SPMD1301 — host-divergent branch ahead of a lockstep dispatch.** On
+  the follower replay path, a branch test carrying *host-local* taint —
+  wall clock, RNG, process identity, environment reads — ahead of a
+  jitted dispatch or collective. Each follower evaluates the test with
+  its own clock/seed and can take a different arm, so the dispatch
+  counts stop matching. Branch tests that inspect the lockstep channel
+  itself (``if …_lockstep…:``) are the protocol's own mode switch and
+  stay silent.
+- **SPMD1302 — host-local jit specialization key.** An argument of a
+  jit-specialization getter (``self._decode_fn(mode, window, …)``)
+  carrying host-local taint in any lockstep-relevant context: the
+  arguments ARE the jit cache key, so divergent values compile/resolve
+  different programs on different hosts — the same hang, one layer
+  lower. Keys must come from broadcast descriptor fields or the
+  sanctioned deterministic bucketing helpers.
+- **SPMD1303 — un-broadcast leader dispatch.** An engine-file hot-path
+  method that resolves a jit-specialization getter with no
+  ``self._lockstep.broadcast(...)`` anywhere in the same method's
+  closure tree: in lockstep mode the followers never hear about the
+  step, so the leader's collective waits forever. Leader-only decisions
+  must flow through the broadcast before any follower-visible dispatch
+  (the broadcast-before-dispatch invariant).
+
+Host-local taint sources are spellings whose value differs across
+replicas by construction: ``time.*`` clocks, ``random``/``np.random``/
+``secrets``/``os.urandom``, ``uuid.uuid1/uuid4``, ``os.getpid``,
+``socket.gethostname``, ``os.environ`` reads. Nothing launders them —
+hashing or casting a host-local value leaves it host-local. Known
+limits (docs/ANALYSIS.md, "device-boundary model"): per-replica
+*counter drift* and dict-iteration order are not modeled (no cheap
+syntactic witness), and SPMD1303 checks broadcast presence at method
+granularity, not path-sensitively.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis import dataflow as df
+from langstream_tpu.analysis.core import Finding, dotted_name
+from langstream_tpu.analysis.project import (
+    CTX_HOT,
+    CTX_REPLAY,
+    JIT_GETTER_NAMES,
+    FunctionInfo,
+    ProjectIndex,
+    ProjectRule,
+)
+from langstream_tpu.analysis.rules_hot import (
+    calls_in_expr,
+    device_layer,
+    exprs_of_node,
+    mentions_lockstep,
+    own_stmts,
+)
+
+_ENGINE_FILE = "serving/engine.py"
+
+HOSTLOCAL = "host-local"
+
+_HOSTLOCAL_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns",
+    "os.urandom", "os.getpid", "os.getenv", "socket.gethostname",
+    "uuid.uuid1", "uuid.uuid4",
+}
+_HOSTLOCAL_PREFIXES = (
+    "random.", "np.random.", "numpy.random.", "secrets.",
+)
+_HOSTLOCAL_ATTRS = {"os.environ"}
+
+#: collective spellings that block until every replica arrives
+_COLLECTIVE_LEAVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "shard_map", "pjit", "pmap",
+}
+
+
+class _HostLocalSpec(df.TaintSpec):
+    """No sanctioners on purpose: casting/hashing a wall-clock or RNG
+    value leaves it just as replica-divergent."""
+
+    def source_label(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func) or ""
+            if d in _HOSTLOCAL_CALLS or d.startswith(_HOSTLOCAL_PREFIXES):
+                return HOSTLOCAL
+        elif isinstance(expr, ast.Attribute):
+            if (dotted_name(expr) or "") in _HOSTLOCAL_ATTRS:
+                return HOSTLOCAL
+        return None
+
+
+def _getter_call(call: ast.Call, getter_locals: set[str]) -> str | None:
+    """The getter spelling when ``call`` resolves a jit specialization —
+    ``self._decode_fn(...)`` directly, or a local previously bound from
+    one (``fn = engine._decode_fn(...); fn(*args)`` dispatches it)."""
+    leaf = (dotted_name(call.func) or "").split(".")[-1]
+    if leaf in JIT_GETTER_NAMES:
+        return leaf
+    if isinstance(call.func, ast.Name) and call.func.id in getter_locals:
+        return call.func.id
+    return None
+
+
+def _getter_locals(fn: df.FlowFunction) -> set[str]:
+    out: set[str] = set()
+    for stmt in own_stmts(fn.node):
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value,
+                                                       ast.Call):
+            leaf = (dotted_name(stmt.value.func) or "").split(".")[-1]
+            if leaf in JIT_GETTER_NAMES:
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        out.add(target.id)
+    return out
+
+
+def _dispatch_marker_lines(fn: df.FlowFunction) -> list[int]:
+    """Lines in this function (nested defs excluded) where a jitted
+    dispatch or collective happens."""
+    getter_locals = _getter_locals(fn)
+    lines = []
+    for stmt in own_stmts(fn.node):
+        for call in calls_in_expr(stmt):
+            d = dotted_name(call.func) or ""
+            leaf = d.split(".")[-1]
+            if (_getter_call(call, getter_locals) is not None
+                    or leaf in _COLLECTIVE_LEAVES):
+                lines.append(call.lineno)
+    return sorted(set(lines))
+
+
+def _host_taint(layer: dict, fn: df.FlowFunction) -> df.TaintState | None:
+    got = fn.memo.get("spmd_host_taint")
+    if got is None:
+        try:
+            got = df.run_taint(fn.cfg, _HostLocalSpec())
+        except RecursionError:
+            return None
+        fn.memo["spmd_host_taint"] = got
+    return got
+
+
+def _branch_exits(stmt: ast.If) -> bool:
+    for sub in ast.walk(stmt):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            continue
+        if isinstance(sub, (ast.Return, ast.Raise, ast.Break,
+                            ast.Continue)):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# SPMD1301 — host-divergent branch ahead of a lockstep dispatch
+# --------------------------------------------------------------------------
+
+
+def check_replay_divergence(index: ProjectIndex) -> Iterator[Finding]:
+    layer = device_layer(index)
+    for qname in sorted(layer["scope"]):
+        if CTX_REPLAY not in index.contexts.get(qname, frozenset()):
+            continue
+        info = index.functions.get(qname)
+        fn = layer["flows"].get(qname)
+        if info is None or fn is None:
+            continue
+        markers = _dispatch_marker_lines(fn)
+        if not markers:
+            continue
+        taint = _host_taint(layer, fn)
+        if taint is None:
+            continue
+        for node in fn.cfg.nodes:
+            stmt = node.ast_node
+            if node.kind != "head" or not isinstance(stmt,
+                                                     (ast.If, ast.While)):
+                continue
+            if mentions_lockstep(stmt.test):
+                continue  # the protocol's own mode switch
+            if HOSTLOCAL not in taint.expr_labels(stmt.test, node.idx):
+                continue
+            end = stmt.end_lineno or stmt.lineno
+            inside = any(stmt.lineno < m <= end for m in markers)
+            after = any(m > end for m in markers)
+            diverges = inside or (
+                isinstance(stmt, ast.If)
+                and after
+                and _branch_exits(stmt)
+            )
+            if not diverges:
+                continue
+            yield Finding(
+                rule="SPMD1301",
+                path=info.path,
+                line=stmt.lineno,
+                symbol=".".join(info.scope_names),
+                message=(
+                    f"branch test on host-local state (wall clock / RNG "
+                    f"/ process identity) ahead of a jitted dispatch on "
+                    f"the lockstep replay path: each replica evaluates "
+                    f"it with its own clock/seed, so hosts can take "
+                    f"different arms and their dispatch sequences stop "
+                    f"matching — every host then blocks inside the next "
+                    f"collective waiting for peers that never arrive; "
+                    f"branch only on broadcast descriptor fields "
+                    f"(docs/ANALYSIS.md, broadcast-before-dispatch)"
+                ),
+            )
+
+
+# --------------------------------------------------------------------------
+# SPMD1302 — host-local jit specialization key
+# --------------------------------------------------------------------------
+
+
+def check_hostlocal_jit_key(index: ProjectIndex) -> Iterator[Finding]:
+    layer = device_layer(index)
+    for qname in sorted(layer["scope"]):
+        tags = index.contexts.get(qname, frozenset())
+        if not (tags & {CTX_HOT, CTX_REPLAY}):
+            continue
+        info = index.functions.get(qname)
+        fn = layer["flows"].get(qname)
+        if info is None or fn is None:
+            continue
+        taint = None
+        for node in fn.cfg.nodes:
+            for expr in exprs_of_node(node):
+                for call in calls_in_expr(expr):
+                    leaf = (dotted_name(call.func) or "").split(".")[-1]
+                    if leaf not in JIT_GETTER_NAMES:
+                        continue
+                    if taint is None:
+                        taint = _host_taint(layer, fn)
+                    if taint is None:
+                        break
+                    operands = list(call.args) + [
+                        kw.value for kw in call.keywords
+                    ]
+                    for arg in operands:
+                        if HOSTLOCAL not in taint.expr_labels(arg,
+                                                              node.idx):
+                            continue
+                        yield Finding(
+                            rule="SPMD1302",
+                            path=info.path,
+                            line=call.lineno,
+                            symbol=".".join(info.scope_names),
+                            message=(
+                                f"host-local value (wall clock / RNG / "
+                                f"process identity) used as a "
+                                f"`{leaf}(...)` argument: the getter's "
+                                f"arguments are the jit cache key, so "
+                                f"replicas resolve different compiled "
+                                f"variants and the lockstep dispatch "
+                                f"sequences diverge — derive the key "
+                                f"from broadcast descriptor fields or "
+                                f"a deterministic bucketing helper "
+                                f"(docs/ANALYSIS.md, device-boundary "
+                                f"model)"
+                            ),
+                        )
+                        break
+
+
+# --------------------------------------------------------------------------
+# SPMD1303 — un-broadcast leader dispatch
+# --------------------------------------------------------------------------
+
+
+def _method_tree(index: ProjectIndex, top: str) -> list[FunctionInfo]:
+    """``top`` plus every function lexically nested under it."""
+    out = []
+    for fn in index.functions.values():
+        cur: FunctionInfo | None = fn
+        while cur is not None:
+            if cur.qname == top:
+                out.append(fn)
+                break
+            cur = (index.functions.get(cur.parent)
+                   if cur.parent is not None else None)
+    return out
+
+
+def _outermost(index: ProjectIndex, info: FunctionInfo) -> FunctionInfo:
+    cur = info
+    while cur.parent is not None:
+        parent = index.functions.get(cur.parent)
+        if parent is None:
+            break
+        cur = parent
+    return cur
+
+
+def _tree_broadcasts(index: ProjectIndex, top: str) -> bool:
+    for fn in _method_tree(index, top):
+        for raw in fn.raw_calls:
+            if raw.name != "broadcast":
+                continue
+            if "lockstep" in (raw.extra or "").lower():
+                return True
+            if raw.kind == "dotted" and "lockstep" in raw.name.lower():
+                return True
+    return False
+
+
+def check_unbroadcast_dispatch(index: ProjectIndex) -> Iterator[Finding]:
+    layer = device_layer(index)
+    checked: set[str] = set()
+    for qname in sorted(layer["scope"]):
+        tags = index.contexts.get(qname, frozenset())
+        if CTX_HOT not in tags or CTX_REPLAY in tags:
+            continue
+        info = index.functions.get(qname)
+        fn = layer["flows"].get(qname)
+        if info is None or fn is None:
+            continue
+        if not info.path.endswith(_ENGINE_FILE):
+            continue
+        getter_sites = []
+        for stmt in own_stmts(fn.node):
+            for call in calls_in_expr(stmt):
+                leaf = (dotted_name(call.func) or "").split(".")[-1]
+                if leaf in JIT_GETTER_NAMES:
+                    getter_sites.append((call.lineno, leaf))
+        if not getter_sites:
+            continue
+        top = _outermost(index, info).qname
+        key = f"{top}:{qname}"
+        if key in checked:
+            continue
+        checked.add(key)
+        if _tree_broadcasts(index, top):
+            continue
+        for line, leaf in sorted(set(getter_sites)):
+            yield Finding(
+                rule="SPMD1303",
+                path=info.path,
+                line=line,
+                symbol=".".join(info.scope_names),
+                message=(
+                    f"hot-path method resolves the jit specialization "
+                    f"`{leaf}(...)` with no `self._lockstep.broadcast("
+                    f"...)` anywhere in the method's closure tree: in "
+                    f"lockstep mode the followers never hear about this "
+                    f"step, so the leader's collective blocks forever "
+                    f"waiting for replicas that were never told to "
+                    f"dispatch — broadcast the step descriptor before "
+                    f"any follower-visible dispatch, or keep the "
+                    f"dispatch out of lockstep scope (docs/ANALYSIS.md, "
+                    f"broadcast-before-dispatch)"
+                ),
+            )
+
+
+RULES = [
+    ProjectRule(
+        id="SPMD1301",
+        family="spmd",
+        summary="branch on host-local state (wall clock / RNG / process "
+        "identity) ahead of a jitted dispatch on the lockstep replay path",
+        check=check_replay_divergence,
+    ),
+    ProjectRule(
+        id="SPMD1302",
+        family="spmd",
+        summary="host-local value used as a jit-specialization-getter "
+        "argument — replicas resolve different compiled variants",
+        check=check_hostlocal_jit_key,
+    ),
+    ProjectRule(
+        id="SPMD1303",
+        family="spmd",
+        summary="engine hot-path method resolves a jit specialization "
+        "with no lockstep broadcast in its closure tree "
+        "(broadcast-before-dispatch invariant)",
+        check=check_unbroadcast_dispatch,
+    ),
+]

@@ -496,6 +496,33 @@ def test_perf_diff_reads_flight_dumps(tmp_path):
     assert [r["metric"] for r in result["regressions"]] == ["step_ms_p50"]
 
 
+def test_perf_diff_watches_analyzer_self_stats(tmp_path):
+    """The bench record carries graftcheck self-stats (bench.py
+    _analyzer_stats): a slower analyzer or suppression creep is a
+    declared regression direction, not ignored drift."""
+    perf_diff = _load_tool("perf_diff")
+    assert perf_diff.METRICS["analyzer_wall_s"] == "up"
+    assert perf_diff.METRICS["analyzer_suppressions"] == "up"
+    base = _bench_record(40.0)
+    new = _bench_record(40.0)
+    base["detail"]["analyzer"] = {
+        "analyzer_wall_s": 10.0, "suppressions": 10, "violations": 0,
+    }
+    new["detail"]["analyzer"] = {
+        "analyzer_wall_s": 15.0, "suppressions": 10, "violations": 0,
+    }
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(new))
+    results, any_regression = perf_diff.diff_files([str(a), str(b)])
+    (_b, _n, result), = results
+    assert any_regression
+    assert [r["metric"] for r in result["regressions"]] == [
+        "analyzer_wall_s"
+    ]
+
+
 # --------------------------------------------------------------------------
 # engine_top: attribution panels + degraded-program flag + cross-run diff
 # --------------------------------------------------------------------------

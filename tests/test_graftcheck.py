@@ -2261,6 +2261,7 @@ def test_every_rule_has_unique_id_and_family():
     assert {
         "jax", "async-blocking", "concurrency", "secret-leak",
         "exception-swallowing", "obs", "race", "inv", "flow",
+        "spmd", "hot",
     } <= families
 
 
@@ -2692,6 +2693,490 @@ def test_flow1004_tn_same_order_and_sequential(tmp_path):
                         self._tables = n
         """,
     }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# execution contexts — the interprocedural layer SPMD/HOT rules scope on
+# --------------------------------------------------------------------------
+
+
+def test_contexts_hot_closure_and_fetch_cut(tmp_path):
+    index = build_index({
+        "serving/engine.py": """
+            from serving.sample import pick
+
+            class Engine:
+                def __init__(self):
+                    self.helper_used_in_ctor = pick
+
+                def _decode_loop(self):
+                    return pick(self._logits)
+
+                def _fetch_chunk(self):
+                    return self._pending
+
+                def offline_report(self):
+                    return pick(self._logits)
+        """,
+        "serving/sample.py": """
+            def pick(logits):
+                return logits
+        """,
+    }, tmp_path)
+    from langstream_tpu.analysis.project import CTX_FETCH, CTX_HOT
+
+    assert CTX_HOT in index.context_of("serving.engine.Engine._decode_loop")
+    # the closure follows resolved calls out of the root...
+    assert CTX_HOT in index.context_of("serving.sample.pick")
+    # ...but a fetch stage is lexically CTX_FETCH (the sanctioned sync
+    # point), and non-root engine methods stay unclassified
+    assert CTX_FETCH in index.context_of("serving.engine.Engine._fetch_chunk")
+    assert index.context_of("serving.engine.Engine.offline_report") == frozenset()
+    assert index.context_of("serving.engine.Engine.__init__") == frozenset()
+
+
+def test_contexts_replay_root_requires_lockstep_follower(tmp_path):
+    index = build_index({
+        "serving/lockstep.py": """
+            class LockstepFollower:
+                def run(self, steps):
+                    return self._replay(steps)
+
+                def _replay(self, steps):
+                    return steps
+
+            class LockstepLeader:
+                def run(self, steps):
+                    return steps
+        """,
+    }, tmp_path)
+    from langstream_tpu.analysis.project import CTX_REPLAY
+
+    assert CTX_REPLAY in index.context_of(
+        "serving.lockstep.LockstepFollower.run"
+    )
+    assert CTX_REPLAY in index.context_of(
+        "serving.lockstep.LockstepFollower._replay"
+    )
+    assert CTX_REPLAY not in index.context_of(
+        "serving.lockstep.LockstepLeader.run"
+    )
+
+
+# --------------------------------------------------------------------------
+# SPMD1301 — host-local branch ahead of a lockstep dispatch
+# --------------------------------------------------------------------------
+
+
+def test_spmd1301_tp_clock_branch_before_dispatch(tmp_path):
+    findings = project_findings({
+        "serving/lockstep.py": """
+            import time
+
+            class LockstepFollower:
+                def run(self, engine, steps):
+                    for step in steps:
+                        if time.monotonic() > step.deadline:
+                            return
+                        fn = engine._decode_fn(step.batch)
+                        fn(step.tokens)
+        """,
+    }, tmp_path)
+    assert "SPMD1301" in [f.rule for f in findings]
+
+
+def test_spmd1301_tp_env_guard_before_dispatch(tmp_path):
+    assert "SPMD1301" in project_ids({
+        "serving/lockstep.py": """
+            import os
+
+            class LockstepFollower:
+                def run(self, engine, steps):
+                    debug = os.getenv("LS_DEBUG")
+                    for step in steps:
+                        if debug:
+                            continue
+                        engine._decode_fn(step.batch)(step.tokens)
+        """,
+    }, tmp_path)
+
+
+def test_spmd1301_tn_lockstep_guard_spelling(tmp_path):
+    assert project_ids({
+        "serving/lockstep.py": """
+            class LockstepFollower:
+                def run(self, engine, steps):
+                    for step in steps:
+                        if step.lockstep_stop:
+                            return
+                        fn = engine._decode_fn(step.batch)
+                        fn(step.tokens)
+        """,
+    }, tmp_path) == []
+
+
+def test_spmd1301_tn_host_local_branch_after_dispatch(tmp_path):
+    # the clock read only shapes control flow AFTER the dispatch (timing
+    # stats): every replica still dispatches identically
+    assert project_ids({
+        "serving/lockstep.py": """
+            import time
+
+            class LockstepFollower:
+                def run(self, engine, steps):
+                    for step in steps:
+                        fn = engine._decode_fn(step.batch)
+                        fn(step.tokens)
+                        if time.monotonic() > step.deadline:
+                            self._late += 1
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# SPMD1302 — host-local jit cache key
+# --------------------------------------------------------------------------
+
+
+def test_spmd1302_tp_clock_derived_getter_key(tmp_path):
+    assert "SPMD1302" in project_ids({
+        "serving/engine.py": """
+            import time
+
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    self._lockstep.broadcast(len(tokens))
+                    fn = self._decode_fn(int(time.time()) % 7)
+                    return fn(tokens)
+        """,
+    }, tmp_path)
+
+
+def test_spmd1302_tn_batch_derived_key(tmp_path):
+    assert project_ids({
+        "serving/engine.py": """
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    self._lockstep.broadcast(len(tokens))
+                    fn = self._decode_fn(len(tokens))
+                    return fn(tokens)
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# SPMD1303 — hot dispatch with no lockstep broadcast in the method tree
+# --------------------------------------------------------------------------
+
+
+def test_spmd1303_tp_unbroadcast_dispatch(tmp_path):
+    assert "SPMD1303" in project_ids({
+        "serving/engine.py": """
+            class TpuServingEngine:
+                def _decode_loop(self, batch):
+                    fn = self._decode_fn(batch.rows)
+                    return fn(batch.tokens)
+        """,
+    }, tmp_path)
+
+
+def test_spmd1303_tn_broadcast_in_method_tree(tmp_path):
+    assert project_ids({
+        "serving/engine.py": """
+            class TpuServingEngine:
+                def _decode_loop(self, batch):
+                    rows = self._lockstep.broadcast(batch.rows)
+                    fn = self._decode_fn(rows)
+                    return fn(batch.tokens)
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# HOT1401 — blocking materialization in the hot loop
+# --------------------------------------------------------------------------
+
+
+def test_hot1401_tp_np_asarray_in_hot_helper(tmp_path):
+    """The seeded acceptance fixture: np.asarray on a device value in a
+    helper the decode loop calls — caught across the call edge."""
+    findings = project_findings({
+        "serving/engine.py": """
+            import jax.numpy as jnp
+
+            from serving.sample import pick
+
+            class TpuServingEngine:
+                def _decode_loop(self):
+                    logits = jnp.zeros((4,))
+                    return pick(logits)
+        """,
+        "serving/sample.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def pick(logits):
+                idx = jnp.argmax(logits)
+                return int(np.asarray(idx))
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["HOT1401"]
+    assert findings[0].path.endswith("serving/sample.py")
+
+
+def test_hot1401_tn_fetch_stage_materializes(tmp_path):
+    """The sanctioned ``_fetch*`` spelling stays a true negative."""
+    assert "HOT1401" not in project_ids({
+        "serving/engine.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            class TpuServingEngine:
+                def _decode_loop(self):
+                    self._pending = jnp.zeros((4,))
+                    return self._fetch_chunk()
+
+                def _fetch_chunk(self):
+                    return np.asarray(self._pending)
+        """,
+    }, tmp_path)
+
+
+def test_hot1401_tn_metadata_reads_are_host_side(tmp_path):
+    # .shape/.dtype never leave host metadata: no materialization
+    assert "HOT1401" not in project_ids({
+        "serving/engine.py": """
+            import jax.numpy as jnp
+
+            class TpuServingEngine:
+                def _decode_loop(self):
+                    logits = jnp.zeros((4,))
+                    rows = logits.shape[0]
+                    return rows
+        """,
+    }, tmp_path)
+
+
+def test_hot1401_tn_outside_hot_context(tmp_path):
+    # same spelling in an unclassified method: not the hot loop's problem
+    assert "HOT1401" not in project_ids({
+        "serving/engine.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            class TpuServingEngine:
+                def offline_report(self):
+                    logits = jnp.zeros((4,))
+                    return np.asarray(logits)
+        """,
+    }, tmp_path)
+
+
+# --------------------------------------------------------------------------
+# HOT1402 — implicit __bool__ on a device value in the hot loop
+# --------------------------------------------------------------------------
+
+
+def test_hot1402_tp_if_on_device_value(tmp_path):
+    assert "HOT1402" in project_ids({
+        "serving/engine.py": """
+            import jax.numpy as jnp
+
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    done = jnp.any(tokens == 0)
+                    if done:
+                        return None
+                    return tokens
+        """,
+    }, tmp_path)
+
+
+def test_hot1402_tn_lockstep_state_guard(tmp_path):
+    """The ``if self._stopping_lockstep:`` spelling is replicated
+    control state, not a device value — stays a true negative."""
+    assert project_ids({
+        "serving/engine.py": """
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    if self._stopping_lockstep:
+                        return None
+                    fn = self._decode_fn(len(tokens))
+                    self._lockstep.broadcast(len(tokens))
+                    return fn(tokens)
+        """,
+    }, tmp_path) == []
+
+
+def test_hot1402_tn_fetch_laundered_bool(tmp_path):
+    assert "HOT1402" not in project_ids({
+        "serving/engine.py": """
+            import jax.numpy as jnp
+
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    done = self._fetch_done(tokens)
+                    if done:
+                        return None
+                    return tokens
+
+                def _fetch_done(self, tokens):
+                    return bool(jnp.any(tokens == 0))
+        """,
+    }, tmp_path)
+
+
+def test_hot1402_tn_is_none_compare_stays_clean(tmp_path):
+    # identity tests read the pointer, not the value: no device sync
+    assert "HOT1402" not in project_ids({
+        "serving/engine.py": """
+            import jax.numpy as jnp
+
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    out = jnp.argmax(tokens)
+                    if out is None:
+                        return None
+                    return out
+        """,
+    }, tmp_path)
+
+
+# --------------------------------------------------------------------------
+# SPMD/HOT x GC001 — suppression hygiene covers the new families
+# --------------------------------------------------------------------------
+
+
+def test_gc001_flags_stale_hot_suppression(tmp_path):
+    findings = project_findings({
+        "serving/engine.py": """
+            import numpy as np
+
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    # graftcheck: disable=HOT1401 host row count only
+                    rows = len(tokens)
+                    return rows
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["GC001"]
+    assert "HOT1401" in findings[0].message
+
+
+def test_spmd_suppression_with_reason_is_honored(tmp_path):
+    assert project_ids({
+        "serving/engine.py": """
+            import time
+
+            class TpuServingEngine:
+                def _decode_loop(self, tokens):
+                    self._lockstep.broadcast(len(tokens))
+                    # graftcheck: disable=SPMD1302 single-host dev mode only
+                    fn = self._decode_fn(int(time.time()) % 7)
+                    return fn(tokens)
+        """,
+    }, tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# GC002 — unknown rule ids in suppressions (full-registry runs only)
+# --------------------------------------------------------------------------
+
+
+def test_gc002_flags_unknown_rule_id_on_full_run(tmp_path):
+    findings = project_findings({
+        "serving/engine.py": """
+            def helper(x):
+                # graftcheck: disable=HOT9999 typo'd id silences nothing
+                return x
+        """,
+    }, tmp_path)
+    assert [f.rule for f in findings] == ["GC002"]
+    assert "HOT9999" in findings[0].message
+
+
+def test_gc002_exempts_framework_ids(tmp_path):
+    # a suppression naming GC000/GC001/GC002 themselves is evaluable and
+    # must not be reported as unknown
+    assert project_ids({
+        "serving/engine.py": """
+            def helper(x):
+                # graftcheck: disable=GC001 kept while refactor lands
+                return x
+        """,
+    }, tmp_path) == []
+
+
+def test_gc002_not_raised_by_per_file_entry_point():
+    # analyze_source runs per-file rules only: it cannot tell a typo
+    # from a project-rule id, so unknown ids stay unevaluated there
+    out = findings(
+        """
+        def helper(x):
+            # graftcheck: disable=RACE9999 maybe a project rule
+            return x
+        """
+    )
+    assert [f.rule for f in out] == []
+
+
+# --------------------------------------------------------------------------
+# --profile — per-rule / per-layer timing
+# --------------------------------------------------------------------------
+
+
+def test_run_profile_reports_layers_and_rules(tmp_path):
+    tree = write_tree({
+        "serving/engine.py": (
+            "class TpuServingEngine:\n"
+            "    def _decode_loop(self, batch):\n"
+            "        rows = self._lockstep.broadcast(batch.rows)\n"
+            "        return self._decode_fn(rows)(batch.tokens)\n"
+        ),
+    }, tmp_path)
+    report = run(
+        ALL_RULES, files=tree, baseline=[], repo_root=tmp_path,
+        project_rules=PROJECT_RULES, profile=True,
+    )
+    assert report.profile is not None
+    layers = report.profile["layers"]
+    assert {"read", "per_file", "index_build", "project_rules", "total"} <= (
+        set(layers)
+    )
+    assert layers["total"] >= 0.0
+    # every rule that ran is attributed — per-file and project families
+    assert {r.id for r in ALL_RULES} <= set(report.profile["rules"])
+    assert {r.id for r in PROJECT_RULES} <= set(report.profile["rules"])
+    # an unprofiled run carries no timing payload
+    plain = run(
+        ALL_RULES, files=tree, baseline=[], repo_root=tmp_path,
+        project_rules=PROJECT_RULES,
+    )
+    assert plain.profile is None
+
+
+def test_cli_profile_flag(tmp_path, capsys):
+    from langstream_tpu.analysis.__main__ import main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean), "--profile"]) == 0
+    out = capsys.readouterr().out
+    assert "profile: layers" in out
+    assert "profile: rules" in out
+    assert "per_file" in out
+
+
+def test_cli_profile_json_payload(tmp_path, capsys):
+    from langstream_tpu.analysis.__main__ import main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean), "--profile", "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert "profile" in payload
+    assert "layers" in payload["profile"]
+    assert "rules" in payload["profile"]
 
 
 # --------------------------------------------------------------------------

@@ -98,6 +98,11 @@ METRICS: dict[str, str] = {
     "partition_storm_completed_fraction": "down",
     "partition_storm_fallbacks": "up",
     "partition_storm_ttft_p99_s": "up",
+    # analyzer self-stats (bench.py _analyzer_stats): the tier-1 gate
+    # pays the analyzer's wall time every run, and a growing suppression
+    # count is escape-hatch creep — both get worse upward
+    "analyzer_wall_s": "up",
+    "analyzer_suppressions": "up",
 }
 
 #: default noise band: relative change below this is never flagged
@@ -228,6 +233,13 @@ def extract_metrics(payload) -> dict:
             ):
                 if storm.get(key) is not None:
                     metrics[key] = storm[key]
+        # analyzer self-stats (bench.py parent side)
+        analyzer = detail.get("analyzer")
+        if isinstance(analyzer, dict):
+            if analyzer.get("analyzer_wall_s") is not None:
+                metrics["analyzer_wall_s"] = analyzer["analyzer_wall_s"]
+            if analyzer.get("suppressions") is not None:
+                metrics["analyzer_suppressions"] = analyzer["suppressions"]
         _journey_metrics(detail.get("journey_segments"), metrics)
         for leg in detail.values():
             if isinstance(leg, dict):
