@@ -62,7 +62,7 @@ Env knobs: BENCH_MODEL (tiny|llama-1b|llama3-8b|...), BENCH_SLOTS,
 BENCH_DECODE_CHUNK, BENCH_QUANTIZE (int8|none), BENCH_KV (dense|paged),
 BENCH_KV_QUANT (int8|none), BENCH_GATEWAY=0 / BENCH_PAGED=0 /
 BENCH_PREFIX=0 / BENCH_KV_INT8=0 / BENCH_SPEC=0 / BENCH_QOS=0 /
-BENCH_OOM=0 / BENCH_PARTITION=0 to skip phases.
+BENCH_OOM=0 / BENCH_PARTITION=0 / BENCH_STREAM=0 to skip phases.
 
 Offline note: weights are random-init (no checkpoint files in this
 environment) — identical FLOPs/bytes to trained weights, so throughput is
@@ -138,6 +138,7 @@ RUN_SPEC = os.environ.get("BENCH_SPEC", "1") != "0"
 RUN_QOS = os.environ.get("BENCH_QOS", "1") != "0"
 RUN_OOM = os.environ.get("BENCH_OOM", "1") != "0"
 RUN_PARTITION = os.environ.get("BENCH_PARTITION", "1") != "0"
+RUN_STREAM = os.environ.get("BENCH_STREAM", "1") != "0"
 DEGRADED = os.environ.get("BENCH_DEGRADED") == "1"
 
 PROMPT = "Benchmarking the TPU serving engine end to end. " * 4
@@ -581,6 +582,13 @@ def run_bench() -> dict:
     # mid-handoff; records re-handoffs, breaker opens, local-decode
     # fallbacks, deadline sheds, and the zero-silent-loss ledger
     optional("partition_storm", RUN_PARTITION,
+             budget_cap=min(PHASE_BUDGET_S, 240))
+    # streaming-delivery phase (docs/OBSERVABILITY.md Streaming): N
+    # streaming WS clients against the TBT-instrumented engine; records
+    # client-observed TBT p50/p99 per class, first-frame TTFB, stall
+    # count, and the disconnect-burst cancellation ledger (every
+    # dropped stream's decode slot reclaimed at a chunk boundary)
+    optional("gateway_stream", RUN_STREAM,
              budget_cap=min(PHASE_BUDGET_S, 240))
 
     return _record(headline, detail)
@@ -1178,6 +1186,13 @@ async def _child_phase(phase: str) -> dict:
 
         return await _phase(
             run_partition_storm_phase(), budget_s=min(PHASE_BUDGET_S, 240)
+        )
+    if phase == "gateway_stream":
+        sys.path.insert(0, os.path.join(os.path.dirname(_BENCH_PATH), "tools"))
+        from gateway_bench import run_stream_phase
+
+        return await _phase(
+            run_stream_phase(), budget_s=min(PHASE_BUDGET_S, 240)
         )
     raise ValueError(f"unknown bench phase {phase!r}")
 

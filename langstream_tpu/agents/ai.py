@@ -15,6 +15,7 @@ continuous-batching decode loop on this pod's chips".
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import math
@@ -82,7 +83,34 @@ class _AIAgentBase(SingleRecordProcessor):
                 # the engine's admission gate enforces it 504-shaped, so
                 # the same deadline the client saw bounds the device work
                 options["deadline"] = deadline
+            stream_id = headers.get("langstream-stream-id")
+            if stream_id:
+                # the gateway's per-message stream identity: the engine
+                # registers the request future under this key so a client
+                # disconnect at the gateway cancels the decode and frees
+                # the slot (serving/streaming.py)
+                options["stream-key"] = stream_id
         return options
+
+    @staticmethod
+    def _stream_cancelled(record: Record | None) -> bool:
+        """Classify a ``CancelledError`` out of the completion call:
+        True means the client disconnected and the gateway cancelled this
+        record's stream-key (serving/streaming.py) — the record is
+        TERMINAL (the engine already reclaimed the slot and logged
+        ``stream-cancel``), so the agent commits it with zero results
+        instead of letting the cancel fall through ``composite._done``'s
+        cancelled branch, which would leak the record as forever-inflight.
+        False means shutdown (or an unrelated cancel): keep propagating.
+        """
+        if record is None:
+            return False
+        key = record.header_map().get("langstream-stream-id")
+        if not key:
+            return False
+        from langstream_tpu.serving.streaming import STREAMS
+
+        return STREAMS.consume_cancelled(str(key))
 
 
 class _StreamWriter:
@@ -171,9 +199,14 @@ class ChatCompletionsAgent(_AIAgentBase):
                 int(self.configuration.get("min-chunks-per-message", 20)),
             )
             consumer = writer.on_chunk
-        result = await self.provider.get_completions_service(
-            self.configuration
-        ).chat_completions(messages, self._options(record), consumer)
+        try:
+            result = await self.provider.get_completions_service(
+                self.configuration
+            ).chat_completions(messages, self._options(record), consumer)
+        except asyncio.CancelledError:
+            if self._stream_cancelled(record):
+                return []  # client disconnect: terminal, commit quietly
+            raise
 
         completion_field = self.configuration.get("completion-field")
         if completion_field:
@@ -230,9 +263,14 @@ class TextCompletionsAgent(_AIAgentBase):
                 int(self.configuration.get("min-chunks-per-message", 20)),
             )
             consumer = writer.on_chunk
-        result = await self.provider.get_completions_service(
-            self.configuration
-        ).text_completions(prompt, self._options(record), consumer)
+        try:
+            result = await self.provider.get_completions_service(
+                self.configuration
+            ).text_completions(prompt, self._options(record), consumer)
+        except asyncio.CancelledError:
+            if self._stream_cancelled(record):
+                return []  # client disconnect: terminal, commit quietly
+            raise
         completion_field = self.configuration.get("completion-field", "value")
         if completion_field == "value":
             mutable.value = result.text

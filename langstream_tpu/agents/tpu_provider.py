@@ -54,6 +54,16 @@ Resource shape (``configuration.yaml``):
                                        # rates; `alert` flight events +
                                        # slo_burn_rate gauges on fast burn —
                                        # docs/OBSERVABILITY.md Health & SLO
+          streaming: false             # per-chunk token delivery with TBT
+                                       # (time-between-tokens) telemetry:
+                                       # stream-emit/stall/cancel flight
+                                       # events, per-class tbt_seconds
+                                       # histograms, stats()["streaming"] —
+                                       # off keeps every default surface
+                                       # byte-identical
+          stream-stall-s: 2.0          # inter-emit gap that counts as a
+                                       # stall for classes without a
+                                       # tbt-p99-s target
 """
 
 from __future__ import annotations
@@ -139,6 +149,28 @@ class _StreamAdapter:
             self.index += 1
 
 
+class _ChunkAdapter:
+    """Bridges engine on_chunk callbacks to the agents' chunk consumers.
+
+    The streaming-configured engine already detokenised the delta,
+    held back partial UTF-8 sequences and possible stop-prefix tails,
+    and truncated at stop matches (``_stream_text``) — so this adapter
+    only re-shapes ``(new_ids, new_text, is_final)`` into :class:`Chunk`
+    calls. Using on_chunk instead of on_token is what feeds the engine's
+    TBT telemetry: each delivery is timestamped at the decode-chunk
+    safe point and lands in the inter-token-interval digest."""
+
+    def __init__(self, consumer: StreamingChunksConsumer):
+        self.consumer = consumer
+        self.index = 0
+
+    async def on_chunk(self, new_ids: list, new_text: str, is_final: bool) -> None:
+        result = self.consumer(Chunk(new_text, self.index, last=is_final))
+        if hasattr(result, "__await__"):
+            await result
+        self.index += 1
+
+
 class TpuCompletionsService(CompletionsService):
     def __init__(self, engine: TpuServingEngine):
         self.engine = engine
@@ -149,6 +181,23 @@ class TpuCompletionsService(CompletionsService):
         options: dict[str, Any],
         consumer: StreamingChunksConsumer | None,
     ) -> CompletionResult:
+        if consumer is not None and self.engine.config.streaming:
+            # streaming-configured engine: deliver at the chunk safe
+            # point (TBT-instrumented); the engine does the holdback
+            result = await self.engine.generate(
+                prompt,
+                options,
+                on_chunk=_ChunkAdapter(consumer).on_chunk,
+            )
+            return CompletionResult(
+                text=result["text"],
+                num_prompt_tokens=result["num_prompt_tokens"],
+                num_completion_tokens=result["num_completion_tokens"],
+                finish_reason=result["finish_reason"],
+                ttft_s=result.get("ttft", 0.0),
+                queue_wait_s=result.get("queue_wait", 0.0),
+                prefill_s=result.get("prefill", 0.0),
+            )
         adapter = (
             _StreamAdapter(
                 self.engine.tokenizer, consumer, stop=options.get("stop")

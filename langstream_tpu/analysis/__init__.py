@@ -53,6 +53,10 @@ HOT1401/2   hot-path host syncs with device-taint evidence: blocking
             materialization (np.asarray / .item() / float() / .tolist())
             and implicit __bool__ on a device value inside the hot-loop
             context, outside the sanctioned fetch stages
+STRM1501    streaming emit-path discipline: device syncs, blocking I/O,
+            or lock acquisition in the per-token chunk-delivery path
+            (engine burst-flush delivery, TBT digest updates, gateway
+            frame-writer loops) — waits there are the client's TBT
 ==========  ==============================================================
 
 RACE/INV/FLOW/SPMD/HOT are **project rules**: they run over a
@@ -106,6 +110,7 @@ from langstream_tpu.analysis.rules_qos import RULES as _QOS_RULES
 from langstream_tpu.analysis.rules_race import RULES as _RACE_RULES
 from langstream_tpu.analysis.rules_secrets import RULES as _SEC_RULES
 from langstream_tpu.analysis.rules_spmd import RULES as _SPMD_RULES
+from langstream_tpu.analysis.rules_strm import RULES as _STRM_RULES
 
 ALL_RULES: list[Rule] = [
     *_JAX_RULES,
@@ -120,6 +125,7 @@ ALL_RULES: list[Rule] = [
     *_PFX_RULES,
     *_FLT_RULES,
     *_NET_RULES,
+    *_STRM_RULES,
 ]
 
 #: whole-program rules (run over the ProjectIndex, not per file)

@@ -459,6 +459,55 @@ class PrefixStore:
 ))
 
 _register(RuleExample(
+    rule="STRM1501",
+    tp={
+        "langstream_tpu/gateway/server.py": '''\
+import jax
+
+class GatewayServer:
+    async def _stream_push_loop(self, ws, reader, active):
+        while not ws.closed:
+            records = await reader.read(timeout=0.5)
+            for record in records:
+                # a lock inside the frame-writer loop: one slow client
+                # head-of-line blocks every stream on this connection
+                with self._frames_lock:
+                    self._frame_count += 1
+                # a device sync per frame stalls the emit path against
+                # the device — the wait lands in the client's TBT
+                jax.block_until_ready(record.value)
+                await ws.send_json({"record": record.value})
+''',
+    },
+    tn={
+        "langstream_tpu/gateway/server.py": '''\
+class GatewayServer:
+    async def _stream_push_loop(self, ws, reader, active):
+        # the sanctioned shape: reads, header matches, frame writes —
+        # counter bumps are GIL-atomic, no locks, nothing that waits
+        while not ws.closed:
+            records = await reader.read(timeout=0.5)
+            for record in records:
+                sid = record.header_map().get("langstream-stream-id")
+                if sid is None or sid not in active:
+                    continue
+                await ws.send_json(self._record_json(record))
+''',
+    },
+    fix=(
+        "Keep every per-token delivery — the engine's burst-flush chunk "
+        "delivery, TbtDigest.add, the gateway frame-writer loops — to "
+        "container ops, digest bumps, and frame writes. Per-emit "
+        "telemetry is the bounded interval digest (binary search + "
+        "counter bumps), never a lock-guarded structure; anything that "
+        "can wait (device syncs, file/socket I/O beyond the client "
+        "frame write itself) moves off the emit path. The cancel "
+        "registry's small lock is fine — it runs per disconnect, not "
+        "per token (docs/OBSERVABILITY.md Streaming)."
+    ),
+))
+
+_register(RuleExample(
     rule="FLEET601",
     tp={
         "langstream_tpu/controlplane/autoscaler.py": '''\

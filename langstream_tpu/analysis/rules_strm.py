@@ -1,0 +1,130 @@
+"""Streaming-delivery rules: per-token emit-path discipline (STRM1501).
+
+The streaming plane (``serving/streaming.py``, the engine's chunk
+delivery, the gateway's frame writers — docs/OBSERVABILITY.md
+Streaming) runs once per decode chunk per active stream: every
+delivery sits directly between a committed token and the client's
+screen, so any host-side wait there IS the client's time-between-
+tokens. STRM1501 is OBS504's wait-free shape over that plane: **a
+device sync, blocking I/O, or lock acquisition on the per-token emit
+path** is a red gate —
+
+- the engine's emit callback invocation site (``_flush_emits`` /
+  ``_deliver_chunk``) runs at the burst-flush safe point: a wait there
+  stalls the NEXT dispatch for every slot, not just the streaming one,
+  and lands in every client's TBT digest as a stall the operator will
+  chase into the device;
+- the TBT digest is updated inline per emit — it exists precisely
+  because the raw interval list is unbounded, and its ``add`` must stay
+  counter bumps + binary search or the telemetry becomes the stall;
+- the gateway's frame-writer loops (WS stream pusher, SSE delivery,
+  chat push) fan chunk records out to sockets: a lock or blocking call
+  there turns one slow client into head-of-line blocking for the whole
+  connection's streams.
+
+The :class:`StreamCancelRegistry` is deliberately absent from the
+scope: registration happens once per request at ``generate()`` time
+and cancellation on the disconnect path — neither is per-token, and
+its small lock is the sanctioned cross-thread handoff. Nested defs are
+exempt everywhere (deferred work — the same exemption OBS503/PFX801
+grant).
+
+Scope: the named emit-path functions below — the engine's chunk
+delivery surface, the TBT digest's per-emit methods, and the gateway's
+frame writers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule
+from langstream_tpu.analysis.rules_obs import _waitfree_violations
+
+#: the streaming plane's per-token paths, per file. The cancel registry
+#: (`register`/`cancel`/`unregister`) is deliberately absent: those run
+#: per request / per disconnect, not per token, and their lock is the
+#: sanctioned cross-thread handoff.
+_STRM_FUNCS_BY_FILE = {
+    "langstream_tpu/serving/engine.py": {
+        "_emit_token",
+        "_flush_emits",
+        "_deliver_chunk",
+        "_stream_text",
+        "_final_text",
+        "_stream_stall_threshold",
+        "_stream_tbt_hist",
+        "streaming_section",
+    },
+    "langstream_tpu/serving/streaming.py": {
+        "add",
+        "quantile",
+        "summary",
+    },
+    "langstream_tpu/gateway/server.py": {
+        "_stream_push_loop",
+        "_sse_produce",
+        "_chat_push_loop",
+        "_record_json",
+    },
+}
+
+
+def _emit_path_functions(mod: Module) -> Iterator[ast.AST]:
+    named: set[str] = set()
+    for prefix, names in _STRM_FUNCS_BY_FILE.items():
+        if prefix in mod.path or mod.path.endswith(prefix):
+            named = names
+            break
+    if not named:
+        return
+    nested_fns: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if inner is not node and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested_fns.add(id(inner))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(node) in nested_fns:
+            continue
+        if node.name in named:
+            yield node
+
+
+def check_blocking_on_emit_path(mod: Module) -> Iterator[Finding]:
+    for fn in _emit_path_functions(mod):
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "STRM1501",
+                node,
+                f"{kind} {offender} on the per-token emit path "
+                f"(`{fn.name}`): every streaming delivery sits between a "
+                f"committed token and the client's screen, so a wait "
+                f"here IS the client's time-between-tokens — the engine "
+                f"side runs at the burst-flush safe point (stalling the "
+                f"next dispatch for every slot) and the gateway frame "
+                f"writers fan out to sockets (one slow wait head-of-line "
+                f"blocks the connection); keep deliveries to container "
+                f"ops, digest bumps, and frame writes, and push anything "
+                f"that can wait off-path (docs/OBSERVABILITY.md "
+                f"Streaming)",
+            )
+
+
+RULES = [
+    Rule(
+        id="STRM1501",
+        family="strm",
+        summary="device sync, blocking I/O, or lock acquisition on the "
+        "per-token streaming emit path (engine chunk delivery at the "
+        "burst-flush safe point, TBT digest updates, gateway frame-"
+        "writer loops — every wait there lands in the client's "
+        "time-between-tokens)",
+        check=check_blocking_on_emit_path,
+    ),
+]

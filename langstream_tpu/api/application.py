@@ -200,6 +200,11 @@ class Gateway:
     chat_options: dict[str, Any] = field(default_factory=dict)
     service_options: dict[str, Any] = field(default_factory=dict)
     events_topic: str | None = None
+    # topic the AI agents write per-chunk stream records to: a produce
+    # gateway with a stream-topic can serve incremental frames back to
+    # streaming-flagged clients (``option:streaming=true``); absent, the
+    # produce path is byte-identical to the pre-streaming gateway
+    stream_topic: str | None = None
 
     @classmethod
     def from_dict(cls, data: dict[str, Any]) -> "Gateway":
@@ -230,6 +235,10 @@ class Gateway:
             chat_options=chat_options,
             service_options=data.get("service-options") or {},
             events_topic=data.get("events-topic"),
+            stream_topic=(
+                data.get("stream-topic")
+                or (data.get("produce-options") or {}).get("stream-topic")
+            ),
         )
 
 

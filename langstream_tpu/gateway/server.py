@@ -24,6 +24,7 @@ import asyncio
 import json
 import logging
 import time
+import uuid
 from typing import Any
 
 from aiohttp import WSMsgType, web
@@ -46,6 +47,7 @@ from langstream_tpu.serving.prefixstore import (
     prefix_digest_for_text,
 )
 from langstream_tpu.serving.journey import JOURNEYS
+from langstream_tpu.serving.streaming import STREAMS
 from langstream_tpu.serving.qos import (
     QosSpec,
     TenantLimiter,
@@ -60,6 +62,15 @@ QOS_TENANT_HEADER = "langstream-qos-tenant"
 QOS_PRIORITY_HEADER = "langstream-qos-priority"
 #: response header naming the throttled tenant on a 429
 THROTTLED_HEADER = "langstream-throttled"
+#: per-message stream identity stamped on streaming-flagged produces —
+#: the AI agents forward it into engine options as ``stream-key``, the
+#: per-chunk stream records carry it back for frame matching, and a
+#: client disconnect cancels the engine future registered under it
+#: (serving/streaming.py, docs/OBSERVABILITY.md Streaming)
+STREAM_ID_HEADER = "langstream-stream-id"
+#: header the agents' stream writer sets ``true`` on a stream's final
+#: record (agents/ai.py ``_StreamWriter``)
+STREAM_LAST_HEADER = "stream-last-message"
 
 
 class GatewayRegistry:
@@ -653,6 +664,23 @@ class GatewayServer:
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
         producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
         await producer.start()
+        stream_on = (
+            self._stream_requested(options) and gateway.stream_topic is not None
+        )
+        active_streams: set[str] = set()
+        stream_reader = None
+        stream_pusher = None
+        if stream_on:
+            # the chunk reader goes live BEFORE any produce is accepted:
+            # started after a write, it could miss the first frames of a
+            # fast stream (read position is `latest`)
+            stream_reader = runtime.create_reader(
+                {"topic": gateway.stream_topic}, initial_position="latest"
+            )
+            await stream_reader.start()
+            stream_pusher = asyncio.ensure_future(
+                self._stream_push_loop(ws, stream_reader, active_streams)
+            )
         inject = {
             **self._mapped_headers(gateway.produce_headers, params, principal),
             **self._qos_headers(limiter, params, principal),
@@ -694,6 +722,14 @@ class GatewayServer:
                             }
                         )
                         continue
+                    stream_id = None
+                    if stream_on:
+                        # per-message, not per-connection: one socket
+                        # can carry many concurrent streams, each its
+                        # own engine-side cancellation handle
+                        stream_id = str(uuid.uuid4())
+                        headers[STREAM_ID_HEADER] = stream_id
+                        active_streams.add(stream_id)
                     self._journey_produce(headers)
                     record = make_record(
                         value=payload.get("value"),
@@ -702,18 +738,60 @@ class GatewayServer:
                     )
                     with span:
                         await producer.write(record)
-                    await ws.send_json(
-                        {"status": "OK", "trace": headers[TRACE_HEADER]}
-                    )
+                    ack = {"status": "OK", "trace": headers[TRACE_HEADER]}
+                    if stream_id is not None:
+                        ack["stream-id"] = stream_id
+                    await ws.send_json(ack)
                 except Exception as e:
                     await ws.send_json({"status": "BAD_REQUEST", "reason": str(e)})
         finally:
+            if stream_pusher is not None:
+                stream_pusher.cancel()
+            if stream_reader is not None:
+                await stream_reader.close()
+            for sid in active_streams:
+                # disconnect IS cancellation: cancel the engine future
+                # registered under each still-open stream so the decode
+                # slot frees at the next chunk boundary (a completed
+                # stream already left the registry — no-op)
+                STREAMS.cancel(sid)
             await producer.close()
             await runtime.close()
             await self._emit_event(
                 gateway, streaming, "ClientDisconnected", tenant, app_id
             )
         return ws
+
+    @staticmethod
+    def _stream_requested(options: dict[str, str]) -> bool:
+        """``option:streaming`` truthiness (query options are strings)."""
+        return str(options.get("streaming", "")).lower() in (
+            "1", "true", "yes", "on",
+        )
+
+    async def _stream_push_loop(self, ws, reader, active: set) -> None:
+        """Forward per-chunk stream records to one streaming-flagged
+        produce socket. Frame-writer discipline (graftcheck STRM1501):
+        the loop body is reads, header matches, and frame writes only —
+        no locks, no blocking I/O, no host syncs — because every stall
+        here lands directly in the client's time-between-tokens."""
+        try:
+            while not ws.closed:
+                records = await reader.read(timeout=0.5)
+                for record in records:
+                    headers = record.header_map()
+                    sid = headers.get(STREAM_ID_HEADER)
+                    if sid is None or sid not in active:
+                        continue
+                    await ws.send_json(self._record_json(record))
+                    if str(headers.get(STREAM_LAST_HEADER)).lower() == "true":
+                        # completed stream: nothing to cancel on
+                        # disconnect anymore
+                        active.discard(sid)
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("stream push loop failed")
 
     async def _http_produce(self, request: web.Request) -> web.Response:
         tenant, app_id, gateway, streaming, params, options, credentials = (
@@ -749,6 +827,12 @@ class GatewayServer:
                 )
         self._journey_produce(headers)
         runtime = TopicConnectionsRuntimeRegistry.get_runtime(streaming)
+        if self._stream_requested(options) and gateway.stream_topic is not None:
+            # SSE variant: hold the response open and deliver each chunk
+            # record as a `data:` frame (closes the runtime itself)
+            return await self._sse_produce(
+                request, gateway, runtime, payload, headers, span
+            )
         producer = runtime.create_producer("gateway-produce", {"topic": gateway.topic})
         await producer.start()
         try:
@@ -767,6 +851,88 @@ class GatewayServer:
             {"status": "OK", "trace": headers[TRACE_HEADER]},
             headers={TRACE_HEADER: headers[TRACE_HEADER]},
         )
+
+    async def _sse_produce(
+        self,
+        request: web.Request,
+        gateway: Gateway,
+        runtime,
+        payload: dict[str, Any],
+        headers: dict[str, Any],
+        span,
+    ) -> web.StreamResponse:
+        """The SSE variant of the HTTP produce route: one POST with
+        ``option:streaming=true`` against a stream-topic gateway holds
+        the response open (``text/event-stream``) and delivers each
+        chunk record as a ``data:`` frame. Heartbeat comments go out on
+        idle polls so a gone client surfaces as a write failure — which
+        maps to cancellation of the engine future, exactly like a WS
+        disconnect. Frame-writer discipline applies (graftcheck
+        STRM1501): the delivery loop is reads and frame writes only."""
+        stream_id = str(uuid.uuid4())
+        headers[STREAM_ID_HEADER] = stream_id
+        response = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                TRACE_HEADER: headers[TRACE_HEADER],
+                STREAM_ID_HEADER: stream_id,
+            },
+        )
+        await response.prepare(request)
+        # the chunk reader goes live BEFORE the produce: started after,
+        # it could miss the first frames of a fast stream (`latest`)
+        reader = runtime.create_reader(
+            {"topic": gateway.stream_topic}, initial_position="latest"
+        )
+        await reader.start()
+        producer = runtime.create_producer(
+            "gateway-produce", {"topic": gateway.topic}
+        )
+        await producer.start()
+        try:
+            with span:
+                await producer.write(
+                    make_record(
+                        value=payload.get("value"),
+                        key=payload.get("key"),
+                        headers=headers,
+                    )
+                )
+            done = False
+            while not done:
+                records = await reader.read(timeout=0.5)
+                if not records:
+                    # comment frame: keeps intermediaries from timing
+                    # the idle stream out AND probes the socket — a dead
+                    # client raises here instead of leaking the slot
+                    await response.write(b": keep-alive\n\n")
+                    continue
+                for record in records:
+                    rec_headers = record.header_map()
+                    if rec_headers.get(STREAM_ID_HEADER) != stream_id:
+                        continue
+                    frame = json.dumps(self._record_json(record))
+                    await response.write(f"data: {frame}\n\n".encode())
+                    if str(rec_headers.get(STREAM_LAST_HEADER)).lower() == "true":
+                        done = True
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler on client disconnect:
+            # disconnect IS cancellation (no-op for a finished stream)
+            STREAMS.cancel(stream_id)
+            raise
+        except ConnectionResetError:
+            STREAMS.cancel(stream_id)
+        finally:
+            await producer.close()
+            await reader.close()
+            await runtime.close()
+        try:
+            await response.write_eof()
+        except ConnectionResetError:
+            pass
+        return response
 
     # ------------------------------------------------------------------
     # consume
@@ -855,10 +1021,16 @@ class GatewayServer:
             **self._mapped_headers(gateway.produce_headers, params, principal),
             **self._qos_headers(limiter, params, principal),
         }
+        # streaming-flagged chat sockets get per-message stream ids: the
+        # answers topic already carries the agent's chunk records back
+        # (headers copy through the stream writer), so frames need no
+        # extra reader — the id exists for disconnect-as-cancellation
+        chat_stream = self._stream_requested(options)
+        active_streams: set[str] = set()
         # the same headers injected on produce are the consume-side filters
         # (that's how chat correlates answers to this session)
         pusher = asyncio.ensure_future(
-            self._chat_push_loop(ws, reader, inject)
+            self._chat_push_loop(ws, reader, inject, active_streams)
         )
         try:
             async for msg in ws:
@@ -895,6 +1067,11 @@ class GatewayServer:
                             }
                         )
                         continue
+                    stream_id = None
+                    if chat_stream:
+                        stream_id = str(uuid.uuid4())
+                        headers[STREAM_ID_HEADER] = stream_id
+                        active_streams.add(stream_id)
                     self._journey_produce(headers)
                     with span:
                         await producer.write(
@@ -904,13 +1081,19 @@ class GatewayServer:
                                 headers=headers,
                             )
                         )
-                    await ws.send_json(
-                        {"status": "OK", "trace": headers[TRACE_HEADER]}
-                    )
+                    ack = {"status": "OK", "trace": headers[TRACE_HEADER]}
+                    if stream_id is not None:
+                        ack["stream-id"] = stream_id
+                    await ws.send_json(ack)
                 except Exception as e:
                     await ws.send_json({"status": "BAD_REQUEST", "reason": str(e)})
         finally:
             pusher.cancel()
+            for sid in active_streams:
+                # disconnect IS cancellation: free the decode slot of
+                # every stream still open on this socket (no-op for
+                # completed streams — they left the registry)
+                STREAMS.cancel(sid)
             await producer.close()
             await reader.close()
             await runtime.close()
@@ -919,7 +1102,13 @@ class GatewayServer:
             )
         return ws
 
-    async def _chat_push_loop(self, ws, reader, inject: dict[str, Any]) -> None:
+    async def _chat_push_loop(
+        self,
+        ws,
+        reader,
+        inject: dict[str, Any],
+        active: set | None = None,
+    ) -> None:
         try:
             while not ws.closed:
                 records = await reader.read(timeout=0.5)
@@ -927,6 +1116,13 @@ class GatewayServer:
                     headers = record.header_map()
                     if all(headers.get(k) == v for k, v in inject.items()):
                         await ws.send_json(self._record_json(record))
+                        if (
+                            active
+                            and str(headers.get(STREAM_LAST_HEADER)).lower()
+                            == "true"
+                        ):
+                            # completed stream: drop its cancel handle
+                            active.discard(headers.get(STREAM_ID_HEADER))
         except (asyncio.CancelledError, ConnectionResetError):
             pass
         except Exception:

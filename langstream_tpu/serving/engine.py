@@ -95,7 +95,13 @@ from langstream_tpu.serving.handoff import (
 )
 from langstream_tpu.serving.journal import RequestJournal, request_entry
 from langstream_tpu.serving.journey import JOURNEYS
-from langstream_tpu.serving.health import EngineWatchdog, SloSpec, SloTracker
+from langstream_tpu.serving.health import (
+    EngineWatchdog,
+    SloObjective,
+    SloSpec,
+    SloTracker,
+)
+from langstream_tpu.serving.streaming import STREAMS, TbtDigest
 from langstream_tpu.serving.prefixstore import PrefixStore, PrefixStoreSpec
 from langstream_tpu.serving.profiling import (
     ProfilerHooks,
@@ -287,6 +293,22 @@ class ServingConfig:
     # queue-wait quantiles, shed rate, and availability, evaluated
     # engine-side with multi-window burn rates; None disables tracking
     slo: SloSpec | None = None
+    # streaming token delivery + TBT plane (docs/OBSERVABILITY.md
+    # Streaming & TBT): False (the default) keeps every pre-streaming
+    # surface pinned bit for bit — no new flight-event kinds, no new
+    # Prometheus series, no stats() section. True activates the
+    # per-chunk telemetry around on_chunk consumers: the bounded TBT
+    # digest into request_timings, stream-emit/stream-stall/
+    # stream-cancel flight events, stats()["streaming"], per-QoS-class
+    # langstream_stream_tbt_seconds histograms, and (with qos classes
+    # declaring tbt-p99-s) per-class burn trackers behind the health()
+    # tbt_burn predicate. Chunk DELIVERY itself needs no flag — the
+    # flag gates observability, not the API.
+    streaming: bool = False
+    # stall line (seconds between chunk emissions) for classes without
+    # their own tbt-p99-s target: an inter-emit gap past this records a
+    # stream-stall flight event
+    stream_stall_s: float = 2.0
     # disaggregated prefill/decode pools (docs/DISAGG.md): "combined"
     # (default) serves both phases in one engine — every pre-existing
     # behavior, bit for bit. "prefill" runs admission/prefill (chunked,
@@ -379,6 +401,8 @@ class ServingConfig:
             "pipeline": self.pipeline,
             "wedge-window-s": self.wedge_window_s,
             "slo": self.slo.to_dict() if self.slo is not None else None,
+            "streaming": self.streaming,
+            "stream-stall-s": self.stream_stall_s,
             "shrink-fraction": self.shrink_fraction,
             "shrink-recovery-s": self.shrink_recovery_s,
             "faults": [p.to_dict() for p in self.faults],
@@ -459,6 +483,10 @@ class ServingConfig:
                 d.get("wedge-window-s", d.get("wedge_window_s", 60.0))
             ),
             slo=SloSpec.from_dict(d.get("slo")),
+            streaming=_parse_bool(d.get("streaming", False)),
+            stream_stall_s=float(
+                d.get("stream-stall-s", d.get("stream_stall_s", 2.0))
+            ),
             shrink_fraction=float(
                 d.get("shrink-fraction", d.get("shrink_fraction", 0.125))
             ),
@@ -566,6 +594,24 @@ class _Request:
     # on the request's path can compare against. None = no deadline,
     # every check one attribute test (the default-config pin).
     deadline: "float | None" = None
+    # streaming chunk delivery (docs/OBSERVABILITY.md Streaming & TBT):
+    # on_chunk(new_token_ids, new_text, is_final) fires once per decode
+    # chunk at the _flush_emits safe point (sync or async). The sent
+    # counters drive delta computation (chunks tile the final text
+    # byte-exactly); stream_tbt is the bounded inter-emit digest (only
+    # allocated on streaming-configured engines); stream_key is the
+    # gateway's langstream-stream-id, the handle disconnect-cancellation
+    # grabs.
+    on_chunk: "Callable[[list, str, bool], Any] | None" = None
+    stream_key: "str | None" = None
+    stream_sent_tokens: int = 0
+    stream_sent_chars: int = 0
+    stream_first_emit: "float | None" = None
+    stream_last_emit: "float | None" = None
+    stream_emits: int = 0
+    stream_stalls: int = 0
+    stream_closed: bool = False
+    stream_tbt: "TbtDigest | None" = None
 
     @property
     def context_tokens(self) -> list[int]:
@@ -936,6 +982,51 @@ class TpuServingEngine:
                     f"slow-window error budget remaining for the "
                     f"{objective.name} objective (1 - slow burn; negative "
                     f"= overspent)",
+                )
+        # streaming + TBT plane (docs/OBSERVABILITY.md Streaming & TBT):
+        # empty/zero on non-streaming engines — the default Prometheus
+        # scrape surface and flight-event set stay pinned bit for bit.
+        # Per-class digests/histograms are created lazily on the first
+        # finished stream of each class (classes are clamped to the QoS
+        # vocabulary, so the maps stay bounded); the per-class burn
+        # trackers exist only for classes declaring tbt-p99-s.
+        self.stream_emits_total = 0
+        self.stream_stalls_total = 0
+        self.stream_cancels_total = 0
+        self.stream_reclaims_total = 0
+        self._stream_tbt_by_class: dict[str, TbtDigest] = {}
+        self._m_tbt_hist: dict[str, Any] = {}
+        self._stream_slo: dict[str, SloTracker] = {}
+        if config.streaming and config.qos is not None:
+            for policy in config.qos.classes:
+                if policy.tbt_p99_s is None:
+                    continue
+                # one single-objective tracker per declaring class: the
+                # same multi-window burn machinery TTFT uses, windowed
+                # like the engine's own slo section when one is declared
+                self._stream_slo[policy.name] = SloTracker(
+                    SloSpec(
+                        objectives=(
+                            SloObjective(
+                                "tbt", 0.99, policy.tbt_p99_s * 1000.0
+                            ),
+                        ),
+                        fast_window_s=(
+                            config.slo.fast_window_s
+                            if config.slo is not None
+                            else 300.0
+                        ),
+                        slow_window_s=(
+                            config.slo.slow_window_s
+                            if config.slo is not None
+                            else 3600.0
+                        ),
+                        fast_burn=(
+                            config.slo.fast_burn
+                            if config.slo is not None
+                            else 14.4
+                        ),
+                    )
                 )
         # shapes already compiled (jit-variant keys AND prefill bucket/row
         # shapes): a miss here is a fresh XLA compile — tens of seconds on
@@ -2173,9 +2264,22 @@ class TpuServingEngine:
         stall evidence."""
         queued = self.scheduler.qsize()
         occupancy = sum(1 for s in self.slots if not s.free)
+        # streaming TBT burn predicate (wait-free: committed-alert dict
+        # reads): classes whose tbt-p99-s error budget is fast-burning
+        # degrade the engine exactly like the watchdog's own predicates
+        tbt_burn = [
+            name
+            for name, tracker in self._stream_slo.items()
+            if tracker.alerting.get("tbt")
+        ]
         verdict = self.watchdog.evaluate(
             queued=queued,
             occupancy=occupancy,
+            extra_reasons=tuple(
+                f"tbt burn-rate alert: class {name!r} is burning its "
+                f"tbt-p99-s error budget at page rate"
+                for name in sorted(tbt_burn)
+            ),
             samples=self.flight.recent(240),
             # 256, not the display tail's 64: the shrink-pressure
             # predicate compares pool-shrink events across a whole
@@ -2208,7 +2312,7 @@ class TpuServingEngine:
             and verdict["state"] != "wedged"
             and not self._draining
         )
-        return {
+        out = {
             "model": self.config.model,
             "slots": self.config.slots,
             **verdict,
@@ -2225,6 +2329,13 @@ class TpuServingEngine:
                 else 0
             ),
         }
+        if self.config.streaming:
+            # which classes are currently fast-burning their tbt-p99-s
+            # budget (empty list when healthy) — keyed off the same
+            # committed-alert reads that fed extra_reasons above, so the
+            # list and the DEGRADED verdict can never disagree
+            out["tbt_burn"] = sorted(tbt_burn)
+        return out
 
     def _warmup_state(self) -> str:
         """``not-required`` (no warmup_on_start), ``pending`` (gate armed
@@ -2248,6 +2359,41 @@ class TpuServingEngine:
         if self.slo is None:
             return None
         return self.slo.status()
+
+    def streaming_section(self) -> dict[str, Any]:
+        """The streaming-delivery payload for ``stats()["streaming"]``
+        (streaming-configured engines only — the default stats surface
+        stays pinned without the flag). Wait-free by the same contract
+        as :meth:`attribution_section`: counter snapshots and digest
+        walks only, no locks, no awaits — a stats poll must answer while
+        a stream is mid-emit."""
+        return {
+            # streams currently holding a decode slot (the cancellation
+            # leak detector in tools/engine_top.py compares this against
+            # cancelled-vs-reclaimed below)
+            "active": sum(
+                1
+                for s in self.slots
+                if not s.free
+                and s.request is not None
+                and s.request.on_chunk is not None
+            ),
+            "emits": self.stream_emits_total,
+            "stalls": self.stream_stalls_total,
+            "cancelled": self.stream_cancels_total,
+            "reclaimed": self.stream_reclaims_total,
+            # per-class inter-token-interval digests — bounded summaries
+            # (count/p50/p99/max/mean), never raw interval lists
+            "tbt": {
+                name: digest.summary()
+                for name, digest in sorted(self._stream_tbt_by_class.items())
+            },
+            "tbt_burn": sorted(
+                name
+                for name, tracker in self._stream_slo.items()
+                if tracker.alerting.get("tbt")
+            ),
+        }
 
     def attribution_section(self) -> dict[str, Any]:
         """The device-attribution payload: per-program achieved-vs-
@@ -2350,11 +2496,21 @@ class TpuServingEngine:
         prompt: str | list[int],
         options: dict[str, Any] | None = None,
         on_token: Callable[[int, float, bool], Any] | None = None,
+        on_chunk: Callable[[list, str, bool], Any] | None = None,
         _warmup_probe: bool = False,
     ) -> dict[str, Any]:
         """Generate a completion. ``on_token(token_id, logprob, last)`` fires
-        per token (sync or async). Returns
+        per token (sync or async). ``on_chunk(new_token_ids, new_text,
+        is_final)`` fires once per committed decode chunk at the burst-flush
+        safe point — ``new_text`` deltas concatenate byte-identically to the
+        non-streaming ``text`` (UTF-8 partials and possible stop-sequence
+        prefixes are held back until they resolve). Returns
         ``{"tokens", "text", "logprobs", "num_prompt_tokens", "ttft"}``.
+
+        ``options["stream-key"]`` (the gateway's ``langstream-stream-id``)
+        registers the request with the process-wide stream-cancel registry
+        so a client disconnect observed at the gateway cancels this future;
+        the decode loop frees the slot at the next chunk boundary.
 
         ``_warmup_probe`` is internal: warmup()'s own generate calls skip
         the warmup gate below (they ARE the warmup)."""
@@ -2429,7 +2585,24 @@ class TpuServingEngine:
             # the langstream-deadline header; "deadline-s" a caller-
             # relative budget. Malformed values degrade to None.
             deadline=_deadline_from_options(options),
+            on_chunk=on_chunk,
+            stream_key=(
+                str(options["stream-key"])
+                if options.get("stream-key")
+                else None
+            ),
         )
+        if on_chunk is not None and self.config.streaming:
+            # bounded per-request TBT digest (never the raw interval
+            # list); only streaming-configured engines pay for the plane
+            request.stream_tbt = TbtDigest()
+        if request.stream_key is not None and not _warmup_probe:
+            # disconnect-as-cancellation bridge: the gateway cancels by
+            # this key from its socket teardown; the entry self-cleans
+            # when the future resolves either way
+            STREAMS.register(
+                request.stream_key, request.future, request.loop
+            )
         if not _warmup_probe:
             # journey ledger key: the trace id when traced (the one id
             # that already spans gateway → broker → engine and now rides
@@ -2605,6 +2778,10 @@ class TpuServingEngine:
         slo = self.slo_status()
         if slo is not None:
             out["slo"] = slo
+        if self.config.streaming:
+            # streaming delivery plane: active streams, emit/stall/cancel
+            # counters, per-class TBT digests (docs/OBSERVABILITY.md)
+            out["streaming"] = self.streaming_section()
         if self.prefix_store is not None:
             # tiered prefix store: per-tier bytes/budgets, hit and
             # demotion/eviction counters, exact byte ledger
@@ -5768,6 +5945,7 @@ class TpuServingEngine:
             if (
                 request.stop
                 or request.on_token is not None
+                or request.on_chunk is not None
                 or request.future.cancelled()
             ):
                 # slow path: per-token semantics (stop-string windows,
@@ -5869,7 +6047,7 @@ class TpuServingEngine:
         )
         # streaming consumers always get a final last=True emission (the
         # tokenizer hides the EOS id itself), so chunk streams terminate
-        if request.on_token is not None:
+        if request.on_token is not None or request.on_chunk is not None:
             self._pending_emits.append((request, token, logprob, done))
         if done:
             slot.request = None
@@ -5885,12 +6063,179 @@ class TpuServingEngine:
             self._finished_requests.append((request, is_eos))
         return done
 
+    def _final_text(self, request: _Request) -> str:
+        """The authoritative completion text: full decode, truncated at
+        the earliest stop match (OpenAI semantics — the match itself
+        excluded). One helper so the finish path and the streaming final
+        chunk produce byte-identical text."""
+        text = self.tokenizer.decode(request.generated)
+        if request.stop_matched:
+            hits = [
+                i for i in (text.find(s) for s in request.stop) if i >= 0
+            ]
+            if hits:
+                text = text[: min(hits)]
+        return text
+
+    def _stream_text(self, request: _Request, is_final: bool) -> str:
+        """The stream-safe decoded prefix of the generated text. Final →
+        :meth:`_final_text` (so chunk deltas concatenate byte-identically
+        to the non-streaming completion). Mid-stream → the full decode
+        minus a trailing UTF-8 partial (the replacement char a cut
+        multi-byte sequence renders as) and minus any tail that could
+        still grow into a stop match — the same holdback contract the
+        agents' _StreamAdapter keeps per token, applied per chunk."""
+        if is_final:
+            return self._final_text(request)
+        text = self.tokenizer.decode(request.generated)
+        if text.endswith("�"):
+            text = text[:-1]
+        if request.stop:
+            hits = [
+                i for i in (text.find(s) for s in request.stop) if i >= 0
+            ]
+            if hits:
+                return text[: min(hits)]
+            hold = 0
+            for s in request.stop:
+                for k in range(min(len(s) - 1, len(text)), 0, -1):
+                    if s.startswith(text[-k:]):
+                        hold = max(hold, k)
+                        break
+            if hold:
+                text = text[: len(text) - hold]
+        return text
+
+    def _stream_tbt_hist(self, cls_name: str):
+        """Per-QoS-class ``tbt_seconds`` histogram closure
+        (``langstream_stream_tbt_seconds{agent_id="<class>"}`` — the
+        class rides the reporter's agent_id label, the gateway's
+        _count_throttle pattern). Lazily created on a class's first
+        measured interval; class names are clamped to the QoS vocabulary
+        so the map stays bounded. Streaming-configured engines only —
+        the default scrape surface never grows."""
+        h = self._m_tbt_hist.get(cls_name)
+        if h is None:
+            h = PrometheusMetricsReporter(
+                prefix="langstream_stream", agent_id=cls_name
+            ).histogram(
+                "tbt_seconds",
+                "streaming inter-chunk interval (time between token "
+                "deliveries) by QoS class",
+            )
+            self._m_tbt_hist[cls_name] = h
+        return h
+
+    def _stream_stall_threshold(self, cls_name: str) -> float:
+        """The stall line for one class: its declared tbt-p99-s target
+        when it has one, the engine-wide stream-stall-s default
+        otherwise."""
+        if self.config.qos is not None:
+            tbt = self.config.qos.class_policy(cls_name).tbt_p99_s
+            if tbt is not None:
+                return tbt
+        return self.config.stream_stall_s
+
+    async def _deliver_chunk(
+        self, request: _Request, is_final: bool, now: float
+    ) -> None:
+        """Deliver one committed decode chunk to the request's on_chunk
+        consumer and record its telemetry. Runs at the burst-flush safe
+        point between device dispatches — wait-free apart from awaiting
+        the consumer itself (graftcheck STRM1501 polices this body the
+        way OBS503 polices the emit hot loop)."""
+        if request.stream_closed:
+            return
+        if request.future.cancelled():
+            # the client is gone — deliver nothing; the finished drain
+            # records the stream-cancel evidence below
+            request.stream_closed = True
+            return
+        safe = self._stream_text(request, is_final)
+        delta = safe[request.stream_sent_chars:]
+        new_ids = request.generated[request.stream_sent_tokens:]
+        if not delta and not new_ids and not is_final:
+            return  # the holdback ate the whole chunk; nothing surfaced
+        request.stream_sent_chars = max(
+            request.stream_sent_chars, len(safe)
+        )
+        request.stream_sent_tokens = len(request.generated)
+        if request.stream_tbt is not None:
+            if request.stream_first_emit is None:
+                request.stream_first_emit = now
+                self._journey(request, "first-emit")
+            else:
+                interval = now - (request.stream_last_emit or now)
+                request.stream_tbt.add(interval)
+                digest = self._stream_tbt_by_class.get(request.priority)
+                if digest is None:
+                    digest = TbtDigest()
+                    self._stream_tbt_by_class[request.priority] = digest
+                digest.add(interval)
+                self._stream_tbt_hist(request.priority)(interval)
+                threshold = self._stream_stall_threshold(request.priority)
+                if interval > threshold:
+                    request.stream_stalls += 1
+                    self.stream_stalls_total += 1
+                    self.flight.event(
+                        "stream-stall",
+                        request=request.journey_id,
+                        interval_s=round(interval, 6),
+                        threshold_s=threshold,
+                        priority=request.priority,
+                        tokens=len(request.generated),
+                    )
+            request.stream_last_emit = now
+            request.stream_emits += 1
+            self.stream_emits_total += 1
+        if is_final:
+            request.stream_closed = True
+            if request.stream_tbt is not None:
+                # ONE summarized event per stream, never one per chunk
+                # (a 4k-token stream would otherwise flood the ring)
+                summary = request.stream_tbt.summary()
+                self.flight.event(
+                    "stream-emit",
+                    request=request.journey_id,
+                    emits=request.stream_emits,
+                    tokens=len(request.generated),
+                    tbt_p50_s=summary["p50"],
+                    tbt_p99_s=summary["p99"],
+                    tbt_max_s=summary["max"],
+                    stalls=request.stream_stalls,
+                    priority=request.priority,
+                )
+                self._journey(
+                    request, "last-emit", emits=request.stream_emits
+                )
+        result = request.on_chunk(new_ids, delta, is_final)
+        if asyncio.iscoroutine(result):
+            await result
+
     async def _flush_emits(self, active: list[int]) -> None:
         emits, self._pending_emits = self._pending_emits, []
+        # per-request chunk grouping, first-appearance order: on_token
+        # subscribers keep exact per-token delivery; on_chunk subscribers
+        # get ONE delivery per request per flush with everything that
+        # committed in this burst
+        chunks: "OrderedDict[int, list]" = OrderedDict()
         for request, token, logprob, done in emits:
-            result = request.on_token(token, logprob, done)
-            if asyncio.iscoroutine(result):
-                await result
+            if request.on_token is not None:
+                result = request.on_token(token, logprob, done)
+                if asyncio.iscoroutine(result):
+                    await result
+            if request.on_chunk is not None:
+                entry = chunks.get(id(request))
+                if entry is None:
+                    chunks[id(request)] = [request, done]
+                elif done:
+                    entry[1] = True
+        if chunks:
+            # one clock per flush: chunk emission is the granularity the
+            # client observes, so inter-EMIT gaps are what TBT digests
+            now = time.monotonic()
+            for request, done in chunks.values():
+                await self._deliver_chunk(request, done, now)
         # decode-pool first-step edge: the first NEW token after a KV
         # import closes the decode-admission segment (the emits list
         # above only carries on_token subscribers; imported handoffs
@@ -5923,24 +6268,42 @@ class TpuServingEngine:
                 # aborted by the caller: not a served request — keep it out
                 # of the request-rate/TTFT metrics (a disconnect storm must
                 # not read as healthy throughput) and skip the decode
+                if request.on_chunk is not None and self.config.streaming:
+                    # disconnect-as-cancellation evidence: the slot was
+                    # freed in _emit_token's done branch, i.e. within one
+                    # chunk boundary of the cancel landing. tokens_wasted
+                    # is the decode work nobody consumed (generated but
+                    # never delivered — the engine-visible waste).
+                    self.stream_cancels_total += 1
+                    self.stream_reclaims_total += 1
+                    self.flight.event(
+                        "stream-cancel",
+                        request=request.journey_id,
+                        tokens_generated=len(request.generated),
+                        tokens_delivered=request.stream_sent_tokens,
+                        tokens_wasted=(
+                            len(request.generated)
+                            - request.stream_sent_tokens
+                        ),
+                        emits=request.stream_emits,
+                        priority=request.priority,
+                        tenant=request.tenant,
+                        slot_reclaimed=True,
+                    )
                 self._journey(request, "cancelled")
                 continue
             self.completed_requests += 1
             self._m_requests()
             if request.first_token_time is not None:
                 self._m_ttft(request.first_token_time - request.enqueue_time)
-            text = self.tokenizer.decode(request.generated)
-            if request.stop_matched:
-                # OpenAI semantics: the stop match itself is excluded. The
-                # token list keeps every generated token (they are in the
-                # KV cache and were streamed); only the text truncates.
-                # The find runs on the FINAL decode — the detection window
-                # can render boundary chars differently.
-                hits = [
-                    i for i in (text.find(s) for s in request.stop) if i >= 0
-                ]
-                if hits:
-                    text = text[: min(hits)]
+            # OpenAI semantics: the stop match itself is excluded. The
+            # token list keeps every generated token (they are in the
+            # KV cache and were streamed); only the text truncates. The
+            # find runs on the FINAL decode (the detection window can
+            # render boundary chars differently) — shared with the
+            # streaming final chunk so deltas concatenate to this exact
+            # string.
+            text = self._final_text(request)
             done_t = time.monotonic()
             first = request.first_token_time or done_t
             admit = request.admit_time or first
@@ -5978,6 +6341,15 @@ class TpuServingEngine:
                 # prefill here are decode-pod-local and ~0 by design —
                 # the prefill pool's share rode the handoff header)
                 timing["imported"] = 1.0
+            if request.stream_tbt is not None and request.stream_tbt.count:
+                # bounded TBT record (p50/p99/max + count, NEVER the raw
+                # interval list): what the gateway bench and perf_diff
+                # read off request_timings
+                summary = request.stream_tbt.summary()
+                timing["tbt_p50"] = summary["p50"]
+                timing["tbt_p99"] = summary["p99"]
+                timing["tbt_max"] = summary["max"]
+                timing["tbt_count"] = float(summary["count"])
             if not request.warmup:
                 # warmup probes never enter the latency record: their TTFT
                 # is XLA compile time, which would poison both the
@@ -5994,6 +6366,38 @@ class TpuServingEngine:
                 self._slo_record("availability", True)
                 self._slo_record_latency("ttft", timing["ttft"])
                 self._slo_record_latency("queue-wait", timing["queue_wait"])
+                if (
+                    request.stream_tbt is not None
+                    and request.stream_tbt.count
+                ):
+                    # one tbt event per finished stream: the request's
+                    # own p99 inter-emit interval, judged against (a)
+                    # the engine-wide slo.tbt objective when declared
+                    # and (b) the class's tbt-p99-s burn tracker — the
+                    # health() tbt_burn predicate reads the latter
+                    p99 = request.stream_tbt.quantile(0.99)
+                    self._slo_record_latency("tbt", p99)
+                    tracker = self._stream_slo.get(request.priority)
+                    if tracker is not None:
+                        verdict = tracker.record_latency(
+                            "tbt", p99 * 1000.0
+                        )
+                        if verdict is not None and verdict["transition"]:
+                            self.flight.event(
+                                "alert",
+                                objective=f"tbt:{request.priority}",
+                                state=(
+                                    "firing"
+                                    if verdict["alerting"]
+                                    else "resolved"
+                                ),
+                                burn_rate_fast=verdict["burn_rate_fast"],
+                                burn_rate_slow=verdict["burn_rate_slow"],
+                                budget_remaining=verdict[
+                                    "budget_remaining"
+                                ],
+                                target=verdict["target"],
+                            )
             self._journey(
                 request, "finish",
                 reason=(
@@ -6076,6 +6480,13 @@ def flight_report(
             # engine_top's prefix panel and the control-plane fan-in
             # need no extra engine surface
             entry["prefixstore"] = engine.prefix_store_section()
+        if engine.config.streaming:
+            # per-class TBT digests + the cancellation ledger: rides
+            # /flight/summary so engine_top's streaming panel and
+            # --analyze need no extra engine surface. Streaming-
+            # configured engines only — the default payload stays
+            # byte-identical (the non-streaming pin)
+            entry["streaming"] = engine.streaming_section()
         slo = engine.slo_status()
         if slo is not None:
             entry["slo"] = slo

@@ -231,6 +231,7 @@ class EngineWatchdog:
         samples: list[dict[str, Any]] | None = None,
         events: list[dict[str, Any]] | None = None,
         stopped: bool = False,
+        extra_reasons: tuple = (),
     ) -> dict[str, Any]:
         """Judge the engine now. Returns the health verdict::
 
@@ -270,6 +271,10 @@ class EngineWatchdog:
             ):
                 if reason:
                     reasons.append(reason)
+            # caller-evaluated predicates (e.g. the engine's per-class
+            # TBT burn trackers): pre-judged strings, appended so the
+            # watchdog stays pure arithmetic over its own inputs
+            reasons.extend(extra_reasons)
             state = "degraded" if reasons else "ok"
         previous = self.state
         transition = state != previous
@@ -292,11 +297,16 @@ class EngineWatchdog:
 # SLO objectives + tracker
 # ---------------------------------------------------------------------------
 
-#: objective vocabulary: what the engine records against each name
-OBJECTIVES = ("ttft", "queue-wait", "shed-rate", "availability")
+#: objective vocabulary: what the engine records against each name.
+#: "tbt" is the streaming time-between-tokens objective (one event per
+#: finished stream, measured as the request's p99 inter-chunk interval
+#: — docs/OBSERVABILITY.md Streaming & TBT); per-QoS-class targets
+#: (qos.classes.<name>.tbt-p99-s) build one tracker per class with this
+#: same machinery.
+OBJECTIVES = ("ttft", "queue-wait", "tbt", "shed-rate", "availability")
 
 #: objectives whose good/bad split needs a latency threshold
-LATENCY_OBJECTIVES = ("ttft", "queue-wait")
+LATENCY_OBJECTIVES = ("ttft", "queue-wait", "tbt")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -35,7 +35,7 @@ for same-pod math. Kinds (the lifecycle vocabulary)::
     gateway-produce  bounce  submit  admit  preempt  resume
     hydrate-begin  hydrate-done
     first-token  export  export-taken  import-received  import
-    first-step  finish  shed  fail  cancelled
+    first-step  first-emit  last-emit  finish  shed  fail  cancelled
 
 Hot-path discipline (graftcheck **OBS506**, the journey plane's OBS503/
 POOL701 twin): every write is a GIL-atomic container append plus plain
@@ -78,6 +78,8 @@ LIFECYCLE_CHAIN = (
     "import-received",
     "import",
     "first-step",
+    "first-emit",
+    "last-emit",
     "finish",
 )
 
@@ -94,6 +96,7 @@ SEGMENT_ORDER = (
     "decode-admission",
     "first-step",
     "decode",
+    "stream",
     "preempted",
 )
 
@@ -133,6 +136,22 @@ EDGE_SEGMENTS: dict[tuple[str, str], str] = {
     # straight to finish (its first-token edge was already recorded):
     # that interval is decode-phase recovery — re-prefill included
     ("admit", "finish"): "decode",
+    # streaming chunk delivery (docs/OBSERVABILITY.md Streaming & TBT):
+    # first-emit → last-emit is the STREAM segment — the interval the
+    # client was actually receiving tokens, the product latency TBT
+    # quantifies. The flanking edges are flush-boundary bookkeeping
+    # (first token → its chunk's delivery; final chunk → finish) and
+    # stay labeled decode so the TTFT decomposition is unchanged.
+    ("first-token", "first-emit"): "decode",
+    ("first-step", "first-emit"): "decode",
+    ("first-emit", "last-emit"): "stream",
+    ("last-emit", "finish"): "decode",
+    # a one-chunk generation emits first and last in the same flush
+    ("first-emit", "finish"): "decode",
+    # a disconnect mid-stream cancels between emits: the open stream is
+    # what the client abandoned
+    ("first-emit", "cancelled"): "stream",
+    ("last-emit", "cancelled"): "decode",
 }
 
 

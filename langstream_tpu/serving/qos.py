@@ -141,13 +141,25 @@ class ClassPolicy:
     weight: int
     queue_limit: int
     deadline_s: float
+    # streaming time-between-tokens target (docs/OBSERVABILITY.md
+    # Streaming & TBT): the p99 inter-chunk interval this class
+    # promises. Opt-in like deadline_headers — None (the default) means
+    # no TBT SLO for the class: no per-class burn tracker, the engine's
+    # stream-stall-s default draws the stall line instead. A
+    # streaming-configured engine builds one "tbt" burn-rate tracker
+    # per declaring class and health() degrades on a fast burn
+    # (tbt_burn).
+    tbt_p99_s: float | None = None
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "weight": self.weight,
             "queue-limit": self.queue_limit,
             "deadline-s": self.deadline_s,
         }
+        if self.tbt_p99_s is not None:
+            out["tbt-p99-s"] = self.tbt_p99_s
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -248,6 +260,7 @@ class QosSpec:
             weight = int(raw.get("weight", w_def))
             queue_limit = int(raw.get("queue-limit", raw.get("queue_limit", q_def)))
             deadline = float(raw.get("deadline-s", raw.get("deadline_s", d_def)))
+            tbt = _opt_float(raw, "tbt-p99-s", "tbt_p99_s")
             if weight < 1:
                 raise ValueError(
                     f"qos.classes.{name}.weight must be >= 1 (a zero weight "
@@ -258,7 +271,14 @@ class QosSpec:
                 raise ValueError(f"qos.classes.{name}.queue-limit must be >= 1")
             if deadline <= 0:
                 raise ValueError(f"qos.classes.{name}.deadline-s must be > 0")
-            classes.append(ClassPolicy(name, weight, queue_limit, deadline))
+            if tbt is not None and tbt <= 0:
+                raise ValueError(
+                    f"qos.classes.{name}.tbt-p99-s must be > 0 (omit it "
+                    f"for no streaming TBT target)"
+                )
+            classes.append(
+                ClassPolicy(name, weight, queue_limit, deadline, tbt)
+            )
         tenants: list[TenantPolicy] = []
         raw_tenants = d.get("tenants") or {}
         if not isinstance(raw_tenants, dict):

@@ -1342,6 +1342,81 @@ def test_net1201_tn_timeouts_splats_and_scope():
     )
 
 
+# --------------------------------------------------------------------------
+# STRM1501 — per-token streaming emit-path discipline
+# --------------------------------------------------------------------------
+
+
+def test_strm1501_tp_lock_and_sync_in_engine_emit_path():
+    src = """
+        import jax
+
+        class TpuServingEngine:
+            async def _deliver_chunk(self, request, is_final, now):
+                # a lock per delivery queues the burst-flush safe point
+                # behind whoever holds it
+                with self._emit_lock:
+                    request.stream_emits += 1
+                # a device sync on the emit path stalls the next
+                # dispatch for every slot
+                jax.block_until_ready(request.last_out)
+        """
+    ids = rule_ids(src)
+    assert ids.count("STRM1501") == 2
+
+
+def test_strm1501_tp_blocking_io_in_gateway_frame_writer():
+    assert "STRM1501" in rule_ids(
+        """
+        class GatewayServer:
+            async def _stream_push_loop(self, ws, reader, active):
+                while not ws.closed:
+                    for record in await reader.read(timeout=0.5):
+                        # frame audit log: blocking file I/O per frame
+                        open("/tmp/frames.log", "a")
+                        await ws.send_json(self._record_json(record))
+        """,
+        path="langstream_tpu/gateway/server.py",
+    )
+
+
+def test_strm1501_tn_sanctioned_delivery_and_scope():
+    # the real shape: counter bumps, digest add, frame writes — clean
+    assert "STRM1501" not in rule_ids(
+        """
+        class TpuServingEngine:
+            async def _deliver_chunk(self, request, is_final, now):
+                delta = request.text[request.stream_sent_chars:]
+                request.stream_sent_chars += len(delta)
+                request.stream_tbt.add(now - request.stream_last_emit)
+                result = request.on_chunk([], delta, is_final)
+                if result is not None:
+                    await result
+        """
+    )
+    # the cancel registry's lock is out of scope BY DESIGN: it runs per
+    # disconnect, not per token
+    assert "STRM1501" not in rule_ids(
+        """
+        class StreamCancelRegistry:
+            def cancel(self, key):
+                with self._lock:
+                    entries = list(self._streams.get(key, ()))
+                return len(entries)
+        """,
+        path="langstream_tpu/serving/streaming.py",
+    )
+    # same offending spelling outside the scoped emit-path functions
+    assert "STRM1501" not in rule_ids(
+        """
+        class TpuServingEngine:
+            def _drain_section(self):
+                with self._drain_lock:
+                    return dict(self._drain_stats)
+        """
+    )
+
+
 def test_inline_suppression_with_reason_silences_finding():
     ids = rule_ids(
         """

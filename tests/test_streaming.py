@@ -1,0 +1,522 @@
+"""Streaming token delivery + TBT SLO plane (docs/OBSERVABILITY.md
+*Streaming & TBT*).
+
+Layers covered: the bounded :class:`TbtDigest` (log-bucket quantiles,
+overflow answers the exact max), the stream-cancel registry (cross-loop
+cancel, late-cancel memory, one-shot ``consume_cancelled``, LRU bound),
+the engine acceptance — ≥2 incremental chunks whose concatenation is
+byte-identical to the non-streaming completion, TBT telemetry in
+``request_timings`` / ``stats()["streaming"]`` / one summarized
+``stream-emit`` flight event, the journey ``stream`` segment — the
+disconnect-as-cancellation acceptance (slot reclaimed within one chunk
+boundary, ``stream-cancel`` carrying ``tokens_wasted``), the QoS
+``tbt-p99-s`` burn alert degrading ``health()`` (``tbt_burn``), the
+**non-streaming pin** (default config: byte-identical output, no new
+flight-event kinds, no streaming stats section, no ``tbt_seconds``
+scrape series), the agent-layer disconnect classification, the
+``engine_top`` streaming panel + analyze flags, the ``gateway_stream``
+bench phase (slow), and ``perf_diff``'s worse-directions.
+"""
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pytest
+
+from langstream_tpu.serving.streaming import (
+    STREAMS,
+    StreamCancelRegistry,
+    TbtDigest,
+)
+
+
+def _tool(name: str):
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    return __import__(name)
+
+
+# --------------------------------------------------------------------------
+# TbtDigest
+# --------------------------------------------------------------------------
+
+
+def test_tbt_digest_bounded_quantiles_and_overflow():
+    d = TbtDigest()
+    assert d.quantile(0.99) == 0.0
+    assert d.summary() == {
+        "count": 0, "p50": 0.0, "p99": 0.0, "max": 0.0, "mean": 0.0,
+    }
+    for _ in range(100):
+        d.add(0.01)
+    d.add(5.0)  # one stall
+    assert d.count == 101
+    # the 1.33x bucket bound is within ~15% of the true value
+    assert 0.01 <= d.quantile(0.50) <= 0.0135
+    assert d.quantile(1.0) == 5.0
+    assert d.max == 5.0
+    # storage is fixed regardless of stream length
+    assert len(d.counts) == len(TbtDigest.BOUNDS) + 1
+    # negative clock skew clamps, never throws off the bucket walk
+    d.add(-1.0)
+    assert d.count == 102 and d.max == 5.0
+    # off-scale overflow answers the exact observed max, not the last
+    # bucket bound
+    d2 = TbtDigest()
+    d2.add(1000.0)
+    assert d2.quantile(0.99) == 1000.0
+    s = d2.summary()
+    assert s["count"] == 1 and s["max"] == 1000.0 and s["mean"] == 1000.0
+
+
+# --------------------------------------------------------------------------
+# StreamCancelRegistry
+# --------------------------------------------------------------------------
+
+
+def test_stream_cancel_registry_cancel_and_self_clean(run_async):
+    reg = StreamCancelRegistry()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        reg.register("k1", fut, loop)
+        assert reg.active() == 1
+        assert reg.cancel("k1") == 1
+        await asyncio.sleep(0)  # the cancel is marshalled via call_soon
+        assert fut.cancelled()
+        await asyncio.sleep(0)  # ... and the done-callback one tick later
+        assert reg.active() == 0  # done-callback unregistered the entry
+        # a resolved future self-cleans too
+        fut2 = loop.create_future()
+        reg.register("k2", fut2, loop)
+        fut2.set_result("done")
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        assert reg.active() == 0
+        # cancelling an unknown key signals nothing but is remembered
+        assert reg.cancel("never-registered") == 0
+
+    run_async(main())
+
+
+def test_stream_cancel_registry_late_cancel_and_consume(run_async):
+    """A disconnect that lands BEFORE the record reaches the engine
+    cancels at registration — the record must not decode to a dead
+    socket — and ``consume_cancelled`` answers True exactly once."""
+    reg = StreamCancelRegistry()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        reg.cancel("late")  # disconnect first ...
+        fut = loop.create_future()
+        reg.register("late", fut, loop)  # ... record arrives after
+        await asyncio.sleep(0)
+        assert fut.cancelled()
+        assert reg.consume_cancelled("late") is True
+        assert reg.consume_cancelled("late") is False  # one-shot
+        assert reg.consume_cancelled("never-cancelled") is False
+
+    run_async(main())
+
+
+def test_stream_cancel_registry_cancelled_memory_is_bounded():
+    reg = StreamCancelRegistry()
+    reg.CANCELLED_KEYS_MAX = 8
+    for i in range(50):
+        reg.cancel(f"k{i}")
+    assert len(reg._cancelled) == 8
+    # LRU: the oldest fell off, the newest survive
+    assert reg.consume_cancelled("k0") is False
+    assert reg.consume_cancelled("k49") is True
+
+
+# --------------------------------------------------------------------------
+# engine acceptance: chunks concatenate byte-identically + TBT telemetry
+# --------------------------------------------------------------------------
+
+
+def test_streaming_chunks_byte_identical_with_tbt_telemetry(run_async):
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+    from langstream_tpu.serving.journey import JOURNEYS, segments
+
+    async def main():
+        JOURNEYS.clear()
+        engine = TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=128, decode_chunk=4,
+                streaming=True,
+            )
+        )
+        try:
+            prompt = "stream me the full answer please"
+            opts = {"max-tokens": 24}
+            plain = await engine.generate(prompt, dict(opts))
+            chunks: list = []
+            streamed = await engine.generate(
+                prompt, dict(opts),
+                on_chunk=lambda ids, delta, final: chunks.append(
+                    (list(ids), delta, final)
+                ),
+            )
+            # >=2 incremental deliveries, exactly one final
+            assert len(chunks) >= 2, chunks
+            assert sum(1 for _, _, final in chunks if final) == 1
+            assert chunks[-1][2] is True
+            # concatenation is byte-identical to the non-streaming
+            # completion (greedy, same engine/weights)
+            assert "".join(delta for _, delta, _ in chunks) == plain["text"]
+            assert streamed["text"] == plain["text"]
+            ids = [t for chunk_ids, _, _ in chunks for t in chunk_ids]
+            assert ids == plain["tokens"] == streamed["tokens"]
+
+            # per-request TBT digest landed in request_timings
+            timing = list(engine.request_timings)[-1]
+            for key in ("tbt_p50", "tbt_p99", "tbt_max"):
+                assert key in timing and timing[key] >= 0.0
+            assert timing["tbt_max"] >= timing["tbt_p50"]
+
+            # stats()["streaming"]: emits counted, per-class digest under
+            # the request's (default) class, nothing cancelled
+            section = engine.stats()["streaming"]
+            assert section["emits"] >= 2
+            assert section["active"] == 0
+            assert section["cancelled"] == 0 and section["reclaimed"] == 0
+            assert section["tbt_burn"] == []
+            assert section["tbt"]["default"]["count"] >= 1
+
+            # ONE summarized stream-emit flight event per stream — never
+            # one per chunk
+            emits = [
+                e for e in engine.flight.recent_events(0)
+                if e["kind"] == "stream-emit"
+            ]
+            assert len(emits) == 1
+            ev = emits[0]
+            assert ev["emits"] == len(chunks)
+            assert ev["tokens"] == len(streamed["tokens"])
+            assert ev["priority"] == "default"
+            assert ev["stalls"] == 0
+            assert ev["tbt_max_s"] >= ev["tbt_p50_s"] >= 0.0
+
+            # per-class Prometheus histogram registered lazily
+            assert "default" in engine._m_tbt_hist
+
+            # journey: first-emit → last-emit tiles as the stream segment
+            evs = JOURNEYS.events(ev["request"])
+            kinds = [e["kind"] for e in evs]
+            assert "first-emit" in kinds and "last-emit" in kinds
+            assert any(s["segment"] == "stream" for s in segments(evs))
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+def test_non_streaming_pin(run_async):
+    """The default (non-streaming) engine is byte-identical to the
+    pre-streaming engine: chunk delivery still works for a direct
+    ``on_chunk`` caller, but no streaming stats section, no stream-*
+    flight-event kinds, no TBT timing keys, and no ``tbt_seconds``
+    scrape series appear."""
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(
+            ServingConfig(model="tiny", slots=2, max_seq_len=128,
+                          decode_chunk=4)
+        )
+        try:
+            prompt = "default config pin prompt"
+            plain = await engine.generate(prompt, {"max-tokens": 16})
+            chunks: list = []
+            streamed = await engine.generate(
+                prompt, {"max-tokens": 16},
+                on_chunk=lambda ids, delta, final: chunks.append(delta),
+            )
+            # delivery itself needs no flag, and stays byte-identical
+            assert "".join(chunks) == plain["text"] == streamed["text"]
+            # ... but every streaming observability surface is absent
+            assert "streaming" not in engine.stats()
+            assert "tbt_burn" not in engine.health()
+            assert engine._m_tbt_hist == {}
+            timing = list(engine.request_timings)[-1]
+            assert "tbt_p50" not in timing
+            kinds = {e["kind"] for e in engine.flight.recent_events(0)}
+            assert not any(k.startswith("stream-") for k in kinds)
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# disconnect as cancellation
+# --------------------------------------------------------------------------
+
+
+def test_disconnect_cancels_and_reclaims_slot_with_waste_evidence(run_async):
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+
+    async def main():
+        engine = TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=256, decode_chunk=2,
+                streaming=True,
+            )
+        )
+        key = "sk-disconnect-test"
+        try:
+            first_chunk = asyncio.Event()
+
+            def on_chunk(ids, delta, final):
+                first_chunk.set()
+
+            task = asyncio.ensure_future(
+                engine.generate(
+                    "long streaming request the client will abandon",
+                    {"max-tokens": 96, "stream-key": key},
+                    on_chunk=on_chunk,
+                )
+            )
+            await asyncio.wait_for(first_chunk.wait(), timeout=60)
+            # the gateway's socket-teardown path: cancel by stream key
+            assert STREAMS.cancel(key) == 1
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            # slot reclaimed within one chunk boundary: poll briefly for
+            # the finished-drain bookkeeping, then assert the evidence
+            for _ in range(200):
+                if engine.stats()["streaming"]["reclaimed"] >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            section = engine.stats()["streaming"]
+            assert section["cancelled"] == 1
+            assert section["reclaimed"] == 1
+            assert section["active"] == 0
+            assert all(s.free for s in engine.slots)
+            cancels = [
+                e for e in engine.flight.recent_events(0)
+                if e["kind"] == "stream-cancel"
+            ]
+            assert len(cancels) == 1
+            ev = cancels[0]
+            assert ev["slot_reclaimed"] is True
+            assert ev["tokens_generated"] >= ev["tokens_delivered"] >= 1
+            assert ev["tokens_wasted"] == (
+                ev["tokens_generated"] - ev["tokens_delivered"]
+            )
+            assert ev["priority"] == "default"
+            # a cancelled stream is NOT a served request: the completion
+            # metrics must not read a disconnect storm as throughput
+            assert engine.completed_requests == 0
+            # the agent layer classifies this cancel as a disconnect
+            # (one-shot) — and the registry entry self-cleaned
+            assert STREAMS.consume_cancelled(key) is True
+            assert STREAMS.active() == 0
+        finally:
+            STREAMS.consume_cancelled(key)
+            await engine.close()
+
+    run_async(main())
+
+
+def test_agent_layer_classifies_disconnect_cancels():
+    """``CancelledError`` out of the completion call: a disconnect
+    (stream-key cancelled at the gateway) is terminal for the record —
+    anything else (shutdown) must keep propagating."""
+    from langstream_tpu.agents.ai import ChatCompletionsAgent
+
+    class _Rec:
+        def __init__(self, headers):
+            self._h = headers
+
+        def header_map(self):
+            return self._h
+
+    classify = ChatCompletionsAgent._stream_cancelled
+    assert classify(None) is False
+    assert classify(_Rec({})) is False
+    STREAMS.cancel("agent-sk-1")
+    assert classify(_Rec({"langstream-stream-id": "agent-sk-1"})) is True
+    # consumed: a second cancel of the same record would be a shutdown
+    assert classify(_Rec({"langstream-stream-id": "agent-sk-1"})) is False
+    # a live (never-cancelled) stream key propagates the cancel
+    assert classify(_Rec({"langstream-stream-id": "agent-sk-2"})) is False
+
+
+# --------------------------------------------------------------------------
+# tbt-p99-s burn alert → health() DEGRADED
+# --------------------------------------------------------------------------
+
+
+def test_tbt_burn_degrades_health(run_async):
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+    from langstream_tpu.serving.qos import QosSpec
+
+    qos = QosSpec.from_dict(
+        {
+            "classes": {
+                "interactive": {"weight": 4, "tbt-p99-s": 0.05},
+                "batch": {"weight": 1},  # no target: no tracker
+            }
+        }
+    )
+
+    async def main():
+        engine = TpuServingEngine(
+            ServingConfig(
+                model="tiny", slots=2, max_seq_len=128, decode_chunk=4,
+                streaming=True, qos=qos,
+            )
+        )
+        try:
+            # only declaring classes get a burn tracker
+            assert set(engine._stream_slo) == {"interactive"}
+            # and the declared target draws that class's stall line,
+            # while non-declaring classes keep the engine-wide default
+            assert engine._stream_stall_threshold("interactive") == 0.05
+            assert engine._stream_stall_threshold("batch") == (
+                engine.config.stream_stall_s
+            )
+            h = engine.health()
+            assert h["state"] == "ok" and h["tbt_burn"] == []
+            # every stream misses the 50ms p99 target by 10x: both burn
+            # windows exceed the page threshold
+            tracker = engine._stream_slo["interactive"]
+            for _ in range(20):
+                tracker.record_latency("tbt", 500.0)
+            assert tracker.alerting["tbt"] is True
+            h = engine.health()
+            assert h["state"] == "degraded"
+            assert h["tbt_burn"] == ["interactive"]
+            assert any(
+                "tbt burn-rate alert" in r and "interactive" in r
+                for r in h["reasons"]
+            )
+            assert engine.stats()["streaming"]["tbt_burn"] == ["interactive"]
+        finally:
+            await engine.close()
+
+    run_async(main())
+
+
+# --------------------------------------------------------------------------
+# engine_top: streaming panel + analyze flags
+# --------------------------------------------------------------------------
+
+
+def test_engine_top_streaming_panel_and_flags():
+    engine_top = _tool("engine_top")
+    section = {
+        "active": 1, "emits": 240, "stalls": 4, "cancelled": 3,
+        "reclaimed": 2,
+        "tbt": {
+            "interactive": {"count": 180, "p50": 0.021, "p99": 0.043,
+                            "max": 0.3, "mean": 0.024},
+            "default": {"count": 60, "p50": 0.05, "p99": 0.31,
+                        "max": 2.5, "mean": 0.08},
+        },
+        "tbt_burn": ["interactive"],
+    }
+    cancel_event = {
+        "kind": "stream-cancel", "request": "abc123", "tokens_generated": 40,
+        "tokens_delivered": 30, "tokens_wasted": 10, "emits": 9,
+        "priority": "default",
+    }
+    lines = engine_top._render_streaming(section, [cancel_event])
+    text = "\n".join(lines)
+    assert "stream" in text and "cancelled 3/reclaimed 2" in text
+    assert "TBT BURN interactive" in text
+    assert "interactive" in text and "default" in text
+    assert "wasted 10" in text
+    # absent section renders nothing (the non-streaming pin, panel-side)
+    assert engine_top._render_streaming(None, []) == []
+
+    stall = lambda req: {  # noqa: E731
+        "kind": "stream-stall", "request": req, "interval_s": 3.0,
+        "threshold_s": 0.25, "priority": "interactive", "tokens": 12,
+    }
+    entry = {
+        "model": "tiny", "summary": {"totals": {}},
+        "events": [stall("r1"), stall("r1"), stall("r1"), stall("r2")],
+        "streaming": section,
+    }
+    flags = engine_top._anomalies(entry)
+    assert any("stream stall storm" in f for f in flags)
+    assert any("stream cancellation leak" in f for f in flags)
+    # balanced ledger + quiet streams: neither flag
+    ok_entry = {
+        "model": "tiny", "summary": {"totals": {}},
+        "events": [stall("r1")],
+        "streaming": dict(section, cancelled=2, reclaimed=2),
+    }
+    flags = engine_top._anomalies(ok_entry)
+    assert not any("stream" in f for f in flags)
+
+
+# --------------------------------------------------------------------------
+# bench phase + perf_diff
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gateway_stream_phase_smoke(run_async):
+    gateway_bench = _tool("gateway_bench")
+    out = run_async(
+        gateway_bench.run_stream_phase(
+            streams=4, disconnects=1, max_tokens=16, warmup=1
+        )
+    )
+    # a streaming client observes >=2 incremental frames
+    assert out["gateway_stream_frames_min"] >= 2
+    assert out["multi_frame"] is True
+    # the disconnect burst reclaimed its decode slots
+    assert out["gateway_stream_cancelled"] >= 1
+    assert out["slots_reclaimed_on_disconnect"] is True
+    assert out["gateway_stream_cancel_reclaim_fraction"] == 1.0
+    for key in (
+        "gateway_stream_ttfb_s", "gateway_stream_tbt_p50_s",
+        "gateway_stream_tbt_p99_s", "gateway_stream_tokens_wasted",
+        "tbt_by_class", "engine_tbt_by_class",
+    ):
+        assert key in out, key
+
+
+def test_perf_diff_stream_directions_and_extraction():
+    perf_diff = _tool("perf_diff")
+    for key, direction in (
+        ("gateway_stream_tbt_p50_s", "up"),
+        ("gateway_stream_tbt_p99_s", "up"),
+        ("gateway_stream_stalls", "up"),
+        ("gateway_stream_ttfb_s", "up"),
+        ("gateway_stream_cancel_reclaim_fraction", "down"),
+    ):
+        assert perf_diff.METRICS[key] == direction
+    payload = {
+        "detail": {
+            "gateway_stream": {
+                "gateway_stream_tbt_p50_s": 0.02,
+                "gateway_stream_tbt_p99_s": 0.09,
+                "gateway_stream_stalls": 0,
+                "gateway_stream_ttfb_s": 0.4,
+                "gateway_stream_cancel_reclaim_fraction": 1.0,
+            }
+        }
+    }
+    metrics = perf_diff.extract_metrics(payload)["metrics"]
+    assert metrics["gateway_stream_tbt_p99_s"] == 0.09
+    assert metrics["gateway_stream_cancel_reclaim_fraction"] == 1.0
+    # a TBT regression in the candidate is flagged in the worse
+    # direction; the same move the other way is an improvement
+    base = {"metrics": {"gateway_stream_tbt_p99_s": 0.05}}
+    cand = {"metrics": {"gateway_stream_tbt_p99_s": 0.2}}
+    out = perf_diff.diff_metrics(base, cand)
+    assert any(
+        r["metric"] == "gateway_stream_tbt_p99_s" for r in out["regressions"]
+    )
+    out = perf_diff.diff_metrics(cand, base)
+    assert any(
+        r["metric"] == "gateway_stream_tbt_p99_s"
+        for r in out["improvements"]
+    )

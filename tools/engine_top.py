@@ -202,6 +202,7 @@ def render(report: list[dict]) -> str:
         )
         lines.extend(_render_prefix(entry.get("prefixstore"), events))
         lines.extend(_render_survival(entry.get("survival"), events))
+        lines.extend(_render_streaming(entry.get("streaming"), events))
         spec_acc = totals.get("spec_accepted") or 0
         spec_rej = totals.get("spec_rejected") or 0
         if spec_acc or spec_rej:
@@ -418,6 +419,60 @@ def _render_survival(survival: dict | None, events: list[dict]) -> list[str]:
                 f" (last {breaker.get('kind')}: {breaker.get('replica')})"
             )
         lines.append(line)
+    return lines
+
+
+def _render_streaming(streaming: dict | None, events: list[dict]) -> list[str]:
+    """Streaming panel (docs/OBSERVABILITY.md Streaming): active stream
+    count, emit/stall totals, the disconnect-cancellation ledger
+    (cancelled vs reclaimed — any daylight between them is a leaked
+    decode slot), and one TBT digest bar per QoS class (bar = that
+    class's p99 against the slowest class, so the class burning its
+    tbt budget is the longest bar on the panel). Rendered only for
+    streaming-configured engines — the section is absent otherwise."""
+    if not isinstance(streaming, dict):
+        return []
+    lines: list[str] = []
+    cancelled = streaming.get("cancelled") or 0
+    reclaimed = streaming.get("reclaimed") or 0
+    line = (
+        f"stream   active {streaming.get('active', 0)}  "
+        f"emits {streaming.get('emits', 0)}  "
+        f"stalls {streaming.get('stalls', 0)}  "
+        f"cancelled {cancelled}/reclaimed {reclaimed}"
+    )
+    burn = streaming.get("tbt_burn") or []
+    if burn:
+        line += f"  TBT BURN {','.join(burn)}"
+    lines.append(line)
+    tbt = streaming.get("tbt") or {}
+    digests = {
+        name: d for name, d in tbt.items()
+        if isinstance(d, dict) and d.get("count")
+    }
+    if digests:
+        scale = max(d.get("p99") or 0.0 for d in digests.values()) or 1.0
+        width = max(len(name) for name in digests)
+        for name, d in sorted(digests.items()):
+            lines.append(
+                f"tbt      {name:{width}s} "
+                f"[{_bar((d.get('p99') or 0.0) / scale, 16)}] "
+                f"p50 {_fmt_ms((d.get('p50') or 0.0) * 1000)}  "
+                f"p99 {_fmt_ms((d.get('p99') or 0.0) * 1000)}  "
+                f"max {_fmt_ms((d.get('max') or 0.0) * 1000)}  "
+                f"(n={d.get('count')})"
+            )
+    last = next(
+        (e for e in reversed(events) if e.get("kind") == "stream-cancel"),
+        None,
+    )
+    if last is not None:
+        lines.append(
+            f"cancel   request {last.get('request')}  delivered "
+            f"{last.get('tokens_delivered')}/{last.get('tokens_generated')} "
+            f"tok  wasted {last.get('tokens_wasted')}  "
+            f"class {last.get('priority')}"
+        )
     return lines
 
 
@@ -970,6 +1025,42 @@ def _anomalies(entry: dict) -> list[str]:
             f"replica that keeps failing; the failure is load-shaped "
             f"(use Retry-After holds / scale the pool), not a dead pod"
         )
+    # stream stall storm (docs/OBSERVABILITY.md Streaming): one request
+    # tripping the stall line >=3 times means its client repeatedly sat
+    # past the class's TBT budget mid-stream — a convoyed decode loop or
+    # a choked frame path, not a one-off hiccup; the TBT burn alert will
+    # page on exactly this if it keeps up
+    stalls_by_request: dict = {}
+    for e in events:
+        if e.get("kind") == "stream-stall":
+            key = e.get("request") or "?"
+            stalls_by_request[key] = stalls_by_request.get(key, 0) + 1
+    stall_storm = {k: n for k, n in stalls_by_request.items() if n >= 3}
+    if stall_storm:
+        worst = max(stall_storm.items(), key=lambda kv: kv[1])
+        flags.append(
+            f"stream stall storm: {len(stall_storm)} stream(s) tripped "
+            f"the stall line >=3 times (worst {worst[0]}: {worst[1]} "
+            f"stalls) — inter-chunk gaps keep exceeding the class TBT "
+            f"budget; check decode convoys (recompiles, KV pressure) and "
+            f"the gateway frame path before the tbt burn alert pages"
+        )
+    # cancellation leak: every disconnect-cancel must free its decode
+    # slot at the next chunk boundary — cancelled streams outnumbering
+    # reclaimed slots means a cancelled request is still holding (and
+    # decoding into) a slot nobody is reading
+    streaming = entry.get("streaming")
+    if isinstance(streaming, dict):
+        cancelled = streaming.get("cancelled") or 0
+        reclaimed = streaming.get("reclaimed") or 0
+        if cancelled > reclaimed:
+            flags.append(
+                f"stream cancellation leak: {cancelled} stream(s) "
+                f"cancelled but only {reclaimed} decode slot(s) "
+                f"reclaimed — {cancelled - reclaimed} cancelled "
+                f"request(s) still occupy slots, burning decode capacity "
+                f"on tokens nobody will read"
+            )
     survival = entry.get("survival")
     if isinstance(survival, dict) and survival.get("withheld_blocks"):
         flags.append(
@@ -1174,6 +1265,12 @@ def analyze(dump) -> str:
                 f"{scheduler.get('preempted', 0)}  resumed "
                 f"{scheduler.get('resumed', 0)}"
             )
+        streaming = entry.get("streaming")
+        if isinstance(streaming, dict):
+            for line in _render_streaming(
+                streaming, entry.get("events") or []
+            ):
+                lines.append(f"  {line}")
         flags = _anomalies(entry)
         for flag in flags:
             lines.append(f"  !! {flag}")

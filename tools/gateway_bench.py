@@ -73,6 +73,12 @@ instance:
     type: memory
 """
 
+# the streaming phase wants one gateway frame per decode chunk — chunk
+# batching would average the very inter-frame intervals it measures
+STREAM_PIPELINE = PIPELINE.replace(
+    "min-chunks-per-message: 4", "min-chunks-per-message: 1"
+)
+
 
 def _pct(sorted_values, q: float):
     """Nearest-rank percentile of an already-sorted list (None when
@@ -344,6 +350,249 @@ async def run_gateway_bench(
             if len(engines) > 1:
                 out["flight"]["engines_observed"] = len(engines)
                 out["flight"]["model"] = chat_engine.config.model
+        return out
+    finally:
+        await session.close()
+        await gateway.stop()
+        await control.stop()
+        await compute.close()
+
+
+async def run_stream_phase(
+    *,
+    serving: dict[str, Any] | None = None,
+    streams: int = 8,
+    disconnects: int = 3,
+    max_tokens: int = 32,
+    warmup: int = 2,
+    prompt: str = "please stream the full fleet status report",
+    instance_yaml: str | None = None,
+) -> dict[str, Any]:
+    """Streaming-delivery phase (docs/OBSERVABILITY.md Streaming): N
+    concurrent streaming WS clients against the in-process gateway +
+    TBT-instrumented engine (``streaming: true``, one frame per decode
+    chunk), measuring the SLO surface the tbt plane alerts on —
+    client-observed time-between-frames p50/p99/max per priority class,
+    first-frame TTFB, engine-side stall count — then a mid-stream
+    disconnect burst whose verdict is the cancellation ledger:
+    ``slots_reclaimed_on_disconnect`` (every disconnected stream's
+    decode slot freed at a chunk boundary, ``stream-cancel`` logged with
+    its wasted-token bill) — the zero-silent-loss shape of the streaming
+    plane. ``perf_diff`` declares the worse-directions so a regression
+    that stretches TBT, stalls streams, or leaks cancelled slots is
+    flagged, not averaged away."""
+    import aiohttp
+
+    from langstream_tpu.controlplane.server import (
+        ControlPlaneServer,
+        LocalComputeRuntime,
+    )
+    from langstream_tpu.controlplane.stores import InMemoryApplicationStore
+    from langstream_tpu.gateway.server import GatewayRegistry, GatewayServer
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    serving = dict(serving or {})
+    serving.setdefault("model", "tiny")
+    serving.setdefault("slots", 4)
+    serving.setdefault("max-seq-len", 256)
+    serving.setdefault("decode-chunk", 4)
+    serving.setdefault("model-dtype", "float32")
+    serving.setdefault("streaming", True)
+
+    registry = GatewayRegistry()
+    compute = LocalComputeRuntime(gateway_registry=registry)
+    control = ControlPlaneServer(
+        store=InMemoryApplicationStore(), compute=compute, port=_free_port()
+    )
+    gateway = GatewayServer(registry=registry, port=_free_port())
+    await control.start()
+    await gateway.start()
+    session = aiohttp.ClientSession()
+    t_start = time.monotonic()
+    try:
+        api = f"http://127.0.0.1:{control.port}"
+        async with session.put(f"{api}/api/tenants/bench") as resp:
+            assert resp.status in (200, 201), await resp.text()
+        payload = {
+            "files": {
+                "pipeline.yaml": STREAM_PIPELINE.replace(
+                    "%MAX_TOKENS%", str(max_tokens)
+                ),
+                "configuration.yaml": CONFIGURATION.replace(
+                    "%SERVING%", _yaml_serving(serving)
+                ),
+                "gateways.yaml": GATEWAYS,
+            },
+            "instance": instance_yaml or INSTANCE,
+        }
+        async with session.post(
+            f"{api}/api/applications/bench/streamapp", json=payload
+        ) as resp:
+            assert resp.status in (200, 201), await resp.text()
+
+        ws_base = f"ws://127.0.0.1:{gateway.port}"
+
+        async def one_stream(
+            i: int, priority: str = "default", disconnect_after: int = 0
+        ) -> dict[str, Any]:
+            # option:streaming stamps the per-message stream-id header
+            # the engine registers its future under (disconnect →
+            # cancel); param:priority keys the per-class TBT digests
+            url = (
+                f"{ws_base}/v1/chat/bench/streamapp/chat"
+                f"?param:sessionId=s{i}&option:streaming=true"
+                f"&param:priority={priority}"
+            )
+            out: dict[str, Any] = {
+                "frames": 0, "intervals": [], "priority": priority,
+            }
+            async with session.ws_connect(url) as chat:
+                t0 = time.monotonic()
+                await chat.send_json({"value": {"question": f"{prompt} #{i}"}})
+                last_t = None
+                while True:
+                    msg = await asyncio.wait_for(chat.receive_json(), 600)
+                    if "record" not in msg:
+                        continue  # the produce ack; frames are pushes
+                    now = time.monotonic()
+                    out["frames"] += 1
+                    if last_t is None:
+                        out["ttfb"] = now - t0
+                    else:
+                        out["intervals"].append(now - last_t)
+                    last_t = now
+                    if disconnect_after and out["frames"] >= disconnect_after:
+                        # leave mid-generation: the async-with teardown
+                        # closes the socket, the gateway cancels the
+                        # stream-key, the engine frees the slot at the
+                        # next chunk boundary
+                        out["disconnected"] = True
+                        return out
+                    headers = (msg.get("record") or {}).get("headers") or {}
+                    if headers.get("stream-last-message") in ("true", True):
+                        out["e2e"] = now - t0
+                        return out
+
+        # warmup compiles prefill + decode variants (sequential, then a
+        # small concurrent wave) so no measured TBT interval carries an
+        # XLA compile inside it
+        for i in range(warmup):
+            await one_stream(10_000 + i)
+        if warmup > 0:
+            wave = min(int(serving.get("slots", 4) or 4), 8)
+            await asyncio.gather(
+                *(one_stream(20_000 + i) for i in range(wave))
+            )
+
+        with TpuServingEngine._instances_lock:
+            engines = list(TpuServingEngine._instances.values())
+        assert engines, "no engine came up behind the streaming gateway"
+        engine = engines[0]
+        engine.request_timings.clear()
+        base = dict(engine.stats().get("streaming") or {})
+
+        # ---- measured wave: mixed priority classes -------------------
+        classes = ("interactive", "default")
+        results = await asyncio.gather(
+            *(
+                one_stream(i, priority=classes[i % len(classes)])
+                for i in range(streams)
+            )
+        )
+
+        # ---- disconnect burst: leave after the first frame -----------
+        burst = await asyncio.gather(
+            *(
+                one_stream(50_000 + i, disconnect_after=1)
+                for i in range(disconnects)
+            )
+        )
+        # the cancel lands via the gateway's socket-teardown sweep and
+        # the engine observes it at the next chunk boundary: wait the
+        # ledger out instead of racing it
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            now_s = engine.stats().get("streaming") or {}
+            if (
+                now_s.get("reclaimed", 0) - base.get("reclaimed", 0)
+                >= disconnects
+            ):
+                break
+            await asyncio.sleep(0.05)
+
+        streaming_now = dict(engine.stats().get("streaming") or {})
+        cancel_events = [
+            e
+            for e in engine.flight.recent_events(0)
+            if e["kind"] == "stream-cancel"
+        ]
+
+        pct = _pct
+        ttfbs = sorted(r["ttfb"] for r in results if "ttfb" in r)
+        intervals_by_class: dict[str, list[float]] = {}
+        all_intervals: list[float] = []
+        for r in results:
+            intervals_by_class.setdefault(r["priority"], []).extend(
+                r["intervals"]
+            )
+            all_intervals.extend(r["intervals"])
+        all_intervals.sort()
+        frames = sorted(r["frames"] for r in results)
+        cancelled = streaming_now.get("cancelled", 0) - base.get(
+            "cancelled", 0
+        )
+        reclaimed = streaming_now.get("reclaimed", 0) - base.get(
+            "reclaimed", 0
+        )
+        out: dict[str, Any] = {
+            "streams": streams,
+            "disconnects": disconnects,
+            "max_tokens": max_tokens,
+            # client-observed: the ONLY vantage the SLO is defined at —
+            # engine emit → broker hop → gateway push all inside it
+            "gateway_stream_ttfb_s": round(pct(ttfbs, 0.50), 4),
+            "gateway_stream_tbt_p50_s": round(pct(all_intervals, 0.50), 4),
+            "gateway_stream_tbt_p99_s": round(pct(all_intervals, 0.99), 4),
+            "gateway_stream_tbt_max_s": round(all_intervals[-1], 4)
+            if all_intervals
+            else None,
+            "gateway_stream_frames_min": frames[0] if frames else 0,
+            # the byte-identity acceptance rides on ≥2 incremental frames
+            "multi_frame": bool(frames) and frames[0] >= 2,
+            "tbt_by_class": {
+                name: {
+                    "p50_s": round(pct(sorted(vals), 0.50), 4),
+                    "p99_s": round(pct(sorted(vals), 0.99), 4),
+                    "max_s": round(max(vals), 4),
+                    "n": len(vals),
+                }
+                for name, vals in sorted(intervals_by_class.items())
+                if vals
+            },
+            # engine-side per-class digests (the stats()["streaming"]
+            # surface): client TBT minus this is the transport share
+            "engine_tbt_by_class": streaming_now.get("tbt") or {},
+            "gateway_stream_stalls": streaming_now.get("stalls", 0)
+            - base.get("stalls", 0),
+            # the cancellation ledger (zero-silent-loss shape): every
+            # disconnected stream cancelled AND its decode slot freed
+            "gateway_stream_cancelled": cancelled,
+            "gateway_stream_reclaimed": reclaimed,
+            "gateway_stream_cancel_reclaim_fraction": round(
+                reclaimed / disconnects, 4
+            )
+            if disconnects
+            else None,
+            "slots_reclaimed_on_disconnect": reclaimed >= disconnects,
+            "gateway_stream_tokens_wasted": sum(
+                int(e.get("tokens_wasted") or 0) for e in cancel_events
+            ),
+            "stream_cancel_events": len(cancel_events),
+            "disconnected_streams": sum(
+                1 for r in burst if r.get("disconnected")
+            ),
+            "wall_s": round(time.monotonic() - t_start, 3),
+        }
         return out
     finally:
         await session.close()

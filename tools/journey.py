@@ -74,6 +74,13 @@ EDGE_SEGMENTS: dict[tuple[str, str], str] = {
     ("first-token", "preempt"): "decode",
     ("first-step", "preempt"): "decode",
     ("admit", "finish"): "decode",
+    ("first-token", "first-emit"): "decode",
+    ("first-step", "first-emit"): "decode",
+    ("first-emit", "last-emit"): "stream",
+    ("last-emit", "finish"): "decode",
+    ("first-emit", "finish"): "decode",
+    ("first-emit", "cancelled"): "stream",
+    ("last-emit", "cancelled"): "decode",
 }
 
 #: segments that are part of TTFT (everything before the first token

@@ -98,6 +98,16 @@ METRICS: dict[str, str] = {
     "partition_storm_completed_fraction": "down",
     "partition_storm_fallbacks": "up",
     "partition_storm_ttft_p99_s": "up",
+    # streaming-delivery phase (docs/OBSERVABILITY.md Streaming,
+    # gateway_bench run_stream_phase): client-observed time-between-
+    # frames growing, streams stalling, the first frame arriving later,
+    # or disconnect-cancelled slots reclaiming less than 1:1 is the
+    # streaming plane regressing
+    "gateway_stream_tbt_p50_s": "up",
+    "gateway_stream_tbt_p99_s": "up",
+    "gateway_stream_stalls": "up",
+    "gateway_stream_ttfb_s": "up",
+    "gateway_stream_cancel_reclaim_fraction": "down",
     # analyzer self-stats (bench.py _analyzer_stats): the tier-1 gate
     # pays the analyzer's wall time every run, and a growing suppression
     # count is escape-hatch creep — both get worse upward
@@ -233,6 +243,18 @@ def extract_metrics(payload) -> dict:
             ):
                 if storm.get(key) is not None:
                     metrics[key] = storm[key]
+        # streaming-delivery phase (gateway_bench run_stream_phase):
+        # client-observed TBT, first-frame TTFB, stall count, and the
+        # disconnect-cancellation reclaim fraction
+        stream = detail.get("gateway_stream")
+        if isinstance(stream, dict):
+            for key in (
+                "gateway_stream_tbt_p50_s", "gateway_stream_tbt_p99_s",
+                "gateway_stream_stalls", "gateway_stream_ttfb_s",
+                "gateway_stream_cancel_reclaim_fraction",
+            ):
+                if stream.get(key) is not None:
+                    metrics[key] = float(stream[key])
         # analyzer self-stats (bench.py parent side)
         analyzer = detail.get("analyzer")
         if isinstance(analyzer, dict):
