@@ -57,6 +57,11 @@ STRM1501    streaming emit-path discipline: device syncs, blocking I/O,
             or lock acquisition in the per-token chunk-delivery path
             (engine burst-flush delivery, TBT digest updates, gateway
             frame-writer loops) — waits there are the client's TBT
+INC1601     incident breach-observe discipline: device syncs, blocking
+            I/O, or lock acquisition in the capture path that snapshots
+            evidence at the moment of an SLO/health breach (cooldown
+            gate, bundle submit, storm/ranking predicates) — a wait
+            there adds latency to the degraded moment it explains
 ==========  ==============================================================
 
 RACE/INV/FLOW/SPMD/HOT are **project rules**: they run over a
@@ -99,6 +104,7 @@ from langstream_tpu.analysis.rules_fleet import RULES as _FLEET_RULES
 from langstream_tpu.analysis.rules_flt import RULES as _FLT_RULES
 from langstream_tpu.analysis.rules_flow import RULES as _FLOW_RULES
 from langstream_tpu.analysis.rules_hot import RULES as _HOT_RULES
+from langstream_tpu.analysis.rules_inc import RULES as _INC_RULES
 from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
 from langstream_tpu.analysis.rules_net import RULES as _NET_RULES
@@ -126,6 +132,7 @@ ALL_RULES: list[Rule] = [
     *_FLT_RULES,
     *_NET_RULES,
     *_STRM_RULES,
+    *_INC_RULES,
 ]
 
 #: whole-program rules (run over the ProjectIndex, not per file)

@@ -868,3 +868,73 @@ class TpuServingEngine:
         "jitted computation (jnp.where / lax.cond)."
     ),
 ))
+
+_register(RuleExample(
+    rule="INC1601",
+    tp={
+        "langstream_tpu/serving/incident.py": '''\
+import json
+import time
+
+class IncidentRecorder:
+    def should_capture(self, kind, dedup_key=None):
+        key = kind if dedup_key is None else f"{kind}:{dedup_key}"
+        # a lock on the breach-observe path: health() and the finish
+        # path now contend with the writer thread's disk latency at
+        # the exact moment the engine is degraded
+        with self._lock:
+            last = self._last_capture.get(key)
+            now = time.monotonic()
+            if last is not None and now - last < self.cooldown_s:
+                return False
+            self._last_capture[key] = now
+        return True
+
+    def submit(self, bundle):
+        bundle_id = f"incident-{self._seq:06d}"
+        # file I/O inline at the breach site: the probe handler that
+        # tripped the trigger is now waiting on the disk
+        with open(self._path_for(bundle_id), "w") as fh:
+            json.dump(bundle, fh)
+        return bundle_id
+''',
+    },
+    tn={
+        "langstream_tpu/serving/incident.py": '''\
+import time
+
+class IncidentRecorder:
+    def should_capture(self, kind, dedup_key=None):
+        # the sanctioned shape: GIL-atomic dict ops on a vocabulary-
+        # bounded dict; a racing duplicate capture is dedup'd by the
+        # writer, never waited for here
+        key = kind if dedup_key is None else f"{kind}:{dedup_key}"
+        last = self._last_capture.get(key)
+        now = time.monotonic()
+        if last is not None and now - last < self.cooldown_s:
+            self.suppressed[kind] = self.suppressed.get(kind, 0) + 1
+            return False
+        self._last_capture[key] = now
+        return True
+
+    def submit(self, bundle):
+        # deque handoff to the writer thread, same shape journal.admit
+        # proved: append + wake, zero waits
+        self.captured += 1
+        self._pending.append(bundle)
+        self._wake.set()
+        return f"incident-{self._seq + self.captured:06d}"
+''',
+    },
+    fix=(
+        "Keep the breach-observe side (should_capture, submit, the "
+        "breaker-storm/worst-journeys predicates, the engine's "
+        "_incident_capture assembly) to GIL-atomic container ops and a "
+        "deque handoff; all file I/O and the bundle-table lock live on "
+        "the dedicated writer thread (`_run_writer`/`_drain`), exactly "
+        "the journal.py split. If evidence assembly needs a section "
+        "that can wait, snapshot it from state the hot path already "
+        "maintains instead of computing it at the breach site "
+        "(docs/OBSERVABILITY.md, Incident bundles & exemplars)."
+    ),
+))

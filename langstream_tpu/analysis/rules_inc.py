@@ -1,0 +1,118 @@
+"""Incident-capture rules: breach-observe-path discipline (INC1601).
+
+The incident plane (``serving/incident.py``, docs/OBSERVABILITY.md
+*Incident bundles & exemplars*) snapshots evidence at the exact moment a
+breach predicate trips — inside ``health()`` (probe handlers, OBS504's
+wait-free domain), the engine finish path, and the SLO emit path. A
+capture that waits is worse than no capture at all: the evidence plane
+would *add* latency to precisely the degraded moment it exists to
+explain, and a lock shared with the writer thread would let disk
+latency reach a liveness probe. INC1601 is OBS504's wait-free shape
+over that plane: **a device sync, blocking call, or lock acquisition on
+the breach-observe path** is a red gate —
+
+- :meth:`IncidentRecorder.should_capture` is the cooldown/dedup gate
+  called at every breach site — it must stay GIL-atomic dict ops on a
+  vocabulary-bounded dict;
+- :meth:`IncidentRecorder.submit` is the bundle handoff — a deque
+  append plus event set, the exact shape ``journal.admit`` proved;
+- the engine's ``_incident_capture`` assembles the bundle inline from
+  sections that are wait-free by their own contracts (flight summary,
+  journey-ledger snapshots, attribution/survival/kvtransfer) — adding
+  a blocking section there silently converts every trigger into a
+  stall;
+- :func:`worst_journeys` and :func:`breaker_storm` are the predicate/
+  ranking helpers running at the same sites.
+
+The writer side (``_drain``, ``_run_writer``, ``list``/``get``/
+``stats`` on the serving thread) is deliberately absent from the
+scope: it owns ALL file I/O and the bundle table, and its single lock
+is the sanctioned reader/writer handoff — the same split
+``journal.py`` ships. Nested defs are exempt everywhere (deferred
+work — the OBS503/STRM1501 exemption).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule
+from langstream_tpu.analysis.rules_obs import _waitfree_violations
+
+#: the incident plane's breach-observe paths, per file. The writer
+#: thread's functions (`_drain`, `_run_writer`) and the serving-thread
+#: readers (`list`/`get`/`stats`) are deliberately absent: they own the
+#: file I/O and the bundle-table lock — the sanctioned side of the
+#: journal.py split.
+_INC_FUNCS_BY_FILE = {
+    "langstream_tpu/serving/incident.py": {
+        "should_capture",
+        "submit",
+        "breaker_storm",
+        "worst_journeys",
+    },
+    "langstream_tpu/serving/engine.py": {
+        "_incident_capture",
+    },
+}
+
+
+def _observe_path_functions(mod: Module) -> Iterator[ast.AST]:
+    named: set[str] = set()
+    for prefix, names in _INC_FUNCS_BY_FILE.items():
+        if prefix in mod.path or mod.path.endswith(prefix):
+            named = names
+            break
+    if not named:
+        return
+    nested_fns: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if inner is not node and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested_fns.add(id(inner))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(node) in nested_fns:
+            continue
+        if node.name in named:
+            yield node
+
+
+def check_blocking_on_observe_path(mod: Module) -> Iterator[Finding]:
+    for fn in _observe_path_functions(mod):
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "INC1601",
+                node,
+                f"{kind} {offender} on the incident breach-observe path "
+                f"(`{fn.name}`): capture runs inside health() (probe "
+                f"handlers — OBS504's domain), the finish path, and the "
+                f"SLO emit path at the exact moment the engine is "
+                f"degraded, so a wait here adds latency to the incident "
+                f"it exists to explain, and a lock shared with the "
+                f"writer thread lets disk latency reach a liveness "
+                f"probe; keep the observe side to GIL-atomic container "
+                f"ops and deque handoffs, and leave file I/O plus the "
+                f"bundle-table lock to the writer thread "
+                f"(docs/OBSERVABILITY.md, Incident bundles & exemplars)",
+            )
+
+
+RULES = [
+    Rule(
+        id="INC1601",
+        family="inc",
+        summary="device sync, blocking call, or lock acquisition on the "
+        "incident breach-observe path (should_capture/submit, the "
+        "breaker-storm/worst-journeys predicates, the engine's "
+        "_incident_capture assembly — evidence capture at the breach "
+        "instant must never add a wait to the degraded moment it "
+        "explains)",
+        check=check_blocking_on_observe_path,
+    ),
+]

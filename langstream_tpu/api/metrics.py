@@ -10,11 +10,28 @@ registry records the same series and :func:`render_metrics` renders them in
 the text exposition format — the endpoint always answers a well-formed
 ``text/plain; version=0.0.4`` body, so scraper probes don't read an empty
 response as a dead target.
+
+**Exemplars** (docs/OBSERVABILITY.md, *Incident bundles & exemplars*):
+histograms registered via :meth:`PrometheusMetricsReporter.exemplar_histogram`
+keep one bounded last-wins ``(trace_id, value, ts)`` slot per bucket —
+the most recent *traced* observation that landed there — and
+:func:`render_metrics` appends them to the matching ``_bucket`` lines in
+OpenMetrics exemplar syntax (`` # {trace_id="..."} <value> <ts>``), so a
+p99 bucket on the scrape names a journey id ``tools/journey.py --trace``
+can open. The slot store is written with single GIL-atomic dict stores
+(wait-free — observation sites sit on the engine's finish path) and
+bounded by construction (one slot per declared bucket). Engines that
+never observe a traced request leave every slot empty, and an empty
+store leaves the scrape body **byte-identical** to the pre-exemplar
+format — Prometheus' text parser never sees the comment unless an
+exemplar exists.
 """
 
 from __future__ import annotations
 
+import re
 import threading
+import time
 from typing import Callable
 
 from langstream_tpu.api.agent import MetricsReporter
@@ -43,6 +60,58 @@ LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0,
 )
+
+#: exemplar slots: full metric name → agent label → bucket upper bound
+#: (``float('inf')`` for +Inf) → ``(trace_id, value, unix ts)``. Written
+#: last-wins by the observe closures (GIL-atomic dict stores, no lock —
+#: the sites sit on the engine finish path); read by the renderer.
+_exemplars: dict[str, dict[str, dict[float, tuple[str, float, float]]]] = {}
+
+_BUCKET_LINE = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)_bucket\{(?P<labels>[^}]*)\} "
+    r"(?P<value>\S+)$"
+)
+
+
+def _label_value(labels: str, key: str) -> str | None:
+    m = re.search(re.escape(key) + r'="([^"]*)"', labels)
+    return m.group(1) if m else None
+
+
+def _have_exemplars() -> bool:
+    return any(
+        slots
+        for per_agent in _exemplars.values()
+        for slots in per_agent.values()
+    )
+
+
+def _annotate_exemplars(body: bytes) -> bytes:
+    """Append OpenMetrics exemplar comments to the ``_bucket`` lines that
+    have a recorded slot. With no exemplars recorded the body passes
+    through BYTE-IDENTICAL — the default scrape surface is pinned."""
+    if not _have_exemplars():
+        return body
+    out: list[str] = []
+    for line in body.decode("utf-8").split("\n"):
+        m = None if line.startswith("#") else _BUCKET_LINE.match(line)
+        if m is not None:
+            per_agent = _exemplars.get(m.group("name"))
+            if per_agent is not None:
+                labels = m.group("labels")
+                slots = per_agent.get(_label_value(labels, "agent_id") or "")
+                le = _label_value(labels, "le")
+                if slots is not None and le is not None:
+                    bound = float("inf") if le == "+Inf" else float(le)
+                    ex = slots.get(bound)
+                    if ex is not None:
+                        trace_id, value, ts = ex
+                        line = (
+                            f'{line} # {{trace_id="{trace_id}"}} '
+                            f"{value} {ts}"
+                        )
+        out.append(line)
+    return "\n".join(out).encode("utf-8")
 
 
 # ---------------------------------------------------------------------------
@@ -204,6 +273,38 @@ class PrometheusMetricsReporter(MetricsReporter):
             h = _histograms[full].labels(agent_id=self.agent_id)
         return lambda v: h.observe(v)
 
+    def exemplar_histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: tuple[float, ...] | None = None,
+    ) -> Callable[..., None]:
+        """A histogram whose observe callable also accepts an optional
+        ``trace_id``: ``observe(v)`` behaves exactly like
+        :meth:`histogram`'s (untraced traffic changes nothing), while
+        ``observe(v, trace_id)`` additionally stamps the value's bucket
+        slot last-wins — one bounded ``(trace_id, value, ts)`` exemplar
+        per bucket, emitted by :func:`render_metrics` in OpenMetrics
+        exemplar syntax. The extra work on the traced path is one tuple
+        store into a pre-sized dict — wait-free."""
+        full = self._full(name)
+        bounds = tuple(buckets or LATENCY_BUCKETS)
+        observe = self.histogram(name, help, bounds)
+        with _metric_lock:
+            slots = _exemplars.setdefault(full, {}).setdefault(
+                self.agent_id, {}
+            )
+
+        def _observe(v: float, trace_id: str | None = None) -> None:
+            observe(v)
+            if trace_id:
+                le = next(
+                    (b for b in bounds if v <= b), float("inf")
+                )
+                slots[le] = (str(trace_id), float(v), time.time())
+
+        return _observe
+
 
 def render_metrics() -> bytes:
     """Text exposition of every registered series. Always non-empty and
@@ -211,5 +312,7 @@ def render_metrics() -> bytes:
     ``text/plain; version=0.0.4`` regardless of which registry backed it."""
     if not _HAVE_PROM:
         body = _render_fallback()
-        return body if body.strip() else b"# no metrics registered yet\n"
-    return generate_latest(REGISTRY)
+        body = body if body.strip() else b"# no metrics registered yet\n"
+    else:
+        body = generate_latest(REGISTRY)
+    return _annotate_exemplars(body)

@@ -357,6 +357,25 @@ class LocalComputeRuntime:
             e for e in attribution_report() if e.get("model") in models
         ]
 
+    def incidents(
+        self, tenant: str, name: str, bundle_id: str | None = None
+    ) -> list[dict[str, Any]]:
+        """Incident-bundle index (or one full bundle) for the /incidents
+        aggregation route (serving/incident.py), scoped to the app's
+        declared models exactly like :meth:`flight` — a breach bundle
+        carries one tenant's journeys and config, so the scope is a
+        confidentiality boundary, not a convenience."""
+        from langstream_tpu.serving.engine import incident_report
+
+        models = self._declared_models(tenant, name)
+        if models is None:
+            return []
+        return [
+            e
+            for e in incident_report(bundle_id)
+            if e.get("model") in models
+        ]
+
     def agent_info(self, tenant: str, name: str) -> list[dict[str, Any]]:
         runner = self.runners.get((tenant, name))
         return runner.agent_info() if runner else []
@@ -432,6 +451,14 @@ class ControlPlaneServer:
                 web.get(
                     "/api/applications/{tenant}/{name}/journey/{journey_id}",
                     self._journey,
+                ),
+                web.get(
+                    "/api/applications/{tenant}/{name}/incidents",
+                    self._incidents,
+                ),
+                web.get(
+                    "/api/applications/{tenant}/{name}/incidents/{bundle_id}",
+                    self._incidents,
                 ),
                 web.get("/api/applications/{tenant}/{name}/qos", self._qos),
                 web.get(
@@ -893,6 +920,26 @@ class ControlPlaneServer:
         tenant = request.match_info["tenant"]
         name = request.match_info["name"]
         report = await asyncio.to_thread(self.compute.slo, tenant, name)
+        return web.json_response(report)
+
+    async def _incidents(self, request: web.Request) -> web.Response:
+        """Per-application incident-bundle aggregation (beside /flight,
+        same fan-in shape): the bounded index of breach-triggered
+        evidence bundles, or one full bundle by id — in-process
+        recorders in dev mode, per-pod ``/incidents`` endpoints under
+        the k8s compute runtime."""
+        import asyncio
+
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        bundle_id = request.match_info.get("bundle_id")
+        report = await asyncio.to_thread(
+            self.compute.incidents, tenant, name, bundle_id
+        )
+        if bundle_id and not report:
+            raise web.HTTPNotFound(
+                reason=f"unknown incident bundle {bundle_id!r}"
+            )
         return web.json_response(report)
 
     async def _journey(self, request: web.Request) -> web.Response:

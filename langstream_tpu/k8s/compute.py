@@ -343,6 +343,27 @@ class KubernetesComputeRuntime:
                     merged.append({"pod": pod, **entry})
         return merged
 
+    def incidents(
+        self, tenant: str, name: str, bundle_id: str | None = None
+    ) -> list[dict[str, Any]]:
+        """Fan in the application pods' ``/incidents`` payloads — the
+        bounded breach-bundle index per engine per pod (or one full
+        bundle by id), concatenated exactly like :meth:`flight`, with
+        timed-out pods surfaced as ``unreachable`` members: during an
+        incident the replica that stopped answering is evidence, not
+        noise."""
+        path = "/incidents" + (f"/{bundle_id}" if bundle_id else "")
+        merged: list[dict[str, Any]] = []
+        for pod, chunk in self._pod_json_fanin(tenant, name, path):
+            if chunk is None:
+                if bundle_id is None:
+                    merged.append({"pod": pod, "unreachable": True})
+                continue
+            for entry in chunk if isinstance(chunk, list) else []:
+                if isinstance(entry, dict):
+                    merged.append({"pod": pod, **entry})
+        return merged
+
     def _summary_section_fanin(
         self, tenant: str, name: str, section: str
     ) -> dict[str, Any]:

@@ -88,6 +88,11 @@ from langstream_tpu.serving.faults import (
     plans_from_env,
 )
 from langstream_tpu.serving.flight import FlightRecorder
+from langstream_tpu.serving.incident import (
+    IncidentRecorder,
+    breaker_storm,
+    worst_journeys,
+)
 from langstream_tpu.serving.handoff import (
     DeadlineExceeded,
     parse_deadline,
@@ -353,6 +358,12 @@ class ServingConfig:
     # front-of-class, so an engine death no longer silently drops
     # accepted work. None (default) disables — hot path unchanged.
     journal_dir: str | None = None
+    # incident capture plane (serving/incident.py): a directory where an
+    # SLO/health breach snapshots a bounded evidence bundle (flight
+    # summary + event tail, worst-K journeys, attribution, streaming
+    # digests, config fingerprint) the moment the predicate trips.
+    # None (default) disables — observe paths unchanged.
+    incident_dir: str | None = None
     # suffixes longer than this skip the cache and take the full prefill.
     # The continuation path is memory-bounded (blocked online softmax), so
     # this is a kernel-efficiency trade, not an OOM guard: the full prefill
@@ -407,6 +418,7 @@ class ServingConfig:
             "shrink-recovery-s": self.shrink_recovery_s,
             "faults": [p.to_dict() for p in self.faults],
             "journal-dir": self.journal_dir,
+            "incident-dir": self.incident_dir,
         }
 
     @classmethod
@@ -502,6 +514,15 @@ class ServingConfig:
                     d.get(
                         "journal_dir",
                         os.environ.get("LS_TPU_JOURNAL_DIR") or None,
+                    ),
+                )
+            ),
+            incident_dir=(
+                d.get(
+                    "incident-dir",
+                    d.get(
+                        "incident_dir",
+                        os.environ.get("LS_TPU_INCIDENT_DIR") or None,
                     ),
                 )
             ),
@@ -714,6 +735,7 @@ class _DeviceLru:
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
+                # graftcheck: disable=RACE801 device_bytes reads via a single C-level list() snapshot (the OBS505 lock-free reader contract above); the locked writes here never leave a torn view for it to observe
                 self._entries.move_to_end(key)
                 self.hits += 1
                 return entry
@@ -916,8 +938,12 @@ class TpuServingEngine:
             "last_ttft_seconds", "time to first token of the last request"
         )
         # real distributions, not counter-of-sums: p50/p99 TTFT and queue
-        # wait are what the gateway bench and dashboards quantile over
-        self._m_ttft_hist = reporter.histogram(
+        # wait are what the gateway bench and dashboards quantile over.
+        # Exemplar-capable: traced requests stamp their journey id on the
+        # bucket they land in, so a p99 scrape names a journey
+        # `tools/journey.py --trace` can open (untraced traffic records
+        # exactly as before — the scrape stays byte-identical)
+        self._m_ttft_hist = reporter.exemplar_histogram(
             "ttft_seconds", "engine time-to-first-token (enqueue to token 1)"
         )
         self._m_queue_wait_hist = reporter.histogram(
@@ -1156,12 +1182,12 @@ class TpuServingEngine:
                 "KV handoffs decoded LOCALLY after the re-offer cap "
                 "(every decode replica dead, held, or refusing)",
             )
-            self._m_kv_export_hist = reporter.histogram(
+            self._m_kv_export_hist = reporter.exemplar_histogram(
                 "kv_export_seconds",
                 "device gather + serialization wall time per KV handoff "
                 "export (prefill pool)",
             )
-            self._m_kv_import_hist = reporter.histogram(
+            self._m_kv_import_hist = reporter.exemplar_histogram(
                 "kv_import_seconds",
                 "block allocation + device scatter wall time per KV "
                 "handoff import (decode pool)",
@@ -1404,6 +1430,17 @@ class TpuServingEngine:
             self._journal_replay_pending = self.journal.pending()
         else:
             self._journal_replay_pending = []
+        # incident capture plane (serving/incident.py): breach-triggered
+        # evidence bundles. None (the default) keeps every observe path
+        # one attribute test against None — byte-identical to pre-plane.
+        self.incidents: IncidentRecorder | None = None
+        if config.incident_dir:
+            self.incidents = IncidentRecorder(
+                config.incident_dir,
+                on_evict=lambda bid: self.flight.event(
+                    "incident-evict", bundle=bid
+                ),
+            )
 
     # ------------------------------------------------------------------
     # model + jit setup
@@ -2253,6 +2290,21 @@ class TpuServingEngine:
                 budget_remaining=verdict["budget_remaining"],
                 target=verdict["target"],
             )
+            if verdict["alerting"] and self.incidents is not None:
+                # page-threshold crossing: snapshot the evidence at the
+                # breach instant (per-objective cooldown in the recorder)
+                self._incident_capture(
+                    "slo-fast-burn",
+                    {
+                        "source": "slo",
+                        "objective": objective,
+                        "burn_rate_fast": verdict["burn_rate_fast"],
+                        "burn_rate_slow": verdict["burn_rate_slow"],
+                        "budget_remaining": verdict["budget_remaining"],
+                        "target": verdict["target"],
+                    },
+                    dedup_key=objective,
+                )
 
     def health(self) -> dict[str, Any]:
         """Wait-free health snapshot (OBS504: callable from probe
@@ -2304,6 +2356,46 @@ class TpuServingEngine:
                 queued=queued,
                 occupancy=occupancy,
             )
+            if self.incidents is not None and verdict["state"] in (
+                "degraded",
+                "wedged",
+            ):
+                # a worsening transition is a page: classify the trigger
+                # by the dominant reason so the bundle's worst-K journeys
+                # rank by the segment that reason indicts
+                reasons = list(verdict["reasons"])
+                if verdict["state"] == "wedged":
+                    kind = "health-wedged"
+                elif any("memory pressure" in r for r in reasons):
+                    kind = "shrink-pressure"
+                elif any("tbt burn" in r for r in reasons):
+                    kind = "tbt-burn"
+                else:
+                    kind = "health-degraded"
+                self._incident_capture(
+                    kind,
+                    {
+                        "source": "health",
+                        "state": verdict["state"],
+                        "previous": verdict["previous"],
+                        "reasons": reasons,
+                        "queued": queued,
+                        "occupancy": occupancy,
+                    },
+                )
+        if self.incidents is not None:
+            # breaker-storm predicate over the already-snapshotted event
+            # tail (router breaker events mirror into this ring): fires
+            # independently of watchdog transitions — a replica fanout
+            # melting down is an incident even while this engine's own
+            # loop is healthy
+            storm = breaker_storm(
+                self.flight.recent_events(256), time.monotonic()
+            )
+            if storm is not None:
+                self._incident_capture(
+                    "breaker-storm", {"source": "health", **storm}
+                )
         warmup = self._warmup_state()
         # a draining engine is alive but must take no new traffic: ready
         # drops (the router and the readiness probe both key off it)
@@ -2336,6 +2428,54 @@ class TpuServingEngine:
             # list and the DEGRADED verdict can never disagree
             out["tbt_burn"] = sorted(tbt_burn)
         return out
+
+    def _incident_capture(
+        self,
+        kind: str,
+        evidence: dict[str, Any],
+        dedup_key: str | None = None,
+    ) -> None:
+        """Assemble one incident bundle at the breach site and hand it to
+        the recorder's writer thread. Wait-free end to end (graftcheck
+        INC1601): the cooldown gate is GIL-atomic dict ops, every section
+        is wait-free by its own contract (flight summary, journey-ledger
+        snapshots, attribution/survival/kvtransfer, SLO status), and the
+        handoff is a deque append — this runs inside ``health()`` (probe
+        handlers, OBS504's domain) and the finish path."""
+        rec = self.incidents
+        if rec is None or not rec.should_capture(kind, dedup_key):
+            return
+        # event-tail slice: only events past the recorder's seq
+        # high-water mark, so overlapping captures dedup exactly
+        events = self.flight.recent_events(256)
+        watermark = rec.last_event_seq
+        fresh = [e for e in events if e.get("seq", 0) > watermark]
+        if events:
+            rec.last_event_seq = max(watermark, events[-1].get("seq", 0))
+        bundle: dict[str, Any] = {
+            # wall anchor for cross-pod timeline alignment only
+            # graftcheck: disable=OBS501 display anchor, never subtracted
+            "captured_at_ms": round(time.time() * 1000.0, 3),
+            "model": self.config.model,
+            "trigger": {"kind": kind, **evidence},
+            "flight": self.flight.summary(),
+            "events": fresh,
+            "worst_journeys": worst_journeys(kind),
+            "attribution": self.attribution_section(),
+            "survival": self.survival_section(),
+            "kvtransfer": self.kv_transfer_section(),
+            "breakers": {
+                "open": self.flight.events_by_type.get("breaker-open", 0),
+                "close": self.flight.events_by_type.get("breaker-close", 0),
+            },
+            "slo": self.slo_status(),
+            "streaming": (
+                self.streaming_section() if self.config.streaming else None
+            ),
+            "config": self.config.to_dict(),
+        }
+        bundle_id = rec.submit(bundle)
+        self.flight.event("incident", bundle=bundle_id, trigger=kind)
 
     def _warmup_state(self) -> str:
         """``not-required`` (no warmup_on_start), ``pending`` (gate armed
@@ -2798,6 +2938,10 @@ class TpuServingEngine:
                 # FLOPs, not host overhead
                 "rejected": self.spec_rejected,
             }
+        if self.incidents is not None:
+            # incident capture plane: captured/suppressed/evicted counts
+            # plus the bounded bundle index (docs/OBSERVABILITY.md)
+            out["incidents"] = self.incidents.stats()
         return out
 
     async def close(self) -> None:
@@ -2813,6 +2957,10 @@ class TpuServingEngine:
             # flush the retire tail: a clean shutdown leaves a journal
             # that replays exactly the work this process never answered
             self.journal.close()
+        if self.incidents is not None:
+            # flush any in-flight bundle: evidence captured moments
+            # before a shutdown is exactly the evidence worth keeping
+            self.incidents.close()
         # wait=True: the loop task above is done, so the executor queue is
         # empty or finishing its last closure — joining it here is what
         # makes the reference drops below race-free (the dispatch thread
@@ -3271,13 +3419,21 @@ class TpuServingEngine:
                  "tokens": float(len(request.generated)),
                  "handoff": 1.0}
             )
-            self._m_ttft_hist(timings["ttft"])
+            # exemplar: traced requests stamp their journey id on the
+            # TTFT bucket (None for untraced — the scrape stays pinned)
+            self._m_ttft_hist(
+                timings["ttft"],
+                request.journey_id if request.trace is not None else None,
+            )
             self._m_queue_wait_hist(timings["queue_wait"])
             self._slo_record("availability", True)
             self._slo_record_latency("ttft", timings["ttft"])
             self._slo_record_latency("queue-wait", timings["queue_wait"])
         if self._m_kv_export_hist is not None:
-            self._m_kv_export_hist(time.monotonic() - t_start)
+            self._m_kv_export_hist(
+                time.monotonic() - t_start,
+                request.journey_id if request.trace is not None else None,
+            )
         if self._m_kv_export_bytes is not None and not request.warmup:
             self._m_kv_export_bytes(len(payload))
         self.flight.event(
@@ -3708,7 +3864,12 @@ class TpuServingEngine:
             self.kv_imports_total += 1
             self.kv_import_bytes += nbytes
             if self._m_kv_import_hist is not None:
-                self._m_kv_import_hist(time.monotonic() - t_start)
+                self._m_kv_import_hist(
+                    time.monotonic() - t_start,
+                    request.journey_id
+                    if request.trace is not None
+                    else None,
+                )
             if self._m_kv_import_bytes is not None:
                 self._m_kv_import_bytes(nbytes)
             self.flight.event(
@@ -6118,7 +6279,7 @@ class TpuServingEngine:
         if h is None:
             h = PrometheusMetricsReporter(
                 prefix="langstream_stream", agent_id=cls_name
-            ).histogram(
+            ).exemplar_histogram(
                 "tbt_seconds",
                 "streaming inter-chunk interval (time between token "
                 "deliveries) by QoS class",
@@ -6172,7 +6333,12 @@ class TpuServingEngine:
                     digest = TbtDigest()
                     self._stream_tbt_by_class[request.priority] = digest
                 digest.add(interval)
-                self._stream_tbt_hist(request.priority)(interval)
+                self._stream_tbt_hist(request.priority)(
+                    interval,
+                    request.journey_id
+                    if request.trace is not None
+                    else None,
+                )
                 threshold = self._stream_stall_threshold(request.priority)
                 if interval > threshold:
                     request.stream_stalls += 1
@@ -6357,7 +6523,15 @@ class TpuServingEngine:
                 # decomposition (a warmup_on_start engine created lazily
                 # inside the measured window)
                 self.request_timings.append(timing)
-                self._m_ttft_hist(timing["ttft"])
+                # exemplar: a traced request's journey id rides the TTFT
+                # bucket it lands in (None for untraced traffic — the
+                # default scrape stays byte-identical)
+                self._m_ttft_hist(
+                    timing["ttft"],
+                    request.journey_id
+                    if request.trace is not None
+                    else None,
+                )
                 self._m_queue_wait_hist(timing["queue_wait"])
                 # SLO evidence (no-ops without a declared objective): a
                 # served request is availability-good, and the tracker
@@ -6398,6 +6572,30 @@ class TpuServingEngine:
                                 ],
                                 target=verdict["target"],
                             )
+                            if verdict["alerting"]:
+                                # the streaming SLO paged: capture at the
+                                # breach, keyed per class so one flapping
+                                # class can't spam (cooldown + dedup in
+                                # the recorder; no-op without
+                                # incident-dir)
+                                self._incident_capture(
+                                    "tbt-burn",
+                                    {
+                                        "source": "stream-slo",
+                                        "objective": (
+                                            f"tbt:{request.priority}"
+                                        ),
+                                        "tbt_p99_s": p99,
+                                        "burn_rate_fast": verdict[
+                                            "burn_rate_fast"
+                                        ],
+                                        "budget_remaining": verdict[
+                                            "budget_remaining"
+                                        ],
+                                        "target": verdict["target"],
+                                    },
+                                    dedup_key=request.priority,
+                                )
             self._journey(
                 request, "finish",
                 reason=(
@@ -6487,6 +6685,16 @@ def flight_report(
             # configured engines only — the default payload stays
             # byte-identical (the non-streaming pin)
             entry["streaming"] = engine.streaming_section()
+        if engine.incidents is not None:
+            # incident-capture posture (docs/OBSERVABILITY.md "Incident
+            # bundles & exemplars"): rides /flight/summary so engine_top's
+            # incidents panel and the control-plane fan-in need no extra
+            # engine surface. Present only when incident-dir is
+            # configured — the default payload stays byte-identical
+            entry["incidents"] = {
+                **engine.incidents.stats(),
+                "recent": engine.incidents.list()[-4:],
+            }
         slo = engine.slo_status()
         if slo is not None:
             entry["slo"] = slo
@@ -6522,6 +6730,33 @@ def health_report() -> list[dict[str, Any]]:
     return [
         engine.health() for engine in list(TpuServingEngine._instances.values())
     ]
+
+
+def incident_report(bundle_id: str | None = None) -> list[dict[str, Any]]:
+    """Per-engine incident payloads for the pod ``GET /incidents``
+    endpoint and the control-plane fan-in: the bounded bundle index per
+    engine (plus capture stats), or — with ``bundle_id`` — the full
+    bundle from whichever engine holds it. The instance map is
+    snapshotted WITHOUT ``_instances_lock`` (the :func:`health_report`
+    rationale — an evidence poll during an incident is exactly when the
+    lock might be held); the recorder's own table lock is the serving
+    thread's, never the hot path's."""
+    report: list[dict[str, Any]] = []
+    for engine in list(TpuServingEngine._instances.values()):
+        rec = engine.incidents
+        if rec is None:
+            continue
+        entry: dict[str, Any] = {"model": engine.config.model}
+        if bundle_id is not None:
+            bundle = rec.get(bundle_id)
+            if bundle is None:
+                continue
+            entry["bundle"] = bundle
+        else:
+            entry["incidents"] = rec.list()
+            entry["stats"] = rec.stats()
+        report.append(entry)
+    return report
 
 
 def kick_warmups() -> None:

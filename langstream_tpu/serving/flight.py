@@ -127,6 +127,7 @@ class FlightRecorder:
         self._samples: deque[dict[str, Any]] = deque(maxlen=self.capacity)
         self._events: deque[dict[str, Any]] = deque(maxlen=512)
         self._seq = 0
+        self._event_seq = 0
         self._last_mark = time.monotonic()
         # cumulative counters: exact over the engine's whole life, immune
         # to ring eviction (plain attributes — engine loop is the only
@@ -290,9 +291,13 @@ class FlightRecorder:
         self.events_by_type[kind] = self.events_by_type.get(kind, 0) + 1
         if kind == "recompile":
             self.recompiles += 1
+        # per-recorder monotonic event sequence: same-millisecond events
+        # stay totally ordered, so tail consumers (the watchdog's 256-event
+        # window, incident capture) dedup by seq instead of timestamp ties
+        self._event_seq += 1
         self._events.append(
             {
-                "seq": self._seq,
+                "seq": self._event_seq,
                 # graftcheck: disable=OBS501 display anchor, never subtracted
                 "t_ms": round(time.time() * 1000.0, 3),
                 # monotonic stamp for the live health predicates

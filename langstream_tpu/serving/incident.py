@@ -1,0 +1,376 @@
+"""SLO-triggered incident bundles: the flight-data-recorder capture plane.
+
+Every measurement plane this engine carries — flight ring, journey
+ledgers, attribution, streaming TBT digests, burn-rate paging in
+``health()`` — is *live state*: when a page actually fires, a human must
+race to point ``engine_top`` at the pod before the rings age out. This
+module closes that race (docs/OBSERVABILITY.md, *Incident bundles &
+exemplars*): the moment a breach predicate trips, the engine snapshots
+the evidence it already holds into a bounded **incident bundle** on
+disk, so the post-mortem starts from the breach instant, not from
+whenever a human arrived.
+
+Triggers (wired in ``serving/engine.py``):
+
+- a health-state transition out of OK (``health-degraded`` /
+  ``health-wedged``), with the watchdog's reasons as evidence;
+- ``shrink-pressure`` — the device-memory-pressure reason specifically
+  (repeated pool shrinks inside one recovery window);
+- ``slo-fast-burn`` — an SLO objective's multi-window burn rate crossed
+  the page threshold (serving/slo.py);
+- ``tbt-burn`` — the streaming time-between-tokens objective paged
+  (PR 17's plane);
+- ``breaker-storm`` — ≥ ``k`` ``breaker-open`` events inside one window
+  of the engine's event ring (:func:`breaker_storm` below).
+
+Capture discipline (graftcheck rule INC1601 gates this): the observe
+side — :meth:`IncidentRecorder.should_capture`, the bundle handoff
+:meth:`IncidentRecorder.submit`, and the engine's assembly method —
+runs inside ``health()`` / the finish path / the SLO emit path, all of
+which sit on or adjacent to the engine hot loop. It is therefore
+**wait-free**: cooldown stamps and suppression counters live in plain
+dicts (GIL-atomic; the trigger vocabulary bounds them), the bundle is
+assembled from sections that are wait-free by contract (flight
+summary, journey-ledger snapshots, attribution/survival/kvtransfer
+sections), and the handoff is a deque append + event set — the exact
+shape ``journal.py`` proved. The writer thread owns ALL file I/O and
+the bundle table; ``list()``/``get()``/``stats()`` read that table
+under one uncontended lock from the pod's serving thread (never the
+hot path).
+
+Durability: one JSON file per bundle, write-then-rename
+(``incident-<n>-<kind>.json``), bounded to ``max_bundles`` on disk and
+in memory — the oldest bundle is evicted LOUDLY (``on_evict`` → an
+``incident-evict`` flight event). A flapping predicate cannot spam:
+captures dedup per ``(kind, dedup key)`` under a cooldown, and
+suppressed breaches are counted, not silently dropped. Bundles already
+on disk at construction are re-indexed, so a restarted pod still
+serves its history under ``GET /incidents``.
+
+Event-tail dedup: flight events carry a per-recorder monotonic ``seq``,
+and the recorder keeps a high-water mark — overlapping captures slice
+the tail at ``seq > watermark``, so two bundles seconds apart never
+double-report the same event.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+# IncidentRecorder.list() shadows the builtin inside the class body
+builtin_list = list
+
+#: capture-trigger kinds (the cooldown/dedup vocabulary — bounds the
+#: stamp dicts by construction)
+TRIGGER_KINDS = (
+    "health-degraded",
+    "health-wedged",
+    "shrink-pressure",
+    "slo-fast-burn",
+    "tbt-burn",
+    "breaker-storm",
+)
+
+#: trigger kind → the journey segment it indicts: worst-K ledgers are
+#: ranked by time spent THERE, so a TBT page surfaces the slowest
+#: streamers, not the longest prompts. None ranks by total journey time.
+OFFENDING_SEGMENT: dict[str, str | None] = {
+    "health-degraded": None,
+    "health-wedged": None,
+    "shrink-pressure": "decode",
+    "slo-fast-burn": "queue",
+    "tbt-burn": "stream",
+    "breaker-storm": "transfer",
+}
+
+
+def breaker_storm(
+    events: list[dict[str, Any]],
+    now_s: float,
+    k: int = 3,
+    window_s: float = 30.0,
+) -> dict[str, Any] | None:
+    """The breaker-storm predicate: ≥ ``k`` ``breaker-open`` events whose
+    monotonic stamp falls inside the trailing ``window_s`` of the event
+    tail. Returns the evidence dict (count + the opens) or None. Pure
+    function over an already-snapshotted tail — wait-free."""
+    opens = [
+        e
+        for e in events
+        if e.get("kind") == "breaker-open"
+        and e.get("m_s") is not None
+        and now_s - e["m_s"] <= window_s
+    ]
+    if len(opens) < k:
+        return None
+    return {
+        "count": len(opens),
+        "window_s": window_s,
+        "replicas": sorted(
+            {e.get("replica") for e in opens if e.get("replica")}
+        ),
+        "opens": opens[-k:],
+    }
+
+
+def worst_journeys(kind: str, k: int = 3) -> list[dict[str, Any]]:
+    """The worst-``k`` journey ledgers ranked by time spent in the
+    trigger's offending segment (:data:`OFFENDING_SEGMENT`; total
+    journey time when the trigger indicts no one segment). Snapshot
+    reads over the bounded global ledger — wait-free by the ledger's
+    contract."""
+    from langstream_tpu.serving.journey import JOURNEYS, segments
+
+    segment = OFFENDING_SEGMENT.get(kind)
+    ranked: list[tuple[float, str, list, list]] = []
+    for jid in JOURNEYS.ids():
+        events = JOURNEYS.events(jid)
+        if not events:
+            continue
+        segs = segments(events)
+        total = sum(s.get("ms", 0.0) for s in segs)
+        if segment is None:
+            score = total
+        else:
+            score = sum(
+                s.get("ms", 0.0) for s in segs if s.get("segment") == segment
+            )
+        ranked.append((score, jid, segs, events))
+    ranked.sort(key=lambda t: t[0], reverse=True)
+    out = []
+    for score, jid, segs, events in ranked[:k]:
+        out.append(
+            {
+                "journey": jid,
+                "offending_segment": segment,
+                "offending_ms": round(score, 3),
+                "segments": segs,
+                "events": events,
+            }
+        )
+    return out
+
+
+class IncidentRecorder:
+    """Bounded on-disk incident-bundle store with a wait-free capture
+    side. One instance per engine (``incident-dir`` config)."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_bundles: int = 32,
+        cooldown_s: float = 60.0,
+        on_evict: Callable[[str], None] | None = None,
+    ):
+        self.directory = directory
+        self.max_bundles = max(1, int(max_bundles))
+        self.cooldown_s = float(cooldown_s)
+        self._on_evict = on_evict
+        os.makedirs(directory, exist_ok=True)
+        # -- observe-side state: GIL-atomic containers, NO lock ----------
+        # (INC1601 polices should_capture/submit — a lock here would put
+        # a wait on the health()/finish paths)
+        self._last_capture: dict[str, float] = {}
+        self.suppressed: dict[str, int] = {}
+        self.captured = 0
+        #: flight-event seq high-water mark (overlap dedup across bundles)
+        self.last_event_seq = 0
+        # -- writer-side state: bundle table + counters under one lock ---
+        self._lock = threading.Lock()
+        self._bundles: "OrderedDict[str, dict[str, Any]]" = OrderedDict()
+        self._written = 0
+        self._evicted = 0
+        self._write_errors = 0
+        self._seq = self._load_existing()
+        self._pending: deque = deque()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._closed = threading.Event()
+        self._writer = threading.Thread(
+            target=self._run_writer,
+            name="incident-recorder",
+            daemon=True,
+        )
+        self._writer.start()
+
+    # -- construction-time reload (single-threaded) ----------------------
+
+    def _load_existing(self) -> int:
+        """Re-index bundles a previous life left on disk (oldest beyond
+        the bound deleted loudly), returning the next bundle sequence
+        number. Unreadable files are skipped, never fatal."""
+        names = sorted(
+            n
+            for n in os.listdir(self.directory)
+            if n.startswith("incident-") and n.endswith(".json")
+        )
+        seq = 0
+        for name in names:
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    bundle = json.load(fh)
+            except (OSError, ValueError) as e:
+                log.warning("skipping unreadable incident bundle %s: %s", path, e)
+                continue
+            bid = bundle.get("id") or name[: -len(".json")]
+            self._bundles[bid] = bundle
+            try:
+                seq = max(seq, int(bid.split("-")[1]))
+            except (IndexError, ValueError):
+                pass
+        while len(self._bundles) > self.max_bundles:
+            old_id, _ = self._bundles.popitem(last=False)
+            self._evicted += 1
+            self._remove_file(old_id)
+        return seq
+
+    # -- wait-free capture side ------------------------------------------
+
+    def should_capture(self, kind: str, dedup_key: str | None = None) -> bool:
+        """Cooldown/dedup gate, called at the breach site. Wait-free:
+        one monotonic read plus GIL-atomic dict ops on a dict whose key
+        space is the trigger vocabulary (× per-trigger dedup keys such
+        as the SLO objective name) — bounded by construction."""
+        if self._closed.is_set():
+            return False
+        key = kind if dedup_key is None else f"{kind}:{dedup_key}"
+        now_s = time.monotonic()
+        last = self._last_capture.get(key)
+        if last is not None and now_s - last < self.cooldown_s:
+            self.suppressed[kind] = self.suppressed.get(kind, 0) + 1
+            return False
+        self._last_capture[key] = now_s
+        return True
+
+    def submit(self, bundle: dict[str, Any]) -> str:
+        """Hand an assembled bundle to the writer thread: stamp its id,
+        append, wake. Wait-free — the same handoff shape as
+        ``journal.admit``."""
+        self.captured += 1
+        bundle_id = "incident-%06d-%s" % (
+            self._seq + self.captured,
+            bundle.get("trigger", {}).get("kind", "unknown"),
+        )
+        bundle["id"] = bundle_id
+        self._pending.append(bundle)
+        self._idle.clear()
+        self._wake.set()
+        return bundle_id
+
+    # -- serving-side reads (pod HTTP thread; one uncontended lock) ------
+
+    def list(self) -> list[dict[str, Any]]:
+        """Bounded bundle summaries, oldest first — the ``GET
+        /incidents`` index payload."""
+        with self._lock:
+            bundles = builtin_list(self._bundles.values())
+        return [
+            {
+                "id": b.get("id"),
+                "kind": b.get("trigger", {}).get("kind"),
+                "captured_at_ms": b.get("captured_at_ms"),
+                "reasons": b.get("trigger", {}).get("reasons"),
+                "journeys": len(b.get("worst_journeys") or ()),
+                "events": len(b.get("events") or ()),
+            }
+            for b in bundles
+        ]
+
+    def get(self, bundle_id: str) -> dict[str, Any] | None:
+        with self._lock:
+            return self._bundles.get(bundle_id)
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            live = len(self._bundles)
+            written = self._written
+            evicted = self._evicted
+            write_errors = self._write_errors
+        return {
+            "dir": self.directory,
+            "live": live,
+            "captured": self.captured,
+            "written": written,
+            "evicted": evicted,
+            "write_errors": write_errors,
+            "suppressed": dict(self.suppressed),
+            "pending": len(self._pending),
+            "cooldown_s": self.cooldown_s,
+            "max_bundles": self.max_bundles,
+        }
+
+    # -- writer thread ---------------------------------------------------
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every submitted bundle reached disk (tests, drain)."""
+        return self._idle.wait(timeout)
+
+    def close(self, timeout: float = 5.0) -> None:
+        if self._closed.is_set():
+            return
+        self.flush(timeout)
+        self._closed.set()
+        self._wake.set()
+        self._writer.join(timeout)
+
+    def _run_writer(self) -> None:
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            try:
+                self._drain()
+            except OSError as e:
+                # disk trouble must never take the engine down: the
+                # capture plane degrades loudly, serving continues
+                log.error("incident bundle write failed: %s", e)
+                self._write_errors += 1
+            if not self._pending:
+                self._idle.set()
+                if self._closed.is_set():
+                    return
+
+    def _drain(self) -> None:
+        while self._pending:
+            bundle = self._pending.popleft()
+            bundle_id = bundle["id"]
+            path = os.path.join(self.directory, bundle_id + ".json")
+            # write-then-rename: a crash mid-write leaves no torn bundle
+            tmp = f"{path}.tmp.{os.getpid()}"
+            evicted: list[str] = []
+            try:
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(bundle, fh, sort_keys=True, default=str)
+                    fh.flush()
+                os.replace(tmp, path)
+            except OSError:
+                self._write_errors += 1
+                raise
+            with self._lock:
+                self._bundles[bundle_id] = bundle
+                self._written += 1
+                while len(self._bundles) > self.max_bundles:
+                    old_id, _ = self._bundles.popitem(last=False)
+                    self._evicted += 1
+                    evicted.append(old_id)
+            for old_id in evicted:
+                # file removal + callbacks OUTSIDE the lock (the callback
+                # appends a flight event)
+                self._remove_file(old_id)
+                if self._on_evict is not None:
+                    self._on_evict(old_id)
+
+    def _remove_file(self, bundle_id: str) -> None:
+        try:
+            os.remove(os.path.join(self.directory, bundle_id + ".json"))
+        except OSError:
+            pass

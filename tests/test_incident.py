@@ -1,0 +1,698 @@
+"""Incident capture plane tests (docs/OBSERVABILITY.md, *Incident
+bundles & exemplars*).
+
+Layers covered: the IncidentRecorder units (cooldown/dedup suppression,
+write-then-rename durability with restart re-indexing, loud bounded
+eviction, the breaker-storm predicate), the flight recorder's monotonic
+event ``seq``, the chaos e2e acceptance (an injected OOM burst drives
+``health()`` OK→DEGRADED and exactly ONE bundle captures — trigger
+evidence, the ``fault-injected`` event ordered by seq, worst-K journeys
+ranked by the offending segment — while a second breach inside the
+cooldown captures nothing), the default-config pins (no ``incident-dir``
+→ greedy output AND the ``/metrics`` scrape byte-identical to a
+configured engine's), histogram tail exemplars (a traced request's
+journey id rides its TTFT bucket and resolves end-to-end through
+``tools/journey.py --trace``), the strict OpenMetrics line-grammar
+conformance of the scrape, the pod ``GET /incidents[/{id}]`` endpoints,
+``engine_top``'s incidents panel + ``--json`` mirror + capture-storm
+anomaly flag, ``perf_diff --gate``'s TBT regression gate, and the
+docs-drift conformance test that pins the flight-event vocabulary table
+against every ``flight.event(...)`` call site in BOTH directions.
+"""
+
+import ast
+import asyncio
+import importlib.util
+import json
+import re
+import socket
+import time
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+from langstream_tpu.core.tracing import TraceContext
+from langstream_tpu.core import tracing
+from langstream_tpu.serving.faults import FaultPlan
+from langstream_tpu.serving.flight import FlightRecorder
+from langstream_tpu.serving.incident import (
+    IncidentRecorder,
+    OFFENDING_SEGMENT,
+    TRIGGER_KINDS,
+    breaker_storm,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_tool(name: str):
+    path = REPO / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _base_config(**kw):
+    from langstream_tpu.serving.engine import ServingConfig
+
+    d = dict(
+        model="tiny", slots=4, max_seq_len=192, model_dtype="float32",
+        kv_layout="paged", kv_block_size=16, decode_chunk=4,
+        default_max_tokens=24, shrink_recovery_s=0.3,
+    )
+    d.update(kw)
+    return ServingConfig(**d)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# IncidentRecorder units
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_cooldown_dedup_and_suppression(tmp_path):
+    rec = IncidentRecorder(str(tmp_path), cooldown_s=60.0)
+    try:
+        assert rec.should_capture("health-degraded")
+        # same kind inside the cooldown: suppressed, counted
+        assert not rec.should_capture("health-degraded")
+        assert rec.suppressed["health-degraded"] == 1
+        # a different kind has its own stamp
+        assert rec.should_capture("tbt-burn", dedup_key="interactive")
+        # same kind, different dedup key: a distinct flapping source
+        assert rec.should_capture("tbt-burn", dedup_key="batch")
+        assert not rec.should_capture("tbt-burn", dedup_key="batch")
+        assert rec.suppressed["tbt-burn"] == 1
+    finally:
+        rec.close()
+    # a closed recorder refuses silently (engine shutdown races)
+    assert not rec.should_capture("health-degraded")
+
+
+def test_recorder_submit_write_rename_and_reload(tmp_path):
+    rec = IncidentRecorder(str(tmp_path))
+    bid = rec.submit({"trigger": {"kind": "health-degraded",
+                                  "reasons": ["r1"]},
+                      "captured_at_ms": 1.0, "events": [],
+                      "worst_journeys": []})
+    assert rec.flush()
+    rec.close()
+    assert bid == "incident-000001-health-degraded"
+    path = tmp_path / f"{bid}.json"
+    assert path.exists()
+    # write-then-rename left no torn temp file behind
+    assert not list(tmp_path.glob("*.tmp.*"))
+    assert json.loads(path.read_text())["id"] == bid
+
+    # a restarted recorder re-indexes disk and continues the sequence
+    rec2 = IncidentRecorder(str(tmp_path))
+    try:
+        assert [b["id"] for b in rec2.list()] == [bid]
+        assert rec2.get(bid)["trigger"]["reasons"] == ["r1"]
+        bid2 = rec2.submit({"trigger": {"kind": "breaker-storm"}})
+        assert bid2 == "incident-000002-breaker-storm"
+        assert rec2.flush()
+    finally:
+        rec2.close()
+
+
+def test_recorder_bound_evicts_oldest_loudly(tmp_path):
+    evicted = []
+    rec = IncidentRecorder(str(tmp_path), max_bundles=2,
+                           on_evict=evicted.append)
+    try:
+        ids = []
+        for i in range(3):
+            # distinct dedup keys dodge the cooldown for the unit
+            assert rec.should_capture("slo-fast-burn", dedup_key=f"o{i}")
+            ids.append(rec.submit({"trigger": {"kind": "slo-fast-burn"}}))
+        assert rec.flush()
+        stats = rec.stats()
+        assert stats["live"] == 2 and stats["evicted"] == 1
+        assert stats["captured"] == 3 and stats["written"] == 3
+        assert evicted == [ids[0]]
+        assert not (tmp_path / f"{ids[0]}.json").exists()
+        assert [b["id"] for b in rec.list()] == ids[1:]
+    finally:
+        rec.close()
+
+
+def test_breaker_storm_predicate():
+    now = 1000.0
+    opens = [{"kind": "breaker-open", "m_s": now - i, "replica": f"r{i}"}
+             for i in range(3)]
+    storm = breaker_storm(opens, now)
+    assert storm is not None
+    assert storm["count"] == 3
+    assert storm["replicas"] == ["r0", "r1", "r2"]
+    # below k: quiet
+    assert breaker_storm(opens[:2], now) is None
+    # stale opens outside the window: quiet
+    old = [{**e, "m_s": now - 300.0} for e in opens]
+    assert breaker_storm(old, now) is None
+    # close events never count as opens
+    closes = [{"kind": "breaker-close", "m_s": now} for _ in range(5)]
+    assert breaker_storm(closes, now) is None
+
+
+def test_trigger_vocabulary_covers_segment_map():
+    # every trigger kind has a declared offending-segment verdict (None
+    # = rank by total journey time), and nothing else does
+    assert set(OFFENDING_SEGMENT) == set(TRIGGER_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# flight events: monotonic seq (the bundle-overlap dedup key)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_event_seq_monotonic_and_dense():
+    flight = FlightRecorder(slots=2)
+    for i in range(8):
+        flight.event("drain", step=i)
+    events = flight.recent_events(0)
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    assert len(set(seqs)) == len(seqs)
+    # dense from 1: overlapping captures can slice by "seq > watermark"
+    # without timestamp ties losing events
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: breach → exactly one bundle with the evidence
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_breach_captures_one_bundle_with_evidence(run_async, tmp_path):
+    """The acceptance proof: an injected RESOURCE_EXHAUSTED burst at the
+    pool-grow seam shrinks the budget twice inside one recovery window,
+    the next ``health()`` transitions OK→DEGRADED with the memory-
+    pressure reason, and exactly ONE ``shrink-pressure`` bundle
+    snapshots the evidence — the ``fault-injected`` event ordered by
+    seq, worst-K journeys ranked by the decode segment — while a second
+    breach inside the cooldown is suppressed, not captured."""
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    incident_dir = tmp_path / "incidents"
+    config = _base_config(
+        incident_dir=str(incident_dir),
+        # a wide recovery window so both shrinks are still inside it
+        # when health() judges the ring after the flood
+        shrink_recovery_s=5.0,
+        faults=(FaultPlan(site="pool-grow", after=3, count=2),),
+    )
+
+    async def run():
+        engine = TpuServingEngine(config)
+        try:
+            outs = await asyncio.gather(*(
+                engine.generate(f"chaos request {i} says hello",
+                                {"max-tokens": 16, "temperature": 0})
+                for i in range(6)
+            ))
+            health = engine.health()
+            # a second breach of the same trigger inside the cooldown:
+            # suppressed and counted, never a second bundle
+            engine._incident_capture(
+                "shrink-pressure", {"source": "second-breach"}
+            )
+            stats = engine.incidents.stats()
+            assert engine.incidents.flush()
+            index = engine.incidents.list()
+            bundle = engine.incidents.get(index[-1]["id"]) if index else None
+            events = engine.flight.recent_events(0)
+            return outs, health, stats, index, bundle, events
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    outs, health, stats, index, bundle, events = run_async(run())
+
+    assert all(o["tokens"] for o in outs)  # zero loss under the fault
+    assert health["state"] == "degraded"
+    assert any("memory pressure" in r for r in health["reasons"])
+
+    # exactly one capture; the second breach was suppressed, loudly
+    assert stats["captured"] == 1
+    assert stats["suppressed"].get("shrink-pressure", 0) >= 1
+    assert len(index) == 1 and bundle is not None
+    assert bundle["trigger"]["kind"] == "shrink-pressure"
+    assert any("memory pressure" in r
+               for r in bundle["trigger"]["reasons"])
+
+    # the bundle's event tail holds the cause, ordered by seq
+    kinds_by_seq = [(e["seq"], e["kind"]) for e in bundle["events"]]
+    seqs = [s for s, _ in kinds_by_seq]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    kinds = [k for _, k in kinds_by_seq]
+    assert "fault-injected" in kinds and "pool-shrink" in kinds
+    assert kinds.index("fault-injected") < kinds.index("pool-shrink")
+
+    # worst-K journeys ranked by the trigger's offending segment
+    assert bundle["worst_journeys"]
+    for j in bundle["worst_journeys"]:
+        assert j["offending_segment"] == "decode"
+        assert j["segments"] and j["events"]
+
+    # the config fingerprint rode along
+    assert bundle["config"]["incident-dir"] == str(incident_dir)
+
+    # durable: exactly one bundle file on disk, id-matched
+    files = sorted(incident_dir.glob("incident-*.json"))
+    assert [f.stem for f in files] == [bundle["id"]]
+
+    # the capture is itself flight evidence (and engine_top's storm flag
+    # feeds off this kind)
+    captures = [e for e in events if e["kind"] == "incident"]
+    assert len(captures) == 1
+    assert captures[0]["bundle"] == bundle["id"]
+    assert captures[0]["trigger"] == "shrink-pressure"
+
+
+def test_default_config_stays_byte_identical(run_async, monkeypatch):
+    """The opt-in pin: without ``incident-dir`` the engine carries no
+    recorder, no stats/flight sections, and the greedy output is
+    byte-identical to a configured engine's — the capture plane observes,
+    never perturbs."""
+    from langstream_tpu.api import metrics as metrics_mod
+    from langstream_tpu.serving.engine import (
+        TpuServingEngine, flight_report,
+    )
+
+    monkeypatch.setattr(metrics_mod, "_exemplars", {})
+    prompts = [f"pin request {i}" for i in range(3)]
+
+    async def run(cfg):
+        engine = TpuServingEngine.get_or_create(cfg)
+        try:
+            outs = await asyncio.gather(*(
+                engine.generate(p, {"max-tokens": 12, "temperature": 0})
+                for p in prompts
+            ))
+            entry = flight_report(summary_only=True)[0]
+            return (
+                [o["text"] for o in outs],
+                engine.incidents is None,
+                "incidents" in engine.stats(),
+                "incidents" in entry,
+            )
+        finally:
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    texts_default, no_rec, in_stats, in_flight = run_async(
+        run(_base_config())
+    )
+    assert no_rec and not in_stats and not in_flight
+    # untraced traffic records no exemplars: the scrape carries zero
+    # annotations — byte-identical in form to the pre-exemplar body
+    assert b" # {" not in metrics_mod.render_metrics()
+
+    texts_configured, no_rec2, in_stats2, in_flight2 = run_async(
+        run(_base_config(incident_dir=None))
+    )
+    assert texts_configured == texts_default
+    assert no_rec2 and not in_stats2 and not in_flight2
+
+
+# ---------------------------------------------------------------------------
+# tail exemplars: a p99 scrape resolves to its journey
+# ---------------------------------------------------------------------------
+
+
+def test_ttft_exemplar_resolves_to_journey(run_async, tmp_path,
+                                           monkeypatch, capsys):
+    """The end-to-end resolution the plane exists for: a traced request
+    stamps its journey id on the TTFT bucket it lands in, the scrape
+    carries it in OpenMetrics exemplar syntax, and ``tools/journey.py
+    --trace <trace_id>`` opens exactly that journey's waterfall."""
+    from langstream_tpu.api import metrics as metrics_mod
+    from langstream_tpu.serving.engine import TpuServingEngine
+    from langstream_tpu.serving.journey import JOURNEYS, stitch
+
+    monkeypatch.setattr(metrics_mod, "_exemplars", {})
+
+    async def run():
+        engine = TpuServingEngine(_base_config())
+        ctx = TraceContext.new()
+        token = tracing.set_current(ctx)
+        try:
+            await engine.generate("trace me to my bucket",
+                                  {"max-tokens": 8, "temperature": 0})
+        finally:
+            tracing.reset_current(token)
+            await engine.close()
+            TpuServingEngine.reset_instances()
+        return ctx.trace_id
+
+    trace_id = run_async(run())
+
+    body = metrics_mod.render_metrics().decode()
+    exemplar_lines = [
+        line for line in body.splitlines()
+        if line.startswith("langstream_serving_ttft_seconds_bucket")
+        and " # {" in line
+    ]
+    assert exemplar_lines, "traced request left no TTFT exemplar"
+    m = re.search(r'# \{trace_id="([^"]+)"\} ([0-9.e+-]+) ([0-9.]+)$',
+                  exemplar_lines[0])
+    assert m, exemplar_lines[0]
+    assert m.group(1) == trace_id  # journey id IS the trace id
+
+    # the operator's next command: resolve the exemplar to its journey
+    events = JOURNEYS.events(trace_id)
+    assert events, "traced request recorded no journey ledger"
+    dump = tmp_path / "journeys.json"
+    dump.write_text(json.dumps([stitch(trace_id, [events])]))
+
+    tool = _load_tool("journey")
+    assert tool.main(["--trace", trace_id, str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert trace_id in out
+    # an id the inputs never held exits 2 (the operator grabbed the
+    # wrong dump, not an empty render)
+    assert tool.main(["--trace", "no-such-journey", str(dump)]) == 2
+
+
+def test_metrics_exposition_openmetrics_line_grammar(run_async):
+    """Strict line-grammar conformance of the full scrape: every line is
+    a HELP/TYPE comment or a well-formed sample, exemplar annotations
+    parse as OpenMetrics exemplars and appear ONLY on ``_bucket``
+    lines."""
+    from langstream_tpu.api import metrics as metrics_mod
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    async def run():
+        engine = TpuServingEngine(_base_config())
+        ctx = TraceContext.new()
+        token = tracing.set_current(ctx)
+        try:
+            await engine.generate("grammar probe",
+                                  {"max-tokens": 6, "temperature": 0})
+        finally:
+            tracing.reset_current(token)
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    run_async(run())
+    body = metrics_mod.render_metrics().decode()
+    assert body  # never empty
+
+    name = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    value = r"(?:[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)|NaN|[-+]?Inf)"
+    label = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    labels = rf"\{{(?:{label}(?:,{label})*)?,?\}}"
+    exemplar = rf' # \{{trace_id="[^"]+"\}} {value} {value}'
+    help_re = re.compile(rf"^# HELP {name} .*$")
+    type_re = re.compile(
+        rf"^# TYPE {name} (counter|gauge|histogram|summary|untyped)$"
+    )
+    sample_re = re.compile(
+        rf"^(?P<name>{name})(?:{labels})? {value}(?: {value})?"
+        rf"(?P<exemplar>{exemplar})?$"
+    )
+
+    for line in body.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            assert help_re.match(line) or type_re.match(line), line
+            continue
+        m = sample_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        if m.group("exemplar"):
+            # exemplars ride histogram buckets only — never counters,
+            # gauges, sums, or counts
+            assert m.group("name").endswith("_bucket"), line
+
+
+# ---------------------------------------------------------------------------
+# pod endpoints: GET /incidents, /incidents/{id}
+# ---------------------------------------------------------------------------
+
+
+def test_pod_serves_incident_bundles(run_async, tmp_path, monkeypatch):
+    from langstream_tpu.runtime.pod import _serve_info
+    from langstream_tpu.serving.engine import TpuServingEngine
+
+    class _StubRunner:
+        def info(self):
+            return {"agent-id": "stub"}
+
+    config = _base_config(incident_dir=str(tmp_path / "incidents"))
+
+    async def main():
+        engine = TpuServingEngine.get_or_create(config)
+        port = free_port()
+        monkeypatch.setenv("LS_HTTP_PORT", str(port))
+        server = await _serve_info(_StubRunner())
+        try:
+            await engine.generate("incident endpoint probe",
+                                  {"max-tokens": 6, "temperature": 0})
+            engine._incident_capture(
+                "health-degraded",
+                {"source": "test", "reasons": ["probe"]},
+            )
+            assert engine.incidents.flush()
+            (bid,) = [b["id"] for b in engine.incidents.list()]
+            base = f"http://127.0.0.1:{port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/incidents") as resp:
+                    assert resp.status == 200
+                    index = await resp.json()
+                async with session.get(f"{base}/incidents/{bid}") as resp:
+                    assert resp.status == 200
+                    detail = await resp.json()
+                async with session.get(
+                    f"{base}/incidents/no-such-bundle"
+                ) as resp:
+                    missing = resp.status
+            return bid, index, detail, missing
+        finally:
+            server.close()
+            await engine.close()
+            TpuServingEngine.reset_instances()
+
+    bid, index, detail, missing = run_async(main())
+    entry = next(e for e in index if e.get("model") == "tiny")
+    assert [b["id"] for b in entry["incidents"]] == [bid]
+    assert entry["incidents"][0]["kind"] == "health-degraded"
+    (full,) = [
+        e["bundle"] for e in detail
+        if e.get("bundle", {}).get("id") == bid
+    ]
+    assert full["trigger"]["reasons"] == ["probe"]
+    assert full["worst_journeys"]
+    assert missing == 404
+
+
+# ---------------------------------------------------------------------------
+# engine_top: incidents panel, --json mirror, capture-storm flag
+# ---------------------------------------------------------------------------
+
+
+def _incident_entry() -> dict:
+    return {
+        "model": "tiny",
+        "pod": "pod-0",
+        "events": [],
+        "summary": {},
+        "incidents": {
+            "dir": "/var/incidents", "live": 1, "captured": 2,
+            "written": 2, "evicted": 0, "write_errors": 0,
+            "suppressed": {"tbt-burn": 3}, "pending": 0,
+            "cooldown_s": 60.0, "max_bundles": 32,
+            "recent": [
+                {"id": "incident-000002-tbt-burn", "kind": "tbt-burn",
+                 "events": 5, "journeys": 3},
+            ],
+        },
+    }
+
+
+def test_engine_top_json_mirrors_incidents_panel():
+    engine_top = _load_tool("engine_top")
+    (out,) = engine_top.render_json([_incident_entry()])
+    assert out["model"] == "tiny" and out["pod"] == "pod-0"
+    panel = out["panels"]["incidents"]
+    # the exact console lines, pinned: a paging runbook parses these
+    assert panel["lines"] == [
+        "incident captured 2  written 2 (1 live/32 cap)  evicted 0  "
+        "suppressed 3  cooldown 60s",
+        "incident incident-000002-tbt-burn  trigger tbt-burn  events 5  "
+        "journeys 3",
+    ]
+    # the raw section rides alongside the rendered lines
+    assert panel["section"]["suppressed"] == {"tbt-burn": 3}
+    # silent panels are omitted from the JSON exactly as from the console
+    assert "slo" not in out["panels"]
+    # and the same lines appear in the console render
+    text = engine_top.render([_incident_entry()])
+    for line in panel["lines"]:
+        assert line in text
+
+
+def test_engine_top_flags_capture_storm():
+    engine_top = _load_tool("engine_top")
+    entry = _incident_entry()
+    entry["events"] = [
+        {"kind": "incident", "trigger": "tbt-burn"} for _ in range(3)
+    ]
+    flags = engine_top._anomalies(entry)
+    assert any("capture storm" in f for f in flags)
+    # suppression dominating captures: the cooldown is absorbing a storm
+    entry2 = _incident_entry()
+    entry2["incidents"]["captured"] = 1
+    entry2["incidents"]["suppressed"] = {"shrink-pressure": 9}
+    assert any("cooldown" in f for f in engine_top._anomalies(entry2))
+    # a calm incidents section raises neither flag
+    calm = _incident_entry()
+    calm["incidents"]["suppressed"] = {}
+    assert not [f for f in engine_top._anomalies(calm)
+                if "capture" in f or "cooldown" in f]
+
+
+# ---------------------------------------------------------------------------
+# perf_diff --gate: the TBT regression gate
+# ---------------------------------------------------------------------------
+
+
+def _stream_record(tbt_p99: float) -> dict:
+    return {
+        "metric": "tok/s", "value": 100.0,
+        "detail": {"gateway_stream": {
+            "gateway_stream_tbt_p99_s": tbt_p99,
+        }},
+    }
+
+
+def test_perf_diff_gate_fails_tbt_regression(tmp_path, capsys):
+    perf_diff = _load_tool("perf_diff")
+    base = tmp_path / "base.json"
+    worse = tmp_path / "worse.json"
+    better = tmp_path / "better.json"
+    base.write_text(json.dumps(_stream_record(0.050)))
+    worse.write_text(json.dumps(_stream_record(0.056)))   # +12% > 10% gate
+    better.write_text(json.dumps(_stream_record(0.045)))  # improvement
+
+    # unit: the gate judges per-metric thresholds, not the noise band
+    assert perf_diff.GATE_THRESHOLDS["gateway_stream_tbt_p99_s"] == 0.10
+    violations = perf_diff.gate_violations(
+        {"gateway_stream_tbt_p99_s": 0.050},
+        {"gateway_stream_tbt_p99_s": 0.056},
+    )
+    assert [v["metric"] for v in violations] == ["gateway_stream_tbt_p99_s"]
+    assert perf_diff.gate_violations(
+        {"gateway_stream_tbt_p99_s": 0.050},
+        {"gateway_stream_tbt_p99_s": 0.045},
+    ) == []
+
+    # a +12% TBT regression hides inside the 15% noise band without the
+    # gate — and fails the build with it
+    assert perf_diff.main([str(base), str(worse)]) == 0
+    capsys.readouterr()
+    assert perf_diff.main(["--gate", str(base), str(worse)]) == 1
+    assert "GATE" in capsys.readouterr().out
+    # the same move the other way passes the gate
+    assert perf_diff.main(["--gate", str(base), str(better)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# docs drift: the flight-event vocabulary table, both directions
+# ---------------------------------------------------------------------------
+
+#: kinds that flow through the two sanctioned *dynamic* emit sites —
+#: the engine's prefix-event drain (``_emit_prefix_events`` forwards
+#: the prefix store's queued kinds) and the handoff plane's breaker
+#: mirror (``_breaker_event`` forwards the router's circuit verdicts).
+#: A third dynamic site fails the site-count pin below, forcing whoever
+#: adds it to extend this table and the docs together.
+DYNAMIC_EVENT_KINDS = {
+    "prefix-demote", "prefix-promote", "prefix-evict", "prefix-hydrate",
+    "fault-injected",                        # prefix-store fault drain
+    "breaker-open", "breaker-close",         # router → handoff mirror
+}
+
+
+def _flight_event_call_kinds() -> tuple[set, list]:
+    """Every ``flight.event(...)`` call site in the tree: the set of
+    literal kinds plus the dynamic (non-literal) sites."""
+    kinds: set[str] = set()
+    dynamic: list[tuple[str, str]] = []
+    for path in sorted((REPO / "langstream_tpu").rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"
+                and "flight" in ast.unparse(node.func.value)
+            ):
+                continue
+            kind = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                kind = node.args[0].value
+            else:
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "kind"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                    ):
+                        kind = kw.value.value
+            if kind is None:
+                dynamic.append(
+                    (path.relative_to(REPO).as_posix(), ast.unparse(node))
+                )
+            else:
+                kinds.add(kind)
+    return kinds, dynamic
+
+
+def _documented_event_kinds() -> set:
+    text = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    assert "### Flight event vocabulary" in text
+    section = text.split("### Flight event vocabulary", 1)[1]
+    kinds: set[str] = set()
+    for line in section.splitlines():
+        m = re.match(r"^\|\s*`([a-z-]+)`\s*\|", line)
+        if m:
+            kinds.add(m.group(1))
+        elif kinds and line.strip() and not line.startswith("|"):
+            break  # table ended
+    return kinds
+
+
+def test_flight_event_vocabulary_matches_docs_both_directions():
+    """Conformance, not prose-trust: every kind a ``flight.event(...)``
+    call site can emit appears in docs/OBSERVABILITY.md's vocabulary
+    table, and every documented kind is emitted somewhere — so the
+    table can neither rot stale nor grow fiction."""
+    literal, dynamic = _flight_event_call_kinds()
+    # exactly the two sanctioned dynamic sites; a third must extend
+    # DYNAMIC_EVENT_KINDS and the docs table in the same change
+    assert sorted(p for p, _ in dynamic) == [
+        "langstream_tpu/serving/engine.py",
+        "langstream_tpu/serving/handoff.py",
+    ], dynamic
+    code_kinds = literal | DYNAMIC_EVENT_KINDS
+    doc_kinds = _documented_event_kinds()
+    assert len(doc_kinds) >= 30  # the parser actually found the table
+    undocumented = sorted(code_kinds - doc_kinds)
+    assert not undocumented, (
+        f"emitted but missing from the OBSERVABILITY.md vocabulary "
+        f"table: {undocumented}"
+    )
+    phantom = sorted(doc_kinds - code_kinds)
+    assert not phantom, (
+        f"documented but emitted nowhere (stale table rows): {phantom}"
+    )

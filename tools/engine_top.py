@@ -12,14 +12,19 @@ decomposition with the pipelined loop's overlapped-vs-exposed host split
 (``overlap_ratio``), admission-stall breakdown by reason, KV-pool
 utilization,
 the QoS scheduler state (per-class queue depths, per-tenant throttle
-counts, shed/preempt tallies plus their event tail), and the
-discrete-event tail (recompiles, pool growth, warmup, preemptions).
-Control-plane fan-ins mark timed-out pods ``UNREACHABLE`` instead of
-omitting them.
+counts, shed/preempt tallies plus their event tail), the incident-
+capture panel (bundles captured/suppressed with their trigger kinds,
+for incident-dir-configured engines — docs/OBSERVABILITY.md "Incident
+bundles & exemplars"), and the discrete-event tail (recompiles, pool
+growth, warmup, preemptions). Control-plane fan-ins mark timed-out pods
+``UNREACHABLE`` instead of omitting them. ``--json`` emits one frame as
+machine-readable JSON: per engine, every rendered panel's lines, the
+raw section it rendered from, and the anomaly flags.
 
     python tools/engine_top.py                          # localhost:8080
     python tools/engine_top.py --url http://pod:8080/flight --interval 2
     python tools/engine_top.py --once                   # one frame, no clear
+    python tools/engine_top.py --json                   # one frame, JSON
 
 Pointing ``--url`` at the control plane's autoscaler route
 (``/api/applications/{t}/{n}/autoscaler``) renders the FLEET panel
@@ -38,7 +43,9 @@ fast burn, — for saved autoscaler payloads — scale thrash (≥3
 direction changes inside one cooldown window), handoff retry storms
 (one request re-offered ≥3 times) and breaker flapping (one replica's
 breaker opening ≥3 times in the event window — docs/RESILIENCE.md
-"Distributed failure domain"), and — for stitched
+"Distributed failure domain"), incident capture storms (≥3 bundles in
+one event window, or the cooldown suppressing far more captures than it
+admits), and — for stitched
 request-journey payloads (``/api/applications/{t}/{n}/journey/{id}``,
 tools/journey.py) — per-segment TTFT totals with a transfer-dominated
 flag when the handoff cost exceeds prefill at p50 (disaggregation
@@ -203,6 +210,7 @@ def render(report: list[dict]) -> str:
         lines.extend(_render_prefix(entry.get("prefixstore"), events))
         lines.extend(_render_survival(entry.get("survival"), events))
         lines.extend(_render_streaming(entry.get("streaming"), events))
+        lines.extend(_render_incidents(entry.get("incidents"), events))
         spec_acc = totals.get("spec_accepted") or 0
         spec_rej = totals.get("spec_rejected") or 0
         if spec_acc or spec_rej:
@@ -472,6 +480,39 @@ def _render_streaming(streaming: dict | None, events: list[dict]) -> list[str]:
             f"{last.get('tokens_delivered')}/{last.get('tokens_generated')} "
             f"tok  wasted {last.get('tokens_wasted')}  "
             f"class {last.get('priority')}"
+        )
+    return lines
+
+
+def _render_incidents(incidents: dict | None, events: list[dict]) -> list[str]:
+    """Incident-capture panel (docs/OBSERVABILITY.md "Incident bundles &
+    exemplars"): captured/written/evicted tallies, the cooldown's
+    suppression count, and the most recent bundles with their trigger
+    kinds — so the operator staring at a DEGRADED header knows whether
+    evidence was already snapshotted and under which bundle id. Rendered
+    only for incident-dir-configured engines — the section is absent
+    otherwise and default payloads render unchanged."""
+    if not isinstance(incidents, dict):
+        return []
+    suppressed = incidents.get("suppressed") or {}
+    sup_total = sum(suppressed.values()) if isinstance(suppressed, dict) else 0
+    lines = [
+        f"incident captured {incidents.get('captured', 0)}  "
+        f"written {incidents.get('written', 0)} "
+        f"({incidents.get('live', 0)} live/{incidents.get('max_bundles', 0)} "
+        f"cap)  evicted {incidents.get('evicted', 0)}  "
+        f"suppressed {sup_total}  cooldown {incidents.get('cooldown_s', 0):g}s"
+    ]
+    if incidents.get("write_errors"):
+        lines.append(
+            f"incident !! {incidents['write_errors']} bundle write "
+            f"error(s) — evidence is being lost; check incident-dir"
+        )
+    for bundle in (incidents.get("recent") or [])[-3:]:
+        lines.append(
+            f"incident {bundle.get('id')}  trigger {bundle.get('kind')}  "
+            f"events {bundle.get('events', 0)}  "
+            f"journeys {bundle.get('journeys', 0)}"
         )
     return lines
 
@@ -1100,6 +1141,44 @@ def _anomalies(entry: dict) -> list[str]:
                 f"(fast/slow) against target {obj.get('target')} — error "
                 f"budget {obj.get('budget_remaining')} remaining"
             )
+    # incident capture storm (docs/OBSERVABILITY.md "Incident bundles &
+    # exemplars"): >=3 bundles in the event tail means distinct trigger
+    # kinds (or dedup keys) keep breaching past each other's cooldowns —
+    # the engine is failing along several axes at once, and the bounded
+    # incident-dir is churning through its eviction budget on one episode
+    incident_events = [e for e in events if e.get("kind") == "incident"]
+    if len(incident_events) >= 3:
+        by_trigger: dict = {}
+        for e in incident_events:
+            key = e.get("trigger") or "?"
+            by_trigger[key] = by_trigger.get(key, 0) + 1
+        triggers = "  ".join(
+            f"{k}x{n}" for k, n in sorted(
+                by_trigger.items(), key=lambda kv: -kv[1]
+            )
+        )
+        flags.append(
+            f"incident capture storm: {len(incident_events)} bundles in "
+            f"the event window ({triggers}) — multiple trigger kinds are "
+            f"breaching past each other's cooldowns; one episode is "
+            f"churning the bounded incident-dir, read the FIRST bundle "
+            f"of the window before eviction rotates it out"
+        )
+    incidents = entry.get("incidents")
+    if isinstance(incidents, dict):
+        suppressed = incidents.get("suppressed") or {}
+        sup_total = (
+            sum(suppressed.values()) if isinstance(suppressed, dict) else 0
+        )
+        captured = incidents.get("captured") or 0
+        if sup_total >= max(3, 3 * captured):
+            flags.append(
+                f"incident cooldown absorbing a storm: {sup_total} "
+                f"suppressed captures vs {captured} taken — breach "
+                f"predicates are re-firing continuously inside the "
+                f"cooldown window; the captured bundles bracket a "
+                f"sustained episode, not isolated blips"
+            )
     return flags
 
 
@@ -1355,6 +1434,61 @@ def analyze(dump) -> str:
     return "\n".join(lines).rstrip()
 
 
+def render_json(report: list[dict]) -> list[dict]:
+    """Machine-readable mirror of :func:`render`: one object per engine
+    carrying every rendered panel under its name, as the exact lines the
+    console prints plus the raw section the panel was rendered from — so
+    a script (or a paging runbook) can pull one panel without scraping
+    an ANSI frame, and the snapshot test pins the panel inventory.
+    Panels that would be silent on the console are omitted here too."""
+    out: list[dict] = []
+    for entry in report:
+        if entry.get("unreachable"):
+            out.append({"pod": entry.get("pod"), "unreachable": True})
+            continue
+        events = entry.get("events") or []
+        summary = entry.get("summary") or {}
+        sections = {
+            "health": entry.get("health"),
+            "slo": entry.get("slo"),
+            "scheduler": entry.get("scheduler"),
+            "pool": entry.get("kvtransfer"),
+            "prefix": entry.get("prefixstore"),
+            "survival": entry.get("survival"),
+            "streaming": entry.get("streaming"),
+            "incidents": entry.get("incidents"),
+            "memory": entry.get("memory"),
+            "programs": entry.get("programs"),
+        }
+        rendered = {
+            "health": _render_health(sections["health"]),
+            "slo": _render_slo(sections["slo"]),
+            "scheduler": _render_scheduler(sections["scheduler"], events),
+            "pool": _render_pool(
+                entry.get("pool_role"), sections["pool"], summary
+            ),
+            "prefix": _render_prefix(sections["prefix"], events),
+            "survival": _render_survival(sections["survival"], events),
+            "streaming": _render_streaming(sections["streaming"], events),
+            "incidents": _render_incidents(sections["incidents"], events),
+            "memory": _render_memory(sections["memory"]),
+            "programs": _render_programs(sections["programs"]),
+        }
+        out.append(
+            {
+                "model": entry.get("model"),
+                "pod": entry.get("pod"),
+                "panels": {
+                    name: {"lines": lines, "section": sections[name]}
+                    for name, lines in rendered.items()
+                    if lines
+                },
+                "anomalies": _anomalies(entry),
+            }
+        )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -1387,6 +1521,13 @@ def main(argv: list[str] | None = None) -> int:
         "--once", action="store_true", help="print one frame and exit"
     )
     parser.add_argument(
+        "--json",
+        action="store_true",
+        help="print one frame as machine-readable JSON (per engine, every "
+        "rendered panel's lines + its raw section + anomaly flags) and "
+        "exit",
+    )
+    parser.add_argument(
         "--analyze",
         metavar="DUMP_JSON",
         nargs="+",
@@ -1395,6 +1536,21 @@ def main(argv: list[str] | None = None) -> int:
         "(tools/perf_diff.py) on top, oldest first",
     )
     args = parser.parse_args(argv)
+
+    if args.json:
+        try:
+            payload = _fetch(args.url)
+        except (OSError, ValueError) as e:
+            print(f"fetch {args.url} failed: {e}", file=sys.stderr)
+            return 2
+        if isinstance(payload, dict):
+            # autoscaler route: the fleet frame's lines, still structured
+            print(json.dumps(
+                {"fleet": render_fleet(payload).splitlines()}, indent=2
+            ))
+        else:
+            print(json.dumps(render_json(payload), indent=2))
+        return 0
 
     if args.analyze:
         dumps: list[tuple[str, dict]] = []

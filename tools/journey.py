@@ -26,6 +26,12 @@ end of that plane:
     python tools/journey.py stitched.json                  # waterfall
     python tools/journey.py --url http://cp:8090/api/applications/t/app/journey/<id>
     python tools/journey.py --aggregate dump1.json dump2.json ...
+    python tools/journey.py --trace <id> dump.json         # exemplar -> journey
+
+``--trace <id>`` filters the inputs to one journey by id — the
+resolution step for a ``/metrics`` histogram exemplar: the exemplar's
+``trace_id`` IS the journey id, so a p99 bucket observation resolves to
+the full lifecycle of the request that landed it (exit 2 when absent).
 
 Accepted inputs (auto-detected per file): a stitched journey payload
 (the control-plane route's shape), a list of stitched journeys, a raw
@@ -406,6 +412,13 @@ def main(argv: list[str] | None = None) -> int:
         help="replica bounces beyond this are flagged (default 3)",
     )
     parser.add_argument(
+        "--trace",
+        metavar="ID",
+        help="render only the journey with this id — the resolution step "
+        "for a /metrics exemplar's trace_id (exit 2 when the inputs "
+        "hold no such journey)",
+    )
+    parser.add_argument(
         "--json", action="store_true", help="emit the analysis as JSON"
     )
     args = parser.parse_args(argv)
@@ -430,6 +443,19 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.trace:
+        journeys = [
+            j for j in journeys if str(j.get("journey")) == args.trace
+        ]
+        if not journeys:
+            print(
+                f"no journey {args.trace!r} in the inputs — if the id came "
+                f"from a /metrics exemplar, fetch the stitched payload "
+                f"from the control plane's /journey/{args.trace} route "
+                f"first",
+                file=sys.stderr,
+            )
+            return 2
 
     if args.aggregate:
         agg = aggregate(journeys)

@@ -31,6 +31,13 @@ can be read against "the engine compiles different programs now".
 ``engine_top --analyze A.json B.json`` runs the same diff. Exit code:
 0 quiet, 1 when any regression is flagged, 2 on usage errors.
 Zero dependencies (stdlib only).
+
+``--gate`` additionally judges the metrics in ``GATE_THRESHOLDS``
+against their own per-metric limit — product SLOs, not noise bands, so
+a gated regression fails the run (exit 1) even inside the default
+threshold. The first gated direction is the streaming TBT p99
+(``gateway_stream_tbt_p99_s``): ROADMAP item 5's chip-measured TBT
+gate, holding future bench rounds to the product-latency guarantee.
 """
 
 from __future__ import annotations
@@ -117,6 +124,48 @@ METRICS: dict[str, str] = {
 
 #: default noise band: relative change below this is never flagged
 DEFAULT_THRESHOLD = 0.15
+
+#: SLO gate thresholds (``--gate``): metric → the maximum tolerated
+#: relative regression in its declared worse direction. These are
+#: product guarantees, not noise bands — they may sit BELOW the default
+#: threshold, and crossing one fails the gate (non-zero exit) even when
+#: the ordinary diff would have stayed quiet. First gated direction:
+#: the streaming time-between-tokens p99 (ROADMAP item 5's
+#: "chip-measured TBT gate" — bench rounds are held to the SLO the
+#: decode-chunk tuning promised, not to vibes).
+GATE_THRESHOLDS: dict[str, float] = {
+    "gateway_stream_tbt_p99_s": 0.10,
+}
+
+
+def gate_violations(base_m: dict, new_m: dict) -> list[dict]:
+    """Gated metrics that regressed past their own threshold between two
+    extracted metric dicts. Same direction/relative-change arithmetic as
+    :func:`diff_metrics`, but judged against :data:`GATE_THRESHOLDS`
+    per metric instead of the shared noise band."""
+    violations: list[dict] = []
+    for metric, limit in GATE_THRESHOLDS.items():
+        worse = METRICS.get(metric)
+        if worse is None:
+            continue
+        b, n = base_m.get(metric), new_m.get(metric)
+        if not isinstance(b, (int, float)) or not isinstance(n, (int, float)):
+            continue
+        if b == 0:
+            continue
+        change = (n - b) / abs(b)
+        regressed = change > 0 if worse == "up" else change < 0
+        if regressed and abs(change) > limit:
+            violations.append(
+                {
+                    "metric": metric,
+                    "base": b,
+                    "new": n,
+                    "change": round(change, 4),
+                    "limit": limit,
+                }
+            )
+    return violations
 
 
 def _first(d: dict, *keys, default=None):
@@ -358,6 +407,12 @@ def diff_metrics(
 def render(label_base: str, label_new: str, result: dict,
            threshold: float) -> str:
     lines = [f"== {label_base} -> {label_new} =="]
+    for entry in result.get("gate", ()):
+        lines.append(
+            f"  !! GATE {entry['metric']}: {entry['base']} -> "
+            f"{entry['new']} ({100 * entry['change']:+.1f}% past the "
+            f"±{100 * entry['limit']:.0f}% SLO gate)"
+        )
     for entry in result["regressions"]:
         lines.append(
             f"  !! REGRESSION {entry['metric']}: {entry['base']} -> "
@@ -378,13 +433,19 @@ def render(label_base: str, label_new: str, result: dict,
 
 
 def diff_payloads(
-    labeled: list[tuple[str, object]], threshold: float = DEFAULT_THRESHOLD
+    labeled: list[tuple[str, object]],
+    threshold: float = DEFAULT_THRESHOLD,
+    gate: bool = False,
 ) -> tuple[list[tuple[str, str, dict]], bool]:
     """Pairwise diffs over consecutive already-loaded payloads (label,
     parsed JSON), oldest first — the entry point for callers that hold
     the dumps in memory (engine_top's multi-dump ``--analyze`` loads
     each file once for decomposition and hands the payloads here).
-    Returns the pair results and whether any regression was flagged."""
+    Returns the pair results and whether any regression was flagged.
+    With ``gate=True`` each result additionally carries a ``gate`` list
+    (:func:`gate_violations`), and a violation counts as a flagged
+    regression — the SLO gate fails the run even inside the noise
+    band."""
     extracted = [
         (label, extract_metrics(payload)) for label, payload in labeled
     ]
@@ -392,13 +453,20 @@ def diff_payloads(
     any_regression = False
     for (base_label, base), (new_label, new) in zip(extracted, extracted[1:]):
         result = diff_metrics(base, new, threshold)
+        if gate:
+            result["gate"] = gate_violations(
+                base["metrics"], new["metrics"]
+            )
+            any_regression = any_regression or bool(result["gate"])
         any_regression = any_regression or bool(result["regressions"])
         results.append((base_label, new_label, result))
     return results, any_regression
 
 
 def diff_files(
-    paths: list[str], threshold: float = DEFAULT_THRESHOLD
+    paths: list[str],
+    threshold: float = DEFAULT_THRESHOLD,
+    gate: bool = False,
 ) -> tuple[list[tuple[str, str, dict]], bool]:
     """Pairwise diffs over consecutive files (sorted order is the
     caller's business — pass rounds oldest first). Returns the pair
@@ -407,7 +475,7 @@ def diff_files(
     for path in paths:
         with open(path) as f:
             labeled.append((path, json.load(f)))
-    return diff_payloads(labeled, threshold)
+    return diff_payloads(labeled, threshold, gate=gate)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -426,11 +494,20 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="emit results as JSON"
     )
+    parser.add_argument(
+        "--gate", action="store_true",
+        help="additionally judge gated metrics against their per-metric "
+        "SLO thresholds (GATE_THRESHOLDS) and exit non-zero on any "
+        "violation — the bench-verdict regression gate (first gated "
+        "direction: the streaming TBT p99)",
+    )
     args = parser.parse_args(argv)
     if len(args.files) < 2:
         parser.error("need at least two files to diff")
     try:
-        results, any_regression = diff_files(args.files, args.threshold)
+        results, any_regression = diff_files(
+            args.files, args.threshold, gate=args.gate
+        )
     except (OSError, ValueError) as e:
         print(f"perf_diff failed: {e}", file=sys.stderr)
         return 2
