@@ -62,7 +62,8 @@ Env knobs: BENCH_MODEL (tiny|llama-1b|llama3-8b|...), BENCH_SLOTS,
 BENCH_DECODE_CHUNK, BENCH_QUANTIZE (int8|none), BENCH_KV (dense|paged),
 BENCH_KV_QUANT (int8|none), BENCH_GATEWAY=0 / BENCH_PAGED=0 /
 BENCH_PREFIX=0 / BENCH_KV_INT8=0 / BENCH_SPEC=0 / BENCH_QOS=0 /
-BENCH_OOM=0 / BENCH_PARTITION=0 / BENCH_STREAM=0 to skip phases.
+BENCH_OOM=0 / BENCH_PARTITION=0 / BENCH_STREAM=0 / BENCH_LORA=0 to
+skip phases.
 
 Offline note: weights are random-init (no checkpoint files in this
 environment) — identical FLOPs/bytes to trained weights, so throughput is
@@ -139,6 +140,7 @@ RUN_QOS = os.environ.get("BENCH_QOS", "1") != "0"
 RUN_OOM = os.environ.get("BENCH_OOM", "1") != "0"
 RUN_PARTITION = os.environ.get("BENCH_PARTITION", "1") != "0"
 RUN_STREAM = os.environ.get("BENCH_STREAM", "1") != "0"
+RUN_LORA = os.environ.get("BENCH_LORA", "1") != "0"
 DEGRADED = os.environ.get("BENCH_DEGRADED") == "1"
 
 PROMPT = "Benchmarking the TPU serving engine end to end. " * 4
@@ -590,6 +592,11 @@ def run_bench() -> dict:
     # dropped stream's decode slot reclaimed at a chunk boundary)
     optional("gateway_stream", RUN_STREAM,
              budget_cap=min(PHASE_BUDGET_S, 240))
+    # multi-LoRA adapter phase (docs/ADAPTERS.md): N tenants over M
+    # adapters with M > the device row budget; records warm vs hydrate
+    # TTFT, the T0 hit ratio, eviction churn, and the byte-ledger
+    # conservation verdict
+    optional("multi_lora", RUN_LORA, budget_cap=min(PHASE_BUDGET_S, 300))
 
     return _record(headline, detail)
 
@@ -1193,6 +1200,13 @@ async def _child_phase(phase: str) -> dict:
 
         return await _phase(
             run_stream_phase(), budget_s=min(PHASE_BUDGET_S, 240)
+        )
+    if phase == "multi_lora":
+        sys.path.insert(0, os.path.join(os.path.dirname(_BENCH_PATH), "tools"))
+        from gateway_bench import run_multi_lora_phase
+
+        return await _phase(
+            run_multi_lora_phase(), budget_s=min(PHASE_BUDGET_S, 300)
         )
     raise ValueError(f"unknown bench phase {phase!r}")
 

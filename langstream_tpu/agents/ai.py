@@ -90,6 +90,13 @@ class _AIAgentBase(SingleRecordProcessor):
                 # disconnect at the gateway cancels the decode and frees
                 # the slot (serving/streaming.py)
                 options["stream-key"] = stream_id
+            adapter = headers.get("langstream-adapter")
+            if adapter:
+                # the LoRA adapter the gateway resolved from QoS tenant
+                # config (serving/adapters.py): the engine's admission
+                # gate hydrates it through the tier store and the decode
+                # program applies it per-slot (docs/ADAPTERS.md)
+                options["adapter"] = adapter
         return options
 
     @staticmethod

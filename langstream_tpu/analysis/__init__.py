@@ -62,6 +62,11 @@ INC1601     incident breach-observe discipline: device syncs, blocking
             evidence at the moment of an SLO/health breach (cooldown
             gate, bundle submit, storm/ranking predicates) — a wait
             there adds latency to the degraded moment it explains
+LORA1701    multi-LoRA resolve-plane discipline: device syncs, blocking
+            I/O, or lock acquisition in an adapter resolve or eviction-
+            decision path (the store's loop-side surface, the engine's
+            adapter admission surface, the router adapter pin) — T2
+            I/O belongs on the background hydrator
 ==========  ==============================================================
 
 RACE/INV/FLOW/SPMD/HOT are **project rules**: they run over a
@@ -107,6 +112,7 @@ from langstream_tpu.analysis.rules_hot import RULES as _HOT_RULES
 from langstream_tpu.analysis.rules_inc import RULES as _INC_RULES
 from langstream_tpu.analysis.rules_inv import RULES as _INV_RULES
 from langstream_tpu.analysis.rules_jax import RULES as _JAX_RULES
+from langstream_tpu.analysis.rules_lora import RULES as _LORA_RULES
 from langstream_tpu.analysis.rules_net import RULES as _NET_RULES
 from langstream_tpu.analysis.rules_obs import RULES as _OBS_RULES
 from langstream_tpu.analysis.rules_perf import RULES as _PERF_RULES
@@ -129,6 +135,7 @@ ALL_RULES: list[Rule] = [
     *_FLEET_RULES,
     *_POOL_RULES,
     *_PFX_RULES,
+    *_LORA_RULES,
     *_FLT_RULES,
     *_NET_RULES,
     *_STRM_RULES,

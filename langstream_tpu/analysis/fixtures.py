@@ -459,6 +459,69 @@ class PrefixStore:
 ))
 
 _register(RuleExample(
+    rule="LORA1701",
+    tp={
+        "langstream_tpu/serving/adapters.py": '''\
+import jax
+
+class AdapterStore:
+    def t0_assign(self, name, engine):
+        # a T0 row-assignment that syncs the device queues EVERY
+        # admission behind the dispatch in flight — and the lock queues
+        # the resolve behind whatever holds it
+        jax.block_until_ready(engine.last_out)
+        with self._lock:
+            return self._rows.pop(name, None)
+
+    def _shrink_t1(self, storage):
+        while self.t1_bytes > self.budget:
+            name, entry = self._t1.popitem(last=False)
+            # blocking T2 I/O inside the eviction DECISION: every
+            # byte-budget walk becomes a per-pass host stall
+            storage.put(name, open("/tmp/x", "rb").read())
+''',
+    },
+    tn={
+        "langstream_tpu/serving/adapters.py": '''\
+class AdapterStore:
+    def t0_assign(self, name):
+        # the sanctioned shape: GIL-atomic container ops + arithmetic
+        for row, holder in self._rows.items():
+            if holder is None:
+                self._rows[row] = name
+                return row
+        return None
+
+    def _shrink_t1(self):
+        # the eviction DECISION only moves the entry onto the handoff
+        # deque; the background hydrator does the object-storage I/O
+        while self.t1_bytes > self.budget and self._t1:
+            name, entry = self._t1.popitem(last=False)
+            self.t1_bytes -= entry["nbytes"]
+            self._jobs.append(("put", name, entry))
+            self._kick.set()
+
+    def _io_put(self, storage, name, entry):
+        # hydrator thread: T2 I/O is exempt HERE by design
+        storage.put(name, entry["blob"])
+''',
+    },
+    fix=(
+        "Keep every adapter resolve — T0 row lookup/assignment, pin "
+        "bookkeeping, T1 take, hydration request — and every eviction "
+        "decision to GIL-atomic container ops plus arithmetic: they "
+        "run at the engine loop's safe point, on the admission path, "
+        "ahead of adapter-less traffic too. Anything that must touch "
+        "object storage becomes a job on the hydrator's handoff deque "
+        "(AdapterStore._io_* processes it on the background thread and "
+        "hands results back for apply_results to apply loop-side). The "
+        "one device wait is the row-upload closure the engine's "
+        "_load_adapter_row runs and times on the dispatch thread — "
+        "docs/ADAPTERS.md."
+    ),
+))
+
+_register(RuleExample(
     rule="STRM1501",
     tp={
         "langstream_tpu/gateway/server.py": '''\

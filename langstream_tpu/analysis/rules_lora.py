@@ -1,0 +1,138 @@
+"""Multi-LoRA adapter-store rules: resolve-plane discipline (LORA1701).
+
+The tiered adapter store (``serving/adapters.py``, docs/ADAPTERS.md)
+sits on the same admission path the prefix tiers do: every ``_admit``
+pass may resolve a request's adapter — T0 row lookup, pin, LRU
+eviction decision, T1 take, hydration request — at the engine loop's
+safe point, and ``stats()["adapters"]`` is a poll surface beside the
+prefix/attribution planes. LORA1701 is PFX801's shape over that plane:
+**a device sync, blocking I/O, or lock acquisition in an adapter
+resolve/eviction-decision path** is a red gate —
+
+- a resolve that blocks queues EVERY admission behind it — including
+  adapter-less requests, which must stay byte-identical to a
+  pre-adapter engine in latency, not just tokens;
+- an eviction decision that touches the device or disk turns the T0
+  row walk into a per-pass host stall the flight recorder would
+  misattribute to prefill;
+- the router's adapter-affinity pin runs on the gateway's produce hot
+  path — a blocking pin stalls every client.
+
+T2 object-storage I/O is **exempt by design**: it lives on the
+background hydrator thread (``AdapterStore._io_*`` methods), which
+talks to the loop exclusively through handoff deques — the same
+contract the prefix hydrator pins. The ONE sanctioned device wait is
+the row-upload closure ``_load_adapter_row`` runs on the dispatch
+thread (timed, like the promote scatter) — a nested def, exempt
+everywhere.
+
+Scope: the named decision-path functions below — the store's loop-side
+surface, the engine's adapter admission/maintenance surface, and the
+router's adapter-pin path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from langstream_tpu.analysis.core import Finding, Module, Rule
+from langstream_tpu.analysis.rules_obs import _waitfree_violations
+
+#: the resolve plane's decision paths, per file. The hydrator (`_io_*`,
+#: `flush`, `close`) and the publish-side helpers (serialize/publish/
+#: merge) are deliberately absent: their blocking I/O is the design
+#: (background thread + handoff deques / offline tooling).
+_LORA_FUNCS_BY_FILE = {
+    "langstream_tpu/serving/adapters.py": {
+        "t0_row",
+        "t0_resident",
+        "pin",
+        "unpin",
+        "pinned",
+        "t0_assign",
+        "note_loaded",
+        "t1_has",
+        "t2_has",
+        "hydrating",
+        "known",
+        "t1_peek",
+        "_insert_t1",
+        "_shrink_t1",
+        "request_hydration",
+        "apply_results",
+        "_trim_t2",
+        "drain_events",
+        "ledger",
+        "stats",
+    },
+    "langstream_tpu/serving/engine.py": {
+        "_resolve_adapter",
+        "_adapter_tier_step",
+        "_adapter_release",
+        "adapter_store_section",
+        "_emit_store_events",
+    },
+    "langstream_tpu/gateway/router.py": {
+        "_pin_adapter",
+    },
+}
+
+
+def _resolve_functions(mod: Module) -> Iterator[ast.AST]:
+    named: set[str] = set()
+    for prefix, names in _LORA_FUNCS_BY_FILE.items():
+        if prefix in mod.path or mod.path.endswith(prefix):
+            named = names
+            break
+    if not named:
+        return
+    nested_fns: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if inner is not node and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested_fns.add(id(inner))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(node) in nested_fns:
+            continue
+        if node.name in named:
+            yield node
+
+
+def check_blocking_in_resolve_plane(mod: Module) -> Iterator[Finding]:
+    for fn in _resolve_functions(mod):
+        for node, offender, kind in _waitfree_violations(fn):
+            yield mod.finding(
+                "LORA1701",
+                node,
+                f"{kind} {offender} in an adapter resolve/eviction-"
+                f"decision path (`{fn.name}`): the resolve plane must "
+                f"stay wait-free — an adapter lookup that blocks queues "
+                f"every admission behind it (adapter-less traffic "
+                f"included), and the router's adapter pin runs on the "
+                f"produce hot path; keep decisions to GIL-atomic "
+                f"container ops + arithmetic, push ALL T2 object-"
+                f"storage I/O onto the background hydrator (`_io_*` "
+                f"jobs over the handoff deques), and confine the one "
+                f"device wait to the timed dispatch-thread row-upload "
+                f"closure (docs/ADAPTERS.md)",
+            )
+
+
+RULES = [
+    Rule(
+        id="LORA1701",
+        family="lora",
+        summary="device sync, blocking I/O, or lock acquisition in an "
+        "adapter resolve or eviction-decision path (T0/T1 decisions, "
+        "the engine's adapter admission surface, and the router "
+        "adapter pin must be wait-free; T2 I/O belongs on the "
+        "background hydrator)",
+        check=check_blocking_in_resolve_plane,
+    ),
+]

@@ -42,6 +42,7 @@ from langstream_tpu.controlplane.autoscaler import (
 from langstream_tpu.core.parser import ModelBuilder
 from langstream_tpu.gateway.auth import validate_gateway_authentication
 from langstream_tpu.gateway.server import GatewayRegistry
+from langstream_tpu.serving.adapters import validate_application_adapter_store
 from langstream_tpu.serving.health import validate_application_slo
 from langstream_tpu.serving.prefixstore import validate_application_prefix_store
 from langstream_tpu.serving.qos import validate_application_qos
@@ -700,6 +701,7 @@ class ControlPlaneServer:
             validate_application_slo(application)
             validate_application_autoscale(application)
             validate_application_prefix_store(application)
+            validate_application_adapter_store(application)
         except web.HTTPException:
             raise
         except Exception as e:
@@ -726,6 +728,7 @@ class ControlPlaneServer:
                 validate_application_slo(application)
                 validate_application_autoscale(application)
                 validate_application_prefix_store(application)
+                validate_application_adapter_store(application)
             except Exception as e:
                 raise web.HTTPBadRequest(reason=f"invalid application: {e}")
         else:

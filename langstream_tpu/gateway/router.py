@@ -113,11 +113,18 @@ class ReplicaRouter:
         # bounded like the tenant map — digests derive from prompt text,
         # which clients control
         self._prefix_affinity: "OrderedDict[str, list]" = OrderedDict()
+        # adapter name -> [replica, pinned_at] (docs/ADAPTERS.md): a
+        # tenant's LoRA adapter rides the replica whose device/T1 tiers
+        # already hold it — re-routing pays a T2 hydration, so the pin
+        # sits beside the prefix pin and is bounded the same way
+        self._adapter_affinity: "OrderedDict[str, list]" = OrderedDict()
         self.picks = 0
         self.affinity_hits = 0
         self.affinity_rerouted = 0
         self.prefix_hits = 0
         self.prefix_rerouted = 0
+        self.adapter_hits = 0
+        self.adapter_rerouted = 0
         # disaggregated pools (docs/DISAGG.md): the phase of the latest
         # pick ("prefill"/"decode"/"any") — engine_top's split-fleet view
         self.last_pick_phase: str | None = None
@@ -263,6 +270,7 @@ class ReplicaRouter:
         phase: str | None = None,
         exclude: Any = (),
         prefix: str | None = None,
+        adapter: str | None = None,
     ) -> str | None:
         """The replica for one record: the tenant's pinned replica while
         it stays eligible and fresh, else the least-loaded eligible
@@ -282,7 +290,15 @@ class ReplicaRouter:
         traffic for one shared system prompt returns to the replica
         whose prefix tiers hold its blocks, whatever tenant sent it.
         Consulted before the tenant pin; ``None`` (prefix-less traffic)
-        leaves the pre-existing choice bit for bit."""
+        leaves the pre-existing choice bit for bit.
+
+        ``adapter`` (the gateway's ``langstream-adapter`` stamp,
+        docs/ADAPTERS.md) pins the tenant's LoRA adapter to the replica
+        whose adapter tiers already hold it — a re-route costs a T2
+        hydration plus a device-row load, which is the multi-LoRA
+        analogue of a cold prefix. Consulted after the prefix pin
+        (an exact shared-prompt match is stronger evidence) and before
+        the tenant pin; adapter-less traffic is untouched."""
         if self.fault_injector is not None:
             # deterministic routing outage (serving/faults.py `route`
             # site): drop = no pick this pass, error = the registry blew
@@ -342,8 +358,34 @@ class ReplicaRouter:
                         # keep the tenant pin converged on the same
                         # replica so the two affinity maps never fight
                         self._pin_tenant(tenant, replica, now)
+                    if adapter:
+                        self._pin_adapter(adapter, replica, now)
                     return self._chosen(replica)
                 self.prefix_rerouted += 1
+        if adapter:
+            pinned = self._adapter_affinity.get(adapter)
+            if pinned is not None:
+                replica, pinned_at = pinned
+                snap = self._replicas.get(replica)
+                if (
+                    snap is not None
+                    and self._eligible(snap)
+                    and self._phase_ok(snap, phase)
+                    and replica not in exclude
+                    and self._routable(replica, now)
+                    and now - pinned_at <= self.affinity_ttl_s
+                ):
+                    # the replica whose adapter tiers already hold this
+                    # fine-tune (device rows or T1 host RAM): warm
+                    # adapter TTFT beats load spread (docs/ADAPTERS.md)
+                    pinned[1] = now
+                    self._adapter_affinity.move_to_end(adapter)
+                    self.picks += 1
+                    self.adapter_hits += 1
+                    if tenant:
+                        self._pin_tenant(tenant, replica, now)
+                    return self._chosen(replica)
+                self.adapter_rerouted += 1
         if tenant:
             pinned = self._affinity.get(tenant)
             if pinned is not None:
@@ -365,6 +407,8 @@ class ReplicaRouter:
                     self.affinity_hits += 1
                     if prefix:
                         self._pin_prefix(prefix, replica, now)
+                    if adapter:
+                        self._pin_adapter(adapter, replica, now)
                     return self._chosen(replica)
                 self.affinity_rerouted += 1
         choice = min(candidates)[1]
@@ -373,6 +417,8 @@ class ReplicaRouter:
             self._pin_tenant(tenant, choice, now)
         if prefix:
             self._pin_prefix(prefix, choice, now)
+        if adapter:
+            self._pin_adapter(adapter, choice, now)
         return self._chosen(choice)
 
     def _pin_tenant(self, tenant: str, replica: str, now: float) -> None:
@@ -386,6 +432,12 @@ class ReplicaRouter:
         self._prefix_affinity.move_to_end(prefix)
         while len(self._prefix_affinity) > self.MAX_AFFINITY:
             self._prefix_affinity.popitem(last=False)
+
+    def _pin_adapter(self, adapter: str, replica: str, now: float) -> None:
+        self._adapter_affinity[adapter] = [replica, now]
+        self._adapter_affinity.move_to_end(adapter)
+        while len(self._adapter_affinity) > self.MAX_AFFINITY:
+            self._adapter_affinity.popitem(last=False)
 
     # -- introspection ---------------------------------------------------
 
@@ -428,6 +480,12 @@ class ReplicaRouter:
             "prefix_hits": self.prefix_hits,
             "prefix_rerouted": self.prefix_rerouted,
             "pinned_prefixes": len(self._prefix_affinity),
+            # adapter-affinity counters (docs/ADAPTERS.md): traffic naming
+            # a LoRA adapter landing back on the replica whose tiers
+            # already hold it vs pins broken by stale/ineligible replicas
+            "adapter_hits": self.adapter_hits,
+            "adapter_rerouted": self.adapter_rerouted,
+            "pinned_adapters": len(self._adapter_affinity),
             # circuit-breaker posture (docs/RESILIENCE.md): per-replica
             # state machines + the transition tail the autoscaler/
             # engine_top read; breaker_open_replicas is the headline

@@ -41,6 +41,7 @@ from langstream_tpu.gateway.auth import (
     get_auth_provider,
 )
 from langstream_tpu.gateway.router import REPLICA_HEADER, ReplicaRouter
+from langstream_tpu.serving.adapters import ADAPTER_HEADER
 from langstream_tpu.serving.handoff import DEADLINE_HEADER
 from langstream_tpu.serving.prefixstore import (
     PREFIX_HEADER,
@@ -164,6 +165,7 @@ class GatewayRegistry:
         app_id: str,
         qos_tenant: str | None,
         prefix: str | None = None,
+        adapter: str | None = None,
     ) -> str | None:
         """The replica one produced record should land on (None = don't
         stamp): least-loaded eligible member, with session affinity on
@@ -178,7 +180,9 @@ class GatewayRegistry:
         router = self._routers.get((tenant, app_id))
         if router is None:
             return None
-        return router.pick(qos_tenant, phase="prefill", prefix=prefix)
+        return router.pick(
+            qos_tenant, phase="prefill", prefix=prefix, adapter=adapter
+        )
 
     def qos_limiter(self, tenant: str, app_id: str) -> TenantLimiter | None:
         """The app's gateway-side QoS limiter (None when the app declares
@@ -439,7 +443,17 @@ class GatewayServer:
         ):
             return {}
         tenant, priority = self._qos_identity(params, principal)
-        return {QOS_TENANT_HEADER: tenant, QOS_PRIORITY_HEADER: priority}
+        out = {QOS_TENANT_HEADER: tenant, QOS_PRIORITY_HEADER: priority}
+        if limiter is not None:
+            # tenant config names the LoRA adapter this tenant decodes
+            # with (docs/ADAPTERS.md): stamped here so the agents, the
+            # engine, and the router all see the SAME adapter identity
+            # the gateway resolved — clients cannot steer themselves
+            # onto another tenant's fine-tune
+            policy = limiter.spec.tenant_policy(tenant)
+            if policy is not None and policy.adapter:
+                out[ADAPTER_HEADER] = policy.adapter
+        return out
 
     def _stamp_deadline(
         self,
@@ -509,9 +523,13 @@ class GatewayServer:
             return headers
         qos_tenant, _ = self._qos_identity(params, principal)
         affinity = qos_tenant if qos_tenant != "anonymous" else None
-        if prefix is not None:
+        # adapter identity was already injected from tenant config (or a
+        # client header on adapter-permissive setups): route by adapter
+        # affinity beside the prefix pins (docs/ADAPTERS.md)
+        adapter = headers.get(ADAPTER_HEADER) or None
+        if prefix is not None or adapter is not None:
             replica = self.registry.route_replica(
-                tenant, app_id, affinity, prefix=prefix
+                tenant, app_id, affinity, prefix=prefix, adapter=adapter
             )
         else:
             # prefix-less traffic keeps the pre-tier call shape exactly

@@ -258,6 +258,30 @@ def attention_block(config, x, lp, cos, sin, attention):
 
 
 # ---------------------------------------------------------------------------
+# batched ragged LoRA (Punica/S-LoRA-style adapter gather)
+# ---------------------------------------------------------------------------
+
+
+def lora_delta(h: jax.Array, ids: jax.Array, a: jax.Array, b: jax.Array):
+    """Per-slot low-rank delta ``h @ A[id] @ B[id]`` for one projection.
+
+    ``a``/``b`` are one layer's slices of the stacked adapter buffers —
+    ``(n_rows, d_in, rank)`` / ``(n_rows, rank, d_out)`` — and ``ids``
+    is the per-slot ``(B,)`` int32 row index. Row 0 is all-zeros, so
+    adapter-less slots compute the base model exactly; heterogeneous-
+    adapter batches stay ONE jitted program (the gather is data, not
+    structure — no per-adapter recompiles). The LoRA alpha/rank scale
+    is folded into B at publish time (serving/adapters.py)."""
+    a_sel = jnp.take(a, ids, axis=0)  # (B, d_in, rank)
+    b_sel = jnp.take(b, ids, axis=0)  # (B, rank, d_out)
+    if h.ndim == 2:
+        t = jnp.einsum("bh,bhr->br", h, a_sel)
+        return jnp.einsum("br,bro->bo", t, b_sel)
+    t = jnp.einsum("bph,bhr->bpr", h, a_sel)
+    return jnp.einsum("bpr,bro->bpo", t, b_sel)
+
+
+# ---------------------------------------------------------------------------
 # prefill
 # ---------------------------------------------------------------------------
 
@@ -271,6 +295,9 @@ def prefill_forward(
     mesh: Mesh | None = None,  # flash under a mesh runs via shard_map
     ffn=None,                # (h (B,P,H), lp, valid=None) -> (B,P,H);
                              # default dense SwiGLU
+    adapters: dict | None = None,  # {"ids": (B,) int32, "layers":
+                             # {wq_a (L,N,H,r), wq_b (L,N,r,qd), ...}} —
+                             # None keeps the seed jaxpr untouched
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared prompt forward (the single source of the prefill layer math):
     returns (last-token logits (B,V), ks, vs) where ks/vs are the roped
@@ -329,12 +356,24 @@ def prefill_forward(
         x_spec = NamedSharding(mesh, P(sp_dp, "sp", None))
         x = jax.lax.with_sharding_constraint(x, x_spec)
 
-    def layer(carry, lp):
+    def layer(carry, layer_in):
         x = carry
+        if adapters is None:
+            lp = layer_in
+        else:
+            lp, al = layer_in
         h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"])).reshape(B, Pn, c.heads, c.head_dim)
-        k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"])).reshape(B, Pn, c.kv_heads, c.head_dim)
-        v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"])).reshape(B, Pn, c.kv_heads, c.head_dim)
+        q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"]))
+        k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"]))
+        v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"]))
+        if adapters is not None:
+            ids = adapters["ids"]
+            q = q + lora_delta(h, ids, al["wq_a"], al["wq_b"])
+            k = k + lora_delta(h, ids, al["wk_a"], al["wk_b"])
+            v = v + lora_delta(h, ids, al["wv_a"], al["wv_b"])
+        q = q.reshape(B, Pn, c.heads, c.head_dim)
+        k = k.reshape(B, Pn, c.kv_heads, c.head_dim)
+        v = v.reshape(B, Pn, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         if sp_ring:
@@ -370,14 +409,22 @@ def prefill_forward(
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
             out = out.reshape(B, Pn, c.heads * c.head_dim)
-        x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
+        attn = jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
+        if adapters is not None:
+            attn = attn + lora_delta(out, adapters["ids"], al["wo_a"], al["wo_b"])
+        x = x + attn
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + ffn(h2, lp, pos_valid)
         if sp_ring:
             x = jax.lax.with_sharding_constraint(x, x_spec)
         return x, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+    layer_xs = (
+        params["layers"]
+        if adapters is None
+        else (params["layers"], adapters["layers"])
+    )
+    x, (ks, vs) = jax.lax.scan(layer, x, layer_xs)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     # logits for the last real token of each prompt
     last = jnp.take_along_axis(

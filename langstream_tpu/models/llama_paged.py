@@ -26,6 +26,7 @@ from langstream_tpu.models.llama import (
     _default_ffn,
     _rms_norm,
     _rope,
+    lora_delta,
 )
 from langstream_tpu.models.paged import gather_kv, write_rows
 from langstream_tpu.models.quant import as_weight as _w, embedding_take
@@ -47,6 +48,7 @@ def llama_prefill_paged(
     use_flash: bool | None = None,
     mesh=None,
     ffn=None,                 # pluggable FFN sub-block (MoE family hook)
+    adapters: dict | None = None,  # batched ragged LoRA (see lora_delta)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prompt forward + paged cache fill: the shared
     :func:`~langstream_tpu.models.llama.prefill_forward` layer math with the
@@ -56,7 +58,8 @@ def llama_prefill_paged(
     c = config
     B, Pn = tokens.shape
     logits, ks, vs = prefill_forward(
-        c, params, tokens, lengths, use_flash, mesh=mesh, ffn=ffn
+        c, params, tokens, lengths, use_flash, mesh=mesh, ffn=ffn,
+        adapters=adapters,
     )
     KhD = c.kv_heads * c.head_dim
     L = ks.shape[0]
@@ -87,6 +90,7 @@ def llama_prefill_continue_paged(
                           # kernel; under a mesh it runs per-shard via
                           # shard_map — slots on dp, heads on tp)
     mesh=None,
+    adapters: dict | None = None,  # batched ragged LoRA (see lora_delta)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill CONTINUATION: process a prompt suffix whose prefix K/V is
     already in the paged pool (positions ``[0, start)`` per slot).
@@ -136,17 +140,22 @@ def llama_prefill_continue_paged(
     n_suffix_blocks = P2 // sbs
 
     def layer(x, layer_in):
-        lp, ck_l, cv_l = layer_in
+        if adapters is None:
+            lp, ck_l, cv_l = layer_in
+        else:
+            lp, al, ck_l, cv_l = layer_in
         h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"])).reshape(
-            B, P2, c.heads, c.head_dim
-        )
-        k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"])).reshape(
-            B, P2, c.kv_heads, c.head_dim
-        )
-        v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"])).reshape(
-            B, P2, c.kv_heads, c.head_dim
-        )
+        q = jnp.einsum("bph,hd->bpd", h, _w(lp["wq"]))
+        k = jnp.einsum("bph,hd->bpd", h, _w(lp["wk"]))
+        v = jnp.einsum("bph,hd->bpd", h, _w(lp["wv"]))
+        if adapters is not None:
+            ids = adapters["ids"]
+            q = q + lora_delta(h, ids, al["wq_a"], al["wq_b"])
+            k = k + lora_delta(h, ids, al["wk_a"], al["wk_b"])
+            v = v + lora_delta(h, ids, al["wv_a"], al["wv_b"])
+        q = q.reshape(B, P2, c.heads, c.head_dim)
+        k = k.reshape(B, P2, c.kv_heads, c.head_dim)
+        v = v.reshape(B, P2, c.kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         qg = q.reshape(B, P2, c.kv_heads, G, c.head_dim)
@@ -305,12 +314,20 @@ def llama_prefill_continue_paged(
         inv = jnp.where(l > 0.0, 1.0 / jnp.maximum(l, 1e-30), 0.0)
         out = (o * inv[..., None]).astype(x.dtype)  # (B, Kh, G, P2, D)
         out = out.transpose(0, 3, 1, 2, 4).reshape(B, P2, c.heads * c.head_dim)
-        x = x + jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
+        attn = jnp.einsum("bpd,dh->bph", out, _w(lp["wo"]))
+        if adapters is not None:
+            attn = attn + lora_delta(out, adapters["ids"], al["wo_a"], al["wo_b"])
+        x = x + attn
         h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
         x = x + ffn(h2, lp, pos_valid)
         return x, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], pool_k, pool_v))
+    layer_xs = (
+        (params["layers"], pool_k, pool_v)
+        if adapters is None
+        else (params["layers"], adapters["layers"], pool_k, pool_v)
+    )
+    x, (ks, vs) = jax.lax.scan(layer, x, layer_xs)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     if return_all_logits:
         logits = jnp.einsum("bph,hv->bpv", x, _w(params["lm_head"])).astype(
@@ -351,6 +368,7 @@ def llama_verify_chunk_paged(
     topks: jax.Array | None = None,
     topps: jax.Array | None = None,
     sampler_mode: tuple | None = None,  # (use_top_p, use_top_k, all_greedy)
+    adapters: dict | None = None,  # batched ragged LoRA (see lora_delta)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Speculative VERIFY step (prompt-lookup decoding).
 
@@ -404,7 +422,7 @@ def llama_verify_chunk_paged(
         c, params, tokens, base_lengths,
         suffix_lengths, pool_k, pool_v, block_tables,
         num_read_blocks, ffn=ffn, return_all_logits=True, kernel=kernel,
-        mesh=mesh,
+        mesh=mesh, adapters=adapters,
     )  # logits (B, D1, V)
     drafts = tokens[:, 1:]                                   # (B, D1-1)
     logits_f32 = logits.astype(jnp.float32)
@@ -517,6 +535,7 @@ def llama_decode_chunk_paged(
                               # default dense SwiGLU
     sample_extras=None,       # (presences, frequencies, counts0) — see
                               # llama_decode_chunk
+    adapters: dict | None = None,  # batched ragged LoRA (see lora_delta)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K fused decode steps against the paged pool; same two-segment
     discipline as the dense ``llama_decode_chunk`` (pool read-only, new K/V
@@ -587,11 +606,22 @@ def llama_decode_chunk_paged(
         G = c.heads // c.kv_heads
 
         def layer(x, layer_in):
-            lp, ck_l, cv_l, kbuf_l, vbuf_l = layer_in
+            if adapters is None:
+                lp, ck_l, cv_l, kbuf_l, vbuf_l = layer_in
+            else:
+                lp, al, ck_l, cv_l, kbuf_l, vbuf_l = layer_in
             h = _rms_norm(x, lp["attn_norm"], c.norm_eps)
-            q = (h @ _w(lp["wq"])).reshape(B, c.heads, c.head_dim)
-            k = (h @ _w(lp["wk"])).reshape(B, c.kv_heads, c.head_dim)
-            v = (h @ _w(lp["wv"])).reshape(B, c.kv_heads, c.head_dim)
+            q = h @ _w(lp["wq"])
+            k = h @ _w(lp["wk"])
+            v = h @ _w(lp["wv"])
+            if adapters is not None:
+                ids = adapters["ids"]
+                q = q + lora_delta(h, ids, al["wq_a"], al["wq_b"])
+                k = k + lora_delta(h, ids, al["wk_a"], al["wk_b"])
+                v = v + lora_delta(h, ids, al["wv_a"], al["wv_b"])
+            q = q.reshape(B, c.heads, c.head_dim)
+            k = k.reshape(B, c.kv_heads, c.head_dim)
+            v = v.reshape(B, c.kv_heads, c.head_dim)
             q = _apply_rope(q, cos, sin)
             k = _apply_rope(k, cos, sin)
             kbuf_l = jax.lax.dynamic_update_slice_in_dim(
@@ -624,14 +654,23 @@ def llama_decode_chunk_paged(
                 ),
             ]).astype(x.dtype)
             out = out.reshape(B, c.heads * c.head_dim)
-            x = x + out @ _w(lp["wo"])
+            attn = out @ _w(lp["wo"])
+            if adapters is not None:
+                attn = attn + lora_delta(
+                    out, adapters["ids"], al["wo_a"], al["wo_b"]
+                )
+            x = x + attn
             h2 = _rms_norm(x, lp["mlp_norm"], c.norm_eps)
             x = x + ffn(h2, lp, active)
             return x, (kbuf_l, vbuf_l)
 
-        x, (kbuf, vbuf) = jax.lax.scan(
-            layer, x, (params["layers"], pool_k, pool_v, kbuf, vbuf)
+        layer_xs = (
+            (params["layers"], pool_k, pool_v, kbuf, vbuf)
+            if adapters is None
+            else (params["layers"], adapters["layers"], pool_k, pool_v,
+                  kbuf, vbuf)
         )
+        x, (kbuf, vbuf) = jax.lax.scan(layer, x, layer_xs)
         x = _rms_norm(x, params["final_norm"], c.norm_eps)
         logits = (x @ _w(params["lm_head"])).astype(jnp.float32)
         if pen:

@@ -90,6 +90,7 @@ from langstream_tpu.serving.faults import (
 from langstream_tpu.serving.flight import FlightRecorder
 from langstream_tpu.serving.incident import (
     IncidentRecorder,
+    adapter_eviction_storm,
     breaker_storm,
     worst_journeys,
 )
@@ -107,6 +108,11 @@ from langstream_tpu.serving.health import (
     SloTracker,
 )
 from langstream_tpu.serving.streaming import STREAMS, TbtDigest
+from langstream_tpu.serving.adapters import (
+    AdapterStore,
+    AdapterStoreSpec,
+    AdapterUnavailable,
+)
 from langstream_tpu.serving.prefixstore import PrefixStore, PrefixStoreSpec
 from langstream_tpu.serving.profiling import (
     ProfilerHooks,
@@ -334,6 +340,17 @@ class ServingConfig:
     # hydrate instead of recomputing. Requires kv-layout=paged with
     # prefix-cache on.
     prefix_store: "PrefixStoreSpec | None" = None
+    # multi-LoRA adapter store (serving/adapters.py, docs/ADAPTERS.md):
+    # None keeps the single-model engine, bit for bit — no stacked
+    # buffers, no new jit arguments, no new surfaces. A spec gives the
+    # paged decode program a stacked per-layer A/B factor buffer with
+    # t0-entries device-resident adapter rows (row 0 = zeros for
+    # adapter-less slots), a T1 host-RAM spill, and a T2 object-storage
+    # origin; requests name adapters via the langstream-adapter header
+    # and admission blocks on hydration like the prefix stash. Requires
+    # kv-layout=paged; incompatible with multi-host lockstep (followers
+    # replay positional descriptors that carry no adapter rows).
+    adapter_store: "AdapterStoreSpec | None" = None
     # device-survival plane (docs/RESILIENCE.md): a device allocator
     # failure (RESOURCE_EXHAUSTED and its jaxlib spellings) at a
     # pool-grow/prefill/scatter seam no longer fails every in-flight
@@ -402,6 +419,11 @@ class ServingConfig:
             "prefix-store": (
                 self.prefix_store.to_dict()
                 if self.prefix_store is not None
+                else None
+            ),
+            "adapter-store": (
+                self.adapter_store.to_dict()
+                if self.adapter_store is not None
                 else None
             ),
             "prefill-chunk": self.prefill_chunk,
@@ -473,6 +495,9 @@ class ServingConfig:
             ),
             prefix_store=PrefixStoreSpec.from_dict(
                 d.get("prefix-store", d.get("prefix_store"))
+            ),
+            adapter_store=AdapterStoreSpec.from_dict(
+                d.get("adapter-store", d.get("adapter_store"))
             ),
             prefill_chunk=int(
                 d.get("prefill-chunk", d.get("prefill_chunk", 0))
@@ -594,6 +619,16 @@ class _Request:
     # has stashed this request for a T2 hydration — it never stashes
     # twice, so a failed/timed-out hydration falls back to cold compute
     hydrate_attempted: bool = False
+    # multi-LoRA adapter serving (serving/adapters.py): the adapter the
+    # request named (gateway-stamped langstream-adapter header, "" =
+    # base model), the device row its slot decodes against, whether a
+    # T2 hydration stash already happened (one stash, then cold
+    # refusal — unlike a prefix miss there is no recompute fallback),
+    # and whether this request holds a pin on the adapter's row
+    adapter: str = ""
+    adapter_row: int = 0
+    adapter_hydrate_attempted: bool = False
+    adapter_pinned: bool = False
     # KV handoff (docs/DISAGG.md): True for a request admitted through
     # /kv/import on a decode-pool engine — its KV state arrived over the
     # wire, so admission skipped prefill entirely (request_timings carry
@@ -1353,6 +1388,56 @@ class TpuServingEngine:
                     "store — counted, never silent)",
                 ),
             }
+        # tiered multi-LoRA adapter store (serving/adapters.py,
+        # docs/ADAPTERS.md): device-resident stacked A/B rows (T0) over
+        # host-RAM spill (T1) and an object-storage origin (T2). Same
+        # off-scheduler hydration stash discipline as the prefix store;
+        # requests stalled on a cold adapter never head-block admission.
+        # Disabled (the default) the engine is byte-identical to seed:
+        # no store, no gauges, no stats section, no extra jit kwargs.
+        self.adapter_store: AdapterStore | None = None
+        self._adapter_hydrating: list = []  # (request, deadline_m, name)
+        self.adapter_refusals = 0  # cold refusals (unknown or timed out)
+        self._m_adapters: dict[str, Any] = {}
+        if config.adapter_store is not None and config.adapter_store.enabled:
+            self.adapter_store = AdapterStore(
+                config.adapter_store,
+                fingerprint=self.adapter_fingerprint(),
+                entry_bytes=self._adapter_entry_bytes(),
+            )
+            self._m_adapters = {
+                "t0_bytes": reporter.gauge(
+                    "adapter_tier_t0_bytes",
+                    "HBM bytes held by device-resident LoRA adapter rows "
+                    "(budget = adapter-store t0-entries x entry bytes)",
+                ),
+                "t1_bytes": reporter.gauge(
+                    "adapter_tier_t1_bytes",
+                    "host-RAM bytes held by T1 spilled LoRA adapters",
+                ),
+                "t2_bytes": reporter.gauge(
+                    "adapter_tier_t2_bytes",
+                    "object-storage payload bytes indexed in adapter T2",
+                ),
+                "loads": reporter.counter(
+                    "adapter_loads_total",
+                    "LoRA adapter rows loaded into the device buffers "
+                    "(T1→T0 promotions)",
+                ),
+                "hydrations": reporter.counter(
+                    "adapter_hydrations_total",
+                    "LoRA adapters hydrated T2→T1 for an admission",
+                ),
+                "demotions": reporter.counter(
+                    "adapter_demotions_total",
+                    "LoRA adapters demoted T1→T2 under host-RAM pressure",
+                ),
+                "evictions": reporter.counter(
+                    "adapter_evictions_total",
+                    "LoRA adapters evicted from any tier (bytes left the "
+                    "store — counted, never silent)",
+                ),
+            }
         # device-survival plane (docs/RESILIENCE.md): fault injection,
         # adaptive pool-shrink, crash-requeue journal. Default config
         # keeps the hot path bit-for-bit: _faults is None (every seam
@@ -1369,6 +1454,10 @@ class TpuServingEngine:
             # consults the SAME injector the device seams use, so one
             # chaos plan scripts both failure domains
             self.prefix_store._fault_injector = self._faults
+        if self.adapter_store is not None and self._faults is not None:
+            # the adapter hydrator shares the t2-get seam too — one plan
+            # scripts prefix AND adapter origin fetches
+            self.adapter_store._fault_injector = self._faults
         # fired faults hand off loop-ward through a deque: the seams
         # span both thread roles, the flight ring's emission is loop-side
         self._fault_fired: deque = deque()
@@ -1546,6 +1635,22 @@ class TpuServingEngine:
                     "prefix-store requires prefix-cache=true (T0 IS the "
                     "automatic prefix cache; without it there is nothing "
                     "to demote or promote)"
+                )
+        if (
+            self.config.adapter_store is not None
+            and self.config.adapter_store.enabled
+        ):
+            if self.config.kv_layout != "paged":
+                raise ValueError(
+                    "adapter-store requires kv-layout=paged (batched "
+                    "ragged adapter application rides the paged "
+                    "decode/prefill programs)"
+                )
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "adapter-store is incompatible with multi-host "
+                    "lockstep (followers replay positional dispatch "
+                    "descriptors that carry no adapter rows)"
                 )
         if self.config.prefill_chunk > 0 and self.config.kv_layout != "paged":
             raise ValueError(
@@ -1747,6 +1852,36 @@ class TpuServingEngine:
                     cache_v = put_global(cache_v, cspec)
         self.cache_k, self.cache_v = cache_k, cache_v
 
+        # stacked device LoRA buffers (docs/ADAPTERS.md): row 0 is the
+        # permanent zero adapter (adapter-less slots gather zeros, so one
+        # jitted program serves heterogeneous-adapter batches), rows
+        # 1..t0_entries back the AdapterStore's T0 tier. The buffers are
+        # NOT donated — loads rebuild one row functionally (`.at[:, row]
+        # .set`) on the dispatch thread, so an in-flight dispatch keeps
+        # its snapshot. `_ad_rows` is the loop-side slot→row mirror.
+        self._ad_layers: dict[str, Any] | None = None
+        self._ad_rows: np.ndarray | None = None
+        spec_ad = self.config.adapter_store
+        if spec_ad is not None and spec_ad.enabled:
+            n_rows = spec_ad.t0_entries + 1
+            r = spec_ad.rank
+            q_dim = mc.heads * mc.head_dim
+            kv_dim = mc.kv_heads * mc.head_dim
+            shapes = {
+                "wq_a": (mc.layers, n_rows, mc.hidden, r),
+                "wq_b": (mc.layers, n_rows, r, q_dim),
+                "wk_a": (mc.layers, n_rows, mc.hidden, r),
+                "wk_b": (mc.layers, n_rows, r, kv_dim),
+                "wv_a": (mc.layers, n_rows, mc.hidden, r),
+                "wv_b": (mc.layers, n_rows, r, kv_dim),
+                "wo_a": (mc.layers, n_rows, q_dim, r),
+                "wo_b": (mc.layers, n_rows, r, mc.hidden),
+            }
+            self._ad_layers = {
+                k: jnp.zeros(s, dtype=mc.dtype) for k, s in shapes.items()
+            }
+            self._ad_rows = np.zeros(self.config.slots, dtype=np.int32)
+
         mc_static = mc
         ffn_static = self._ffn  # None = dense SwiGLU; MoE routes experts
 
@@ -1813,11 +1948,19 @@ class TpuServingEngine:
                 @partial(jax.jit, donate_argnums=(1, 2))
                 def _decode_chunk(params, cache_k, cache_v, tokens, lengths,
                                   active, tables, key, temps, topks, topps,
-                                  pres=None, freq=None, counts=None):
+                                  pres=None, freq=None, counts=None,
+                                  ad_layers=None, ad_ids=None):
                     from langstream_tpu.models.llama_paged import (
                         llama_decode_chunk_paged,
                     )
 
+                    # kwargs default to None so the adapter-less engine traces
+                    # the exact seed jaxpr — adapters ride in only when the
+                    # store is enabled and the dispatch passes them explicitly
+                    adapters = (
+                        None if ad_ids is None
+                        else {"ids": ad_ids, "layers": ad_layers}
+                    )
                     sample_fn = _sample_fn_for(temps, topks, topps, pres, freq)
                     out = llama_decode_chunk_paged(
                         mc_static, params, tokens, lengths, active,
@@ -1826,6 +1969,7 @@ class TpuServingEngine:
                         kernel=self.paged_read_kernel,
                         mesh=mesh_static, ffn=ffn_static,
                         sample_extras=_extras(pres, freq, counts),
+                        adapters=adapters,
                     )
                     return _fetchable(out[0], out[1]) + out[2:]
 
@@ -1875,15 +2019,20 @@ class TpuServingEngine:
             if paged:
                 @partial(jax.jit, donate_argnums=(1, 2))
                 def _prefill(params, cache_k, cache_v, tokens, lengths, tables,
-                             key, temps, topks, topps):
+                             key, temps, topks, topps,
+                             ad_layers=None, ad_ids=None):
                     from langstream_tpu.models.llama_paged import (
                         llama_prefill_paged,
                     )
 
+                    adapters = (
+                        None if ad_ids is None
+                        else {"ids": ad_ids, "layers": ad_layers}
+                    )
                     logits, ck, cv = llama_prefill_paged(
                         mc_static, params, tokens, lengths, cache_k, cache_v,
                         tables, use_flash=prefill_flash, mesh=mesh_static,
-                        ffn=ffn_static,
+                        ffn=ffn_static, adapters=adapters,
                     )
                     next_tokens, logprobs = _fetchable(
                         *sample_tokens(
@@ -1924,16 +2073,21 @@ class TpuServingEngine:
 
             @partial(jax.jit, donate_argnums=(1, 2))
             def _prefill_cont(params, cache_k, cache_v, tokens, starts,
-                              suffix_lengths, tables, key, temps, topks, topps):
+                              suffix_lengths, tables, key, temps, topks, topps,
+                              ad_layers=None, ad_ids=None):
                 from langstream_tpu.models.llama_paged import (
                     llama_prefill_continue_paged,
                 )
 
+                adapters = (
+                    None if ad_ids is None
+                    else {"ids": ad_ids, "layers": ad_layers}
+                )
                 logits, ck, cv = llama_prefill_continue_paged(
                     mc_static, params, tokens, starts, suffix_lengths,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
                     ffn=ffn_static, kernel=self._continuation_kernel(),
-                    mesh=mesh_static,
+                    mesh=mesh_static, adapters=adapters,
                 )
                 next_tokens, logprobs = _fetchable(
                     *sample_tokens(
@@ -1956,17 +2110,23 @@ class TpuServingEngine:
 
             @partial(jax.jit, donate_argnums=(1, 2))
             def _verify(params, cache_k, cache_v, tokens, lengths, active,
-                        tables, key, temps, topks, topps):
+                        tables, key, temps, topks, topps,
+                        ad_layers=None, ad_ids=None):
                 from langstream_tpu.models.llama_paged import (
                     llama_verify_chunk_paged,
                 )
 
+                adapters = (
+                    None if ad_ids is None
+                    else {"ids": ad_ids, "layers": ad_layers}
+                )
                 out = llama_verify_chunk_paged(
                     mc_static, params, tokens, lengths, active,
                     cache_k, cache_v, tables, num_read_blocks=nrb,
                     ffn=ffn_static, kernel=self._continuation_kernel(),
                     mesh=mesh_static, key=key, temps=temps, topks=topks,
                     topps=topps, sampler_mode=sampler_mode,
+                    adapters=adapters,
                 )
                 # the leader host reads everything but the pools each step
                 return _fetchable(*out[:4]) + out[4:6] + _fetchable(out[6])
@@ -2396,6 +2556,22 @@ class TpuServingEngine:
                 self._incident_capture(
                     "breaker-storm", {"source": "health", **storm}
                 )
+            if self.adapter_store is not None:
+                # adapter eviction-storm predicate (docs/ADAPTERS.md):
+                # one adapter bouncing out of the tiers repeatedly
+                # inside a single hydrate window — thrash the next
+                # request re-pays — over the same snapshotted tail
+                thrash = adapter_eviction_storm(
+                    self.flight.recent_events(256),
+                    time.monotonic(),
+                    window_s=self.adapter_store.spec.hydrate_timeout_s,
+                )
+                if thrash is not None:
+                    self._incident_capture(
+                        "adapter-storm",
+                        {"source": "health", **thrash},
+                        dedup_key=thrash["adapter"],
+                    )
         warmup = self._warmup_state()
         # a draining engine is alive but must take no new traffic: ready
         # drops (the router and the readiness probe both key off it)
@@ -2474,6 +2650,11 @@ class TpuServingEngine:
             ),
             "config": self.config.to_dict(),
         }
+        if self.adapter_store is not None:
+            # tier residency + ledger at the breach instant (key absent
+            # on adapter-less engines: their bundles stay byte-identical
+            # to a pre-adapter build)
+            bundle["adapters"] = self.adapter_store_section()
         bundle_id = rec.submit(bundle)
         self.flight.event("incident", bundle=bundle_id, trigger=kind)
 
@@ -2701,6 +2882,14 @@ class TpuServingEngine:
                 f"kv-pool-blocks/kv-pool-fraction"
             )
         stop = _normalize_stop(options.get("stop"))
+        adapter = str(options.get("adapter", "") or "")
+        if adapter and self.adapter_store is None:
+            # refused loudly at submit: a silently-ignored adapter would
+            # serve base-model output under the tenant's fine-tune name
+            raise ValueError(
+                f"request names adapter {adapter!r} but this engine has "
+                "no adapter store configured (serving adapter-store)"
+            )
         request = _Request(
             prompt_tokens=tokens,
             max_tokens=max_tokens,
@@ -2731,6 +2920,7 @@ class TpuServingEngine:
                 if options.get("stream-key")
                 else None
             ),
+            adapter=adapter,
         )
         if on_chunk is not None and self.config.streaming:
             # bounded per-request TBT digest (never the raw interval
@@ -2927,6 +3117,11 @@ class TpuServingEngine:
             # demotion/eviction counters, exact byte ledger
             # (docs/PREFIX.md)
             out["prefixstore"] = self.prefix_store_section()
+        if self.adapter_store is not None:
+            # tiered multi-LoRA adapter store: per-tier bytes/budgets,
+            # hit/load/eviction counters, resident rows, exact byte
+            # ledger (docs/ADAPTERS.md)
+            out["adapters"] = self.adapter_store_section()
         if self.block_mgr is not None:
             out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
         if self.config.speculative_drafts > 0:
@@ -2953,6 +3148,8 @@ class TpuServingEngine:
             self._lockstep.close()
         if self.prefix_store is not None:
             self.prefix_store.close()
+        if self.adapter_store is not None:
+            self.adapter_store.close()
         if self.journal is not None:
             # flush the retire tail: a clean shutdown leaves a journal
             # that replays exactly the work this process never answered
@@ -2982,6 +3179,8 @@ class TpuServingEngine:
         self.params = None
         # graftcheck: disable=RACE801 loop task awaited + executor joined (wait=True): no dispatch closure can still run
         self.cache_k = self.cache_v = None
+        # graftcheck: disable=RACE801 loop task awaited + executor joined (wait=True): no dispatch closure can still run
+        self._ad_layers = None
         self._decode_chunk_fns.clear()
         self._pending_chunk = None
         # graftcheck: disable=RACE801 loop task awaited + executor joined (wait=True): no dispatch closure can still run
@@ -3137,6 +3336,40 @@ class TpuServingEngine:
             "head-dim": mc.head_dim,
             "max-seq-len": mc.max_seq_len,
         }
+
+    def adapter_fingerprint(self) -> dict[str, Any]:
+        """The facts a LoRA adapter blob must agree on before its
+        factors may touch the device A/B buffers — serialized into
+        every T2 wire header and checked on fetch (mismatch → the blob
+        is refused AND deleted, never installed). Pure attribute reads
+        (POOL701)."""
+        mc = self.model_config
+        spec = self.config.adapter_store
+        return {
+            "model": self.config.model,
+            "dtype": str(np.dtype(mc.dtype).name),
+            "rank": spec.rank if spec is not None else 0,
+            "layers": mc.layers,
+            "hidden": mc.hidden,
+            "heads": mc.heads,
+            "kv-heads": mc.kv_heads,
+            "head-dim": mc.head_dim,
+        }
+
+    def _adapter_entry_bytes(self) -> int:
+        """Device bytes one resident adapter row occupies across the
+        eight stacked factor buffers (all layers, model dtype)."""
+        mc = self.model_config
+        r = self.config.adapter_store.rank
+        q_dim = mc.heads * mc.head_dim
+        kv_dim = mc.kv_heads * mc.head_dim
+        per_layer = (
+            (mc.hidden * r + r * q_dim)        # wq_a / wq_b
+            + (mc.hidden * r + r * kv_dim)     # wk_a / wk_b
+            + (mc.hidden * r + r * kv_dim)     # wv_a / wv_b
+            + (q_dim * r + r * mc.hidden)      # wo_a / wo_b
+        )
+        return mc.layers * per_layer * np.dtype(mc.dtype).itemsize
 
     def kv_transfer_section(self) -> dict[str, Any]:
         """The ``stats()["kvtransfer"]`` / flight-summary section:
@@ -3297,6 +3530,9 @@ class TpuServingEngine:
                 slot.request = None
                 slot.prefill_done = 0
                 self._lengths[slot_id] = 0
+                self._adapter_release(request)
+                if self._ad_rows is not None:
+                    self._ad_rows[slot_id] = 0
                 if self.block_mgr is not None:
                     self.block_mgr.release(slot_id)
                 self.scheduler.on_finished(request)
@@ -3379,6 +3615,9 @@ class TpuServingEngine:
         slot.prefilling = False
         slot.prefill_done = 0
         self._lengths[slot_id] = 0
+        self._adapter_release(request)
+        if self._ad_rows is not None:
+            self._ad_rows[slot_id] = 0
         self.block_mgr.release(slot_id)
         if not request.warmup:
             self._exports[rid] = {
@@ -3944,6 +4183,11 @@ class TpuServingEngine:
                     # requeue at class front, so the admission passes
                     # below see them immediately (docs/PREFIX.md)
                     self._prefix_tier_step()
+                if self.adapter_store is not None:
+                    # adapter hydrations settle at the same safe point
+                    # (requeue at class front or cold-refuse loudly —
+                    # docs/ADAPTERS.md)
+                    self._adapter_tier_step()
                 if self._pending_imports:
                     # KV handoff imports land at the loop's safe point,
                     # exactly like admission: a free slot + a worst-case
@@ -4011,6 +4255,7 @@ class TpuServingEngine:
                         idle_s = (
                             0.02
                             if self._prefix_hydrating
+                            or self._adapter_hydrating
                             or self._prefix_demote_pending()
                             else 1.0
                         )
@@ -4103,12 +4348,15 @@ class TpuServingEngine:
                 # an explicitly failed request was ANSWERED — retire its
                 # journal entry so a restart never replays served errors
                 self._journal_retire(request)
+                self._adapter_release(request)
             slot.request = None
             slot.prefilling = False
             slot.prefill_done = 0
             if self.block_mgr is not None:
                 self.block_mgr.release(slot_id)
         self._lengths[:] = 0
+        if self._ad_rows is not None:
+            self._ad_rows[:] = 0
         for request in self.scheduler.drain():
             if not request.future.done():
                 request.future.set_exception(error)
@@ -4131,6 +4379,15 @@ class TpuServingEngine:
                     self._slo_record("availability", False)
             self._journal_retire(request)
         self._prefix_hydrating.clear()
+        for stashed in self._adapter_hydrating:
+            request = stashed[0]
+            if not request.future.done():
+                request.future.set_exception(error)
+                self._journey(request, "fail", error=error_text)
+                if not request.warmup:
+                    self._slo_record("availability", False)
+            self._journal_retire(request)
+        self._adapter_hydrating.clear()
         self._pending_emits.clear()
         self._finished_requests.clear()
 
@@ -4184,6 +4441,13 @@ class TpuServingEngine:
         slot.prefilling = False
         slot.prefill_done = 0
         self._lengths[slot_id] = 0
+        # drop the adapter pin across the preemption (the slot frees and
+        # its row may evict); re-admission re-resolves — and may re-
+        # hydrate, so the one-shot attempt flag resets too
+        self._adapter_release(request)
+        request.adapter_hydrate_attempted = False
+        if self._ad_rows is not None:
+            self._ad_rows[slot_id] = 0
         if self.block_mgr is not None:
             self.block_mgr.release(slot_id)
         request.preemptions += 1
@@ -4258,6 +4522,9 @@ class TpuServingEngine:
         slot.prefilling = False
         slot.prefill_done = 0
         self._lengths[slot_id] = 0
+        self._adapter_release(request)
+        if self._ad_rows is not None:
+            self._ad_rows[slot_id] = 0
         if self.block_mgr is not None:
             self.block_mgr.release(slot_id)
         self.flight.event(
@@ -4592,12 +4859,35 @@ class TpuServingEngine:
         )
 
     def _emit_prefix_events(self) -> None:
-        """Drain the store's pending event feed into the flight ring and
-        mirror each transition onto its Prometheus counter — the ONE
-        emission path, so the scrape surface can never drift from the
-        flight events (wait-free: appends + counter bumps, PFX801)."""
-        for kind, detail in self.prefix_store.drain_events():
+        """Drain the prefix store's pending event feed (see
+        :meth:`_emit_store_events` for the shared emission path)."""
+        self._emit_store_events(self.prefix_store.drain_events())
+
+    def _emit_store_events(self, events) -> None:
+        """Drain a tiered store's pending event feed into the flight
+        ring and mirror each transition onto its Prometheus counter —
+        the ONE dynamic emission path in the engine (both the prefix
+        and the adapter store drain through this call site; the
+        event-vocabulary conformance test pins it), so the scrape
+        surface can never drift from the flight events (wait-free:
+        appends + counter bumps, PFX801/LORA1701)."""
+        for kind, detail in events:
             self.flight.event(kind, **detail)
+            if kind.startswith("adapter-"):
+                if not self._m_adapters:
+                    continue
+                if kind == "adapter-evict":
+                    self._m_adapters["evictions"](1)
+                elif kind == "adapter-demote":
+                    self._m_adapters["demotions"](1)
+                elif kind == "adapter-load":
+                    self._m_adapters["loads"](1)
+                elif (
+                    kind == "adapter-hydrate"
+                    and detail.get("stage") == "fetched"
+                ):
+                    self._m_adapters["hydrations"](1)
+                continue
             if not self._m_prefix_tier:
                 continue
             if kind == "prefix-demote":
@@ -4865,6 +5155,211 @@ class TpuServingEngine:
             self._m_prefix_tier["t2_bytes"](store.t2_bytes)
         return section
 
+    # ------------------------------------------------------------------
+    # multi-LoRA adapter tier plumbing (serving/adapters.py,
+    # docs/ADAPTERS.md)
+    # ------------------------------------------------------------------
+
+    def install_adapter(
+        self, name: str, arrays: dict[str, np.ndarray]
+    ) -> None:
+        """Install LoRA factors into the store's T1 tier directly (the
+        local load path: tests, bench seeding, a sidecar that fetched
+        out-of-band). Shapes are checked against the model HERE so a
+        wrong-rank adapter fails at install, not mid-decode."""
+        if self.adapter_store is None:
+            raise ValueError(
+                "adapter store not configured (serving adapter-store)"
+            )
+        mc = self.model_config
+        r = self.config.adapter_store.rank
+        q_dim = mc.heads * mc.head_dim
+        kv_dim = mc.kv_heads * mc.head_dim
+        expect = {
+            "wq_a": (mc.layers, mc.hidden, r),
+            "wq_b": (mc.layers, r, q_dim),
+            "wk_a": (mc.layers, mc.hidden, r),
+            "wk_b": (mc.layers, r, kv_dim),
+            "wv_a": (mc.layers, mc.hidden, r),
+            "wv_b": (mc.layers, r, kv_dim),
+            "wo_a": (mc.layers, q_dim, r),
+            "wo_b": (mc.layers, r, mc.hidden),
+        }
+        for k, shape in expect.items():
+            got = tuple(np.asarray(arrays[k]).shape) if k in arrays else None
+            if got != shape:
+                raise ValueError(
+                    f"adapter {name!r} factor {k}: shape {got}, "
+                    f"model expects {shape}"
+                )
+        self.adapter_store.install(name, arrays)
+
+    async def _resolve_adapter(self, loop, request: "_Request") -> str:
+        """Admission-side adapter resolve. Returns one of:
+
+        - ``"ready"``    — a device row holds the adapter; the request is
+          pinned against eviction and carries the row index.
+        - ``"wait"``     — the adapter is hydrating T2→T1; the request was
+          popped and stashed OFF the scheduler (same discipline as the
+          prefix hydration stash — it never head-blocks admission).
+        - ``"refused"``  — unknown adapter or a spent hydration attempt:
+          the request was popped and failed loudly (AdapterUnavailable).
+        - ``"backpressure"`` — every T0 row is pinned by in-flight
+          requests; the caller breaks the admission pass and retries
+          after decode frees pins.
+
+        Wait-free on the loop side apart from the one awaited device
+        row-copy dispatch (LORA1701: the T2 I/O lives on the hydrator)."""
+        store = self.adapter_store
+        name = request.adapter
+        row = store.t0_row(name)
+        if row is None and store.t1_has(name):
+            row = store.t0_assign(name)
+            if row is None:
+                return "backpressure"
+            await self._load_adapter_row(loop, name, row)
+        if row is not None:
+            request.adapter_row = row
+            store.pin(name)
+            request.adapter_pinned = True
+            return "ready"
+        if (
+            not request.adapter_hydrate_attempted
+            and not self._draining
+            and (store.t2_has(name) or store.hydrating(name))
+        ):
+            request.adapter_hydrate_attempted = True
+            if store.request_hydration([name]):
+                self.scheduler.pop()
+                deadline = (
+                    time.monotonic() + store.spec.hydrate_timeout_s
+                )
+                self._adapter_hydrating.append((request, deadline, name))
+                store.hydrations += 1
+                self.flight.event(
+                    "adapter-hydrate", stage="begin", adapter=name
+                )
+                self._journey(request, "adapter-hydrate", adapter=name)
+                return "wait"
+        self.scheduler.pop()
+        self.adapter_refusals += 1
+        self.flight.event("adapter-refused", adapter=name)
+        self._journal_retire(request)
+        if not request.future.done():
+            request.future.set_exception(
+                AdapterUnavailable(
+                    f"adapter {name!r} unavailable: not resident in any "
+                    "tier (install it or publish it to the T2 origin)"
+                )
+            )
+        return "refused"
+
+    async def _load_adapter_row(self, loop, name: str, row: int) -> None:
+        """Copy a T1-resident adapter's factors into device row ``row``
+        (T1→T0). Runs on the dispatch thread — the only thread that
+        touches ``_ad_layers`` — as a functional per-row rebuild
+        (``.at[:, row].set``): in-flight dispatches keep the buffer
+        snapshot they captured, exactly like the donated caches."""
+        store = self.adapter_store
+        entry = store.t1_peek(name)
+        arrays = entry["arrays"]
+        dtype = self.model_config.dtype
+
+        def _run():
+            t0 = time.monotonic()
+            new = {
+                k: buf.at[:, row].set(jnp.asarray(arrays[k], dtype=dtype))
+                for k, buf in self._ad_layers.items()
+            }
+            # graftcheck: disable=JAX104 one timed per-load sync, on the dispatch thread
+            jax.block_until_ready(list(new.values()))
+            self._ad_layers = new
+            return (time.monotonic() - t0) * 1000.0
+
+        device_ms = await loop.run_in_executor(self._executor, _run)
+        store.note_loaded(name, row, device_ms)
+
+    def _adapter_release(self, request: "_Request") -> None:
+        """Release a finished/failed request's pin on its adapter row.
+        Wait-free: dict arithmetic (LORA1701)."""
+        if request.adapter_pinned:
+            request.adapter_pinned = False
+            if self.adapter_store is not None:
+                self.adapter_store.unpin(request.adapter)
+
+    def _adapter_tier_step(self) -> None:
+        """Loop-safe-point adapter bookkeeping (wait-free, LORA1701):
+        apply the hydrator's results, emit the store's pending flight
+        events through the shared drain, and settle the hydration
+        stash. A request whose adapter landed in T1 requeues at the
+        FRONT of its class; a timed-out or failed hydration is a COLD
+        REFUSAL (AdapterUnavailable) — unlike a prefix miss there is no
+        cheaper fallback compute, so requeueing would just spin."""
+        store = self.adapter_store
+        if store is None:
+            return
+        store.apply_results()
+        self._emit_store_events(store.drain_events())
+        if not self._adapter_hydrating:
+            return
+        now = time.monotonic()
+        still_waiting = []
+        # reversed: settled requests requeue at the FRONT, so walking
+        # newest-first leaves the oldest at the actual head
+        for request, deadline, name in reversed(self._adapter_hydrating):
+            if request.future.cancelled():
+                self._journey(request, "cancelled", stage="adapter-hydrate")
+                self._journal_retire(request)
+                continue
+            if store.t1_has(name):
+                self.flight.event(
+                    "adapter-hydrate", stage="done", adapter=name
+                )
+                self._journey(request, "adapter-hydrate-done", adapter=name)
+                self.scheduler.requeue_front(request)
+                continue
+            if store.hydrating(name) and now < deadline:
+                still_waiting.append((request, deadline, name))
+                continue
+            # failed or timed out: refuse cold — loudly, never silently
+            store.hydrate_failures += 1
+            self.adapter_refusals += 1
+            self.flight.event(
+                "adapter-hydrate", stage="timeout", adapter=name
+            )
+            self.flight.event("adapter-refused", adapter=name)
+            self._journey(
+                request, "adapter-hydrate-done", adapter=name, timeout=True
+            )
+            self._journal_retire(request)
+            if not request.future.done():
+                request.future.set_exception(
+                    AdapterUnavailable(
+                        f"adapter {name!r} hydration timed out after "
+                        f"{store.spec.hydrate_timeout_s:.1f}s"
+                    )
+                )
+        still_waiting.reverse()  # restore arrival order in the stash
+        self._adapter_hydrating = still_waiting
+
+    def adapter_store_section(self) -> dict[str, Any]:
+        """``stats()["adapters"]`` / flight-summary section: per-tier
+        bytes vs budget, hit/load/eviction counters, the resident row
+        map, and the exact byte ledger. Wait-free (LORA1701): snapshot
+        reads + arithmetic; the tier gauges refresh here so any reader
+        keeps the scrape surface current."""
+        store = self.adapter_store
+        section = {
+            "hydrating_requests": len(self._adapter_hydrating),
+            "refusals": self.adapter_refusals,
+            **store.stats(),
+        }
+        if self._m_adapters:
+            self._m_adapters["t0_bytes"](section["t0"]["bytes"])
+            self._m_adapters["t1_bytes"](store.t1_bytes)
+            self._m_adapters["t2_bytes"](store.t2_bytes)
+        return section
+
     def _draft_tokens(
         self, slot_id: int, num_drafts: int
     ) -> tuple[list[int], int]:
@@ -4952,6 +5447,9 @@ class TpuServingEngine:
             temps_np = self._temps.copy()
             topks_np = self._topks.copy()
             topps_np = self._topps.copy()
+            ad_np = (
+                self._ad_rows.copy() if self._ad_rows is not None else None
+            )
             key = self._split_key()
 
             def _run():
@@ -4973,12 +5471,18 @@ class TpuServingEngine:
                             "topps": topps_np,
                         }
                     )
+                ad_kw = (
+                    {}
+                    if ad_np is None
+                    else {"ad_layers": self._ad_layers,
+                          "ad_ids": jnp.asarray(ad_np)}
+                )
                 out = fn(
                     self.params, self.cache_k, self.cache_v,
                     jnp.asarray(tokens), jnp.asarray(lengths_np),
                     jnp.asarray(active_mask), jnp.asarray(tables),
                     key, jnp.asarray(temps_np), jnp.asarray(topks_np),
-                    jnp.asarray(topps_np),
+                    jnp.asarray(topps_np), **ad_kw,
                 )
                 self.cache_k, self.cache_v = out[4], out[5]
                 # dispatch returned async; the fetches below block until
@@ -5301,7 +5805,7 @@ class TpuServingEngine:
             return self.block_mgr.tables.copy()
 
         def _dispatch(tokens, lengths, key, window, tables, decode_fn,
-                      counts_np=None, first=False):
+                      counts_np=None, first=False, ad_np=None):
             # async JAX dispatch: returns device arrays without blocking.
             # Everything the closure needs (the resolved jit variant, the
             # penalty snapshot, the block tables) was prepared on the loop
@@ -5357,10 +5861,20 @@ class TpuServingEngine:
                     jnp.asarray(pres_np), jnp.asarray(freq_np),
                     jnp.asarray(counts_np),
                 )
+            # adapter rows ride as kwargs only when the store is enabled:
+            # the default engine's trace (and its jaxpr) stays the seed's.
+            # _ad_layers is touched only on this (dispatch) thread, so the
+            # snapshot here serializes after any in-flight row load.
+            ad_kw = (
+                {}
+                if ad_np is None
+                else {"ad_layers": self._ad_layers,
+                      "ad_ids": jnp.asarray(ad_np)}
+            )
             self.profiler.dump_hlo(
                 f"decode_chunk_w{window}_s{sampler_mode}", decode_fn, *args
             )
-            chunk_t, chunk_lp, t, l, ck, cv = decode_fn(*args)
+            chunk_t, chunk_lp, t, l, ck, cv = decode_fn(*args, **ad_kw)
             self.cache_k, self.cache_v = ck, cv
             # pack tokens+logprobs NOW and start their D2H copy: by the
             # time the deferred _fetch_chunk wait runs, the transfer has
@@ -5391,6 +5905,9 @@ class TpuServingEngine:
             decode_fn = self._decode_fn(sampler_mode, window, K, pen)
             prog_q.append(self._program_decode(window, K, sampler_mode, pen))
             counts_np = _build_counts() if pen else None
+            # slot→adapter-row mirror snapshotted on the LOOP thread
+            # (RACE801): admission rewrites _ad_rows between bursts
+            ad_np = self._ad_rows.copy() if self._ad_rows is not None else None
             if light:
                 self._light_chunks += 1
             else:
@@ -5398,7 +5915,7 @@ class TpuServingEngine:
             return loop.run_in_executor(
                 self._executor,
                 partial(_dispatch, tokens, lengths, key, window, tables,
-                        decode_fn, counts_np, first=first),
+                        decode_fn, counts_np, first=first, ad_np=ad_np),
             )
 
         out = await _submit(
@@ -5595,9 +6112,12 @@ class TpuServingEngine:
         for i, s in enumerate(self.slots):
             if s.prefilling and s.request.future.cancelled():
                 self._journal_retire(s.request)
+                self._adapter_release(s.request)
                 s.request = None
                 s.prefilling = False
                 s.prefill_done = 0
+                if self._ad_rows is not None:
+                    self._ad_rows[i] = 0
                 if self.block_mgr is not None:
                     self.block_mgr.release(i)
         pre = [i for i, s in enumerate(self.slots) if s.prefilling]
@@ -5634,6 +6154,12 @@ class TpuServingEngine:
         program = self._program_prefill_continue(nrb, Bp, C, mode)
         sel_np = self.block_mgr.tables[slot_ids]
         key = self._split_key()
+        # adapter rows for the CHUNK batch rows (loop-thread snapshot,
+        # RACE801); None when the store is disabled keeps the seed trace
+        ad_np = (
+            self._ad_rows[slot_ids].copy()
+            if self._ad_rows is not None else None
+        )
 
         def _run():
             self._fault("prefill")
@@ -5653,11 +6179,18 @@ class TpuServingEngine:
                         "topps": topps,
                     }
                 )
+            ad_kw = (
+                {}
+                if ad_np is None
+                else {"ad_layers": self._ad_layers,
+                      "ad_ids": jnp.asarray(ad_np)}
+            )
             out = fn(
                 self.params, self.cache_k, self.cache_v,
                 jnp.asarray(tokens), jnp.asarray(starts),
                 jnp.asarray(suffix_lens), jnp.asarray(sel_np), key,
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                **ad_kw,
             )
             # the donated caches are re-bound HERE, on the dispatch thread
             # — the same side that reads them in every dispatch closure, so
@@ -5700,8 +6233,13 @@ class TpuServingEngine:
                 # against a released slot's empty table publishes nothing.
                 # Resumed contexts stay out of the prefix cache — their
                 # block chains mix generated content into what looks like
-                # a prompt prefix.
-                if self.config.prefix_cache and not request.preemptions:
+                # a prompt prefix. Adapter contexts stay out too: their
+                # KV is adapter-colored (docs/ADAPTERS.md).
+                if (
+                    self.config.prefix_cache
+                    and not request.preemptions
+                    and not request.adapter
+                ):
                     self.block_mgr.register_prefix(
                         slot_id, request.prompt_tokens
                     )
@@ -5766,6 +6304,19 @@ class TpuServingEngine:
                         if not request.future.done():
                             request.future.set_exception(err)
                         continue
+                if self.adapter_store is not None and request.adapter:
+                    # multi-LoRA resolve (docs/ADAPTERS.md): the request
+                    # admits only once its adapter holds a device row.
+                    # "wait" stashed it off-scheduler (like the prefix
+                    # hydration stash), "refused" failed it loudly —
+                    # both popped it, so the pass moves on.
+                    verdict = await self._resolve_adapter(loop, request)
+                    if verdict == "backpressure":
+                        # every T0 row pinned by in-flight requests;
+                        # finishing slots release pins — retry next pass
+                        break
+                    if verdict != "ready":
+                        continue
                 # one chain-digest walk per admission attempt, shared by
                 # the hydration check, the promotion, and match_prefix
                 # below — the admission path hashes the prompt ONCE
@@ -5774,6 +6325,7 @@ class TpuServingEngine:
                     if self.prefix_store is not None
                     and use_prefix
                     and not request.preemptions
+                    and not request.adapter
                     else None
                 )
                 if (
@@ -5823,7 +6375,14 @@ class TpuServingEngine:
                 # (prompt + generated so far), rebuilding the KV state the
                 # preemption dropped; untouched requests see ctx == prompt
                 ctx = request.context_tokens
-                if use_prefix and not request.preemptions:
+                # adapter requests bypass the shared prefix plane both
+                # ways: their KV is colored by the adapter's attention
+                # projections, so reusing a base/other-adapter chain
+                # would splice foreign KV under this request — and
+                # registering theirs would poison adapter-less traffic
+                # (docs/ADAPTERS.md)
+                if use_prefix and not request.preemptions \
+                        and not request.adapter:
                     if chain is not None:
                         # promote the T1 run extending this prompt's T0
                         # chain back into pool blocks, so the match
@@ -5873,6 +6432,8 @@ class TpuServingEngine:
                     slot.request = request
                     slot.prefilling = True
                     slot.prefill_done = reuse
+                    if self._ad_rows is not None:
+                        self._ad_rows[slot_id] = request.adapter_row
                     try:
                         self._fault("pool-grow")
                         self.block_mgr.ensure_capacity(slot_id, len(ctx))
@@ -5928,6 +6489,8 @@ class TpuServingEngine:
             admit_now = time.monotonic()
             for slot_id, request, _reuse in batch:
                 self.slots[slot_id].request = request
+                if self._ad_rows is not None:
+                    self._ad_rows[slot_id] = request.adapter_row
                 request.admit_time = admit_now
                 self._note_resume(request)
                 self._journey(request, "admit")
@@ -5962,6 +6525,11 @@ class TpuServingEngine:
                 topps[i] = request.top_p
             key = self._split_key()
             prefill_mode = self._sampler_mode(temps, topks, topps)
+            # per-batch-row adapter rows (loop-thread snapshot, RACE801)
+            ad_np = (
+                self._ad_rows[slot_ids].copy()
+                if self._ad_rows is not None else None
+            )
 
             if self.block_mgr is not None:
                 # per-batch-row block tables (duplicate padded rows write
@@ -6022,11 +6590,17 @@ class TpuServingEngine:
                         jnp.asarray(temps), jnp.asarray(topks),
                         jnp.asarray(topps),
                     )
+                ad_kw = (
+                    {}
+                    if ad_np is None
+                    else {"ad_layers": self._ad_layers,
+                          "ad_ids": jnp.asarray(ad_np)}
+                )
                 variant = f"_cont_nrb{nrb}" if use_continue else ""
                 self.profiler.dump_hlo(
                     f"prefill_p{bucket}_b{Bp}{variant}", prefill_fn, *args
                 )
-                out = prefill_fn(*args)
+                out = prefill_fn(*args, **ad_kw)
                 # donated caches re-bound on the dispatch thread — see
                 # _advance_prefills._run (RACE801: single thread role)
                 self.cache_k, self.cache_v = out[2], out[3]
@@ -6044,9 +6618,11 @@ class TpuServingEngine:
             )
             if use_prefix:
                 for slot_id, request, reuse in batch:
-                    if request.preemptions:
+                    if request.preemptions or request.adapter:
                         # resumed contexts stay out of the prefix cache
-                        # (generated content is not a shareable prompt)
+                        # (generated content is not a shareable prompt);
+                        # adapter contexts too — their KV is colored by
+                        # the adapter's projections (docs/ADAPTERS.md)
                         continue
                     self.block_mgr.register_prefix(
                         slot_id, request.prompt_tokens
@@ -6160,6 +6736,9 @@ class TpuServingEngine:
                 slot.prefilling = False
                 slot.prefill_done = 0
                 self._lengths[slot_id] = 0
+                self._adapter_release(request)
+                if self._ad_rows is not None:
+                    self._ad_rows[slot_id] = 0
                 self._release_blocks(slot_id)
                 self._finished_requests.append(
                     (request, bool(eos_hits.size))
@@ -6215,6 +6794,9 @@ class TpuServingEngine:
             slot.prefilling = False
             slot.prefill_done = 0
             self._lengths[slot_id] = 0
+            self._adapter_release(request)
+            if self._ad_rows is not None:
+                self._ad_rows[slot_id] = 0
             # release is safe while a speculative chunk is in flight (it
             # writes via the tables captured at its dispatch, and those
             # writes land before any re-allocation's prefill — single
@@ -6678,6 +7260,11 @@ def flight_report(
             # engine_top's prefix panel and the control-plane fan-in
             # need no extra engine surface
             entry["prefixstore"] = engine.prefix_store_section()
+        if engine.adapter_store is not None:
+            # multi-LoRA tier posture: rides /flight/summary so
+            # engine_top's adapters panel and the router's affinity
+            # fan-in need no extra engine surface
+            entry["adapters"] = engine.adapter_store_section()
         if engine.config.streaming:
             # per-class TBT digests + the cancellation ledger: rides
             # /flight/summary so engine_top's streaming panel and

@@ -21,7 +21,12 @@ Triggers (wired in ``serving/engine.py``):
 - ``tbt-burn`` — the streaming time-between-tokens objective paged
   (PR 17's plane);
 - ``breaker-storm`` — ≥ ``k`` ``breaker-open`` events inside one window
-  of the engine's event ring (:func:`breaker_storm` below).
+  of the engine's event ring (:func:`breaker_storm` below);
+- ``adapter-storm`` — ONE adapter evicted ≥ ``k`` times inside one
+  hydrate window (:func:`adapter_eviction_storm` below): the multi-LoRA
+  tier budgets are too small for the live adapter mix, and every
+  eviction buys a re-load or re-hydration the next request pays for
+  (docs/ADAPTERS.md).
 
 Capture discipline (graftcheck rule INC1601 gates this): the observe
 side — :meth:`IncidentRecorder.should_capture`, the bundle handoff
@@ -77,6 +82,7 @@ TRIGGER_KINDS = (
     "slo-fast-burn",
     "tbt-burn",
     "breaker-storm",
+    "adapter-storm",
 )
 
 #: trigger kind → the journey segment it indicts: worst-K ledgers are
@@ -89,6 +95,7 @@ OFFENDING_SEGMENT: dict[str, str | None] = {
     "slo-fast-burn": "queue",
     "tbt-burn": "stream",
     "breaker-storm": "transfer",
+    "adapter-storm": "adapter-hydrate",
 }
 
 
@@ -118,6 +125,45 @@ def breaker_storm(
             {e.get("replica") for e in opens if e.get("replica")}
         ),
         "opens": opens[-k:],
+    }
+
+
+def adapter_eviction_storm(
+    events: list[dict[str, Any]],
+    now_s: float,
+    k: int = 3,
+    window_s: float = 30.0,
+) -> dict[str, Any] | None:
+    """The adapter eviction-storm predicate: ONE adapter evicted ≥ ``k``
+    times inside the trailing ``window_s`` (the caller passes the hydrate
+    window) of the event tail — thrash, not turnover: distinct adapters
+    cycling through T0 rows is the LRU doing its job, the SAME adapter
+    bouncing means the tier budgets are undersized for the live mix and
+    every bounce re-pays a device load or a T2 hydration. Returns the
+    evidence dict (adapter, count + the evictions) or None. Pure
+    function over an already-snapshotted tail — wait-free (INC1601,
+    the LORA1701 plane's breach observer)."""
+    by_adapter: dict[str, list[dict[str, Any]]] = {}
+    for e in events:
+        if (
+            e.get("kind") == "adapter-evict"
+            and e.get("m_s") is not None
+            and now_s - e["m_s"] <= window_s
+            and e.get("adapter")
+        ):
+            by_adapter.setdefault(str(e["adapter"]), []).append(e)
+    worst: tuple[str, list[dict[str, Any]]] | None = None
+    for name, evictions in by_adapter.items():
+        if worst is None or len(evictions) > len(worst[1]):
+            worst = (name, evictions)
+    if worst is None or len(worst[1]) < k:
+        return None
+    name, evictions = worst
+    return {
+        "adapter": name,
+        "count": len(evictions),
+        "window_s": window_s,
+        "evictions": evictions[-k:],
     }
 
 

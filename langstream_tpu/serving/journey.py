@@ -33,7 +33,7 @@ is flagged, never hidden). ``m_s`` is the in-process monotonic stamp
 for same-pod math. Kinds (the lifecycle vocabulary)::
 
     gateway-produce  bounce  submit  admit  preempt  resume
-    hydrate-begin  hydrate-done
+    hydrate-begin  hydrate-done  adapter-hydrate  adapter-hydrate-done
     first-token  export  export-taken  import-received  import
     first-step  first-emit  last-emit  finish  shed  fail  cancelled
 
@@ -89,6 +89,7 @@ SEGMENT_ORDER = (
     "ingest",
     "queue",
     "prefix-hydrate",
+    "adapter-hydrate",
     "prefill",
     "export",
     "handoff-wait",
@@ -119,6 +120,15 @@ EDGE_SEGMENTS: dict[tuple[str, str], str] = {
     ("submit", "hydrate-begin"): "queue",
     ("hydrate-begin", "hydrate-done"): "prefix-hydrate",
     ("hydrate-done", "admit"): "queue",
+    # tiered adapter store (docs/ADAPTERS.md): an admission stashed
+    # while the hydrator pulls the request's LoRA factors T2→T1 — the
+    # cold-start interval an adapter pays once per replica, or writes
+    # off at the hydrate timeout (a cold refusal: no recompute fallback)
+    ("submit", "adapter-hydrate"): "queue",
+    ("hydrate-done", "adapter-hydrate"): "queue",
+    ("adapter-hydrate", "adapter-hydrate-done"): "adapter-hydrate",
+    ("adapter-hydrate-done", "admit"): "queue",
+    ("adapter-hydrate", "cancelled"): "adapter-hydrate",
     ("admit", "first-token"): "prefill",
     ("first-token", "export"): "export",       # gather + serialize
     ("export", "export-taken"): "handoff-wait",

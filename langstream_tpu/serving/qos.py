@@ -165,21 +165,29 @@ class ClassPolicy:
 @dataclasses.dataclass(frozen=True)
 class TenantPolicy:
     """Rate limits for one tenant (or the ``*`` catch-all). ``None``
-    means unlimited on that axis."""
+    means unlimited on that axis. ``adapter`` names the LoRA adapter
+    this tenant's traffic decodes with (``serving/adapters.py``): the
+    gateway stamps it as the ``langstream-adapter`` record header and
+    the AI agents forward it into engine options — empty means base
+    weights, byte-identical to a pre-adapter deploy."""
 
     name: str
     requests_per_s: float | None = None
     request_burst: float | None = None
     tokens_per_s: float | None = None
     token_burst: float | None = None
+    adapter: str = ""
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "requests-per-s": self.requests_per_s,
             "request-burst": self.request_burst,
             "tokens-per-s": self.tokens_per_s,
             "token-burst": self.token_burst,
         }
+        if self.adapter:
+            out["adapter"] = self.adapter
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -297,6 +305,16 @@ class QosSpec:
                         f"qos.tenants.{tenant}.{label} must be > 0 (omit it "
                         f"for unlimited)"
                     )
+            adapter = str(raw.get("adapter") or "")
+            if adapter:
+                # mirror of serving/adapters.py check_adapter_name, kept
+                # inline so this module stays stdlib-only (no jax in the
+                # gateway/control-plane import graph via this path)
+                if len(adapter) > 120 or not set(adapter) <= _ADAPTER_NAME_OK:
+                    raise ValueError(
+                        f"qos.tenants.{tenant}.adapter {adapter!r} may only "
+                        f"contain [A-Za-z0-9_-] (max 120 chars)"
+                    )
             tenants.append(
                 TenantPolicy(
                     name=str(tenant),
@@ -304,6 +322,7 @@ class QosSpec:
                     request_burst=rburst,
                     tokens_per_s=tps,
                     token_burst=tburst,
+                    adapter=adapter,
                 )
             )
         max_preemptions = int(d.get("max-preemptions", d.get("max_preemptions", 2)))
@@ -319,6 +338,13 @@ class QosSpec:
                 d.get("deadline-headers", d.get("deadline_headers", False))
             ),
         )
+
+
+#: legal characters in a tenant's adapter name (serving/adapters.py
+#: check_adapter_name — adapter names are storage keys + metric labels)
+_ADAPTER_NAME_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-"
+)
 
 
 def _parse_bool(v: Any) -> bool:

@@ -610,14 +610,17 @@ def test_perf_diff_gate_fails_tbt_regression(tmp_path, capsys):
 # ---------------------------------------------------------------------------
 
 #: kinds that flow through the two sanctioned *dynamic* emit sites —
-#: the engine's prefix-event drain (``_emit_prefix_events`` forwards
-#: the prefix store's queued kinds) and the handoff plane's breaker
-#: mirror (``_breaker_event`` forwards the router's circuit verdicts).
-#: A third dynamic site fails the site-count pin below, forcing whoever
-#: adds it to extend this table and the docs together.
+#: the engine's store-event drain (``_emit_store_events`` forwards the
+#: queued kinds of BOTH the prefix store and the adapter store) and the
+#: handoff plane's breaker mirror (``_breaker_event`` forwards the
+#: router's circuit verdicts).  A third dynamic site fails the
+#: site-count pin below, forcing whoever adds it to extend this table
+#: and the docs together.
 DYNAMIC_EVENT_KINDS = {
     "prefix-demote", "prefix-promote", "prefix-evict", "prefix-hydrate",
     "fault-injected",                        # prefix-store fault drain
+    "adapter-load", "adapter-evict",         # adapter-store drain
+    "adapter-demote", "adapter-hydrate",     # (docs/ADAPTERS.md)
     "breaker-open", "breaker-close",         # router → handoff mirror
 }
 

@@ -208,6 +208,7 @@ def render(report: list[dict]) -> str:
                          summary)
         )
         lines.extend(_render_prefix(entry.get("prefixstore"), events))
+        lines.extend(_render_adapters(entry.get("adapters"), events))
         lines.extend(_render_survival(entry.get("survival"), events))
         lines.extend(_render_streaming(entry.get("streaming"), events))
         lines.extend(_render_incidents(entry.get("incidents"), events))
@@ -340,6 +341,92 @@ def _render_prefix(prefixstore: dict | None, events: list[dict]) -> list[str]:
     for event in tail:
         lines.append(
             f"prefix   evict {event.get('tier')} {event.get('digest')} "
+            f"{_fmt_bytes(event.get('bytes') or 0)} "
+            f"({event.get('reason')})"
+        )
+    return lines
+
+
+def _render_adapters(adapters: dict | None, events: list[dict]) -> list[str]:
+    """Multi-LoRA adapter-store panel (docs/ADAPTERS.md): per-tier
+    bytes-vs-budget bars, hit ratios, the device-resident row set, and
+    the eviction tail. Silent for engines without an adapters section —
+    adapter-less payloads render unchanged."""
+    if not adapters:
+        return []
+    lines: list[str] = []
+    t0 = adapters.get("t0") or {}
+    t1 = adapters.get("t1") or {}
+    t2 = adapters.get("t2") or {}
+
+    def _tier_line(name: str, section: dict, extra: str) -> str:
+        used = section.get("bytes") or 0
+        budget = section.get("budget_bytes")
+        if budget is not None:
+            frac = 1.0 if not budget else min(1.0, used / budget)
+            if not used and not budget:
+                frac = 0.0
+            bar = f"[{_bar(frac, 16)}] {_fmt_bytes(used)}/{_fmt_bytes(budget)}"
+        else:
+            bar = f"{_fmt_bytes(used)} (unbudgeted)"
+        return f"adapter  {name} {bar}  {extra}"
+
+    t0_hits = t0.get("hits") or 0
+    t0_loads = t0.get("loads") or 0
+    t0_looked = t0_hits + t0_loads
+    t0_ratio = f"{100 * t0_hits / t0_looked:.0f}%" if t0_looked else "-"
+    lines.append(
+        _tier_line(
+            "T0", t0,
+            f"rows {t0.get('entries') or 0}/{t0.get('budget_entries') or 0}"
+            f"  hit {t0_ratio} ({t0_hits}/{t0_looked})  evict "
+            f"{t0.get('evictions') or 0} (refused "
+            f"{t0.get('eviction_refusals') or 0})",
+        )
+    )
+    t1_hits = t1.get("hits") or 0
+    t1_misses = t1.get("misses") or 0
+    t1_looked = t1_hits + t1_misses
+    t1_ratio = f"{100 * t1_hits / t1_looked:.0f}%" if t1_looked else "-"
+    lines.append(
+        _tier_line(
+            "T1", t1,
+            f"entries {t1.get('entries') or 0}  hit {t1_ratio} "
+            f"({t1_hits}/{t1_looked})",
+        )
+    )
+    if t2.get("enabled"):
+        lines.append(
+            _tier_line(
+                "T2", t2,
+                f"entries {t2.get('entries') or 0}  hydrations "
+                f"{adapters.get('hydrations') or 0}  in-transit "
+                f"{_fmt_bytes(t2.get('in_transit_bytes') or 0)}",
+            )
+        )
+    resident = t0.get("resident") or []
+    pinned = t0.get("pinned") or {}
+    if resident:
+        shown = ", ".join(
+            f"{name}({pinned[name]})" if pinned.get(name) else str(name)
+            for name in resident[:6]
+        )
+        more = f" +{len(resident) - 6}" if len(resident) > 6 else ""
+        lines.append(f"adapter  resident {shown}{more}  (pins in parens)")
+    lines.append(
+        f"adapter  rank {adapters.get('rank')}  installs "
+        f"{adapters.get('installs') or 0}   demote "
+        f"{adapters.get('demotions_t1_t2') or 0}→T2   evict "
+        f"{adapters.get('evictions') or 0}   refused cold "
+        f"{adapters.get('refusals') or 0}   fingerprint-refused "
+        f"{adapters.get('fingerprint_refusals') or 0}"
+    )
+    tail = [
+        e for e in events if str(e.get("kind", "")) == "adapter-evict"
+    ][-3:]
+    for event in tail:
+        lines.append(
+            f"adapter  evict {event.get('tier')} {event.get('adapter')} "
             f"{_fmt_bytes(event.get('bytes') or 0)} "
             f"({event.get('reason')})"
         )
@@ -1028,6 +1115,36 @@ def _anomalies(entry: dict) -> list[str]:
                     f"or scale out), not transient"
                 )
                 break
+    # adapter thrash (docs/ADAPTERS.md): >=3 evictions of ONE adapter
+    # inside a single hydrate window — distinct adapters cycling through
+    # the T0 rows is the LRU working; the SAME adapter bouncing means
+    # every bounce re-pays a device load or a T2 hydration and the tier
+    # budgets are undersized for the live adapter mix. Uses the
+    # section's own hydrate_timeout_s so a tuned window still flags.
+    adapter_evicts: dict = {}
+    for e in events:
+        if e.get("kind") == "adapter-evict" and e.get("adapter"):
+            if e.get("t_ms") is not None:
+                adapter_evicts.setdefault(str(e["adapter"]), []).append(
+                    e["t_ms"]
+                )
+    if adapter_evicts:
+        window_s = float(
+            (entry.get("adapters") or {}).get("hydrate_timeout_s") or 30.0
+        )
+        for name in sorted(adapter_evicts):
+            stamps = sorted(adapter_evicts[name])
+            for i in range(len(stamps) - 2):
+                if stamps[i + 2] - stamps[i] <= window_s * 1000.0:
+                    flags.append(
+                        f"adapter thrash: adapter {name!r} evicted >=3 "
+                        f"times inside one {window_s:.0f}s hydrate window "
+                        f"— the tier budgets are undersized for the live "
+                        f"adapter mix (grow adapter-store t0-entries / "
+                        f"t1-bytes, or pin the hot adapters to dedicated "
+                        f"replicas via tenant adapter affinity)"
+                    )
+                    break
     # retry storm (docs/RESILIENCE.md "Distributed failure domain"):
     # one request re-offered >=3 times means the decode pool is not
     # taking handoffs (dead/held/refusing replicas) and the chainer is
@@ -1454,6 +1571,7 @@ def render_json(report: list[dict]) -> list[dict]:
             "scheduler": entry.get("scheduler"),
             "pool": entry.get("kvtransfer"),
             "prefix": entry.get("prefixstore"),
+            "adapters": entry.get("adapters"),
             "survival": entry.get("survival"),
             "streaming": entry.get("streaming"),
             "incidents": entry.get("incidents"),
@@ -1468,6 +1586,7 @@ def render_json(report: list[dict]) -> list[dict]:
                 entry.get("pool_role"), sections["pool"], summary
             ),
             "prefix": _render_prefix(sections["prefix"], events),
+            "adapters": _render_adapters(sections["adapters"], events),
             "survival": _render_survival(sections["survival"], events),
             "streaming": _render_streaming(sections["streaming"], events),
             "incidents": _render_incidents(sections["incidents"], events),

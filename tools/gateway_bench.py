@@ -273,7 +273,8 @@ async def run_gateway_bench(
                 )
         journey_out: dict[str, Any] = {}
         for name in (
-            "ingest", "queue", "prefix-hydrate", "prefill", "export",
+            "ingest", "queue", "prefix-hydrate", "adapter-hydrate",
+            "prefill", "export",
             "handoff-wait", "transfer", "decode-admission", "first-step",
             "decode",
         ):
@@ -850,6 +851,230 @@ async def run_warm_prefix_phase(
             "prefix-hydrate": {
                 "p50_s": round(pct(seg_samples, 0.50), 4),
                 "p99_s": round(pct(seg_samples, 0.99), 4),
+                "n": len(seg_samples),
+            }
+        }
+    return out
+
+
+async def run_multi_lora_phase(
+    *,
+    serving: dict[str, Any] | None = None,
+    tenants: int = 6,
+    adapters: int = 4,
+    repeats: int = 3,
+    max_tokens: int = 8,
+    t2_dir: str | None = None,
+) -> dict[str, Any]:
+    """Multi-LoRA phase for the tiered adapter store (docs/ADAPTERS.md):
+    N tenants spread over M named adapters with M > the device budget
+    (``t0-entries``), so heterogeneous-adapter traffic churns the T0
+    row LRU — load, evict, re-load — while half the fleet is ONLY
+    published to the T2 origin and first-touches take the hydration
+    path a cross-replica cold start takes.
+
+    Records warm vs hydrate TTFT quantiles, the T0 hit ratio, eviction
+    churn, the ``adapter-hydrate`` journey segment, the router's
+    adapter-affinity counters, and the store's exact byte ledger with
+    its conservation verdict (``t1 + in_transit + t2 == inserted +
+    discovered - evicted``). ``perf_diff`` declares the worse-directions
+    (TTFT p99 up, hit ratio down, evictions up) so adapter-plane
+    regressions are flagged, not averaged away."""
+    import tempfile
+
+    from langstream_tpu.gateway.router import ReplicaRouter
+    from langstream_tpu.serving.adapters import (
+        make_lora_arrays,
+        publish_adapter,
+    )
+    from langstream_tpu.serving.engine import ServingConfig, TpuServingEngine
+    from langstream_tpu.serving.journey import (
+        JOURNEYS,
+        segments as journey_segments,
+    )
+
+    t2_dir = t2_dir or tempfile.mkdtemp(prefix="bench_lora_t2_")
+    serving = dict(serving or {})
+    serving.setdefault("model", "tiny")
+    serving.setdefault("slots", 4)
+    serving.setdefault("max-seq-len", 256)
+    serving.setdefault("decode-chunk", 4)
+    serving.setdefault("model-dtype", "float32")
+    serving.setdefault("kv-layout", "paged")
+    serving.setdefault("kv-block-size", 16)
+    t0_entries = max(2, adapters - 2)
+    serving["adapter-store"] = {
+        "rank": 4,
+        # fewer device rows than adapters: the churn under test
+        "t0-entries": t0_entries,
+        "t1-bytes": 64 << 20,
+        "t2": {"type": "local", "path": t2_dir},
+        "hydrate-timeout-s": 10.0,
+        "t2-rescan-s": 0.2,
+    }
+    config = ServingConfig.from_dict(serving)
+    engine = TpuServingEngine(config)
+    store = engine.adapter_store
+    mc = engine.model_config
+    fingerprint = engine.adapter_fingerprint()
+    rank = config.adapter_store.rank
+    names = [f"bench-lora-{m}" for m in range(adapters)]
+    # even adapters install locally (T1); odd ones are published ONLY
+    # to the shared T2 origin, as another replica (or an offline
+    # publisher) would — their first touch exercises discover + hydrate
+    published = []
+    for m, name in enumerate(names):
+        arrays = make_lora_arrays(
+            layers=mc.layers, hidden=mc.hidden, heads=mc.heads,
+            kv_heads=mc.kv_heads, head_dim=mc.head_dim, rank=rank,
+            seed=101 + m,
+        )
+        if m % 2 == 0:
+            engine.install_adapter(name, arrays)
+        else:
+            publish_adapter(
+                {"type": "local", "path": t2_dir}, name, arrays, fingerprint
+            )
+            published.append(name)
+    # wait for the hydrator's periodic rescan to discover the published
+    # names (applying results here is loop-side: same event-loop thread
+    # the engine's tier step uses)
+    for _ in range(400):
+        store.apply_results()
+        if all(store.known(n) for n in names):
+            break
+        await asyncio.sleep(0.02)
+    missing = [n for n in names if not store.known(n)]
+    if missing:
+        raise RuntimeError(f"T2 scan never discovered {missing}")
+
+    async def _ask(tenant_i: int, name: str) -> float:
+        result = await engine.generate(
+            f"Tenant {tenant_i} asks via adapter {name}: status?",
+            {"max-tokens": max_tokens, "temperature": 0, "adapter": name},
+        )
+        return float(result["ttft"])
+
+    # warmup: compile the base path plus each device row's upload
+    # program (.at[:, row].set is one XLA program per row index) —
+    # first compiles must not land inside a measured TTFT
+    await engine.generate(
+        "warmup base path", {"max-tokens": 2, "temperature": 0}
+    )
+    installed = [n for i, n in enumerate(names) if i % 2 == 0]
+    for name in (installed * t0_entries)[:t0_entries]:
+        await _ask(-1, name)
+
+    # a router beside the engine records the affinity semantics the
+    # gateway would apply: first pick per adapter pins, repeats hit
+    router = ReplicaRouter(fresh_s=3600.0)
+    router.observe([
+        {"replica": "bench-ai-0", "queued": 0, "occupancy": 0, "slots": 4},
+        {"replica": "bench-ai-1", "queued": 0, "occupancy": 0, "slots": 4},
+    ])
+
+    JOURNEYS.clear()
+    warm_ttfts: list[float] = []
+    hydrate_ttfts: list[float] = []
+    failures: list[str] = []
+    submitted = 0
+    t_start = time.monotonic()
+    for _ in range(repeats):
+        wave = []
+        for i in range(tenants):
+            name = names[i % adapters]
+            router.pick(f"tenant-{i}", adapter=name)
+            # resident => warm-path TTFT; not yet in T0/T1 => the TTFT
+            # includes a hydration (classified at submit: concurrent
+            # same-adapter requests ride the same fetch)
+            resident = store.t1_has(name) or name in store.t0_resident()
+            wave.append((resident, _ask(i, name)))
+            submitted += 1
+        results = await asyncio.gather(
+            *(coro for _, coro in wave), return_exceptions=True
+        )
+        for (resident, _), result in zip(wave, results):
+            if isinstance(result, BaseException):
+                failures.append(f"{type(result).__name__}: {result}")
+            elif resident:
+                warm_ttfts.append(result)
+            else:
+                hydrate_ttfts.append(result)
+    wall_s = time.monotonic() - t_start
+
+    seg_samples: list[float] = []
+    for jid in JOURNEYS.ids():
+        for seg in journey_segments(JOURNEYS.events(jid)):
+            if seg["segment"] == "adapter-hydrate":
+                seg_samples.append(seg["ms"] / 1000.0)
+    section = engine.stats()["adapters"]
+    events = engine.flight.recent_events(0)
+    event_counts: dict[str, int] = {}
+    for e in events:
+        if e["kind"].startswith("adapter-"):
+            event_counts[e["kind"]] = event_counts.get(e["kind"], 0) + 1
+    await engine.close()
+    TpuServingEngine.reset_instances()
+
+    def pct(values, q):
+        v = _pct(values, q)
+        return round(v, 4) if v is not None else None
+
+    warm_ttfts.sort()
+    hydrate_ttfts.sort()
+    all_ttfts = sorted(warm_ttfts + hydrate_ttfts)
+    t0 = section["t0"]
+    ledger = section["ledger"]
+    out: dict[str, Any] = {
+        "tenants": tenants,
+        "adapters": adapters,
+        "t0_entries": t0_entries,
+        "published_to_t2": len(published),
+        "submitted": submitted,
+        "completed": len(all_ttfts),
+        "failures": failures,
+        # zero silent loss: every request completed (a refused adapter
+        # would surface here as a loud AdapterUnavailable)
+        "zero_silent_loss": not failures and len(all_ttfts) == submitted,
+        "multi_lora_ttft_p50_s": pct(all_ttfts, 0.50),
+        "multi_lora_ttft_p99_s": pct(all_ttfts, 0.99),
+        "multi_lora_warm_ttft_p50_s": pct(warm_ttfts, 0.50),
+        "multi_lora_hydrate_ttft_p50_s": pct(hydrate_ttfts, 0.50),
+        "multi_lora_hydrate_ttft_p99_s": pct(hydrate_ttfts, 0.99),
+        "multi_lora_t0_hit_ratio": round(
+            t0["hits"] / max(1, t0["hits"] + t0["loads"]), 4
+        ),
+        # eviction churn across every tier (T0 row churn + T1/T2)
+        "multi_lora_evictions": t0["evictions"] + section["evictions"],
+        "t0_evictions": t0["evictions"],
+        "t0_loads": t0["loads"],
+        "eviction_refusals": t0["eviction_refusals"],
+        "hydrations": section["hydrations"],
+        "hydrate_failures": section["hydrate_failures"],
+        "fingerprint_refusals": section["fingerprint_refusals"],
+        "ledger": ledger,
+        "ledger_balanced": (
+            ledger["t1_bytes"]
+            + ledger["in_transit_bytes"]
+            + ledger["t2_bytes"]
+            == ledger["inserted_bytes"]
+            + ledger["discovered_bytes"]
+            - ledger["evicted_bytes"]
+        ),
+        "router": {
+            "adapter_hits": router.stats()["adapter_hits"],
+            "adapter_rerouted": router.stats()["adapter_rerouted"],
+            "pinned_adapters": router.stats()["pinned_adapters"],
+        },
+        "flight_events": event_counts,
+        "wall_s": round(wall_s, 3),
+    }
+    if seg_samples:
+        seg_samples.sort()
+        out["journey_segments"] = {
+            "adapter-hydrate": {
+                "p50_s": pct(seg_samples, 0.50),
+                "p99_s": pct(seg_samples, 0.99),
                 "n": len(seg_samples),
             }
         }

@@ -66,6 +66,15 @@ EDGE_SEGMENTS: dict[tuple[str, str], str] = {
     ("submit", "hydrate-begin"): "queue",
     ("hydrate-begin", "hydrate-done"): "prefix-hydrate",
     ("hydrate-done", "admit"): "queue",
+    # tiered adapter store (docs/ADAPTERS.md): an admission stashed
+    # while the hydrator pulls the request's LoRA factors T2→T1 — the
+    # cold-start interval an adapter pays once per replica, or writes
+    # off at the hydrate timeout (a cold refusal: no recompute fallback)
+    ("submit", "adapter-hydrate"): "queue",
+    ("hydrate-done", "adapter-hydrate"): "queue",
+    ("adapter-hydrate", "adapter-hydrate-done"): "adapter-hydrate",
+    ("adapter-hydrate-done", "admit"): "queue",
+    ("adapter-hydrate", "cancelled"): "adapter-hydrate",
     ("admit", "first-token"): "prefill",
     ("first-token", "export"): "export",
     ("export", "export-taken"): "handoff-wait",
@@ -93,7 +102,8 @@ EDGE_SEGMENTS: dict[tuple[str, str], str] = {
 #: the CLIENT can see: the decode pool's first step for a handoff, the
 #: first-token edge otherwise)
 TTFT_SEGMENTS = (
-    "ingest", "queue", "prefill", "export", "handoff-wait", "transfer",
+    "ingest", "queue", "prefix-hydrate", "adapter-hydrate", "prefill",
+    "export", "handoff-wait", "transfer",
     "decode-admission", "first-step", "preempted", "requeue",
 )
 

@@ -115,6 +115,16 @@ METRICS: dict[str, str] = {
     "gateway_stream_stalls": "up",
     "gateway_stream_ttfb_s": "up",
     "gateway_stream_cancel_reclaim_fraction": "down",
+    # multi-LoRA adapter phase (docs/ADAPTERS.md, gateway_bench
+    # run_multi_lora_phase): TTFT growing under the same adapter mix,
+    # the T0 hit ratio shrinking, eviction churn rising, or hydrations
+    # slowing is the adapter plane regressing
+    "multi_lora_ttft_p99_s": "up",
+    "multi_lora_t0_hit_ratio": "down",
+    "multi_lora_evictions": "up",
+    "multi_lora_hydrate_ttft_p99_s": "up",
+    "journey_adapter_hydrate_p50_s": "up",
+    "journey_adapter_hydrate_p99_s": "up",
     # analyzer self-stats (bench.py _analyzer_stats): the tier-1 gate
     # pays the analyzer's wall time every run, and a growing suppression
     # count is escape-hatch creep — both get worse upward
@@ -292,6 +302,17 @@ def extract_metrics(payload) -> dict:
             ):
                 if storm.get(key) is not None:
                     metrics[key] = storm[key]
+        # multi-LoRA adapter phase (gateway_bench run_multi_lora_phase):
+        # mixed-adapter TTFT quantiles, T0 hit ratio, eviction churn
+        lora = detail.get("multi_lora")
+        if isinstance(lora, dict):
+            for key in (
+                "multi_lora_ttft_p99_s", "multi_lora_t0_hit_ratio",
+                "multi_lora_evictions", "multi_lora_hydrate_ttft_p99_s",
+            ):
+                if lora.get(key) is not None:
+                    metrics[key] = float(lora[key])
+            _journey_metrics(lora.get("journey_segments"), metrics)
         # streaming-delivery phase (gateway_bench run_stream_phase):
         # client-observed TBT, first-frame TTFB, stall count, and the
         # disconnect-cancellation reclaim fraction
