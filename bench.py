@@ -820,6 +820,12 @@ async def run_decode_bench(
         "requests": requests,
         "total_tokens": total_tokens,
         "elapsed_s": round(elapsed, 2),
+        # the fused-tail invariant on the record: one packed host fetch
+        # per dispatched decode chunk (perf_diff flags drift upward)
+        "decode_host_fetches_per_chunk": (
+            (engine.stats().get("decode-chunks") or {})
+            .get("host_fetches_per_chunk")
+        ),
         "roofline": {
             "hbm_gbps_assumed": roof.hbm_gbps,
             # detected device identity (null off-TPU / when the plugin
@@ -913,6 +919,10 @@ async def run_speculative_phase() -> dict:
         "accepted_per_step": round(accepted / steps, 2) if steps else 0.0,
         "requests": reqs,
         "max_tokens": toks,
+        # the engine's own speculation section (fused-tail dispatch/fetch
+        # counters, rolling measured uplift, auto-disable posture) rides
+        # the record so perf_diff can extract it schema-2-aligned
+        "engine": spec or None,
     }
 
 
@@ -1150,6 +1160,33 @@ async def run_gateway_phase() -> dict:
             broker_proc.stop()
 
 
+def _stream_tbt_gate(out: dict) -> dict:
+    """ROADMAP item 5's leftover wired in: the streaming phase's measured
+    client-observed TBT p99 is judged against an absolute per-token
+    latency budget (``BENCH_TBT_P99_BUDGET_S``, seconds; default 0.25 —
+    the 4 Hz floor a reading human perceives as continuous) and the
+    verdict rides the phase output. Together with perf_diff's relative
+    ``gateway_stream_tbt_p99_s`` gate (±10% round-over-round), decode-
+    chunk tuning is held to the product-latency guarantee in the record
+    itself, not just observed."""
+    if not isinstance(out, dict):
+        return out
+    budget = float(os.environ.get("BENCH_TBT_P99_BUDGET_S", "0.25") or 0)
+    if budget <= 0:
+        return out  # record-only posture: gate explicitly disabled
+    tbt = out.get("gateway_stream_tbt_p99_s")
+    out["tbt_p99_budget_s"] = budget
+    out["tbt_p99_within_budget"] = (
+        tbt is not None and float(tbt) <= budget
+    )
+    if not out["tbt_p99_within_budget"]:
+        out["gate_violation"] = (
+            f"gateway_stream_tbt_p99_s {tbt} over the "
+            f"{budget}s product budget"
+        )
+    return out
+
+
 async def _child_phase(phase: str) -> dict:
     if phase == "decode":
         return await _phase(
@@ -1198,9 +1235,10 @@ async def _child_phase(phase: str) -> dict:
         sys.path.insert(0, os.path.join(os.path.dirname(_BENCH_PATH), "tools"))
         from gateway_bench import run_stream_phase
 
-        return await _phase(
+        out = await _phase(
             run_stream_phase(), budget_s=min(PHASE_BUDGET_S, 240)
         )
+        return _stream_tbt_gate(out)
     if phase == "multi_lora":
         sys.path.insert(0, os.path.join(os.path.dirname(_BENCH_PATH), "tools"))
         from gateway_bench import run_multi_lora_phase

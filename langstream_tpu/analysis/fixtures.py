@@ -796,7 +796,7 @@ class TpuServingEngine:
     },
     fix=(
         "Specialization-getter arguments (_decode_fn / _prefill_fn / "
-        "_verify_fn) are jit cache keys: every replica must compute "
+        "_spec_step_fn) are jit cache keys: every replica must compute "
         "the same key or the mesh compiles divergent programs. Derive "
         "keys from the (broadcast) batch shape, never from host-local "
         "sources (time.*, random.*, os.environ, hostname) — and note "

@@ -134,7 +134,7 @@ HOT_CONTEXT_ROOTS = (
 #: resolve the same variant (SPMD1302) and every dispatch must be
 #: broadcast first (SPMD1303)
 JIT_GETTER_NAMES = (
-    "_decode_fn", "_prefill_fn", "_prefill_continue_fn", "_verify_fn",
+    "_decode_fn", "_prefill_fn", "_prefill_continue_fn", "_spec_step_fn",
 )
 
 

@@ -350,6 +350,130 @@ def llama_prefill_continue_paged(
     return logits, pool_k, pool_v
 
 
+def pack_tokens_logprobs(tokens: jax.Array, logprobs: jax.Array) -> jax.Array:
+    """Fold a chunk's host-bound outputs into ONE int32 buffer *inside*
+    the decode program: tokens first, then the logprobs bit-cast to int32
+    (lossless — the host views the tail back as float32). The engine's
+    per-chunk host traffic is exactly this array's D2H copy; packing here
+    rather than in a second jitted program removes the post-hoc pack
+    dispatch from the decode tail."""
+    return jnp.concatenate([
+        tokens.astype(jnp.int32).reshape(-1),
+        jax.lax.bitcast_convert_type(
+            logprobs.astype(jnp.float32), jnp.int32
+        ).reshape(-1),
+    ])
+
+
+def prompt_lookup_draft(
+    ctx: jax.Array,         # (S,) int32 — [prompt | generated], zero-padded
+    n: jax.Array,           # scalar int32 — valid tokens in ``ctx``
+    num_drafts: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Device twin of the engine's host bigram drafter: continue the
+    context's most recent occurrence of its final bigram.
+
+    Matches the host semantics exactly (the greedy speculative stream is
+    byte-identity-pinned against plain decode, so the drafter must too):
+    candidate positions are ``i in [1, n-2]`` with
+    ``(ctx[i-1], ctx[i]) == (ctx[n-2], ctx[n-1])``, the LAST occurrence
+    wins, and the draft is ``ctx[i+1 : i+1+num_drafts]`` clipped to the
+    valid region and zero-padded. No match (or ``n < 3``) → all zeros
+    with zero real drafts. Returns ``(drafts (num_drafts,), n_real)``.
+    """
+    S = ctx.shape[0]
+    pos = jnp.arange(S, dtype=jnp.int32)
+    last0 = ctx[jnp.maximum(n - 2, 0)]
+    last1 = ctx[jnp.maximum(n - 1, 0)]
+    prev = jnp.roll(ctx, 1)  # prev[i] = ctx[i-1]; prev[0] is masked out
+    match = (prev == last0) & (ctx == last1) & (pos >= 1) & (pos <= n - 2)
+    i = jnp.max(jnp.where(match, pos, -1))
+    found = (i >= 0) & (n >= 3)
+    start = i + 1
+    offs = start + jnp.arange(num_drafts, dtype=jnp.int32)
+    drafts = jnp.where(
+        (offs < n) & found, ctx[jnp.clip(offs, 0, S - 1)], 0
+    )
+    n_real = jnp.where(found, jnp.clip(n - start, 0, num_drafts), 0)
+    return drafts.astype(jnp.int32), n_real.astype(jnp.int32)
+
+
+def llama_spec_step_paged(
+    config: LlamaConfig,
+    params: dict,
+    ctx: jax.Array,            # (B, S) int32 device-resident context tokens
+    current: jax.Array,        # (B,) last emitted token per slot
+    base_lengths: jax.Array,   # (B,) tokens committed in the pool
+    active: jax.Array,         # (B,) bool
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    num_drafts: int,
+    num_read_blocks: int,
+    ffn=None,
+    kernel: str = "xla",
+    mesh=None,
+    key: jax.Array | None = None,
+    temps: jax.Array | None = None,
+    topks: jax.Array | None = None,
+    topps: jax.Array | None = None,
+    sampler_mode: tuple | None = None,
+    adapters: dict | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused device-resident speculative step: prompt-lookup draft over
+    the resident context rows, the verify forward, and the in-program
+    context update — ONE dispatch and ONE packed host fetch per step.
+
+    The context rows hold ``[prompt | generated]`` so ``n = lengths + 1``
+    (``current`` is ``ctx[n-1]``, not yet committed to the pool). Drafts
+    are computed per-row by :func:`prompt_lookup_draft`, verified by
+    :func:`llama_verify_chunk_paged`, and the emitted run is scattered
+    back into ``ctx`` at ``n .. n+adv-1`` so the next step drafts from an
+    already-current device context — the host never ships tokens back.
+
+    Returns ``(packed, ctx, pool_k, pool_v)`` where ``packed`` is the
+    int32 single-fetch layout
+    ``[emitted (B*D1) | adv (B) | next (B) | new_lengths (B) |
+    n_real (B) | bitcast logprobs (B*D1)]``.
+    """
+    c = config
+    B, S = ctx.shape
+    n = base_lengths.astype(jnp.int32) + 1
+    drafts, n_real = jax.vmap(
+        lambda row, ln: prompt_lookup_draft(row, ln, num_drafts)
+    )(ctx, n)
+    drafts = jnp.where(active[:, None], drafts, 0)
+    n_real = jnp.where(active, n_real, 0)
+    tokens = jnp.concatenate([current[:, None], drafts], axis=1)  # (B, D1)
+    emitted, adv, next_tokens, new_lengths, pool_k, pool_v, logprobs = (
+        llama_verify_chunk_paged(
+            c, params, tokens, base_lengths, active, pool_k, pool_v,
+            block_tables, num_read_blocks, ffn=ffn, kernel=kernel,
+            mesh=mesh, key=key, temps=temps, topks=topks, topps=topps,
+            sampler_mode=sampler_mode, adapters=adapters,
+        )
+    )
+    D1 = num_drafts + 1
+    js = jnp.arange(D1, dtype=jnp.int32)[None, :]
+    write_pos = n[:, None] + js                    # emitted[:, j] → ctx[n+j]
+    rows = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, D1))
+    # unemitted columns (and context-cap overruns) redirect to an OOB
+    # column and drop — inactive rows have adv 0, so they never write
+    cols = jnp.where(js < adv[:, None], write_pos, S)
+    ctx = ctx.at[rows, cols].set(emitted.astype(jnp.int32), mode="drop")
+    packed = jnp.concatenate([
+        emitted.astype(jnp.int32).reshape(-1),
+        adv.astype(jnp.int32),
+        next_tokens.astype(jnp.int32),
+        new_lengths.astype(jnp.int32),
+        n_real.astype(jnp.int32),
+        jax.lax.bitcast_convert_type(
+            logprobs.astype(jnp.float32), jnp.int32
+        ).reshape(-1),
+    ])
+    return packed, ctx, pool_k, pool_v
+
+
 def llama_verify_chunk_paged(
     config: LlamaConfig,
     params: dict,
@@ -536,10 +660,17 @@ def llama_decode_chunk_paged(
     sample_extras=None,       # (presences, frequencies, counts0) — see
                               # llama_decode_chunk
     adapters: dict | None = None,  # batched ragged LoRA (see lora_delta)
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    return_packed: bool = False,
+) -> tuple[jax.Array, ...]:
     """K fused decode steps against the paged pool; same two-segment
     discipline as the dense ``llama_decode_chunk`` (pool read-only, new K/V
-    in a chunk buffer, one scatter commit at the end)."""
+    in a chunk buffer, one scatter commit at the end).
+
+    ``return_packed=True`` folds the chunk's host-bound outputs into the
+    program itself (:func:`pack_tokens_logprobs`) and returns
+    ``(packed, final_tokens, final_lengths, pool_k, pool_v)`` — the
+    engine's whole per-chunk host traffic becomes that one array's D2H
+    copy, with no post-hoc pack dispatch."""
     c = config
     if ffn is None:
         ffn = _default_ffn
@@ -704,6 +835,9 @@ def llama_decode_chunk_paged(
         base_lengths, valid,
     )
     final_lengths = base_lengths + num_steps * adv
+    if return_packed:
+        packed = pack_tokens_logprobs(chunk_tokens, chunk_lps)
+        return packed, final_tokens, final_lengths, pool_k, pool_v
     return chunk_tokens, chunk_lps, final_tokens, final_lengths, pool_k, pool_v
 
 

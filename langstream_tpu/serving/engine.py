@@ -1008,6 +1008,41 @@ class TpuServingEngine:
         self.spec_steps = 0
         self.spec_accepted = 0
         self.spec_rejected = 0
+        # device-resident speculation state (PR 20): per-slot context token
+        # rows on device (lazy — allocated at the first speculative burst)
+        # and the host ledger of how many leading entries per row are
+        # known-correct for the slot's CURRENT request. Plain-decode paths
+        # never touch the ledger, so their slots read as stale and re-sync
+        # at the next burst entry; slot release resets to 0.
+        self._ctx_dev = None
+        self._ctx_synced = np.zeros(config.slots, dtype=np.int64)
+        # fetch/dispatch conservation counters: the one-host-fetch-per-
+        # chunk acceptance rides on these (stats() exposes the ratio)
+        self._decode_dispatches = 0
+        self._decode_fetches = 0
+        self._spec_dispatches = 0
+        self._spec_fetches = 0
+        # measured-uplift auto-disable: rolling (tokens, seconds) windows
+        # for speculative steps and plain-decode chunks. Uplift = spec
+        # tok/s over plain tok/s; < 1 over a full window flips speculation
+        # off with a spec-auto-disable flight event. Plain samples come
+        # from the periodic in-burst calibration chunk (wall-measured at
+        # matched posture) and, while disabled, from ordinary decode
+        # chunks — which also count toward the re-enable probe.
+        _win = int(os.environ.get("LS_TPU_SPEC_UPLIFT_WINDOW", "32"))
+        self._spec_window: deque = deque(maxlen=max(_win, 1))
+        self._plain_window: deque = deque(maxlen=max(_win, 1))
+        self._spec_cal_every = int(
+            os.environ.get("LS_TPU_SPEC_CALIBRATE_EVERY", "32")
+        )
+        self._spec_retry_plain = int(
+            os.environ.get("LS_TPU_SPEC_RETRY_CHUNKS", "256")
+        )
+        self._spec_steps_since_cal = 0
+        self._spec_auto_disabled = False
+        self._spec_plain_since_disable = 0
+        self._spec_last_uplift: float | None = None
+        self._spec_flips: list[tuple[float, str]] = []
         # host mirrors of the prefix-cache counters (flight samples carry
         # them; the metric closures above are write-only)
         self.prefix_hits = 0
@@ -1133,6 +1168,11 @@ class TpuServingEngine:
         self._m_spec_ratio = reporter.gauge(
             "speculative_accept_ratio",
             "accepted / drafted ratio over the engine's life",
+        )
+        self._m_spec_uplift = reporter.gauge(
+            "speculative_uplift",
+            "rolling measured speculative-vs-plain tokens/s ratio (the "
+            "auto-disable verdict input; 0 until the first full window)",
         )
         self._m_recompiles = reporter.counter(
             "recompiles_total",
@@ -1962,6 +2002,10 @@ class TpuServingEngine:
                         else {"ids": ad_ids, "layers": ad_layers}
                     )
                     sample_fn = _sample_fn_for(temps, topks, topps, pres, freq)
+                    # return_packed folds the tokens+bitcast-logprobs pack
+                    # into the decode program itself: the chunk's whole
+                    # host traffic is out[0]'s D2H copy, with no post-hoc
+                    # pack dispatch (pre-fusion _pack_chunk) behind it
                     out = llama_decode_chunk_paged(
                         mc_static, params, tokens, lengths, active,
                         cache_k, cache_v, tables, sample_fn, key, K,
@@ -1970,8 +2014,9 @@ class TpuServingEngine:
                         mesh=mesh_static, ffn=ffn_static,
                         sample_extras=_extras(pres, freq, counts),
                         adapters=adapters,
+                        return_packed=True,
                     )
-                    return _fetchable(out[0], out[1]) + out[2:]
+                    return _fetchable(out[0]) + out[1:]
 
                 return _decode_chunk
 
@@ -1985,6 +2030,9 @@ class TpuServingEngine:
                 static ``window`` caps the cache read to the smallest bucket
                 covering the longest active sequence."""
                 from langstream_tpu.models.llama import llama_decode_chunk
+                from langstream_tpu.models.llama_paged import (
+                    pack_tokens_logprobs,
+                )
 
                 sample_fn = _sample_fn_for(temps, topks, topps, pres, freq)
                 if self.dense_read_kernel != "xla":
@@ -2000,7 +2048,11 @@ class TpuServingEngine:
                         ffn=ffn_static,
                         sample_extras=_extras(pres, freq, counts),
                     )
-                    return _fetchable(out[0], out[1]) + out[2:]
+                    # dense twins pack inside THIS jit: same one-fetch
+                    # tail, same single compiled program per chunk
+                    return _fetchable(
+                        pack_tokens_logprobs(out[0], out[1])
+                    ) + out[2:]
 
                 out = llama_decode_chunk(
                     mc_static, params, tokens, lengths, active,
@@ -2008,7 +2060,9 @@ class TpuServingEngine:
                     key, K, window=window, ffn=ffn_static,
                     sample_extras=_extras(pres, freq, counts),
                 )
-                return _fetchable(out[0], out[1]) + out[2:]
+                return _fetchable(
+                    pack_tokens_logprobs(out[0], out[1])
+                ) + out[2:]
 
             return _decode_chunk
 
@@ -2102,38 +2156,41 @@ class TpuServingEngine:
 
         self._make_prefill_continue = _make_prefill_continue
 
-        def _make_verify(nrb: int, sampler_mode: tuple):
-            """Speculative verify step (prompt-lookup decoding); the draft
-            count specializes via the tokens shape at trace time, the
-            acceptance rule (greedy vs rejection-sampled) via
-            ``sampler_mode``."""
+        def _make_spec_step(nrb: int, sampler_mode: tuple):
+            """Fused device-resident speculative step (prompt-lookup
+            decoding): draft over the resident context rows + verify +
+            in-program context update, ONE dispatch per step. The draft
+            count is static (config), the acceptance rule (greedy vs
+            rejection-sampled) specializes via ``sampler_mode``. The
+            host reads exactly one packed array back per step."""
+            D = self.config.speculative_drafts
 
-            @partial(jax.jit, donate_argnums=(1, 2))
-            def _verify(params, cache_k, cache_v, tokens, lengths, active,
-                        tables, key, temps, topks, topps,
-                        ad_layers=None, ad_ids=None):
+            @partial(jax.jit, donate_argnums=(1, 2, 3))
+            def _spec_step(params, cache_k, cache_v, ctx, current, lengths,
+                           active, tables, key, temps, topks, topps,
+                           ad_layers=None, ad_ids=None):
                 from langstream_tpu.models.llama_paged import (
-                    llama_verify_chunk_paged,
+                    llama_spec_step_paged,
                 )
 
                 adapters = (
                     None if ad_ids is None
                     else {"ids": ad_ids, "layers": ad_layers}
                 )
-                out = llama_verify_chunk_paged(
-                    mc_static, params, tokens, lengths, active,
-                    cache_k, cache_v, tables, num_read_blocks=nrb,
-                    ffn=ffn_static, kernel=self._continuation_kernel(),
-                    mesh=mesh_static, key=key, temps=temps, topks=topks,
-                    topps=topps, sampler_mode=sampler_mode,
-                    adapters=adapters,
+                out = llama_spec_step_paged(
+                    mc_static, params, ctx, current, lengths, active,
+                    cache_k, cache_v, tables, num_drafts=D,
+                    num_read_blocks=nrb, ffn=ffn_static,
+                    kernel=self._continuation_kernel(), mesh=mesh_static,
+                    key=key, temps=temps, topks=topks, topps=topps,
+                    sampler_mode=sampler_mode, adapters=adapters,
                 )
-                # the leader host reads everything but the pools each step
-                return _fetchable(*out[:4]) + out[4:6] + _fetchable(out[6])
+                # the leader host reads ONLY the packed array each step
+                return _fetchable(out[0]) + out[1:]
 
-            return _verify
+            return _spec_step
 
-        self._make_verify = _make_verify
+        self._make_spec_step = _make_spec_step
         # the sampler's expensive passes (top-p vocab sort, top-k selection
         # sweep, any sampling at all for greedy-only batches) are compiled
         # in only when an active request needs them; decode additionally
@@ -2142,7 +2199,7 @@ class TpuServingEngine:
         self._decode_chunk_fns: dict[tuple[tuple, int | None, int], Any] = {}
         self._prefill_fns: dict[tuple, Any] = {}
         self._prefill_continue_fns: dict[tuple[tuple, int], Any] = {}
-        self._verify_fns: dict[tuple[int, tuple], Any] = {}
+        self._spec_step_fns: dict[tuple[int, tuple], Any] = {}
 
     def _decode_fn(self, sampler_mode: tuple, window: int | None,
                    k_steps: int = 0, use_pen: bool = False):
@@ -2188,12 +2245,12 @@ class TpuServingEngine:
         # paged_read_kernel is resolved away from "auto" at init
         return self.paged_read_kernel
 
-    def _verify_fn(self, nrb: int, sampler_mode: tuple):
+    def _spec_step_fn(self, nrb: int, sampler_mode: tuple):
         key = (nrb, sampler_mode)
-        if key not in self._verify_fns:
-            self._note_compile("verify", key)
-            self._verify_fns[key] = self._make_verify(nrb, sampler_mode)
-        return self._verify_fns[key]
+        if key not in self._spec_step_fns:
+            self._note_compile("spec_step", key)
+            self._spec_step_fns[key] = self._make_spec_step(nrb, sampler_mode)
+        return self._spec_step_fns[key]
 
     # ------------------------------------------------------------------
     # flight recorder plumbing
@@ -2300,10 +2357,17 @@ class TpuServingEngine:
             )
         return program
 
-    def _program_verify(self, nrb: int, sampler_mode: tuple) -> str:
+    def _program_spec_step(self, nrb: int, sampler_mode: tuple) -> str:
+        """Program id for the fused draft+verify step. A NEW census family
+        (``specstep:``, replacing the pre-fusion ``verify:`` ids): the
+        program now contains the prompt-lookup draft and the context
+        update, so schema-2 records must not conflate its measured cost
+        with the old verify-only program's. The cost model stays the
+        verify forward — the draft scan and ctx scatter are noise next to
+        the D+1-position forward."""
         drafts = self.config.speculative_drafts
         program = (
-            f"verify:nrb{nrb}:d{drafts}:{self._sampler_code(sampler_mode)}"
+            f"specstep:nrb{nrb}:d{drafts}:{self._sampler_code(sampler_mode)}"
         )
         if not self.attribution.known(program):
             self.attribution.register(
@@ -2383,6 +2447,27 @@ class TpuServingEngine:
         )
         # watchdog heartbeat: a recorded dispatch IS step progress
         self.watchdog.beat(sample["queue_depth"])
+        if (
+            phase == "decode"
+            and self._spec_auto_disabled
+            and self.config.speculative_drafts > 0
+        ):
+            # measured-uplift backoff: after enough plain chunks, give
+            # speculation another audition (the workload's copy-from-
+            # context affinity can change mid-stream — RAG turns end,
+            # code-edit turns begin)
+            self._spec_plain_since_disable += 1
+            if self._spec_plain_since_disable >= self._spec_retry_plain:
+                self._spec_auto_disabled = False
+                self._spec_plain_since_disable = 0
+                self._spec_steps_since_cal = self._spec_cal_every
+                self._spec_window.clear()
+                self._plain_window.clear()
+                self._spec_flips.append((time.monotonic(), "enable"))
+                self.flight.event(
+                    "spec-auto-enable",
+                    plain_chunks=self._spec_retry_plain,
+                )
         if depths:
             for cls, gauge in self._m_class_depth.items():
                 gauge(depths.get(cls, 0))
@@ -2714,6 +2799,31 @@ class TpuServingEngine:
                 for name, tracker in self._stream_slo.items()
                 if tracker.alerting.get("tbt")
             ),
+        }
+
+    def speculative_section(self) -> dict[str, Any]:
+        """The speculation payload for ``stats()["speculative"]`` and the
+        ``/flight/summary`` entry (speculative-configured engines only —
+        the default surfaces stay pinned without the flag). Wait-free:
+        counter snapshots only. Carries the fused-tail plumbing counters
+        (dispatches/fetches must track 1:1 — one packed fetch per fused
+        draft+verify step) and the measured-uplift plane that drives
+        auto-disable, so engine_top's speculation panel and ``--analyze``
+        need no extra engine surface."""
+        return {
+            "steps": self.spec_steps,
+            "drafts_accepted": self.spec_accepted,
+            # rejected drafts make a spec slowdown decomposable from a
+            # live engine: high reject ratio = wasted verify FLOPs, not
+            # host overhead
+            "rejected": self.spec_rejected,
+            "dispatches": self._spec_dispatches,
+            "fetches": self._spec_fetches,
+            "uplift": self._spec_last_uplift,
+            "auto_disabled": self._spec_auto_disabled,
+            "flips": len(self._spec_flips),
+            "window_steps": len(self._spec_window),
+            "window_plain": len(self._plain_window),
         }
 
     def attribution_section(self) -> dict[str, Any]:
@@ -3075,6 +3185,15 @@ class TpuServingEngine:
             "decode-chunks": {
                 "light": self._light_chunks,
                 "heavy": self._heavy_chunks,
+                # the one-fetch invariant, observable live: a ratio above
+                # 1.0 means the decode tail is re-crossing the host
+                # boundary (regression canary for the fused sampler)
+                "dispatched": self._decode_dispatches,
+                "fetched": self._decode_fetches,
+                "host_fetches_per_chunk": (
+                    round(self._decode_fetches / self._decode_dispatches, 4)
+                    if self._decode_dispatches else 0.0
+                ),
             },
             # pipelined loop posture + the bounded device-upload caches
             # (size/hits/misses/evictions — the eviction counter is the
@@ -3125,14 +3244,7 @@ class TpuServingEngine:
         if self.block_mgr is not None:
             out["kv"] = {"layout": "paged", **self.block_mgr.stats()}
         if self.config.speculative_drafts > 0:
-            out["speculative"] = {
-                "steps": self.spec_steps,
-                "drafts_accepted": self.spec_accepted,
-                # rejected drafts make the 4.3x spec slowdown decomposable
-                # from a live engine: high reject ratio = wasted verify
-                # FLOPs, not host overhead
-                "rejected": self.spec_rejected,
-            }
+            out["speculative"] = self.speculative_section()
         if self.incidents is not None:
             # incident capture plane: captured/suppressed/evicted counts
             # plus the bounded bundle index (docs/OBSERVABILITY.md)
@@ -4273,6 +4385,9 @@ class TpuServingEngine:
                 if (
                     self.config.speculative_drafts > 0
                     and self.block_mgr is not None
+                    # measured-uplift auto-disable parks the engine on the
+                    # plain pipelined loop until the retry window elapses
+                    and not self._spec_auto_disabled
                     # greedy bursts use argmax acceptance; sampled bursts
                     # use rejection sampling against the filtered target
                     # distribution (distribution-exact). Penalties alone
@@ -4320,6 +4435,13 @@ class TpuServingEngine:
                         "lockstep-divergence", error=str(e)[:200]
                     )
                     self._stop = True
+        if self._pending_chunk is not None:
+            # a stop that lands between a pipelined burst and the next
+            # loop pass leaves one dispatched chunk in flight: drain it so
+            # the dispatch/fetch ledger closes 1:1 (the one-fetch-per-
+            # chunk canary) and the flight timeline stays contiguous —
+            # finished slots' tokens are identity-filtered as always
+            await self._drain_pending(loop)
 
     def _fail_inflight(self, error: Exception) -> None:
         self.flight.event(
@@ -5387,19 +5509,208 @@ class TpuServingEngine:
                 return padded, len(cont)
         return [0] * num_drafts, 0
 
+    def _sync_ctx_rows(
+        self, live: list[int]
+    ) -> tuple[np.ndarray, np.ndarray] | tuple[None, None]:
+        """Host-side payload for re-syncing stale context rows of the
+        device-resident token buffer the fused drafter reads. The ledger
+        ``_ctx_synced[slot]`` holds the number of valid tokens in the
+        slot's device row; a row is current when it equals ``lengths+1``
+        (history plus the pending current token). The fused spec step
+        extends rows in-program as drafts are accepted, so under a pure
+        speculative run NOTHING re-syncs — only freshly-prefilled slots
+        and slots advanced by a plain decode chunk (calibration, or an
+        auto-disabled interval), each with one full-row upload. Loop-
+        thread only (host truth, ledger update); the device write itself
+        happens in the dispatch closure, which also broadcasts this
+        payload so lockstep followers apply the identical update."""
+        S = self.model_config.max_seq_len
+        rows: list[int] = []
+        vals: list[np.ndarray] = []
+        for slot_id in live:
+            request = self.slots[slot_id].request
+            n = min(int(self._lengths[slot_id]) + 1, S)
+            if int(self._ctx_synced[slot_id]) == n:
+                continue
+            ctx = request.prompt_tokens + request.generated
+            row = np.zeros(S, dtype=np.int32)
+            m = min(n, len(ctx))
+            row[:m] = ctx[:m]
+            rows.append(slot_id)
+            vals.append(row)
+            self._ctx_synced[slot_id] = n
+        if not rows:
+            return None, None
+        return np.fromiter(rows, dtype=np.int32, count=len(rows)), np.stack(vals)
+
+    def _fetch_spec(
+        self, packed, d1: int
+    ) -> tuple[np.ndarray, ...]:
+        """Designated fetch stage for the fused speculative step: ONE
+        device→host transfer per step carries emitted tokens, per-slot
+        advance counts, the next-token feedback, new lengths, real-draft
+        counts, and bitcast logprobs."""
+        B = self.config.slots
+        nE = B * d1
+        self._fault("fetch")
+        flat = np.asarray(packed)
+        self._spec_fetches += 1
+        return (
+            flat[:nE].reshape(B, d1),
+            flat[nE:nE + B],
+            flat[nE + B:nE + 2 * B],
+            flat[nE + 2 * B:nE + 3 * B],
+            flat[nE + 3 * B:nE + 4 * B],
+            flat[nE + 4 * B:].view(np.float32).reshape(B, d1),
+        )
+
+    def _spec_note_step(self, tokens: int, wall_s: float) -> None:
+        if tokens > 0 and wall_s > 0:
+            self._spec_window.append((tokens, wall_s))
+
+    def _spec_note_plain(self, tokens: int, wall_s: float) -> None:
+        if tokens > 0 and wall_s > 0:
+            self._plain_window.append((tokens, wall_s))
+
+    def _spec_uplift(self) -> float | None:
+        """Rolling measured uplift: speculative tokens/s over plain
+        tokens/s, None until the spec window is full AND at least one
+        plain (calibration) sample exists — a half-window verdict would
+        flap on warmup jitter."""
+        if len(self._spec_window) < (self._spec_window.maxlen or 1):
+            return None
+        if not self._plain_window:
+            return None
+        spec_n = sum(n for n, _ in self._spec_window)
+        spec_t = sum(w for _, w in self._spec_window)
+        plain_n = sum(n for n, _ in self._plain_window)
+        plain_t = sum(w for _, w in self._plain_window)
+        if spec_t <= 0 or plain_t <= 0 or plain_n <= 0:
+            return None
+        return (spec_n / spec_t) / (plain_n / plain_t)
+
+    def _spec_check_uplift(self) -> bool:
+        """Flip speculation off when the measured uplift drops below 1 —
+        the honest answer to BENCH_r05's 0.23x speculative slowdown: a
+        high accept ratio is NOT a win if the per-step cost eats it.
+        Returns True when the flip happened (the burst must return to the
+        plain decode loop). Re-enable is time-served: see the
+        ``spec-auto-enable`` branch in :meth:`_flight_record`."""
+        uplift = self._spec_uplift()
+        if uplift is None:
+            return False
+        self._spec_last_uplift = uplift
+        self._m_spec_uplift(uplift)
+        if uplift >= 1.0:
+            return False
+        self._spec_auto_disabled = True
+        self._spec_plain_since_disable = 0
+        self._spec_flips.append((time.monotonic(), "disable"))
+        self.flight.event(
+            "spec-auto-disable",
+            uplift=round(uplift, 4),
+            window_steps=len(self._spec_window),
+            plain_samples=len(self._plain_window),
+        )
+        self._spec_window.clear()
+        self._plain_window.clear()
+        return True
+
+    def _spec_cal_due(self) -> bool:
+        return self._spec_steps_since_cal >= self._spec_cal_every
+
+    async def _spec_calibration_chunk(
+        self, loop, live: list[int], active_mask: np.ndarray,
+        sampler_mode: tuple, tables: np.ndarray, nrb: int,
+    ) -> bool:
+        """One plain K=1 decode chunk, wall-timed end to end, feeding the
+        plain-throughput window the uplift verdict divides by. Greedy
+        streams stay byte-identical: a single plain greedy step emits
+        exactly the token the spec step's first verified position would.
+        Returns True when any slot finished (the burst tears down, same
+        as the sequential decode loop)."""
+        K = 1
+        fn = self._decode_fn(sampler_mode, nrb, K, False)
+        program = self._program_decode(nrb, K, sampler_mode, False)
+        amask, temps, topks, topps = self._sampler_device(active_mask)
+        lengths_np = self._lengths.copy()
+        current_np = self._current.copy()
+        temps_np = self._temps.copy()
+        topks_np = self._topks.copy()
+        topps_np = self._topps.copy()
+        ad_np = self._ad_rows.copy() if self._ad_rows is not None else None
+        key = self._split_key()
+
+        def _run():
+            if self._lockstep is not None:
+                self._lockstep.broadcast(
+                    {
+                        "op": "decode",
+                        "sampler_mode": list(sampler_mode),
+                        "window": nrb,
+                        "k": K,
+                        "key": np.asarray(key),
+                        "active": active_mask,
+                        "tables": tables,
+                        "tokens": current_np,
+                        "lengths": lengths_np,
+                        "temps": temps_np,
+                        "topks": topks_np,
+                        "topps": topps_np,
+                    }
+                )
+            self.profiler.on_decode_chunk()
+            tables_dev = self._tables_device(tables)
+            ad_kw = (
+                {}
+                if ad_np is None
+                else {"ad_layers": self._ad_layers,
+                      "ad_ids": jnp.asarray(ad_np)}
+            )
+            packed, _t, _l, ck, cv = fn(
+                self.params, self.cache_k, self.cache_v,
+                jnp.asarray(current_np), jnp.asarray(lengths_np),
+                amask, tables_dev, key, temps, topks, topps, **ad_kw,
+            )
+            self.cache_k, self.cache_v = ck, cv
+            self._decode_dispatches += 1
+            self._start_fetch(packed)
+            return self._fetch_chunk(packed, K)
+
+        t_wall = time.monotonic()
+        chunk_t, chunk_lp, fetch_s = await loop.run_in_executor(
+            self._executor, _run
+        )
+        gen_before = self.total_generated
+        finished = self._process_chunk(chunk_t, chunk_lp, live)
+        self._spec_note_plain(
+            self.total_generated - gen_before, time.monotonic() - t_wall
+        )
+        self._flight_record(
+            "decode", device_s=fetch_s,
+            tokens=self.total_generated - gen_before, program=program,
+        )
+        await self._flush_emits(live)
+        return finished
+
     async def _speculative_burst(self, loop, active: list[int]) -> None:
-        """Greedy prompt-lookup speculative decoding: per step, each active
-        slot's drafted continuation is verified in one forward over D+1
-        positions; accepted drafts emit as a burst of tokens. Streams are
-        identical to plain greedy decode — only the tokens-per-step ratio
-        changes. Host round-trips per step (drafts need the emitted
-        context), so this path trades the pipelined chunk loop for up to
-        (D+1)x tokens per forward; workloads that copy from their context
-        (RAG, summarization, code edits) win, others see ~plain speed."""
+        """Device-resident prompt-lookup speculative decoding: per step,
+        ONE fused dispatch drafts each slot's continuation from the
+        device-resident context rows, verifies D+1 positions, extends the
+        context rows in-program, and packs everything the host needs into
+        a single array — zero host syncs inside the dispatch closure
+        (graftcheck HOT1401/HOT1402), one packed fetch per step. Streams
+        are identical to plain greedy decode — only the tokens-per-step
+        ratio changes. A rolling measured-uplift window (calibrated by
+        periodic plain K=1 chunks) flips speculation off with a
+        ``spec-auto-disable`` flight event when the fused step is not
+        actually paying for itself."""
         D = self.config.speculative_drafts
         D1 = D + 1
         S = self.model_config.max_seq_len
         while True:
+            if self._spec_auto_disabled:
+                return
             live = [
                 i for i in active
                 if self.slots[i].request is not None
@@ -5407,20 +5718,14 @@ class TpuServingEngine:
             ]
             if not live:
                 return
-            tokens = np.zeros((self.config.slots, D1), dtype=np.int32)
             self._fault("pool-grow")
             grown_blocks = grown_slots = 0
-            drafted_real: dict[int, int] = {}
             for slot_id in live:
                 n = self.block_mgr.ensure_capacity(
                     slot_id, min(int(self._lengths[slot_id]) + D1, S)
                 )
                 grown_blocks += n
                 grown_slots += bool(n)
-                tokens[slot_id, 0] = self._current[slot_id]
-                drafts, n_real = self._draft_tokens(slot_id, D)
-                drafted_real[slot_id] = n_real
-                tokens[slot_id, 1:] = drafts
             if grown_blocks:
                 self.flight.event(
                     "pool-grow", slots=grown_slots, blocks=grown_blocks,
@@ -5437,13 +5742,31 @@ class TpuServingEngine:
                 self._temps[active_mask], self._topks[active_mask],
                 self._topps[active_mask],
             )
-            fn = self._verify_fn(nrb, sampler_mode)
-            program = self._program_verify(nrb, sampler_mode)
-            # host state snapshotted on the LOOP thread: the verify step
+            if self._spec_cal_due():
+                finished = await self._spec_calibration_chunk(
+                    loop, live, active_mask, sampler_mode, tables, nrb
+                )
+                self._spec_steps_since_cal = 0
+                if self._spec_check_uplift():
+                    return
+                if (
+                    finished
+                    or not self.scheduler.empty()
+                    or self._stop
+                    or self._has_prefilling()
+                    or (self._draining and not self._drain_pass_done)
+                ):
+                    return
+                continue  # re-derive live/lengths: the chunk advanced them
+            ctx_rows, ctx_vals = self._sync_ctx_rows(live)
+            fn = self._spec_step_fn(nrb, sampler_mode)
+            program = self._program_spec_step(nrb, sampler_mode)
+            # host state snapshotted on the LOOP thread: the spec step
             # yields to admission between iterations, which rewrites the
             # sampler arrays — the dispatch closure must not re-read
             # mutable engine fields mid-flight (RACE801)
             lengths_np = self._lengths.copy()
+            current_np = self._current.copy()
             temps_np = self._temps.copy()
             topks_np = self._topks.copy()
             topps_np = self._topps.copy()
@@ -5454,52 +5777,70 @@ class TpuServingEngine:
 
             def _run():
                 if self._lockstep is not None:
-                    # drafts are plain host data: followers replay the same
-                    # verify jit from the broadcast descriptor
-                    self._lockstep.broadcast(
-                        {
-                            "op": "verify",
-                            "nrb": nrb,
-                            "sampler_mode": list(sampler_mode),
-                            "tokens": tokens,
-                            "lengths": lengths_np,
-                            "active": active_mask,
-                            "tables": tables,
-                            "key": np.asarray(key),
-                            "temps": temps_np,
-                            "topks": topks_np,
-                            "topps": topps_np,
-                        }
-                    )
+                    # drafting moved on-device: followers replay the same
+                    # fused jit from control-plane state only — current
+                    # tokens, lengths, and any context rows the leader
+                    # re-synced this step (device rows chain otherwise)
+                    desc: dict[str, Any] = {
+                        "op": "spec_step",
+                        "nrb": nrb,
+                        "sampler_mode": list(sampler_mode),
+                        "current": current_np,
+                        "lengths": lengths_np,
+                        "active": active_mask,
+                        "tables": tables,
+                        "key": np.asarray(key),
+                        "temps": temps_np,
+                        "topks": topks_np,
+                        "topps": topps_np,
+                    }
+                    if ctx_rows is not None:
+                        desc["ctx_rows"] = ctx_rows
+                        desc["ctx_vals"] = ctx_vals
+                    self._lockstep.broadcast(desc)
                 ad_kw = (
                     {}
                     if ad_np is None
                     else {"ad_layers": self._ad_layers,
                           "ad_ids": jnp.asarray(ad_np)}
                 )
+                # the context buffer lives on the dispatch thread, like
+                # the KV caches: created lazily, patched with the loop
+                # thread's re-sync payload, then chained through the
+                # fused program's donated output
+                if self._ctx_dev is None:
+                    self._ctx_dev = jnp.zeros(
+                        (self.config.slots, self.model_config.max_seq_len),
+                        dtype=jnp.int32,
+                    )
+                if ctx_rows is not None:
+                    self._ctx_dev = self._ctx_dev.at[
+                        jnp.asarray(ctx_rows)
+                    ].set(jnp.asarray(ctx_vals))
                 out = fn(
-                    self.params, self.cache_k, self.cache_v,
-                    jnp.asarray(tokens), jnp.asarray(lengths_np),
+                    self.params, self.cache_k, self.cache_v, self._ctx_dev,
+                    jnp.asarray(current_np), jnp.asarray(lengths_np),
                     jnp.asarray(active_mask), jnp.asarray(tables),
                     key, jnp.asarray(temps_np), jnp.asarray(topks_np),
                     jnp.asarray(topps_np), **ad_kw,
                 )
-                self.cache_k, self.cache_v = out[4], out[5]
-                # dispatch returned async; the fetches below block until
+                self._ctx_dev = out[1]
+                self.cache_k, self.cache_v = out[2], out[3]
+                self._spec_dispatches += 1
+                self._start_fetch(out[0])
+                # dispatch returned async; the fetch below blocks until
                 # the device finishes — that wait is the step's device time
                 t_dev = time.monotonic()
-                fetched = (
-                    np.asarray(out[0]), np.asarray(out[1]),
-                    np.asarray(out[2]), np.asarray(out[3]),
-                    np.asarray(out[6]),
-                )
+                fetched = self._fetch_spec(out[0], D1)
                 return fetched + (time.monotonic() - t_dev,)
 
-            emitted, adv, nxt, new_lengths, logprobs, device_s = (
+            t_wall = time.monotonic()
+            emitted, adv, nxt, new_lengths, n_real, logprobs, device_s = (
                 await loop.run_in_executor(self._executor, _run)
             )
             self._m_spec_steps(1)
             self.spec_steps += 1
+            self._spec_steps_since_cal += 1
             finished = False
             emitted_before = self.total_generated  # _emit_token counts each
             accepted_before = self.spec_accepted
@@ -5530,10 +5871,13 @@ class TpuServingEngine:
                         break
                 if not done:
                     self._current[slot_id] = int(nxt[slot_id])
+                    # the fused step appended this slot's accepted tokens
+                    # to its device context row in-program
+                    self._ctx_synced[slot_id] = base + a + 1
                 # only REAL drafts count as rejected (padding zeros never
                 # were drafts); drafts left unconsumed by a mid-burst
                 # stop/EOS were still wasted verify positions
-                rejected_step += max(0, drafted_real[slot_id] - acc_slot)
+                rejected_step += max(0, int(n_real[slot_id]) - acc_slot)
             self._m_tokens(self.total_generated - emitted_before)
             accepted_step = self.spec_accepted - accepted_before
             self.spec_rejected += rejected_step
@@ -5541,6 +5885,10 @@ class TpuServingEngine:
             drafted = self.spec_accepted + self.spec_rejected
             if drafted:
                 self._m_spec_ratio(self.spec_accepted / drafted)
+            self._spec_note_step(
+                self.total_generated - emitted_before,
+                time.monotonic() - t_wall,
+            )
             self._flight_record(
                 "verify",
                 device_s=device_s,
@@ -5549,6 +5897,9 @@ class TpuServingEngine:
                 spec_rejected=rejected_step,
                 program=program,
             )
+            if self._spec_check_uplift():
+                await self._flush_emits(live)
+                return
             await self._flush_emits(live)
             if (
                 finished
@@ -5596,15 +5947,6 @@ class TpuServingEngine:
             return True  # pre-r5 behavior (A/B knob): yield on any queue
         return any(s.free for s in self.slots)
 
-    # jitted so the pack is ONE async dispatch (eager ops can take the
-    # slow per-op path on relay backends); shape-polymorphic via jit cache
-    _pack_chunk = staticmethod(jax.jit(
-        lambda t, l: jnp.concatenate([
-            t.reshape(-1),
-            jax.lax.bitcast_convert_type(l, jnp.int32).reshape(-1),
-        ])
-    ))
-
     def _fetch_chunk(
         self, packed, k_steps: int
     ) -> tuple[np.ndarray, np.ndarray, float]:
@@ -5621,6 +5963,7 @@ class TpuServingEngine:
         t_dev = time.monotonic()
         flat = np.asarray(packed)
         fetch_s = time.monotonic() - t_dev
+        self._decode_fetches += 1
         return (
             flat[:n].reshape(k_steps, B),
             flat[n:].view(np.float32).reshape(k_steps, B),
@@ -5874,12 +6217,13 @@ class TpuServingEngine:
             self.profiler.dump_hlo(
                 f"decode_chunk_w{window}_s{sampler_mode}", decode_fn, *args
             )
-            chunk_t, chunk_lp, t, l, ck, cv = decode_fn(*args, **ad_kw)
+            packed, t, l, ck, cv = decode_fn(*args, **ad_kw)
             self.cache_k, self.cache_v = ck, cv
-            # pack tokens+logprobs NOW and start their D2H copy: by the
+            # tokens+logprobs were packed INSIDE the decode program
+            # (sample-in-program): start their D2H copy now, so by the
             # time the deferred _fetch_chunk wait runs, the transfer has
             # been riding under this dispatch's own device shadow
-            packed = self._pack_chunk(chunk_t, chunk_lp)
+            self._decode_dispatches += 1
             self._start_fetch(packed)
             return packed, t, l
 
@@ -6095,6 +6439,9 @@ class TpuServingEngine:
         immediate release safe)."""
         if self.block_mgr is None:
             return
+        # the slot's device-resident context row is dead with the request:
+        # the next occupant re-syncs from host truth
+        self._ctx_synced[slot_id] = 0
         if self._defer_release:
             self._deferred_releases.append(slot_id)
         else:
@@ -7272,6 +7619,13 @@ def flight_report(
             # configured engines only — the default payload stays
             # byte-identical (the non-streaming pin)
             entry["streaming"] = engine.streaming_section()
+        if engine.config.speculative_drafts > 0:
+            # fused decode-tail speculation posture: accept/uplift/
+            # auto-disable state rides /flight/summary so engine_top's
+            # speculation panel and --analyze thrash detection need no
+            # extra engine surface. Spec-configured engines only — the
+            # default payload stays byte-identical
+            entry["speculative"] = engine.speculative_section()
         if engine.incidents is not None:
             # incident-capture posture (docs/OBSERVABILITY.md "Incident
             # bundles & exemplars"): rides /flight/summary so engine_top's

@@ -413,8 +413,11 @@ class LockstepFollower:
                         jnp.asarray(desc["counts"]),
                     ]
                 out = fn(*args)
-                carry_tokens, carry_lengths = out[2], out[3]
-                engine.cache_k, engine.cache_v = out[4], out[5]
+                # out[0] is the packed tokens+logprobs array (sample-in-
+                # program): followers never fetch it — only the leader
+                # crosses the host boundary
+                carry_tokens, carry_lengths = out[1], out[2]
+                engine.cache_k, engine.cache_v = out[3], out[4]
             elif op == "prefill":
                 fn = engine._prefill_fn(
                     tuple(bool(x) for x in desc["sampler_mode"])
@@ -427,22 +430,36 @@ class LockstepFollower:
                     jnp.asarray(desc["topps"]),
                 )
                 engine.cache_k, engine.cache_v = out[2], out[3]
-            elif op == "verify":
-                # speculative verify: drafts are host data the leader
-                # already broadcast — replay the same jit (same key, so
-                # sampled acceptance matches bit-for-bit)
-                fn = engine._verify_fn(
+            elif op == "spec_step":
+                # fused draft+verify: drafting reads the device-resident
+                # context rows, so the descriptor carries only control
+                # state plus whichever rows the leader re-synced this step
+                # — replay the same jit (same key, so sampled acceptance
+                # matches bit-for-bit)
+                if engine._ctx_dev is None:
+                    engine._ctx_dev = jnp.zeros(
+                        (engine.config.slots,
+                         engine.model_config.max_seq_len),
+                        dtype=jnp.int32,
+                    )
+                if "ctx_rows" in desc:
+                    engine._ctx_dev = engine._ctx_dev.at[
+                        jnp.asarray(desc["ctx_rows"])
+                    ].set(jnp.asarray(desc["ctx_vals"]))
+                fn = engine._spec_step_fn(
                     int(desc["nrb"]),
                     tuple(bool(x) for x in desc["sampler_mode"]),
                 )
                 out = fn(
                     engine.params, engine.cache_k, engine.cache_v,
-                    jnp.asarray(desc["tokens"]), jnp.asarray(desc["lengths"]),
+                    engine._ctx_dev,
+                    jnp.asarray(desc["current"]), jnp.asarray(desc["lengths"]),
                     jnp.asarray(desc["active"]), jnp.asarray(desc["tables"]),
                     jnp.asarray(desc["key"]), jnp.asarray(desc["temps"]),
                     jnp.asarray(desc["topks"]), jnp.asarray(desc["topps"]),
                 )
-                engine.cache_k, engine.cache_v = out[4], out[5]
+                engine._ctx_dev = out[1]
+                engine.cache_k, engine.cache_v = out[2], out[3]
             elif op == "prefill_continue":
                 # prefix-cache suffix prefill: block adoption is host state
                 # the leader already resolved — the follower just replays

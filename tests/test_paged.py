@@ -309,6 +309,52 @@ def test_paged_kernel_partial_q8_matches_xla_reference():
     )
 
 
+def test_paged_kernel_q8_batch_leading_layout_pin():
+    """The batch-leading q8 accumulate (per-head dot_general, no block
+    transpose) ≡ the XLA gather path across the shapes the transpose
+    used to normalize: multiple kv_heads with a wide GQA group, a batch
+    larger than one sweep tile, ragged lengths including a sub-block row
+    and an exact block-boundary row."""
+    from langstream_tpu.models.llama import LlamaConfig
+    from langstream_tpu.models.llama_paged import _cache_partial_xla
+    from langstream_tpu.ops.paged_attention import (
+        merge_partial_attention, paged_attention_partial,
+    )
+    import dataclasses
+
+    c = dataclasses.replace(LlamaConfig.tiny(), heads=8, kv_heads=2)
+    B, H, D, Kh = 6, c.heads, c.head_dim, c.kv_heads
+    bs, nb, nrb = 8, 16, 2
+    k1, k2, k3, k4, k5 = jax.random.split(jax.random.PRNGKey(3), 5)
+    q = jax.random.normal(k1, (B, H, D), dtype=jnp.bfloat16)
+    pool_k = {
+        "q": jax.random.randint(k2, (nb, bs, Kh * D), -127, 128, jnp.int8),
+        "s": jax.random.uniform(k3, (nb, bs, Kh), jnp.float32, 0.01, 0.1),
+    }
+    pool_v = {
+        "q": jax.random.randint(k4, (nb, bs, Kh * D), -127, 128, jnp.int8),
+        "s": jax.random.uniform(k5, (nb, bs, Kh), jnp.float32, 0.01, 0.1),
+    }
+    tables = jnp.array(
+        [[1, 2], [3, 4], [5, 6], [7, 8], [9, 10], [11, 12]], jnp.int32
+    )
+    # ragged: sub-block, block-exact, and full-sweep rows all in one batch
+    lengths = jnp.array([3, 8, 11, 16, 5, 13], jnp.int32)
+
+    ref = _cache_partial_xla(c, q, pool_k, pool_v, tables, lengths, nrb)
+    got = paged_attention_partial(
+        q, pool_k, pool_v, tables, lengths,
+        num_read_blocks=nrb, kv_heads=Kh, head_dim=D, interpret=True,
+    )
+    out_ref = merge_partial_attention([ref])
+    out_got = merge_partial_attention([got])
+    np.testing.assert_allclose(
+        np.asarray(out_ref, dtype=np.float32),
+        np.asarray(out_got, dtype=np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
 # ---------------------------------------------------------------------------
 # engine integration
 # ---------------------------------------------------------------------------

@@ -154,11 +154,162 @@ def measure_continuation(name, mc, B, start, suffix, quantize, kernel, iters):
     del run, params, pk, pv, out
 
 
+def measure_fused_tail(name, mc, B, K, window, quantize, iters):
+    """Leg-1 ablation (``--fused-sampler``): the fused tail packs tokens +
+    bitcast logprobs INSIDE the decode program — the host's per-chunk work
+    is one fetch of an already-materialized array. The split tail (the
+    pre-fusion engine) gets the same decode outputs but pays a separate
+    pack dispatch before its fetch. Both run at equal K; ``host_tail_ms``
+    times ONLY the post-program host work (everything after a device
+    fence), which is the quantity the fusion deletes."""
+    from langstream_tpu.models.llama_paged import pack_tokens_logprobs
+
+    params = _params(mc, quantize)
+    cache_k, cache_v = init_kv_cache(mc, B)
+
+    def sample_fn(logits, sub):
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), t[:, None], axis=1
+        ).squeeze(1)
+        return t, lp
+
+    @jax.jit
+    def run_split(params, ck, cv, tokens, lengths, active, key):
+        return llama_decode_chunk(
+            mc, params, tokens, lengths, active, ck, cv,
+            sample_fn, key, K, window=window,
+        )
+
+    # the pre-fusion engine's separate pack program
+    pack = jax.jit(lambda t, l: jnp.concatenate([
+        t.reshape(-1),
+        jax.lax.bitcast_convert_type(l, jnp.int32).reshape(-1),
+    ]))
+
+    @jax.jit
+    def run_fused(params, ck, cv, tokens, lengths, active, key):
+        out = llama_decode_chunk(
+            mc, params, tokens, lengths, active, ck, cv,
+            sample_fn, key, K, window=window,
+        )
+        return (pack_tokens_logprobs(out[0], out[1]),) + out[2:]
+
+    tokens = jnp.zeros((B,), jnp.int32)
+    lengths = jnp.full((B,), 64, jnp.int32)
+    active = jnp.ones((B,), bool)
+    key = jax.random.PRNGKey(0)
+
+    for tail, runner in (("split", run_split), ("fused", run_fused)):
+        out = runner(params, cache_k, cache_v, tokens, lengths, active, key)
+        if tail == "split":
+            np.asarray(pack(out[0], out[1]))  # warm the pack variant too
+        else:
+            np.asarray(out[0])
+        np.asarray(out[2])
+        t0 = time.perf_counter()
+        host_s = 0.0
+        for _ in range(iters):
+            out = runner(
+                params, cache_k, cache_v, tokens, lengths, active, key
+            )
+            if tail == "split":
+                # fence the decode program, then time the host tail the
+                # split design pays: pack dispatch + packed fetch
+                np.asarray(out[2])
+                th = time.perf_counter()
+                np.asarray(pack(out[0], out[1]))
+                host_s += time.perf_counter() - th
+            else:
+                np.asarray(out[2])
+                th = time.perf_counter()
+                np.asarray(out[0])
+                host_s += time.perf_counter() - th
+        chunk_ms = (time.perf_counter() - t0) / iters * 1e3
+        host_ms = host_s / iters * 1e3
+        print(json.dumps({
+            "name": f"{name}-{tail}", "B": B, "K": K, "window": window,
+            "quant": quantize,
+            "chunk_ms": round(chunk_ms, 2),
+            "host_tail_ms": round(host_ms, 3),
+            "host_tail_ms_per_step": round(host_ms / K, 4),
+        }), flush=True)
+    del params, cache_k, cache_v
+
+
+def measure_device_draft(name, B, S, D, steps):
+    """Leg-2 ablation (``--device-draft``): steady-state per-step drafting
+    cost for B slots — the engine's incremental host bigram loop (dict
+    update + lookup + slice, per slot, per step) vs ONE jitted vmapped
+    ``prompt_lookup_draft`` dispatch over the device-resident context
+    rows. ``match`` cross-checks the two drafters token-for-token on the
+    final step (the fused engine path relies on this equivalence)."""
+    from langstream_tpu.models.llama_paged import prompt_lookup_draft
+
+    rng = np.random.default_rng(0)
+    half = S // 2
+    ctx = rng.integers(1, 97, size=(B, S)).astype(np.int32)
+    ctx[:, half:] = ctx[:, : S - half]  # repetitive: lookups actually hit
+    n0 = S - steps - 1
+
+    # --- host bigram loop (engine._draft_tokens semantics) ---
+    idxs: list[dict] = []
+    for b in range(B):
+        row, idx = ctx[b], {}
+        for i in range(1, n0 - 1):
+            idx[(int(row[i - 1]), int(row[i]))] = i - 1
+        idxs.append(idx)
+    host_drafts = np.zeros((B, D), np.int32)
+    t0 = time.perf_counter()
+    for s in range(steps):
+        n = n0 + s
+        for b in range(B):
+            row, idx = ctx[b], idxs[b]
+            idx[(int(row[n - 2]), int(row[n - 1]))] = n - 2
+            pos = idx.get((int(row[n - 1]), int(row[n])))
+            if pos is not None:
+                cont = row[pos + 2 : pos + 2 + D]
+                host_drafts[b, : len(cont)] = cont
+                host_drafts[b, len(cont):] = 0
+            else:
+                host_drafts[b] = 0
+    host_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    # --- jitted device drafter (one dispatch for all B slots) ---
+    draft_fn = jax.jit(
+        jax.vmap(lambda row, ln: prompt_lookup_draft(row, ln, D))
+    )
+    ctx_dev = jnp.asarray(ctx)
+    out = draft_fn(ctx_dev, jnp.full((B,), n0 + 1, jnp.int32))
+    np.asarray(out[0])  # warm
+    t0 = time.perf_counter()
+    for s in range(steps):
+        out = draft_fn(ctx_dev, jnp.full((B,), n0 + s + 1, jnp.int32))
+    dev_drafts = np.asarray(out[0])
+    dev_ms = (time.perf_counter() - t0) / steps * 1e3
+    print(json.dumps({
+        "name": name, "B": B, "ctx": S, "drafts": D, "steps": steps,
+        "host_ms_per_step": round(host_ms, 4),
+        "dispatch_ms_per_step": round(dev_ms, 4),
+        "match": bool((host_drafts == dev_drafts).all()),
+    }), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument(
         "--phase", choices=["decode", "continuation", "all"], default="all"
+    )
+    ap.add_argument(
+        "--fused-sampler", action="store_true",
+        help="run ONLY the leg-1 ablation: fused in-program sample+pack "
+             "tail vs the pre-fusion split tail, at equal K",
+    )
+    ap.add_argument(
+        "--device-draft", action="store_true",
+        help="run ONLY the leg-2 ablation: host bigram drafting loop vs "
+             "one jitted prompt-lookup dispatch (no model forward)",
     )
     ap.add_argument(
         "--model", choices=["llama-1b", "llama3-8b", "tiny"],
@@ -188,6 +339,24 @@ def main():
             print(json.dumps(
                 {"name": name, "error": f"{type(e).__name__}: {e}"}
             ), flush=True)
+
+    if args.fused_sampler or args.device_draft:
+        # targeted ablations replace the sweep: each prints its own JSON
+        # rows and exits so a CI smoke can assert on exactly one leg
+        if args.fused_sampler:
+            quant = None if args.model == "tiny" else "int8"
+            safe(
+                measure_fused_tail, "fused-tail", mc, B, K, W, quant,
+                args.iters,
+            )
+        if args.device_draft:
+            # draft width 4 matches the engine's speculative default
+            # shape; steps large enough for a steady-state per-step mean
+            safe(
+                measure_device_draft, "device-draft", B,
+                mc.max_seq_len, 4, 16 if args.model == "tiny" else 64,
+            )
+        return
 
     if args.phase in ("decode", "all"):
         # bench shape baseline

@@ -212,9 +212,14 @@ def render(report: list[dict]) -> str:
         lines.extend(_render_survival(entry.get("survival"), events))
         lines.extend(_render_streaming(entry.get("streaming"), events))
         lines.extend(_render_incidents(entry.get("incidents"), events))
+        lines.extend(_render_speculative(entry.get("speculative"), events))
         spec_acc = totals.get("spec_accepted") or 0
         spec_rej = totals.get("spec_rejected") or 0
-        if spec_acc or spec_rej:
+        # legacy totals-based line for old payloads without the
+        # speculation section — superseded by the panel above
+        if (spec_acc or spec_rej) and not isinstance(
+            entry.get("speculative"), dict
+        ):
             drafted = spec_acc + spec_rej
             lines.append(
                 f"spec     accepted {spec_acc}/{drafted} "
@@ -601,6 +606,55 @@ def _render_incidents(incidents: dict | None, events: list[dict]) -> list[str]:
             f"events {bundle.get('events', 0)}  "
             f"journeys {bundle.get('journeys', 0)}"
         )
+    return lines
+
+
+def _render_speculative(
+    speculative: dict | None, events: list[dict]
+) -> list[str]:
+    """Speculation panel (docs/OBSERVABILITY.md): fused decode-tail
+    posture — accept ratio, the dispatch/fetch ledger (1:1 by the one-
+    packed-fetch-per-step contract, so daylight between them is a host
+    fetch leak), the measured spec-vs-plain uplift with the rolling
+    window fill, the auto-disable state, and the most recent
+    enable/disable flip event. Rendered only for speculative-configured
+    engines — the section is absent otherwise and default payloads
+    render unchanged."""
+    if not isinstance(speculative, dict):
+        return []
+    lines: list[str] = []
+    acc = speculative.get("drafts_accepted") or 0
+    rej = speculative.get("rejected") or 0
+    drafted = acc + rej
+    lines.append(
+        f"spec     steps {speculative.get('steps', 0)}  accepted "
+        f"{acc}/{drafted}"
+        + (f" ({100 * acc / drafted:.1f}%)" if drafted else "")
+        + f"  dispatch/fetch {speculative.get('dispatches', 0)}/"
+        f"{speculative.get('fetches', 0)}"
+    )
+    uplift = speculative.get("uplift")
+    lines.append(
+        "spec     uplift "
+        + (f"{uplift:.2f}x" if uplift is not None else "- (calibrating)")
+        + ("  auto-DISABLED" if speculative.get("auto_disabled")
+           else "  auto on")
+        + f"  flips {speculative.get('flips', 0)}  window "
+        f"{speculative.get('window_steps', 0)} spec/"
+        f"{speculative.get('window_plain', 0)} plain"
+    )
+    last = next(
+        (
+            e for e in reversed(events)
+            if e.get("kind") in ("spec-auto-disable", "spec-auto-enable")
+        ),
+        None,
+    )
+    if last is not None:
+        detail = {
+            k: v for k, v in last.items() if k not in ("kind", "t_ms", "seq")
+        }
+        lines.append(f"spec     last flip {last.get('kind')} {detail}")
     return lines
 
 
@@ -1183,6 +1237,44 @@ def _anomalies(entry: dict) -> list[str]:
             f"replica that keeps failing; the failure is load-shaped "
             f"(use Retry-After holds / scale the pool), not a dead pod"
         )
+    # speculation enable/disable thrash (docs/OBSERVABILITY.md): >=3
+    # spec-auto-* flips inside one event window means the measured
+    # uplift is hovering at the 1.0 boundary — every flip re-pays a
+    # calibration chunk and a cold draft window, so the engine is
+    # oscillating between two equally-slow modes instead of settling.
+    # Falls back to the section's cumulative flip counter when only a
+    # rollup survived (no event tail).
+    spec_flip_events = [
+        e for e in events
+        if e.get("kind") in ("spec-auto-disable", "spec-auto-enable")
+    ]
+    spec_section = entry.get("speculative")
+    section_flips = (
+        spec_section.get("flips") or 0
+        if isinstance(spec_section, dict) else 0
+    )
+    if len(spec_flip_events) >= 3 or (
+        not events and section_flips >= 3
+    ):
+        uplifts = [
+            e.get("uplift") for e in spec_flip_events
+            if e.get("uplift") is not None
+        ]
+        detail = (
+            f" (recent uplift {', '.join(f'{u:.2f}' for u in uplifts[-3:])})"
+            if uplifts else ""
+        )
+        flip_count = (
+            len(spec_flip_events) if spec_flip_events else section_flips
+        )
+        flags.append(
+            f"speculation thrash: {flip_count} enable/disable "
+            f"flips in the event window{detail} — measured uplift is "
+            f"hovering at the 1.0 boundary and every flip re-pays a "
+            f"calibration chunk; pin speculation off "
+            f"(speculative-drafts 0) for this workload or widen "
+            f"LS_TPU_SPEC_UPLIFT_WINDOW so the estimate stops oscillating"
+        )
     # stream stall storm (docs/OBSERVABILITY.md Streaming): one request
     # tripping the stall line >=3 times means its client repeatedly sat
     # past the class's TBT budget mid-stream — a convoyed decode loop or
@@ -1467,6 +1559,12 @@ def analyze(dump) -> str:
                 streaming, entry.get("events") or []
             ):
                 lines.append(f"  {line}")
+        speculative = entry.get("speculative")
+        if isinstance(speculative, dict):
+            for line in _render_speculative(
+                speculative, entry.get("events") or []
+            ):
+                lines.append(f"  {line}")
         flags = _anomalies(entry)
         for flag in flags:
             lines.append(f"  !! {flag}")
@@ -1575,6 +1673,7 @@ def render_json(report: list[dict]) -> list[dict]:
             "survival": entry.get("survival"),
             "streaming": entry.get("streaming"),
             "incidents": entry.get("incidents"),
+            "speculative": entry.get("speculative"),
             "memory": entry.get("memory"),
             "programs": entry.get("programs"),
         }
@@ -1590,6 +1689,9 @@ def render_json(report: list[dict]) -> list[dict]:
             "survival": _render_survival(sections["survival"], events),
             "streaming": _render_streaming(sections["streaming"], events),
             "incidents": _render_incidents(sections["incidents"], events),
+            "speculative": _render_speculative(
+                sections["speculative"], events
+            ),
             "memory": _render_memory(sections["memory"]),
             "programs": _render_programs(sections["programs"]),
         }

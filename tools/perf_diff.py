@@ -59,6 +59,15 @@ METRICS: dict[str, str] = {
     "hbm_utilization": "down",
     "speculative_uplift": "down",
     "speculative_accepted_per_step": "down",
+    # device-resident decode tail (fused sampler): the engine's one-
+    # packed-fetch-per-chunk invariant on the record — any drift above
+    # 1.0 means the tail re-crossed the host boundary
+    "decode_host_fetches_per_chunk": "up",
+    # engine-measured rolling uplift (spec window vs plain calibration
+    # chunks) and per-step fused fetch ratio from the speculation
+    # section of schema-2 records
+    "speculative_measured_uplift": "down",
+    "speculative_fetches_per_step": "up",
     "gateway_ttft_p50_s": "up",
     "prefix_cache_speedup": "down",
     "recompile_count": "up",
@@ -256,6 +265,11 @@ def extract_metrics(payload) -> dict:
                         metrics.setdefault(
                             "recompile_count", flight["recompile_count"]
                         )
+                if leg.get("decode_host_fetches_per_chunk") is not None:
+                    metrics.setdefault(
+                        "decode_host_fetches_per_chunk",
+                        leg["decode_host_fetches_per_chunk"],
+                    )
         spec = detail.get("speculative")
         if isinstance(spec, dict):
             if spec.get("uplift") is not None:
@@ -264,6 +278,17 @@ def extract_metrics(payload) -> dict:
                 metrics["speculative_accepted_per_step"] = spec[
                     "accepted_per_step"
                 ]
+            # the engine's own speculation section (schema-2): rolling
+            # measured uplift and the fused one-fetch-per-step ratio
+            eng = spec.get("engine")
+            if isinstance(eng, dict):
+                if eng.get("uplift") is not None:
+                    metrics["speculative_measured_uplift"] = eng["uplift"]
+                steps = eng.get("steps") or eng.get("dispatches")
+                if steps and eng.get("fetches") is not None:
+                    metrics["speculative_fetches_per_step"] = round(
+                        eng["fetches"] / steps, 4
+                    )
         if detail.get("gateway_ttft_p50_s") is not None:
             metrics["gateway_ttft_p50_s"] = detail["gateway_ttft_p50_s"]
         prefix = detail.get("prefix_cache")
